@@ -1,0 +1,29 @@
+"""polyaxon_tpu — a TPU-native ML orchestration framework.
+
+A brand-new framework with the capability surface of the reference
+(zchunhai/polyaxon — declarative polyaxonfile specs, compile, schedule,
+run, track, tune, stream, recover), re-designed TPU-first:
+
+- ``flow``:          declarative spec schemas (components, operations,
+                     runtime kinds incl. TPUJob, matrix kinds).
+- ``polyaxonfile``:  YAML reading/merging/param overrides.
+- ``compiler``:      param/context resolution -> CompiledOperation.
+- ``tracking``:      in-process experiment tracking (traceml-equivalent).
+- ``client``:        run/project clients over the local store or API.
+- ``runner``:        local + distributed executors, agent.
+- ``scheduler``:     control plane: queue, DAG/matrix progression, streams.
+- ``k8s``:           converter emitting TPU-slice manifests.
+- ``parallel``:      JAX distributed runtime: mesh, DP/TP/PP/SP/CP/EP,
+                     ring attention, Ulysses, ICI/DCN collectives.
+- ``ops``:           Pallas/XLA kernels for hot ops.
+- ``models``:        flagship model families (ResNet, BERT, GPT-2, ...).
+- ``tune``:          hyperparameter search (grid/random/hyperband/bayes/...).
+
+Unlike the reference — which delegates distributed compute to
+NCCL/MPI/Kubeflow operators (SURVEY.md section 2.5/5.8) — this framework
+owns the device mesh natively via jax.sharding/pjit/shard_map.
+"""
+
+__version__ = "0.1.0"
+
+DIST = "polyaxon-tpu"

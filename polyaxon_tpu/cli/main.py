@@ -1,0 +1,17 @@
+"""CLI entrypoint. Command groups are registered as subsystems land."""
+
+from __future__ import annotations
+
+import click
+
+from polyaxon_tpu import __version__
+
+
+@click.group(name="ptpu")
+@click.version_option(version=__version__, prog_name="polyaxon-tpu")
+def cli():
+    """polyaxon-tpu: TPU-native ML orchestration."""
+
+
+if __name__ == "__main__":
+    cli()

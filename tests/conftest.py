@@ -1,0 +1,29 @@
+"""Test harness config: force JAX onto 8 virtual CPU devices.
+
+Multi-chip hardware is not available in CI; sharding/collective tests run on
+a virtual CPU mesh (SURVEY.md section 4: "multi-node without a cluster").
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("POLYAXON_TPU_NO_TPU", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_home(tmp_path, monkeypatch):
+    """Isolate user home/config so tests never touch ~/.polyaxon_tpu."""
+    home = tmp_path / "home"
+    home.mkdir()
+    monkeypatch.setenv("POLYAXON_TPU_HOME", str(home))
+    monkeypatch.delenv("POLYAXON_TPU_RUN_UUID", raising=False)
+    monkeypatch.delenv("POLYAXON_TPU_PROJECT", raising=False)
+    return home

@@ -1,0 +1,311 @@
+"""Schema round-trip tests: every V1* model serializes/validates/deserializes.
+
+Mirrors the reference's per-model round-trip test strategy (SURVEY.md §4).
+"""
+
+import pytest
+
+from polyaxon_tpu.flow import (
+    V1IO,
+    V1Bayes,
+    V1Component,
+    V1CompiledOperation,
+    V1Container,
+    V1Environment,
+    V1GridSearch,
+    V1Hyperband,
+    V1Job,
+    V1MPIJob,
+    V1Mapping,
+    V1Operation,
+    V1Param,
+    V1PytorchJob,
+    V1RandomSearch,
+    V1Service,
+    V1SliceSpec,
+    V1TFJob,
+    V1TPUJob,
+    V1Termination,
+    parse_matrix,
+    parse_runtime,
+)
+from polyaxon_tpu.flow.base import patch_dict
+
+
+class TestIO:
+    def test_round_trip(self):
+        io = V1IO.from_dict(
+            {"name": "lr", "type": "float", "value": 0.1, "isOptional": True}
+        )
+        assert io.name == "lr"
+        assert io.is_optional is True
+        d = io.to_dict()
+        assert d["isOptional"] is True
+        assert V1IO.from_dict(d) == io
+
+    def test_snake_case_accepted(self):
+        io = V1IO.from_dict({"name": "x", "is_optional": True})
+        assert io.is_optional is True
+
+    def test_bad_type_rejected(self):
+        with pytest.raises(Exception):
+            V1IO.from_dict({"name": "x", "type": "tensor"})
+
+    def test_validate_value_coerces(self):
+        io = V1IO(name="n", type="int")
+        assert io.validate_value("3") == 3
+        with pytest.raises(ValueError):
+            io.validate_value("abc")
+
+    def test_options(self):
+        io = V1IO(name="opt", type="str", options=["a", "b"])
+        assert io.validate_value("a") == "a"
+        with pytest.raises(ValueError):
+            io.validate_value("c")
+
+    def test_list_io(self):
+        io = V1IO(name="xs", type="int", is_list=True)
+        assert io.validate_value(["1", 2]) == [1, 2]
+
+
+class TestParam:
+    def test_literal(self):
+        p = V1Param(value=3)
+        assert p.is_literal and not p.is_template
+
+    def test_template(self):
+        p = V1Param(value="{{ globals.run_outputs_path }}")
+        assert p.is_template and not p.is_literal
+
+    def test_ref_validation(self):
+        assert V1Param(value="out", ref="ops.train").ref == "ops.train"
+        with pytest.raises(Exception):
+            V1Param(value="x", ref="bogus ref!")
+
+
+class TestRuntimeKinds:
+    def test_job(self):
+        rt = parse_runtime(
+            {"kind": "job", "container": {"image": "py:3", "command": ["python"]}}
+        )
+        assert isinstance(rt, V1Job)
+        assert rt.container.image == "py:3"
+
+    def test_service(self):
+        rt = parse_runtime({"kind": "service", "ports": [8888], "replicas": 2})
+        assert isinstance(rt, V1Service)
+
+    def test_tpujob(self):
+        rt = parse_runtime(
+            {
+                "kind": "tpujob",
+                "slice": {"type": "v5litepod-16", "topology": "4x4", "numSlices": 2},
+                "worker": {"replicas": 4, "container": {"image": "jax:latest"}},
+            }
+        )
+        assert isinstance(rt, V1TPUJob)
+        assert rt.slice.chips_per_slice == 16
+        assert rt.slice.hosts_per_slice == 4
+        assert rt.slice.total_chips == 32
+
+    def test_tfjob_compat(self):
+        rt = parse_runtime(
+            {
+                "kind": "tfjob",
+                "worker": {"replicas": 8, "container": {"image": "tf"}},
+                "chief": {"replicas": 1, "container": {"image": "tf"}},
+            }
+        )
+        assert isinstance(rt, V1TFJob)
+        assert rt.worker.replicas == 8
+
+    def test_pytorchjob_compat(self):
+        rt = parse_runtime(
+            {"kind": "pytorchjob", "master": {"replicas": 1}, "worker": {"replicas": 3}}
+        )
+        assert isinstance(rt, V1PytorchJob)
+
+    def test_mpijob_compat(self):
+        rt = parse_runtime(
+            {"kind": "mpijob", "launcher": {"replicas": 1}, "worker": {"replicas": 4}}
+        )
+        assert isinstance(rt, V1MPIJob)
+
+    def test_unknown_kind(self):
+        with pytest.raises(ValueError, match="Unknown run kind"):
+            parse_runtime({"kind": "sparkjob"})
+
+    def test_slice_inference(self):
+        s = V1SliceSpec(type="v5litepod-256", chips_per_host=4)
+        assert s.chips_per_slice == 256
+        assert s.hosts_per_slice == 64
+
+
+class TestMatrix:
+    def test_grid(self):
+        m = parse_matrix(
+            {"kind": "grid", "params": {"lr": {"kind": "choice", "value": [0.1, 0.2]}}}
+        )
+        assert isinstance(m, V1GridSearch)
+
+    def test_random(self):
+        m = parse_matrix(
+            {
+                "kind": "random",
+                "numRuns": 5,
+                "params": {"lr": {"kind": "loguniform", "value": [1e-5, 1e-1]}},
+            }
+        )
+        assert isinstance(m, V1RandomSearch)
+        assert m.num_runs == 5
+
+    def test_hyperband(self):
+        m = parse_matrix(
+            {
+                "kind": "hyperband",
+                "maxIterations": 81,
+                "eta": 3,
+                "resource": {"name": "epochs", "type": "int"},
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "params": {"lr": {"kind": "uniform", "value": [0.0, 1.0]}},
+            }
+        )
+        assert isinstance(m, V1Hyperband)
+        assert m.metric.is_better(0.1, 0.2)
+
+    def test_bayes(self):
+        m = parse_matrix(
+            {
+                "kind": "bayes",
+                "numInitialRuns": 3,
+                "maxIterations": 7,
+                "metric": {"name": "acc", "optimization": "maximize"},
+                "params": {"lr": {"kind": "uniform", "value": [0, 1]}},
+            }
+        )
+        assert isinstance(m, V1Bayes)
+
+    def test_mapping(self):
+        m = parse_matrix({"kind": "mapping", "values": [{"lr": 0.1}, {"lr": 0.2}]})
+        assert isinstance(m, V1Mapping)
+
+    def test_pchoice_probability_check(self):
+        with pytest.raises(Exception):
+            parse_matrix(
+                {
+                    "kind": "random",
+                    "numRuns": 2,
+                    "params": {"x": {"kind": "pchoice", "value": [["a", 0.5], ["b", 0.2]]}},
+                }
+            )
+
+
+class TestComponentOperation:
+    def _component(self):
+        return V1Component.from_dict(
+            {
+                "kind": "component",
+                "name": "trainer",
+                "inputs": [
+                    {"name": "lr", "type": "float", "value": 0.01, "isOptional": True},
+                    {"name": "epochs", "type": "int"},
+                ],
+                "outputs": [{"name": "accuracy", "type": "float"}],
+                "run": {
+                    "kind": "job",
+                    "container": {
+                        "image": "jax:latest",
+                        "command": ["python", "train.py"],
+                        "args": ["--lr={{ lr }}", "--epochs={{ epochs }}"],
+                    },
+                },
+            }
+        )
+
+    def test_component_round_trip(self):
+        c = self._component()
+        assert c.get_io("lr").type == "float"
+        c2 = V1Component.from_dict(c.to_dict())
+        assert c2 == c
+
+    def test_validate_params_defaults_and_required(self):
+        c = self._component()
+        params = c.validate_params({"epochs": 3})
+        assert params["lr"].value == 0.01
+        assert params["epochs"].value == 3
+        with pytest.raises(ValueError, match="required"):
+            c.validate_params({})
+        with pytest.raises(ValueError, match="not declared"):
+            c.validate_params({"epochs": 1, "bogus": 2})
+
+    def test_param_type_coercion(self):
+        c = self._component()
+        params = c.validate_params({"epochs": "7"})
+        assert params["epochs"].value == 7
+
+    def test_operation(self):
+        op = V1Operation.from_dict(
+            {
+                "kind": "operation",
+                "name": "train-1",
+                "params": {"epochs": {"value": 2}, "lr": 0.1},
+                "component": self._component().to_dict(),
+            }
+        )
+        assert op.params["lr"].value == 0.1
+        assert op.component.name == "trainer"
+
+    def test_operation_single_source(self):
+        with pytest.raises(Exception, match="one component source"):
+            V1Operation.from_dict(
+                {
+                    "kind": "operation",
+                    "hubRef": "a",
+                    "pathRef": "./b.yaml",
+                }
+            )
+
+    def test_compiled_operation(self):
+        co = V1CompiledOperation.from_dict(
+            {
+                "kind": "compiled_operation",
+                "name": "train-1",
+                "inputs": [{"name": "lr", "type": "float", "value": 0.1}],
+                "run": {"kind": "tpujob", "worker": {"replicas": 2}},
+            }
+        )
+        assert co.is_distributed
+        assert co.get_io_dict() == {"lr": 0.1}
+
+
+class TestPatchDict:
+    def test_post_merge(self):
+        assert patch_dict({"a": 1, "b": {"c": 1}}, {"b": {"c": 2, "d": 3}}) == {
+            "a": 1,
+            "b": {"c": 2, "d": 3},
+        }
+
+    def test_pre_merge(self):
+        assert patch_dict({"a": 1}, {"a": 2, "b": 3}, "pre_merge") == {"a": 1, "b": 3}
+
+    def test_replace(self):
+        assert patch_dict({"a": 1}, {"b": 2}, "replace") == {"b": 2}
+
+    def test_isnull(self):
+        assert patch_dict({"a": None, "b": 1}, {"a": 2, "b": 9}, "isnull") == {
+            "a": 2,
+            "b": 1,
+        }
+
+
+class TestMisc:
+    def test_termination(self):
+        t = V1Termination.from_dict({"maxRetries": 3, "timeout": 60})
+        assert t.max_retries == 3
+
+    def test_environment_open(self):
+        e = V1Environment.from_dict(
+            {"nodeSelector": {"cloud.google.com/gke-tpu-topology": "4x4"},
+             "someFutureField": 1}
+        )
+        assert e.node_selector["cloud.google.com/gke-tpu-topology"] == "4x4"
