@@ -86,32 +86,29 @@ class V1Component(BaseSchema):
         Returns the full resolved param dict (including defaulted inputs).
         Raises on unknown params, missing required inputs, or type errors.
         """
+        from .io import check_declared_params, fill_default_params
+
         params = params_from_dict(params)
         declared = {io.name: io for io in (self.inputs or [])}
         out_names = {io.name for io in (self.outputs or [])}
+        owner = f"component {self.name!r}"
 
+        check_declared_params(
+            [n for n, p in params.items() if not p.context_only],
+            declared, out_names, owner,
+        )
         for name, param in params.items():
-            if param.context_only:
-                continue
-            if name not in declared and name not in out_names:
-                raise ValueError(
-                    f"Param {name!r} is not declared as an input/output of "
-                    f"component {self.name!r}"
-                )
             io = declared.get(name)
             if io is not None and param.is_literal and param.value is not None:
                 param.value = io.validate_value(param.value)
 
-        for name, io in declared.items():
-            if name in params:
-                continue
-            if io.value is not None:
-                params[name] = V1Param(value=io.value)
-            elif not io.is_optional and not is_template:
-                raise ValueError(
-                    f"Input {name!r} of component {self.name!r} is required "
-                    "but no param was given and it has no default"
-                )
+        filled = fill_default_params(
+            declared, {n: p for n, p in params.items()}, owner,
+            require=not is_template,
+        )
+        for name, value in filled.items():
+            if name not in params:
+                params[name] = V1Param(value=value)
         return params
 
 

@@ -36,7 +36,33 @@ IO_TYPES = {
 }
 
 REF_RE = re.compile(r"^(runs\.[\w-]+|ops\.[\w-]+|dag|matrix|globals)$")
-TEMPLATE_RE = re.compile(r"{{\s*([^}\s]+)\s*}}")
+# Canonical template pattern; the compiler's template engine imports this.
+TEMPLATE_RE = re.compile(r"{{\s*(.*?)\s*}}")
+
+
+def check_declared_params(names, declared, out_names, owner: str = "component"):
+    """Raise if any supplied param name is not a declared input/output."""
+    for name in names:
+        if name not in declared and name not in out_names:
+            raise ValueError(
+                f"Param {name!r} is not declared as an input/output of {owner}"
+            )
+
+
+def fill_default_params(declared, resolved, owner: str = "component",
+                        require: bool = True):
+    """Fill IO defaults into ``resolved``; raise on missing required inputs."""
+    for name, io in declared.items():
+        if name in resolved:
+            continue
+        if io.value is not None:
+            resolved[name] = io.value
+        elif not io.is_optional and require:
+            raise ValueError(
+                f"Input {name!r} of {owner} is required but no param was "
+                "given and it has no default"
+            )
+    return resolved
 
 
 def check_io_value(value: Any, type_: Optional[str]) -> bool:

@@ -1,0 +1,13 @@
+"""Polyaxonfile reading: YAML -> V1Operation / V1Component.
+
+Parity with the reference's ``polyaxon/_polyaxonfile/`` (SURVEY.md 2.2 —
+unverified path): multi-file merge, ``-P`` param overrides, presets,
+``--patch`` run patches.
+"""
+
+from .reader import (
+    OperationSpecification,
+    check_polyaxonfile,
+    get_op_from_files,
+    read_polyaxonfile,
+)

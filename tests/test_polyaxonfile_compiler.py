@@ -1,0 +1,238 @@
+"""Polyaxonfile reading + compiler resolution tests (SURVEY.md §4 strategy:
+YAML fixtures, resolved param/context assertions)."""
+
+import pytest
+import yaml
+
+from polyaxon_tpu.compiler import (
+    CompilerError,
+    TopologyError,
+    build_contexts,
+    build_globals,
+    make_compiled,
+    normalize,
+    resolve,
+    resolve_obj,
+    resolve_str,
+)
+from polyaxon_tpu.flow import V1Operation
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile, get_op_from_files
+from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
+
+COMPONENT_YAML = """
+kind: component
+name: trainer
+inputs:
+  - {name: lr, type: float, value: 0.01, isOptional: true}
+  - {name: epochs, type: int}
+outputs:
+  - {name: accuracy, type: float}
+run:
+  kind: job
+  container:
+    image: jax:latest
+    command: [python, train.py]
+    args: ["--lr={{ lr }}", "--epochs={{ epochs }}", "--out={{ globals.run_outputs_path }}"]
+"""
+
+OPERATION_YAML = """
+kind: operation
+name: train-op
+params:
+  epochs: 4
+component:
+""" + "\n".join("  " + line for line in COMPONENT_YAML.strip().splitlines())
+
+
+class TestPolyaxonfile:
+    def test_component_file_wraps_into_operation(self, tmp_path):
+        f = tmp_path / "comp.yaml"
+        f.write_text(COMPONENT_YAML)
+        op = get_op_from_files(str(f), params={"epochs": "3"})
+        assert isinstance(op, V1Operation)
+        assert op.component.name == "trainer"
+        assert op.params["epochs"].value == 3
+
+    def test_operation_file(self, tmp_path):
+        f = tmp_path / "op.yaml"
+        f.write_text(OPERATION_YAML)
+        op = get_op_from_files(str(f))
+        assert op.name == "train-op"
+        assert op.params["epochs"].value == 4
+
+    def test_multi_file_merge(self, tmp_path):
+        f1 = tmp_path / "op.yaml"
+        f1.write_text(OPERATION_YAML)
+        f2 = tmp_path / "override.yaml"
+        f2.write_text("name: train-v2\nparams:\n  epochs: 9\n")
+        op = get_op_from_files([str(f1), str(f2)])
+        assert op.name == "train-v2"
+        assert op.params["epochs"].value == 9
+
+    def test_param_override_wins(self, tmp_path):
+        f = tmp_path / "op.yaml"
+        f.write_text(OPERATION_YAML)
+        op = get_op_from_files(str(f), params={"epochs": "12", "lr": "0.5"})
+        assert op.params["epochs"].value == 12
+        assert op.params["lr"].value == 0.5
+
+    def test_preset_merge(self, tmp_path):
+        f = tmp_path / "op.yaml"
+        f.write_text(OPERATION_YAML)
+        preset = tmp_path / "preset.yaml"
+        preset.write_text(
+            "isPreset: true\nkind: operation\nqueue: tpu-queue\n"
+            "termination: {maxRetries: 5}\n"
+        )
+        op = get_op_from_files(str(f), presets=[str(preset)])
+        assert op.queue == "tpu-queue"
+        assert op.termination.max_retries == 5
+
+    def test_missing_file(self):
+        with pytest.raises(PolyaxonfileError, match="not found"):
+            get_op_from_files("/nonexistent/x.yaml")
+
+    def test_bad_kind(self, tmp_path):
+        f = tmp_path / "bad.yaml"
+        f.write_text("kind: pipeline\n")
+        with pytest.raises(PolyaxonfileError, match="kind"):
+            get_op_from_files(str(f))
+
+    def test_check_validates_required(self, tmp_path):
+        f = tmp_path / "comp.yaml"
+        f.write_text(COMPONENT_YAML)
+        with pytest.raises(Exception, match="required"):
+            check_polyaxonfile(str(f))
+        check_polyaxonfile(str(f), params={"epochs": "2"})
+
+
+class TestTemplates:
+    CTX = build_contexts(build_globals("uid-1", "runx"), inputs={"lr": 0.1, "n": 2})
+
+    def test_bare_io(self):
+        assert resolve_str("{{ lr }}", self.CTX) == 0.1
+
+    def test_native_type_preserved(self):
+        assert resolve_str("{{ n }}", self.CTX) == 2
+        assert resolve_str("n={{ n }}!", self.CTX) == "n=2!"
+
+    def test_globals(self):
+        out = resolve_str("{{ globals.run_outputs_path }}", self.CTX)
+        assert out.endswith("uid-1/outputs")
+
+    def test_filters(self):
+        assert resolve_str("{{ lr | str }}", self.CTX) == "0.1"
+
+    def test_unknown_path_raises(self):
+        with pytest.raises(ValueError, match="Unknown context path"):
+            resolve_str("{{ nope.x }}", self.CTX)
+
+    def test_nested_obj(self):
+        obj = {"args": ["--lr={{ lr }}"], "plain": "x"}
+        assert resolve_obj(obj, self.CTX) == {"args": ["--lr=0.1"], "plain": "x"}
+
+
+class TestResolve:
+    def _op(self):
+        return get_op_from_files(yaml.safe_load(OPERATION_YAML))
+
+    def test_full_resolution(self):
+        compiled = resolve(self._op(), run_uuid="abc123", project="proj")
+        args = compiled.run.container.args
+        assert args[0] == "--lr=0.01"
+        assert args[1] == "--epochs=4"
+        assert args[2].endswith("abc123/outputs")
+        assert compiled.get_io_dict() == {"lr": 0.01, "epochs": 4}
+
+    def test_matrix_values(self):
+        op = self._op()
+        compiled = resolve(op, run_uuid="m1", matrix_values={"lr": 0.9})
+        assert compiled.get_io_dict()["lr"] == 0.9
+
+    def test_missing_required_param(self):
+        op = self._op()
+        op.params = None
+        with pytest.raises(CompilerError, match="is required"):
+            resolve(op, run_uuid="x")
+
+    def test_run_patch(self):
+        op = self._op()
+        op.run_patch = {"container": {"image": "jax:nightly"}}
+        compiled = make_compiled(op)
+        assert compiled.run.container.image == "jax:nightly"
+        assert compiled.run.container.command == ["python", "train.py"]
+
+    def test_type_validation_after_resolution(self):
+        op = self._op()
+        op.params["epochs"].value = "not-a-number"
+        with pytest.raises(Exception):
+            resolve(op, run_uuid="x")
+
+
+class TestTopology:
+    def test_tfjob_normalizes(self):
+        op = get_op_from_files(
+            {
+                "kind": "operation",
+                "component": {
+                    "kind": "component",
+                    "run": {
+                        "kind": "tfjob",
+                        "slice": {"type": "v5litepod-16", "chipsPerHost": 4},
+                        "chief": {"replicas": 1},
+                        "worker": {"replicas": 3},
+                    },
+                },
+            }
+        )
+        topo = normalize(make_compiled(op).run)
+        assert topo.num_processes == 4
+        assert topo.coordinator_role == "chief"
+        env = topo.process_env("worker", 2, run="r1")
+        assert env["PTPU_PROCESS_ID"] == "3"
+        assert env["PTPU_NUM_PROCESSES"] == "4"
+        assert env["PTPU_COORDINATOR_ADDRESS"].startswith("r1-chief-0:")
+
+    def test_tfjob_ps_rejected(self):
+        op = get_op_from_files(
+            {
+                "kind": "operation",
+                "component": {
+                    "kind": "component",
+                    "run": {"kind": "tfjob", "worker": {"replicas": 2},
+                            "ps": {"replicas": 1}},
+                },
+            }
+        )
+        with pytest.raises(TopologyError, match="no TPU analogue"):
+            normalize(make_compiled(op).run)
+
+    def test_mpijob_launcher_dissolves(self):
+        op = get_op_from_files(
+            {
+                "kind": "operation",
+                "component": {
+                    "kind": "component",
+                    "run": {"kind": "mpijob", "launcher": {"replicas": 1},
+                            "worker": {"replicas": 4}},
+                },
+            }
+        )
+        topo = normalize(make_compiled(op).run)
+        assert topo.num_processes == 4
+        assert topo.coordinator_role == "worker"
+
+    def test_pytorchjob(self):
+        op = get_op_from_files(
+            {
+                "kind": "operation",
+                "component": {
+                    "kind": "component",
+                    "run": {"kind": "pytorchjob", "master": {"replicas": 1},
+                            "worker": {"replicas": 7}},
+                },
+            }
+        )
+        topo = normalize(make_compiled(op).run)
+        assert topo.num_processes == 8
+        assert topo.process_env("worker", 6)["PTPU_PROCESS_ID"] == "7"
