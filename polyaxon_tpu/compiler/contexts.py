@@ -10,14 +10,19 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-# Root for run artifacts on the executing host; overridable via env/config.
-CONTEXT_ROOT = "/tmp/ptpu"
-ARTIFACTS_ROOT = os.environ.get("POLYAXON_TPU_ARTIFACTS_ROOT",
-                                os.path.join(CONTEXT_ROOT, "artifacts"))
-
 
 def run_artifacts_path(run_uuid: str, root: Optional[str] = None) -> str:
-    return os.path.join(root or ARTIFACTS_ROOT, run_uuid)
+    """Canonical artifacts dir for a run.
+
+    Must agree with ``client.store.FileRunStore.artifacts_path`` — the
+    templated ``{{ globals.run_artifacts_path }}`` a job writes to is the
+    same tree the store, lineage, and tuner joins read from.  ``root``
+    overrides the home dir (e.g. a mounted artifacts store in-cluster).
+    """
+    from ..client.store import default_home
+
+    home = root or default_home()
+    return os.path.join(home, "runs", run_uuid, "artifacts")
 
 
 def run_outputs_path(run_uuid: str, root: Optional[str] = None) -> str:
@@ -32,6 +37,8 @@ def build_globals(
     created_at: Optional[str] = None,
     store_path: Optional[str] = None,
 ) -> Dict[str, Any]:
+    from ..client.store import default_home
+
     artifacts = run_artifacts_path(run_uuid, store_path)
     return {
         "run_uuid": run_uuid,
@@ -46,7 +53,7 @@ def build_globals(
         "run_outputs_path": os.path.join(artifacts, "outputs"),
         "artifacts_path": artifacts,
         "outputs_path": os.path.join(artifacts, "outputs"),
-        "store_path": store_path or ARTIFACTS_ROOT,
+        "store_path": store_path or default_home(),
         "namespace": os.environ.get("POLYAXON_TPU_NAMESPACE", "polyaxon-tpu"),
     }
 

@@ -195,9 +195,20 @@ def resolve(
             break
     unresolvable = {n: v for n, v in resolved.items() if has_template(v)}
     if unresolvable:
-        # Re-raise with the real error for the first stuck template.
+        # Surface the real lookup error when there is one; otherwise the
+        # template is circular/self-referential — fail explicitly rather
+        # than shipping literal '{{ ... }}' text into the container.
         for name, value in unresolvable.items():
-            resolve_obj(value, ctx)
+            try:
+                resolve_obj(value, ctx)
+            except TemplateError as e:
+                raise CompilerError(
+                    f"Param {name!r} cannot be resolved: {e}"
+                ) from e
+        raise CompilerError(
+            f"Circular or self-referential param templates: "
+            f"{sorted(unresolvable)}"
+        )
 
     for name, value in list(resolved.items()):
         io = declared.get(name)

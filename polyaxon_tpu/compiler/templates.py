@@ -42,7 +42,13 @@ def _lookup(path: str, ctx: Dict[str, Any]) -> Any:
                 raise TemplateError(f"Unknown context path: {path!r}")
             cur = cur[part]
         elif isinstance(cur, (list, tuple)) and part.lstrip("-").isdigit():
-            cur = cur[int(part)]
+            try:
+                cur = cur[int(part)]
+            except IndexError:
+                raise TemplateError(
+                    f"Index {part} out of range in context path {path!r} "
+                    f"(length {len(cur)})"
+                )
         else:
             attr = getattr(cur, part, _MISSING)
             if attr is _MISSING:

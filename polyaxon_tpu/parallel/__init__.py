@@ -1,0 +1,22 @@
+"""JAX distributed runtime: the layer the reference never had.
+
+The reference delegates all distributed compute to NCCL/MPI/gRPC via
+Kubeflow operators (SURVEY.md 2.5/5.8).  Here the framework owns the device
+mesh natively:
+
+- ``bootstrap``:   jax.distributed.initialize from injected PTPU_* env.
+- ``mesh``:        mesh construction (ICI x DCN axes) + sharding helpers.
+- ``strategies``:  DP/TP/PP/SP/CP/EP train-step builders on pjit/shard_map.
+- ``ring``:        ring attention (ppermute KV rotation) for long context.
+- ``ulysses``:     all-to-all head/sequence resharding attention.
+- ``collectives``: hierarchical ICI/DCN collective helpers.
+"""
+
+from .bootstrap import initialize_from_env, topology_from_env
+from .mesh import (
+    MeshSpec,
+    build_mesh,
+    data_sharding,
+    local_mesh,
+    replicate_sharding,
+)

@@ -1,0 +1,86 @@
+"""Multi-host bootstrap: env-injected topology -> jax.distributed.
+
+This is the north-star wiring (SURVEY.md 3.2/5.8): the converter/agent
+inject the PTPU_* env block (see ``compiler.topology.ProcessTopology
+.process_env``); calling ``initialize_from_env()`` before any JAX
+computation starts the XLA coordination service in process 0 and connects
+every other process — replacing the reference's delegated TF_CONFIG /
+NCCL / MPI bootstrap entirely.  Collectives then ride ICI within a slice
+and DCN across slices with no further user configuration.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_COORDINATOR = "PTPU_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "PTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "PTPU_PROCESS_ID"
+ENV_NUM_SLICES = "PTPU_NUM_SLICES"
+ENV_SLICE_TYPE = "PTPU_SLICE_TYPE"
+
+_initialized = False
+
+
+@dataclass
+class TopologyEnv:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    num_slices: int = 1
+    slice_type: Optional[str] = None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def topology_from_env() -> Optional[TopologyEnv]:
+    """Parse the injected topology block; None when not a managed
+    distributed run."""
+    addr = os.environ.get(ENV_COORDINATOR)
+    if not addr:
+        return None
+    try:
+        return TopologyEnv(
+            coordinator_address=addr,
+            num_processes=int(os.environ.get(ENV_NUM_PROCESSES, "1")),
+            process_id=int(os.environ.get(ENV_PROCESS_ID, "0")),
+            num_slices=int(os.environ.get(ENV_NUM_SLICES, "1") or "1"),
+            slice_type=os.environ.get(ENV_SLICE_TYPE) or None,
+        )
+    except ValueError as e:
+        raise RuntimeError(f"Malformed PTPU_* topology env: {e}") from e
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> Optional[TopologyEnv]:
+    """Bootstrap jax.distributed from env; idempotent; no-op when the
+    topology block is absent or trivial (single process)."""
+    global _initialized
+    topo = topology_from_env()
+    if topo is None or not topo.is_distributed:
+        return topo
+    if _initialized:
+        return topo
+    import jax
+
+    kwargs = dict(
+        coordinator_address=topo.coordinator_address,
+        num_processes=topo.num_processes,
+        process_id=topo.process_id,
+    )
+    if timeout_s is not None:
+        kwargs["initialization_timeout"] = timeout_s
+    logger.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
+        "process_id=%d)", topo.coordinator_address, topo.num_processes,
+        topo.process_id,
+    )
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return topo

@@ -84,7 +84,11 @@ def get_op_from_files(
     name: Optional[str] = None,
 ) -> V1Operation:
     """Full CLI-equivalent pipeline: files + presets + -P params -> V1Operation."""
-    spec = read_polyaxonfile(sources)
+    import copy
+
+    # Deep-copy so caller-supplied spec dicts are never mutated by merges
+    # or -P writes (one dict may seed many operations).
+    spec = copy.deepcopy(read_polyaxonfile(sources))
     kind = spec.get("kind")
 
     if kind == "component":

@@ -1,0 +1,101 @@
+"""System-metric processors: host (psutil) and TPU device stats.
+
+Parity: reference traceml processors (psutil CPU/mem/disk/net + pynvml GPU
+— SURVEY.md 2.12/5.1).  The GPU path is replaced by TPU device metrics
+sourced from JAX (`jax.local_devices()` memory stats / libtpu counters when
+available); on CPU-only hosts the TPU block is simply absent.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+def host_metrics() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    try:
+        import psutil
+    except ImportError:
+        return out
+    try:
+        out["cpu_percent"] = psutil.cpu_percent(interval=None)
+        vm = psutil.virtual_memory()
+        out["memory_percent"] = vm.percent
+        out["memory_used_gb"] = vm.used / 1e9
+        du = psutil.disk_usage("/")
+        out["disk_percent"] = du.percent
+        net = psutil.net_io_counters()
+        out["net_sent_gb"] = net.bytes_sent / 1e9
+        out["net_recv_gb"] = net.bytes_recv / 1e9
+        load1, _, _ = os.getloadavg()
+        out["load1"] = load1
+    except Exception:
+        pass
+    return out
+
+
+def tpu_metrics() -> Dict[str, float]:
+    """Per-process TPU device stats via JAX; {} when no TPU is attached."""
+    if os.environ.get("POLYAXON_TPU_NO_TPU"):
+        return {}
+    out: Dict[str, float] = {}
+    try:
+        import jax
+
+        devices = [d for d in jax.local_devices() if d.platform == "tpu"]
+        if not devices:
+            return {}
+        out["tpu_local_devices"] = float(len(devices))
+        for i, dev in enumerate(devices):
+            stats = getattr(dev, "memory_stats", None)
+            stats = stats() if callable(stats) else None
+            if stats:
+                used = stats.get("bytes_in_use")
+                limit = stats.get("bytes_limit")
+                if used is not None:
+                    out[f"tpu{i}_hbm_used_gb"] = used / 1e9
+                if used is not None and limit:
+                    out[f"tpu{i}_hbm_percent"] = 100.0 * used / limit
+    except Exception:
+        return {}
+    return out
+
+
+class SystemMetricsMonitor:
+    """Daemon thread sampling host+TPU metrics into the event stream."""
+
+    def __init__(self, log_fn, interval: float = 30.0):
+        self._log_fn = log_fn  # (name, value, timestamp) -> None
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ptpu-sys-metrics")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.sample()
+
+    def sample(self) -> Dict[str, float]:
+        now = time.time()
+        metrics = {**host_metrics(), **tpu_metrics()}
+        for name, value in metrics.items():
+            try:
+                self._log_fn(name, value, now)
+            except Exception:
+                pass
+        return metrics
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,334 @@
+"""The tracking ``Run``: in-process experiment tracking.
+
+Parity: reference traceml ``Run``/``tracking`` API (SURVEY.md 2.12, call
+stack 3.2): ``init()`` attaches to the managed run via agent-injected env
+(or creates a standalone one), ``log_metric(s)`` append stepped series
+through the async writer, ``log_artifact``/``log_model``/rich-media loggers
+copy files into the run's artifact tree and record lineage, and a system-
+metrics monitor samples host/TPU stats.
+
+In distributed runs only process 0 tracks by default (``all_processes=True``
+opts replicas in; their series get a ``/p{id}`` suffix).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from ..client import RunClient
+from ..lifecycle import V1Statuses
+from .events import EventKind, artifact_event, make_event, metric_event
+from .processors import SystemMetricsMonitor
+from .writer import AsyncEventWriter
+
+
+class Run:
+    def __init__(
+        self,
+        run_uuid: Optional[str] = None,
+        project: Optional[str] = None,
+        client: Optional[RunClient] = None,
+        track_code: bool = True,
+        track_env: bool = True,
+        collect_system_metrics: Optional[bool] = None,
+        system_metrics_interval: float = 30.0,
+        auto_create: bool = True,
+        name: Optional[str] = None,
+        is_new: Optional[bool] = None,
+        all_processes: bool = False,
+    ):
+        self.client = client or RunClient(run_uuid=run_uuid, project=project)
+        self._process_id = int(os.environ.get("PTPU_PROCESS_ID", "0"))
+        self._is_chief = self._process_id == 0
+        self._tracks = self._is_chief or all_processes
+        self._suffix = "" if self._is_chief else f"/p{self._process_id}"
+
+        created = False
+        if not self.client.run_uuid:
+            if not auto_create:
+                raise RuntimeError(
+                    "tracking.init: no run to attach to (env not injected) "
+                    "and auto_create disabled"
+                )
+            self.client.create(name=name, kind="job", managed_by="tracking")
+            created = True
+        self._owns_status = created or (is_new or False)
+
+        self._writer = AsyncEventWriter(self.client)
+        self._writer.start()
+        self._monitor: Optional[SystemMetricsMonitor] = None
+        self._closed = False
+        if self._owns_status:
+            self._install_finalizers()
+
+        if self._tracks:
+            if self._owns_status:
+                self.client.log_status(V1Statuses.RUNNING, reason="TrackingInit")
+            if track_env:
+                self._log_env()
+            if collect_system_metrics is None:
+                # Default on only inside managed runs (env-injected identity).
+                from ..client.run_client import ENV_RUN_UUID
+
+                collect_system_metrics = bool(os.environ.get(ENV_RUN_UUID))
+            if collect_system_metrics:
+                self._monitor = SystemMetricsMonitor(
+                    self._log_system_metric, interval=system_metrics_interval)
+                self._monitor.start()
+
+    # -- internals --------------------------------------------------------
+
+    def _install_finalizers(self) -> None:
+        """Ensure the run never ends up stuck in `running` if the script
+        exits without calling end(): uncaught exceptions mark it failed,
+        clean interpreter exit marks it succeeded."""
+        import atexit
+        import sys
+
+        prev_hook = sys.excepthook
+        state = {"exit_code": 0}
+
+        def hook(exc_type, exc, tb):
+            if not self._closed and not issubclass(exc_type, SystemExit):
+                self.end(V1Statuses.FAILED, message=f"{exc_type.__name__}: {exc}")
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+        # sys.exit(nonzero) bypasses excepthook; wrap it so a deliberate
+        # failure exit is not recorded as success.  (os._exit and a raw
+        # `raise SystemExit(n)` still bypass this — the managed runner
+        # supervises those cases by exit code.)
+        prev_exit = sys.exit
+
+        def exit_wrapper(code=0):
+            state["exit_code"] = code if isinstance(code, int) else 1
+            prev_exit(code)
+
+        sys.exit = exit_wrapper
+
+        def finalize():
+            if state["exit_code"] not in (0, None):
+                self.end(V1Statuses.FAILED,
+                         message=f"exit code {state['exit_code']}")
+            else:
+                self.end(V1Statuses.SUCCEEDED)
+
+        atexit.register(finalize)
+
+    def _log_env(self) -> None:
+        import platform
+        import sys
+
+        env = {
+            "python_version": sys.version.split()[0],
+            "platform": platform.platform(),
+            "hostname": platform.node(),
+            "pid": os.getpid(),
+            "process_id": self._process_id,
+        }
+        try:
+            import jax
+
+            env["jax_version"] = jax.__version__
+            env["jax_backend"] = jax.default_backend()
+            env["jax_device_count"] = jax.device_count()
+        except Exception:
+            pass
+        self._writer.add(EventKind.ENV, "env" + self._suffix,
+                         make_event(EventKind.ENV, value=env))
+
+    def _log_system_metric(self, name: str, value: float,
+                           timestamp: float) -> None:
+        self._writer.add(EventKind.SYSTEM, name + self._suffix,
+                         metric_event(value, timestamp=timestamp))
+
+    def _copy_to_assets(self, path: str, subdir: str) -> str:
+        assets = os.path.join(self.client.get_artifacts_path(), subdir)
+        os.makedirs(assets, exist_ok=True)
+        dest = os.path.join(assets, os.path.basename(path))
+        if os.path.abspath(path) != os.path.abspath(dest):
+            if os.path.isdir(path):
+                shutil.copytree(path, dest, dirs_exist_ok=True)
+            else:
+                shutil.copy2(path, dest)
+        return dest
+
+    # -- public api -------------------------------------------------------
+
+    @property
+    def run_uuid(self) -> Optional[str]:
+        return self.client.run_uuid
+
+    def get_artifacts_path(self) -> str:
+        return self.client.get_artifacts_path()
+
+    def get_outputs_path(self) -> str:
+        return self.client.get_outputs_path()
+
+    def log_metric(self, name: str, value: float, step: Optional[int] = None,
+                   timestamp: Optional[float] = None) -> None:
+        if not self._tracks:
+            return
+        self._writer.add(EventKind.METRIC, name + self._suffix,
+                         metric_event(value, step=step, timestamp=timestamp))
+
+    def log_metrics(self, step: Optional[int] = None,
+                    timestamp: Optional[float] = None,
+                    **metrics: float) -> None:
+        for name, value in metrics.items():
+            self.log_metric(name, value, step=step, timestamp=timestamp)
+
+    def log_inputs(self, **inputs: Any) -> None:
+        if self._tracks:
+            self.client.log_inputs(**inputs)
+
+    def log_outputs(self, **outputs: Any) -> None:
+        if self._tracks:
+            self.client.log_outputs(**outputs)
+
+    def log_tags(self, *tags: str) -> None:
+        if self._tracks:
+            self.client.log_tags(list(tags))
+
+    def log_artifact(self, path: str, name: Optional[str] = None,
+                     kind: str = EventKind.ARTIFACT,
+                     step: Optional[int] = None) -> str:
+        if not self._tracks:
+            return path
+        dest = self._copy_to_assets(path, "assets")
+        name = name or os.path.basename(path)
+        self._writer.add(kind, name + self._suffix,
+                         artifact_event(dest, kind=kind, step=step))
+        self.client.log_artifact_lineage(name, kind, dest)
+        return dest
+
+    def log_model(self, path: str, name: Optional[str] = None,
+                  framework: Optional[str] = None,
+                  step: Optional[int] = None) -> str:
+        if not self._tracks:
+            return path
+        dest = self._copy_to_assets(path, "models")
+        name = name or os.path.basename(path)
+        self._writer.add(
+            EventKind.MODEL, name + self._suffix,
+            make_event(EventKind.MODEL, path=dest, framework=framework,
+                       step=step))
+        self.client.log_artifact_lineage(name, EventKind.MODEL, dest,
+                                         summary={"framework": framework})
+        return dest
+
+    def log_image(self, path: str, name: Optional[str] = None,
+                  step: Optional[int] = None) -> str:
+        return self.log_artifact(path, name=name, kind=EventKind.IMAGE,
+                                 step=step)
+
+    def log_audio(self, path: str, name: Optional[str] = None,
+                  step: Optional[int] = None) -> str:
+        return self.log_artifact(path, name=name, kind=EventKind.AUDIO,
+                                 step=step)
+
+    def log_video(self, path: str, name: Optional[str] = None,
+                  step: Optional[int] = None) -> str:
+        return self.log_artifact(path, name=name, kind=EventKind.VIDEO,
+                                 step=step)
+
+    def log_html(self, html: str, name: str = "report",
+                 step: Optional[int] = None) -> None:
+        if not self._tracks:
+            return
+        self._writer.add(EventKind.HTML, name + self._suffix,
+                         make_event(EventKind.HTML, value=html, step=step))
+
+    def log_text(self, text: str, name: str = "text",
+                 step: Optional[int] = None) -> None:
+        if not self._tracks:
+            return
+        self._writer.add(EventKind.TEXT, name + self._suffix,
+                         make_event(EventKind.TEXT, value=text, step=step))
+
+    def log_curve(self, name: str, x: List[float], y: List[float],
+                  annotation: Optional[str] = None,
+                  step: Optional[int] = None) -> None:
+        if not self._tracks:
+            return
+        self._writer.add(
+            EventKind.CURVE, name + self._suffix,
+            make_event(EventKind.CURVE, value={"x": list(x), "y": list(y)},
+                       annotation=annotation, step=step))
+
+    def log_confusion_matrix(self, name: str, labels: List[str],
+                             matrix: List[List[float]],
+                             step: Optional[int] = None) -> None:
+        if not self._tracks:
+            return
+        self._writer.add(
+            EventKind.CONFUSION, name + self._suffix,
+            make_event(EventKind.CONFUSION,
+                       value={"labels": list(labels),
+                              "matrix": [list(r) for r in matrix]},
+                       step=step))
+
+    def log_histogram(self, name: str, values: List[float], bins: int = 32,
+                      step: Optional[int] = None) -> None:
+        if not self._tracks:
+            return
+        import numpy as np
+
+        counts, edges = np.histogram(np.asarray(values), bins=bins)
+        self._writer.add(
+            EventKind.HISTOGRAM, name + self._suffix,
+            make_event(EventKind.HISTOGRAM,
+                       value={"counts": counts.tolist(),
+                              "edges": edges.tolist()},
+                       step=step))
+
+    def log_dataframe(self, df: Any, name: str = "dataframe",
+                      step: Optional[int] = None) -> None:
+        if not self._tracks:
+            return
+        assets = os.path.join(self.client.get_artifacts_path(), "dataframes")
+        os.makedirs(assets, exist_ok=True)
+        dest = os.path.join(assets, f"{name}.csv")
+        try:
+            df.to_csv(dest, index=False)
+        except AttributeError:
+            with open(dest, "w") as f:
+                json.dump(df, f, default=str)
+        self._writer.add(EventKind.DATAFRAME, name + self._suffix,
+                         artifact_event(dest, kind=EventKind.DATAFRAME,
+                                        step=step))
+
+    def get_metrics(self, name: str) -> List[Dict[str, Any]]:
+        return self.client.get_metrics(name)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        return self._writer.flush(timeout=timeout)
+
+    def end(self, status: str = V1Statuses.SUCCEEDED,
+            message: Optional[str] = None) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._monitor is not None:
+            self._monitor.stop()
+        self._writer.flush()
+        self._writer.close()
+        if self._tracks and self._owns_status:
+            self.client.log_status(status, reason="TrackingEnd",
+                                   message=message)
+
+    def __enter__(self) -> "Run":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.end(V1Statuses.SUCCEEDED)
+        else:
+            self.end(V1Statuses.FAILED, message=str(exc))

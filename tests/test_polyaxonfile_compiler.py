@@ -118,7 +118,8 @@ class TestTemplates:
 
     def test_globals(self):
         out = resolve_str("{{ globals.run_outputs_path }}", self.CTX)
-        assert out.endswith("uid-1/outputs")
+        # canonical layout agrees with FileRunStore: runs/<uuid>/artifacts/outputs
+        assert out.endswith("runs/uid-1/artifacts/outputs")
 
     def test_filters(self):
         assert resolve_str("{{ lr | str }}", self.CTX) == "0.1"
@@ -141,7 +142,7 @@ class TestResolve:
         args = compiled.run.container.args
         assert args[0] == "--lr=0.01"
         assert args[1] == "--epochs=4"
-        assert args[2].endswith("abc123/outputs")
+        assert args[2].endswith("runs/abc123/artifacts/outputs")
         assert compiled.get_io_dict() == {"lr": 0.01, "epochs": 4}
 
     def test_matrix_values(self):

@@ -1,0 +1,218 @@
+"""Store / client / tracking / query tests (SURVEY.md §4: event goldens,
+isolated home config)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from polyaxon_tpu.client import FileRunStore, RunClient, StoreError
+from polyaxon_tpu.lifecycle import V1Statuses, can_transition
+from polyaxon_tpu.query import QueryError, apply_query, apply_sort, parse_query
+
+
+@pytest.fixture
+def store(tmp_home):
+    return FileRunStore(str(tmp_home))
+
+
+class TestLifecycle:
+    def test_transitions(self):
+        assert can_transition(V1Statuses.CREATED, V1Statuses.QUEUED)
+        assert can_transition(V1Statuses.QUEUED, V1Statuses.RUNNING)
+        assert can_transition(V1Statuses.RUNNING, V1Statuses.SUCCEEDED)
+        assert not can_transition(V1Statuses.SUCCEEDED, V1Statuses.RUNNING)
+        assert not can_transition(V1Statuses.CREATED, V1Statuses.SUCCEEDED)
+        # kills allowed from any non-done state
+        assert can_transition(V1Statuses.QUEUED, V1Statuses.STOPPED)
+        assert not can_transition(V1Statuses.FAILED, V1Statuses.STOPPED)
+
+
+class TestStore:
+    def test_create_get_update(self, store):
+        rec = store.create_run(name="r1", project="p1", tags=["a"])
+        uid = rec["uuid"]
+        assert store.get_run(uid)["name"] == "r1"
+        store.update_run(uid, inputs={"lr": 0.1})
+        store.update_run(uid, inputs={"epochs": 2})
+        rec = store.get_run(uid)
+        assert rec["inputs"] == {"lr": 0.1, "epochs": 2}
+
+    def test_status_flow(self, store):
+        uid = store.create_run()["uuid"]
+        assert store.set_status(uid, V1Statuses.QUEUED)
+        assert store.set_status(uid, V1Statuses.RUNNING)
+        assert not store.set_status(uid, V1Statuses.QUEUED)  # illegal
+        assert store.set_status(uid, V1Statuses.SUCCEEDED)
+        rec = store.get_run(uid)
+        assert rec["status"] == V1Statuses.SUCCEEDED
+        assert rec["duration"] is not None
+        types = [c.type for c in store.get_statuses(uid)]
+        assert types == [V1Statuses.CREATED, V1Statuses.QUEUED,
+                         V1Statuses.RUNNING, V1Statuses.SUCCEEDED]
+
+    def test_events_round_trip(self, store):
+        uid = store.create_run()["uuid"]
+        store.append_events(uid, "metric", "loss",
+                            [{"step": 0, "value": 1.0},
+                             {"step": 1, "value": 0.5}])
+        events = store.read_events(uid, "metric", "loss")
+        assert [e["value"] for e in events] == [1.0, 0.5]
+        assert store.last_metrics(uid) == {"loss": 0.5}
+
+    def test_logs(self, store):
+        uid = store.create_run()["uuid"]
+        store.append_log(uid, "line1\nline2\n")
+        store.append_log(uid, "line3\n")
+        assert store.read_logs(uid).splitlines() == ["line1", "line2", "line3"]
+        assert store.read_logs(uid, tail=1) == "line3"
+
+    def test_missing_run(self, store):
+        with pytest.raises(StoreError, match="not found"):
+            store.get_run("nope")
+
+    def test_list_runs_query(self, store):
+        a = store.create_run(name="resnet-1", project="vision")["uuid"]
+        b = store.create_run(name="bert-1", project="nlp")["uuid"]
+        store.set_status(a, V1Statuses.QUEUED)
+        store.append_events(a, "metric", "loss", [{"step": 0, "value": 0.05}])
+        runs = store.list_runs(project="vision")
+        assert [r["name"] for r in runs] == ["resnet-1"]
+        runs = store.list_runs(query="status:queued")
+        assert len(runs) == 1 and runs[0]["uuid"] == a
+        runs = store.list_runs(query="metrics.loss:<0.1")
+        assert [r["uuid"] for r in runs] == [a]
+
+
+class TestRunClient:
+    def test_create_and_track(self, store):
+        client = RunClient(store=store, project="p")
+        client.create(name="exp")
+        client.log_status(V1Statuses.RUNNING, force=True)
+        client.log_inputs(lr=0.1)
+        client.log_outputs(accuracy=0.9)
+        client.append_events("metric", "loss", [{"step": 0, "value": 2.0}])
+        assert client.get_last_metrics() == {"loss": 2.0}
+        assert client.run_data["inputs"] == {"lr": 0.1}
+
+    def test_env_attachment(self, store, monkeypatch):
+        uid = store.create_run()["uuid"]
+        monkeypatch.setenv("POLYAXON_TPU_RUN_UUID", uid)
+        client = RunClient(store=store)
+        assert client.run_uuid == uid
+
+    def test_requires_run(self, store):
+        client = RunClient(store=store)
+        with pytest.raises(StoreError, match="No run is attached"):
+            client.log_inputs(x=1)
+
+
+class TestTracking:
+    def test_full_tracking_flow(self, store, tmp_path):
+        from polyaxon_tpu.tracking import Run
+
+        run = Run(client=RunClient(store=store), name="tracked",
+                  collect_system_metrics=False, auto_create=True)
+        run.log_metrics(step=0, loss=1.5, acc=0.3)
+        run.log_metrics(step=1, loss=0.7, acc=0.6)
+        art = tmp_path / "weights.txt"
+        art.write_text("w")
+        run.log_artifact(str(art))
+        run.log_curve("roc", x=[0, 1], y=[0, 1])
+        run.flush()
+
+        uid = run.run_uuid
+        events = store.read_events(uid, "metric", "loss")
+        assert [e["value"] for e in events] == [1.5, 0.7]
+        assert [e["step"] for e in events] == [0, 1]
+        lineage = store.get_lineage(uid)
+        assert lineage and lineage[0]["name"] == "weights.txt"
+        assert os.path.exists(lineage[0]["path"])
+
+        run.end()
+        assert store.get_run(uid)["status"] == V1Statuses.SUCCEEDED
+
+    def test_context_manager_failure(self, store):
+        from polyaxon_tpu.tracking import Run
+
+        with pytest.raises(RuntimeError):
+            with Run(client=RunClient(store=store),
+                     collect_system_metrics=False) as run:
+                uid = run.run_uuid
+                raise RuntimeError("boom")
+        assert store.get_run(uid)["status"] == V1Statuses.FAILED
+
+    def test_non_chief_is_silent(self, store, monkeypatch):
+        from polyaxon_tpu.tracking import Run
+
+        uid = store.create_run()["uuid"]
+        monkeypatch.setenv("PTPU_PROCESS_ID", "3")
+        run = Run(run_uuid=uid, client=RunClient(store=store, run_uuid=uid),
+                  collect_system_metrics=False)
+        run.log_metric("loss", 1.0, step=0)
+        run.flush()
+        assert store.read_events(uid, "metric", "loss") == []
+        run.end()
+        # non-chief must not flip the run status either
+        assert store.get_run(uid)["status"] == V1Statuses.CREATED
+
+    def test_event_golden_shape(self, store):
+        from polyaxon_tpu.tracking.events import metric_event
+
+        e = metric_event(0.5, step=3, timestamp=123.0)
+        assert e == {"timestamp": 123.0, "kind": "metric", "step": 3,
+                     "value": 0.5}
+
+    def test_system_metrics_sample(self, store):
+        from polyaxon_tpu.tracking.processors import host_metrics
+
+        m = host_metrics()
+        assert "cpu_percent" in m and "memory_percent" in m
+
+
+class TestQuery:
+    RECORDS = [
+        {"uuid": "1", "name": "resnet-a", "status": "running",
+         "tags": ["tpu"], "created_at": 3, "inputs": {"lr": 0.1}},
+        {"uuid": "2", "name": "resnet-b", "status": "failed",
+         "tags": [], "created_at": 1, "inputs": {"lr": 0.5}},
+        {"uuid": "3", "name": "bert", "status": "running",
+         "tags": ["tpu", "nlp"], "created_at": 2, "inputs": {"lr": 0.01}},
+    ]
+
+    def test_equality_and_or(self):
+        out = apply_query(self.RECORDS, "status:running")
+        assert [r["uuid"] for r in out] == ["1", "3"]
+        out = apply_query(self.RECORDS, "status:failed|running")
+        assert len(out) == 3
+
+    def test_and_clauses(self):
+        out = apply_query(self.RECORDS, "status:running, tags:nlp")
+        assert [r["uuid"] for r in out] == ["3"]
+
+    def test_substring(self):
+        out = apply_query(self.RECORDS, "name:resnet")
+        assert [r["uuid"] for r in out] == ["1", "2"]
+
+    def test_negation(self):
+        out = apply_query(self.RECORDS, "status:~failed")
+        assert [r["uuid"] for r in out] == ["1", "3"]
+
+    def test_comparison_on_inputs(self):
+        out = apply_query(self.RECORDS, "inputs.lr:>=0.1")
+        assert [r["uuid"] for r in out] == ["1", "2"]
+
+    def test_range(self):
+        out = apply_query(self.RECORDS, "created_at:1..2")
+        assert {r["uuid"] for r in out} == {"2", "3"}
+
+    def test_sort(self):
+        out = apply_sort(list(self.RECORDS), "-created_at")
+        assert [r["uuid"] for r in out] == ["1", "3", "2"]
+        out = apply_sort(list(self.RECORDS), "name,-created_at")
+        assert out[0]["name"] == "bert"
+
+    def test_bad_query(self):
+        with pytest.raises(QueryError):
+            parse_query("no-colon-here")
