@@ -1,16 +1,365 @@
-"""CLI entrypoint. Command groups are registered as subsystems land."""
+"""CLI: the L9 surface (SURVEY.md 2.1).
+
+Command tree parity with the reference (`polyaxon run/ops/config/version`
+et al.), TPU-first semantics: local mode executes in-process against the
+file store; API mode (POLYAXON_TPU_HOST) goes through the control plane.
+"""
 
 from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
 
 import click
 
 from polyaxon_tpu import __version__
 
 
+def _parse_params(params: Tuple[str, ...]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for item in params:
+        if "=" not in item:
+            raise click.BadParameter(
+                f"-P expects name=value, got {item!r}")
+        key, _, value = item.partition("=")
+        out[key.strip()] = value
+    return out
+
+
+def _echo_record(record: Dict[str, Any], fields: Optional[List[str]] = None):
+    fields = fields or ["uuid", "name", "kind", "status", "created_at",
+                        "duration"]
+    for f in fields:
+        click.echo(f"{f:>12}: {record.get(f)}")
+
+
 @click.group(name="ptpu")
 @click.version_option(version=__version__, prog_name="polyaxon-tpu")
 def cli():
-    """polyaxon-tpu: TPU-native ML orchestration."""
+    """polyaxon-tpu: TPU-native ML orchestration.
+
+    Declarative specs -> compile -> run (local or TPU slices) -> track ->
+    tune -> stream.
+    """
+
+
+# ---------------------------------------------------------------------------
+# run
+# ---------------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("-f", "--file", "files", multiple=True, required=True,
+              type=click.Path(), help="Polyaxonfile(s) to run (merged in order).")
+@click.option("-P", "--param", "params", multiple=True,
+              help="Param override: -P lr=0.1 (repeatable).")
+@click.option("--preset", "presets", multiple=True, type=click.Path(),
+              help="Preset file(s) applied before -P params.")
+@click.option("--name", default=None, help="Run name override.")
+@click.option("--project", default="default", help="Project name.")
+@click.option("--watch/--no-watch", default=True,
+              help="Stream logs while running (local mode).")
+@click.option("--eager", is_flag=True, default=False,
+              help="Force local in-process execution even in API mode.")
+@click.option("--check-only", is_flag=True, default=False,
+              help="Validate and print the operation without running.")
+def run(files, params, presets, name, project, watch, eager, check_only):
+    """Run a polyaxonfile: compile, execute, track."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+    from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
+
+    try:
+        op = check_polyaxonfile(list(files), params=_parse_params(params),
+                                presets=list(presets) or None)
+    except (PolyaxonfileError, ValueError) as e:
+        raise click.ClickException(f"Invalid polyaxonfile: {e}")
+
+    if check_only:
+        click.echo(json.dumps(op.to_dict(), indent=2, default=str))
+        return
+
+    host = os.environ.get("POLYAXON_TPU_HOST")
+    if host and not eager:
+        from polyaxon_tpu.client import RunClient
+
+        client = RunClient(project=project)
+        record = client.create(name=name or op.name, content=op.to_dict(),
+                               kind=getattr(op.component.run, "kind", None)
+                               if op.has_component else None,
+                               managed_by="agent")
+        client.log_status("queued", reason="CliSubmit", force=True)
+        click.echo(f"Run {record['uuid']} queued on {host}")
+        return
+
+    from polyaxon_tpu.runner import LocalExecutor
+
+    if name:
+        op = op.model_copy(update={"name": name})
+    executor = LocalExecutor(project=project, stream_logs=watch)
+    try:
+        record = executor.run_operation(op)
+    except Exception as e:
+        raise click.ClickException(f"Run failed: {e}")
+    status = record.get("status")
+    _echo_record(record)
+    if status != "succeeded":
+        logs = executor.store.read_logs(record["uuid"], tail=20)
+        if logs:
+            click.echo("--- last logs ---")
+            click.echo(logs)
+        raise click.ClickException(f"Run finished with status {status!r}")
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def ops():
+    """Inspect and manage runs."""
+
+
+def _store():
+    from polyaxon_tpu.client.run_client import get_client
+
+    return get_client()
+
+
+@ops.command(name="ls")
+@click.option("--project", default=None)
+@click.option("--query", "-q", default=None,
+              help='Filter, e.g. "status:running, metrics.loss:<0.1".')
+@click.option("--sort", default="-created_at")
+@click.option("--limit", default=20, type=int)
+@click.option("--offset", default=0, type=int)
+def ops_ls(project, query, sort, limit, offset):
+    """List runs."""
+    from polyaxon_tpu.client.store import StoreError
+    from polyaxon_tpu.query import QueryError
+
+    try:
+        runs = _store().list_runs(project=project, query=query, sort=sort,
+                                  limit=limit, offset=offset)
+    except (QueryError, StoreError) as e:
+        raise click.ClickException(str(e))
+    if not runs:
+        click.echo("No runs found.")
+        return
+    fmt = "{:<14} {:<24} {:<12} {:<11} {:>9}"
+    click.echo(fmt.format("UUID", "NAME", "KIND", "STATUS", "DURATION"))
+    for r in runs:
+        dur = r.get("duration")
+        click.echo(fmt.format(
+            r["uuid"], (r.get("name") or "")[:24], str(r.get("kind") or "-"),
+            r.get("status") or "-", f"{dur:.1f}s" if dur else "-",
+        ))
+
+
+@ops.command(name="get")
+@click.argument("run_uuid")
+def ops_get(run_uuid):
+    """Show one run's record."""
+    record = _get_run_or_fail(run_uuid)
+    click.echo(json.dumps(record, indent=2, default=str))
+
+
+def _get_run_or_fail(run_uuid: str) -> Dict[str, Any]:
+    from polyaxon_tpu.client.store import StoreError
+
+    try:
+        return _store().get_run(run_uuid)
+    except StoreError as e:
+        raise click.ClickException(str(e))
+
+
+@ops.command(name="logs")
+@click.argument("run_uuid")
+@click.option("--replica", default=None)
+@click.option("--tail", default=None, type=int)
+def ops_logs(run_uuid, replica, tail):
+    """Print a run's logs."""
+    _get_run_or_fail(run_uuid)
+    click.echo(_store().read_logs(run_uuid, replica=replica, tail=tail))
+
+
+@ops.command(name="statuses")
+@click.argument("run_uuid")
+def ops_statuses(run_uuid):
+    """Print a run's status history."""
+    _get_run_or_fail(run_uuid)
+    for c in _store().get_statuses(run_uuid):
+        line = f"{c.last_transition_time:.0f}  {c.type:<16} {c.reason or ''}"
+        if c.message:
+            line += f"  {c.message}"
+        click.echo(line)
+
+
+@ops.command(name="artifacts")
+@click.argument("run_uuid")
+def ops_artifacts(run_uuid):
+    """List a run's artifact tree and lineage."""
+    _get_run_or_fail(run_uuid)
+    store = _store()
+    root = store.artifacts_path(run_uuid)
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            path = os.path.join(dirpath, fname)
+            click.echo(os.path.relpath(path, root))
+    lineage = store.get_lineage(run_uuid)
+    if lineage:
+        click.echo("--- lineage ---")
+        for rec in lineage:
+            click.echo(f"{rec.get('kind'):<10} {rec.get('name')}")
+
+
+@ops.command(name="metrics")
+@click.argument("run_uuid")
+@click.option("--name", default=None, help="One metric series (else last values).")
+def ops_metrics(run_uuid, name):
+    """Show tracked metrics."""
+    _get_run_or_fail(run_uuid)
+    store = _store()
+    if name:
+        for e in store.read_events(run_uuid, "metric", name):
+            click.echo(f"step={e.get('step')} value={e.get('value')}")
+    else:
+        for metric, value in sorted(store.last_metrics(run_uuid).items()):
+            click.echo(f"{metric}: {value}")
+
+
+@ops.command(name="stop")
+@click.argument("run_uuid")
+def ops_stop(run_uuid):
+    """Request a run stop."""
+    _get_run_or_fail(run_uuid)
+    ok = _store().set_status(run_uuid, "stopping", reason="CliStop")
+    click.echo("stopping" if ok else "run is already done")
+
+
+@ops.command(name="delete")
+@click.argument("run_uuid")
+@click.confirmation_option(prompt="Delete this run and its artifacts?")
+def ops_delete(run_uuid):
+    """Delete a run."""
+    _get_run_or_fail(run_uuid)
+    _store().delete_run(run_uuid)
+    click.echo(f"deleted {run_uuid}")
+
+
+@ops.command(name="restart")
+@click.argument("run_uuid")
+@click.option("--copy", "copy_artifacts", is_flag=True,
+              help="Copy the original run's artifacts into the new run.")
+def ops_restart(run_uuid, copy_artifacts):
+    """Restart a run as a new run (optionally copying artifacts)."""
+    record = _restart(run_uuid, copy_artifacts=copy_artifacts, resume=False)
+    _echo_record(record)
+
+
+@ops.command(name="resume")
+@click.argument("run_uuid")
+def ops_resume(run_uuid):
+    """Resume a run: restart pointing at the SAME artifacts (latest
+    checkpoint is picked up via {{ globals.run_artifacts_path }})."""
+    record = _restart(run_uuid, copy_artifacts=True, resume=True)
+    _echo_record(record)
+
+
+def _restart(run_uuid: str, copy_artifacts: bool, resume: bool):
+    import shutil
+
+    from polyaxon_tpu.flow import V1Operation
+    from polyaxon_tpu.runner import LocalExecutor
+
+    record = _get_run_or_fail(run_uuid)
+    content = record.get("content")
+    if not content:
+        raise click.ClickException(
+            f"Run {run_uuid} stores no operation content; cannot restart")
+    op = V1Operation.from_dict(content)
+    # Sweep children were created with matrix stripped and their concrete
+    # suggestion stored in meta_info — replay it.
+    matrix_values = (record.get("meta_info") or {}).get("matrix_values")
+    meta = {"restarted_from": run_uuid, "is_resume": resume}
+    if matrix_values:
+        meta["matrix_values"] = matrix_values
+
+    if os.environ.get("POLYAXON_TPU_HOST"):
+        # API mode: resubmit to the control plane; the agent executes.
+        store = _store()
+        new = store.create_run(
+            name=record.get("name"), project=record.get("project"),
+            content=content, kind=record.get("kind"), meta_info=meta,
+            managed_by="agent",
+        )
+        store.set_status(new["uuid"], "queued", reason="CliRestart",
+                         force=True)
+        return store.get_run(new["uuid"])
+
+    executor = LocalExecutor(project=record.get("project") or "default")
+    new_uuid = executor.create_run(op, meta_info=meta)
+    if copy_artifacts:
+        src = executor.store.artifacts_path(run_uuid)
+        dst = executor.store.artifacts_path(new_uuid)
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+    try:
+        return executor.run_operation(op, run_uuid=new_uuid,
+                                      matrix_values=matrix_values)
+    except Exception as e:
+        raise click.ClickException(f"Restart failed: {e}")
+
+
+# ---------------------------------------------------------------------------
+# config / check / version
+# ---------------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("-f", "--file", "files", multiple=True, required=True,
+              type=click.Path())
+@click.option("-P", "--param", "params", multiple=True)
+def check(files, params):
+    """Validate a polyaxonfile."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+    from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
+
+    try:
+        op = check_polyaxonfile(list(files), params=_parse_params(params))
+    except (PolyaxonfileError, ValueError) as e:
+        raise click.ClickException(str(e))
+    kind = (getattr(op.component.run, "kind", "?")
+            if op.has_component else "ref")
+    click.echo(f"Valid operation: name={op.name!r} kind={kind}"
+               + (f" matrix={op.matrix.kind}" if op.matrix else ""))
+
+
+@cli.group()
+def config():
+    """Show/set client configuration."""
+
+
+@config.command(name="show")
+def config_show():
+    from polyaxon_tpu.client.store import default_home
+
+    click.echo(f"home: {default_home()}")
+    click.echo(f"host: {os.environ.get('POLYAXON_TPU_HOST') or '(local mode)'}")
+
+
+@cli.command()
+def version():
+    """Print versions (framework + runtime stack)."""
+    click.echo(f"polyaxon-tpu {__version__}")
+    try:
+        import jax
+
+        click.echo(f"jax {jax.__version__}")
+    except ImportError:
+        pass
 
 
 if __name__ == "__main__":

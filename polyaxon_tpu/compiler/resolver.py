@@ -229,9 +229,20 @@ def resolve(
         new_inputs.append(io)
     compiled.inputs = new_inputs or None
 
-    # Resolve templates throughout the run section.
+    # Resolve templates throughout the run section.  Dag member operations
+    # keep their templates: each member resolves against its OWN run
+    # context when the DagRunner executes it.
     run_dict = compiled.run.to_dict()
-    run_dict = resolve_obj(run_dict, ctx)
+    if compiled.run_kind == "dag":
+        member_ops = run_dict.pop("operations", None)
+        member_comps = run_dict.pop("components", None)
+        run_dict = resolve_obj(run_dict, ctx)
+        if member_ops is not None:
+            run_dict["operations"] = member_ops
+        if member_comps is not None:
+            run_dict["components"] = member_comps
+    else:
+        run_dict = resolve_obj(run_dict, ctx)
     compiled.run = run_dict  # validator re-parses into the proper kind
 
     return compiled

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
+from pydantic import field_validator
+
 from .base import BaseOpenSchema
 
 
@@ -49,6 +51,29 @@ class V1Container(BaseOpenSchema):
     volume_mounts: Optional[List[V1VolumeMount]] = None
     working_dir: Optional[str] = None
     ports: Optional[List[V1ContainerPort]] = None
+
+    @field_validator("command", "args", mode="before")
+    @classmethod
+    def _stringify(cls, v):
+        # Template resolution yields native types ({{ epochs }} -> 4); exec
+        # argv is strings.  Use YAML/JSON spellings (true, not True; JSON
+        # for containers) so programs parse what the spec author wrote.
+        import json
+
+        def conv(x):
+            if isinstance(x, str):
+                return x
+            if x is None:
+                return ""
+            if isinstance(x, bool):
+                return "true" if x else "false"
+            if isinstance(x, (dict, list)):
+                return json.dumps(x)
+            return str(x)
+
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        return v
 
     def get_resources(self) -> V1ResourceRequirements:
         return self.resources or V1ResourceRequirements()

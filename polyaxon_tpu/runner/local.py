@@ -1,0 +1,304 @@
+"""Local executor.
+
+Execution semantics per run kind:
+
+- ``job``:     one subprocess (command/args from the container spec).
+- ``tpujob``/``tfjob``/``pytorchjob``/``mpijob``: N subprocesses — one per
+  process in the normalized topology — each receiving the same PTPU_* env
+  block the agent would inject in-cluster (coordinator on localhost).
+  This is the "multi-node without a cluster" harness (SURVEY.md §4).
+- ``dag``:     topological execution of member operations with concurrency.
+- ``service``: refused locally (needs the operator; port-forward instead).
+
+Matrix operations are handled by the tuner controller
+(``polyaxon_tpu.tune.controller``), which calls back into this executor
+for each child run.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..client import FileRunStore, RunClient
+from ..client.run_client import ENV_PROJECT, ENV_RUN_UUID
+from ..compiler import normalize, resolve
+from ..compiler.topology import ProcessTopology
+from ..flow import V1Operation
+from ..flow.run import RunKind
+from ..lifecycle import V1Statuses
+
+
+class ExecutionError(RuntimeError):
+    pass
+
+
+class StopRequested(Exception):
+    """Raised inside _wait when ``ops stop`` flipped the run to stopping."""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalExecutor:
+    def __init__(self, store: Optional[FileRunStore] = None,
+                 project: str = "default", stream_logs: bool = False):
+        self.store = store or FileRunStore()
+        self.project = project
+        self.stream_logs = stream_logs
+
+    # ------------------------------------------------------------------
+
+    def create_run(self, operation: V1Operation,
+                   pipeline: Optional[str] = None,
+                   meta_info: Optional[Dict[str, Any]] = None) -> str:
+        record = self.store.create_run(
+            name=operation.name,
+            project=self.project,
+            description=operation.description,
+            tags=operation.tags,
+            content=operation.to_dict(),
+            kind=getattr(operation.component.run, "kind", None)
+            if operation.has_component else None,
+            pipeline=pipeline,
+            meta_info=meta_info,
+        )
+        return record["uuid"]
+
+    def run_operation(
+        self,
+        operation: V1Operation,
+        run_uuid: Optional[str] = None,
+        matrix_values: Optional[Dict[str, Any]] = None,
+        dag_values: Optional[Dict[str, Any]] = None,
+        pipeline: Optional[str] = None,
+        timeout: Optional[float] = None,
+        ref_resolver=None,
+    ) -> Dict[str, Any]:
+        """Execute synchronously; returns the final run record."""
+        if operation.matrix is not None:
+            from ..tune.controller import TuneController
+
+            run_uuid = run_uuid or self.create_run(operation,
+                                                   pipeline=pipeline)
+            controller = TuneController(self, operation, run_uuid)
+            return controller.execute()
+
+        run_uuid = run_uuid or self.create_run(
+            operation, pipeline=pipeline,
+            meta_info={"matrix_values": matrix_values} if matrix_values else None,
+        )
+        try:
+            compiled = resolve(
+                operation, run_uuid=run_uuid, project=self.project,
+                matrix_values=matrix_values, dag_values=dag_values,
+                ref_resolver=ref_resolver, store_path=self.store.home,
+            )
+        except Exception as e:
+            self.store.set_status(run_uuid, V1Statuses.FAILED,
+                                  reason="CompilationError", message=str(e),
+                                  force=True)
+            raise
+
+        self.store.update_run(
+            run_uuid,
+            inputs=compiled.get_io_dict(),
+        )
+        self.store.set_status(run_uuid, V1Statuses.COMPILED,
+                              reason="LocalExecutor")
+
+        kind = compiled.run_kind
+        termination = compiled.termination
+        max_retries = (termination.max_retries if termination and
+                       termination.max_retries else 0)
+        timeout = timeout or (termination.timeout if termination else None)
+
+        attempt = 0
+        while True:
+            try:
+                if kind == RunKind.JOB:
+                    self._run_job(run_uuid, compiled, timeout)
+                elif kind in RunKind.DISTRIBUTED:
+                    self._run_distributed(run_uuid, compiled, timeout)
+                elif kind == RunKind.DAG:
+                    self._run_dag(run_uuid, operation, compiled)
+                else:
+                    raise ExecutionError(
+                        f"Run kind {kind!r} is not executable locally "
+                        "(services need the operator; use port-forward)"
+                    )
+                break
+            except StopRequested:
+                self.store.set_status(run_uuid, V1Statuses.STOPPED,
+                                      reason="StopRequested")
+                return self.store.get_run(run_uuid)
+            except ExecutionError as e:
+                attempt += 1
+                if attempt > max_retries:
+                    self.store.set_status(run_uuid, V1Statuses.FAILED,
+                                          reason="ExecutionError",
+                                          message=str(e), force=True)
+                    return self.store.get_run(run_uuid)
+                self.store.set_status(run_uuid, V1Statuses.RETRYING,
+                                      reason="Retry",
+                                      message=f"attempt {attempt}", force=True)
+
+        self.store.set_status(run_uuid, V1Statuses.SUCCEEDED,
+                              reason="LocalExecutor")
+        return self.store.get_run(run_uuid)
+
+    def run_operation_with_refs(self, operation: V1Operation,
+                                dag_values=None, ref_resolver=None,
+                                pipeline: Optional[str] = None) -> Dict[str, Any]:
+        """DAG-member entrypoint (outputs of upstream ops via refs)."""
+        return self.run_operation(operation, dag_values=dag_values,
+                                  ref_resolver=ref_resolver,
+                                  pipeline=pipeline)
+
+    # -- job ------------------------------------------------------------
+
+    def _build_env(self, run_uuid: str, extra: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+        env = dict(os.environ)
+        env[ENV_RUN_UUID] = run_uuid
+        env[ENV_PROJECT] = self.project
+        env["POLYAXON_TPU_HOME"] = self.store.home
+        env.update(extra or {})
+        return env
+
+    def _container_argv(self, container) -> List[str]:
+        if container is None or (not container.command and not container.args):
+            raise ExecutionError("Container has no command to execute")
+        argv = list(container.command or [])
+        argv += [str(a) for a in (container.args or [])]
+        if len(argv) == 1 and " " in argv[0]:
+            argv = shlex.split(argv[0])
+        return argv
+
+    def _spawn(self, run_uuid: str, argv: List[str], env: Dict[str, str],
+               replica: str, cwd: Optional[str] = None) -> subprocess.Popen:
+        log_path = self.store.logs_path(run_uuid, replica)
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        proc = subprocess.Popen(
+            argv, env=env, cwd=cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+        def pump():
+            assert proc.stdout is not None
+            with open(log_path, "a") as sink:
+                for line in proc.stdout:
+                    sink.write(line)
+                    sink.flush()
+                    if self.stream_logs:
+                        sys.stdout.write(f"[{replica}] {line}")
+                        sys.stdout.flush()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        proc._ptpu_pump = t  # joined before wait() returns
+        return proc
+
+    def _wait(self, run_uuid: str, procs: Dict[str, subprocess.Popen],
+              timeout: Optional[float], poll_interval: float = 0.3) -> None:
+        """Wait for all replicas; honors timeouts and cooperative stop
+        (``ops stop`` flips the run to ``stopping``; we kill and finalize
+        as ``stopped``)."""
+        deadline = time.time() + timeout if timeout else None
+        pending = dict(procs)
+        failed: Dict[str, int] = {}
+        last_status_check = 0.0
+        while pending:
+            for replica, proc in list(pending.items()):
+                rc = proc.poll()
+                if rc is not None:
+                    proc._ptpu_pump.join(timeout=5)
+                    del pending[replica]
+                    if rc != 0:
+                        failed[replica] = rc
+            if not pending:
+                break
+            now = time.time()
+            if deadline is not None and now >= deadline:
+                self._kill_all(pending)
+                raise ExecutionError(f"Run timed out after {timeout}s")
+            if now - last_status_check >= poll_interval:
+                last_status_check = now
+                try:
+                    status = self.store.get_run(run_uuid).get("status")
+                except Exception:
+                    status = None
+                if status == V1Statuses.STOPPING:
+                    self._kill_all(pending)
+                    raise StopRequested()
+            time.sleep(min(poll_interval, 0.05))
+        if failed:
+            detail = ", ".join(f"{r} exited {c}" for r, c in failed.items())
+            raise ExecutionError(f"Process failure: {detail}")
+
+    @staticmethod
+    def _kill_all(procs: Dict[str, subprocess.Popen]) -> None:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+
+    def _run_job(self, run_uuid: str, compiled, timeout: Optional[float]) -> None:
+        container = compiled.run.container
+        argv = self._container_argv(container)
+        env = self._build_env(run_uuid)
+        for e in (container.env or []):
+            if e.value is not None:
+                env[e.name] = str(e.value)
+        self.store.set_status(run_uuid, V1Statuses.RUNNING,
+                              reason="LocalExecutor", force=True)
+        proc = self._spawn(run_uuid, argv, env, "main",
+                           cwd=container.working_dir)
+        self._wait(run_uuid, {"main": proc}, timeout)
+
+    # -- distributed -----------------------------------------------------
+
+    def _run_distributed(self, run_uuid: str, compiled,
+                         timeout: Optional[float]) -> None:
+        topo: ProcessTopology = normalize(compiled.run)
+        port = _free_port()
+        procs: Dict[str, subprocess.Popen] = {}
+        self.store.set_status(run_uuid, V1Statuses.RUNNING,
+                              reason="LocalExecutor", force=True)
+        for group in topo.groups:
+            container = group.spec.container or getattr(
+                compiled.run, "worker", None) and compiled.run.worker.container
+            argv = self._container_argv(container)
+            for index in range(group.replicas):
+                replica = f"{group.role}-{index}"
+                topo_env = topo.process_env(group.role, index, run=run_uuid,
+                                            port=port)
+                # Local simulation: every process is on this host.
+                topo_env["PTPU_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+                env = self._build_env(run_uuid, topo_env)
+                for e in (container.env or []):
+                    if e.value is not None:
+                        env[e.name] = str(e.value)
+                procs[replica] = self._spawn(run_uuid, argv, env, replica,
+                                             cwd=container.working_dir)
+        self._wait(run_uuid, procs, timeout)
+
+    # -- dag -------------------------------------------------------------
+
+    def _run_dag(self, run_uuid: str, operation: V1Operation, compiled) -> None:
+        from .dag import DagError, DagRunner
+
+        self.store.set_status(run_uuid, V1Statuses.RUNNING,
+                              reason="LocalExecutor", force=True)
+        try:
+            DagRunner(self, compiled, pipeline_uuid=run_uuid).execute()
+        except DagError as e:
+            raise ExecutionError(str(e)) from e

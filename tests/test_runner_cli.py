@@ -1,0 +1,340 @@
+"""Local runner + CLI end-to-end tests (the minimum e2e slice,
+SURVEY.md §7 step 4)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+from click.testing import CliRunner
+
+from polyaxon_tpu.client import FileRunStore
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.polyaxonfile import get_op_from_files
+from polyaxon_tpu.runner import ExecutionError, LocalExecutor
+
+
+def job_op(command, name="test-job", **kw):
+    spec = {
+        "kind": "operation",
+        "name": name,
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "container": {"command": [sys.executable, "-c", command]},
+            },
+        },
+    }
+    spec.update(kw)
+    return get_op_from_files(spec)
+
+
+@pytest.fixture
+def executor(tmp_home):
+    return LocalExecutor(store=FileRunStore(str(tmp_home)), project="test")
+
+
+class TestLocalJob:
+    def test_success_flow(self, executor):
+        record = executor.run_operation(job_op("print('hello from job')"))
+        assert record["status"] == V1Statuses.SUCCEEDED
+        logs = executor.store.read_logs(record["uuid"])
+        assert "hello from job" in logs
+        types = [c.type for c in executor.store.get_statuses(record["uuid"])]
+        assert types[0] == V1Statuses.CREATED
+        assert V1Statuses.COMPILED in types and V1Statuses.RUNNING in types
+        assert types[-1] == V1Statuses.SUCCEEDED
+
+    def test_failure_flow(self, executor):
+        record = executor.run_operation(job_op("import sys; sys.exit(3)"))
+        assert record["status"] == V1Statuses.FAILED
+
+    def test_retries(self, executor):
+        op = job_op("import sys; sys.exit(1)")
+        op.termination = __import__(
+            "polyaxon_tpu.flow", fromlist=["V1Termination"]
+        ).V1Termination(max_retries=2)
+        record = executor.run_operation(op)
+        assert record["status"] == V1Statuses.FAILED
+        types = [c.type for c in executor.store.get_statuses(record["uuid"])]
+        assert types.count(V1Statuses.RETRYING) == 2
+
+    def test_timeout(self, executor):
+        op = job_op("import time; time.sleep(30)")
+        op.termination = __import__(
+            "polyaxon_tpu.flow", fromlist=["V1Termination"]
+        ).V1Termination(timeout=1)
+        record = executor.run_operation(op)
+        assert record["status"] == V1Statuses.FAILED
+
+    def test_tracking_inside_job(self, executor):
+        code = textwrap.dedent("""
+            from polyaxon_tpu import tracking
+            run = tracking.init(collect_system_metrics=False, track_env=False)
+            tracking.log_metrics(step=1, loss=0.25)
+            tracking.log_outputs(accuracy=0.99)
+            tracking.end()
+        """)
+        record = executor.run_operation(job_op(code))
+        assert record["status"] == V1Statuses.SUCCEEDED
+        # the job attached to ITS run via injected env
+        assert executor.store.last_metrics(record["uuid"]) == {"loss": 0.25}
+        assert executor.store.get_run(record["uuid"])["outputs"] == {
+            "accuracy": 0.99}
+
+    def test_params_resolve_into_args(self, executor):
+        spec = {
+            "kind": "operation",
+            "name": "argjob",
+            "params": {"msg": "tpu-rocks"},
+            "component": {
+                "kind": "component",
+                "inputs": [{"name": "msg", "type": "str"}],
+                "run": {
+                    "kind": "job",
+                    "container": {
+                        "command": [sys.executable, "-c",
+                                    "import sys; print(sys.argv[1])"],
+                        "args": ["{{ msg }}"],
+                    },
+                },
+            },
+        }
+        record = executor.run_operation(get_op_from_files(spec))
+        assert "tpu-rocks" in executor.store.read_logs(record["uuid"])
+
+
+class TestLocalDistributed:
+    def test_multiprocess_topology_env(self, executor):
+        code = textwrap.dedent("""
+            import os
+            print("pid=%s role=%s coord=%s n=%s" % (
+                os.environ["PTPU_PROCESS_ID"],
+                os.environ["PTPU_REPLICA_ROLE"],
+                os.environ["PTPU_COORDINATOR_ADDRESS"],
+                os.environ["PTPU_NUM_PROCESSES"]))
+        """)
+        spec = {
+            "kind": "operation",
+            "name": "dist",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "tpujob",
+                    "worker": {
+                        "replicas": 3,
+                        "container": {"command": [sys.executable, "-c", code]},
+                    },
+                },
+            },
+        }
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.SUCCEEDED
+        logs = executor.store.read_logs(record["uuid"])
+        for pid in range(3):
+            assert f"pid={pid} role=worker" in logs
+        assert logs.count("n=3") == 3
+
+    def test_mpijob_compat_runs(self, executor):
+        spec = {
+            "kind": "operation",
+            "name": "mpi-compat",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "mpijob",
+                    "launcher": {"replicas": 1},
+                    "worker": {
+                        "replicas": 2,
+                        "container": {
+                            "command": [sys.executable, "-c",
+                                        "import os; print('w', os.environ['PTPU_PROCESS_ID'])"],
+                        },
+                    },
+                },
+            },
+        }
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.SUCCEEDED
+
+    def test_replica_failure_fails_run(self, executor):
+        code = ("import os,sys; "
+                "sys.exit(2 if os.environ['PTPU_PROCESS_ID']=='1' else 0)")
+        spec = {
+            "kind": "operation",
+            "name": "dist-fail",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "tpujob",
+                    "worker": {
+                        "replicas": 2,
+                        "container": {"command": [sys.executable, "-c", code]},
+                    },
+                },
+            },
+        }
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.FAILED
+
+
+class TestDag:
+    def test_dag_with_output_refs(self, executor):
+        produce = textwrap.dedent("""
+            from polyaxon_tpu import tracking
+            tracking.init(collect_system_metrics=False, track_env=False)
+            tracking.log_outputs(number=41)
+            tracking.end()
+        """)
+        consume = ("import sys; v=int(sys.argv[1]); print('got', v+1); "
+                   "assert v == 41")
+        spec = {
+            "kind": "operation",
+            "name": "pipeline",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {
+                            "kind": "operation",
+                            "name": "producer",
+                            "component": {
+                                "kind": "component",
+                                "outputs": [{"name": "number", "type": "int"}],
+                                "run": {"kind": "job", "container": {
+                                    "command": [sys.executable, "-c", produce]}},
+                            },
+                        },
+                        {
+                            "kind": "operation",
+                            "name": "consumer",
+                            "params": {"n": {"ref": "ops.producer",
+                                             "value": "number"}},
+                            "component": {
+                                "kind": "component",
+                                "inputs": [{"name": "n", "type": "int"}],
+                                "run": {"kind": "job", "container": {
+                                    "command": [sys.executable, "-c", consume],
+                                    "args": ["{{ n }}"]}},
+                            },
+                        },
+                    ],
+                },
+            },
+        }
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.SUCCEEDED
+        children = executor.store.list_runs(pipeline=record["uuid"])
+        assert len(children) == 2
+        consumer = [c for c in children if c["name"] == "consumer"][0]
+        assert "got 42" in executor.store.read_logs(consumer["uuid"])
+
+    def test_dag_cycle_detected(self, executor):
+        spec = {
+            "kind": "operation",
+            "name": "cyc",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"kind": "operation", "name": "a",
+                         "dependencies": ["b"],
+                         "component": {"kind": "component",
+                                       "run": {"kind": "job", "container": {
+                                           "command": ["true"]}}}},
+                        {"kind": "operation", "name": "b",
+                         "dependencies": ["a"],
+                         "component": {"kind": "component",
+                                       "run": {"kind": "job", "container": {
+                                           "command": ["true"]}}}},
+                    ],
+                },
+            },
+        }
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.FAILED
+
+
+class TestCli:
+    def _invoke(self, tmp_home, args, input=None):
+        from polyaxon_tpu.cli.main import cli
+
+        runner = CliRunner()
+        env = {"POLYAXON_TPU_HOME": str(tmp_home)}
+        return runner.invoke(cli, args, env=env, input=input,
+                             catch_exceptions=False)
+
+    def test_version(self, tmp_home):
+        result = self._invoke(tmp_home, ["version"])
+        assert result.exit_code == 0
+        assert "polyaxon-tpu" in result.output
+
+    def test_run_and_ops_flow(self, tmp_home, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(textwrap.dedent(f"""
+            kind: operation
+            name: cli-job
+            component:
+              kind: component
+              inputs:
+                - {{name: msg, type: str, value: default-msg, isOptional: true}}
+              run:
+                kind: job
+                container:
+                  command: ["{sys.executable}", "-c", "import sys; print(sys.argv[1])"]
+                  args: ["{{{{ msg }}}}"]
+        """))
+        result = self._invoke(tmp_home, ["run", "-f", str(f),
+                                         "-P", "msg=from-cli", "--no-watch"])
+        assert result.exit_code == 0, result.output
+        assert "succeeded" in result.output
+
+        result = self._invoke(tmp_home, ["ops", "ls"])
+        assert "cli-job" in result.output
+        uuid = result.output.splitlines()[1].split()[0]
+
+        result = self._invoke(tmp_home, ["ops", "logs", uuid])
+        assert "from-cli" in result.output
+
+        result = self._invoke(tmp_home, ["ops", "get", uuid])
+        assert json.loads(result.output)["status"] == "succeeded"
+
+        result = self._invoke(tmp_home, ["ops", "statuses", uuid])
+        assert "succeeded" in result.output
+
+        result = self._invoke(tmp_home, ["ops", "restart", uuid])
+        assert result.exit_code == 0
+        result = self._invoke(tmp_home, ["ops", "ls"])
+        assert result.output.count("cli-job") == 2
+
+    def test_run_failure_exits_nonzero(self, tmp_home, tmp_path):
+        f = tmp_path / "bad.yaml"
+        f.write_text(textwrap.dedent(f"""
+            kind: operation
+            name: failing
+            component:
+              kind: component
+              run:
+                kind: job
+                container:
+                  command: ["{sys.executable}", "-c", "raise SystemExit(2)"]
+        """))
+        result = self._invoke(tmp_home, ["run", "-f", str(f), "--no-watch"])
+        assert result.exit_code != 0
+
+    def test_check_command(self, tmp_home, tmp_path):
+        f = tmp_path / "op.yaml"
+        f.write_text("kind: operation\nname: x\ncomponent:\n  kind: component\n"
+                     "  run:\n    kind: job\n    container: {command: [echo]}\n")
+        result = self._invoke(tmp_home, ["check", "-f", str(f)])
+        assert "Valid operation" in result.output
+
+    def test_check_invalid_file(self, tmp_home, tmp_path):
+        f = tmp_path / "op.yaml"
+        f.write_text("kind: wat\n")
+        result = self._invoke(tmp_home, ["check", "-f", str(f)])
+        assert result.exit_code != 0
