@@ -13,6 +13,15 @@ mesh natively:
 """
 
 from .bootstrap import initialize_from_env, topology_from_env
+from .collectives import (
+    all_gather,
+    all_reduce,
+    all_reduce_mean,
+    all_to_all,
+    hierarchical_all_reduce,
+    reduce_scatter,
+    ring_permute,
+)
 from .mesh import (
     MeshSpec,
     build_mesh,
@@ -20,3 +29,14 @@ from .mesh import (
     local_mesh,
     replicate_sharding,
 )
+from .moe import moe_layer, top1_dispatch
+from .pipeline import pipeline_apply
+from .ring import ring_attention
+from .strategies import (
+    TrainStep,
+    infer_param_spec,
+    make_batch_sharding,
+    make_param_shardings,
+    make_train_step,
+)
+from .ulysses import ulysses_attention

@@ -1,0 +1,71 @@
+"""Collective helpers: the ICI/DCN communication vocabulary.
+
+The reference's communication backends are NCCL/MPI/gRPC, all delegated
+(SURVEY.md 5.8).  Here every collective is an XLA op over mesh axes; these
+helpers add the hierarchical multi-slice pattern (reduce-scatter inside the
+slice on ICI -> allreduce across slices on DCN -> all-gather on ICI),
+which XLA also derives automatically from hybrid meshes — the explicit
+forms exist for shard_map code and for benchmarks/tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisName = Union[str, Sequence[str]]
+
+
+def all_reduce(x: jax.Array, axis: AxisName) -> jax.Array:
+    """Sum over one or more mesh axes (inside shard_map)."""
+    return jax.lax.psum(x, axis)
+
+
+def all_reduce_mean(x: jax.Array, axis: AxisName) -> jax.Array:
+    return jax.lax.pmean(x, axis)
+
+
+def reduce_scatter(x: jax.Array, axis: str, *, scatter_dim: int = 0) -> jax.Array:
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=True)
+
+
+def all_gather(x: jax.Array, axis: str, *, gather_dim: int = 0) -> jax.Array:
+    return jax.lax.all_gather(x, axis, axis=gather_dim, tiled=True)
+
+
+def all_to_all(x: jax.Array, axis: str, *, split_dim: int,
+               concat_dim: int) -> jax.Array:
+    return jax.lax.all_to_all(x, axis, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
+
+
+def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
+    """Rotate shards around an axis (nearest-neighbor ICI hops)."""
+    n = jax.lax.psum(1, axis)
+    perm = [(j, (j + shift) % n) for j in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def hierarchical_all_reduce(
+    x: jax.Array,
+    ici_axis: str = "fsdp",
+    dcn_axis: str = "dp",
+    *,
+    scatter_dim: int = 0,
+) -> jax.Array:
+    """Bandwidth-optimal multi-slice allreduce (inside shard_map):
+
+    1. reduce-scatter over the ICI axis (each chip ends with 1/n of the sum)
+    2. allreduce the shard over the DCN axis (small traffic crosses DCN)
+    3. all-gather back over ICI.
+
+    Equivalent to psum over both axes; the explicit form pins the
+    DCN-traffic-minimizing schedule and serves as the reference
+    implementation for the benchmark suite.
+    """
+    shard = reduce_scatter(x, ici_axis, scatter_dim=scatter_dim)
+    shard = all_reduce(shard, dcn_axis)
+    return all_gather(shard, ici_axis, gather_dim=scatter_dim)

@@ -1,0 +1,120 @@
+"""Pipeline parallelism: GPipe-style schedule over the ``pp`` mesh axis.
+
+The reference has no tensor-level pipeline support (SURVEY.md 2.12); here
+stages live on mesh devices and activations move stage-to-stage with
+``ppermute`` (one ICI hop on TPU).  The schedule is a single ``lax.scan``
+over ``n_micro + n_stages - 1`` ticks: in steady state every stage
+computes one microbatch per tick while the permute of the previous tick's
+activations rides the ICI in parallel — XLA overlaps the two.
+
+Assumes homogeneous stages (a stack of identical blocks — the transformer
+case): each device holds its own stage's params; stage0 additionally owns
+embedding, the last stage the head (handled by the caller's stage_fn via
+the stage index).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_shard(params, x_micro, *, axis_name: str, stage_fn,
+                    n_micro: int):
+    """Per-shard body.
+
+    params:  this stage's params (pytree, local).
+    x_micro: [n_micro, mb, ...] input microbatches (only stage 0's are
+             real; other stages receive garbage they ignore).
+    Returns [n_micro, mb, ...] outputs (valid on the LAST stage).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    total = n_micro + n_stages - 1
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    buf_shape = x_micro.shape[1:]
+    out_accum = jnp.zeros_like(x_micro)
+
+    def tick(carry, t):
+        carried_act, out_accum = carry
+        # Stage 0 ingests microbatch t (while t < n_micro); other stages
+        # consume what arrived from the left neighbor.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inject = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, 0,
+                                              keepdims=False)
+        x_in = jnp.where(stage == 0, inject, carried_act)
+        y = stage_fn(stage, params, x_in)
+        # Last stage writes its result for microbatch (t - n_stages + 1).
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        write = jnp.logical_and(stage == n_stages - 1,
+                                t >= n_stages - 1)
+        out_accum = jax.lax.cond(
+            write,
+            lambda acc: jax.lax.dynamic_update_index_in_dim(
+                acc, y, out_idx, 0),
+            lambda acc: acc,
+            out_accum,
+        )
+        # Move activations right one stage for the next tick.
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return (nxt, out_accum), None
+
+    init = (jnp.zeros(buf_shape, x_micro.dtype), out_accum)
+    (_, out_accum), _ = jax.lax.scan(tick, init, jnp.arange(total))
+    return out_accum
+
+
+def pipeline_apply(
+    stage_fn: Callable[[jax.Array, Any, jax.Array], jax.Array],
+    params_stacked: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pp",
+    n_micro: int = 4,
+    batch_axes=("dp", "fsdp"),
+) -> jax.Array:
+    """Run a homogeneous pipeline.
+
+    stage_fn(stage_index, stage_params, x) -> y  (same shape as x).
+    params_stacked: pytree whose leaves have a leading [n_stages] axis
+    (stage i's slice lives on pipeline rank i).
+    x: GLOBAL [batch, ...]; batch must divide n_micro * microbatch.
+    Returns y with x's sharding; results are only meaningful after the
+    caller reads them from the last stage (psum-broadcast below makes the
+    value uniform across the pp axis so downstream code is simple).
+    """
+    from jax import shard_map
+
+    n_stages = mesh.shape.get(axis_name, 1)
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(f"Batch {batch} must divide into {n_micro} microbatches")
+    mb = batch // n_micro
+
+    bspec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    param_spec = jax.tree.map(lambda _: P(axis_name), params_stacked)
+    x_micro = x.reshape((n_micro, mb) + x.shape[1:])
+
+    def body(params, xm):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        out = _pipeline_shard(params, xm, axis_name=axis_name,
+                              stage_fn=stage_fn, n_micro=n_micro)
+        # Broadcast the last stage's result to all pp ranks.
+        n = jax.lax.psum(1, axis_name)
+        stage = jax.lax.axis_index(axis_name)
+        out = jnp.where(stage == n - 1, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis_name)
+
+    out_micro = shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, P(None, bspec)),
+        out_specs=P(None, bspec),
+        check_vma=False,
+    )(params_stacked, x_micro)
+    return out_micro.reshape((batch,) + out_micro.shape[2:])

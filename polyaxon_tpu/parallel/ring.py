@@ -1,0 +1,123 @@
+"""Ring attention: sequence/context parallelism over the ICI torus.
+
+Long-context capability the reference lacks entirely (SURVEY.md 5.7).
+Design follows the blockwise/ring-attention literature (see PAPERS.md):
+each device owns one sequence block of Q/K/V; K/V blocks rotate around the
+``sp`` axis via ``ppermute`` (on TPU this maps onto nearest-neighbor ICI
+hops — the hardware *is* the ring), while each device accumulates its
+local Q's attention with a numerically-stable running log-sum-exp.
+Compute of block r overlaps with the DMA of block r+1 (XLA schedules the
+ppermute async); the attention never materializes the full [S, S] matrix.
+
+All functions are written per-shard and meant to be wrapped by
+``shard_map`` (see ``ring_attention`` for the driver).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BIG_NEG = -1e30
+
+
+def _block_attend(q, k, v, *, scale, q_offset, kv_offset, causal):
+    """One blockwise attention contribution.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D] -> (scores-derived partials)
+    Returns (p @ v) unnormalized [B, Sq, H, D], row max m [B, Sq, H],
+    row sum l [B, Sq, H] — all in f32 for stable accumulation.
+    """
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q32, k32) * scale  # [B,Sq,H,Sk]
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_ids = q_offset + jnp.arange(sq)[:, None]
+        k_ids = kv_offset + jnp.arange(sk)[None, :]
+        mask = q_ids >= k_ids  # [Sq, Sk]
+        scores = jnp.where(mask[None, :, None, :], scores, BIG_NEG)
+    m = jnp.max(scores, axis=-1)  # [B,Sq,H]
+    p = jnp.exp(scores - m[..., None])
+    # Fully-masked rows: zero contribution (m stays BIG_NEG, p -> 1.0 rows
+    # must not pollute the sum).
+    valid = m > BIG_NEG / 2
+    p = jnp.where(valid[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,Sq,H]
+    pv = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return pv, m, l
+
+
+def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-shard body: q/k/v are the LOCAL sequence blocks [B, Sblk, H, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_blk = q.shape[1]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:2] + q.shape[2:3], BIG_NEG, jnp.float32)  # [B,Sq,H]
+    l = jnp.zeros(q.shape[:2] + q.shape[2:3], jnp.float32)
+
+    def step(carry, r):
+        o, m, l, k_cur, v_cur = carry
+        src = (my_idx - r) % n  # which block k_cur/v_cur originated from
+        pv, m_blk, l_blk = _block_attend(
+            q, k_cur, v_cur, scale=scale,
+            q_offset=my_idx * s_blk, kv_offset=src * s_blk, causal=causal,
+        )
+        new_m = jnp.maximum(m, m_blk)
+        corr_old = jnp.exp(m - new_m)
+        corr_new = jnp.exp(m_blk - new_m)
+        # exp(BIG_NEG - BIG_NEG) = 1 on never-touched rows: guard with the
+        # validity of each side instead.
+        corr_old = jnp.where(m > BIG_NEG / 2, corr_old, 0.0)
+        corr_new = jnp.where(m_blk > BIG_NEG / 2, corr_new, 0.0)
+        o = o * corr_old[..., None] + pv * corr_new[..., None]
+        l = l * corr_old + l_blk * corr_new
+        # Rotate K/V to the next neighbor (skipped after the last step).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, new_m, l, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+):
+    """Ring attention over a mesh axis.
+
+    q/k/v: GLOBAL arrays [B, S, H, D]; S must divide by mesh.shape[axis_name].
+    Returns attention output with the same sharding as q.
+    """
+    from jax import shard_map
+
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch, axis_name, None, None)
+    body = functools.partial(_ring_attention_shard, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,228 @@
+"""Strategy library: build sharded train steps from a spec.
+
+This is the framework-owned replacement for the reference's delegated
+DP-via-NCCL / ring-allreduce paths (SURVEY.md 2.12/5.8):
+
+- **DP**:   batch sharded over ``dp``; XLA inserts the gradient AllReduce
+            (ICI within a slice, hierarchical over DCN for multi-slice
+            meshes) and overlaps it with the backward pass.
+- **FSDP**: params/optimizer sharded on their largest axis over ``fsdp``;
+            XLA turns the weight use into all-gather + reduce-scatter.
+- **TP**:   params matching the tensor-parallel rules shard over ``tp``.
+- Strategies compose: one mesh, one set of PartitionSpecs.
+
+The job spec selects a strategy via ``run.strategy`` (e.g.
+``{dp: -1, tp: 4}``) — see ``flow.run.V1TPUJob.strategy``.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MeshSpec, build_mesh
+
+
+# Rules: (regex over the param path, PartitionSpec builder).  First match
+# wins.  Paths look like "transformer/layers_3/attn/qkv/kernel".
+TP_RULES: List[Tuple[str, Callable[[tuple], P]]] = [
+    # Row-parallel (input dim sharded) rules first — they are the more
+    # specific names and must win over any generic block-name token.
+    (r"(o_proj|out_proj|attention_out|proj_out)[^/]*/kernel",
+     lambda shape: P("tp", None)),
+    (r"(fc2|wo|down_proj|output_dense|mlp_out)[^/]*/kernel",
+     lambda shape: P("tp", None)),
+    # Column-parallel (output dim sharded).
+    (r"(q_proj|k_proj|v_proj|qkv|query|key|value)[^/]*/kernel",
+     lambda shape: P(None, "tp")),
+    (r"(fc1|wi|up_proj|gate_proj|intermediate)[^/]*/kernel",
+     lambda shape: P(None, "tp")),
+    # Embeddings / LM head: shard the vocab dim.
+    (r"(embed|embedding|wte|lm_head)[^/]*/(embedding|kernel)",
+     lambda shape: P("tp", None)),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None) or getattr(p, "name", None) or \
+            getattr(p, "idx", None)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def infer_param_spec(
+    path,
+    leaf,
+    *,
+    tp: bool = False,
+    fsdp: bool = False,
+    fsdp_min_size: int = 2 ** 16,
+) -> P:
+    """PartitionSpec for one parameter."""
+    shape = getattr(leaf, "shape", ())
+    spec = [None] * len(shape)
+    name = _path_str(path)
+
+    if tp:
+        for pattern, builder in TP_RULES:
+            if re.search(pattern, name):
+                cand = builder(shape)
+                cand_list = list(cand) + [None] * (len(shape) - len(cand))
+                spec = cand_list[:len(shape)]
+                break
+
+    if fsdp and int(np.prod(shape or (1,))) >= fsdp_min_size:
+        # Shard the largest still-unsharded axis over fsdp.
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for axis in order:
+            if spec[axis] is None:
+                spec[axis] = "fsdp"
+                break
+    return P(*spec)
+
+
+def make_param_shardings(
+    params: Any,
+    mesh: Mesh,
+    *,
+    fsdp_min_size: int = 2 ** 16,
+) -> Any:
+    """NamedShardings for a param pytree based on the mesh's active axes."""
+    tp = mesh.shape.get("tp", 1) > 1
+    fsdp = mesh.shape.get("fsdp", 1) > 1
+
+    def leaf_sharding(path, leaf):
+        spec = infer_param_spec(path, leaf, tp=tp, fsdp=fsdp,
+                                fsdp_min_size=fsdp_min_size)
+        # Drop axes that don't divide the dim.
+        shape = getattr(leaf, "shape", ())
+        fixed = []
+        for dim, ax in zip(shape, spec):
+            if ax is not None and dim % mesh.shape[ax] != 0:
+                ax = None
+            fixed.append(ax)
+        return NamedSharding(mesh, P(*fixed))
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
+
+
+def make_batch_sharding(mesh: Mesh) -> NamedSharding:
+    axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    return NamedSharding(mesh, P(axes or None))
+
+
+class TrainStep:
+    """A compiled, sharded train step.
+
+    Wraps: loss_fn(params, batch, rng) -> (loss, aux) into
+    step(state, batch, rng) -> (state, metrics), jitted over the mesh with
+    donated state.  ``state`` is a dict {params, opt_state, step}.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        optimizer,
+        mesh: Mesh,
+        *,
+        param_shardings=None,
+        batch_sharding=None,
+        donate: bool = True,
+        grad_accum: int = 1,
+    ):
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.param_shardings = param_shardings
+        self.batch_sharding = batch_sharding or make_batch_sharding(mesh)
+        self.grad_accum = grad_accum
+        self._step = None
+        self._donate = donate
+
+    def init_state(self, params) -> Dict[str, Any]:
+        shardings = self.param_shardings or make_param_shardings(params,
+                                                                 self.mesh)
+        self.param_shardings = shardings
+        params = jax.device_put(params, shardings)
+        opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=None,  # let XLA lay optimizer state like params
+        )(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _build(self):
+        loss_fn, optimizer = self.loss_fn, self.optimizer
+        accum = self.grad_accum
+
+        def one_grad(params, batch, rng):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, rng)
+            return loss, aux, grads
+
+        def step(state, batch, rng):
+            params = state["params"]
+            if accum > 1:
+                def micro(carry, mb):
+                    loss_a, grads_a = carry
+                    loss, aux, grads = one_grad(params, mb, rng)
+                    grads_a = jax.tree.map(jnp.add, grads_a, grads)
+                    return (loss_a + loss, grads_a), aux
+                micro_batches = jax.tree.map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum)
+                                        + x.shape[1:]), batch)
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (loss, grads), aux = jax.lax.scan(
+                    micro, (jnp.zeros(()), zeros), micro_batches)
+                loss = loss / accum
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                aux = jax.tree.map(lambda a: a[-1], aux)
+            else:
+                loss, aux, grads = one_grad(params, batch, rng)
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], params)
+            params = jax.tree.map(
+                lambda p, u: (p + u).astype(p.dtype), params, updates)
+            metrics = {"loss": loss,
+                       "grad_norm": optax_global_norm(grads), **(aux or {})}
+            return (
+                {"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                metrics,
+            )
+
+        self._step = jax.jit(
+            step,
+            donate_argnums=(0,) if self._donate else (),
+            in_shardings=(None, self.batch_sharding, None),
+        )
+        return self._step
+
+    def __call__(self, state, batch, rng):
+        if self._step is None:
+            self._build()
+        return self._step(state, batch, rng)
+
+
+def optax_global_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    spec: Optional[MeshSpec] = None,
+    **kwargs,
+) -> TrainStep:
+    mesh = mesh or build_mesh(spec)
+    return TrainStep(loss_fn, optimizer, mesh, **kwargs)

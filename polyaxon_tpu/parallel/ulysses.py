@@ -1,0 +1,90 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+Second context-parallel strategy (SURVEY.md 5.7): instead of rotating K/V
+(ring), reshard so each device sees the FULL sequence for a subset of
+heads — one all-to-all before attention, one after.  On TPU the
+``all_to_all`` lowers to ICI all-to-all; cost is 2 reshards of activations
+vs the ring's (n-1) K/V hops, favoring Ulysses when heads >> sp and
+attention kernels want the whole sequence (e.g. flash attention on-chip).
+
+Requires num_heads % sp == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, attn_fn, n_heads_global: int):
+    """Per-shard body: inputs [B, S/sp, H, D] -> output [B, S/sp, H, D]."""
+    sp = jax.lax.psum(1, axis_name)
+
+    def seq2head(x):
+        # [B, S/sp, H, D] -> [B, S, H/sp, D]: split heads, gather sequence.
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    q_full = seq2head(q)
+    k_full = seq2head(k)
+    v_full = seq2head(v)
+    o_full = attn_fn(q_full, k_full, v_full)
+    return head2seq(o_full)
+
+
+def _plain_attention(q, k, v, *, causal: bool, scale: Optional[float]):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    attn_fn: Optional[Callable] = None,
+    batch_axes=("dp", "fsdp"),
+):
+    """Ulysses attention over a mesh axis; q/k/v GLOBAL [B, S, H, D]."""
+    from jax import shard_map
+
+    sp = mesh.shape.get(axis_name, 1)
+    n_heads = q.shape[2]
+    if n_heads % sp:
+        raise ValueError(
+            f"Ulysses needs heads ({n_heads}) divisible by {axis_name} "
+            f"axis size ({sp}); use ring attention otherwise"
+        )
+    inner = attn_fn or functools.partial(_plain_attention, causal=causal,
+                                         scale=scale)
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch, axis_name, None, None)
+    body = functools.partial(_ulysses_shard, axis_name=axis_name,
+                             attn_fn=inner, n_heads_global=n_heads)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

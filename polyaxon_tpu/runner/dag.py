@@ -21,6 +21,10 @@ class DagError(RuntimeError):
     pass
 
 
+class DagStopped(RuntimeError):
+    """A member run was deliberately stopped; the dag finalizes as stopped."""
+
+
 def _op_from_entry(entry: Any, components: Dict[str, V1Component]) -> V1Operation:
     if isinstance(entry, V1Operation):
         op = entry
@@ -163,6 +167,7 @@ class DagRunner:
                     n for n in list(remaining)
                     if self.edges[n] <= set(self.statuses)
                 ]
+                skipped_any = False
                 for name in ready:
                     remaining.discard(name)
                     if not self._upstream_ok(name):
@@ -175,8 +180,12 @@ class DagRunner:
                             else V1Statuses.SKIPPED
                         )
                         self.statuses[name] = skip_status
+                        skipped_any = True
                         continue
                     futures[pool.submit(self._run_one, name)] = name
+                if skipped_any:
+                    # Skip decisions may have made more ops ready.
+                    continue
                 if not futures:
                     if remaining:
                         raise DagError(
@@ -190,6 +199,10 @@ class DagRunner:
                         self.statuses[name] = fut.result()
                     except Exception:
                         self.statuses[name] = V1Statuses.FAILED
+        stopped = [n for n, s in self.statuses.items()
+                   if s == V1Statuses.STOPPED]
+        if stopped:
+            raise DagStopped(f"Dag stopped: members {sorted(stopped)}")
         failed = [n for n, s in self.statuses.items()
                   if s in (V1Statuses.FAILED, V1Statuses.UPSTREAM_FAILED)]
         if failed:

@@ -169,6 +169,10 @@ class LocalExecutor:
     def _build_env(self, run_uuid: str, extra: Optional[Dict[str, str]] = None
                    ) -> Dict[str, str]:
         env = dict(os.environ)
+        # The child must track against THIS executor's store; a configured
+        # API host would silently send its metrics elsewhere (breaking
+        # tuner joins in --eager mode).
+        env.pop("POLYAXON_TPU_HOST", None)
         env[ENV_RUN_UUID] = run_uuid
         env[ENV_PROJECT] = self.project
         env["POLYAXON_TPU_HOME"] = self.store.home
@@ -294,11 +298,13 @@ class LocalExecutor:
     # -- dag -------------------------------------------------------------
 
     def _run_dag(self, run_uuid: str, operation: V1Operation, compiled) -> None:
-        from .dag import DagError, DagRunner
+        from .dag import DagError, DagRunner, DagStopped
 
         self.store.set_status(run_uuid, V1Statuses.RUNNING,
                               reason="LocalExecutor", force=True)
         try:
             DagRunner(self, compiled, pipeline_uuid=run_uuid).execute()
+        except DagStopped as e:
+            raise StopRequested() from e
         except DagError as e:
             raise ExecutionError(str(e)) from e

@@ -50,6 +50,7 @@ class TuneController:
         self.concurrency = getattr(self.matrix, "concurrency", None) or 4
         self.results: List[Dict[str, Any]] = []
         self._stop = threading.Event()
+        self._stopped_by_user = False
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -69,8 +70,9 @@ class TuneController:
     def _run_child(self, index: int, params: Dict[str, Any],
                    extra_meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Execute one suggestion; returns {'params', 'metric', 'status', 'uuid'}."""
+        self._poll_pipeline_stop()
         if self._stop.is_set():
-            out = {"params": params, "metric": None,
+            out = {"params": params, "metric": None, "metrics": {},
                    "status": V1Statuses.SKIPPED, "uuid": None}
             with self._lock:
                 self.results.append(out)
@@ -82,14 +84,13 @@ class TuneController:
             uuid = record["uuid"]
             if extra_meta:
                 self.store.update_run(uuid, meta_info=extra_meta)
+            metrics = self.store.last_metrics(uuid)
             metric_name = self._metric_name()
-            metric = None
-            if metric_name:
-                metric = self.store.last_metrics(uuid).get(metric_name)
-            out = {"params": params, "metric": metric,
+            metric = metrics.get(metric_name) if metric_name else None
+            out = {"params": params, "metric": metric, "metrics": metrics,
                    "status": record["status"], "uuid": uuid}
         except Exception as e:  # child failure must not kill the sweep
-            out = {"params": params, "metric": None,
+            out = {"params": params, "metric": None, "metrics": {},
                    "status": V1Statuses.FAILED, "uuid": None,
                    "error": str(e)}
         with self._lock:
@@ -97,11 +98,23 @@ class TuneController:
             self._check_early_stopping()
         return out
 
+    def _poll_pipeline_stop(self) -> None:
+        """Honor `ops stop <pipeline-uuid>`: stop launching trials."""
+        try:
+            status = self.store.get_run(self.pipeline_uuid).get("status")
+        except Exception:
+            return
+        if status in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+            self._stopped_by_user = True
+            self._stop.set()
+
     def _check_early_stopping(self) -> None:
         for policy in getattr(self.matrix, "early_stopping", None) or []:
             if isinstance(policy, V1MetricEarlyStopping):
                 for r in self.results:
-                    v = r.get("metric")
+                    # The policy names its own metric series — it need not
+                    # be the sweep's optimization metric.
+                    v = (r.get("metrics") or {}).get(policy.metric)
                     if v is None:
                         continue
                     hit = (v >= policy.value
@@ -258,8 +271,12 @@ class TuneController:
                 outputs["best_params"] = best["params"]
                 outputs["best_run"] = best["uuid"]
         self.store.update_run(self.pipeline_uuid, outputs=outputs)
-        status = (V1Statuses.SUCCEEDED if succeeded
-                  else V1Statuses.FAILED)
+        if self._stopped_by_user:
+            status = V1Statuses.STOPPED
+        elif succeeded:
+            status = V1Statuses.SUCCEEDED
+        else:
+            status = V1Statuses.FAILED
         self.store.set_status(self.pipeline_uuid, status,
                               reason="TuneController", force=True)
         return self.store.get_run(self.pipeline_uuid)

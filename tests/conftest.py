@@ -15,6 +15,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("POLYAXON_TPU_NO_TPU", "1")
 
+# Plugins (jaxtyping) import jax BEFORE this conftest runs, so jax.config
+# already captured the env; override the live config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,288 @@
+"""Parallelism library tests on the 8-device virtual CPU mesh
+(SURVEY.md §4: multi-node behavior without a cluster).
+
+Correctness bar: every distributed op must match its single-device
+reference implementation to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from polyaxon_tpu.parallel import (
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+    make_param_shardings,
+    make_train_step,
+    moe_layer,
+    pipeline_apply,
+    ring_attention,
+    ulysses_attention,
+)
+from polyaxon_tpu.parallel.mesh import MeshError
+from polyaxon_tpu.parallel.ulysses import _plain_attention
+
+
+def reference_attention(q, k, v, causal=True):
+    return _plain_attention(q, k, v, causal=causal, scale=None)
+
+
+class TestMesh:
+    def test_resolve_fill(self):
+        spec = MeshSpec(dp=-1, tp=2)
+        sizes = spec.resolve(8)
+        assert sizes["dp"] == 4 and sizes["tp"] == 2
+
+    def test_resolve_mismatch(self):
+        with pytest.raises(MeshError):
+            MeshSpec(dp=3, tp=1, fsdp=1, pp=1, sp=1, ep=1).resolve(8)
+
+    def test_build_mesh(self):
+        mesh = local_mesh(dp=4, tp=2)
+        assert mesh.shape["dp"] == 4
+        assert mesh.shape["tp"] == 2
+        assert mesh.devices.size == 8
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        mesh = local_mesh(dp=2, sp=4)
+        rng = np.random.default_rng(0)
+        b, s, h, d = 4, 32, 2, 8
+        q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_jit_and_grad(self):
+        mesh = local_mesh(sp=8)
+        b, s, h, d = 2, 64, 2, 8
+        rng = np.random.default_rng(1)
+        q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+                   for _ in range(3))
+
+        @jax.jit
+        def loss(q, k, v):
+            return ring_attention(q, k, v, mesh, causal=True).sum()
+
+        g = jax.grad(loss)(q, k, v)
+        ref_g = jax.grad(
+            lambda q, k, v: reference_attention(q, k, v).sum())(q, k, v)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_long_sequence_sharded(self):
+        # The point of ring attention: S larger than any single shard.
+        mesh = local_mesh(sp=8)
+        b, s, h, d = 1, 256, 1, 4
+        rng = np.random.default_rng(2)
+        q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+                   for _ in range(3))
+        out = ring_attention(q, k, v, mesh, causal=True)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        mesh = local_mesh(dp=2, sp=4)
+        rng = np.random.default_rng(3)
+        b, s, h, d = 2, 32, 4, 8  # heads divisible by sp=4
+        q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+                   for _ in range(3))
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_head_divisibility_check(self):
+        mesh = local_mesh(sp=8)
+        q = jnp.zeros((1, 8, 4, 4))  # 4 heads, sp=8
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh)
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        n_stages, n_micro = 4, 4
+        mesh = local_mesh(dp=2, pp=4)
+        rng = np.random.default_rng(4)
+        dim = 16
+        w = jnp.asarray(rng.normal(size=(n_stages, dim, dim)) * 0.1,
+                        jnp.float32)
+        b = jnp.asarray(rng.normal(size=(n_stages, dim)) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.normal(size=(8, dim)), jnp.float32)
+
+        def stage_fn(stage_idx, params, x):
+            w, b = params
+            return jnp.tanh(x @ w + b)
+
+        out = pipeline_apply(stage_fn, (w, b), x, mesh, n_micro=n_micro)
+
+        ref = x
+        for i in range(n_stages):
+            ref = jnp.tanh(ref @ w[i] + b[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_batch_divisibility(self):
+        mesh = local_mesh(pp=8)
+        x = jnp.zeros((6, 4))
+        with pytest.raises(ValueError, match="microbatch"):
+            pipeline_apply(lambda i, p, x: x, jnp.zeros((8, 1)), x, mesh,
+                           n_micro=4)
+
+
+class TestMoE:
+    def test_routing_and_shapes(self):
+        mesh = local_mesh(dp=2, ep=4)
+        rng = np.random.default_rng(5)
+        b, s, d, e, f = 2, 16, 8, 8, 16
+        x = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+        router = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(e, d, f)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(e, f, d)) * 0.1, jnp.float32)
+        out, aux = moe_layer(x, router, w1, w2, mesh, capacity_factor=2.0)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+        assert float(aux) > 0  # load-balance loss is positive
+
+    def test_matches_dense_reference_large_capacity(self):
+        # With capacity >= tokens, EP top-1 MoE == dense per-token expert MLP.
+        mesh = build_mesh(MeshSpec(dp=1, ep=4), devices=jax.devices()[:4])
+        rng = np.random.default_rng(6)
+        b, s, d, e, f = 1, 16, 8, 4, 16
+        x = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+        router = jnp.asarray(rng.normal(size=(d, e)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(e, d, f)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(e, f, d)) * 0.1, jnp.float32)
+        out, _ = moe_layer(x, router, w1, w2, mesh, capacity_factor=float(e))
+
+        flat = x.reshape(-1, d)
+        logits = flat @ router
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, idx[:, None], 1)[:, 0]
+        h = jnp.einsum("td,tdf->tf", flat, w1[idx])
+        h = jax.nn.gelu(h)
+        y = jnp.einsum("tf,tfd->td", h, w2[idx]) * gate[:, None]
+        np.testing.assert_allclose(np.asarray(out).reshape(-1, d),
+                                   np.asarray(y), rtol=1e-4, atol=1e-4)
+
+
+class TestTrainStep:
+    def _toy(self):
+        rng = np.random.default_rng(7)
+        params = {
+            "dense1": {"kernel": jnp.asarray(
+                rng.normal(size=(16, 512)) * 0.05, jnp.float32)},
+            "dense2": {"kernel": jnp.asarray(
+                rng.normal(size=(512, 4)) * 0.05, jnp.float32)},
+        }
+        x = jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 4, size=(16,)))
+
+        def loss_fn(params, batch, rng_key):
+            x, y = batch
+            h = jnp.tanh(x @ params["dense1"]["kernel"])
+            logits = h @ params["dense2"]["kernel"]
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, {"accuracy": (logits.argmax(-1) == y).mean()}
+
+        return params, (x, y), loss_fn
+
+    def test_dp_training_reduces_loss(self):
+        mesh = build_mesh(MeshSpec(dp=8))
+        params, batch, loss_fn = self._toy()
+        step = make_train_step(loss_fn, optax.adam(1e-2), mesh=mesh)
+        state = step.init_state(params)
+        rng = jax.random.PRNGKey(0)
+        first = None
+        for i in range(20):
+            state, metrics = step(state, batch, rng)
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < first * 0.7
+        assert int(state["step"]) == 20
+
+    def test_dp_matches_single_device(self):
+        params, batch, loss_fn = self._toy()
+        mesh_dp = build_mesh(MeshSpec(dp=8))
+        mesh_single = build_mesh(MeshSpec(dp=1),
+                                 devices=jax.devices()[:1])
+        s_dp = make_train_step(loss_fn, optax.sgd(0.1), mesh=mesh_dp,
+                               donate=False)
+        s_1 = make_train_step(loss_fn, optax.sgd(0.1), mesh=mesh_single,
+                              donate=False)
+        rng = jax.random.PRNGKey(0)
+        st_dp = s_dp.init_state(params)
+        st_1 = s_1.init_state(params)
+        for _ in range(3):
+            st_dp, m_dp = s_dp(st_dp, batch, rng)
+            st_1, m_1 = s_1(st_1, batch, rng)
+        np.testing.assert_allclose(float(m_dp["loss"]), float(m_1["loss"]),
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(st_dp["params"]),
+                        jax.tree.leaves(st_1["params"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_fsdp_shards_params(self):
+        mesh = build_mesh(MeshSpec(dp=2, fsdp=4))
+        params, batch, loss_fn = self._toy()
+        shardings = make_param_shardings(params, mesh, fsdp_min_size=1024)
+        spec = shardings["dense1"]["kernel"].spec
+        assert "fsdp" in tuple(spec)
+        step = make_train_step(loss_fn, optax.adam(1e-2), mesh=mesh)
+        state = step.init_state(params)
+        state, metrics = step(state, batch, jax.random.PRNGKey(0))
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_grad_accum_matches_full_batch(self):
+        params, batch, loss_fn = self._toy()
+        mesh = build_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+        full = make_train_step(loss_fn, optax.sgd(0.1), mesh=mesh,
+                               donate=False)
+        accum = make_train_step(loss_fn, optax.sgd(0.1), mesh=mesh,
+                                donate=False, grad_accum=4)
+        rng = jax.random.PRNGKey(0)
+        st_f = full.init_state(params)
+        st_a = accum.init_state(params)
+        st_f, m_f = full(st_f, batch, rng)
+        st_a, m_a = accum(st_a, batch, rng)
+        np.testing.assert_allclose(float(m_f["loss"]), float(m_a["loss"]),
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(st_f["params"]),
+                        jax.tree.leaves(st_a["params"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+
+class TestTPRules:
+    def test_attention_and_mlp_rules(self):
+        import jax.tree_util as jtu
+
+        mesh = local_mesh(tp=8)
+        params = {
+            "attn": {"q_proj": {"kernel": jnp.zeros((64, 64))},
+                     "o_proj": {"kernel": jnp.zeros((64, 64))}},
+            "mlp": {"fc1": {"kernel": jnp.zeros((64, 256))},
+                    "fc2": {"kernel": jnp.zeros((256, 64))}},
+            "ln": {"scale": jnp.zeros((64,))},
+        }
+        sh = make_param_shardings(params, mesh)
+        assert sh["attn"]["q_proj"]["kernel"].spec == (None, "tp")
+        assert sh["attn"]["o_proj"]["kernel"].spec == ("tp", None)
+        assert sh["mlp"]["fc1"]["kernel"].spec == (None, "tp")
+        assert sh["mlp"]["fc2"]["kernel"].spec == ("tp", None)
+        assert sh["ln"]["scale"].spec == (None,)
