@@ -1,0 +1,23 @@
+"""Model zoo backing the BASELINE eval configs (SURVEY.md §6).
+
+The reference ships no models — they live in user containers.  The TPU
+build provides them natively so the five BASELINE configs run end-to-end
+on our runtime (SURVEY.md §7 step 10):
+
+- ``mlp``      — MNIST MLP              (config 1: local CPU run)
+- ``convnet``  — CIFAR-10 ConvNet       (config 4: Hyperband sweep)
+- ``resnet50`` — ResNet-50              (config 2: distributed DP)
+- ``bert``     — BERT-base              (config 3: DDP -> ICI allreduce)
+- ``gpt2``     — GPT-2 medium, flagship (config 5: ring-allreduce -> ICI)
+
+All models follow the TPU playbook: bf16 compute / f32 params, static
+shapes, param names matching ``parallel.strategies.TP_RULES`` so tensor
+parallelism works out of the box.
+"""
+
+from .registry import ModelSpec, get_model, list_models  # noqa: F401
+from .mlp import MLP  # noqa: F401
+from .convnet import ConvNet  # noqa: F401
+from .resnet import ResNet, ResNet50  # noqa: F401
+from .bert import BertConfig, BertModel  # noqa: F401
+from .gpt2 import GPT2Config, GPT2Model  # noqa: F401

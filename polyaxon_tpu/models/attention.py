@@ -1,0 +1,7 @@
+"""Attention entrypoint for the model zoo — re-exported from ``ops``.
+
+Kept as a module so models depend on a stable local name while the op
+library evolves (pallas kernel selection lives in ``ops.attention``).
+"""
+
+from ..ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,120 @@
+"""BERT — BASELINE config 3 (PytorchJob DDP -> ICI allreduce).
+
+TPU-first encoder: bf16 matmuls on the MXU, f32 layernorm/softmax
+accumulation, fused QKV projection (one big matmul beats three small
+ones on the systolic array).  Param names (``qkv``, ``o_proj``, ``fc1``,
+``fc2``, ``embed``) line up with ``parallel.strategies.TP_RULES`` so
+``{tp: N}`` shards attention heads and MLP width with no per-model config.
+
+Attention routes through ``ops.attention`` (pallas flash kernel on TPU,
+pure-XLA fallback elsewhere).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .attention import dot_product_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position=128)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = x.shape[:-1] + (cfg.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        out = dot_product_attention(q, k, v, mask=mask, causal=False)
+        out = out.reshape(x.shape)
+        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                        name="o_proj")(out)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        # Post-LN, as in the original encoder.
+        a = BertSelfAttention(cfg, name="attn")(x, mask)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_attn")(x + a)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="fc1")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_mlp")(x + h)
+        return x.astype(cfg.dtype)
+
+
+class BertModel(nn.Module):
+    """Encoder with an MLM head (tied to the token embedding)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, token_type_ids=None,
+                 attention_mask=None, train: bool = False):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         dtype=cfg.dtype, name="embed")
+        x = embed(input_ids)
+        pos = jnp.arange(input_ids.shape[-1])
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size,
+                         dtype=cfg.dtype, name="pos_embed")(pos)
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype,
+                             name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_embed")(x).astype(cfg.dtype)
+
+        mask = None
+        if attention_mask is not None:
+            # [B, S] -> [B, 1, 1, S] additive-style boolean mask.
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, mask)
+
+        # MLM head: transform then decode with the tied embedding.
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="mlm_ln")(h)
+        logits = embed.attend(h.astype(cfg.dtype))
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        return logits.astype(jnp.float32) + bias

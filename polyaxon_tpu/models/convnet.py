@@ -1,0 +1,41 @@
+"""CIFAR-10 ConvNet — BASELINE config 4 (the Hyperband sweep target).
+
+Small enough to train 32 concurrent trials (SURVEY.md §6 configs[3]);
+width/depth/dropout are exposed as constructor args so the tuner's search
+space maps directly onto them.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    """VGG-style stack: [conv-conv-pool] blocks then a dense head."""
+
+    widths: Sequence[int] = (64, 128, 256)
+    dense_width: int = 256
+    num_classes: int = 10
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        for i, width in enumerate(self.widths):
+            for j in range(2):
+                x = nn.Conv(width, (3, 3), padding="SAME",
+                            dtype=self.dtype,
+                            name=f"block{i + 1}_conv{j + 1}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.dense_width, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)

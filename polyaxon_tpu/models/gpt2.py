@@ -1,0 +1,96 @@
+"""GPT-2 — BASELINE config 5 (MPIJob ring-allreduce -> ICI) and the
+flagship model for ``__graft_entry__``.
+
+TPU-first decoder: pre-LN blocks, fused QKV, bf16 MXU matmuls with f32
+softmax/layernorm, causal flash attention via ``ops.attention`` (pallas
+on TPU), weight-tied LM head.  Layers run under a ``nn.scan``-style
+Python loop with identical block shapes so XLA compiles one block and
+reuses the schedule.  Param names match ``parallel.strategies.TP_RULES``
+(``qkv``/``o_proj``/``fc1``/``fc2``/``wte``) — ``{tp: N}`` "just works",
+and the block structure is what ``parallel.pipeline`` expects for ``pp``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .attention import dot_product_attention
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @staticmethod
+    def medium() -> "GPT2Config":
+        return GPT2Config()  # 1024h/24L/16H == gpt2-medium (~355M)
+
+    @staticmethod
+    def small() -> "GPT2Config":
+        return GPT2Config(hidden_size=768, num_layers=12, num_heads=12)
+
+    @staticmethod
+    def tiny() -> "GPT2Config":
+        return GPT2Config(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=4, max_position=128)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln1")(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = h.shape[:-1] + (cfg.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        a = dot_product_attention(q, k, v, causal=True)
+        a = a.reshape(h.shape)
+        x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                         name="o_proj")(a)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln2")(x).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
+        return x + h
+
+
+class GPT2Model(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        cfg = self.cfg
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       name="wte")
+        x = wte(input_ids)
+        pos = jnp.arange(input_ids.shape[-1])
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size,
+                         dtype=cfg.dtype, name="wpe")(pos)
+        for i in range(cfg.num_layers):
+            x = GPT2Block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
+        return wte.attend(x.astype(cfg.dtype)).astype(jnp.float32)

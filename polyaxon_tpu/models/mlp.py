@@ -1,0 +1,31 @@
+"""MNIST MLP — BASELINE config 1 (single-replica local CPU run).
+
+Parity note: the reference runs an *arbitrary user* Keras MNIST script
+inside a container (SURVEY.md §6, configs[0]); we provide the model
+natively so ``ptpu run -f examples/mnist/polyaxonfile.yaml`` is fully
+self-contained.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Plain MLP over flattened images."""
+
+    features: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, width in enumerate(self.features):
+            x = nn.Dense(width, dtype=self.dtype, name=f"fc{i + 1}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)

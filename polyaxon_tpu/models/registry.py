@@ -1,0 +1,202 @@
+"""Model registry: name -> (constructor, loss, synthetic batch).
+
+Glue between the spec layer (``run.container.args`` name a model) and
+the runtime: the local runner, the benchmark harness, and
+``__graft_entry__`` all instantiate models through here.  Synthetic
+batches use deterministic numpy data (benchmarks measure compute, not
+input pipelines; real data loaders plug in via ``runner``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .bert import BertConfig, BertModel
+from .convnet import ConvNet
+from .gpt2 import GPT2Config, GPT2Model
+from .mlp import MLP
+from .resnet import ResNet, ResNet50
+
+
+def softmax_xent(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    make_model: Callable[..., Any]
+    make_batch: Callable[[int], Dict[str, np.ndarray]]
+    loss_fn: Callable[[Any], Callable]  # model -> loss(params, batch, rng)
+    default_batch_size: int = 32
+
+    def init_params(self, batch_size: int = 2, seed: int = 0,
+                    **overrides):
+        model = self.make_model(**overrides)
+        batch = self.make_batch(batch_size)
+        rng = jax.random.PRNGKey(seed)
+        variables = model.init(rng, batch["inputs"])
+        return model, variables
+
+
+def _image_batch(batch_size: int, hw: int, classes: int,
+                 channels: int = 3) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(0)
+    return {
+        "inputs": rng.rand(batch_size, hw, hw, channels).astype("float32"),
+        "labels": rng.randint(0, classes, size=(batch_size,)),
+    }
+
+
+def _token_batch(batch_size: int, seq: int,
+                 vocab: int) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(0)
+    return {"inputs": rng.randint(0, vocab, size=(batch_size, seq))}
+
+
+def _classifier_loss(model):
+    def loss(params, batch, rng):
+        logits = model.apply(params, batch["inputs"], train=True,
+                             rngs={"dropout": rng} if rng is not None
+                             else None,
+                             mutable=["batch_stats"]
+                             if "batch_stats" in params else False)
+        new_state = None
+        if isinstance(logits, tuple):
+            logits, new_state = logits
+        l = softmax_xent(logits, batch["labels"])
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        aux = {"accuracy": acc}
+        if new_state:
+            # TrainStep merges this back into state (BN running stats);
+            # it never reaches the metrics dict.
+            aux["__new_vars__"] = dict(new_state)
+        return l, aux
+    return loss
+
+
+def _lm_loss(model):
+    def loss(params, batch, rng):
+        tokens = batch["inputs"]
+        logits = model.apply(params, tokens, train=True)
+        # Next-token prediction: shift by one.
+        l = softmax_xent(logits[:, :-1], tokens[:, 1:])
+        return l, {"perplexity": jnp.exp(l)}
+    return loss
+
+
+def _mlm_loss(model, mask_rate: float = 0.15, mask_id: int = 0):
+    def loss(params, batch, rng):
+        tokens = batch["inputs"]
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        mask = jax.random.bernoulli(rng, mask_rate, tokens.shape)
+        inputs = jnp.where(mask, mask_id, tokens)
+        logits = model.apply(params, inputs, train=True)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens)
+        denom = jnp.maximum(mask.sum(), 1)
+        l = jnp.where(mask, per_tok, 0.0).sum() / denom
+        return l, {"masked_tokens": mask.sum()}
+    return loss
+
+
+_REGISTRY: Dict[str, ModelSpec] = {}
+
+
+def _register(spec: ModelSpec):
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+_register(ModelSpec(
+    name="mlp",
+    make_model=lambda **kw: MLP(**kw),
+    make_batch=lambda b: _image_batch(b, 28, 10, channels=1),
+    loss_fn=_classifier_loss,
+    default_batch_size=64,
+))
+
+_register(ModelSpec(
+    name="convnet",
+    make_model=lambda **kw: ConvNet(**kw),
+    make_batch=lambda b: _image_batch(b, 32, 10),
+    loss_fn=_classifier_loss,
+    default_batch_size=128,
+))
+
+_register(ModelSpec(
+    name="resnet50",
+    make_model=lambda **kw: ResNet50(**kw),
+    make_batch=lambda b: _image_batch(b, 224, 1000),
+    loss_fn=_classifier_loss,
+    default_batch_size=128,
+))
+
+_register(ModelSpec(
+    name="resnet50-tiny",  # CI-sized stand-in, same code path
+    make_model=lambda **kw: ResNet(
+        stage_sizes=(1, 1, 1, 1), width=8, num_classes=10, **kw),
+    make_batch=lambda b: _image_batch(b, 32, 10),
+    loss_fn=_classifier_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="bert-base",
+    make_model=lambda **kw: BertModel(BertConfig.base(), **kw),
+    make_batch=lambda b: _token_batch(b, 512, BertConfig.base().vocab_size),
+    loss_fn=_mlm_loss,
+    default_batch_size=32,
+))
+
+_register(ModelSpec(
+    name="bert-tiny",
+    make_model=lambda **kw: BertModel(BertConfig.tiny(), **kw),
+    make_batch=lambda b: _token_batch(b, 64, BertConfig.tiny().vocab_size),
+    loss_fn=_mlm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="gpt2-medium",
+    make_model=lambda **kw: GPT2Model(GPT2Config.medium(), **kw),
+    make_batch=lambda b: _token_batch(b, 1024,
+                                      GPT2Config.medium().vocab_size),
+    loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="gpt2-small",
+    make_model=lambda **kw: GPT2Model(GPT2Config.small(), **kw),
+    make_batch=lambda b: _token_batch(b, 1024,
+                                      GPT2Config.small().vocab_size),
+    loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="gpt2-tiny",
+    make_model=lambda **kw: GPT2Model(GPT2Config.tiny(), **kw),
+    make_batch=lambda b: _token_batch(b, 64, GPT2Config.tiny().vocab_size),
+    loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+
+def get_model(name: str) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_models():
+    return sorted(_REGISTRY)

@@ -1,0 +1,92 @@
+"""ResNet-50 — BASELINE config 2 / the north-star scaling model.
+
+TPU-first choices (vs. the torch ResNet the reference's TFJob/PytorchJob
+users bring):
+
+- **bf16 activations, f32 params/BN stats** — convs hit the MXU at full
+  rate; statistics stay stable in f32.
+- **NHWC layout** — XLA:TPU's native conv layout; no transposes.
+- **Static shapes everywhere**; BN in inference mode uses running stats,
+  train mode batch stats (cross-replica sync left to the loss wrapper —
+  on TPU per-replica BN at batch>=64/replica matches sync-BN accuracy
+  and avoids a per-layer allreduce on the step path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False,
+                      dtype=self.dtype, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False,
+                      dtype=self.dtype, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False,
+                      dtype=self.dtype, name="conv3")(y)
+        # Zero-init the last BN scale: residual branch starts as identity,
+        # the standard large-batch ResNet trick.
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 use_bias=False, dtype=self.dtype,
+                                 name="proj_conv")(x)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 family over NHWC inputs."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = functools.partial(nn.Conv, padding="SAME")
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), use_bias=False,
+                 dtype=self.dtype, name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.width * 2 ** i, strides=strides,
+                    conv=conv, norm=norm, dtype=self.dtype,
+                    name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)
+
+
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3))
+ResNet101 = functools.partial(ResNet, stage_sizes=(3, 4, 23, 3))

@@ -1,0 +1,13 @@
+"""TPU op library: pallas kernels for the hot ops, XLA fallbacks elsewhere.
+
+The reference has no kernel layer at all (it is an orchestrator —
+SURVEY.md §0); on TPU the framework owns the hot ops.  Every op here has
+two paths:
+
+- a **pallas** TPU kernel tuned for MXU/VMEM tiling, and
+- a **pure-XLA** fallback (CPU tests, interpreters, odd shapes),
+
+behind one stable function so models never branch on backend.
+"""
+
+from .attention import dot_product_attention  # noqa: F401

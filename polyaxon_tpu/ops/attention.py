@@ -1,0 +1,68 @@
+"""Multi-head attention: flash kernel on TPU, fused-XLA fallback.
+
+Input convention: q/k/v are [batch, seq, heads, head_dim] (BSHD —
+matches flax and keeps seq the second axis so sequence-parallel sharding
+specs stay uniform across the codebase).
+
+The fallback is written so XLA fuses mask+softmax into the score matmul
+epilogue; accumulation is f32 regardless of input dtype.  The pallas path
+(``ops.flash``) never materializes the [S, S] score matrix — it is
+selected automatically on TPU for supported shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+BIG_NEG = -1e30
+
+
+def _xla_attention(q, k, v, mask, causal, scale):
+    orig_dtype = q.dtype
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cmask[None, None], scores, BIG_NEG)
+    if mask is not None:
+        # mask: broadcastable to [B, H, Sq, Sk]; True = attend.
+        scores = jnp.where(mask, scores, BIG_NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(orig_dtype)
+
+
+def _flash_supported(q, k, mask, platform) -> bool:
+    if platform != "tpu" or os.environ.get("POLYAXON_TPU_NO_FLASH"):
+        return False
+    if mask is not None:  # pallas path handles causal only (so far)
+        return False
+    # Tiling: seq multiple of the block; head_dim a multiple of 64 (the
+    # zoo's transformers use 64 — mosaic pads the 128-lane tile, still
+    # far cheaper than materializing the [S, S] scores).
+    return (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+            and q.shape[-1] % 64 == 0)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over [B, S, H, D] tensors; returns [B, Sq, H, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    platform = jax.default_backend()
+    if _flash_supported(q, k, mask, platform):
+        from .flash import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return _xla_attention(q, k, v, mask, causal, scale)

@@ -1,0 +1,169 @@
+"""Pallas flash attention for TPU (forward kernel + recompute backward).
+
+Classic online-softmax blocking: grid = (B, H, q_blocks, kv_blocks) with
+the kv axis innermost; the VMEM scratch accumulator/row-stats persist
+across the innermost grid dimension (TPU grids execute sequentially per
+core), so the [S, S] score matrix never exists — each (128 x D) Q block
+streams K/V blocks through VMEM and the MXU.  Fully-masked causal blocks
+are skipped via ``pl.when`` (upper-triangle blocks cost nothing).
+
+Backward: flash-recompute via ``jax.custom_vjp`` — the VJP re-runs the
+XLA attention under ``jax.vjp``.  XLA rematerializes it inside the
+fused backward, which is the standard memory/FLOPs trade on TPU; a
+dedicated pallas backward kernel is a later optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_KV = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  causal: bool, scale: float, block_q: int,
+                  block_kv: int, q_shift: int):
+    iq = pl.program_id(2)
+    ikv = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(ikv == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: a KV block strictly above the diagonal band contributes
+    # nothing for every row of this Q block — skip the matmuls entirely.
+    # q_shift = Sk - Sq implements bottom-right mask alignment (matches
+    # _xla_attention when Sq != Sk, e.g. decode suffixes).
+    needed = (not causal) or (
+        ikv * block_kv <= iq * block_q + q_shift + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]  # [block_q, D]
+        k = k_ref[0, 0]  # [block_kv, D]
+        v = v_ref[0, 0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bkv]
+        if causal:
+            q_ids = q_shift + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_ids = ikv * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            scores = jnp.where(q_ids >= k_ids, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)                # [bq, bkv]
+        correction = jnp.exp(m_prev - m_new)       # [bq, 1]
+        l_new = l_prev * correction + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, D]
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ikv == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float):
+    """q/k/v: [B, H, S, D] (head-major for contiguous blocks)."""
+    batch, heads, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(BLOCK_Q, sq)
+    block_kv = min(BLOCK_KV, sk)
+    if sq % block_q or sk % block_kv:
+        raise ValueError(
+            f"flash_attention needs seq lengths divisible by the block "
+            f"({block_q}/{block_kv}); got Sq={sq}, Sk={sk}. Use "
+            f"ops.dot_product_attention for ragged shapes.")
+    grid = (batch, heads, sq // block_q, sk // block_kv)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale, block_q=block_q,
+        block_kv=block_kv, q_shift=sk - sq)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        # CPU tests run the kernel in the pallas interpreter (same code
+        # path the TPU compiles) — see tests/test_ops.py.
+        interpret=bool(os.environ.get("POLYAXON_TPU_FLASH_INTERPRET")),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    return _flash_forward(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash_forward(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    from .attention import _xla_attention
+    q, k, v = res
+
+    def ref(q, k, v):
+        # _xla_attention takes BSHD; transpose round-trip keeps the
+        # public BHSD convention of this module.
+        out = _xla_attention(q.transpose(0, 2, 1, 3),
+                             k.transpose(0, 2, 1, 3),
+                             v.transpose(0, 2, 1, 3), None, causal, scale)
+        return out.transpose(0, 2, 1, 3)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: float = 1.0) -> jax.Array:
+    """Flash attention over BSHD tensors (public convention).
+
+    Transposes to head-major BHSD for the kernel so each (q-block,
+    kv-block) tile is contiguous in VMEM, and back on the way out.
+    """
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    out = _flash(q, k, v, causal, scale)
+    return out.transpose(0, 2, 1, 3)

@@ -136,12 +136,21 @@ def local_mesh(**axis_sizes: int):
     return build_mesh(MeshSpec.from_dict(axis_sizes))
 
 
+def active_batch_axes(mesh, batch_axes: Tuple[str, ...] = ("dp", "fsdp")):
+    """The subset of ``batch_axes`` with size > 1 on this mesh (or None).
+
+    Single source of truth for "which axes shard the batch dim" — used by
+    data_sharding, the strategy library, and every shard_map spec in
+    ring/ulysses/pipeline/moe.
+    """
+    return tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+
+
 def data_sharding(mesh, *, batch_axes: Tuple[str, ...] = ("dp", "fsdp")):
     """NamedSharding for a [batch, ...] array sharded over the data axes."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
-    return NamedSharding(mesh, P(present))
+    return NamedSharding(mesh, P(active_batch_axes(mesh, batch_axes)))
 
 
 def replicate_sharding(mesh):

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import active_batch_axes
+
 
 def top1_dispatch(logits: jax.Array, capacity: int):
     """Build dispatch/combine tensors for top-1 (switch) routing.
@@ -65,6 +67,10 @@ def moe_layer(
     x: GLOBAL [B, S, D]; experts sharded over ``ep``:
     router_w [D, E] replicated, expert_w1 [E, D, F], expert_w2 [E, F, D].
     Returns ([B, S, D], aux_loss).
+
+    Tokens are sharded over ``ep`` along the sequence dim (each rank
+    routes 1/ep of the tokens; the capacity limit applies per source
+    rank), so per-rank expert FLOPs are 1/ep of dense — the point of EP.
     """
     from jax import shard_map
 
@@ -72,9 +78,13 @@ def moe_layer(
     e = expert_w1.shape[0]
     ep = mesh.shape.get(axis_name, 1)
     if e % ep:
-        raise ValueError(f"num experts {e} must divide ep axis {ep}")
+        raise ValueError(
+            f"num experts {e} must be divisible by ep axis size {ep}")
+    if s % ep:
+        raise ValueError(
+            f"sequence length {s} must be divisible by ep axis size {ep}")
 
-    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    batch = active_batch_axes(mesh, batch_axes)
 
     def body(xl, rw, w1, w2):
         tl = xl.shape[0] * xl.shape[1]
@@ -104,12 +114,15 @@ def moe_layer(
                                tiled=True)
         h = h.reshape(e, capacity, d)
         out = jnp.einsum("tec,ecd->td", combine, h)
-        aux = jax.lax.pmean(aux, axis_name)
+        # aux differs per token shard: average over every axis the tokens
+        # are sharded on so the returned scalar really is replicated.
+        aux = jax.lax.pmean(aux, (axis_name,) + (batch or ()))
         return out.reshape(xl.shape).astype(x.dtype), aux
 
     return shard_map(
         body, mesh=mesh,
-        in_specs=(P(batch, None, None), P(), P(axis_name), P(axis_name)),
-        out_specs=(P(batch, None, None), P()),
+        in_specs=(P(batch, axis_name, None), P(), P(axis_name),
+                  P(axis_name)),
+        out_specs=(P(batch, axis_name, None), P()),
         check_vma=False,
     )(x, router_w, expert_w1, expert_w2)

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import active_batch_axes
+
 
 def _pipeline_shard(params, x_micro, *, axis_name: str, stage_fn,
                     n_micro: int):
@@ -97,7 +99,7 @@ def pipeline_apply(
         raise ValueError(f"Batch {batch} must divide into {n_micro} microbatches")
     mb = batch // n_micro
 
-    bspec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    bspec = active_batch_axes(mesh, batch_axes)
     param_spec = jax.tree.map(lambda _: P(axis_name), params_stacked)
     x_micro = x.reshape((n_micro, mb) + x.shape[1:])
 

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import active_batch_axes
+
 BIG_NEG = -1e30
 
 
@@ -53,21 +55,17 @@ def _block_attend(q, k, v, *, scale, q_offset, kv_offset, causal):
 
 
 def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool,
-                          scale: Optional[float]):
+                          scale: Optional[float], axis_size: int):
     """Per-shard body: q/k/v are the LOCAL sequence blocks [B, Sblk, H, D]."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n = jax.lax.psum(1, axis_name)
+    n = axis_size
     my_idx = jax.lax.axis_index(axis_name)
     s_blk = q.shape[1]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    o = jnp.zeros(q.shape, jnp.float32)
-    m = jnp.full(q.shape[:2] + q.shape[2:3], BIG_NEG, jnp.float32)  # [B,Sq,H]
-    l = jnp.zeros(q.shape[:2] + q.shape[2:3], jnp.float32)
-
-    def step(carry, r):
-        o, m, l, k_cur, v_cur = carry
+    def attend(acc, k_cur, v_cur, r):
+        o, m, l = acc
         src = (my_idx - r) % n  # which block k_cur/v_cur originated from
         pv, m_blk, l_blk = _block_attend(
             q, k_cur, v_cur, scale=scale,
@@ -82,13 +80,27 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool,
         corr_new = jnp.where(m_blk > BIG_NEG / 2, corr_new, 0.0)
         o = o * corr_old[..., None] + pv * corr_new[..., None]
         l = l * corr_old + l_blk * corr_new
-        # Rotate K/V to the next neighbor (skipped after the last step).
+        return o, new_m, l
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:2] + q.shape[2:3], BIG_NEG, jnp.float32)  # [B,Sq,H]
+    l = jnp.zeros(q.shape[:2] + q.shape[2:3], jnp.float32)
+
+    def step(carry, r):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = attend((o, m, l), k_cur, v_cur, r)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o, new_m, l, k_nxt, v_nxt), None
+        return (o, m, l, k_nxt, v_nxt), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(
-        step, (o, m, l, k, v), jnp.arange(n))
+    # n-1 rotations only: the last block is consumed without a further
+    # ppermute (it would be dead ICI traffic on every forward).
+    k_cur, v_cur = k, v
+    if n > 1:
+        (o, m, l, k_cur, v_cur), _ = jax.lax.scan(
+            step, (o, m, l, k, v), jnp.arange(n - 1))
+    o, m, l = attend((o, m, l), k_cur, v_cur, n - 1)
+
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
     return (o / l[..., None]).astype(q.dtype)
 
@@ -111,10 +123,11 @@ def ring_attention(
     """
     from jax import shard_map
 
-    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    batch = active_batch_axes(mesh, batch_axes)
     spec = P(batch, axis_name, None, None)
     body = functools.partial(_ring_attention_shard, axis_name=axis_name,
-                             causal=causal, scale=scale)
+                             causal=causal, scale=scale,
+                             axis_size=mesh.shape.get(axis_name, 1))
     return shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -24,9 +24,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import MeshSpec, build_mesh
+from .mesh import MeshSpec, build_mesh, data_sharding
 
 
 # Rules: (regex over the param path, PartitionSpec builder).  First match
@@ -115,8 +116,7 @@ def make_param_shardings(
 
 
 def make_batch_sharding(mesh: Mesh) -> NamedSharding:
-    axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
-    return NamedSharding(mesh, P(axes or None))
+    return data_sharding(mesh)
 
 
 class TrainStep:
@@ -171,9 +171,14 @@ class TrainStep:
         def step(state, batch, rng):
             params = state["params"]
             if accum > 1:
-                def micro(carry, mb):
+                def micro(carry, inp):
+                    mb, idx = inp
                     loss_a, grads_a = carry
-                    loss, aux, grads = one_grad(params, mb, rng)
+                    # Each microbatch gets an independent rng (dropout /
+                    # MLM masks must differ across microbatches).
+                    r = None if rng is None else jax.random.fold_in(rng,
+                                                                    idx)
+                    loss, aux, grads = one_grad(params, mb, r)
                     grads_a = jax.tree.map(jnp.add, grads_a, grads)
                     return (loss_a + loss, grads_a), aux
                 micro_batches = jax.tree.map(
@@ -181,18 +186,29 @@ class TrainStep:
                                         + x.shape[1:]), batch)
                 zeros = jax.tree.map(jnp.zeros_like, params)
                 (loss, grads), aux = jax.lax.scan(
-                    micro, (jnp.zeros(()), zeros), micro_batches)
+                    micro, (jnp.zeros(()), zeros),
+                    (micro_batches, jnp.arange(accum)))
                 loss = loss / accum
                 grads = jax.tree.map(lambda g: g / accum, grads)
-                aux = jax.tree.map(lambda a: a[-1], aux)
+                # aux is stacked [accum, ...]: average so metrics describe
+                # the whole batch, not just the last microbatch.
+                aux = jax.tree.map(lambda a: a.mean(0), aux)
             else:
                 loss, aux, grads = one_grad(params, batch, rng)
+            # Mutable model state (e.g. BN running stats) rides aux under
+            # a reserved key and is merged back into params, not metrics.
+            new_vars = None
+            if isinstance(aux, dict) and "__new_vars__" in aux:
+                aux = dict(aux)
+                new_vars = aux.pop("__new_vars__")
             updates, opt_state = optimizer.update(
                 grads, state["opt_state"], params)
             params = jax.tree.map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates)
+            if new_vars is not None:
+                params = {**params, **new_vars}
             metrics = {"loss": loss,
-                       "grad_norm": optax_global_norm(grads), **(aux or {})}
+                       "grad_norm": optax.global_norm(grads), **(aux or {})}
             return (
                 {"params": params, "opt_state": opt_state,
                  "step": state["step"] + 1},
@@ -210,11 +226,6 @@ class TrainStep:
         if self._step is None:
             self._build()
         return self._step(state, batch, rng)
-
-
-def optax_global_norm(tree) -> jax.Array:
-    leaves = jax.tree.leaves(tree)
-    return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
 
 
 def make_train_step(

@@ -19,10 +19,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import active_batch_axes
 
-def _ulysses_shard(q, k, v, *, axis_name: str, attn_fn, n_heads_global: int):
+
+def _ulysses_shard(q, k, v, *, axis_name: str, attn_fn):
     """Per-shard body: inputs [B, S/sp, H, D] -> output [B, S/sp, H, D]."""
-    sp = jax.lax.psum(1, axis_name)
 
     def seq2head(x):
         # [B, S/sp, H, D] -> [B, S, H/sp, D]: split heads, gather sequence.
@@ -78,10 +79,10 @@ def ulysses_attention(
         )
     inner = attn_fn or functools.partial(_plain_attention, causal=causal,
                                          scale=scale)
-    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    batch = active_batch_axes(mesh, batch_axes)
     spec = P(batch, axis_name, None, None)
     body = functools.partial(_ulysses_shard, axis_name=axis_name,
-                             attn_fn=inner, n_heads_global=n_heads)
+                             attn_fn=inner)
     return shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),
