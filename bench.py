@@ -1,0 +1,92 @@
+"""Benchmark entry for the driver: prints ONE JSON line.
+
+Measures the headline BASELINE metric — ResNet-50 training throughput in
+img/sec/chip (BASELINE.json: "ResNet-50 img/sec/chip via `polyaxon run`")
+— on whatever accelerator is attached (one TPU chip under the driver;
+falls back to a CI-sized ResNet on CPU so the harness always completes).
+
+The reference publishes no benchmark numbers (BASELINE.json.published ==
+{}), so ``vs_baseline`` is reported against the framework's own recorded
+best (``.bench_baseline.json``, committed after the first TPU run); 1.0
+until a baseline exists.
+
+Usage: python bench.py [--model resnet50] [--batch N] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+    import optax
+
+    from polyaxon_tpu.models.registry import get_model
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "gpu")
+    model_name = args.model or ("resnet50" if on_accel else "resnet50-tiny")
+    spec = get_model(model_name)
+    batch_size = args.batch or (128 if on_accel else 16)
+
+    mesh = build_mesh(MeshSpec(dp=-1))
+    n_chips = mesh.devices.size
+
+    model, params = spec.init_params(batch_size=2)
+    step = make_train_step(spec.loss_fn(model),
+                           optax.sgd(0.1, momentum=0.9), mesh)
+    state = step.init_state(params)
+    batch = spec.make_batch(batch_size)
+    batch = jax.device_put(batch, step.batch_sharding)
+    rng = jax.random.PRNGKey(0)
+
+    for _ in range(args.warmup):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch_size * args.steps / dt
+    per_chip = img_per_sec / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(__file__) or ".",
+                                 ".bench_baseline.json")
+    vs_baseline = 1.0
+    try:
+        with open(baseline_path) as f:
+            recorded = json.load(f)
+        key = f"{model_name}:{backend}"
+        if recorded.get(key):
+            vs_baseline = per_chip / recorded[key]
+    except (OSError, ValueError):
+        pass
+
+    print(json.dumps({
+        "metric": f"{model_name} img/sec/chip ({backend}, batch {batch_size})",
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,9 +60,14 @@ class ProcessTopology:
         return f"{service_fmt.format(run=run, role=role, index=0)}:{port}"
 
     def process_env(self, role: str, index: int, run: str = "run",
-                    port: int = 8476) -> Dict[str, str]:
+                    port: int = 8476,
+                    service_fmt: str = "{run}-{role}-{index}",
+                    ) -> Dict[str, str]:
         """Env block injected per pod so in-container bootstrap can derive
-        (coordinator, num_processes, process_id) — SURVEY.md 3.2/5.8."""
+        (coordinator, num_processes, process_id) — SURVEY.md 3.2/5.8.
+
+        ``service_fmt`` must yield a resolvable DNS name; in-cluster the
+        converter passes a pod-hostname.headless-subdomain format."""
         offset = 0
         for g in self.groups:
             if g.role == role:
@@ -76,7 +81,8 @@ class ProcessTopology:
         else:
             raise TopologyError(f"Unknown role {role!r}")
         return {
-            "PTPU_COORDINATOR_ADDRESS": self.coordinator_address(run=run, port=port),
+            "PTPU_COORDINATOR_ADDRESS": self.coordinator_address(
+                service_fmt=service_fmt, run=run, port=port),
             "PTPU_NUM_PROCESSES": str(self.num_processes),
             "PTPU_PROCESS_ID": str(offset + index),
             "PTPU_REPLICA_ROLE": role,

@@ -1,0 +1,47 @@
+"""Kubernetes layer: converter + custom resources (SURVEY.md L2).
+
+Turns compiled operations into ``Operation`` CRs with TPU-slice
+scheduling (``google.com/tpu`` resources, GKE topology selectors) and
+env injection for tracking + ``jax.distributed`` bootstrap.  The C++
+operator (``operator/``) reconciles these CRs into pods.
+"""
+
+from .converter import (
+    API_VERSION,
+    COORDINATOR_PORT,
+    MAIN_CONTAINER,
+    OPERATION_KIND,
+    ConverterConfig,
+    ConverterError,
+    convert,
+    headless_service,
+)
+from .tpu import (
+    ACCELERATOR_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    SliceError,
+    accelerator_for,
+    default_topology,
+    slice_node_selector,
+    tpu_resources,
+)
+
+__all__ = [
+    "API_VERSION",
+    "ACCELERATOR_LABEL",
+    "COORDINATOR_PORT",
+    "ConverterConfig",
+    "ConverterError",
+    "MAIN_CONTAINER",
+    "OPERATION_KIND",
+    "SliceError",
+    "TOPOLOGY_LABEL",
+    "TPU_RESOURCE",
+    "accelerator_for",
+    "convert",
+    "default_topology",
+    "headless_service",
+    "slice_node_selector",
+    "tpu_resources",
+]

@@ -1,0 +1,86 @@
+"""TPU slice scheduling vocabulary: resources, selectors, topologies.
+
+The reference's converter emits ``nvidia.com/gpu`` resource requests
+(SURVEY.md 2.10 / north-star); the TPU-native converter instead emits
+``google.com/tpu`` chip requests plus the GKE TPU-slice node selectors
+(``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``) that
+the GKE scheduler uses to place pods onto slice hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..flow.run import V1SliceSpec
+
+TPU_RESOURCE = "google.com/tpu"
+ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+# Public GKE accelerator values per TPU generation (family prefix of the
+# slice ``type``).  3D-torus generations take XxYxZ topologies; the lite
+# (cost-optimized) generations are 2D.
+_ACCELERATORS = {
+    "v6e": ("tpu-v6e-slice", 2),
+    "v5litepod": ("tpu-v5-lite-podslice", 2),
+    "v5e": ("tpu-v5-lite-podslice", 2),
+    "v5p": ("tpu-v5p-slice", 3),
+    "v4": ("tpu-v4-podslice", 3),
+    "v3": ("tpu-v3-slice", 2),
+}
+
+
+class SliceError(ValueError):
+    pass
+
+
+def _family(slice_type: str) -> str:
+    return slice_type.split("-", 1)[0].lower()
+
+
+def accelerator_for(slice_type: str) -> str:
+    fam = _family(slice_type)
+    if fam not in _ACCELERATORS:
+        raise SliceError(
+            f"Unknown TPU slice family {fam!r} (from {slice_type!r}); "
+            f"known: {sorted(_ACCELERATORS)}")
+    return _ACCELERATORS[fam][0]
+
+
+def default_topology(slice_type: str, chips: int) -> str:
+    """Near-square power-of-two factorization of the chip count onto the
+    generation's torus rank (2D for lite parts, 3D for v4/v5p)."""
+    fam = _family(slice_type)
+    rank = _ACCELERATORS.get(fam, ("", 2))[1]
+    if chips <= 0 or chips & (chips - 1):
+        raise SliceError(
+            f"Cannot derive a torus topology for {chips} chips; give "
+            "slice.topology explicitly")
+    dims = [1] * rank
+    remaining = chips
+    i = 0
+    while remaining > 1:
+        dims[i % rank] *= 2
+        remaining //= 2
+        i += 1
+    dims.sort()
+    return "x".join(str(d) for d in dims)
+
+
+def slice_node_selector(spec: V1SliceSpec) -> Dict[str, str]:
+    topology = spec.topology or default_topology(spec.type,
+                                                 spec.chips_per_slice)
+    return {
+        ACCELERATOR_LABEL: accelerator_for(spec.type),
+        TOPOLOGY_LABEL: topology,
+    }
+
+
+def tpu_resources(spec: V1SliceSpec) -> Dict[str, int]:
+    """Per-pod chip request: each pod is one slice host."""
+    return {TPU_RESOURCE: spec.chips_per_host}
+
+
+def tpu_toleration() -> Dict[str, str]:
+    return {"key": TPU_RESOURCE, "operator": "Exists",
+            "effect": "NoSchedule"}

@@ -1,0 +1,137 @@
+"""Initializer + sidecar auxiliary tests (in-process; the same code paths
+the aux containers run in-cluster)."""
+
+import os
+import subprocess
+
+import pytest
+
+from polyaxon_tpu.initializer import (
+    InitError,
+    init_artifacts,
+    init_dockerfile,
+    init_file,
+    init_git,
+)
+from polyaxon_tpu.initializer import main as init_main
+from polyaxon_tpu.sidecar import Sidecar, _sync_tree
+
+
+class TestInitializer:
+    def test_file(self, tmp_path):
+        path = init_file(str(tmp_path / "ctx"), "run.sh", "echo hi",
+                         chmod="0755")
+        assert open(path).read() == "echo hi"
+        assert os.stat(path).st_mode & 0o777 == 0o755
+
+    def test_file_via_cli(self, tmp_path):
+        init_main(["file", "--dest", str(tmp_path), "--filename", "a.txt",
+                   "--content", "x"])
+        assert (tmp_path / "a.txt").read_text() == "x"
+
+    def test_artifacts_copies_from_store(self, tmp_path):
+        store = tmp_path / "store"
+        (store / "run1" / "outputs").mkdir(parents=True)
+        (store / "run1" / "outputs" / "model.bin").write_bytes(b"W")
+        dest = tmp_path / "ctx"
+        copied = init_artifacts(str(dest), files=["run1/outputs/model.bin"],
+                                dirs=["run1/outputs"],
+                                store_root=str(store))
+        assert (dest / "model.bin").read_bytes() == b"W"
+        assert (dest / "outputs" / "model.bin").exists()
+        assert len(copied) == 2
+
+    def test_dockerfile_render(self, tmp_path):
+        path = init_dockerfile(str(tmp_path), {
+            "image": "jax:latest",
+            "env": {"A": "1"},
+            "workdir": "/app",
+            "run": ["pip install -e ."],
+        })
+        text = open(path).read()
+        assert text.splitlines()[0] == "FROM jax:latest"
+        assert "ENV A=1" in text
+        assert "WORKDIR /app" in text
+        assert "RUN pip install -e ." in text
+
+    def test_git_requires_url(self, tmp_path):
+        with pytest.raises(InitError):
+            init_git("", str(tmp_path))
+
+    def test_connection_root_resolution(self, tmp_path, monkeypatch):
+        data = tmp_path / "datasets"
+        data.mkdir()
+        (data / "train.csv").write_text("a,b\n")
+        monkeypatch.setenv("POLYAXON_TPU_CONNECTION_MY_DATA_ROOT",
+                           str(data))
+        dest = tmp_path / "ctx"
+        # bare connection copies the whole root
+        init_artifacts(str(dest), [], [], connection="my-data")
+        assert (dest / "train.csv").exists()
+
+    def test_unmaterialized_connection_raises(self, tmp_path):
+        with pytest.raises(InitError):
+            init_artifacts(str(tmp_path / "ctx"), [], [],
+                           connection="missing")
+
+    def test_tensorboard_keeps_runs_separate(self, tmp_path, monkeypatch):
+        store = tmp_path / "store"
+        for uuid in ("runa", "runb"):
+            d = store / uuid / "events"
+            d.mkdir(parents=True)
+            (d / "metrics.jsonl").write_text(uuid)
+        monkeypatch.setenv("POLYAXON_TPU_ARTIFACTS_PATH", str(store))
+        dest = tmp_path / "tb"
+        init_main(["tensorboard", "--dest", str(dest), "--spec",
+                   '{"uuids": ["runa", "runb"]}'])
+        assert (dest / "runa" / "events" / "metrics.jsonl").read_text() \
+            == "runa"
+        assert (dest / "runb" / "events" / "metrics.jsonl").read_text() \
+            == "runb"
+
+    def test_git_clones_local_repo(self, tmp_path):
+        src = tmp_path / "srcrepo"
+        src.mkdir()
+        subprocess.run(["git", "init", "-q", str(src)], check=True)
+        (src / "f.txt").write_text("hello")
+        subprocess.run(["git", "-C", str(src), "add", "."], check=True)
+        subprocess.run(
+            ["git", "-C", str(src), "-c", "user.email=t@t", "-c",
+             "user.name=t", "commit", "-qm", "init"], check=True)
+        repo_dir = init_git(str(src), str(tmp_path / "ctx"))
+        assert os.path.exists(os.path.join(repo_dir, "f.txt"))
+
+
+class TestSidecar:
+    def test_sync_tree_copies_new_and_changed(self, tmp_path):
+        src, dst = tmp_path / "src", tmp_path / "dst"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("1")
+        (src / "sub" / "b.txt").write_text("2")
+        assert _sync_tree(str(src), str(dst)) == 2
+        assert (dst / "sub" / "b.txt").read_text() == "2"
+        # unchanged -> no copies; changed -> recopied
+        assert _sync_tree(str(src), str(dst)) == 0
+        (src / "a.txt").write_text("changed")
+        assert _sync_tree(str(src), str(dst)) == 1
+        assert (dst / "a.txt").read_text() == "changed"
+
+    def test_sidecar_syncs_run_dirs(self, tmp_path):
+        local = tmp_path / "local"
+        store = tmp_path / "store"
+        (local / "outputs").mkdir(parents=True)
+        (local / "logs").mkdir()
+        (local / "outputs" / "ckpt").write_text("state")
+        (local / "logs" / "stdout.log").write_text("line\n")
+        sc = Sidecar("run9", str(local), str(store), sync_interval=1)
+        sc.sync_once()
+        assert (store / "run9" / "outputs" / "ckpt").read_text() == "state"
+        assert (store / "run9" / "logs" / "stdout.log").exists()
+
+    def test_sidecar_respects_collect_flags(self, tmp_path):
+        local = tmp_path / "local"
+        store = tmp_path / "store"
+        (local / "logs").mkdir(parents=True)
+        (local / "logs" / "x.log").write_text("x")
+        Sidecar("r", str(local), str(store), collect_logs=False).sync_once()
+        assert not (store / "r" / "logs").exists()

@@ -1,0 +1,279 @@
+"""k8s converter golden-manifest tests (SURVEY.md §4: distributed behavior
+asserted via emitted manifests — topology env, replica counts, TPU
+resources — no cluster needed)."""
+
+import pytest
+import yaml
+
+from polyaxon_tpu.compiler import resolve
+from polyaxon_tpu.flow import V1Operation
+from polyaxon_tpu.k8s import (
+    ACCELERATOR_LABEL,
+    COORDINATOR_PORT,
+    MAIN_CONTAINER,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    ConverterConfig,
+    ConverterError,
+    SliceError,
+    accelerator_for,
+    convert,
+    default_topology,
+    headless_service,
+)
+from polyaxon_tpu.polyaxonfile import get_op_from_files
+
+
+def compile_yaml(tmp_path, text, run_uuid="abc123", project="proj"):
+    f = tmp_path / "spec.yaml"
+    f.write_text(text)
+    op = get_op_from_files(str(f))
+    return resolve(op, run_uuid=run_uuid, project=project)
+
+
+JOB_YAML = """
+kind: component
+name: trainer
+run:
+  kind: job
+  environment:
+    nodeSelector: {pool: batch}
+    tolerations:
+      - {key: dedicated, operator: Equal, value: train, effect: NoSchedule}
+    labels: {team: ml}
+  init:
+    - git: {url: "https://example.com/org/code.git", revision: main}
+    - file: {filename: run.sh, content: "echo hi", chmod: "0755"}
+  container:
+    image: jax:latest
+    command: [python, train.py]
+    resources:
+      requests: {cpu: "4", memory: 8Gi}
+"""
+
+TPUJOB_YAML = """
+kind: component
+name: dist-trainer
+run:
+  kind: tpujob
+  slice: {type: v5litepod-16, numSlices: 1, chipsPerHost: 4}
+  worker:
+    replicas: 4
+    container:
+      image: jax:latest
+      command: [python, train.py]
+  strategy: {dp: -1, tp: 4}
+"""
+
+TFJOB_YAML = """
+kind: component
+name: tf-trainer
+run:
+  kind: tfjob
+  slice: {type: v5litepod-8}
+  chief:
+    replicas: 1
+    container: {image: jax:latest}
+  worker:
+    replicas: 1
+    container: {image: jax:latest}
+"""
+
+SERVICE_YAML = """
+kind: component
+name: board
+run:
+  kind: service
+  ports: [6006]
+  container:
+    image: tb:latest
+    command: [tensorboard, --logdir=/ptpu-artifacts]
+"""
+
+
+class TestTPUVocabulary:
+    def test_accelerators(self):
+        assert accelerator_for("v5litepod-16") == "tpu-v5-lite-podslice"
+        assert accelerator_for("v4-32") == "tpu-v4-podslice"
+        assert accelerator_for("v6e-8") == "tpu-v6e-slice"
+        with pytest.raises(SliceError):
+            accelerator_for("h100-8")
+
+    def test_default_topology_2d(self):
+        assert default_topology("v5litepod-16", 16) == "4x4"
+        assert default_topology("v5litepod-8", 8) == "2x4"
+        assert default_topology("v6e-4", 4) == "2x2"
+
+    def test_default_topology_3d(self):
+        assert default_topology("v4-8", 8) == "2x2x2"
+        assert default_topology("v5p-16", 16) == "2x2x4"
+
+    def test_non_pow2_requires_explicit(self):
+        with pytest.raises(SliceError):
+            default_topology("v5litepod-12", 12)
+
+
+class TestJobConversion:
+    def test_job_manifest(self, tmp_path):
+        compiled = compile_yaml(tmp_path, JOB_YAML)
+        cr = convert(compiled, "abc123", "proj",
+                     ConverterConfig(host="http://cp:8000"))
+        assert cr["apiVersion"] == "core.polyaxon-tpu.io/v1"
+        assert cr["kind"] == "Operation"
+        assert cr["metadata"]["name"] == "ptpu-abc123"
+        assert cr["metadata"]["labels"]["polyaxon-tpu/run-uuid"] == "abc123"
+        assert cr["metadata"]["labels"]["team"] == "ml"
+        spec = cr["spec"]
+        assert spec["runKind"] == "job"
+        pod = spec["template"]["spec"]
+        # environment passthrough
+        assert pod["nodeSelector"] == {"pool": "batch"}
+        assert pod["tolerations"][0]["key"] == "dedicated"
+        # init containers: git + file
+        inits = pod["initContainers"]
+        assert len(inits) == 2
+        assert inits[0]["args"][0] == "git"
+        assert "--url" in inits[0]["args"]
+        assert inits[1]["args"][0] == "file"
+        # main container keeps user resources, gains identity env
+        main = next(c for c in pod["containers"]
+                    if c["name"] == MAIN_CONTAINER)
+        assert main["resources"]["requests"]["cpu"] == "4"
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["POLYAXON_TPU_RUN_UUID"] == "abc123"
+        assert env["POLYAXON_TPU_PROJECT"] == "proj"
+        assert env["POLYAXON_TPU_HOST"] == "http://cp:8000"
+        # no TPU resources on a plain job
+        assert TPU_RESOURCE not in (main["resources"].get("limits") or {})
+        # sidecar attached
+        assert any(c["name"] == "ptpu-sidecar" for c in pod["containers"])
+
+    def test_user_env_wins_over_injected(self, tmp_path):
+        yaml_text = JOB_YAML.replace(
+            "    command: [python, train.py]",
+            "    command: [python, train.py]\n"
+            "    env:\n"
+            "      - {name: POLYAXON_TPU_PROJECT, value: custom}",
+        )
+        compiled = compile_yaml(tmp_path, yaml_text)
+        cr = convert(compiled, "abc123", "proj")
+        main = next(c for c in cr["spec"]["template"]["spec"]["containers"]
+                    if c["name"] == MAIN_CONTAINER)
+        values = [e.get("value") for e in main["env"]
+                  if e["name"] == "POLYAXON_TPU_PROJECT"]
+        assert values == ["custom"]
+
+
+class TestDistributedConversion:
+    def test_tpujob_manifest(self, tmp_path):
+        compiled = compile_yaml(tmp_path, TPUJOB_YAML, run_uuid="run42")
+        cr = convert(compiled, "run42", "proj")
+        spec = cr["spec"]
+        assert spec["slice"] == {"type": "v5litepod-16", "topology": "4x4",
+                                 "numSlices": 1, "chipsPerHost": 4}
+        assert spec["coordinator"]["port"] == COORDINATOR_PORT
+        assert spec["coordinator"]["service"].startswith("run42-worker-0")
+        workers = spec["replicaSpecs"]["worker"]
+        assert workers["replicas"] == 4
+        pod = workers["template"]["spec"]
+        main = next(c for c in pod["containers"]
+                    if c["name"] == MAIN_CONTAINER)
+        # the north-star asks: google.com/tpu, never nvidia.com/gpu
+        assert main["resources"]["limits"][TPU_RESOURCE] == 4
+        assert main["resources"]["requests"][TPU_RESOURCE] == 4
+        assert "nvidia.com/gpu" not in str(cr)
+        assert pod["nodeSelector"][ACCELERATOR_LABEL] == \
+            "tpu-v5-lite-podslice"
+        assert pod["nodeSelector"][TOPOLOGY_LABEL] == "4x4"
+        assert pod["tolerations"][0]["key"] == TPU_RESOURCE
+        # topology env drives jax.distributed.initialize
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        # address = pod-hostname.headless-subdomain -> resolvable DNS
+        assert env["PTPU_COORDINATOR_ADDRESS"] == \
+            f"run42-worker-0.ptpu-run42-hs:{COORDINATOR_PORT}"
+        assert pod["subdomain"] == "ptpu-run42-hs"
+        assert env["PTPU_NUM_PROCESSES"] == "4"
+        assert env["PTPU_REPLICA_ROLE"] == "worker"
+        assert "PTPU_PROCESS_ID" not in env  # operator stamps per-pod
+        assert spec["strategy"] == {"dp": -1, "tp": 4}
+        # sidecar shares the run-home volume with the main container
+        sidecar = next(c for c in pod["containers"]
+                       if c["name"] == "ptpu-sidecar")
+        assert {"name": "ptpu-home", "mountPath": "/ptpu-home"} in \
+            sidecar["volumeMounts"]
+        assert {"name": "ptpu-home", "mountPath": "/ptpu-home"} in \
+            main["volumeMounts"]
+        assert env["POLYAXON_TPU_HOME"] == "/ptpu-home"
+        assert "--local-root" in sidecar["args"]
+
+    def test_tfjob_compat_roles(self, tmp_path):
+        compiled = compile_yaml(tmp_path, TFJOB_YAML, run_uuid="tf1")
+        cr = convert(compiled, "tf1", "proj")
+        specs = cr["spec"]["replicaSpecs"]
+        assert set(specs) == {"chief", "worker"}
+        chief_env = {e["name"]: e.get("value")
+                     for e in specs["chief"]["template"]["spec"]
+                     ["containers"][0]["env"]}
+        # chief is process group 0 -> coordinator lives there
+        assert chief_env["PTPU_COORDINATOR_ADDRESS"] == \
+            f"tf1-chief-0.ptpu-tf1-hs:{COORDINATOR_PORT}"
+        assert chief_env["PTPU_NUM_PROCESSES"] == "2"
+
+    def test_headless_service(self, tmp_path):
+        compiled = compile_yaml(tmp_path, TPUJOB_YAML, run_uuid="run42")
+        cr = convert(compiled, "run42", "proj")
+        svc = headless_service(cr)
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["selector"] == {"polyaxon-tpu/run-uuid": "run42"}
+        assert svc["metadata"]["name"] == "ptpu-run42-hs"
+
+    def test_job_has_no_headless_service(self, tmp_path):
+        compiled = compile_yaml(tmp_path, JOB_YAML)
+        assert headless_service(convert(compiled, "abc123")) is None
+
+
+class TestServiceConversion:
+    def test_service_ports_and_replicas(self, tmp_path):
+        compiled = compile_yaml(tmp_path, SERVICE_YAML)
+        cr = convert(compiled, "svc1", "proj")
+        assert cr["spec"]["runKind"] == "service"
+        assert cr["spec"]["ports"] == [6006]
+        assert cr["spec"]["replicas"] == 1
+
+
+class TestTermination:
+    def test_termination_mapping(self, tmp_path):
+        yaml_text = JOB_YAML.replace(
+            "run:\n",
+            "termination: {maxRetries: 3, ttl: 600, timeout: 3600}\nrun:\n",
+        )
+        compiled = compile_yaml(tmp_path, yaml_text)
+        cr = convert(compiled, "abc123")
+        assert cr["spec"]["backoffLimit"] == 3
+        assert cr["spec"]["ttlSecondsAfterFinished"] == 600
+        assert cr["spec"]["activeDeadlineSeconds"] == 3600
+
+
+class TestPlugins:
+    def test_disable_sidecar(self, tmp_path):
+        yaml_text = JOB_YAML.replace(
+            "run:\n",
+            "plugins: {collectLogs: false, collectArtifacts: false}\nrun:\n",
+        )
+        compiled = compile_yaml(tmp_path, yaml_text)
+        cr = convert(compiled, "abc123")
+        pod = cr["spec"]["template"]["spec"]
+        assert not any(c["name"] == "ptpu-sidecar"
+                       for c in pod["containers"])
+
+    def test_shm_volume(self, tmp_path):
+        yaml_text = JOB_YAML.replace(
+            "run:\n", "plugins: {shm: true}\nrun:\n")
+        compiled = compile_yaml(tmp_path, yaml_text)
+        cr = convert(compiled, "abc123")
+        pod = cr["spec"]["template"]["spec"]
+        assert any(v["name"] == "ptpu-shm" for v in pod["volumes"])
+        main = next(c for c in pod["containers"]
+                    if c["name"] == MAIN_CONTAINER)
+        assert any(m["mountPath"] == "/dev/shm"
+                   for m in main["volumeMounts"])
