@@ -28,9 +28,15 @@ def main() -> int:
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--cpu", action="store_true",
+                        help="Force the CPU backend (the TPU-tunnel "
+                             "plugin ignores JAX_PLATFORMS)")
     args = parser.parse_args()
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
     import optax
 
@@ -56,13 +62,20 @@ def main() -> int:
 
     for _ in range(args.warmup):
         state, metrics = step(state, batch, rng)
-    jax.block_until_ready(state)
+    # Synchronize via a host transfer: the final loss depends on every
+    # prior step through `state`, and device_get cannot return early even
+    # on platforms where block_until_ready is unreliable (axon tunnel).
+    float(jax.device_get(state["step"]))
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = step(state, batch, rng)
-    jax.block_until_ready(state)
+    final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    if not (final_loss == final_loss):  # NaN guard
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0}))
+        return 1
 
     img_per_sec = batch_size * args.steps / dt
     per_chip = img_per_sec / n_chips

@@ -1,0 +1,308 @@
+// Minimal JSON value: parse + serialize, order-preserving objects.
+//
+// The operator's wire format is the Operation CR JSON the agent writes
+// (polyaxon_tpu/runner/agent.py ManifestBackend).  Order preservation
+// matters: replicaSpecs insertion order defines process-id offsets, the
+// same contract as compiler.topology.ProcessTopology.
+//
+// No external deps (header-only, C++17).
+
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ptpu {
+
+class Json;
+using JsonMember = std::pair<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  explicit Json(bool b) : type_(Type::Bool), bool_(b) {}
+  explicit Json(double n) : type_(Type::Number), num_(n) {}
+  explicit Json(int n) : type_(Type::Number), num_(n) {}
+  explicit Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  explicit Json(const char* s) : type_(Type::String), str_(s) {}
+
+  static Json array() { Json j; j.type_ = Type::Array; return j; }
+  static Json object() { Json j; j.type_ = Type::Object; return j; }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  long as_int(long dflt = 0) const {
+    return type_ == Type::Number ? static_cast<long>(num_) : dflt;
+  }
+  const std::string& as_string() const { return str_; }
+
+  const std::vector<Json>& items() const { return arr_; }
+  std::vector<Json>& items() { return arr_; }
+  const std::vector<JsonMember>& members() const { return obj_; }
+
+  // Object access; returns null singleton for missing keys.
+  const Json& operator[](const std::string& key) const {
+    static const Json null_json;
+    for (const auto& kv : obj_)
+      if (kv.first == key) return kv.second;
+    return null_json;
+  }
+  bool contains(const std::string& key) const {
+    for (const auto& kv : obj_)
+      if (kv.first == key) return true;
+    return false;
+  }
+  void set(const std::string& key, Json value) {
+    for (auto& kv : obj_)
+      if (kv.first == key) { kv.second = std::move(value); return; }
+    obj_.emplace_back(key, std::move(value));
+  }
+  void push_back(Json value) { arr_.push_back(std::move(value)); }
+
+  // ---- parsing ----------------------------------------------------------
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json out = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size())
+      throw std::runtime_error("trailing characters at " +
+                               std::to_string(pos));
+    return out;
+  }
+
+  // ---- serialization ----------------------------------------------------
+
+  std::string dump(int indent = 0, int depth = 0) const {
+    std::ostringstream os;
+    write(os, indent, depth);
+    return os.str();
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::vector<Json> arr_;
+  std::vector<JsonMember> obj_;
+
+  static void skip_ws(const std::string& s, size_t& pos) {
+    while (pos < s.size() &&
+           (s[pos] == ' ' || s[pos] == '\t' || s[pos] == '\n' ||
+            s[pos] == '\r'))
+      ++pos;
+  }
+
+  static void expect(const std::string& s, size_t& pos, char c) {
+    if (pos >= s.size() || s[pos] != c)
+      throw std::runtime_error(std::string("expected '") + c + "' at " +
+                               std::to_string(pos));
+    ++pos;
+  }
+
+  static Json parse_value(const std::string& s, size_t& pos) {
+    skip_ws(s, pos);
+    if (pos >= s.size()) throw std::runtime_error("unexpected end");
+    char c = s[pos];
+    if (c == '{') return parse_object(s, pos);
+    if (c == '[') return parse_array(s, pos);
+    if (c == '"') return Json(parse_string(s, pos));
+    if (c == 't' || c == 'f') return parse_bool(s, pos);
+    if (c == 'n') { parse_literal(s, pos, "null"); return Json(); }
+    return parse_number(s, pos);
+  }
+
+  static void parse_literal(const std::string& s, size_t& pos,
+                            const char* lit) {
+    for (const char* p = lit; *p; ++p, ++pos)
+      if (pos >= s.size() || s[pos] != *p)
+        throw std::runtime_error("bad literal at " + std::to_string(pos));
+  }
+
+  static Json parse_bool(const std::string& s, size_t& pos) {
+    if (s[pos] == 't') { parse_literal(s, pos, "true"); return Json(true); }
+    parse_literal(s, pos, "false");
+    return Json(false);
+  }
+
+  static Json parse_number(const std::string& s, size_t& pos) {
+    size_t start = pos;
+    if (pos < s.size() && (s[pos] == '-' || s[pos] == '+')) ++pos;
+    while (pos < s.size() &&
+           (std::isdigit(static_cast<unsigned char>(s[pos])) ||
+            s[pos] == '.' || s[pos] == 'e' || s[pos] == 'E' ||
+            s[pos] == '-' || s[pos] == '+'))
+      ++pos;
+    if (pos == start) throw std::runtime_error("bad number");
+    return Json(std::stod(s.substr(start, pos - start)));
+  }
+
+  static std::string parse_string(const std::string& s, size_t& pos) {
+    expect(s, pos, '"');
+    std::string out;
+    while (pos < s.size() && s[pos] != '"') {
+      char c = s[pos++];
+      if (c == '\\') {
+        if (pos >= s.size()) throw std::runtime_error("bad escape");
+        char e = s[pos++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos + 4 > s.size()) throw std::runtime_error("bad \\u");
+            unsigned cp = std::stoul(s.substr(pos, 4), nullptr, 16);
+            pos += 4;
+            // UTF-8 encode (BMP only; surrogate pairs folded naively).
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            throw std::runtime_error("bad escape char");
+        }
+      } else {
+        out += c;
+      }
+    }
+    expect(s, pos, '"');
+    return out;
+  }
+
+  static Json parse_array(const std::string& s, size_t& pos) {
+    expect(s, pos, '[');
+    Json out = array();
+    skip_ws(s, pos);
+    if (pos < s.size() && s[pos] == ']') { ++pos; return out; }
+    while (true) {
+      out.arr_.push_back(parse_value(s, pos));
+      skip_ws(s, pos);
+      if (pos < s.size() && s[pos] == ',') { ++pos; continue; }
+      expect(s, pos, ']');
+      return out;
+    }
+  }
+
+  static Json parse_object(const std::string& s, size_t& pos) {
+    expect(s, pos, '{');
+    Json out = object();
+    skip_ws(s, pos);
+    if (pos < s.size() && s[pos] == '}') { ++pos; return out; }
+    while (true) {
+      skip_ws(s, pos);
+      std::string key = parse_string(s, pos);
+      skip_ws(s, pos);
+      expect(s, pos, ':');
+      out.obj_.emplace_back(key, parse_value(s, pos));
+      skip_ws(s, pos);
+      if (pos < s.size() && s[pos] == ',') { ++pos; continue; }
+      expect(s, pos, '}');
+      return out;
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof buf, "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  void write(std::ostringstream& os, int indent, int depth) const {
+    const std::string pad(indent * (depth + 1), ' ');
+    const std::string end_pad(indent * depth, ' ');
+    const char* nl = indent ? "\n" : "";
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 1e15) {
+          os << static_cast<long long>(num_);
+        } else {
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[' << nl;
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          os << pad;
+          arr_[i].write(os, indent, depth + 1);
+          if (i + 1 < arr_.size()) os << ',';
+          os << nl;
+        }
+        os << end_pad << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{' << nl;
+        for (size_t i = 0; i < obj_.size(); ++i) {
+          os << pad;
+          write_string(os, obj_[i].first);
+          os << (indent ? ": " : ":");
+          obj_[i].second.write(os, indent, depth + 1);
+          if (i + 1 < obj_.size()) os << ',';
+          os << nl;
+        }
+        os << end_pad << '}';
+        break;
+      }
+    }
+  }
+};
+
+}  // namespace ptpu

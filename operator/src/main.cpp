@@ -1,0 +1,62 @@
+// ptpu-operator: native controller reconciling Operation CRs.
+//
+// Usage: ptpu-operator --cluster-dir DIR [--poll-ms 100] [--once]
+//
+// Watches DIR/operations/*.json, runs pods via the local process
+// runtime, writes DIR/status/<name>.json.  SIGTERM/SIGINT drain
+// gracefully (pods killed, statuses flushed).
+
+#include <csignal>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <thread>
+
+#include "podruntime.hpp"
+#include "reconciler.hpp"
+
+static volatile sig_atomic_t g_stop = 0;
+
+static void on_signal(int) { g_stop = 1; }
+
+int main(int argc, char** argv) {
+  std::string cluster_dir;
+  int poll_ms = 100;
+  bool once = false;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "--cluster-dir" && i + 1 < argc) {
+      cluster_dir = argv[++i];
+    } else if (arg == "--poll-ms" && i + 1 < argc) {
+      poll_ms = std::atoi(argv[++i]);
+    } else if (arg == "--once") {
+      once = true;
+    } else if (arg == "--help") {
+      std::cout << "ptpu-operator --cluster-dir DIR [--poll-ms N] [--once]\n";
+      return 0;
+    } else {
+      std::cerr << "unknown arg: " << arg << "\n";
+      return 2;
+    }
+  }
+  if (cluster_dir.empty()) {
+    std::cerr << "--cluster-dir is required\n";
+    return 2;
+  }
+
+  std::signal(SIGTERM, on_signal);
+  std::signal(SIGINT, on_signal);
+
+  ptpu::LocalProcessRuntime runtime;
+  ptpu::Reconciler reconciler(cluster_dir, &runtime);
+
+  do {
+    reconciler.tick();
+    if (once) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
+  } while (!g_stop);
+
+  return 0;
+}

@@ -1,0 +1,188 @@
+// Pod runtimes: how reconciled pods actually execute.
+//
+// The reference's operator creates k8s pods and watches their conditions
+// (SURVEY.md 2.14).  Here the runtime is pluggable:
+//
+//  - LocalProcessRuntime: each pod is a local process tree (init
+//    containers sequentially, then the main container), stdout/stderr to
+//    a per-pod log file.  This is the cluster-less harness the Python
+//    agent's ManifestBackend talks to in tests AND the single-box
+//    deployment path.
+//  - An api-server transport would implement the same interface with
+//    POST /pods + watch; out of scope for the local build (no cluster in
+//    the environment), the reconciler core does not change.
+
+#pragma once
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ptpu {
+
+enum class PodPhase { Pending, Running, Succeeded, Failed };
+
+inline const char* phase_name(PodPhase p) {
+  switch (p) {
+    case PodPhase::Pending: return "Pending";
+    case PodPhase::Running: return "Running";
+    case PodPhase::Succeeded: return "Succeeded";
+    case PodPhase::Failed: return "Failed";
+  }
+  return "Unknown";
+}
+
+struct ContainerSpec {
+  std::vector<std::string> argv;
+  std::vector<std::pair<std::string, std::string>> env;
+  std::string workdir;
+};
+
+struct PodSpec {
+  std::string name;
+  std::vector<ContainerSpec> init_containers;
+  ContainerSpec main;
+  std::string log_path;
+};
+
+class PodRuntime {
+ public:
+  virtual ~PodRuntime() = default;
+  virtual int launch(const PodSpec& spec) = 0;
+  virtual PodPhase poll(int pod_id) = 0;
+  virtual int exit_code(int pod_id) = 0;
+  virtual void kill_pod(int pod_id) = 0;
+  virtual void remove(int pod_id) = 0;
+};
+
+class LocalProcessRuntime : public PodRuntime {
+ public:
+  int launch(const PodSpec& spec) override {
+    int id = next_id_++;
+    Pod pod;
+    pod.spec = spec;
+    pod.stage = 0;
+    pod.phase = PodPhase::Pending;
+    pods_[id] = std::move(pod);
+    advance(pods_[id]);
+    return id;
+  }
+
+  PodPhase poll(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    if (it == pods_.end()) return PodPhase::Failed;
+    Pod& pod = it->second;
+    if (pod.phase == PodPhase::Succeeded || pod.phase == PodPhase::Failed)
+      return pod.phase;
+    if (pod.pid > 0) {
+      int status = 0;
+      pid_t r = waitpid(pod.pid, &status, WNOHANG);
+      if (r == pod.pid) {
+        int code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                     : 128 + WTERMSIG(status);
+        pod.pid = -1;
+        if (code != 0) {
+          pod.exit_code = code;
+          pod.phase = PodPhase::Failed;
+        } else if (pod.stage <
+                   static_cast<int>(pod.spec.init_containers.size())) {
+          pod.stage++;
+          advance(pod);  // next init container or main
+        } else {
+          pod.exit_code = 0;
+          pod.phase = PodPhase::Succeeded;
+        }
+      }
+    }
+    return pod.phase;
+  }
+
+  int exit_code(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    return it == pods_.end() ? -1 : it->second.exit_code;
+  }
+
+  void kill_pod(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    if (it == pods_.end()) return;
+    Pod& pod = it->second;
+    if (pod.pid > 0) {
+      // Each pod is its own process group (setpgid in spawn): signal the
+      // whole group so shell-wrapped trainers can't survive as orphans.
+      ::kill(-pod.pid, SIGTERM);
+      ::kill(-pod.pid, SIGKILL);
+      int status = 0;
+      waitpid(pod.pid, &status, 0);
+      pod.pid = -1;
+    }
+    pod.exit_code = 137;
+    pod.phase = PodPhase::Failed;
+  }
+
+  void remove(int pod_id) override { pods_.erase(pod_id); }
+
+ private:
+  struct Pod {
+    PodSpec spec;
+    int stage = 0;  // index into init containers; == size() -> main
+    pid_t pid = -1;
+    int exit_code = -1;
+    PodPhase phase = PodPhase::Pending;
+  };
+
+  void advance(Pod& pod) {
+    const ContainerSpec& c =
+        pod.stage < static_cast<int>(pod.spec.init_containers.size())
+            ? pod.spec.init_containers[pod.stage]
+            : pod.spec.main;
+    pod.pid = spawn(c, pod.spec.log_path);
+    if (pod.pid < 0) {
+      pod.exit_code = 127;
+      pod.phase = PodPhase::Failed;
+    } else {
+      pod.phase = PodPhase::Running;
+    }
+  }
+
+  static pid_t spawn(const ContainerSpec& c, const std::string& log_path) {
+    if (c.argv.empty()) return -1;
+    pid_t pid = fork();
+    if (pid != 0) return pid;
+
+    // child: lead a fresh process group so kill_pod can signal the tree
+    setpgid(0, 0);
+    if (!log_path.empty()) {
+      int fd = open(log_path.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
+      if (fd >= 0) {
+        dup2(fd, STDOUT_FILENO);
+        dup2(fd, STDERR_FILENO);
+        close(fd);
+      }
+    }
+    if (!c.workdir.empty() && chdir(c.workdir.c_str()) != 0) _exit(127);
+    for (const auto& kv : c.env)
+      setenv(kv.first.c_str(), kv.second.c_str(), 1);
+    std::vector<char*> argv;
+    argv.reserve(c.argv.size() + 1);
+    for (const auto& a : c.argv)
+      argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  }
+
+  int next_id_ = 1;
+  std::map<int, Pod> pods_;
+};
+
+}  // namespace ptpu

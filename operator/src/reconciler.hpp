@@ -1,0 +1,425 @@
+// The operator's reconciliation loop (native component).
+//
+// Parity target: the reference's Go operator (SURVEY.md 2.14) — watch
+// Operation CRs, create replica pods with stable identities, aggregate
+// pod conditions into a run phase, enforce restart/backoff/deadline/stop
+// semantics, and report status.  Transport here is the file protocol the
+// agent's ManifestBackend writes:
+//
+//   <cluster>/operations/<name>.json   CR (+"services")
+//   <cluster>/status/<name>.json       reconciled status (we write)
+//   <cluster>/logs/<name>/<pod>.log    pod logs
+//
+// TPU-specific semantics vs the reference: a distributed Operation is a
+// gang — TPU slices cannot run partially, so ANY replica failure fails
+// the whole attempt, all pods are torn down, and the attempt restarts
+// from the checkpoint (backoffLimit attempts).  Per-pod process ids are
+// stamped here (PTPU_PROCESS_ID / PTPU_REPLICA_INDEX), completing the
+// role-level env the converter emits.
+
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <dirent.h>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+#include "podruntime.hpp"
+
+namespace ptpu {
+
+inline double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+inline bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path);
+  if (!f) return false;
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+inline void write_file_atomic(const std::string& path,
+                              const std::string& content) {
+  std::string tmp = path + ".tmp";
+  {
+    std::ofstream f(tmp);
+    f << content;
+  }
+  std::rename(tmp.c_str(), path.c_str());
+}
+
+inline int free_port() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    close(fd);
+    return 0;
+  }
+  socklen_t len = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  int port = ntohs(addr.sin_port);
+  close(fd);
+  return port;
+}
+
+struct ReplicaState {
+  std::string pod_name;
+  int pod_id = -1;
+  int restarts = 0;
+  PodPhase phase = PodPhase::Pending;
+  int exit_code = -1;
+};
+
+struct OperationState {
+  Json cr;
+  std::string name;
+  long generation = 0;  // file mtime as generation proxy
+  double started_at = 0;
+  double finished_at = 0;
+  int attempt = 0;  // gang restart attempts (distributed) / pod restarts
+  std::string phase = "Pending";
+  std::string message;
+  std::vector<ReplicaState> replicas;
+  int coordinator_port = 0;
+};
+
+class Reconciler {
+ public:
+  Reconciler(std::string cluster_dir, PodRuntime* runtime)
+      : dir_(std::move(cluster_dir)), runtime_(runtime) {
+    mkdirs(dir_ + "/operations");
+    mkdirs(dir_ + "/status");
+    mkdirs(dir_ + "/logs");
+  }
+
+  // One reconcile pass over every CR; returns number of live operations.
+  int tick() {
+    std::set<std::string> seen;
+    DIR* d = opendir((dir_ + "/operations").c_str());
+    if (d) {
+      while (dirent* e = readdir(d)) {
+        std::string fname = e->d_name;
+        if (fname.size() < 6 ||
+            fname.substr(fname.size() - 5) != ".json")
+          continue;
+        std::string name = fname.substr(0, fname.size() - 5);
+        seen.insert(name);
+        reconcile_one(name);
+      }
+      closedir(d);
+    }
+    // CR deleted -> tear down and clear status.
+    for (auto it = ops_.begin(); it != ops_.end();) {
+      if (!seen.count(it->first)) {
+        teardown(it->second);
+        std::remove(status_path(it->first).c_str());
+        it = ops_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    int live = 0;
+    for (auto& kv : ops_)
+      if (kv.second.phase == "Running" || kv.second.phase == "Pending")
+        ++live;
+    return live;
+  }
+
+  const OperationState* get(const std::string& name) const {
+    auto it = ops_.find(name);
+    return it == ops_.end() ? nullptr : &it->second;
+  }
+
+ private:
+  std::string dir_;
+  PodRuntime* runtime_;
+  std::map<std::string, OperationState> ops_;
+
+  static void mkdirs(const std::string& path) {
+    mkdir(path.c_str(), 0755);
+  }
+
+  std::string status_path(const std::string& name) const {
+    return dir_ + "/status/" + name + ".json";
+  }
+
+  void reconcile_one(const std::string& name) {
+    std::string path = dir_ + "/operations/" + name + ".json";
+    struct stat st{};
+    if (stat(path.c_str(), &st) != 0) return;
+    // Nanosecond mtime: second-granularity misses rapid CR patches.
+    long generation = static_cast<long>(st.st_mtim.tv_sec) * 1000000000L +
+                      st.st_mtim.tv_nsec;
+
+    auto it = ops_.find(name);
+    if (it == ops_.end() || it->second.generation != generation) {
+      std::string text;
+      if (!read_file(path, &text)) return;
+      Json doc;
+      try {
+        doc = Json::parse(text);
+      } catch (const std::exception& e) {
+        // Partially-written file (writer not atomic): retry next tick,
+        // but a CR that never parses must surface, not hang.
+        if (it == ops_.end()) {
+          OperationState bad;
+          bad.name = name;
+          bad.generation = generation;
+          bad.phase = "Failed";
+          bad.message = std::string("invalid CR: ") + e.what();
+          ops_[name] = bad;
+          publish(ops_[name]);
+        }
+        return;
+      }
+      const Json& cr = doc.contains("operation") ? doc["operation"] : doc;
+      if (it == ops_.end()) {
+        OperationState op;
+        op.cr = cr;
+        op.name = name;
+        op.generation = generation;
+        op.started_at = now_s();
+        ops_[name] = op;
+        launch(ops_[name]);
+      } else {
+        // Spec update: only `stopped` is acted on mid-flight (parity:
+        // reference stops via CR patch); other edits take effect on
+        // the next attempt.
+        it->second.cr = cr;
+        it->second.generation = generation;
+      }
+    }
+    supervise(ops_[name]);
+  }
+
+  // -- pod construction --------------------------------------------------
+
+  static ContainerSpec container_from(const Json& c) {
+    ContainerSpec out;
+    for (const auto& a : c["command"].items())
+      out.argv.push_back(a.as_string());
+    for (const auto& a : c["args"].items())
+      out.argv.push_back(a.as_string());
+    for (const auto& e : c["env"].items()) {
+      if (e.contains("value") && e["value"].is_string())
+        out.env.emplace_back(e["name"].as_string(),
+                             e["value"].as_string());
+    }
+    if (c["workingDir"].is_string()) out.workdir = c["workingDir"].as_string();
+    return out;
+  }
+
+  static const Json& main_container(const Json& pod_spec) {
+    static const Json null_json;
+    for (const auto& c : pod_spec["containers"].items())
+      if (c["name"].as_string() == "ptpu-main") return c;
+    // Fall back to the first container (hand-written CRs).
+    const auto& cs = pod_spec["containers"].items();
+    return cs.empty() ? null_json : cs.front();
+  }
+
+  PodSpec build_pod(const OperationState& op, const Json& pod_spec,
+                    const std::string& pod_name,
+                    const std::vector<std::pair<std::string, std::string>>&
+                        extra_env) {
+    PodSpec pod;
+    pod.name = pod_name;
+    std::string log_dir = dir_ + "/logs/" + op.name;
+    mkdirs(log_dir);
+    pod.log_path = log_dir + "/" + pod_name + ".log";
+    for (const auto& ic : pod_spec["initContainers"].items())
+      pod.init_containers.push_back(container_from(ic));
+    pod.main = container_from(main_container(pod_spec));
+    for (const auto& kv : extra_env) {
+      bool replaced = false;
+      for (auto& existing : pod.main.env)
+        if (existing.first == kv.first) {
+          existing.second = kv.second;
+          replaced = true;
+        }
+      if (!replaced) pod.main.env.push_back(kv);
+    }
+    return pod;
+  }
+
+  void launch(OperationState& op) {
+    const Json& spec = op.cr["spec"];
+    op.replicas.clear();
+    op.phase = "Running";
+    op.message = "attempt " + std::to_string(op.attempt + 1);
+
+    if (spec.contains("replicaSpecs")) {
+      // Distributed gang: process ids follow replicaSpecs order — the
+      // same contract as compiler.topology (coordinator group first).
+      if (op.coordinator_port == 0) op.coordinator_port = free_port();
+      std::string coord =
+          "127.0.0.1:" + std::to_string(op.coordinator_port);
+      int process_id = 0;
+      for (const auto& role_kv : spec["replicaSpecs"].members()) {
+        const std::string& role = role_kv.first;
+        const Json& rs = role_kv.second;
+        long n = rs["replicas"].as_int(1);
+        const Json& pod_spec = rs["template"]["spec"];
+        for (long i = 0; i < n; ++i, ++process_id) {
+          std::string run = run_uuid(op);
+          std::string pod_name =
+              run + "-" + role + "-" + std::to_string(i);
+          std::vector<std::pair<std::string, std::string>> extra = {
+              {"PTPU_PROCESS_ID", std::to_string(process_id)},
+              {"PTPU_REPLICA_INDEX", std::to_string(i)},
+              {"PTPU_REPLICA_ROLE", role},
+              // Local runtime: all pods share this host; in-cluster the
+              // converter's DNS address stands.
+              {"PTPU_COORDINATOR_ADDRESS", coord},
+              {"POLYAXON_TPU_POD_ID", pod_name},
+          };
+          ReplicaState rep;
+          rep.pod_name = pod_name;
+          rep.pod_id = runtime_->launch(
+              build_pod(op, pod_spec, pod_name, extra));
+          op.replicas.push_back(rep);
+        }
+      }
+    } else {
+      long n = spec.contains("replicas") ? spec["replicas"].as_int(1) : 1;
+      const Json& pod_spec = spec["template"]["spec"];
+      for (long i = 0; i < n; ++i) {
+        std::string pod_name = run_uuid(op) + "-main-" +
+                               std::to_string(i);
+        ReplicaState rep;
+        rep.pod_name = pod_name;
+        rep.pod_id = runtime_->launch(build_pod(
+            op, pod_spec, pod_name,
+            {{"POLYAXON_TPU_POD_ID", pod_name}}));
+        op.replicas.push_back(rep);
+      }
+    }
+    publish(op);
+  }
+
+  static std::string run_uuid(const OperationState& op) {
+    const Json& labels = op.cr["metadata"]["labels"];
+    if (labels.contains("polyaxon-tpu/run-uuid"))
+      return labels["polyaxon-tpu/run-uuid"].as_string();
+    return op.name;
+  }
+
+  // -- supervision -------------------------------------------------------
+
+  void supervise(OperationState& op) {
+    if (op.phase == "Succeeded" || op.phase == "Failed" ||
+        op.phase == "Stopped")
+      return;
+    const Json& spec = op.cr["spec"];
+
+    if (spec["stopped"].as_bool(false)) {
+      teardown(op);
+      op.phase = "Stopped";
+      op.message = "stop requested";
+      op.finished_at = now_s();
+      publish(op);
+      return;
+    }
+
+    long deadline = spec["activeDeadlineSeconds"].as_int(0);
+    if (deadline > 0 && now_s() - op.started_at > deadline) {
+      teardown(op);
+      op.phase = "Failed";
+      op.message = "activeDeadlineSeconds exceeded";
+      op.finished_at = now_s();
+      publish(op);
+      return;
+    }
+
+    bool changed = false;
+    int succeeded = 0, failed = 0;
+    for (auto& rep : op.replicas) {
+      PodPhase before = rep.phase;
+      rep.phase = runtime_->poll(rep.pod_id);
+      rep.exit_code = runtime_->exit_code(rep.pod_id);
+      if (rep.phase != before) changed = true;
+      if (rep.phase == PodPhase::Succeeded) ++succeeded;
+      if (rep.phase == PodPhase::Failed) ++failed;
+    }
+
+    bool gang = spec.contains("replicaSpecs");
+    long backoff = spec["backoffLimit"].as_int(0);
+
+    if (failed > 0) {
+      // TPU gang semantics: any replica failure fails the attempt.
+      teardown(op);
+      if (op.attempt < backoff) {
+        op.attempt++;
+        launch(op);  // publishes "attempt N"
+        return;
+      }
+      op.phase = "Failed";
+      op.message = gang ? "replica failure (gang torn down)"
+                        : "pod failed";
+      op.finished_at = now_s();
+      publish(op);
+      return;
+    }
+    if (succeeded == static_cast<int>(op.replicas.size()) &&
+        !op.replicas.empty()) {
+      op.phase = "Succeeded";
+      op.finished_at = now_s();
+      publish(op);
+      return;
+    }
+    if (changed) publish(op);
+  }
+
+  void teardown(OperationState& op) {
+    for (auto& rep : op.replicas) {
+      if (rep.pod_id >= 0) {
+        if (runtime_->poll(rep.pod_id) == PodPhase::Running)
+          runtime_->kill_pod(rep.pod_id);
+        runtime_->remove(rep.pod_id);
+        rep.pod_id = -1;
+      }
+    }
+  }
+
+  void publish(const OperationState& op) {
+    Json status = Json::object();
+    status.set("phase", Json(op.phase));
+    status.set("message", Json(op.message));
+    status.set("attempt", Json(op.attempt));
+    status.set("observedGeneration", Json(static_cast<double>(op.generation)));
+    if (op.finished_at > 0) status.set("finishedAt", Json(op.finished_at));
+    Json reps = Json::object();
+    for (const auto& rep : op.replicas) {
+      Json r = Json::object();
+      r.set("phase", Json(phase_name(rep.phase)));
+      r.set("restarts", Json(rep.restarts));
+      if (rep.exit_code >= 0) r.set("exitCode", Json(rep.exit_code));
+      reps.set(rep.pod_name, r);
+    }
+    status.set("replicaStatuses", reps);
+    write_file_atomic(status_path(op.name), status.dump(1));
+  }
+};
+
+}  // namespace ptpu

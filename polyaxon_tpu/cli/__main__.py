@@ -1,0 +1,7 @@
+"""``python -m polyaxon_tpu.cli`` — same entrypoint as the ``ptpu``
+console script."""
+
+from .main import cli
+
+if __name__ == "__main__":
+    cli()

@@ -362,5 +362,77 @@ def version():
         pass
 
 
+# ---------------------------------------------------------------------------
+# control plane + agent services
+# ---------------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8000, type=int)
+@click.option("--schedules/--no-schedules", default=True,
+              help="Also run the schedule-materializer loop.")
+def server(host, port, schedules):
+    """Serve the control plane API (runs DB, queue, streams)."""
+    import threading
+
+    from polyaxon_tpu.client.store import FileRunStore
+    from polyaxon_tpu.scheduler import ScheduleService, make_server
+
+    store = FileRunStore()
+    srv = make_server(host, port, store)
+    if schedules:
+        service = ScheduleService(store)
+        threading.Thread(target=service.run_forever, daemon=True).start()
+    click.echo(f"control plane on http://{host}:{port} (home={store.home})")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+
+
+@cli.command()
+@click.option("--name", default="agent-0")
+@click.option("--host", default=None,
+              help="Control plane URL (default: POLYAXON_TPU_HOST, else "
+                   "in-process over the local store).")
+@click.option("--backend", type=click.Choice(["local", "manifest"]),
+              default="local")
+@click.option("--cluster-dir", default=None,
+              help="Manifest backend: directory the operator watches.")
+@click.option("--max-concurrent", default=8, type=int)
+def agent(name, host, backend, cluster_dir, max_concurrent):
+    """Run an agent: claim queued runs and execute them."""
+    from polyaxon_tpu.runner.agent import Agent, LocalBackend, ManifestBackend
+    from polyaxon_tpu.scheduler import ControlPlane
+
+    host = host or os.environ.get("POLYAXON_TPU_HOST")
+    if host:
+        from polyaxon_tpu.client.api_client import ApiRunStore
+
+        plane = ApiRunStore(host)
+    else:
+        plane = ControlPlane()
+
+    if backend == "manifest":
+        if not cluster_dir:
+            raise click.ClickException(
+                "--backend manifest requires --cluster-dir")
+        be = ManifestBackend(cluster_dir)
+    else:
+        store = getattr(plane, "store", plane)
+        be = LocalBackend(store)
+    worker = Agent(plane, backend=be, name=name,
+                   max_concurrent=max_concurrent)
+    click.echo(f"agent {name} polling "
+               f"{host or 'local store'} (backend={backend})")
+    try:
+        worker.run_forever()
+    except KeyboardInterrupt:
+        pass
+
+
 if __name__ == "__main__":
     cli()

@@ -125,14 +125,41 @@ class ApiRunStore:
         })
         return out.get("logs", "") if isinstance(out, dict) else (out or "")
 
+    def claim(self, agent: str,
+              queues: Optional[List[str]] = None) -> Optional[Dict[str, Any]]:
+        """Agent-side: claim the next queued run (None when queue empty)."""
+        out = self._request("POST", "/agent/claim",
+                            body={"agent": agent, "queues": queues})
+        return out or None
+
+    def read_logs_from(self, run_uuid: str, replica: Optional[str],
+                       offset: int) -> Dict[str, Any]:
+        """Incremental log read for streaming (offset in, new text out)."""
+        return self._request("GET", f"/runs/{run_uuid}/logs", params={
+            "replica": replica, "offset": offset,
+        }) or {"logs": "", "offset": offset}
+
     def add_lineage(self, run_uuid: str, record: Dict[str, Any]) -> None:
         self._request("POST", f"/runs/{run_uuid}/lineage", body=record)
 
     def get_lineage(self, run_uuid: str) -> List[Dict[str, Any]]:
         return self._request("GET", f"/runs/{run_uuid}/lineage") or []
 
-    # Local-path helpers: API mode still materializes artifacts locally
-    # under the home tree (the sidecar syncs them); reuse the file layout.
+    # Local-path helpers: API mode still materializes artifacts/logs
+    # locally under the home tree (the sidecar/agent relay them to the
+    # control plane); reuse the file layout.
+
+    @property
+    def home(self) -> str:
+        from .store import default_home
+
+        return default_home()
+
+    def logs_path(self, run_uuid: str, replica: str = "main") -> str:
+        import os
+
+        return os.path.join(self.home, "runs", run_uuid, "logs",
+                            f"{replica}.log")
 
     def artifacts_path(self, run_uuid: str) -> str:
         from ..compiler.contexts import run_artifacts_path

@@ -1,0 +1,307 @@
+"""The agent: claims queued runs from the control plane and executes them.
+
+Parity: reference agent service (SURVEY.md 2.9, L3) — polls the queue,
+invokes the converter, applies resources, watches status, reports back,
+cleans up.  Backends:
+
+- ``LocalBackend``    — executes on this host via ``LocalExecutor``
+  (subprocess per replica with the full PTPU_* env); the single-box
+  deployment and the test harness.
+- ``ManifestBackend`` — converts to ``Operation`` CRs and writes them to
+  a cluster directory; the operator (C++, ``operator/``) reconciles them
+  into pods and writes status files back.  The same file protocol an
+  apply-to-k8s transport implements with the API server.
+
+DAG / matrix (tuner) kinds are controller runs: the agent executes the
+controller in-process, and the controller creates child runs back
+through the store — each child is then claimed and executed like any
+other run.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..client.store import FileRunStore
+from ..flow import V1Operation
+from ..k8s import ConverterConfig, convert, headless_service
+from ..lifecycle import V1Statuses, is_done
+from .local import LocalExecutor
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Active:
+    run_uuid: str
+    handle: Any
+    backend: "Backend"
+    ttl: Optional[int] = None
+    done_at: Optional[float] = None
+
+
+class Backend:
+    """Execution transport for one claimed run."""
+
+    def submit(self, record: Dict[str, Any],
+               operation: V1Operation) -> Any:  # -> handle
+        raise NotImplementedError
+
+    def check(self, handle: Any) -> Optional[str]:
+        """Current terminal status (succeeded/failed/stopped) or None."""
+        raise NotImplementedError
+
+    def stop(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def cleanup(self, handle: Any) -> None:
+        pass
+
+
+class LocalBackend(Backend):
+    """Runs the operation on this host in a supervised thread."""
+
+    def __init__(self, store: FileRunStore, project: str = "default"):
+        self.store = store
+        self.project = project
+
+    def submit(self, record, operation):
+        executor = LocalExecutor(store=self.store,
+                                 project=record.get("project")
+                                 or self.project)
+        state = {"status": None}
+
+        def work():
+            try:
+                final = executor.run_operation(operation,
+                                               run_uuid=record["uuid"])
+                state["status"] = final.get("status")
+            except Exception as e:  # noqa: BLE001 - terminal supervision
+                logger.exception("local execution failed")
+                state["status"] = V1Statuses.FAILED
+                self.store.set_status(record["uuid"], V1Statuses.FAILED,
+                                      reason="AgentLocalBackend",
+                                      message=str(e), force=True)
+            finally:
+                self._relay_logs(record["uuid"])
+
+        thread = threading.Thread(target=work, daemon=True)
+        thread.start()
+        return (thread, state)
+
+    def _relay_logs(self, run_uuid: str) -> None:
+        """Remote store: push locally-written replica logs up to the
+        control plane so `ops logs` serves them."""
+        if not getattr(self.store, "host", None):
+            return  # file store: logs are already in place
+        try:
+            if self.store.read_logs(run_uuid):
+                return  # control plane shares the home tree; already there
+        except Exception:  # noqa: BLE001 - relay is best-effort
+            pass
+        logs_dir = os.path.dirname(self.store.logs_path(run_uuid))
+        if not os.path.isdir(logs_dir):
+            return
+        for fname in sorted(os.listdir(logs_dir)):
+            if not fname.endswith(".log"):
+                continue
+            try:
+                with open(os.path.join(logs_dir, fname)) as f:
+                    text = f.read()
+                if text:
+                    self.store.append_log(run_uuid, text,
+                                          replica=fname[:-4])
+            except OSError:
+                continue
+
+    def check(self, handle):
+        thread, state = handle
+        if thread.is_alive():
+            return None
+        return state["status"] or V1Statuses.FAILED
+
+    def stop(self, handle):
+        pass  # cooperative: executor reacts to the run's `stopping` status
+
+
+class ManifestBackend(Backend):
+    """File-protocol cluster transport.
+
+    Layout under ``cluster_dir``:
+        operations/<name>.json   CRs this agent applies
+        status/<name>.json       {"phase": ..., "message": ...} from the
+                                 operator
+    """
+
+    def __init__(self, cluster_dir: str,
+                 config: Optional[ConverterConfig] = None):
+        self.cluster_dir = cluster_dir
+        self.config = config or ConverterConfig()
+        os.makedirs(os.path.join(cluster_dir, "operations"), exist_ok=True)
+        os.makedirs(os.path.join(cluster_dir, "status"), exist_ok=True)
+
+    _PHASES = {
+        "Succeeded": V1Statuses.SUCCEEDED,
+        "Failed": V1Statuses.FAILED,
+        "Stopped": V1Statuses.STOPPED,
+    }
+
+    def submit(self, record, operation):
+        from ..compiler import resolve
+
+        compiled = resolve(operation, run_uuid=record["uuid"],
+                           project=record.get("project"))
+        cr = convert(compiled, record["uuid"], record.get("project"),
+                     self.config)
+        name = cr["metadata"]["name"]
+        svc = headless_service(cr)
+        path = os.path.join(self.cluster_dir, "operations", f"{name}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"operation": cr, "services":
+                       [svc] if svc else []}, f, indent=1)
+        os.replace(tmp, path)
+        return name
+
+    def check(self, handle):
+        path = os.path.join(self.cluster_dir, "status", f"{handle}.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                status = json.load(f)
+        except ValueError:
+            return None
+        return self._PHASES.get(status.get("phase"))
+
+    def stop(self, handle):
+        path = os.path.join(self.cluster_dir, "operations",
+                            f"{handle}.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            doc["operation"]["spec"]["stopped"] = True
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except (OSError, ValueError):
+            pass
+
+    def cleanup(self, handle):
+        for sub in ("operations", "status"):
+            try:
+                os.remove(os.path.join(self.cluster_dir, sub,
+                                       f"{handle}.json"))
+            except OSError:
+                pass
+
+
+class Agent:
+    """Queue-polling loop supervising claimed runs to completion."""
+
+    def __init__(
+        self,
+        plane,  # ControlPlane (in-process) or ApiRunStore (remote agent)
+        backend: Optional[Backend] = None,
+        name: str = "agent-0",
+        poll_interval: float = 0.2,
+        max_concurrent: int = 8,
+    ):
+        self.plane = plane
+        # Both expose .claim(); ControlPlane wraps the store, ApiRunStore
+        # IS the (remote) store.
+        self.store = getattr(plane, "store", plane)
+        self.backend = backend or LocalBackend(self.store)
+        self.name = name
+        self.poll_interval = poll_interval
+        self.max_concurrent = max_concurrent
+        self.active: Dict[str, _Active] = {}
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def stop(self):
+        self._stop.set()
+
+    def run_forever(self):
+        while not self._stop.is_set():
+            progressed = self.tick()
+            if not progressed:
+                self._stop.wait(self.poll_interval)
+
+    def tick(self) -> bool:
+        """One scheduling round; returns True if anything happened."""
+        progressed = self._reap()
+        # Finished runs merely awaiting TTL cleanup don't hold a slot.
+        live = sum(1 for a in self.active.values() if a.done_at is None)
+        if live < self.max_concurrent:
+            record = self.plane.claim(self.name)
+            if record:
+                self._launch(record)
+                progressed = True
+        return progressed
+
+    # -- internals -------------------------------------------------------
+
+    def _launch(self, record: Dict[str, Any]) -> None:
+        run_uuid = record["uuid"]
+        try:
+            operation = V1Operation.from_dict(record["content"])
+        except Exception as e:  # content written by client; may be bad
+            self.store.set_status(run_uuid, V1Statuses.FAILED,
+                                  reason="AgentParseError", message=str(e),
+                                  force=True)
+            return
+        try:
+            handle = self.backend.submit(record, operation)
+        except Exception as e:  # noqa: BLE001 - submission is a boundary
+            logger.exception("submit failed for %s", run_uuid)
+            self.store.set_status(run_uuid, V1Statuses.FAILED,
+                                  reason="AgentSubmitError", message=str(e),
+                                  force=True)
+            return
+        termination = (record.get("content") or {}).get("termination") or {}
+        self.active[run_uuid] = _Active(
+            run_uuid=run_uuid, handle=handle, backend=self.backend,
+            ttl=termination.get("ttl"))
+        self.store.set_status(run_uuid, V1Statuses.STARTING,
+                              reason="AgentSubmit")
+
+    def _reap(self) -> bool:
+        progressed = False
+        now = time.time()
+        for run_uuid, active in list(self.active.items()):
+            if active.done_at is not None:
+                # Finished: only TTL cleanup remains (no store polling,
+                # no progress claim — the loop must be able to sleep).
+                if active.ttl is None or now - active.done_at >= active.ttl:
+                    active.backend.cleanup(active.handle)
+                    del self.active[run_uuid]
+                    progressed = True
+                continue
+            # user/CLI requested stop?
+            try:
+                current = self.store.get_run(run_uuid).get("status")
+            except Exception:
+                current = None
+            if current == V1Statuses.STOPPING:
+                active.backend.stop(active.handle)
+            terminal = active.backend.check(active.handle)
+            if terminal is None:
+                continue
+            progressed = True
+            active.done_at = now
+            if not is_done(current):
+                self.store.set_status(run_uuid, terminal,
+                                      reason="AgentReap", force=True)
+            if active.ttl is None:
+                active.backend.cleanup(active.handle)
+                del self.active[run_uuid]
+        return progressed

@@ -169,10 +169,15 @@ class LocalExecutor:
     def _build_env(self, run_uuid: str, extra: Optional[Dict[str, str]] = None
                    ) -> Dict[str, str]:
         env = dict(os.environ)
-        # The child must track against THIS executor's store; a configured
-        # API host would silently send its metrics elsewhere (breaking
-        # tuner joins in --eager mode).
-        env.pop("POLYAXON_TPU_HOST", None)
+        # The child must track against THIS executor's store: against the
+        # API host when the store is remote (agent mode), otherwise the
+        # local file store — a stale configured host would silently send
+        # metrics elsewhere (breaking tuner joins in --eager mode).
+        remote_host = getattr(self.store, "host", None)
+        if remote_host:
+            env["POLYAXON_TPU_HOST"] = remote_host
+        else:
+            env.pop("POLYAXON_TPU_HOST", None)
         env[ENV_RUN_UUID] = run_uuid
         env[ENV_PROJECT] = self.project
         env["POLYAXON_TPU_HOME"] = self.store.home

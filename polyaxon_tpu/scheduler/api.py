@@ -1,0 +1,292 @@
+"""Control-plane API service (SURVEY.md 2.8 / §7 step 8).
+
+The reference splits this across a Django API, DB, orchestration and a
+streams service (``haupt``); here it is ONE stdlib-threaded HTTP process
+over the ``FileRunStore`` — runs DB, scheduling queue, status plane, and
+log/event streaming in ~300 lines.  ``client.ApiRunStore`` is the
+matching client; the agent claims queued work via ``/agent/claim``.
+
+Endpoints (all under ``/api/v1``):
+
+    POST   /runs                         create
+    GET    /runs?project&query&sort&...  list (query DSL applies)
+    GET    /runs/<u>                     fetch
+    PATCH  /runs/<u>                     update fields
+    DELETE /runs/<u>                     delete
+    POST   /runs/<u>/statuses            transition {status, reason, ...}
+    GET    /runs/<u>/statuses            condition history
+    POST   /runs/<u>/events              append event batch
+    GET    /runs/<u>/events?kind&name&offset
+    GET    /runs/<u>/events/names?kind
+    GET    /runs/<u>/metrics/last
+    POST   /runs/<u>/logs                append {text, replica}
+    GET    /runs/<u>/logs?replica&tail&offset  (offset -> incremental read)
+    POST   /runs/<u>/lineage             add artifact lineage record
+    GET    /runs/<u>/lineage
+    POST   /agent/claim                  {agent, queues?} -> next queued run
+    GET    /healthz
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..client.store import FileRunStore, StoreError
+from ..lifecycle import V1Statuses
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class ControlPlane:
+    """Request-independent core: store + queue semantics.
+
+    Kept separate from the HTTP plumbing so the agent can embed it
+    in-process (single-box deployments) and tests can drive it directly.
+    """
+
+    def __init__(self, store: Optional[FileRunStore] = None):
+        self.store = store or FileRunStore()
+        self._claim_lock = threading.Lock()
+
+    # -- queue ----------------------------------------------------------
+
+    def claim(self, agent: str,
+              queues: Optional[List[str]] = None) -> Optional[Dict[str, Any]]:
+        """Atomically hand the oldest queued run to an agent."""
+        with self._claim_lock:
+            queued = self.store.list_runs(query=f"status:{V1Statuses.QUEUED}",
+                                          sort="created_at")
+            for record in queued:
+                if queues and record.get("queue") not in queues:
+                    continue
+                ok = self.store.set_status(
+                    record["uuid"], V1Statuses.SCHEDULED,
+                    reason="AgentClaim", message=agent)
+                if ok:
+                    self.store.update_run(record["uuid"], agent=agent)
+                    return self.store.get_run(record["uuid"])
+        return None
+
+    # -- streams --------------------------------------------------------
+
+    def read_logs_from(self, run_uuid: str, replica: Optional[str],
+                       offset: int) -> Dict[str, Any]:
+        """Incremental log read: byte offset in, new text + offset out.
+
+        Offsets are stable only within ONE replica file; with several
+        replicas and no replica named, the aggregated text shifts as
+        earlier files grow, so fall back to full snapshots (offset 0).
+        """
+        if replica is None:
+            import os
+
+            logs_dir = os.path.join(self.store.run_path(run_uuid), "logs")
+            files = sorted(os.listdir(logs_dir)) if os.path.isdir(logs_dir) \
+                else []
+            if len(files) == 1:
+                replica = files[0].removesuffix(".log")
+            elif len(files) > 1:
+                return {"logs": self.store.read_logs(run_uuid),
+                        "offset": 0}
+        text = self.store.read_logs(run_uuid, replica=replica)
+        blob = text.encode()
+        chunk = blob[offset:] if 0 <= offset <= len(blob) else blob
+        return {"logs": chunk.decode(errors="replace"),
+                "offset": len(blob)}
+
+
+def _json_response(handler: BaseHTTPRequestHandler, code: int,
+                   payload: Any) -> None:
+    blob = json.dumps(payload, default=str).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(blob)))
+    handler.end_headers()
+    handler.wfile.write(blob)
+
+
+_ROUTES: List[Tuple[str, re.Pattern, str]] = [
+    ("POST", re.compile(r"^/runs$"), "create_run"),
+    ("GET", re.compile(r"^/runs$"), "list_runs"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)$"), "get_run"),
+    ("PATCH", re.compile(r"^/runs/(?P<u>[^/]+)$"), "update_run"),
+    ("DELETE", re.compile(r"^/runs/(?P<u>[^/]+)$"), "delete_run"),
+    ("POST", re.compile(r"^/runs/(?P<u>[^/]+)/statuses$"), "set_status"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/statuses$"), "get_statuses"),
+    ("POST", re.compile(r"^/runs/(?P<u>[^/]+)/events$"), "append_events"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/events$"), "read_events"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/events/names$"), "list_events"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/metrics/last$"), "last_metrics"),
+    ("POST", re.compile(r"^/runs/(?P<u>[^/]+)/logs$"), "append_log"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/logs$"), "read_logs"),
+    ("POST", re.compile(r"^/runs/(?P<u>[^/]+)/lineage$"), "add_lineage"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/lineage$"), "get_lineage"),
+    ("POST", re.compile(r"^/agent/claim$"), "agent_claim"),
+    ("GET", re.compile(r"^/healthz$"), "healthz"),
+]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    plane: ControlPlane  # set by make_server
+
+    # -- dispatch -------------------------------------------------------
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        if not parsed.path.startswith("/api/v1"):
+            return _json_response(self, 404, {"error": "not found"})
+        path = parsed.path[len("/api/v1"):] or "/"
+        params = {k: v[0] for k, v in
+                  urllib.parse.parse_qs(parsed.query).items()}
+        body: Dict[str, Any] = {}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                return _json_response(self, 400, {"error": "bad json"})
+        for verb, pattern, name in _ROUTES:
+            if verb != method:
+                continue
+            m = pattern.match(path)
+            if m:
+                try:
+                    result = getattr(self, "_h_" + name)(
+                        body, params, **m.groupdict())
+                except ApiError as e:
+                    return _json_response(self, e.code,
+                                          {"error": e.message})
+                except (StoreError, FileNotFoundError) as e:
+                    return _json_response(self, 404, {"error": str(e)})
+                except (ValueError, TypeError, KeyError) as e:
+                    # Body-driven **kwargs: bad/missing fields surface as
+                    # a 400, never a dropped connection.
+                    return _json_response(self, 400, {"error": repr(e)})
+                return _json_response(self, 200, result)
+        return _json_response(self, 404, {"error": f"no route {path}"})
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PATCH(self):
+        self._dispatch("PATCH")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- handlers -------------------------------------------------------
+
+    def _h_healthz(self, body, params):
+        return {"status": "ok"}
+
+    def _h_create_run(self, body, params):
+        return self.plane.store.create_run(**body)
+
+    def _h_list_runs(self, body, params):
+        limit = params.get("limit")
+        return self.plane.store.list_runs(
+            project=params.get("project"),
+            pipeline=params.get("pipeline"),
+            query=params.get("query"),
+            sort=params.get("sort"),
+            limit=int(limit) if limit else None,
+            offset=int(params.get("offset") or 0),
+        )
+
+    def _h_get_run(self, body, params, u):
+        return self.plane.store.get_run(u)
+
+    def _h_update_run(self, body, params, u):
+        return self.plane.store.update_run(u, **body)
+
+    def _h_delete_run(self, body, params, u):
+        self.plane.store.delete_run(u)
+        return {"ok": True}
+
+    def _h_set_status(self, body, params, u):
+        ok = self.plane.store.set_status(
+            u, body.get("status"), reason=body.get("reason"),
+            message=body.get("message"), force=bool(body.get("force")))
+        return {"ok": ok}
+
+    def _h_get_statuses(self, body, params, u):
+        return [c.to_dict() for c in self.plane.store.get_statuses(u)]
+
+    def _h_append_events(self, body, params, u):
+        self.plane.store.append_events(u, body["kind"], body["name"],
+                                       body.get("events") or [])
+        return {"ok": True}
+
+    def _h_read_events(self, body, params, u):
+        return self.plane.store.read_events(
+            u, params.get("kind"), params.get("name"),
+            offset=int(params.get("offset") or 0))
+
+    def _h_list_events(self, body, params, u):
+        return self.plane.store.list_events(u, kind=params.get("kind"))
+
+    def _h_last_metrics(self, body, params, u):
+        return self.plane.store.last_metrics(u)
+
+    def _h_append_log(self, body, params, u):
+        self.plane.store.append_log(u, body.get("text", ""),
+                                    replica=body.get("replica") or "main")
+        return {"ok": True}
+
+    def _h_read_logs(self, body, params, u):
+        if "offset" in params:
+            return self.plane.read_logs_from(
+                u, params.get("replica"), int(params["offset"]))
+        tail = params.get("tail")
+        return {"logs": self.plane.store.read_logs(
+            u, replica=params.get("replica"),
+            tail=int(tail) if tail else None)}
+
+    def _h_add_lineage(self, body, params, u):
+        self.plane.store.add_lineage(u, body)
+        return {"ok": True}
+
+    def _h_get_lineage(self, body, params, u):
+        return self.plane.store.get_lineage(u)
+
+    def _h_agent_claim(self, body, params):
+        record = self.plane.claim(body.get("agent") or "agent",
+                                  queues=body.get("queues"))
+        return record or {}
+
+
+def make_server(host: str = "127.0.0.1", port: int = 8000,
+                store: Optional[FileRunStore] = None,
+                plane: Optional[ControlPlane] = None) -> ThreadingHTTPServer:
+    plane = plane or ControlPlane(store)
+    handler = type("Handler", (_Handler,), {"plane": plane})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.plane = plane  # type: ignore[attr-defined]
+    return server
+
+
+def serve_forever(host: str = "127.0.0.1", port: int = 8000,
+                  store: Optional[FileRunStore] = None) -> None:
+    server = make_server(host, port, store)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()

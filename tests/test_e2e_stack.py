@@ -1,0 +1,128 @@
+"""Full-stack e2e: CLI submit -> control plane (HTTP) -> agent claim ->
+converter -> Operation CR -> native C++ operator -> pods -> status flows
+back -> logs stream. The reference's call stack 3.1 (SURVEY.md) with the
+file-protocol cluster in place of k8s."""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from polyaxon_tpu.client.api_client import ApiRunStore
+from polyaxon_tpu.client.store import FileRunStore
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.runner.agent import Agent, ManifestBackend
+from polyaxon_tpu.scheduler import make_server
+
+OPERATOR_DIR = Path(__file__).resolve().parent.parent / "operator"
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="session")
+def operator_binary():
+    proc = subprocess.run(["make", "-C", str(OPERATOR_DIR)],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.fail(f"operator build failed:\n{proc.stderr}")
+    return str(OPERATOR_DIR / "build" / "ptpu-operator")
+
+
+SPEC_YAML = """
+kind: component
+name: e2e-trainer
+inputs:
+  - {name: message, type: str, value: stack-e2e, isOptional: true}
+run:
+  kind: job
+  container:
+    image: python:3.11
+    command: [python, -c, "print('msg={{ message }}')"]
+"""
+
+
+def test_full_stack(tmp_home, tmp_path, operator_binary):
+    # control plane over HTTP
+    store = FileRunStore()
+    port = _free_port()
+    server = make_server("127.0.0.1", port, store)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    api = ApiRunStore(f"http://127.0.0.1:{port}")
+
+    # native operator watching the cluster dir
+    cluster = tmp_path / "cluster"
+    cluster.mkdir()
+    operator = subprocess.Popen(
+        [operator_binary, "--cluster-dir", str(cluster), "--poll-ms", "20"])
+
+    # agent: claims from the API, applies CRs to the cluster dir
+    agent = Agent(api, backend=ManifestBackend(str(cluster)),
+                  name="e2e-agent")
+    agent_stop = threading.Event()
+
+    def agent_loop():
+        while not agent_stop.is_set():
+            if not agent.tick():
+                time.sleep(0.05)
+
+    agent_thread = threading.Thread(target=agent_loop, daemon=True)
+    agent_thread.start()
+
+    try:
+        # CLI submit (API mode): queue the polyaxonfile on the server
+        spec = tmp_path / "e2e.yaml"
+        spec.write_text(SPEC_YAML)
+        env = {"POLYAXON_TPU_HOST": f"http://127.0.0.1:{port}",
+               "POLYAXON_TPU_HOME": store.home,
+               "PATH": "/usr/bin:/bin:/usr/local/bin"}
+        out = subprocess.run(
+            [sys.executable, "-m", "polyaxon_tpu.cli", "run",
+             "-f", str(spec), "-P", "message=from-the-cli"],
+            capture_output=True, text=True, env=env,
+            cwd=str(Path(__file__).resolve().parent.parent))
+        assert out.returncode == 0, out.stderr
+        assert "queued" in out.stdout
+
+        runs = api.list_runs()
+        assert len(runs) == 1
+        uuid = runs[0]["uuid"]
+
+        # the whole pipeline converges to succeeded
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = api.get_run(uuid).get("status")
+            if status in V1Statuses.DONE:
+                break
+            time.sleep(0.1)
+        assert api.get_run(uuid)["status"] == V1Statuses.SUCCEEDED
+
+        # the CR carried the resolved param into the pod; operator logs it
+        log = (cluster / "logs" / f"ptpu-{uuid}" /
+               f"{uuid}-main-0.log").read_text()
+        assert "msg=from-the-cli" in log
+
+        # statuses went created -> queued -> scheduled -> starting -> done
+        types = [c.type for c in api.get_statuses(uuid)]
+        assert types[0] == "created"
+        assert "queued" in types and "scheduled" in types
+        assert types[-1] == "succeeded"
+    finally:
+        agent_stop.set()
+        agent_thread.join(timeout=5)
+        operator.send_signal(signal.SIGTERM)
+        try:
+            operator.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            operator.kill()
+        server.shutdown()
+        server.server_close()

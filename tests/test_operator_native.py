@@ -1,0 +1,246 @@
+"""Native operator e2e tests: build the C++ binary, drive it with real
+Operation CRs over the file protocol, assert reconciled statuses —
+the reference's envtest-style operator testing (SURVEY.md §4) without a
+cluster."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+OPERATOR_DIR = Path(__file__).resolve().parent.parent / "operator"
+BINARY = OPERATOR_DIR / "build" / "ptpu-operator"
+
+
+@pytest.fixture(scope="session")
+def operator_binary():
+    proc = subprocess.run(["make", "-C", str(OPERATOR_DIR)],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.fail(f"operator build failed:\n{proc.stderr}")
+    return str(BINARY)
+
+
+@pytest.fixture
+def cluster(tmp_path, operator_binary):
+    cluster_dir = tmp_path / "cluster"
+    cluster_dir.mkdir()
+    proc = subprocess.Popen(
+        [operator_binary, "--cluster-dir", str(cluster_dir),
+         "--poll-ms", "20"])
+    yield cluster_dir
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def write_cr(cluster_dir, name, spec, labels=None):
+    cr = {
+        "operation": {
+            "apiVersion": "core.polyaxon-tpu.io/v1",
+            "kind": "Operation",
+            "metadata": {"name": name,
+                         "labels": labels or
+                         {"polyaxon-tpu/run-uuid": name}},
+            "spec": spec,
+        },
+        "services": [],
+    }
+    path = cluster_dir / "operations" / f"{name}.json"
+    path.parent.mkdir(exist_ok=True)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(cr))
+    os.replace(tmp, path)
+    return path
+
+
+def wait_status(cluster_dir, name, phases=("Succeeded", "Failed", "Stopped"),
+                timeout=20):
+    path = cluster_dir / "status" / f"{name}.json"
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        if path.exists():
+            try:
+                last = json.loads(path.read_text())
+            except ValueError:
+                pass
+            if last and last.get("phase") in phases:
+                return last
+        time.sleep(0.05)
+    pytest.fail(f"status for {name} never reached {phases}; last={last}")
+
+
+def job_spec(command, backoff=0):
+    spec = {
+        "runKind": "job",
+        "template": {"spec": {"containers": [{
+            "name": "ptpu-main",
+            "command": ["/bin/sh", "-c", command],
+            "env": [],
+        }]}},
+    }
+    if backoff:
+        spec["backoffLimit"] = backoff
+    return spec
+
+
+class TestJobReconcile:
+    def test_job_succeeds_and_logs(self, cluster):
+        write_cr(cluster, "ok1", job_spec("echo hello-from-pod"))
+        status = wait_status(cluster, "ok1")
+        assert status["phase"] == "Succeeded"
+        reps = status["replicaStatuses"]
+        assert list(reps.values())[0]["exitCode"] == 0
+        log = (cluster / "logs" / "ok1" / "ok1-main-0.log").read_text()
+        assert "hello-from-pod" in log
+
+    def test_failing_job_retries_to_backoff_limit(self, cluster):
+        write_cr(cluster, "bad1", job_spec("exit 3", backoff=2))
+        status = wait_status(cluster, "bad1")
+        assert status["phase"] == "Failed"
+        assert status["attempt"] == 2  # initial + 2 retries
+
+    def test_init_containers_run_before_main(self, cluster, tmp_path):
+        flag = tmp_path / "flag.txt"
+        spec = job_spec(f"cat {flag}")
+        spec["template"]["spec"]["initContainers"] = [{
+            "name": "init-0",
+            "command": ["/bin/sh", "-c", f"echo ready > {flag}"],
+            "env": [],
+        }]
+        write_cr(cluster, "init1", spec)
+        status = wait_status(cluster, "init1")
+        assert status["phase"] == "Succeeded"
+        log = (cluster / "logs" / "init1" / "init1-main-0.log").read_text()
+        assert "ready" in log
+
+    def test_active_deadline(self, cluster):
+        spec = job_spec("sleep 30")
+        spec["activeDeadlineSeconds"] = 1
+        write_cr(cluster, "slow1", spec)
+        status = wait_status(cluster, "slow1", timeout=30)
+        assert status["phase"] == "Failed"
+        assert "activeDeadlineSeconds" in status["message"]
+
+    def test_stop_via_cr_patch(self, cluster):
+        path = write_cr(cluster, "stop1", job_spec("sleep 30"))
+        wait_status(cluster, "stop1", phases=("Running",))
+        doc = json.loads(path.read_text())
+        doc["operation"]["spec"]["stopped"] = True
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(doc))
+        os.replace(tmp, path)
+        status = wait_status(cluster, "stop1")
+        assert status["phase"] == "Stopped"
+
+    def test_cr_deletion_clears_status(self, cluster):
+        path = write_cr(cluster, "del1", job_spec("sleep 30"))
+        wait_status(cluster, "del1", phases=("Running",))
+        path.unlink()
+        status_path = cluster / "status" / "del1.json"
+        deadline = time.time() + 10
+        while time.time() < deadline and status_path.exists():
+            time.sleep(0.05)
+        assert not status_path.exists()
+
+
+class TestDistributedReconcile:
+    def test_gang_env_stamping(self, cluster):
+        # Two roles x replicas; each pod prints its stamped identity.
+        cmd = ["/bin/sh", "-c",
+               "echo pid=$PTPU_PROCESS_ID role=$PTPU_REPLICA_ROLE "
+               "idx=$PTPU_REPLICA_INDEX coord=$PTPU_COORDINATOR_ADDRESS"]
+        spec = {
+            "runKind": "tpujob",
+            "replicaSpecs": {
+                "coordinator": {"replicas": 1, "template": {"spec": {
+                    "containers": [{"name": "ptpu-main", "command": cmd,
+                                    "env": [{"name": "PTPU_NUM_PROCESSES",
+                                             "value": "3"}]}]}}},
+                "worker": {"replicas": 2, "template": {"spec": {
+                    "containers": [{"name": "ptpu-main", "command": cmd,
+                                    "env": [{"name": "PTPU_NUM_PROCESSES",
+                                             "value": "3"}]}]}}},
+            },
+        }
+        write_cr(cluster, "gang1", spec)
+        status = wait_status(cluster, "gang1")
+        assert status["phase"] == "Succeeded"
+        assert set(status["replicaStatuses"]) == {
+            "gang1-coordinator-0", "gang1-worker-0", "gang1-worker-1"}
+        logs = {}
+        for pod in status["replicaStatuses"]:
+            logs[pod] = (cluster / "logs" / "gang1" /
+                         f"{pod}.log").read_text()
+        # process ids follow replicaSpecs order: coordinator first
+        assert "pid=0 role=coordinator idx=0" in logs["gang1-coordinator-0"]
+        assert "pid=1 role=worker idx=0" in logs["gang1-worker-0"]
+        assert "pid=2 role=worker idx=1" in logs["gang1-worker-1"]
+        coords = {line.split("coord=")[1].strip()
+                  for text in logs.values()
+                  for line in text.splitlines() if "coord=" in line}
+        assert len(coords) == 1  # same coordinator address everywhere
+
+    def test_gang_failure_tears_down_all(self, cluster, tmp_path):
+        marker = tmp_path / "w0.pid"
+        spec = {
+            "runKind": "tpujob",
+            "replicaSpecs": {
+                "worker": {"replicas": 2, "template": {"spec": {
+                    "containers": [{
+                        "name": "ptpu-main",
+                        "command": [
+                            "/bin/sh", "-c",
+                            # replica 0 records itself and sleeps;
+                            # replica 1 fails fast.
+                            f'if [ "$PTPU_REPLICA_INDEX" = "0" ]; then '
+                            f'echo $$ > {marker}; sleep 30; '
+                            f'else exit 7; fi'],
+                        "env": []}]}}},
+            },
+        }
+        write_cr(cluster, "gang2", spec)
+        status = wait_status(cluster, "gang2")
+        assert status["phase"] == "Failed"
+        # the surviving replica was killed with the gang
+        deadline = time.time() + 5
+        pid = int(marker.read_text().strip())
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.05)
+            except ProcessLookupError:
+                break
+        else:
+            pytest.fail("gang survivor still alive after teardown")
+
+    def test_gang_retry_restarts_whole_gang(self, cluster, tmp_path):
+        counter = tmp_path / "count"
+        spec = {
+            "runKind": "tpujob",
+            "backoffLimit": 1,
+            "replicaSpecs": {
+                "worker": {"replicas": 2, "template": {"spec": {
+                    "containers": [{
+                        "name": "ptpu-main",
+                        "command": [
+                            "/bin/sh", "-c",
+                            # fail the first attempt, succeed the second
+                            f'echo x >> {counter}; '
+                            f'n=$(wc -l < {counter}); '
+                            f'[ "$n" -ge 3 ] && exit 0 || exit 1'],
+                        "env": []}]}}},
+            },
+        }
+        write_cr(cluster, "gang3", spec)
+        status = wait_status(cluster, "gang3", timeout=30)
+        assert status["phase"] == "Succeeded"
+        assert status["attempt"] == 1

@@ -1,0 +1,255 @@
+"""Control plane API + agent + schedule tests (SURVEY.md §4: API tests
+against a live local server; scheduler state machines without k8s)."""
+
+import datetime as dt
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.client.api_client import ApiRunStore
+from polyaxon_tpu.client.store import FileRunStore
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.runner.agent import Agent, LocalBackend, ManifestBackend
+from polyaxon_tpu.scheduler import (
+    ControlPlane,
+    Cron,
+    ScheduleService,
+    make_server,
+    next_fire_time,
+)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def store(tmp_home):
+    return FileRunStore()
+
+
+@pytest.fixture
+def api(store):
+    port = _free_port()
+    server = make_server("127.0.0.1", port, store)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield ApiRunStore(f"http://127.0.0.1:{port}")
+    server.shutdown()
+    server.server_close()
+
+
+JOB_CONTENT = {
+    "kind": "operation",
+    "name": "hello",
+    "component": {
+        "kind": "component",
+        "name": "hello",
+        "run": {
+            "kind": "job",
+            "container": {
+                "image": "python",
+                "command": ["python", "-c", "print('hi from job')"],
+            },
+        },
+    },
+}
+
+
+class TestApiServer:
+    def test_run_crud_roundtrip(self, api):
+        record = api.create_run(name="r1", project="proj",
+                                content=JOB_CONTENT)
+        uuid = record["uuid"]
+        assert api.get_run(uuid)["name"] == "r1"
+        api.update_run(uuid, description="desc")
+        assert api.get_run(uuid)["description"] == "desc"
+        runs = api.list_runs(project="proj")
+        assert [r["uuid"] for r in runs] == [uuid]
+        api.delete_run(uuid)
+        runs = api.list_runs(project="proj")
+        assert runs == []
+
+    def test_status_transitions_enforced(self, api):
+        uuid = api.create_run(name="r")["uuid"]
+        assert api.set_status(uuid, V1Statuses.QUEUED)
+        # illegal jump queued -> succeeded is refused
+        assert not api.set_status(uuid, V1Statuses.SUCCEEDED)
+        conditions = api.get_statuses(uuid)
+        assert [c.type for c in conditions] == ["created", "queued"]
+
+    def test_events_metrics_logs(self, api):
+        uuid = api.create_run(name="r")["uuid"]
+        api.append_events(uuid, "metric", "loss", [
+            {"step": 0, "value": 1.0}, {"step": 1, "value": 0.5}])
+        events = api.read_events(uuid, "metric", "loss")
+        assert [e["value"] for e in events] == [1.0, 0.5]
+        assert api.read_events(uuid, "metric", "loss", offset=1) == \
+            [{"step": 1, "value": 0.5}]
+        assert api.last_metrics(uuid) == {"loss": 0.5}
+        assert api.list_events(uuid) == {"metric": ["loss"]}
+        api.append_log(uuid, "line1\n")
+        api.append_log(uuid, "line2\n")
+        assert api.read_logs(uuid) .count("line") == 2
+
+    def test_incremental_log_stream(self, api):
+        uuid = api.create_run(name="r")["uuid"]
+        api.append_log(uuid, "aaa\n")
+        out = api.read_logs_from(uuid, None, 0)
+        assert out["logs"].endswith("aaa\n")
+        mark = out["offset"]
+        api.append_log(uuid, "bbb\n")
+        out = api.read_logs_from(uuid, None, mark)
+        assert "aaa" not in out["logs"] and "bbb" in out["logs"]
+
+    def test_lineage(self, api):
+        uuid = api.create_run(name="r")["uuid"]
+        api.add_lineage(uuid, {"name": "model", "kind": "model",
+                               "path": "outputs/model"})
+        assert api.get_lineage(uuid)[0]["name"] == "model"
+
+    def test_claim_order_and_exhaustion(self, api):
+        u1 = api.create_run(name="a")["uuid"]
+        u2 = api.create_run(name="b")["uuid"]
+        api.set_status(u1, V1Statuses.QUEUED)
+        api.set_status(u2, V1Statuses.QUEUED)
+        first = api.claim("agent-x")
+        assert first["uuid"] == u1
+        assert first["status"] == V1Statuses.SCHEDULED
+        assert api.claim("agent-x")["uuid"] == u2
+        assert api.claim("agent-x") is None
+
+
+class TestAgent:
+    def test_agent_executes_queued_job(self, store):
+        plane = ControlPlane(store)
+        record = store.create_run(name="hello", project="default",
+                                  content=JOB_CONTENT)
+        store.set_status(record["uuid"], V1Statuses.QUEUED)
+        agent = Agent(plane, backend=LocalBackend(store), name="t-agent")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            agent.tick()
+            status = store.get_run(record["uuid"]).get("status")
+            if status in V1Statuses.DONE:
+                break
+            time.sleep(0.05)
+        final = store.get_run(record["uuid"])
+        assert final["status"] == V1Statuses.SUCCEEDED
+        assert final["agent"] == "t-agent"
+        assert "hi from job" in store.read_logs(record["uuid"])
+
+    def test_agent_marks_bad_content_failed(self, store):
+        plane = ControlPlane(store)
+        record = store.create_run(name="bad",
+                                  content={"kind": "operation"})
+        store.set_status(record["uuid"], V1Statuses.QUEUED)
+        agent = Agent(plane, name="t-agent")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            agent.tick()
+            if store.get_run(record["uuid"])["status"] in V1Statuses.DONE:
+                break
+            time.sleep(0.05)
+        assert store.get_run(record["uuid"])["status"] == V1Statuses.FAILED
+
+    def test_manifest_backend_protocol(self, store, tmp_path):
+        cluster = tmp_path / "cluster"
+        plane = ControlPlane(store)
+        backend = ManifestBackend(str(cluster))
+        content = {
+            "kind": "operation",
+            "name": "dist",
+            "component": {
+                "kind": "component",
+                "name": "dist",
+                "run": {
+                    "kind": "tpujob",
+                    "slice": {"type": "v5litepod-8"},
+                    "worker": {"replicas": 2,
+                               "container": {"image": "jax:latest",
+                                             "command": ["python", "t.py"]}},
+                },
+            },
+        }
+        record = store.create_run(name="dist", content=content)
+        store.set_status(record["uuid"], V1Statuses.QUEUED)
+        agent = Agent(plane, backend=backend, name="m-agent")
+        agent.tick()
+        # CR applied to the cluster dir
+        ops_dir = cluster / "operations"
+        files = list(ops_dir.glob("*.json"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["operation"]["spec"]["runKind"] == "tpujob"
+        assert doc["services"], "headless service expected"
+        assert store.get_run(record["uuid"])["status"] == \
+            V1Statuses.STARTING
+        # operator reports success -> agent reaps
+        name = files[0].stem
+        (cluster / "status" / f"{name}.json").write_text(
+            json.dumps({"phase": "Succeeded"}))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            agent.tick()
+            if store.get_run(record["uuid"])["status"] in V1Statuses.DONE:
+                break
+            time.sleep(0.02)
+        assert store.get_run(record["uuid"])["status"] == \
+            V1Statuses.SUCCEEDED
+        # TTL None -> immediate cleanup
+        assert not files[0].exists()
+
+
+class TestSchedules:
+    def test_cron_next(self):
+        cron = Cron("*/15 3 * * *")
+        t = dt.datetime(2026, 7, 29, 2, 50)
+        nxt = cron.next_after(t)
+        assert (nxt.hour, nxt.minute) == (3, 0)
+        assert cron.next_after(nxt).minute == 15
+
+    def test_cron_weekday_sunday_is_zero(self):
+        # cron convention: 0=Sunday. 2026-08-02 is a Sunday.
+        cron = Cron("0 12 * * 0")
+        nxt = cron.next_after(dt.datetime(2026, 7, 29, 0, 0))  # a Wednesday
+        assert nxt == dt.datetime(2026, 8, 2, 12, 0)
+        mon = Cron("0 12 * * 1")
+        assert mon.next_after(dt.datetime(2026, 7, 29, 0, 0)) == \
+            dt.datetime(2026, 8, 3, 12, 0)
+
+    def test_interval_fire_and_exhaust(self):
+        schedule = {"kind": "interval", "frequency": 60, "maxRuns": 2}
+        t0 = 1000.0
+        f1 = next_fire_time(schedule, t0, 0)
+        assert f1 == t0
+        f2 = next_fire_time(schedule, f1, 1)
+        assert f2 == f1 + 60
+        assert next_fire_time(schedule, f2, 2) is None
+
+    def test_schedule_service_materializes_children(self, store):
+        content = dict(JOB_CONTENT)
+        content["schedule"] = {"kind": "interval", "frequency": 0.01,
+                               "maxRuns": 2}
+        controller = store.create_run(name="sched", content=content)
+        store.set_status(controller["uuid"], V1Statuses.ON_SCHEDULE)
+        service = ScheduleService(store)
+        service.tick(now=time.time())            # arms next_at
+        created = service.tick(now=time.time() + 1)
+        assert len(created) == 1
+        created += service.tick(now=time.time() + 2)
+        assert len(created) == 2
+        # exhausted -> controller succeeded, children queued
+        assert store.get_run(controller["uuid"])["status"] == \
+            V1Statuses.SUCCEEDED
+        for uuid in created:
+            child = store.get_run(uuid)
+            assert child["status"] == V1Statuses.QUEUED
+            assert "schedule" not in child["content"]
+            assert child["pipeline"] == controller["uuid"]
