@@ -1,0 +1,155 @@
+"""Checkpoint/resume: Orbax-backed run checkpointing (SURVEY.md 5.4).
+
+The reference is framework-agnostic — user code must load its own
+checkpoints from the outputs store, and ``ops restart/resume`` just
+point a new run at the prior artifacts.  Here checkpointing is a
+first-class runtime service, TPU-style:
+
+- **async saves off the step path** (Orbax background thread) so the
+  training loop never blocks on HBM->host->store transfers;
+- sharding-aware restore: arrays come back with the live mesh's
+  shardings (pass ``abstract_state``/the current state template);
+- ``restore_or_init`` = the auto-resume hook the runner wires when a
+  run is restarted/resumed: latest step wins, empty store -> fresh;
+- preemption-friendly: ``save(..., force=True)`` on SIGTERM via
+  ``install_preemption_hook`` so TPU-slice reclaims lose at most the
+  in-flight step (GKE sends SIGTERM ahead of reclaim).
+
+Layout: ``<run outputs>/checkpoints/<step>/`` — visible to the sidecar
+sync, the lineage plane, and ``ops restart --copy``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+CHECKPOINTS_DIR = "checkpoints"
+
+
+class CheckpointManager:
+    """Thin, typed wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+        run_uuid: Optional[str] = None,
+    ):
+        import orbax.checkpoint as ocp
+
+        if directory is None:
+            directory = default_checkpoint_dir(run_uuid)
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._ocp = ocp
+        self._manager = ocp.CheckpointManager(self.directory,
+                                              options=options)
+
+    # -- save/restore ----------------------------------------------------
+
+    def save(self, step: int, state: Any, *, force: bool = False,
+             metrics: Optional[dict] = None) -> bool:
+        """Queue an (async) save; returns whether a save was started.
+        Idempotent: re-saving an existing step is a no-op, not an error
+        (final forced saves often land on the last periodic step)."""
+        try:
+            saved = self._manager.save(
+                int(step),
+                args=self._ocp.args.StandardSave(state),
+                metrics=metrics,
+                force=force,
+            )
+        except self._ocp.checkpoint_manager.StepAlreadyExistsError:
+            return False
+        return bool(saved)
+
+    def restore(self, step: Optional[int] = None,
+                template: Any = None) -> Any:
+        """Restore a step (default: latest).  ``template`` carries the
+        target structure/shardings (the freshly-initialized state)."""
+        step = int(step) if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"No checkpoints under {self.directory}")
+        if template is not None:
+            import jax
+
+            abstract = jax.tree.map(
+                self._ocp.utils.to_shape_dtype_struct, template)
+            return self._manager.restore(
+                step, args=self._ocp.args.StandardRestore(abstract))
+        return self._manager.restore(step)
+
+    def restore_or_init(self, init_state: Any) -> tuple:
+        """(state, restored_step): auto-resume or fresh start."""
+        step = self.latest_step()
+        if step is None:
+            return init_state, None
+        logger.info("resuming from checkpoint step %s", step)
+        return self.restore(step, template=init_state), step
+
+    # -- introspection ---------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return sorted(self._manager.all_steps())
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+    # -- preemption ------------------------------------------------------
+
+    def install_preemption_hook(self, get_state, get_step) -> None:
+        """SIGTERM -> synchronous forced save (TPU reclaim notice).
+
+        ``get_state``/``get_step`` are callables so the hook always saves
+        the *current* state, not the one at install time.
+        """
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            try:
+                logger.warning("preemption notice: forcing checkpoint")
+                self.save(int(get_step()), get_state(), force=True)
+                self.wait()
+            finally:
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    # SIG_DFL/SIG_IGN are not callable: restore and
+                    # re-raise so the process actually terminates
+                    # (otherwise graceful stops hang until SIGKILL).
+                    signal.signal(signum, prev or signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, handler)
+
+
+def default_checkpoint_dir(run_uuid: Optional[str] = None) -> str:
+    """``<run outputs>/checkpoints`` for the active (or given) run."""
+    from .compiler.contexts import run_outputs_path
+
+    run_uuid = run_uuid or os.environ.get("POLYAXON_TPU_RUN_UUID")
+    if run_uuid:
+        return os.path.join(run_outputs_path(run_uuid), CHECKPOINTS_DIR)
+    return os.path.join(os.getcwd(), CHECKPOINTS_DIR)

@@ -344,10 +344,50 @@ def config():
 
 @config.command(name="show")
 def config_show():
-    from polyaxon_tpu.client.store import default_home
+    import dataclasses
 
+    from polyaxon_tpu.client.store import default_home
+    from polyaxon_tpu.config import ClientConfig
+
+    cfg = ClientConfig.load()
     click.echo(f"home: {default_home()}")
-    click.echo(f"host: {os.environ.get('POLYAXON_TPU_HOST') or '(local mode)'}")
+    for key, value in dataclasses.asdict(cfg).items():
+        if key == "token" and value:
+            value = "****"  # never echo secrets
+        click.echo(f"{key}: {value}")
+
+
+@config.command(name="set")
+@click.argument("pairs", nargs=-1, required=True)
+def config_set(pairs):
+    """Persist config values: ptpu config set host=http://cp:8000."""
+    from polyaxon_tpu.config import ClientConfig
+
+    parsed = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise click.ClickException(f"expected key=value, got {pair!r}")
+        key, _, value = pair.partition("=")
+        parsed[key.strip()] = value
+    try:
+        path = ClientConfig.set_file_values(parsed)
+    except KeyError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"saved {path}")
+
+
+@config.command(name="get")
+@click.argument("key")
+def config_get(key):
+    import dataclasses
+
+    from polyaxon_tpu.config import ClientConfig
+
+    cfg = dataclasses.asdict(ClientConfig.load())
+    if key not in cfg:
+        raise click.ClickException(
+            f"unknown key {key!r}; known: {sorted(cfg)}")
+    click.echo(cfg[key])
 
 
 @cli.command()

@@ -68,6 +68,14 @@ class ConverterConfig:
     artifacts_host_path: Optional[str] = None
     artifacts_root: str = ARTIFACTS_MOUNT
     labels: Dict[str, str] = field(default_factory=dict)
+    catalog: Optional[Any] = None  # connections.ConnectionCatalog
+
+    def get_catalog(self):
+        if self.catalog is None:
+            from ..connections import ConnectionCatalog
+
+            self.catalog = ConnectionCatalog.load()
+        return self.catalog
 
 
 def _labels(config: ConverterConfig, run_uuid: str,
@@ -144,23 +152,53 @@ def _pod_spec(
     collect_logs = not (plugins and plugins.collect_logs is False)
     collect_artifacts = not (plugins and plugins.collect_artifacts is False)
 
+    # Requested connections: volumes + mounts + root-advertising env
+    # (the initializer and user code resolve roots from these).
+    conn_volumes: List[Dict[str, Any]] = []
+    conn_mounts: List[Dict[str, Any]] = []
+    conn_env: List[Dict[str, Any]] = []
+    requested = getattr(section, "connections", None) or []
+    if requested:
+        catalog = config.get_catalog()
+        for conn_name in requested:
+            volume = catalog.volume_for(conn_name)
+            if volume:
+                conn_volumes.append(volume)
+            mount = catalog.mount_for(conn_name)
+            if mount:
+                conn_mounts.append(mount)
+            conn_env.extend(catalog.env_for(conn_name))
+            res_volumes, res_mounts = catalog.resource_volumes_for(conn_name)
+            conn_volumes.extend(res_volumes)
+            conn_mounts.extend(res_mounts)
+
     pod: Dict[str, Any] = {
         "restartPolicy": "Never",
         "containers": [
-            _main_container(section, config, env, tpu_slice=tpu_slice,
-                            shm=shm),
+            _main_container(section, config, env + conn_env,
+                            tpu_slice=tpu_slice, shm=shm,
+                            extra_mounts=conn_mounts),
         ],
         "volumes": get_volumes(
             shm=shm,
             artifacts_claim=config.artifacts_claim,
             artifacts_host_path=config.artifacts_host_path,
-            extra=getattr(section, "volumes", None),
+            extra=(getattr(section, "volumes", None) or []) + conn_volumes,
         ),
     }
 
     inits = get_init_containers(getattr(section, "init", None),
                                 aux_image=config.aux_image)
     if inits:
+        # Init containers resolve connections too (init.connection):
+        # give them the same roots/env and mounts as the main container.
+        for ic in inits:
+            ic_env = list(ic.get("env") or [])
+            present = {e.get("name") for e in ic_env}
+            ic_env.extend(e for e in conn_env
+                          if e.get("name") not in present)
+            ic["env"] = ic_env
+            ic.setdefault("volumeMounts", []).extend(conn_mounts)
         pod["initContainers"] = inits
 
     sidecars = [s.to_dict() for s in (getattr(section, "sidecars", None)

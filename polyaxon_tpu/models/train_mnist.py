@@ -1,0 +1,35 @@
+"""MNIST trainer — BASELINE config 1 entrypoint.
+
+Thin preset over the generic driver (``polyaxon_tpu.train``): the MLP
+classifier on 28x28x1 batches, tracked, checkpointed.  Real MNIST plugs
+in via ``--data-dir`` (inputs.npy/labels.npy); default is the synthetic
+deterministic batch (compute-identical shapes).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..train import build_argparser
+from ..train import main as train_main
+
+
+def main(argv=None) -> int:
+    parser = build_argparser()
+    parser.set_defaults(model="mlp", optimizer="adamw", log_every=10)
+    args = parser.parse_args(argv)
+    forwarded = []
+    for key, value in vars(args).items():
+        flag = "--" + key.replace("_", "-")
+        if isinstance(value, bool):
+            if key == "resume" and not value:
+                forwarded.append("--no-resume")
+            elif value and key != "resume":
+                forwarded.append(flag)
+        elif value is not None:
+            forwarded.extend([flag, str(value)])
+    return train_main(forwarded)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Generic training driver: ``python -m polyaxon_tpu.train --model NAME``.
+
+This is the in-container entrypoint the five BASELINE configs run — the
+piece that ties the runtime together exactly as the north-star demands
+(SURVEY.md 3.2/5.8):
+
+    1. ``parallel.bootstrap.initialize_from_env()``  — multi-host
+       jax.distributed bootstrap from the agent/operator-injected
+       ``PTPU_*`` env (replaces TF_CONFIG/NCCL/MPI);
+    2. mesh from ``--strategy`` (or ``PTPU_STRATEGY`` env) over all
+       connected devices — DP/FSDP/TP axes via the strategy library;
+    3. ``tracking.init()``  — run identity from injected env; stepped
+       metrics (loss, accuracy, throughput img-or-tok/sec/chip);
+    4. Orbax checkpointing with auto-resume + SIGTERM preemption save.
+
+Data is synthetic by default (deterministic; benchmarks measure compute,
+not input pipelines); a ``--data-dir`` of .npy files plugs real arrays
+into the same path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="polyaxon_tpu.train")
+    p.add_argument("--model", default="mlp")
+    p.add_argument("--steps", type=int, default=None,
+                   help="Total optimizer steps (overrides epochs).")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--steps-per-epoch", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="GLOBAL batch size (sharded over dp/fsdp).")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "sgd", "adam"])
+    p.add_argument("--strategy", default=None,
+                   help='Mesh axes JSON, e.g. \'{"dp": -1, "tp": 2}\' '
+                        "(default: PTPU_STRATEGY env, else pure DP).")
+    p.add_argument("--grad-accum", type=int, default=1)
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="Steps between checkpoints (0 = only at end).")
+    p.add_argument("--resume", action="store_true", default=True)
+    p.add_argument("--no-resume", dest="resume", action="store_false")
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data-dir", default=None,
+                   help="Directory of inputs.npy/labels.npy (else "
+                        "synthetic).")
+    p.add_argument("--cpu", action="store_true",
+                   help="Force the CPU backend.")
+    p.add_argument("--target-metric", default=None,
+                   help="name=value: exit once the metric reaches value.")
+    return p
+
+
+def make_optimizer(name: str, lr: float):
+    import optax
+
+    if name == "sgd":
+        return optax.sgd(lr, momentum=0.9)
+    if name == "adam":
+        return optax.adam(lr)
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def load_data(spec, data_dir: Optional[str], batch_size: int):
+    import numpy as np
+
+    if data_dir:
+        inputs = np.load(os.path.join(data_dir, "inputs.npy"))
+        labels_path = os.path.join(data_dir, "labels.npy")
+        batch = {"inputs": inputs[:batch_size]}
+        if os.path.exists(labels_path):
+            batch["labels"] = np.load(labels_path)[:batch_size]
+        return batch
+    return spec.make_batch(batch_size)
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+
+    import jax
+
+    platform = os.environ.get("POLYAXON_TPU_PLATFORM")
+    if args.cpu:
+        platform = "cpu"
+    if platform:
+        # The TPU-tunnel plugin ignores JAX_PLATFORMS; the live config
+        # works when set before first backend use.
+        jax.config.update("jax_platforms", platform)
+
+    # 1. multi-host bootstrap from injected topology env (no-op when the
+    #    run is single-process).
+    from .parallel.bootstrap import initialize_from_env
+
+    topology = initialize_from_env()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .checkpoint import CheckpointManager
+    from .models.registry import get_model
+    from .parallel import MeshSpec, build_mesh, make_train_step
+    from . import tracking
+
+    # 2. mesh from the strategy spec.
+    strategy_raw = args.strategy or os.environ.get("PTPU_STRATEGY")
+    strategy = json.loads(strategy_raw) if strategy_raw else {}
+    mesh = build_mesh(MeshSpec.from_dict(strategy))
+    n_chips = mesh.devices.size
+
+    spec = get_model(args.model)
+    batch_size = args.batch_size or spec.default_batch_size
+    data_axes = max(1, mesh.shape["dp"] * mesh.shape["fsdp"])
+    if batch_size % data_axes:
+        batch_size = data_axes * max(1, batch_size // data_axes)
+
+    model, params = spec.init_params(batch_size=2, seed=args.seed)
+    step_fn = make_train_step(
+        spec.loss_fn(model), make_optimizer(args.optimizer, args.lr),
+        mesh, grad_accum=args.grad_accum, donate=False)
+    state = step_fn.init_state(params)
+
+    # 3. tracking: attaches to the managed run (env) or creates one.
+    run = tracking.init(name=f"train-{args.model}")
+    run.log_inputs(model=args.model, lr=args.lr, batch_size=batch_size,
+                   strategy=strategy or {"dp": -1},
+                   n_chips=int(n_chips),
+                   backend=jax.default_backend())
+
+    # 4. checkpointing with auto-resume.
+    ckpt = CheckpointManager(run_uuid=run.client.run_uuid)
+    start_step = 0
+    if args.resume:
+        state, restored = ckpt.restore_or_init(state)
+        start_step = int(restored or 0)
+    ckpt.install_preemption_hook(lambda: state,
+                                 lambda: int(state["step"]))
+
+    total_steps = args.steps or args.epochs * args.steps_per_epoch
+    batch = load_data(spec, args.data_dir, batch_size)
+    batch = jax.device_put(batch, step_fn.batch_sharding)
+    rng = jax.random.PRNGKey(args.seed)
+
+    target = None
+    if args.target_metric and "=" in args.target_metric:
+        tname, _, tval = args.target_metric.partition("=")
+        target = (tname.strip(), float(tval))
+
+    unit = "tok" if "inputs" in batch and batch["inputs"].ndim == 2 \
+        else "img"
+    per_batch = int(np.prod(batch["inputs"].shape[:2])) \
+        if unit == "tok" else batch_size
+
+    last_metrics: Dict[str, Any] = {}
+    t_block = time.perf_counter()
+    block_start = start_step
+    for step in range(start_step, total_steps):
+        rng, step_rng = jax.random.split(rng)
+        state, metrics = step_fn(state, batch, step_rng)
+        if args.checkpoint_every and (step + 1) % args.checkpoint_every == 0:
+            ckpt.save(step + 1, state)  # async; off the step path
+        if (step + 1) % args.log_every == 0 or step + 1 == total_steps:
+            metrics = {k: float(v) for k, v in metrics.items()}
+            dt = time.perf_counter() - t_block
+            done = step + 1 - block_start
+            throughput = per_batch * done / dt / n_chips
+            metrics[f"{unit}_per_sec_per_chip"] = round(throughput, 2)
+            run.log_metrics(step=step + 1, **metrics)
+            print(f"step {step + 1}/{total_steps} "
+                  + " ".join(f"{k}={v:.4g}" for k, v in metrics.items()),
+                  flush=True)
+            last_metrics = metrics
+            t_block = time.perf_counter()
+            block_start = step + 1
+            if target and target[0] in metrics and \
+                    metrics[target[0]] >= target[1]:
+                print(f"target {target[0]}>={target[1]} reached", flush=True)
+                break
+
+    ckpt.save(int(state["step"]), state, force=True)
+    ckpt.wait()
+    ckpt.close()
+    for key, value in last_metrics.items():
+        if key in ("accuracy", "loss", "perplexity"):
+            run.log_outputs(**{key: value})
+    run.end("succeeded")
+    if topology and topology.is_distributed:
+        jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

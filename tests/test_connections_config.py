@@ -1,0 +1,212 @@
+"""Connections catalog + client config layering tests (SURVEY.md 2.13/2.15)."""
+
+import json
+
+import pytest
+import yaml
+
+from polyaxon_tpu.compiler import resolve
+from polyaxon_tpu.config import ClientConfig
+from polyaxon_tpu.connections import (
+    ConnectionCatalog,
+    ConnectionKind,
+    V1Connection,
+    fs_adapter,
+)
+from polyaxon_tpu.k8s import ConverterConfig, convert
+from polyaxon_tpu.polyaxonfile import get_op_from_files
+
+
+class TestConnectionSchemas:
+    def test_typed_schema_roundtrip(self):
+        conn = V1Connection.from_dict({
+            "name": "datasets",
+            "kind": "host_path",
+            "schema": {"hostPath": "/mnt/data", "mountPath": "/data"},
+        })
+        schema = conn.typed_schema()
+        assert schema.host_path == "/mnt/data"
+        assert conn.is_artifact_store
+        assert conn.store_root() == "/mnt/data"
+        assert conn.env_name() == "POLYAXON_TPU_CONNECTION_DATASETS_ROOT"
+
+    def test_bucket_roots(self):
+        gcs = V1Connection(name="b", kind="gcs",
+                           schema_={"bucket": "my-bucket"})
+        assert gcs.store_root() == "gs://my-bucket"
+        s3 = V1Connection(name="b2", kind="s3",
+                          schema_={"bucket": "s3://explicit"})
+        assert s3.store_root() == "s3://explicit"
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            V1Connection(name="x", kind="ftp")
+
+
+class TestCatalog:
+    def test_load_from_yaml(self, tmp_path, monkeypatch):
+        path = tmp_path / "connections.yaml"
+        path.write_text(yaml.safe_dump({"connections": [
+            {"name": "outputs", "kind": "volume_claim",
+             "schema": {"volumeClaim": "pvc-out", "mountPath": "/out"}},
+            {"name": "slack-alerts", "kind": "slack",
+             "schema": {"url": "https://hooks.slack.example/x"},
+             "secret": {"name": "slack-secret", "items": ["SLACK_TOKEN"]}},
+        ]}))
+        monkeypatch.setenv("POLYAXON_TPU_CONNECTIONS_FILE", str(path))
+        catalog = ConnectionCatalog.load()
+        assert catalog.names() == ["outputs", "slack-alerts"]
+        assert catalog.volume_for("outputs") == {
+            "name": "conn-outputs",
+            "persistentVolumeClaim": {"claimName": "pvc-out"}}
+        assert catalog.mount_for("outputs")["mountPath"] == "/out"
+        env = catalog.env_for("slack-alerts")
+        assert env[0]["valueFrom"]["secretKeyRef"] == {
+            "name": "slack-secret", "key": "SLACK_TOKEN"}
+
+    def test_unknown_connection_raises(self):
+        with pytest.raises(KeyError):
+            ConnectionCatalog().get("nope")
+
+
+class TestConverterIntegration:
+    def test_connections_mounted_into_pod(self, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text("""
+kind: component
+name: train
+run:
+  kind: job
+  connections: [datasets]
+  container: {image: jax:latest, command: [python, t.py]}
+""")
+        catalog = ConnectionCatalog([V1Connection(
+            name="datasets", kind="host_path",
+            schema_={"host_path": "/mnt/data"})])
+        op = get_op_from_files(str(spec))
+        compiled = resolve(op, run_uuid="c1")
+        cr = convert(compiled, "c1", config=ConverterConfig(catalog=catalog))
+        pod = cr["spec"]["template"]["spec"]
+        assert {"name": "conn-datasets",
+                "hostPath": {"path": "/mnt/data"}} in pod["volumes"]
+        main = pod["containers"][0]
+        assert any(m["name"] == "conn-datasets"
+                   for m in main["volumeMounts"])
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["POLYAXON_TPU_CONNECTION_DATASETS_ROOT"] == "/mnt/data"
+
+
+class TestConverterConnectionDetails:
+    def test_init_containers_get_connection_env_and_mounts(self, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text("""
+kind: component
+name: train
+run:
+  kind: job
+  connections: [datasets]
+  init:
+    - artifacts: {dirs: [train]}
+      connection: datasets
+  container: {image: jax:latest, command: [python, t.py]}
+""")
+        catalog = ConnectionCatalog([V1Connection(
+            name="datasets", kind="host_path",
+            schema_={"host_path": "/mnt/data"})])
+        op = get_op_from_files(str(spec))
+        compiled = resolve(op, run_uuid="c2")
+        cr = convert(compiled, "c2", config=ConverterConfig(catalog=catalog))
+        init = cr["spec"]["template"]["spec"]["initContainers"][0]
+        env = {e["name"]: e.get("value") for e in init["env"]}
+        assert env["POLYAXON_TPU_CONNECTION_DATASETS_ROOT"] == "/mnt/data"
+        assert any(m["name"] == "conn-datasets"
+                   for m in init["volumeMounts"])
+
+    def test_secret_mount_materialized(self, tmp_path):
+        spec = tmp_path / "job.yaml"
+        spec.write_text("""
+kind: component
+name: train
+run:
+  kind: job
+  connections: [bucket]
+  container: {image: jax:latest, command: [python, t.py]}
+""")
+        catalog = ConnectionCatalog([V1Connection(
+            name="bucket", kind="gcs", schema_={"bucket": "b"},
+            secret={"name": "gcp-sa", "mount_path": "/secrets/gcp"})])
+        op = get_op_from_files(str(spec))
+        compiled = resolve(op, run_uuid="c3")
+        cr = convert(compiled, "c3", config=ConverterConfig(catalog=catalog))
+        pod = cr["spec"]["template"]["spec"]
+        assert {"name": "secret-gcp-sa",
+                "secret": {"secretName": "gcp-sa"}} in pod["volumes"]
+        main = pod["containers"][0]
+        assert {"name": "secret-gcp-sa", "mountPath": "/secrets/gcp",
+                "readOnly": True} in main["volumeMounts"]
+
+
+class TestFsAdapter:
+    def test_local_roundtrip(self, tmp_path):
+        fs = fs_adapter(str(tmp_path / "store"))
+        with fs.open("a/b.txt", "w") as f:
+            f.write("payload")
+        assert fs.exists("a/b.txt")
+        with fs.open("a/b.txt") as f:
+            assert f.read() == "payload"
+        assert fs.listdir("a") == ["b.txt"]
+        local = tmp_path / "dl.txt"
+        fs.download("a/b.txt", str(local))
+        assert local.read_text() == "payload"
+
+    def test_remote_scheme_requires_fsspec(self):
+        try:
+            import fsspec  # noqa: F401
+            pytest.skip("fsspec present; gate not exercised")
+        except ImportError:
+            pass
+        with pytest.raises(RuntimeError, match="fsspec"):
+            fs_adapter("gs://bucket/path")
+
+
+class TestClientConfig:
+    def test_layering_env_over_file(self, tmp_home, monkeypatch):
+        cfg = ClientConfig.load()
+        cfg.host = "http://from-file:8000"
+        cfg.default_slice_type = "v5litepod-16"
+        cfg.save()
+        loaded = ClientConfig.load()
+        assert loaded.host == "http://from-file:8000"
+        assert loaded.default_slice_type == "v5litepod-16"
+        monkeypatch.setenv("POLYAXON_TPU_HOST", "http://from-env:9000")
+        monkeypatch.setenv("POLYAXON_TPU_DEBUG", "true")
+        layered = ClientConfig.load()
+        assert layered.host == "http://from-env:9000"
+        assert layered.debug is True
+        # explicit kwargs win over everything
+        top = ClientConfig.load(host="http://explicit")
+        assert top.host == "http://explicit"
+
+    def test_strategy_json_coercion(self, tmp_home, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_DEFAULT_STRATEGY",
+                           '{"dp": -1, "tp": 4}')
+        cfg = ClientConfig.load()
+        assert cfg.default_strategy == {"dp": -1, "tp": 4}
+
+    def test_set_value_validation(self, tmp_home):
+        cfg = ClientConfig.load()
+        with pytest.raises(KeyError):
+            cfg.set_value("bogus", "1")
+        cfg.set_value("timeout", "12.5")
+        assert cfg.timeout == 12.5
+
+    def test_set_file_values_never_freezes_env(self, tmp_home,
+                                               monkeypatch):
+        # An exported token/host must NOT be persisted by `config set`.
+        monkeypatch.setenv("POLYAXON_TPU_HOST", "http://transient:1")
+        monkeypatch.setenv("POLYAXON_TPU_AUTH_TOKEN", "s3cret")
+        ClientConfig.set_file_values({"project": "proj-a"})
+        stored = ClientConfig.read_file_layer()
+        assert stored == {"project": "proj-a"}
+        with pytest.raises(KeyError):
+            ClientConfig.set_file_values({"bogus": "x"})
