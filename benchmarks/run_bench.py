@@ -1,0 +1,203 @@
+"""Benchmark harness: model throughput + scaling efficiency.
+
+Measures the BASELINE metrics (SURVEY.md §6):
+
+- ``--suite models``:  train-step throughput (img-or-tok/sec/chip) per
+  zoo model on the attached backend;
+- ``--suite scaling``: DP scaling efficiency over growing mesh sizes —
+  on real hardware this is the 8->256-chip ResNet-50 number; on a
+  virtual CPU mesh it validates the methodology (weak scaling: global
+  batch grows with the mesh, per-chip work constant, efficiency =
+  per-chip throughput vs the 1-device run);
+- ``--suite attention``: ring/Ulysses sequence-parallel attention
+  step latency vs full attention at growing sequence lengths.
+
+Each measurement prints one JSON line and everything lands in
+``results.jsonl`` for cross-round comparison.
+
+Usage: python benchmarks/run_bench.py --suite models --models mlp,convnet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def sync(x):
+    """Host-transfer sync (reliable even where block_until_ready isn't)."""
+    import jax
+
+    return jax.device_get(jax.tree.leaves(x)[0])
+
+
+def time_steps(step_fn, state, batch, rng, steps: int, warmup: int = 3):
+    import jax
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch, rng)
+    sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch, rng)
+    sync(metrics["loss"])
+    return (time.perf_counter() - t0) / steps, state
+
+
+def bench_model(name: str, batch_size=None, steps=10, devices=None):
+    import jax
+    import numpy as np
+    import optax
+
+    from polyaxon_tpu.models.registry import get_model
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+
+    spec = get_model(name)
+    mesh = build_mesh(MeshSpec(dp=-1), devices=devices)
+    n = mesh.devices.size
+    batch_size = batch_size or spec.default_batch_size
+    batch_size = max(n, (batch_size // n) * n)
+
+    model, params = spec.init_params(batch_size=2)
+    step_fn = make_train_step(spec.loss_fn(model),
+                              optax.sgd(0.1, momentum=0.9), mesh,
+                              donate=False)
+    state = step_fn.init_state(params)
+    batch = spec.make_batch(batch_size)
+    batch = jax.device_put(batch, step_fn.batch_sharding)
+    rng = jax.random.PRNGKey(0)
+
+    sec_per_step, _ = time_steps(step_fn, state, batch, rng, steps)
+    inputs = batch["inputs"]
+    per_batch = int(np.prod(inputs.shape[:2])) if inputs.ndim == 2 \
+        else batch_size
+    unit = "tok" if inputs.ndim == 2 else "img"
+    return {
+        "bench": "model",
+        "model": name,
+        "backend": jax.default_backend(),
+        "devices": int(n),
+        "batch_global": int(batch_size),
+        "sec_per_step": round(sec_per_step, 5),
+        "throughput_per_chip": round(per_batch / sec_per_step / n, 2),
+        "unit": f"{unit}/sec/chip",
+    }
+
+
+def bench_scaling(name: str, per_chip_batch=8, steps=10):
+    """Weak-scaling DP efficiency across mesh sizes 1..all devices."""
+    import jax
+
+    devices = jax.devices()
+    sizes = [s for s in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+             if s <= len(devices)]
+    results = []
+    base = None
+    for n in sizes:
+        r = bench_model(name, batch_size=per_chip_batch * n, steps=steps,
+                        devices=devices[:n])
+        if base is None:
+            base = r["throughput_per_chip"]
+        r["bench"] = "scaling"
+        r["scaling_efficiency"] = round(
+            r["throughput_per_chip"] / base, 4) if base else None
+        results.append(r)
+    return results
+
+
+def bench_attention(seq_lengths=(1024, 2048, 4096), heads=8, dim=64,
+                    batch=1, steps=5):
+    """Sequence-parallel attention vs full attention latency."""
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.parallel import (
+        MeshSpec, build_mesh, ring_attention, ulysses_attention)
+    from polyaxon_tpu.ops.attention import dot_product_attention
+
+    n = len(jax.devices())
+    sp = n if n & (n - 1) == 0 else 1
+    mesh = build_mesh(MeshSpec(dp=1, sp=sp))
+    out = []
+    for seq in seq_lengths:
+        q = jnp.ones((batch, seq, heads, dim), jnp.float32)
+
+        def run(fn):
+            jitted = jax.jit(fn)
+            y = jitted(q)
+            sync(y)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                y = jitted(q)
+            sync(y)
+            return (time.perf_counter() - t0) / steps
+
+        full = run(lambda x: dot_product_attention(x, x, x, causal=True))
+        with mesh:
+            ring = run(lambda x: ring_attention(x, x, x, mesh, causal=True))
+            uly = run(lambda x: ulysses_attention(x, x, x, mesh,
+                                                  causal=True))
+        out.append({
+            "bench": "attention",
+            "backend": jax.default_backend(),
+            "seq": seq, "sp": int(mesh.shape["sp"]),
+            "full_ms": round(full * 1e3, 3),
+            "ring_ms": round(ring * 1e3, 3),
+            "ulysses_ms": round(uly * 1e3, 3),
+        })
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--suite", default="models",
+                        choices=["models", "scaling", "attention"])
+    parser.add_argument("--models", default="mlp,convnet,resnet50-tiny")
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--cpu-devices", type=int, default=0,
+                        help="Force N virtual CPU devices.")
+    parser.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results.jsonl"))
+    args = parser.parse_args()
+
+    if args.cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.cpu_devices}").strip()
+        args.cpu = True
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.suite == "models":
+        results = [bench_model(m.strip(), batch_size=args.batch,
+                               steps=args.steps)
+                   for m in args.models.split(",") if m.strip()]
+    elif args.suite == "scaling":
+        results = bench_scaling(args.models.split(",")[0].strip(),
+                                steps=args.steps)
+    else:
+        results = bench_attention(steps=args.steps)
+
+    stamp = time.time()
+    with open(args.out, "a") as f:
+        for r in results:
+            r["ts"] = stamp
+            print(json.dumps(r))
+            f.write(json.dumps(r) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

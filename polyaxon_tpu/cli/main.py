@@ -179,10 +179,53 @@ def _get_run_or_fail(run_uuid: str) -> Dict[str, Any]:
 @click.argument("run_uuid")
 @click.option("--replica", default=None)
 @click.option("--tail", default=None, type=int)
-def ops_logs(run_uuid, replica, tail):
-    """Print a run's logs."""
+@click.option("--follow", "-f", is_flag=True, default=False,
+              help="Stream new log lines until the run finishes.")
+def ops_logs(run_uuid, replica, tail, follow):
+    """Print (or follow) a run's logs."""
+    import time as _time
+
+    from polyaxon_tpu.lifecycle import is_done
+    from polyaxon_tpu.scheduler.api import ControlPlane
+
     _get_run_or_fail(run_uuid)
-    click.echo(_store().read_logs(run_uuid, replica=replica, tail=tail))
+    store = _store()
+    if not follow:
+        click.echo(store.read_logs(run_uuid, replica=replica, tail=tail))
+        return
+    # Per-replica offset streaming (offsets are per file, so multiple
+    # replicas can't shift each other's positions).  API store speaks
+    # the protocol natively; the file store goes through an in-process
+    # ControlPlane shim.
+    reader = store if hasattr(store, "read_logs_multi") else \
+        ControlPlane(store)
+    offsets: Dict[str, int] = {}
+
+    def drain() -> None:
+        out = reader.read_logs_multi(run_uuid, offsets)
+        replicas = out.get("replicas", {})
+        many = len(replicas) > 1 or (replica is None and len(offsets) > 1)
+        for rep in sorted(replicas):
+            if replica is not None and rep != replica:
+                offsets[rep] = replicas[rep]["offset"]
+                continue
+            chunk = replicas[rep]["logs"]
+            offsets[rep] = replicas[rep]["offset"]
+            if not chunk:
+                continue
+            if many:
+                for line in chunk.splitlines():
+                    click.echo(f"[{rep}] {line}")
+            else:
+                click.echo(chunk, nl=False)
+
+    while True:
+        drain()
+        status = store.get_run(run_uuid).get("status")
+        if is_done(status):
+            drain()  # final read: lines flushed just before completion
+            break
+        _time.sleep(1.0)
 
 
 @ops.command(name="statuses")
@@ -400,6 +443,72 @@ def version():
         click.echo(f"jax {jax.__version__}")
     except ImportError:
         pass
+
+
+# ---------------------------------------------------------------------------
+# project
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def project():
+    """Inspect projects (namespaces grouping runs)."""
+
+
+@project.command(name="ls")
+def project_ls():
+    from collections import Counter
+
+    counts = Counter(r.get("project") or "default"
+                     for r in _store().list_runs())
+    for name, n in sorted(counts.items()):
+        click.echo(f"{name:<24} {n} runs")
+
+
+@project.command(name="runs")
+@click.argument("name")
+@click.option("--limit", default=20, type=int)
+def project_runs(name, limit):
+    for r in _store().list_runs(project=name, limit=limit):
+        click.echo(f"{r['uuid']}  {r.get('status', ''):<10} "
+                   f"{r.get('name', '')}")
+
+
+# ---------------------------------------------------------------------------
+# admin
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def admin():
+    """Deployment management."""
+
+
+@admin.command(name="deploy")
+@click.option("--namespace", default="polyaxon-tpu")
+@click.option("--image", default="polyaxon-tpu/core:latest")
+@click.option("--operator-image", default="polyaxon-tpu/operator:latest")
+@click.option("--artifacts-claim", default=None)
+@click.option("-o", "--output", default="-",
+              help="Write manifests to a file ('-' = stdout).")
+def admin_deploy(namespace, image, operator_image, artifacts_claim, output):
+    """Render the k8s manifests for a full deployment (CRD, RBAC,
+    control plane, agent, native operator)."""
+    import yaml as _yaml
+
+    from polyaxon_tpu.deploy import DeploymentConfig, render_all
+
+    manifests = render_all(DeploymentConfig(
+        namespace=namespace, image=image, operator_image=operator_image,
+        artifacts_claim=artifacts_claim))
+    text = "---\n".join(_yaml.safe_dump(m, sort_keys=False)
+                        for m in manifests)
+    if output == "-":
+        click.echo(text)
+    else:
+        with open(output, "w") as f:
+            f.write(text)
+        click.echo(f"wrote {len(manifests)} manifests to {output}")
 
 
 # ---------------------------------------------------------------------------

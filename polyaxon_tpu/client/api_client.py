@@ -139,6 +139,13 @@ class ApiRunStore:
             "replica": replica, "offset": offset,
         }) or {"logs": "", "offset": offset}
 
+    def read_logs_multi(self, run_uuid: str,
+                        offsets: Dict[str, int]) -> Dict[str, Any]:
+        """Per-replica incremental reads (the `ops logs --follow` path)."""
+        return self._request("GET", f"/runs/{run_uuid}/logs", params={
+            "offsets": json.dumps(offsets),
+        }) or {"replicas": {}}
+
     def add_lineage(self, run_uuid: str, record: Dict[str, Any]) -> None:
         self._request("POST", f"/runs/{run_uuid}/lineage", body=record)
 

@@ -1,0 +1,226 @@
+"""Deployment manifest generation: ``ptpu admin deploy``.
+
+Parity: reference deploy/config subsystem (SURVEY.md 2.15 — helm charts
++ ``polyaxon deploy``; expected at ``polyaxon/_deploy/``, unverified).
+No helm here: a typed ``DeploymentConfig`` renders the exact k8s
+manifests for the three services this framework runs in-cluster —
+control plane (API+scheduler), agent, and the native operator — plus
+the Operation CRD, RBAC, and the auth secret skeleton.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class DeploymentConfig:
+    namespace: str = "polyaxon-tpu"
+    image: str = "polyaxon-tpu/core:latest"
+    operator_image: str = "polyaxon-tpu/operator:latest"
+    api_port: int = 8000
+    replicas_api: int = 1
+    agent_name: str = "agent-0"
+    artifacts_claim: Optional[str] = None
+    service_account: str = "polyaxon-tpu"
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+def _meta(name: str, config: DeploymentConfig) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "namespace": config.namespace,
+        "labels": {"app.kubernetes.io/name": name,
+                   "app.kubernetes.io/part-of": "polyaxon-tpu"},
+    }
+
+
+def _env_list(config: DeploymentConfig,
+              extra: Optional[Dict[str, str]] = None) -> List[Dict[str, str]]:
+    env = {**config.env, **(extra or {})}
+    return [{"name": k, "value": v} for k, v in sorted(env.items())]
+
+
+def crd() -> Dict[str, Any]:
+    """The Operation CRD the native operator reconciles."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "operations.core.polyaxon-tpu.io"},
+        "spec": {
+            "group": "core.polyaxon-tpu.io",
+            "names": {"kind": "Operation", "plural": "operations",
+                      "singular": "operation", "shortNames": ["op"]},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": "v1",
+                "served": True,
+                "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {"spec": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    }},
+                }},
+                "subresources": {"status": {}},
+            }],
+        },
+    }
+
+
+def rbac(config: DeploymentConfig) -> List[Dict[str, Any]]:
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": _meta(config.service_account, config)},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+         "metadata": _meta("polyaxon-tpu-role", config),
+         "rules": [
+             {"apiGroups": ["core.polyaxon-tpu.io"],
+              "resources": ["operations", "operations/status"],
+              "verbs": ["*"]},
+             {"apiGroups": [""],
+              "resources": ["pods", "pods/log", "services", "events",
+                            "secrets", "configmaps"],
+              "verbs": ["get", "list", "watch", "create", "delete",
+                        "patch"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+         "metadata": _meta("polyaxon-tpu-rolebinding", config),
+         "subjects": [{"kind": "ServiceAccount",
+                       "name": config.service_account,
+                       "namespace": config.namespace}],
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "Role", "name": "polyaxon-tpu-role"}},
+    ]
+
+
+def control_plane(config: DeploymentConfig) -> List[Dict[str, Any]]:
+    deployment = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("polyaxon-tpu-api", config),
+        "spec": {
+            "replicas": config.replicas_api,
+            "selector": {"matchLabels":
+                         {"app.kubernetes.io/name": "polyaxon-tpu-api"}},
+            "template": {
+                "metadata": {"labels":
+                             {"app.kubernetes.io/name":
+                              "polyaxon-tpu-api"}},
+                "spec": {
+                    "serviceAccountName": config.service_account,
+                    "containers": [{
+                        "name": "api",
+                        "image": config.image,
+                        "command": ["python", "-m", "polyaxon_tpu.cli",
+                                    "server", "--host", "0.0.0.0",
+                                    "--port", str(config.api_port)],
+                        "ports": [{"containerPort": config.api_port}],
+                        "env": _env_list(config),
+                        "readinessProbe": {"httpGet": {
+                            "path": "/api/v1/healthz",
+                            "port": config.api_port}},
+                    }],
+                    "volumes": [],
+                },
+            },
+        },
+    }
+    if config.artifacts_claim:
+        deployment["spec"]["template"]["spec"]["volumes"].append({
+            "name": "artifacts",
+            "persistentVolumeClaim":
+                {"claimName": config.artifacts_claim}})
+        deployment["spec"]["template"]["spec"]["containers"][0][
+            "volumeMounts"] = [{"name": "artifacts",
+                                "mountPath": "/ptpu-artifacts"}]
+    service = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta("polyaxon-tpu-api", config),
+        "spec": {
+            "selector": {"app.kubernetes.io/name": "polyaxon-tpu-api"},
+            "ports": [{"port": config.api_port,
+                       "targetPort": config.api_port}],
+        },
+    }
+    return [deployment, service]
+
+
+def agent(config: DeploymentConfig) -> List[Dict[str, Any]]:
+    host = f"http://polyaxon-tpu-api.{config.namespace}:{config.api_port}"
+    return [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("polyaxon-tpu-agent", config),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels":
+                         {"app.kubernetes.io/name": "polyaxon-tpu-agent"}},
+            "template": {
+                "metadata": {"labels":
+                             {"app.kubernetes.io/name":
+                              "polyaxon-tpu-agent"}},
+                "spec": {
+                    "serviceAccountName": config.service_account,
+                    "containers": [{
+                        "name": "agent",
+                        "image": config.image,
+                        "command": ["python", "-m", "polyaxon_tpu.cli",
+                                    "agent", "--name", config.agent_name,
+                                    "--backend", "manifest",
+                                    "--cluster-dir", "/ptpu-cluster"],
+                        "env": _env_list(config,
+                                         {"POLYAXON_TPU_HOST": host}),
+                        "volumeMounts": [{"name": "cluster",
+                                          "mountPath": "/ptpu-cluster"}],
+                    }],
+                    "volumes": [{"name": "cluster", "emptyDir": {}}],
+                },
+            },
+        },
+    }]
+
+
+def operator(config: DeploymentConfig) -> List[Dict[str, Any]]:
+    return [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("polyaxon-tpu-operator", config),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels":
+                         {"app.kubernetes.io/name":
+                          "polyaxon-tpu-operator"}},
+            "template": {
+                "metadata": {"labels":
+                             {"app.kubernetes.io/name":
+                              "polyaxon-tpu-operator"}},
+                "spec": {
+                    "serviceAccountName": config.service_account,
+                    "containers": [{
+                        "name": "operator",
+                        "image": config.operator_image,
+                        "command": ["/ptpu-operator", "--cluster-dir",
+                                    "/ptpu-cluster"],
+                        "volumeMounts": [{"name": "cluster",
+                                          "mountPath": "/ptpu-cluster"}],
+                    }],
+                    "volumes": [{"name": "cluster", "emptyDir": {}}],
+                },
+            },
+        },
+    }]
+
+
+def render_all(config: Optional[DeploymentConfig] = None
+               ) -> List[Dict[str, Any]]:
+    config = config or DeploymentConfig()
+    manifests: List[Dict[str, Any]] = [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": config.namespace}},
+        crd(),
+    ]
+    manifests += rbac(config)
+    manifests += control_plane(config)
+    manifests += agent(config)
+    manifests += operator(config)
+    return manifests

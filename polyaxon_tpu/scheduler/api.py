@@ -104,6 +104,38 @@ class ControlPlane:
         return {"logs": chunk.decode(errors="replace"),
                 "offset": len(blob)}
 
+    def read_logs_multi(self, run_uuid: str,
+                        offsets: Dict[str, int]) -> Dict[str, Any]:
+        """Per-replica incremental reads — the `--follow` protocol.
+
+        ``offsets``: replica -> byte offset already delivered.  Returns
+        {"replicas": {replica: {"logs": new_text, "offset": new_off}}}.
+        Offsets are per-file, so multi-replica streams never shift.
+        """
+        import os
+
+        logs_dir = os.path.join(self.store.run_path(run_uuid), "logs")
+        out: Dict[str, Any] = {}
+        if os.path.isdir(logs_dir):
+            for fname in sorted(os.listdir(logs_dir)):
+                if not fname.endswith(".log"):
+                    continue
+                replica = fname[:-4]
+                offset = int(offsets.get(replica, 0))
+                path = os.path.join(logs_dir, fname)
+                try:
+                    size = os.path.getsize(path)
+                    if offset > size:
+                        offset = 0  # truncated/rotated: restart
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read()
+                except OSError:
+                    continue
+                out[replica] = {"logs": chunk.decode(errors="replace"),
+                                "offset": offset + len(chunk)}
+        return {"replicas": out}
+
 
 def _json_response(handler: BaseHTTPRequestHandler, code: int,
                    payload: Any) -> None:
@@ -250,6 +282,9 @@ class _Handler(BaseHTTPRequestHandler):
         return {"ok": True}
 
     def _h_read_logs(self, body, params, u):
+        if "offsets" in params:
+            offsets = json.loads(params["offsets"]) or {}
+            return self.plane.read_logs_multi(u, offsets)
         if "offset" in params:
             return self.plane.read_logs_from(
                 u, params.get("replica"), int(params["offset"]))

@@ -13,6 +13,7 @@ opts replicas in; their series get a ``/p{id}`` suffix).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -302,6 +303,45 @@ class Run:
         self._writer.add(EventKind.DATAFRAME, name + self._suffix,
                          artifact_event(dest, kind=EventKind.DATAFRAME,
                                         step=step))
+
+    # -- profiling (SURVEY.md 5.1: jax.profiler capture as a tracked
+    # artifact; replaces the reference's pynvml-only story) -------------
+
+    def start_profiler_trace(self) -> Optional[str]:
+        """Begin a jax.profiler trace into the run's artifact tree.
+        View with TensorBoard (a `tensorboard` service/init kind)."""
+        if not self._tracks:
+            return None
+        import jax
+
+        trace_dir = os.path.join(self.client.get_artifacts_path(),
+                                 "traces")
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        self._trace_dir = trace_dir
+        return trace_dir
+
+    def stop_profiler_trace(self, step: Optional[int] = None) -> None:
+        if not getattr(self, "_trace_dir", None):
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        trace_dir, self._trace_dir = self._trace_dir, None
+        self._writer.add(EventKind.ARTIFACT, "profiler_trace" + self._suffix,
+                         artifact_event(trace_dir, kind=EventKind.ARTIFACT,
+                                        step=step))
+        self.client.log_artifact_lineage("profiler_trace", "trace",
+                                         trace_dir)
+
+    @contextlib.contextmanager
+    def profiler_trace(self, step: Optional[int] = None):
+        """Context manager: ``with run.profiler_trace(): step_fn(...)``."""
+        self.start_profiler_trace()
+        try:
+            yield
+        finally:
+            self.stop_profiler_trace(step=step)
 
     def get_metrics(self, name: str) -> List[Dict[str, Any]]:
         return self.client.get_metrics(name)

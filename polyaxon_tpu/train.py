@@ -49,6 +49,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true", default=True)
     p.add_argument("--no-resume", dest="resume", action="store_false")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--profile-at", type=int, default=0,
+                   help="Capture a jax.profiler trace starting at this "
+                        "step (0 = off).")
+    p.add_argument("--profile-steps", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--data-dir", default=None,
                    help="Directory of inputs.npy/labels.npy (else "
@@ -163,8 +167,14 @@ def main(argv=None) -> int:
     t_block = time.perf_counter()
     block_start = start_step
     for step in range(start_step, total_steps):
+        if args.profile_at and step == args.profile_at:
+            run.start_profiler_trace()
         rng, step_rng = jax.random.split(rng)
         state, metrics = step_fn(state, batch, step_rng)
+        if args.profile_at and step + 1 == args.profile_at + \
+                args.profile_steps:
+            jax.block_until_ready(state)
+            run.stop_profiler_trace(step=step + 1)
         if args.checkpoint_every and (step + 1) % args.checkpoint_every == 0:
             ckpt.save(step + 1, state)  # async; off the step path
         if (step + 1) % args.log_every == 0 or step + 1 == total_steps:
@@ -185,6 +195,8 @@ def main(argv=None) -> int:
                 print(f"target {target[0]}>={target[1]} reached", flush=True)
                 break
 
+    # A profile window reaching past the last step still finalizes.
+    run.stop_profiler_trace(step=int(state["step"]))
     ckpt.save(int(state["step"]), state, force=True)
     ckpt.wait()
     ckpt.close()

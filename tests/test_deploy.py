@@ -1,0 +1,39 @@
+"""Deployment manifest rendering tests (SURVEY.md 2.15)."""
+
+from polyaxon_tpu.deploy import DeploymentConfig, render_all
+
+
+class TestDeployManifests:
+    def test_render_all_components(self):
+        manifests = render_all(DeploymentConfig(namespace="ns1"))
+        kinds = [m["kind"] for m in manifests]
+        assert kinds.count("Deployment") == 3  # api, agent, operator
+        assert "CustomResourceDefinition" in kinds
+        assert "ServiceAccount" in kinds and "Role" in kinds
+        names = {m["metadata"]["name"] for m in manifests
+                 if m["kind"] == "Deployment"}
+        assert names == {"polyaxon-tpu-api", "polyaxon-tpu-agent",
+                         "polyaxon-tpu-operator"}
+        for m in manifests:
+            if m["kind"] not in ("Namespace", "CustomResourceDefinition"):
+                assert m["metadata"]["namespace"] == "ns1"
+
+    def test_agent_points_at_api_service(self):
+        manifests = render_all(DeploymentConfig(namespace="ns2",
+                                                api_port=9001))
+        agent = next(m for m in manifests
+                     if m["kind"] == "Deployment"
+                     and m["metadata"]["name"] == "polyaxon-tpu-agent")
+        env = {e["name"]: e["value"] for e in
+               agent["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["POLYAXON_TPU_HOST"] == \
+            "http://polyaxon-tpu-api.ns2:9001"
+
+    def test_artifacts_claim_mounted(self):
+        manifests = render_all(DeploymentConfig(artifacts_claim="pvc-a"))
+        api = next(m for m in manifests
+                   if m["kind"] == "Deployment"
+                   and m["metadata"]["name"] == "polyaxon-tpu-api")
+        pod = api["spec"]["template"]["spec"]
+        assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+            "pvc-a"

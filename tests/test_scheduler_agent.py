@@ -108,6 +108,21 @@ class TestApiServer:
         out = api.read_logs_from(uuid, None, mark)
         assert "aaa" not in out["logs"] and "bbb" in out["logs"]
 
+    def test_multi_replica_log_stream(self, api):
+        uuid = api.create_run(name="r")["uuid"]
+        api.append_log(uuid, "w0-a\n", replica="worker-0")
+        api.append_log(uuid, "w1-a\n", replica="worker-1")
+        out = api.read_logs_multi(uuid, {})
+        reps = out["replicas"]
+        assert reps["worker-0"]["logs"] == "w0-a\n"
+        offsets = {r: reps[r]["offset"] for r in reps}
+        # earlier replica grows; later replica must NOT be re-served
+        api.append_log(uuid, "w0-b\n", replica="worker-0")
+        out = api.read_logs_multi(uuid, offsets)
+        reps = out["replicas"]
+        assert reps["worker-0"]["logs"] == "w0-b\n"
+        assert reps["worker-1"]["logs"] == ""
+
     def test_lineage(self, api):
         uuid = api.create_run(name="r")["uuid"]
         api.add_lineage(uuid, {"name": "model", "kind": "model",
