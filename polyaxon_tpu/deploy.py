@@ -132,9 +132,14 @@ def control_plane(config: DeploymentConfig) -> List[Dict[str, Any]]:
             "name": "artifacts",
             "persistentVolumeClaim":
                 {"claimName": config.artifacts_claim}})
-        deployment["spec"]["template"]["spec"]["containers"][0][
-            "volumeMounts"] = [{"name": "artifacts",
-                                "mountPath": "/ptpu-artifacts"}]
+        api_container = deployment["spec"]["template"]["spec"][
+            "containers"][0]
+        api_container["volumeMounts"] = [{"name": "artifacts",
+                                          "mountPath": "/ptpu-artifacts"}]
+        # The run store must live ON the claim, or API restarts lose
+        # every run record/log.
+        api_container["env"].append({"name": "POLYAXON_TPU_HOME",
+                                     "value": "/ptpu-artifacts"})
     service = {
         "apiVersion": "v1", "kind": "Service",
         "metadata": _meta("polyaxon-tpu-api", config),
@@ -148,6 +153,10 @@ def control_plane(config: DeploymentConfig) -> List[Dict[str, Any]]:
 
 
 def agent(config: DeploymentConfig) -> List[Dict[str, Any]]:
+    """Agent + operator share ONE pod so the manifest hand-off directory
+    (agent writes Operation CRs, operator reconciles them) is a single
+    shared emptyDir — split pods would each get a private volume and
+    the operator would never see the agent's manifests."""
     host = f"http://polyaxon-tpu-api.{config.namespace}:{config.api_port}"
     return [{
         "apiVersion": "apps/v1", "kind": "Deployment",
@@ -162,48 +171,32 @@ def agent(config: DeploymentConfig) -> List[Dict[str, Any]]:
                               "polyaxon-tpu-agent"}},
                 "spec": {
                     "serviceAccountName": config.service_account,
-                    "containers": [{
-                        "name": "agent",
-                        "image": config.image,
-                        "command": ["python", "-m", "polyaxon_tpu.cli",
-                                    "agent", "--name", config.agent_name,
-                                    "--backend", "manifest",
-                                    "--cluster-dir", "/ptpu-cluster"],
-                        "env": _env_list(config,
-                                         {"POLYAXON_TPU_HOST": host}),
-                        "volumeMounts": [{"name": "cluster",
-                                          "mountPath": "/ptpu-cluster"}],
-                    }],
-                    "volumes": [{"name": "cluster", "emptyDir": {}}],
-                },
-            },
-        },
-    }]
-
-
-def operator(config: DeploymentConfig) -> List[Dict[str, Any]]:
-    return [{
-        "apiVersion": "apps/v1", "kind": "Deployment",
-        "metadata": _meta("polyaxon-tpu-operator", config),
-        "spec": {
-            "replicas": 1,
-            "selector": {"matchLabels":
-                         {"app.kubernetes.io/name":
-                          "polyaxon-tpu-operator"}},
-            "template": {
-                "metadata": {"labels":
-                             {"app.kubernetes.io/name":
-                              "polyaxon-tpu-operator"}},
-                "spec": {
-                    "serviceAccountName": config.service_account,
-                    "containers": [{
-                        "name": "operator",
-                        "image": config.operator_image,
-                        "command": ["/ptpu-operator", "--cluster-dir",
-                                    "/ptpu-cluster"],
-                        "volumeMounts": [{"name": "cluster",
-                                          "mountPath": "/ptpu-cluster"}],
-                    }],
+                    "containers": [
+                        {
+                            "name": "agent",
+                            "image": config.image,
+                            "command": ["python", "-m",
+                                        "polyaxon_tpu.cli",
+                                        "agent", "--name",
+                                        config.agent_name,
+                                        "--backend", "manifest",
+                                        "--cluster-dir", "/ptpu-cluster"],
+                            "env": _env_list(config,
+                                             {"POLYAXON_TPU_HOST": host}),
+                            "volumeMounts": [{"name": "cluster",
+                                              "mountPath":
+                                              "/ptpu-cluster"}],
+                        },
+                        {
+                            "name": "operator",
+                            "image": config.operator_image,
+                            "command": ["/ptpu-operator", "--cluster-dir",
+                                        "/ptpu-cluster"],
+                            "volumeMounts": [{"name": "cluster",
+                                              "mountPath":
+                                              "/ptpu-cluster"}],
+                        },
+                    ],
                     "volumes": [{"name": "cluster", "emptyDir": {}}],
                 },
             },
@@ -221,6 +214,5 @@ def render_all(config: Optional[DeploymentConfig] = None
     ]
     manifests += rbac(config)
     manifests += control_plane(config)
-    manifests += agent(config)
-    manifests += operator(config)
+    manifests += agent(config)  # agent pod carries the operator sidecar
     return manifests

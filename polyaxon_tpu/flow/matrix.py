@@ -66,37 +66,34 @@ class V1HpRange(BaseSchema):
         return v[0], v[1], (v[2] if len(v) > 2 else 1)
 
 
-class V1HpLinSpace(BaseSchema):
+class _SpaceDist(BaseSchema):
+    value: Any  # [start, stop, num] | {"start":..} | "start:stop:num"
+
+    def as_tuple(self):
+        v = self.value
+        if isinstance(v, dict):
+            return v["start"], v["stop"], int(v.get("num", 10))
+        if isinstance(v, str):
+            parts = v.split(":")
+            if len(parts) not in (2, 3):
+                raise ValueError(
+                    f"{self.kind} expects 'start:stop:num', got {v!r}")
+            start, stop = float(parts[0]), float(parts[1])
+            num = int(parts[2]) if len(parts) == 3 else 10
+            return start, stop, num
+        return v[0], v[1], int(v[2])
+
+
+class V1HpLinSpace(_SpaceDist):
     kind: Literal["linspace"] = "linspace"
-    value: Any  # [start, stop, num]
-
-    def as_tuple(self):
-        v = self.value
-        if isinstance(v, dict):
-            return v["start"], v["stop"], int(v.get("num", 10))
-        return v[0], v[1], int(v[2])
 
 
-class V1HpLogSpace(BaseSchema):
+class V1HpLogSpace(_SpaceDist):
     kind: Literal["logspace"] = "logspace"
-    value: Any
-
-    def as_tuple(self):
-        v = self.value
-        if isinstance(v, dict):
-            return v["start"], v["stop"], int(v.get("num", 10))
-        return v[0], v[1], int(v[2])
 
 
-class V1HpGeomSpace(BaseSchema):
+class V1HpGeomSpace(_SpaceDist):
     kind: Literal["geomspace"] = "geomspace"
-    value: Any
-
-    def as_tuple(self):
-        v = self.value
-        if isinstance(v, dict):
-            return v["start"], v["stop"], int(v.get("num", 10))
-        return v[0], v[1], int(v[2])
 
 
 class _Dist2(BaseSchema):

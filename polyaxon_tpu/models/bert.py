@@ -31,6 +31,8 @@ class BertConfig:
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
     dtype: jnp.dtype = jnp.bfloat16
+    # Backward-pass rematerialization (see GPT2Config.remat).
+    remat: bool = False
 
     @staticmethod
     def base() -> "BertConfig":
@@ -106,8 +108,9 @@ class BertModel(nn.Module):
         if attention_mask is not None:
             # [B, S] -> [B, 1, 1, S] additive-style boolean mask.
             mask = attention_mask[:, None, None, :].astype(bool)
+        layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
         for i in range(cfg.num_layers):
-            x = BertLayer(cfg, name=f"layer_{i}")(x, mask)
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
 
         # MLM head: transform then decode with the tied embedding.
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(x)

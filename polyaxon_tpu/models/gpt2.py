@@ -29,6 +29,10 @@ class GPT2Config:
     max_position: int = 1024
     layer_norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
+    # Rematerialize each block in the backward pass: trades ~30% more
+    # FLOPs for O(layers) less activation HBM — the standard TPU knob
+    # for long sequences / big batches.
+    remat: bool = False
 
     @property
     def intermediate_size(self) -> int:
@@ -89,8 +93,9 @@ class GPT2Model(nn.Module):
         pos = jnp.arange(input_ids.shape[-1])
         x = x + nn.Embed(cfg.max_position, cfg.hidden_size,
                          dtype=cfg.dtype, name="wpe")(pos)
+        block_cls = nn.remat(GPT2Block) if cfg.remat else GPT2Block
         for i in range(cfg.num_layers):
-            x = GPT2Block(cfg, name=f"h_{i}")(x)
+            x = block_cls(cfg, name=f"h_{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         return wte.attend(x.astype(cfg.dtype)).astype(jnp.float32)

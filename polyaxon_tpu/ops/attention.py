@@ -12,13 +12,80 @@ selected automatically on TPU for supported shapes.
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import os
+import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 BIG_NEG = -1e30
+
+logger = logging.getLogger(__name__)
+
+# Active sequence-parallel context: when set (mesh with sp>1 + mode),
+# dot_product_attention routes through ring/Ulysses shard_map attention —
+# every transformer in the zoo becomes long-context capable without
+# model changes; the runtime (train.py) activates it from the job
+# spec's strategy (SURVEY.md 5.7).
+_SP_STATE = threading.local()
+
+
+def activate_sequence_parallel(mesh, mode: str = "ring") -> None:
+    """Route subsequent attention calls (this thread) through sequence
+    parallelism.  The routing decision is captured at TRACE time — a
+    function jitted before activation keeps its cached local-attention
+    trace, so activate BEFORE building/jitting the step function."""
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}")
+    _SP_STATE.ctx = (mesh, mode) if mesh.shape.get("sp", 1) > 1 else None
+
+
+def deactivate_sequence_parallel() -> None:
+    _SP_STATE.ctx = None
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, mode: str = "ring"):
+    """Scoped form of :func:`activate_sequence_parallel` (same trace-time
+    caveat)."""
+    prev = getattr(_SP_STATE, "ctx", None)
+    activate_sequence_parallel(mesh, mode)
+    try:
+        yield
+    finally:
+        _SP_STATE.ctx = prev
+
+
+def _sp_route(q, k, v, mask, causal, scale):
+    """The (mesh, mode) to use, or None for local attention."""
+    ctx = getattr(_SP_STATE, "ctx", None)
+    if ctx is None:
+        return None
+    if mask is not None:
+        # Explicit masks (padded batches) are not supported by the
+        # ring/Ulysses kernels yet — warn so sp>1 never silently no-ops.
+        if not getattr(_SP_STATE, "warned_mask", False):
+            _SP_STATE.warned_mask = True
+            logger.warning(
+                "sequence_parallel: attention mask present; falling back "
+                "to local attention (masked SP attention not implemented)")
+        return None
+    mesh, mode = ctx
+    sp = mesh.shape.get("sp", 1)
+    seq = q.shape[1]
+    heads = q.shape[2]
+    if seq % sp or q.shape[1] != k.shape[1]:
+        logger.warning("sequence_parallel: seq %d not divisible by sp %d;"
+                       " falling back to local attention", seq, sp)
+        return None
+    if mode == "ulysses" and heads % sp:
+        logger.warning("sequence_parallel: heads %d not divisible by sp "
+                       "%d; falling back to ring", heads, sp)
+        mode = "ring"
+    return mesh, mode
 
 
 def _xla_attention(q, k, v, mask, causal, scale):
@@ -61,6 +128,17 @@ def dot_product_attention(
     """Attention over [B, S, H, D] tensors; returns [B, Sq, H, D]."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    route = _sp_route(q, k, v, mask, causal, scale)
+    if route is not None:
+        mesh, mode = route
+        if mode == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(q, k, v, mesh, causal=causal,
+                                     scale=scale)
+        from ..parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
     platform = jax.default_backend()
     if _flash_supported(q, k, mask, platform):
         from .flash import flash_attention

@@ -43,6 +43,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--strategy", default=None,
                    help='Mesh axes JSON, e.g. \'{"dp": -1, "tp": 2}\' '
                         "(default: PTPU_STRATEGY env, else pure DP).")
+    p.add_argument("--sp-mode", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="Sequence-parallel attention flavor when the "
+                        "strategy has sp > 1.")
     p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="Steps between checkpoints (0 = only at end).")
@@ -88,6 +92,15 @@ def load_data(spec, data_dir: Optional[str], batch_size: int):
 
 
 def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    finally:
+        from .ops.attention import deactivate_sequence_parallel
+
+        deactivate_sequence_parallel()
+
+
+def _main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
 
     import jax
@@ -119,6 +132,15 @@ def main(argv=None) -> int:
     strategy = json.loads(strategy_raw) if strategy_raw else {}
     mesh = build_mesh(MeshSpec.from_dict(strategy))
     n_chips = mesh.devices.size
+
+    # sp > 1: route every model's attention through ring/Ulysses
+    # sequence parallelism for the whole run (activated before any jit
+    # trace; main()'s wrapper deactivates on the way out so in-process
+    # callers — tune workers, tests — never inherit stale routing).
+    from .ops.attention import activate_sequence_parallel
+
+    if mesh.shape.get("sp", 1) > 1:
+        activate_sequence_parallel(mesh, args.sp_mode)
 
     spec = get_model(args.model)
     batch_size = args.batch_size or spec.default_batch_size

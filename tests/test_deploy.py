@@ -7,16 +7,36 @@ class TestDeployManifests:
     def test_render_all_components(self):
         manifests = render_all(DeploymentConfig(namespace="ns1"))
         kinds = [m["kind"] for m in manifests]
-        assert kinds.count("Deployment") == 3  # api, agent, operator
+        assert kinds.count("Deployment") == 2  # api; agent+operator pod
         assert "CustomResourceDefinition" in kinds
         assert "ServiceAccount" in kinds and "Role" in kinds
         names = {m["metadata"]["name"] for m in manifests
                  if m["kind"] == "Deployment"}
-        assert names == {"polyaxon-tpu-api", "polyaxon-tpu-agent",
-                         "polyaxon-tpu-operator"}
+        assert names == {"polyaxon-tpu-api", "polyaxon-tpu-agent"}
         for m in manifests:
             if m["kind"] not in ("Namespace", "CustomResourceDefinition"):
                 assert m["metadata"]["namespace"] == "ns1"
+
+    def test_agent_and_operator_share_cluster_volume(self):
+        manifests = render_all(DeploymentConfig())
+        pod = next(m for m in manifests
+                   if m["kind"] == "Deployment"
+                   and m["metadata"]["name"] == "polyaxon-tpu-agent"
+                   )["spec"]["template"]["spec"]
+        names = [c["name"] for c in pod["containers"]]
+        assert names == ["agent", "operator"]
+        for c in pod["containers"]:
+            assert {"name": "cluster", "mountPath": "/ptpu-cluster"} in \
+                c["volumeMounts"]
+
+    def test_artifacts_claim_sets_store_home(self):
+        manifests = render_all(DeploymentConfig(artifacts_claim="pvc-a"))
+        api = next(m for m in manifests
+                   if m["kind"] == "Deployment"
+                   and m["metadata"]["name"] == "polyaxon-tpu-api")
+        env = {e["name"]: e["value"] for e in
+               api["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["POLYAXON_TPU_HOME"] == "/ptpu-artifacts"
 
     def test_agent_points_at_api_service(self):
         manifests = render_all(DeploymentConfig(namespace="ns2",
