@@ -445,6 +445,80 @@ def version():
         pass
 
 
+@cli.command(name="port-forward")
+@click.argument("run_uuid")
+@click.option("--port", "-p", default=None, type=int,
+              help="Local port (default: same as the service port).")
+@click.option("--target", default=None,
+              help="Override target host:port (default: the run's "
+                   "recorded endpoint, else 127.0.0.1:<service port>).")
+def port_forward(run_uuid, port, target):
+    """Forward a local port to a service run (notebook/TensorBoard)."""
+    import socket
+    import socketserver
+    import threading
+
+    record = _get_run_or_fail(run_uuid)
+    if target is None:
+        target = (record.get("meta_info") or {}).get("endpoint")
+    if target is None:
+        content = record.get("content") or {}
+        run_section = (content.get("component") or {}).get("run") or {}
+        ports = run_section.get("ports") or []
+        if not ports:
+            raise click.ClickException(
+                f"Run {run_uuid} declares no service ports; pass --target")
+        target = f"127.0.0.1:{ports[0]}"
+    host, _, tport = target.partition(":")
+    tport = int(tport or 80)
+    local_port = port or tport
+
+    class Relay(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                upstream = socket.create_connection((host, tport),
+                                                    timeout=10)
+            except OSError as e:
+                self.request.close()
+                click.echo(f"connect {host}:{tport} failed: {e}", err=True)
+                return
+
+            def pump(src, dst):
+                try:
+                    while True:
+                        data = src.recv(65536)
+                        if not data:
+                            break
+                        dst.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    for s in (src, dst):
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+
+            t = threading.Thread(target=pump,
+                                 args=(upstream, self.request),
+                                 daemon=True)
+            t.start()
+            pump(self.request, upstream)
+            t.join(timeout=5)
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server(("127.0.0.1", local_port), Relay) as server:
+        click.echo(f"forwarding 127.0.0.1:{local_port} -> {host}:{tport} "
+                   "(ctrl-c to stop)")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+
+
 # ---------------------------------------------------------------------------
 # project
 # ---------------------------------------------------------------------------

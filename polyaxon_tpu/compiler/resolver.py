@@ -87,6 +87,7 @@ def resolve_params(
     compiled: V1CompiledOperation,
     matrix_values: Optional[Dict[str, Any]] = None,
     ref_resolver: Optional[RefResolver] = None,
+    join_values: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Materialize param values into the compiled op's inputs.
 
@@ -131,8 +132,10 @@ def resolve_params(
     except ValueError as e:
         raise CompilerError(str(e)) from e
 
-    # Matrix params flow in even without explicit ref= entries.
+    # Matrix/join params flow in even without explicit ref= entries.
     for name, value in (matrix_values or {}).items():
+        resolved.setdefault(name, value)
+    for name, value in (join_values or {}).items():
         resolved.setdefault(name, value)
 
     try:
@@ -152,16 +155,19 @@ def resolve(
     ref_resolver: Optional[RefResolver] = None,
     store_path: Optional[str] = None,
     dag_values: Optional[Dict[str, Any]] = None,
+    join_values: Optional[Dict[str, Any]] = None,
 ) -> V1CompiledOperation:
     """Full resolution: compile, materialize params, resolve templates.
 
     ``dag_values`` supplies the ``{{ dag.* }}`` context (upstream op
-    outputs) when this op runs inside a DAG.
+    outputs) when this op runs inside a DAG; ``join_values`` the
+    query-joined param lists (``runner.joins``).
     """
     compiled = make_compiled(operation)
 
     resolved = resolve_params(compiled, matrix_values=matrix_values,
-                              ref_resolver=ref_resolver)
+                              ref_resolver=ref_resolver,
+                              join_values=join_values)
 
     globals_ctx = build_globals(
         run_uuid=run_uuid, run_name=run_name or compiled.name,

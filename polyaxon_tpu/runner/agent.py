@@ -139,24 +139,35 @@ class ManifestBackend(Backend):
                                  operator
     """
 
-    def __init__(self, cluster_dir: str,
-                 config: Optional[ConverterConfig] = None):
-        self.cluster_dir = cluster_dir
-        self.config = config or ConverterConfig()
-        os.makedirs(os.path.join(cluster_dir, "operations"), exist_ok=True)
-        os.makedirs(os.path.join(cluster_dir, "status"), exist_ok=True)
-
     _PHASES = {
         "Succeeded": V1Statuses.SUCCEEDED,
         "Failed": V1Statuses.FAILED,
         "Stopped": V1Statuses.STOPPED,
     }
 
+    def __init__(self, cluster_dir: str,
+                 config: Optional[ConverterConfig] = None,
+                 store: Optional[FileRunStore] = None):
+        """``store`` enables join resolution at submit time; the Agent
+        fills it in when absent."""
+        self.cluster_dir = cluster_dir
+        self.config = config or ConverterConfig()
+        self.store = store
+        os.makedirs(os.path.join(cluster_dir, "operations"), exist_ok=True)
+        os.makedirs(os.path.join(cluster_dir, "status"), exist_ok=True)
+
     def submit(self, record, operation):
         from ..compiler import resolve
 
+        join_values = None
+        if operation.joins and self.store is not None:
+            from .joins import resolve_joins
+
+            join_values = resolve_joins(operation, self.store,
+                                        project=record.get("project"))
         compiled = resolve(operation, run_uuid=record["uuid"],
-                           project=record.get("project"))
+                           project=record.get("project"),
+                           join_values=join_values)
         cr = convert(compiled, record["uuid"], record.get("project"),
                      self.config)
         name = cr["metadata"]["name"]
@@ -222,6 +233,9 @@ class Agent:
         self.name = name
         self.poll_interval = poll_interval
         self.max_concurrent = max_concurrent
+        # Backends that can resolve joins need store access.
+        if getattr(self.backend, "store", True) is None:
+            self.backend.store = self.store
         self.active: Dict[str, _Active] = {}
         self._stop = threading.Event()
 

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional
 from ..client import FileRunStore, RunClient
 from ..client.run_client import ENV_PROJECT, ENV_RUN_UUID
 from ..compiler import normalize, resolve
+from ..compiler.resolver import make_compiled
 from ..compiler.topology import ProcessTopology
 from ..flow import V1Operation
 from ..flow.run import RunKind
@@ -91,22 +92,38 @@ class LocalExecutor:
             run_uuid = run_uuid or self.create_run(operation,
                                                    pipeline=pipeline)
             controller = TuneController(self, operation, run_uuid)
-            return controller.execute()
+            controller.execute()
+            # Sweep-level hooks fire once on the parent, with the
+            # aggregated outputs (child trials fire their own).
+            return self._finalize(run_uuid, make_compiled(operation))
 
         run_uuid = run_uuid or self.create_run(
             operation, pipeline=pipeline,
             meta_info={"matrix_values": matrix_values} if matrix_values else None,
         )
         try:
+            join_values = None
+            if operation.joins:
+                from .joins import resolve_joins
+
+                join_values = resolve_joins(operation, self.store,
+                                            project=self.project)
             compiled = resolve(
                 operation, run_uuid=run_uuid, project=self.project,
                 matrix_values=matrix_values, dag_values=dag_values,
                 ref_resolver=ref_resolver, store_path=self.store.home,
+                join_values=join_values,
             )
         except Exception as e:
             self.store.set_status(run_uuid, V1Statuses.FAILED,
                                   reason="CompilationError", message=str(e),
                                   force=True)
+            # failed-trigger hooks still fire (hooks live on the raw
+            # component; resolution never got that far)
+            try:
+                self._finalize(run_uuid, make_compiled(operation))
+            except Exception:  # noqa: BLE001 - best effort on a failure
+                pass
             raise
 
         self.store.update_run(
@@ -140,21 +157,34 @@ class LocalExecutor:
             except StopRequested:
                 self.store.set_status(run_uuid, V1Statuses.STOPPED,
                                       reason="StopRequested")
-                return self.store.get_run(run_uuid)
+                return self._finalize(run_uuid, compiled)
             except ExecutionError as e:
                 attempt += 1
                 if attempt > max_retries:
                     self.store.set_status(run_uuid, V1Statuses.FAILED,
                                           reason="ExecutionError",
                                           message=str(e), force=True)
-                    return self.store.get_run(run_uuid)
+                    return self._finalize(run_uuid, compiled)
                 self.store.set_status(run_uuid, V1Statuses.RETRYING,
                                       reason="Retry",
                                       message=f"attempt {attempt}", force=True)
 
         self.store.set_status(run_uuid, V1Statuses.SUCCEEDED,
                               reason="LocalExecutor")
-        return self.store.get_run(run_uuid)
+        return self._finalize(run_uuid, compiled)
+
+    def _finalize(self, run_uuid: str, compiled) -> Dict[str, Any]:
+        """Terminal bookkeeping: fire hooks, return the final record."""
+        record = self.store.get_run(run_uuid)
+        try:
+            from .hooks import run_hooks
+
+            run_hooks(compiled, record, self.store)
+        except Exception:  # noqa: BLE001 - hooks never fail the run
+            import logging
+
+            logging.getLogger(__name__).exception("hook execution failed")
+        return record
 
     def run_operation_with_refs(self, operation: V1Operation,
                                 dag_values=None, ref_resolver=None,

@@ -1,0 +1,174 @@
+"""Join (query fan-in) and hook (post-run action) tests
+(SURVEY.md 2.3/2.11)."""
+
+import json
+
+import pytest
+
+from polyaxon_tpu.client.store import FileRunStore
+from polyaxon_tpu.flow import V1Operation
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.runner import LocalExecutor
+from polyaxon_tpu.runner.hooks import run_hooks, trigger_matches
+from polyaxon_tpu.runner.joins import JoinError, resolve_joins
+
+
+@pytest.fixture
+def store(tmp_home):
+    return FileRunStore()
+
+
+def seed_runs(store, n=3):
+    uuids = []
+    for i in range(n):
+        r = store.create_run(name=f"trial-{i}", project="default",
+                             tags=["sweep"])
+        store.update_run(r["uuid"], outputs={"accuracy": 0.5 + i / 10})
+        store.set_status(r["uuid"], V1Statuses.RUNNING, force=True)
+        store.set_status(r["uuid"], V1Statuses.SUCCEEDED, force=True)
+        uuids.append(r["uuid"])
+    return uuids
+
+
+class TestJoins:
+    def test_resolve_joins_collects_values(self, store):
+        uuids = seed_runs(store)
+        op = V1Operation.from_dict({
+            "kind": "operation",
+            "joins": [{
+                "query": "status:succeeded",
+                "sort": "created_at",
+                "params": {
+                    "accuracies": {"value": "outputs.accuracy"},
+                    "run_ids": {"value": "globals.uuid"},
+                },
+            }],
+            "component": {"kind": "component", "run": {
+                "kind": "job", "container": {"command": ["true"]}}},
+        })
+        values = resolve_joins(op, store)
+        assert values["accuracies"] == [0.5, 0.6, 0.7]
+        assert values["run_ids"] == uuids
+
+    def test_join_feeds_container_args(self, store):
+        seed_runs(store)
+        op = V1Operation.from_dict({
+            "kind": "operation",
+            "name": "report",
+            "joins": [{
+                "query": "status:succeeded",
+                "sort": "created_at",
+                "params": {"accuracies": {"value": "outputs.accuracy"}},
+            }],
+            "component": {
+                "kind": "component",
+                "name": "report",
+                "inputs": [{"name": "accuracies", "type": "list"}],
+                "run": {"kind": "job", "container": {
+                    "command": ["/bin/sh", "-c",
+                                "echo joined:{{ accuracies }}"]}},
+            },
+        })
+        executor = LocalExecutor(store=store)
+        record = executor.run_operation(op)
+        assert record["status"] == "succeeded"
+        logs = store.read_logs(record["uuid"])
+        assert "joined:[0.5, 0.6, 0.7]" in logs
+
+    def test_bad_expression_raises(self, store):
+        seed_runs(store, 1)
+        op = V1Operation.from_dict({
+            "kind": "operation",
+            "joins": [{"query": "status:succeeded",
+                       "params": {"x": {"value": "bogus.thing"}}}],
+            "component": {"kind": "component", "run": {
+                "kind": "job", "container": {"command": ["true"]}}},
+        })
+        with pytest.raises(JoinError):
+            resolve_joins(op, store)
+
+
+class TestHooks:
+    def test_trigger_matching(self):
+        assert trigger_matches("succeeded", "succeeded")
+        assert not trigger_matches("succeeded", "failed")
+        assert trigger_matches("failed", "upstream_failed")
+        assert trigger_matches("done", "stopped")
+        assert trigger_matches(None, "succeeded")
+        assert not trigger_matches(None, "running")
+
+    def test_conditions(self):
+        from polyaxon_tpu.runner.hooks import evaluate_condition
+
+        ctx = {"outputs": {"accuracy": 0.95}, "status": "succeeded"}
+        assert evaluate_condition("{{ outputs.accuracy > 0.9 }}", ctx)
+        assert not evaluate_condition("outputs.accuracy < 0.9", ctx)
+        assert evaluate_condition('status == "succeeded"', ctx)
+        assert evaluate_condition(None, ctx)
+        assert not evaluate_condition("outputs.missing > 1", ctx)
+
+    def test_conditional_hook_skipped(self, store):
+        op = V1Operation.from_dict({
+            "kind": "operation",
+            "name": "cond-hooks",
+            "component": {
+                "kind": "component",
+                "name": "cond-hooks",
+                "hooks": [{"trigger": "succeeded", "connection": "a",
+                           "conditions": "{{ outputs.accuracy > 0.99 }}"}],
+                "run": {"kind": "job", "container": {
+                    "command": ["/bin/sh", "-c", "echo ok"]}},
+            },
+        })
+        record = LocalExecutor(store=store).run_operation(op)
+        assert record["status"] == "succeeded"
+        # no accuracy output -> condition False -> nothing recorded
+        assert store.read_events(record["uuid"], "notification",
+                                 "hooks") == []
+
+    def test_sweep_parent_hooks_fire_once(self, store):
+        op = V1Operation.from_dict({
+            "kind": "operation",
+            "name": "sweep-hooks",
+            "matrix": {"kind": "grid",
+                       "params": {"x": {"kind": "choice",
+                                        "value": [1, 2]}}},
+            "component": {
+                "kind": "component",
+                "name": "sweep-hooks",
+                "inputs": [{"name": "x", "type": "int"}],
+                "hooks": [{"trigger": "done", "connection": "a"}],
+                "run": {"kind": "job", "container": {
+                    "command": ["/bin/sh", "-c", "echo {{ x }}"]}},
+            },
+        })
+        record = LocalExecutor(store=store).run_operation(op)
+        parent_events = store.read_events(record["uuid"], "notification",
+                                          "hooks")
+        assert len(parent_events) == 1
+
+    def test_hooks_fire_and_record_notification(self, store):
+        op = V1Operation.from_dict({
+            "kind": "operation",
+            "name": "with-hooks",
+            "component": {
+                "kind": "component",
+                "name": "with-hooks",
+                "hooks": [
+                    {"trigger": "succeeded", "connection": "alerts"},
+                    {"trigger": "failed", "connection": "alerts"},
+                ],
+                "run": {"kind": "job", "container": {
+                    "command": ["/bin/sh", "-c", "echo ok"]}},
+            },
+        })
+        executor = LocalExecutor(store=store)
+        record = executor.run_operation(op)
+        assert record["status"] == "succeeded"
+        events = store.read_events(record["uuid"], "notification", "hooks")
+        # only the succeeded-trigger hook fired
+        assert len(events) == 1
+        assert events[0]["trigger"] == "succeeded"
+        assert events[0]["payload"]["status"] == "succeeded"
+        # unknown connection recorded as delivery error, run unaffected
+        assert events[0]["delivery"].startswith("error")
