@@ -407,6 +407,16 @@ class Reconciler {
     status.set("phase", Json(op.phase));
     status.set("message", Json(op.message));
     status.set("attempt", Json(op.attempt));
+    // Service kinds: advertise reachable endpoints (local runtime pods
+    // bind the declared ports on this host).
+    const Json& ports = op.cr["spec"]["ports"];
+    if (ports.is_array() && !ports.items().empty()) {
+      Json endpoints = Json::array();
+      for (const auto& p : ports.items())
+        endpoints.push_back(
+            Json("127.0.0.1:" + std::to_string(p.as_int())));
+      status.set("endpoints", endpoints);
+    }
     status.set("observedGeneration", Json(static_cast<double>(op.generation)));
     if (op.finished_at > 0) status.set("finishedAt", Json(op.finished_at));
     Json reps = Json::object();

@@ -493,11 +493,12 @@ def port_forward(run_uuid, port, target):
                 except OSError:
                     pass
                 finally:
-                    for s in (src, dst):
-                        try:
-                            s.shutdown(socket.SHUT_RDWR)
-                        except OSError:
-                            pass
+                    # Half-close only: EOF on src ends THIS direction;
+                    # the reverse pump keeps relaying the response.
+                    try:
+                        dst.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
 
             t = threading.Thread(target=pump,
                                  args=(upstream, self.request),
@@ -549,6 +550,47 @@ def project_runs(name, limit):
 
 
 # ---------------------------------------------------------------------------
+# auth
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def auth():
+    """Authentication against the control plane."""
+
+
+@auth.command(name="login")
+@click.option("--token", prompt=True, hide_input=True,
+              help="API token (prompted when omitted).")
+@click.option("--host", default=None)
+def auth_login(token, host):
+    from polyaxon_tpu.config import ClientConfig
+
+    values = {"token": token}
+    if host:
+        values["host"] = host
+    ClientConfig.set_file_values(values)
+    click.echo("logged in (token stored in home config)")
+
+
+@auth.command(name="logout")
+def auth_logout():
+    from polyaxon_tpu.config import ClientConfig
+
+    ClientConfig.unset_file_values(["token"])
+    click.echo("logged out")
+
+
+@auth.command(name="whoami")
+def auth_whoami():
+    from polyaxon_tpu.config import ClientConfig
+
+    cfg = ClientConfig.load()
+    click.echo(f"host: {cfg.host or '(local mode)'}")
+    click.echo(f"token: {'set' if cfg.token else '(none)'}")
+
+
+# ---------------------------------------------------------------------------
 # admin
 # ---------------------------------------------------------------------------
 
@@ -595,15 +637,19 @@ def admin_deploy(namespace, image, operator_image, artifacts_claim, output):
 @click.option("--port", default=8000, type=int)
 @click.option("--schedules/--no-schedules", default=True,
               help="Also run the schedule-materializer loop.")
-def server(host, port, schedules):
+@click.option("--auth-token", default=None, envvar="POLYAXON_TPU_AUTH_TOKEN",
+              help="Require this bearer token on every request.")
+def server(host, port, schedules, auth_token):
     """Serve the control plane API (runs DB, queue, streams)."""
     import threading
 
     from polyaxon_tpu.client.store import FileRunStore
-    from polyaxon_tpu.scheduler import ScheduleService, make_server
+    from polyaxon_tpu.scheduler import ControlPlane, ScheduleService, \
+        make_server
 
     store = FileRunStore()
-    srv = make_server(host, port, store)
+    srv = make_server(host, port, store,
+                      plane=ControlPlane(store, auth_token=auth_token))
     if schedules:
         service = ScheduleService(store)
         threading.Thread(target=service.run_forever, daemon=True).start()

@@ -21,11 +21,17 @@ from .store import StoreError
 class ApiRunStore:
     """FileRunStore-compatible facade speaking to the control plane."""
 
-    def __init__(self, host: str, timeout: float = 30.0):
+    def __init__(self, host: str, timeout: float = 30.0,
+                 token: Optional[str] = None):
         self.host = host.rstrip("/")
         if not self.host.startswith(("http://", "https://")):
             self.host = "http://" + self.host
         self.timeout = timeout
+        if token is None:
+            from ..config import ClientConfig
+
+            token = ClientConfig.load().token  # env-over-file layering
+        self.token = token
 
     # -- transport --------------------------------------------------------
 
@@ -39,9 +45,11 @@ class ApiRunStore:
             if qs:
                 url += "?" + qs
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            url, data=data, method=method, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:

@@ -70,7 +70,10 @@ def make_compiled(operation: V1Operation) -> V1CompiledOperation:
             "hooks": [h.to_dict() for h in (operation.hooks or comp.hooks or [])] or None,
             "params": {k: p.to_dict() for k, p in (operation.params or {}).items()} or None,
             "matrix": operation.matrix.to_dict() if operation.matrix else None,
-            "joins": [j.to_dict() for j in operation.joins] if operation.joins else None,
+            "joins": [j.to_dict()
+                      for j in (operation.joins
+                                or getattr(comp, "joins", None)
+                                or [])] or None,
             "schedule": operation.schedule.to_dict() if operation.schedule else None,
             "dependencies": operation.dependencies,
             "trigger": operation.trigger,

@@ -137,6 +137,20 @@ class ClientConfig:
         os.replace(tmp, path)
         return path
 
+    @classmethod
+    def unset_file_values(cls, keys) -> str:
+        """Remove keys from the file layer (atomic write)."""
+        stored = cls.read_file_layer()
+        for key in keys:
+            stored.pop(key, None)
+        path = _config_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(stored, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
     def set_value(self, key: str, raw: str) -> None:
         if key not in _ENV_KEYS:
             raise KeyError(

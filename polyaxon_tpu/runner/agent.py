@@ -44,6 +44,7 @@ class _Active:
     backend: "Backend"
     ttl: Optional[int] = None
     done_at: Optional[float] = None
+    endpoint_recorded: bool = False
 
 
 class Backend:
@@ -159,10 +160,10 @@ class ManifestBackend(Backend):
     def submit(self, record, operation):
         from ..compiler import resolve
 
-        join_values = None
-        if operation.joins and self.store is not None:
-            from .joins import resolve_joins
+        from .joins import get_joins, resolve_joins
 
+        join_values = None
+        if get_joins(operation) and self.store is not None:
             join_values = resolve_joins(operation, self.store,
                                         project=record.get("project"))
         compiled = resolve(operation, run_uuid=record["uuid"],
@@ -181,15 +182,20 @@ class ManifestBackend(Backend):
         return name
 
     def check(self, handle):
+        status = self.read_status(handle)
+        if status is None:
+            return None
+        return self._PHASES.get(status.get("phase"))
+
+    def read_status(self, handle) -> Optional[Dict[str, Any]]:
         path = os.path.join(self.cluster_dir, "status", f"{handle}.json")
         if not os.path.exists(path):
             return None
         try:
             with open(path) as f:
-                status = json.load(f)
+                return json.load(f)
         except ValueError:
             return None
-        return self._PHASES.get(status.get("phase"))
 
     def stop(self, handle):
         path = os.path.join(self.cluster_dir, "operations",
@@ -307,7 +313,24 @@ class Agent:
                 current = None
             if current == V1Statuses.STOPPING:
                 active.backend.stop(active.handle)
-            terminal = active.backend.check(active.handle)
+            if hasattr(active.backend, "read_status"):
+                # One status read per tick serves both endpoint discovery
+                # and the terminal-phase check.
+                status_doc = active.backend.read_status(active.handle)
+                endpoints = (status_doc or {}).get("endpoints")
+                if endpoints and not active.endpoint_recorded:
+                    active.endpoint_recorded = True
+                    try:
+                        self.store.update_run(
+                            run_uuid,
+                            meta_info={"endpoint": endpoints[0],
+                                       "endpoints": endpoints})
+                    except Exception:  # noqa: BLE001 - metadata only
+                        pass
+                terminal = (active.backend._PHASES.get(
+                    status_doc.get("phase")) if status_doc else None)
+            else:
+                terminal = active.backend.check(active.handle)
             if terminal is None:
                 continue
             progressed = True

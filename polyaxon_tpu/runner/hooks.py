@@ -54,7 +54,12 @@ def _cond_operand(token: str, ctx: Dict[str, Any]) -> Any:
     try:
         return _lookup(token, ctx)
     except TemplateError:
-        return token  # bare string literal
+        if "." in token:
+            # Dotted tokens are context paths; a missing path must make
+            # the condition False, not silently become a string literal
+            # ('outputs.accuracy != 0' on a run with no outputs).
+            raise
+        return token  # bare string literal (status == succeeded)
 
 
 def evaluate_condition(condition: Optional[str],

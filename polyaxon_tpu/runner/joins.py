@@ -45,11 +45,20 @@ def _extract(record: Dict[str, Any], expr: str, store) -> Any:
         "outputs.*/inputs.*/globals.*/artifacts.*")
 
 
+def get_joins(operation) -> List[Any]:
+    """Effective joins (joins are operation-level in the schema; the
+    getattr keeps this robust if components ever grow them)."""
+    if getattr(operation, "joins", None):
+        return operation.joins
+    component = getattr(operation, "component", None)
+    return getattr(component, "joins", None) or []
+
+
 def resolve_joins(operation, store,
                   project: Optional[str] = None) -> Dict[str, List[Any]]:
     """{param_name: [values across matched runs]} for every join."""
     out: Dict[str, List[Any]] = {}
-    for join in operation.joins or []:
+    for join in get_joins(operation):
         records = store.list_runs(
             project=project, query=join.query, sort=join.sort,
             limit=join.limit, offset=join.offset or 0)

@@ -92,20 +92,27 @@ class LocalExecutor:
             run_uuid = run_uuid or self.create_run(operation,
                                                    pipeline=pipeline)
             controller = TuneController(self, operation, run_uuid)
-            controller.execute()
-            # Sweep-level hooks fire once on the parent, with the
-            # aggregated outputs (child trials fire their own).
-            return self._finalize(run_uuid, make_compiled(operation))
+            try:
+                controller.execute()
+            finally:
+                # Sweep-level hooks fire once on the parent — also on
+                # failure paths where execute() raises (the controller
+                # has already set the terminal status).
+                try:
+                    self._finalize(run_uuid, make_compiled(operation))
+                except Exception:  # noqa: BLE001 - hooks never mask
+                    pass
+            return self.store.get_run(run_uuid)
 
         run_uuid = run_uuid or self.create_run(
             operation, pipeline=pipeline,
             meta_info={"matrix_values": matrix_values} if matrix_values else None,
         )
         try:
-            join_values = None
-            if operation.joins:
-                from .joins import resolve_joins
+            from .joins import get_joins, resolve_joins
 
+            join_values = None
+            if get_joins(operation):
                 join_values = resolve_joins(operation, self.store,
                                             project=self.project)
             compiled = resolve(

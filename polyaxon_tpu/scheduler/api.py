@@ -54,8 +54,10 @@ class ControlPlane:
     in-process (single-box deployments) and tests can drive it directly.
     """
 
-    def __init__(self, store: Optional[FileRunStore] = None):
+    def __init__(self, store: Optional[FileRunStore] = None,
+                 auth_token: Optional[str] = None):
         self.store = store or FileRunStore()
+        self.auth_token = auth_token  # None = open (single-user/local)
         self._claim_lock = threading.Lock()
 
     # -- queue ----------------------------------------------------------
@@ -178,6 +180,13 @@ class _Handler(BaseHTTPRequestHandler):
         if not parsed.path.startswith("/api/v1"):
             return _json_response(self, 404, {"error": "not found"})
         path = parsed.path[len("/api/v1"):] or "/"
+        if self.plane.auth_token and path != "/healthz":
+            import hmac
+
+            supplied = (self.headers.get("Authorization") or "")
+            if not hmac.compare_digest(supplied.removeprefix("Bearer "),
+                                       self.plane.auth_token):
+                return _json_response(self, 401, {"error": "unauthorized"})
         params = {k: v[0] for k, v in
                   urllib.parse.parse_qs(parsed.query).items()}
         body: Dict[str, Any] = {}

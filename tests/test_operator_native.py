@@ -152,6 +152,23 @@ class TestJobReconcile:
         assert not status_path.exists()
 
 
+class TestServiceReconcile:
+    def test_service_endpoints_published(self, cluster):
+        spec = {
+            "runKind": "service",
+            "ports": [6006],
+            "replicas": 1,
+            "template": {"spec": {"containers": [{
+                "name": "ptpu-main",
+                "command": ["/bin/sh", "-c", "sleep 30"],
+                "env": [],
+            }]}},
+        }
+        write_cr(cluster, "svc1", spec)
+        status = wait_status(cluster, "svc1", phases=("Running",))
+        assert status["endpoints"] == ["127.0.0.1:6006"]
+
+
 class TestDistributedReconcile:
     def test_gang_env_stamping(self, cluster):
         # Two roles x replicas; each pod prints its stamped identity.

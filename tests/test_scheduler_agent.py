@@ -141,6 +141,32 @@ class TestApiServer:
         assert api.claim("agent-x") is None
 
 
+class TestAuth:
+    def test_token_required_and_accepted(self, store):
+        port = _free_port()
+        from polyaxon_tpu.scheduler import ControlPlane
+        server = make_server("127.0.0.1", port, store,
+                             plane=ControlPlane(store, auth_token="s3c"))
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            import urllib.request
+
+            # healthz stays open
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/healthz") as r:
+                assert r.status == 200
+            from polyaxon_tpu.client.store import StoreError
+
+            bad = ApiRunStore(f"http://127.0.0.1:{port}", token="wrong")
+            with pytest.raises(StoreError, match="401"):
+                bad.list_runs()
+            good = ApiRunStore(f"http://127.0.0.1:{port}", token="s3c")
+            assert good.list_runs() == []
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
 class TestAgent:
     def test_agent_executes_queued_job(self, store):
         plane = ControlPlane(store)
