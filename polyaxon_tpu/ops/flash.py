@@ -1,16 +1,23 @@
-"""Pallas flash attention for TPU (forward kernel + recompute backward).
+"""Pallas flash attention for TPU — forward AND backward kernels.
 
-Classic online-softmax blocking: grid = (B, H, q_blocks, kv_blocks) with
-the kv axis innermost; the VMEM scratch accumulator/row-stats persist
-across the innermost grid dimension (TPU grids execute sequentially per
-core), so the [S, S] score matrix never exists — each (128 x D) Q block
-streams K/V blocks through VMEM and the MXU.  Fully-masked causal blocks
-are skipped via ``pl.when`` (upper-triangle blocks cost nothing).
+Forward: classic online-softmax blocking, grid = (B, H, q_blocks,
+kv_blocks) with the kv axis innermost; VMEM scratch accumulator/row
+stats persist across the innermost grid dimension (TPU grids execute
+sequentially per core), so the [S, S] score matrix never exists.  The
+row logsumexp (LSE) is emitted as a second output for the backward.
 
-Backward: flash-recompute via ``jax.custom_vjp`` — the VJP re-runs the
-XLA attention under ``jax.vjp``.  XLA rematerializes it inside the
-fused backward, which is the standard memory/FLOPs trade on TPU; a
-dedicated pallas backward kernel is a later optimization.
+Backward (FlashAttention-2 style, two kernels — neither materializes
+[S, S]):
+
+- ``dq``:  grid (B, H, q_blocks, kv_blocks); streams K/V blocks per Q
+  block, recomputes P = exp(S - LSE), accumulates
+  dQ += (P * (dO V^T - delta)) K * scale.
+- ``dkv``: grid (B, H, kv_blocks, q_blocks); streams Q/dO blocks per
+  KV block, accumulates dV += P^T dO and dK += dS^T Q * scale.
+
+``delta = rowsum(dO * O)`` is precomputed in XLA (one fused elementwise
+pass).  Fully-masked causal blocks are skipped via ``pl.when`` in all
+three kernels.
 """
 
 from __future__ import annotations
@@ -28,9 +35,30 @@ BLOCK_KV = 128
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  causal: bool, scale: float, block_q: int,
-                  block_kv: int, q_shift: int):
+def _interpret() -> bool:
+    return bool(os.environ.get("POLYAXON_TPU_FLASH_INTERPRET"))
+
+
+def _causal_needed(iq, ikv, block_q, block_kv, q_shift):
+    return ikv * block_kv <= iq * block_q + q_shift + block_q - 1
+
+
+def _block_ids(iq, ikv, block_q, block_kv, q_shift):
+    q_ids = q_shift + iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    k_ids = ikv * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    return q_ids, k_ids
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                l_ref, *, causal: bool, scale: float, block_q: int,
+                block_kv: int, q_shift: int):
     iq = pl.program_id(2)
     ikv = pl.program_id(3)
     n_kv = pl.num_programs(3)
@@ -41,12 +69,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: a KV block strictly above the diagonal band contributes
-    # nothing for every row of this Q block — skip the matmuls entirely.
-    # q_shift = Sk - Sq implements bottom-right mask alignment (matches
-    # _xla_attention when Sq != Sk, e.g. decode suffixes).
-    needed = (not causal) or (
-        ikv * block_kv <= iq * block_q + q_shift + block_q - 1)
+    needed = (not causal) or _causal_needed(iq, ikv, block_q, block_kv,
+                                            q_shift)
 
     @pl.when(needed)
     def _compute():
@@ -55,24 +79,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0, 0]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bkv]
+            preferred_element_type=jnp.float32) * scale
         if causal:
-            q_ids = q_shift + iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            k_ids = ikv * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
+            q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             scores = jnp.where(q_ids >= k_ids, scores, NEG_INF)
 
-        m_prev = m_ref[:, :1]                      # [bq, 1]
+        m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(scores - m_new)                # [bq, bkv]
-        correction = jnp.exp(m_prev - m_new)       # [bq, 1]
+        p = jnp.exp(scores - m_new)
+        correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, -1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)    # [bq, D]
+            preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * correction + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -80,12 +101,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(ikv == n_kv - 1)
     def _finalize():
         l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(safe_l)
+        lse = jnp.where(l == 0.0, NEG_INF, lse)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float):
-    """q/k/v: [B, H, S, D] (head-major for contiguous blocks)."""
+    """q/k/v: [B, H, S, D] -> (out, lse[B, H, Sq, 128])."""
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(BLOCK_Q, sq)
@@ -98,9 +122,9 @@ def _flash_forward(q, k, v, causal: bool, scale: float):
     grid = (batch, heads, sq // block_q, sk // block_kv)
 
     kernel = functools.partial(
-        _flash_kernel, causal=causal, scale=scale, block_q=block_q,
+        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
         block_kv=block_kv, q_shift=sk - sq)
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -111,9 +135,18 @@ def _flash_forward(q, k, v, causal: bool, scale: float):
             pl.BlockSpec((1, 1, block_kv, d),
                          lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            # LSE rides a 128-lane minor dim (TPU-friendly); column 0
+            # is the value.
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, sq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -123,35 +156,202 @@ def _flash_forward(q, k, v, causal: bool, scale: float):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
-        # CPU tests run the kernel in the pallas interpreter (same code
+        # CPU tests run the kernels in the pallas interpreter (same code
         # path the TPU compiles) — see tests/test_ops.py.
-        interpret=bool(os.environ.get("POLYAXON_TPU_FLASH_INTERPRET")),
+        interpret=_interpret(),
     )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, causal: bool, scale: float,
+                   block_q: int, block_kv: int, q_shift: int):
+    iq = pl.program_id(2)
+    ikv = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(ikv == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = (not causal) or _causal_needed(iq, ikv, block_q, block_kv,
+                                            q_shift)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]      # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]  # [bq, 1]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(scores - lse)       # exp(NEG_INF-ish) -> 0
+        if causal:
+            q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
+            p = jnp.where(q_ids >= k_ids, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bkv]
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ikv == n_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                    scale: float, block_q: int, block_kv: int,
+                    q_shift: int):
+    ikv = pl.program_id(2)
+    iq = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = (not causal) or _causal_needed(iq, ikv, block_q, block_kv,
+                                            q_shift)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(scores - lse)
+        if causal:
+            q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
+            p = jnp.where(q_ids >= k_ids, p, 0.0)
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
+    batch, heads, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(BLOCK_Q, sq)
+    block_kv = min(BLOCK_KV, sk)
+    q_shift = sk - sq
+
+    # delta = rowsum(dO * O): one fused XLA pass, [B, H, Sq, 128].
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (batch, heads, sq, 128))
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 128),
+                           lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_kv=block_kv,
+                          q_shift=q_shift),
+        grid=(batch, heads, sq // block_q, sk // block_kv),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # kv-major grid: same block index maps with (i=kv block, j=q block).
+    qspec_t = pl.BlockSpec((1, 1, block_q, d),
+                           lambda b, h, i, j: (b, h, j, 0))
+    kspec_t = pl.BlockSpec((1, 1, block_kv, d),
+                           lambda b, h, i, j: (b, h, i, 0))
+    rowspec_t = pl.BlockSpec((1, 1, block_q, 128),
+                             lambda b, h, i, j: (b, h, j, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_kv=block_kv,
+                          q_shift=q_shift),
+        grid=(batch, heads, sk // block_kv, sq // block_q),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t,
+                  rowspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom VJP + public API
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
-    return _flash_forward(q, k, v, causal, scale)
+    out, _ = _flash_forward(q, k, v, causal, scale)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash_forward(q, k, v, causal, scale), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, res, g):
-    from .attention import _xla_attention
-    q, k, v = res
+    q, k, v, o, lse = res
+    if os.environ.get("POLYAXON_TPU_FLASH_XLA_BWD"):
+        # Escape hatch: XLA-recompute backward (materializes [S, S]).
+        from .attention import _xla_attention
 
-    def ref(q, k, v):
-        # _xla_attention takes BSHD; transpose round-trip keeps the
-        # public BHSD convention of this module.
-        out = _xla_attention(q.transpose(0, 2, 1, 3),
-                             k.transpose(0, 2, 1, 3),
-                             v.transpose(0, 2, 1, 3), None, causal, scale)
-        return out.transpose(0, 2, 1, 3)
+        def ref(q, k, v):
+            out = _xla_attention(q.transpose(0, 2, 1, 3),
+                                 k.transpose(0, 2, 1, 3),
+                                 v.transpose(0, 2, 1, 3), None, causal,
+                                 scale)
+            return out.transpose(0, 2, 1, 3)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(ref, q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, o, lse, g, causal, scale)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -161,7 +361,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: float = 1.0) -> jax.Array:
     """Flash attention over BSHD tensors (public convention).
 
-    Transposes to head-major BHSD for the kernel so each (q-block,
+    Transposes to head-major BHSD for the kernels so each (q-block,
     kv-block) tile is contiguous in VMEM, and back on the way out.
     """
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
