@@ -23,6 +23,7 @@ static void on_signal(int) { g_stop = 1; }
 int main(int argc, char** argv) {
   std::string cluster_dir;
   int poll_ms = 100;
+  int grace_ms = 10000;
   bool once = false;
 
   for (int i = 1; i < argc; ++i) {
@@ -31,10 +32,13 @@ int main(int argc, char** argv) {
       cluster_dir = argv[++i];
     } else if (arg == "--poll-ms" && i + 1 < argc) {
       poll_ms = std::atoi(argv[++i]);
+    } else if (arg == "--grace-ms" && i + 1 < argc) {
+      grace_ms = std::atoi(argv[++i]);
     } else if (arg == "--once") {
       once = true;
     } else if (arg == "--help") {
-      std::cout << "ptpu-operator --cluster-dir DIR [--poll-ms N] [--once]\n";
+      std::cout << "ptpu-operator --cluster-dir DIR [--poll-ms N]"
+                   " [--grace-ms N] [--once]\n";
       return 0;
     } else {
       std::cerr << "unknown arg: " << arg << "\n";
@@ -49,7 +53,7 @@ int main(int argc, char** argv) {
   std::signal(SIGTERM, on_signal);
   std::signal(SIGINT, on_signal);
 
-  ptpu::LocalProcessRuntime runtime;
+  ptpu::LocalProcessRuntime runtime(grace_ms);
   ptpu::Reconciler reconciler(cluster_dir, &runtime);
 
   do {

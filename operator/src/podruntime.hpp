@@ -19,6 +19,7 @@
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -61,12 +62,22 @@ class PodRuntime {
   virtual int launch(const PodSpec& spec) = 0;
   virtual PodPhase poll(int pod_id) = 0;
   virtual int exit_code(int pod_id) = 0;
+  // Non-blocking SIGTERM: starts the grace clock so several pods can
+  // drain concurrently (gang teardown sends this to every pod first).
+  virtual void terminate_pod(int pod_id) {(void)pod_id;}
   virtual void kill_pod(int pod_id) = 0;
   virtual void remove(int pod_id) = 0;
 };
 
 class LocalProcessRuntime : public PodRuntime {
  public:
+  // grace_ms: time between SIGTERM and SIGKILL.  The framework's
+  // preemption design (checkpoint.install_preemption_hook) relies on the
+  // trainer seeing SIGTERM and flushing a final checkpoint — an immediate
+  // SIGKILL would defeat it (ADVICE r1).  Equivalent of k8s
+  // terminationGracePeriodSeconds.
+  explicit LocalProcessRuntime(int grace_ms = 10000) : grace_ms_(grace_ms) {}
+
   int launch(const PodSpec& spec) override {
     int id = next_id_++;
     Pod pod;
@@ -112,17 +123,43 @@ class LocalProcessRuntime : public PodRuntime {
     return it == pods_.end() ? -1 : it->second.exit_code;
   }
 
+  void terminate_pod(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    if (it == pods_.end()) return;
+    Pod& pod = it->second;
+    if (pod.pid > 0 && !pod.term_sent) {
+      // Each pod is its own process group (setpgid in spawn): signal the
+      // whole group.  SIGTERM starts the grace clock so a preemption
+      // hook can flush its checkpoint before kill_pod escalates.
+      ::kill(-pod.pid, SIGTERM);
+      pod.term_sent = true;
+      pod.term_monotonic_ms = now_ms();
+    }
+  }
+
   void kill_pod(int pod_id) override {
     auto it = pods_.find(pod_id);
     if (it == pods_.end()) return;
     Pod& pod = it->second;
     if (pod.pid > 0) {
-      // Each pod is its own process group (setpgid in spawn): signal the
-      // whole group so shell-wrapped trainers can't survive as orphans.
-      ::kill(-pod.pid, SIGTERM);
-      ::kill(-pod.pid, SIGKILL);
+      terminate_pod(pod_id);
+      // Wait out whatever remains of the grace period (50ms polls),
+      // then SIGKILL the whole GROUP unconditionally — even if the
+      // leader already exited, descendants that ignored SIGTERM must
+      // not survive as orphans holding the TPU.
       int status = 0;
-      waitpid(pod.pid, &status, 0);
+      bool reaped = false;
+      while (true) {
+        pid_t r = waitpid(pod.pid, &status, WNOHANG);
+        if (r == pod.pid) {
+          reaped = true;
+          break;
+        }
+        if (now_ms() - pod.term_monotonic_ms >= grace_ms_) break;
+        usleep(50 * 1000);
+      }
+      ::kill(-pod.pid, SIGKILL);
+      if (!reaped) waitpid(pod.pid, &status, 0);
       pod.pid = -1;
     }
     pod.exit_code = 137;
@@ -138,7 +175,15 @@ class LocalProcessRuntime : public PodRuntime {
     pid_t pid = -1;
     int exit_code = -1;
     PodPhase phase = PodPhase::Pending;
+    bool term_sent = false;
+    long long term_monotonic_ms = 0;
   };
+
+  static long long now_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<long long>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+  }
 
   void advance(Pod& pod) {
     const ContainerSpec& c =
@@ -157,7 +202,14 @@ class LocalProcessRuntime : public PodRuntime {
   static pid_t spawn(const ContainerSpec& c, const std::string& log_path) {
     if (c.argv.empty()) return -1;
     pid_t pid = fork();
-    if (pid != 0) return pid;
+    if (pid > 0) {
+      // Set the group from BOTH sides (races with the child's own
+      // setpgid); whichever runs first wins, and a group-signal sent
+      // right after launch can never hit the operator's group.
+      setpgid(pid, pid);
+      return pid;
+    }
+    if (pid < 0) return pid;
 
     // child: lead a fresh process group so kill_pod can signal the tree
     setpgid(0, 0);
@@ -181,6 +233,7 @@ class LocalProcessRuntime : public PodRuntime {
     _exit(127);
   }
 
+  int grace_ms_ = 10000;
   int next_id_ = 1;
   std::map<int, Pod> pods_;
 };

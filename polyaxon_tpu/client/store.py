@@ -20,11 +20,24 @@ from __future__ import annotations
 import fcntl
 import json
 import os
+import re
 import time
 import uuid as uuidlib
 from typing import Any, Dict, Iterator, List, Optional
 
 from ..lifecycle import V1StatusCondition, V1Statuses, can_transition
+
+# Identifiers that become path components (run uuids, replica names, event
+# kinds).  The store is exposed over the network by the control-plane API,
+# so a traversal segment here would be remote file write/delete.
+_SAFE_ID = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
+
+
+def check_safe_id(value: str, what: str = "run_uuid") -> str:
+    if not isinstance(value, str) or not _SAFE_ID.match(value) \
+            or value in (".", ".."):
+        raise StoreError(f"Invalid {what}: {value!r}")
+    return value
 
 
 def default_home() -> str:
@@ -66,7 +79,7 @@ class FileRunStore:
     # -- paths ------------------------------------------------------------
 
     def run_path(self, run_uuid: str) -> str:
-        return os.path.join(self.runs_root, run_uuid)
+        return os.path.join(self.runs_root, check_safe_id(run_uuid))
 
     def artifacts_path(self, run_uuid: str) -> str:
         return os.path.join(self.run_path(run_uuid), "artifacts")
@@ -75,11 +88,15 @@ class FileRunStore:
         return os.path.join(self.artifacts_path(run_uuid), "outputs")
 
     def events_path(self, run_uuid: str, kind: str, name: str) -> str:
-        safe = name.replace("/", "__")
+        safe = name.replace("/", "__").replace("\\", "__").replace("\0", "_")
+        check_safe_id(kind, "event kind")
+        if safe in (".", ".."):
+            safe = safe + "_"
         return os.path.join(self.run_path(run_uuid), "events", kind,
                             f"{safe}.jsonl")
 
     def logs_path(self, run_uuid: str, replica: str = "main") -> str:
+        check_safe_id(replica, "replica")
         return os.path.join(self.run_path(run_uuid), "logs", f"{replica}.log")
 
     def _meta_path(self, run_uuid: str) -> str:
@@ -287,7 +304,8 @@ class FileRunStore:
         out: Dict[str, List[str]] = {}
         if not os.path.isdir(root):
             return out
-        kinds = [kind] if kind else sorted(os.listdir(root))
+        kinds = [check_safe_id(kind, "event kind")] if kind \
+            else sorted(os.listdir(root))
         for k in kinds:
             kdir = os.path.join(root, k)
             if os.path.isdir(kdir):
@@ -318,6 +336,8 @@ class FileRunStore:
         root = os.path.join(self.run_path(run_uuid), "logs")
         if not os.path.isdir(root):
             return ""
+        if replica is not None:
+            check_safe_id(replica, "replica")
         files = sorted(os.listdir(root)) if replica is None else [f"{replica}.log"]
         chunks = []
         for fname in files:

@@ -51,6 +51,18 @@ def _config_path() -> str:
     return os.path.join(home_dir(), "config.json")
 
 
+def _write_config(path: str, payload: Dict[str, Any]) -> None:
+    """Atomic write, 0600: config.json may hold the API bearer token, so
+    it must not be readable by other local users (ADVICE r1)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    os.chmod(path, 0o600)
+
+
 def _coerce(key: str, value: Any) -> Any:
     if value is None or not isinstance(value, str):
         return value
@@ -108,13 +120,9 @@ class ClientConfig:
     def save(self) -> str:
         """Persist to the home config file (the `config set` surface)."""
         path = _config_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
         payload = {k: v for k, v in dataclasses.asdict(self).items()
                    if v not in (None, {}, [])}
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f, indent=2, sort_keys=True)
-        os.replace(tmp, path)
+        _write_config(path, payload)
         return path
 
     @classmethod
@@ -130,11 +138,7 @@ class ClientConfig:
                     f"{sorted(_ENV_KEYS)}")
             stored[key] = _coerce(key, raw)
         path = _config_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(stored, f, indent=2, sort_keys=True)
-        os.replace(tmp, path)
+        _write_config(path, stored)
         return path
 
     @classmethod
@@ -144,11 +148,7 @@ class ClientConfig:
         for key in keys:
             stored.pop(key, None)
         path = _config_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(stored, f, indent=2, sort_keys=True)
-        os.replace(tmp, path)
+        _write_config(path, stored)
         return path
 
     def set_value(self, key: str, raw: str) -> None:

@@ -25,6 +25,11 @@ class DeploymentConfig:
     artifacts_claim: Optional[str] = None
     service_account: str = "polyaxon-tpu"
     env: Dict[str, str] = field(default_factory=dict)
+    # The API listens on 0.0.0.0 behind a Service, so a bearer token is
+    # mandatory in-cluster (ADVICE r1: unauthenticated remote store access).
+    # None -> a random token is generated at render time.
+    auth_secret_name: str = "polyaxon-tpu-auth"
+    auth_token: Optional[str] = None
 
 
 def _meta(name: str, config: DeploymentConfig) -> Dict[str, Any]:
@@ -37,9 +42,33 @@ def _meta(name: str, config: DeploymentConfig) -> Dict[str, Any]:
 
 
 def _env_list(config: DeploymentConfig,
-              extra: Optional[Dict[str, str]] = None) -> List[Dict[str, str]]:
+              extra: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
     env = {**config.env, **(extra or {})}
-    return [{"name": k, "value": v} for k, v in sorted(env.items())]
+    out: List[Dict[str, Any]] = [{"name": k, "value": v}
+                                 for k, v in sorted(env.items())]
+    out.append({"name": "POLYAXON_TPU_AUTH_TOKEN",
+                "valueFrom": {"secretKeyRef":
+                              {"name": config.auth_secret_name,
+                               "key": "token"}}})
+    return out
+
+
+def auth_secret(config: DeploymentConfig) -> Dict[str, Any]:
+    """Pass ``auth_token`` (or export POLYAXON_TPU_AUTH_TOKEN) to keep the
+    credential stable across re-renders; otherwise each render generates a
+    fresh token, which rotates the cluster credential on re-apply."""
+    import os as _os
+    import secrets as _secrets
+
+    token = config.auth_token \
+        or _os.environ.get("POLYAXON_TPU_AUTH_TOKEN") \
+        or _secrets.token_hex(24)
+    return {
+        "apiVersion": "v1", "kind": "Secret",
+        "metadata": _meta(config.auth_secret_name, config),
+        "type": "Opaque",
+        "stringData": {"token": token},
+    }
 
 
 def crd() -> Dict[str, Any]:
@@ -211,6 +240,7 @@ def render_all(config: Optional[DeploymentConfig] = None
         {"apiVersion": "v1", "kind": "Namespace",
          "metadata": {"name": config.namespace}},
         crd(),
+        auth_secret(config),
     ]
     manifests += rbac(config)
     manifests += control_plane(config)

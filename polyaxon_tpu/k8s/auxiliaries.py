@@ -158,4 +158,9 @@ def get_volumes(
             "emptyDir": {"medium": "Memory"},
         })
     volumes.extend(extra or [])
-    return volumes
+    # Dedupe at the merge point: builtin + user section + connection
+    # volumes can collide on name (e.g. two connections sharing one
+    # secret), and the k8s API rejects duplicate volumes[].name.
+    seen: set = set()
+    return [v for v in volumes
+            if not (v["name"] in seen or seen.add(v["name"]))]

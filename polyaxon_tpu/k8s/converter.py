@@ -171,6 +171,14 @@ def _pod_spec(
             res_volumes, res_mounts = catalog.resource_volumes_for(conn_name)
             conn_volumes.extend(res_volumes)
             conn_mounts.extend(res_mounts)
+        # Volumes dedupe by name inside get_volumes (the merge point);
+        # mounts dedupe here since duplicate (volume, path) pairs within
+        # one container are redundant (e.g. two connections sharing a
+        # secret at the same mount_path).
+        seen: set = set()
+        conn_mounts = [m for m in conn_mounts
+                       if not ((m["name"], m.get("mountPath")) in seen
+                               or seen.add((m["name"], m.get("mountPath"))))]
 
     pod: Dict[str, Any] = {
         "restartPolicy": "Never",

@@ -236,7 +236,18 @@ class _Handler(BaseHTTPRequestHandler):
     def _h_healthz(self, body, params):
         return {"status": "ok"}
 
+    _CREATE_FIELDS = frozenset({
+        "name", "project", "description", "tags", "content", "kind",
+        "pipeline", "meta_info", "run_uuid", "managed_by",
+    })
+
     def _h_create_run(self, body, params):
+        # Whitelist kwargs: the store signature is not a network contract,
+        # and run_uuid is additionally validated as a safe path id inside
+        # the store (ADVICE r1: unauthenticated path traversal).
+        unknown = set(body) - self._CREATE_FIELDS
+        if unknown:
+            raise ApiError(400, f"unknown fields: {sorted(unknown)}")
         return self.plane.store.create_run(**body)
 
     def _h_list_runs(self, body, params):

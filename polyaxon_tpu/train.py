@@ -64,8 +64,40 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--cpu", action="store_true",
                    help="Force the CPU backend.")
     p.add_argument("--target-metric", default=None,
-                   help="name=value: exit once the metric reaches value.")
+                   help="name>=value or name<=value (plain name=value "
+                        "infers direction: loss/error/perplexity-like "
+                        "names minimize, everything else maximizes); "
+                        "exit once the metric reaches value.")
     return p
+
+
+_MINIMIZE_HINTS = ("loss", "error", "err", "perplexity", "ppl", "nll",
+                   "mse", "mae", "rmse")
+
+
+def parse_target_metric(spec):
+    """``name>=value`` / ``name<=value`` / ``name=value`` -> (name, value,
+    op).  A plain ``=`` infers direction from the metric name: a
+    minimizing target like ``loss=0.1`` must NOT be satisfied by the
+    (large) initial loss (ADVICE r1)."""
+    if not spec or "=" not in spec:
+        return None
+    if ">=" in spec:
+        name, _, val = spec.partition(">=")
+        op = ">="
+    elif "<=" in spec:
+        name, _, val = spec.partition("<=")
+        op = "<="
+    else:
+        name, _, val = spec.partition("=")
+        lowered = name.strip().lower()
+        op = "<=" if any(h in lowered for h in _MINIMIZE_HINTS) else ">="
+    return (name.strip(), float(val), op)
+
+
+def target_reached(value, target) -> bool:
+    _, threshold, op = target
+    return value <= threshold if op == "<=" else value >= threshold
 
 
 def make_optimizer(name: str, lr: float):
@@ -175,10 +207,7 @@ def _main(argv=None) -> int:
     batch = jax.device_put(batch, step_fn.batch_sharding)
     rng = jax.random.PRNGKey(args.seed)
 
-    target = None
-    if args.target_metric and "=" in args.target_metric:
-        tname, _, tval = args.target_metric.partition("=")
-        target = (tname.strip(), float(tval))
+    target = parse_target_metric(args.target_metric)
 
     unit = "tok" if "inputs" in batch and batch["inputs"].ndim == 2 \
         else "img"
@@ -213,8 +242,9 @@ def _main(argv=None) -> int:
             t_block = time.perf_counter()
             block_start = step + 1
             if target and target[0] in metrics and \
-                    metrics[target[0]] >= target[1]:
-                print(f"target {target[0]}>={target[1]} reached", flush=True)
+                    target_reached(metrics[target[0]], target):
+                print(f"target {target[0]}{target[2]}{target[1]} reached",
+                      flush=True)
                 break
 
     # A profile window reaching past the last step still finalizes.

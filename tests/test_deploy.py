@@ -34,7 +34,7 @@ class TestDeployManifests:
         api = next(m for m in manifests
                    if m["kind"] == "Deployment"
                    and m["metadata"]["name"] == "polyaxon-tpu-api")
-        env = {e["name"]: e["value"] for e in
+        env = {e["name"]: e.get("value") for e in
                api["spec"]["template"]["spec"]["containers"][0]["env"]}
         assert env["POLYAXON_TPU_HOME"] == "/ptpu-artifacts"
 
@@ -44,7 +44,7 @@ class TestDeployManifests:
         agent = next(m for m in manifests
                      if m["kind"] == "Deployment"
                      and m["metadata"]["name"] == "polyaxon-tpu-agent")
-        env = {e["name"]: e["value"] for e in
+        env = {e["name"]: e.get("value") for e in
                agent["spec"]["template"]["spec"]["containers"][0]["env"]}
         assert env["POLYAXON_TPU_HOST"] == \
             "http://polyaxon-tpu-api.ns2:9001"

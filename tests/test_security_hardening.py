@@ -1,0 +1,199 @@
+"""Security/robustness regression tests for the ADVICE r1 findings.
+
+Covers: path-traversal rejection in the file store, create-run kwarg
+whitelisting at the API boundary, the rendered auth secret, 0600 perms
+on the token-bearing config file, direction-aware --target-metric, and
+connection volume dedup in the converter.
+"""
+
+import json
+import os
+import stat
+
+import pytest
+
+from polyaxon_tpu.client.store import FileRunStore, StoreError, check_safe_id
+
+
+@pytest.fixture
+def store(tmp_path):
+    return FileRunStore(str(tmp_path / "home"))
+
+
+class TestStorePathSafety:
+    @pytest.mark.parametrize("bad", [
+        "../evil", "..", ".", "a/b", "a\\b", "", "x" * 65, "run\0x",
+        "/etc/passwd",
+    ])
+    def test_run_path_rejects_traversal(self, store, bad):
+        with pytest.raises(StoreError):
+            store.run_path(bad)
+
+    def test_create_run_rejects_traversal_uuid(self, store):
+        with pytest.raises(StoreError):
+            store.create_run(run_uuid="../../outside")
+        assert not os.path.exists(str(store.home) + "/../outside")
+
+    def test_delete_run_rejects_traversal(self, store, tmp_path):
+        victim = tmp_path / "victim"
+        victim.mkdir()
+        (victim / "data.txt").write_text("keep me")
+        with pytest.raises(StoreError):
+            store.delete_run("../../victim")
+        assert (victim / "data.txt").exists()
+
+    def test_logs_and_events_paths_validate_components(self, store):
+        run = store.create_run()
+        with pytest.raises(StoreError):
+            store.logs_path(run["uuid"], replica="../../oops")
+        with pytest.raises(StoreError):
+            store.events_path(run["uuid"], "../oops", "m")
+
+    def test_read_paths_validate_components(self, store):
+        run = store.create_run()
+        with pytest.raises(StoreError):
+            store.read_logs(run["uuid"], replica="../../other/logs/main")
+        with pytest.raises(StoreError):
+            store.list_events(run["uuid"], kind="../../../../tmp")
+
+    def test_normal_ids_still_work(self, store):
+        check_safe_id("abc123DEF_-.")
+        run = store.create_run(run_uuid="my-run_01")
+        assert run["uuid"] == "my-run_01"
+        store.append_events(run["uuid"], "metric", "train/loss",
+                            [{"step": 0, "value": 1.0}])
+        assert store.read_events(run["uuid"], "metric", "train/loss")
+
+
+class TestApiCreateWhitelist:
+    def _plane(self, tmp_path):
+        from polyaxon_tpu.scheduler.api import ControlPlane, make_server
+
+        plane = ControlPlane(FileRunStore(str(tmp_path / "home")))
+        return make_server(port=0, plane=plane)
+
+    def test_unknown_fields_rejected(self, tmp_path):
+        import threading
+        import urllib.request
+
+        server = self._plane(tmp_path)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        port = server.server_address[1]
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/runs",
+                data=json.dumps({"name": "x", "home": "/pwned"}).encode(),
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+
+            # traversal run_uuid through the API surfaces as 404, no file
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/runs",
+                data=json.dumps({"run_uuid": "../../pwn"}).encode(),
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code in (400, 404)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestDeployAuthSecret:
+    def test_secret_rendered_and_wired(self):
+        from polyaxon_tpu.deploy import DeploymentConfig, render_all
+
+        manifests = render_all(DeploymentConfig(auth_token="tok123"))
+        secret = next(m for m in manifests if m["kind"] == "Secret")
+        assert secret["stringData"]["token"] == "tok123"
+        for name in ("polyaxon-tpu-api", "polyaxon-tpu-agent"):
+            dep = next(m for m in manifests if m["kind"] == "Deployment"
+                       and m["metadata"]["name"] == name)
+            env = dep["spec"]["template"]["spec"]["containers"][0]["env"]
+            ref = next(e for e in env
+                       if e["name"] == "POLYAXON_TPU_AUTH_TOKEN")
+            assert ref["valueFrom"]["secretKeyRef"]["key"] == "token"
+
+    def test_token_generated_when_absent(self):
+        from polyaxon_tpu.deploy import DeploymentConfig, auth_secret
+
+        token = auth_secret(DeploymentConfig())["stringData"]["token"]
+        assert len(token) >= 32
+
+
+class TestConfigFilePerms:
+    def test_config_written_0600(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        from polyaxon_tpu.config import ClientConfig
+
+        path = ClientConfig.set_file_values({"token": "secret-token"})
+        mode = stat.S_IMODE(os.stat(path).st_mode)
+        assert mode == 0o600
+        path = ClientConfig(token="t2").save()
+        assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+
+
+class TestTargetMetricDirection:
+    def test_loss_equals_infers_minimize(self):
+        from polyaxon_tpu.train import parse_target_metric, target_reached
+
+        target = parse_target_metric("loss=0.1")
+        assert target[2] == "<="
+        assert not target_reached(2.5, target)   # initial loss: keep going
+        assert target_reached(0.05, target)
+
+    def test_accuracy_equals_infers_maximize(self):
+        from polyaxon_tpu.train import parse_target_metric, target_reached
+
+        target = parse_target_metric("accuracy=0.95")
+        assert target[2] == ">="
+        assert not target_reached(0.10, target)
+        assert target_reached(0.97, target)
+
+    def test_explicit_operators(self):
+        from polyaxon_tpu.train import parse_target_metric, target_reached
+
+        t = parse_target_metric("score<=3")
+        assert t == ("score", 3.0, "<=") and target_reached(2, t)
+        t = parse_target_metric("loss>=10")  # explicit op wins over hint
+        assert t == ("loss", 10.0, ">=") and target_reached(11, t)
+        assert parse_target_metric(None) is None
+        assert parse_target_metric("nonsense") is None
+
+
+class TestConverterVolumeDedup:
+    def test_shared_secret_deduped(self, tmp_path):
+        from polyaxon_tpu.compiler import resolve
+        from polyaxon_tpu.connections import ConnectionCatalog, V1Connection
+        from polyaxon_tpu.k8s.converter import ConverterConfig, convert
+        from polyaxon_tpu.polyaxonfile import get_op_from_files
+
+        spec = tmp_path / "job.yaml"
+        spec.write_text("""
+kind: component
+name: train
+run:
+  kind: job
+  connections: [gcs-a, gcs-b]
+  container: {image: jax:latest, command: [python, t.py]}
+""")
+        shared = {"name": "shared-sa", "mount_path": "/secrets/gcp"}
+        catalog = ConnectionCatalog([
+            V1Connection(name="gcs-a", kind="gcs",
+                         schema_={"bucket": "gs://a"}, secret=shared),
+            V1Connection(name="gcs-b", kind="gcs",
+                         schema_={"bucket": "gs://b"}, secret=shared),
+        ])
+        op = get_op_from_files(str(spec))
+        compiled = resolve(op, run_uuid="dd1")
+        cr = convert(compiled, "dd1",
+                     config=ConverterConfig(catalog=catalog))
+        pod = cr["spec"]["template"]["spec"]
+        names = [v["name"] for v in pod["volumes"]]
+        assert names.count("secret-shared-sa") == 1
+        mounts = [m for m in pod["containers"][0]["volumeMounts"]
+                  if m["name"] == "secret-shared-sa"]
+        assert len(mounts) == 1
