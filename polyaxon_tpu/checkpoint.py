@@ -57,6 +57,10 @@ class CheckpointManager:
         self._ocp = ocp
         self._manager = ocp.CheckpointManager(self.directory,
                                               options=options)
+        # Set by the SIGTERM hook when an immediate save is impossible
+        # (state donated into an in-flight step); the training loop
+        # polls it and saves cooperatively.
+        self.preempt_requested = False
 
     # -- save/restore ----------------------------------------------------
 
@@ -120,28 +124,48 @@ class CheckpointManager:
     # -- preemption ------------------------------------------------------
 
     def install_preemption_hook(self, get_state, get_step) -> None:
-        """SIGTERM -> synchronous forced save (TPU reclaim notice).
+        """SIGTERM -> forced save (TPU reclaim notice).
 
         ``get_state``/``get_step`` are callables so the hook always saves
         the *current* state, not the one at install time.
+
+        With buffer donation the signal can land in the window where the
+        bound state was already donated into an in-flight step (its
+        arrays are deleted).  The handler then CANNOT save immediately —
+        and it cannot wait either, since the new state is only bound
+        once the handler returns.  It sets ``preempt_requested`` instead
+        and returns; the training loop checks the flag after each step,
+        saves the fresh (undonated) output state, and exits within the
+        operator's SIGTERM grace period.
         """
         prev = signal.getsignal(signal.SIGTERM)
 
+        def terminate(signum):
+            if callable(prev):
+                prev(signum, None)
+            else:
+                # SIG_DFL/SIG_IGN are not callable: restore and
+                # re-raise so the process actually terminates
+                # (otherwise graceful stops hang until SIGKILL).
+                signal.signal(signum, prev or signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
         def handler(signum, frame):
+            logger.warning("preemption notice: forcing checkpoint")
             try:
-                logger.warning("preemption notice: forcing checkpoint")
                 self.save(int(get_step()), get_state(), force=True)
                 self.wait()
-            finally:
-                if callable(prev):
-                    prev(signum, frame)
-                else:
-                    # SIG_DFL/SIG_IGN are not callable: restore and
-                    # re-raise so the process actually terminates
-                    # (otherwise graceful stops hang until SIGKILL).
-                    signal.signal(signum, prev or signal.SIG_DFL)
-                    os.kill(os.getpid(), signum)
+            except Exception:
+                # Donated/deleted buffers (or a mid-save failure): defer
+                # to the cooperative path in the training loop.
+                logger.warning(
+                    "immediate preemption save failed (state donated "
+                    "into an in-flight step?); deferring to the loop")
+                self.preempt_requested = True
+                return
+            terminate(signum)
 
+        self.preempt_requested = False
         signal.signal(signal.SIGTERM, handler)
 
 

@@ -17,6 +17,7 @@ from dataclasses import dataclass
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..parallel.constraints import BATCH, constrain
 from .attention import dot_product_attention
 
 
@@ -33,6 +34,8 @@ class BertConfig:
     dtype: jnp.dtype = jnp.bfloat16
     # Backward-pass rematerialization (see GPT2Config.remat).
     remat: bool = False
+    # Roll the layer stack into one nn.scan'd block (see GPT2Config).
+    scan_layers: bool = True
 
     @staticmethod
     def base() -> "BertConfig":
@@ -54,11 +57,13 @@ class BertSelfAttention(nn.Module):
         head_dim = cfg.hidden_size // cfg.num_heads
         qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype,
                        name="qkv")(x)
+        qkv = constrain(qkv, BATCH, None, "tp")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = x.shape[:-1] + (cfg.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         out = dot_product_attention(q, k, v, mask=mask, causal=False)
         out = out.reshape(x.shape)
+        out = constrain(out, BATCH, None, "tp")
         return nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                         name="o_proj")(out)
 
@@ -73,13 +78,31 @@ class BertLayer(nn.Module):
         a = BertSelfAttention(cfg, name="attn")(x, mask)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_attn")(x + a)
+        x = constrain(x, BATCH, None, None)
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                      name="fc1")(x)
+        h = constrain(h, BATCH, None, "tp")
         h = nn.gelu(h)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_mlp")(x + h)
-        return x.astype(cfg.dtype)
+        return constrain(x.astype(cfg.dtype), BATCH, None, None)
+
+
+class _ScanLayer(nn.Module):
+    """nn.scan body: (carry, mask) -> (carry, None) around one BertLayer.
+
+    The mask rides as an ``nn.broadcast`` input (identical for every
+    layer), so scan carries only the activations.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cls = nn.remat(BertLayer, prevent_cse=False) if self.cfg.remat \
+            else BertLayer
+        return cls(self.cfg, name="layer")(x, mask), None
 
 
 class BertModel(nn.Module):
@@ -93,7 +116,7 @@ class BertModel(nn.Module):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                          dtype=cfg.dtype, name="embed")
-        x = embed(input_ids)
+        x = constrain(embed(input_ids), BATCH, None, None)
         pos = jnp.arange(input_ids.shape[-1])
         x = x + nn.Embed(cfg.max_position, cfg.hidden_size,
                          dtype=cfg.dtype, name="pos_embed")(pos)
@@ -104,13 +127,25 @@ class BertModel(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_embed")(x).astype(cfg.dtype)
 
+        x = constrain(x, BATCH, None, None)
         mask = None
         if attention_mask is not None:
             # [B, S] -> [B, 1, 1, S] additive-style boolean mask.
             mask = attention_mask[:, None, None, :].astype(bool)
-        layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
-        for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
+        if cfg.scan_layers:
+            layers = nn.scan(
+                _ScanLayer,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,  # the mask is shared by every layer
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            x, _ = layers(x, mask)
+        else:
+            layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
 
         # MLM head: transform then decode with the tied embedding.
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(x)

@@ -1,9 +1,10 @@
 """MNIST trainer — BASELINE config 1 entrypoint.
 
 Thin preset over the generic driver (``polyaxon_tpu.train``): the MLP
-classifier on 28x28x1 batches, tracked, checkpointed.  Real MNIST plugs
-in via ``--data-dir`` (inputs.npy/labels.npy); default is the synthetic
-deterministic batch (compute-identical shapes).
+classifier trained on REAL data by default — the offline ``digits``
+image set with a held-out eval split (MNIST itself cannot be downloaded
+in a zero-egress environment; actual MNIST .npy arrays plug in via
+``--data-dir``).
 """
 
 from __future__ import annotations
@@ -16,7 +17,8 @@ from ..train import main as train_main
 
 def main(argv=None) -> int:
     parser = build_argparser()
-    parser.set_defaults(model="mlp", optimizer="adamw", log_every=10)
+    parser.set_defaults(model="mlp", optimizer="adamw", log_every=10,
+                        dataset="digits", epochs=8, eval_every=40)
     args = parser.parse_args(argv)
     forwarded = []
     for key, value in vars(args).items():

@@ -13,6 +13,7 @@ mesh natively:
 """
 
 from .bootstrap import initialize_from_env, topology_from_env
+from .constraints import BATCH, ambient_mesh, constrain, current_mesh
 from .collectives import (
     all_gather,
     all_reduce,

@@ -1,0 +1,74 @@
+"""Activation sharding constraints (VERDICT r1 #2).
+
+Parameter shardings alone let XLA pick activation layouts per-op; on
+mixed dp×fsdp×tp meshes that produced "Involuntary full
+rematerialization" — a per-step full-tensor copy whenever consecutive
+ops disagreed on layout.  The fix is the standard GSPMD recipe: models
+pin their activation layouts with ``with_sharding_constraint`` so
+params and activations agree end-to-end.
+
+Models don't know the mesh, so the train-step machinery publishes it as
+an *ambient mesh* for the duration of tracing (a contextvar read at
+trace time, zero runtime cost).  ``constrain`` is a no-op when no mesh
+is ambient (single-device tests, plain ``model.apply``) and silently
+drops axis names the mesh doesn't have — model code stays
+strategy-agnostic.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Optional, Sequence, Tuple, Union
+
+AxisName = Union[None, str, Sequence[str]]
+
+_AMBIENT_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "ptpu_ambient_mesh", default=None)
+
+# The canonical batch-dim axes (matches mesh.active_batch_axes).
+BATCH: Tuple[str, ...] = ("dp", "fsdp")
+
+
+@contextlib.contextmanager
+def ambient_mesh(mesh):
+    """Publish ``mesh`` to ``constrain`` calls traced inside the block."""
+    token = _AMBIENT_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _AMBIENT_MESH.reset(token)
+
+
+def current_mesh():
+    return _AMBIENT_MESH.get()
+
+
+def constrain(x, *axes: AxisName):
+    """``with_sharding_constraint`` against the ambient mesh.
+
+    Each entry of ``axes`` is None, a mesh axis name, or a tuple of
+    names for one dimension of ``x`` (align with ``x.ndim``; trailing
+    dims may be omitted and stay unconstrained).  Names absent from the
+    ambient mesh, or present with size 1, are dropped — so
+    ``constrain(x, BATCH, None, "tp")`` is safe on any mesh.
+    """
+    mesh = _AMBIENT_MESH.get()
+    if mesh is None:
+        return x
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = []
+    for a in axes:
+        names = (a,) if isinstance(a, str) else tuple(a or ())
+        names = tuple(n for n in names if mesh.shape.get(n, 1) > 1)
+        spec.append(names if len(names) > 1
+                    else (names[0] if names else None))
+    ndim = getattr(x, "ndim", len(spec))
+    spec = spec[:ndim] + [None] * (ndim - len(spec))
+    if all(s is None for s in spec):
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))

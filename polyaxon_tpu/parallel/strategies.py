@@ -27,6 +27,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .constraints import ambient_mesh
 from .mesh import MeshSpec, build_mesh, data_sharding
 
 
@@ -44,9 +45,13 @@ TP_RULES: List[Tuple[str, Callable[[tuple], P]]] = [
      lambda shape: P(None, "tp")),
     (r"(fc1|wi|up_proj|gate_proj|intermediate)[^/]*/kernel",
      lambda shape: P(None, "tp")),
-    # Embeddings / LM head: shard the vocab dim.
+    # Embeddings / LM head: shard the vocab dim over BOTH tp and fsdp
+    # (axes of size 1 are no-ops).  Sharding the hidden dim instead makes
+    # every token lookup emit a hidden-sharded [B,S,H] that XLA can only
+    # reconcile with the batch-sharded residual stream by replicating the
+    # whole tensor (involuntary full rematerialization).
     (r"(embed|embedding|wte|lm_head)[^/]*/(embedding|kernel)",
-     lambda shape: P("tp", None)),
+     lambda shape: P(("tp", "fsdp"), None)),
 ]
 
 
@@ -75,14 +80,30 @@ def infer_param_spec(
     if tp:
         for pattern, builder in TP_RULES:
             if re.search(pattern, name):
-                cand = builder(shape)
-                cand_list = list(cand) + [None] * (len(shape) - len(cand))
-                spec = cand_list[:len(shape)]
+                cand = list(builder(shape))
+                # Right-align: rules describe the TRAILING (in, out) dims
+                # so scanned/stacked params ([layers, in, out]) shard the
+                # same way as flat ones — never the layer axis.
+                if len(cand) <= len(shape):
+                    spec = [None] * (len(shape) - len(cand)) + cand
+                else:
+                    spec = cand[len(cand) - len(shape):]
                 break
 
-    if fsdp and int(np.prod(shape or (1,))) >= fsdp_min_size:
-        # Shard the largest still-unsharded axis over fsdp.
-        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    def _names(entry):
+        return entry if isinstance(entry, tuple) else \
+            ((entry,) if entry else ())
+
+    fsdp_taken = any("fsdp" in _names(s) for s in spec)
+    if fsdp and not fsdp_taken and \
+            int(np.prod(shape or (1,))) >= fsdp_min_size:
+        # Shard the largest still-unsharded axis over fsdp, preferring
+        # the trailing two dims (the matmul dims): a scan-stacked layer
+        # axis is a poor fsdp axis (it would gather all layers at once).
+        matmul_dims = [i for i in range(max(0, len(shape) - 2), len(shape))]
+        lead_dims = [i for i in range(len(shape)) if i not in matmul_dims]
+        order = sorted(matmul_dims, key=lambda i: -shape[i]) + \
+            sorted(lead_dims, key=lambda i: -shape[i])
         for axis in order:
             if spec[axis] is None:
                 spec[axis] = "fsdp"
@@ -103,13 +124,18 @@ def make_param_shardings(
     def leaf_sharding(path, leaf):
         spec = infer_param_spec(path, leaf, tp=tp, fsdp=fsdp,
                                 fsdp_min_size=fsdp_min_size)
-        # Drop axes that don't divide the dim.
+        # Drop axes that don't divide the dim (tuple entries shrink
+        # greedily from the right until the product divides).
         shape = getattr(leaf, "shape", ())
         fixed = []
         for dim, ax in zip(shape, spec):
-            if ax is not None and dim % mesh.shape[ax] != 0:
-                ax = None
-            fixed.append(ax)
+            names = ax if isinstance(ax, tuple) else \
+                ((ax,) if ax else ())
+            while names and dim % int(np.prod(
+                    [mesh.shape[n] for n in names])) != 0:
+                names = names[:-1]
+            fixed.append(names if len(names) > 1
+                         else (names[0] if names else None))
         return NamedSharding(mesh, P(*fixed))
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, params)
@@ -152,10 +178,21 @@ class TrainStep:
                                                                  self.mesh)
         self.param_shardings = shardings
         params = jax.device_put(params, shardings)
+        # Optimizer state must be laid out exactly like the params it
+        # mirrors (adam mu/nu reuse the param subtree paths, so the same
+        # rule function yields the same specs); XLA-chosen layouts here
+        # caused involuntary-remat copies every step (VERDICT r1 #2).
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+        opt_shardings = make_param_shardings(opt_shapes, self.mesh)
         opt_state = jax.jit(
-            self.optimizer.init,
-            out_shardings=None,  # let XLA lay optimizer state like params
-        )(params)
+            self.optimizer.init, out_shardings=opt_shardings)(params)
+        from jax.sharding import NamedSharding
+
+        self.state_shardings = {
+            "params": shardings,
+            "opt_state": opt_shardings,
+            "step": NamedSharding(self.mesh, P()),
+        }
         return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}
 
@@ -215,17 +252,28 @@ class TrainStep:
                 metrics,
             )
 
+        # Pin the state layout on BOTH sides of the step: with free output
+        # shardings XLA may choose layouts for the updated params/opt
+        # state that disagree with the input layout, forcing a full
+        # copy-and-reshard every step (the involuntary-remat class of
+        # VERDICT r1 #2).  state_shardings exists once init_state ran,
+        # which all framework paths do before stepping.
+        state_shardings = getattr(self, "state_shardings", None)
         self._step = jax.jit(
             step,
             donate_argnums=(0,) if self._donate else (),
-            in_shardings=(None, self.batch_sharding, None),
+            in_shardings=(state_shardings, self.batch_sharding, None),
+            out_shardings=(state_shardings, None),
         )
         return self._step
 
     def __call__(self, state, batch, rng):
         if self._step is None:
             self._build()
-        return self._step(state, batch, rng)
+        # Tracing happens on the first call: publish the mesh so model
+        # activation `constrain` calls resolve against it (constraints.py).
+        with ambient_mesh(self.mesh):
+            return self._step(state, batch, rng)
 
 
 def make_train_step(

@@ -34,7 +34,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=None,
                    help="Total optimizer steps (overrides epochs).")
     p.add_argument("--epochs", type=int, default=1)
-    p.add_argument("--steps-per-epoch", type=int, default=100)
+    p.add_argument("--steps-per-epoch", type=int, default=None,
+                   help="Default: the dataset's epoch length; synthetic "
+                        "data keeps the historical 100-step epoch.")
     p.add_argument("--batch-size", type=int, default=None,
                    help="GLOBAL batch size (sharded over dp/fsdp).")
     p.add_argument("--lr", type=float, default=1e-3)
@@ -61,6 +63,16 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", default=None,
                    help="Directory of inputs.npy/labels.npy (else "
                         "synthetic).")
+    p.add_argument("--dataset", default=None,
+                   choices=["synthetic", "digits", "npy"],
+                   help="Input source (default: npy when --data-dir is "
+                        "given, else synthetic).  'digits' is the real "
+                        "offline 10-class image set (BASELINE config 1).")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="Steps between held-out evals (0 = end only; "
+                        "needs a dataset with an eval split).")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="Device-prefetch depth (0 disables).")
     p.add_argument("--cpu", action="store_true",
                    help="Force the CPU backend.")
     p.add_argument("--target-metric", default=None,
@@ -110,17 +122,44 @@ def make_optimizer(name: str, lr: float):
     return optax.adamw(lr, weight_decay=0.01)
 
 
-def load_data(spec, data_dir: Optional[str], batch_size: int):
-    import numpy as np
+def make_datasets(args, spec, batch_size: int):
+    """(train ArrayDataset, eval ArrayDataset or None)."""
+    from . import data
 
-    if data_dir:
-        inputs = np.load(os.path.join(data_dir, "inputs.npy"))
-        labels_path = os.path.join(data_dir, "labels.npy")
-        batch = {"inputs": inputs[:batch_size]}
-        if os.path.exists(labels_path):
-            batch["labels"] = np.load(labels_path)[:batch_size]
-        return batch
-    return spec.make_batch(batch_size)
+    kind = args.dataset or ("npy" if args.data_dir else "synthetic")
+    if kind == "npy":
+        if not args.data_dir:
+            raise SystemExit("--dataset npy requires --data-dir")
+        return data.npy_dataset(args.data_dir, batch_size,
+                                seed=args.seed), None
+    if kind == "digits":
+        train = data.digits_dataset(batch_size, split="train",
+                                    seed=args.seed)
+        evals = data.digits_dataset(batch_size, split="eval",
+                                    seed=args.seed)
+        return train, evals
+    return data.synthetic_dataset(spec, batch_size, seed=args.seed), None
+
+
+def make_eval_fn(model, mesh, batch_sharding):
+    """Jitted held-out accuracy over an ArrayDataset."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def eval_batch(params, batch):
+        logits = model.apply(params, batch["inputs"], train=False)
+        return (logits.argmax(-1) == batch["labels"]).sum()
+
+    def evaluate(params, dataset):
+        correct, total = 0, 0
+        for batch in dataset.epoch(0):
+            batch = jax.device_put(batch, batch_sharding)
+            correct += int(eval_batch(params, batch))
+            total += len(batch["labels"])
+        return correct / max(total, 1)
+
+    return evaluate
 
 
 def main(argv=None) -> int:
@@ -180,10 +219,15 @@ def _main(argv=None) -> int:
     if batch_size % data_axes:
         batch_size = data_axes * max(1, batch_size // data_axes)
 
-    model, params = spec.init_params(batch_size=2, seed=args.seed)
+    # Data defines the input shapes: init params from a dataset sample
+    # (e.g. digits are 8x8 where the synthetic stand-in is 28x28).
+    train_ds, eval_ds = make_datasets(args, spec, batch_size)
+    sample = train_ds.sample(2)
+    model = spec.make_model()
+    params = model.init(jax.random.PRNGKey(args.seed), sample["inputs"])
     step_fn = make_train_step(
         spec.loss_fn(model), make_optimizer(args.optimizer, args.lr),
-        mesh, grad_accum=args.grad_accum, donate=False)
+        mesh, grad_accum=args.grad_accum, donate=True)
     state = step_fn.init_state(params)
 
     # 3. tracking: attaches to the managed run (env) or creates one.
@@ -202,16 +246,34 @@ def _main(argv=None) -> int:
     ckpt.install_preemption_hook(lambda: state,
                                  lambda: int(state["step"]))
 
-    total_steps = args.steps or args.epochs * args.steps_per_epoch
-    batch = load_data(spec, args.data_dir, batch_size)
-    batch = jax.device_put(batch, step_fn.batch_sharding)
+    synthetic = (args.dataset or
+                 ("npy" if args.data_dir else "synthetic")) == "synthetic"
+    steps_per_epoch = args.steps_per_epoch or \
+        (100 if synthetic else train_ds.steps_per_epoch)
+    total_steps = args.steps or args.epochs * steps_per_epoch
+    from .data import prefetch_to_device
+
+    batches = train_ds.epochs(None)  # endless, reshuffled per epoch
+    if args.prefetch:
+        batches = prefetch_to_device(batches, step_fn.batch_sharding,
+                                     depth=args.prefetch)
     rng = jax.random.PRNGKey(args.seed)
 
     target = parse_target_metric(args.target_metric)
+    evaluate = make_eval_fn(model, mesh, step_fn.batch_sharding) \
+        if eval_ds is not None else None
+    # Evals ride the logging steps (metrics are only published there);
+    # snap --eval-every up to the next log step so no eval is lost to
+    # the log cadence.
+    eval_steps = set()
+    if evaluate and args.eval_every:
+        for due in range(args.eval_every, total_steps + 1,
+                         args.eval_every):
+            snapped = -(-due // args.log_every) * args.log_every
+            eval_steps.add(min(snapped, total_steps))
 
-    unit = "tok" if "inputs" in batch and batch["inputs"].ndim == 2 \
-        else "img"
-    per_batch = int(np.prod(batch["inputs"].shape[:2])) \
+    unit = "tok" if sample["inputs"].ndim == 2 else "img"
+    per_batch = batch_size * sample["inputs"].shape[1] \
         if unit == "tok" else batch_size
 
     last_metrics: Dict[str, Any] = {}
@@ -221,11 +283,22 @@ def _main(argv=None) -> int:
         if args.profile_at and step == args.profile_at:
             run.start_profiler_trace()
         rng, step_rng = jax.random.split(rng)
+        batch = next(batches)
+        if args.prefetch == 0:
+            batch = jax.device_put(batch, step_fn.batch_sharding)
         state, metrics = step_fn(state, batch, step_rng)
         if args.profile_at and step + 1 == args.profile_at + \
                 args.profile_steps:
             jax.block_until_ready(state)
             run.stop_profiler_trace(step=step + 1)
+        if ckpt.preempt_requested:
+            # SIGTERM landed while the bound state was donated into the
+            # in-flight step; save the fresh output state and exit within
+            # the operator's grace period (checkpoint.py).
+            ckpt.save(step + 1, state, force=True)
+            ckpt.wait()
+            print("preempted: checkpoint flushed, exiting", flush=True)
+            break
         if args.checkpoint_every and (step + 1) % args.checkpoint_every == 0:
             ckpt.save(step + 1, state)  # async; off the step path
         if (step + 1) % args.log_every == 0 or step + 1 == total_steps:
@@ -234,6 +307,9 @@ def _main(argv=None) -> int:
             done = step + 1 - block_start
             throughput = per_batch * done / dt / n_chips
             metrics[f"{unit}_per_sec_per_chip"] = round(throughput, 2)
+            if (step + 1) in eval_steps:
+                metrics["eval_accuracy"] = evaluate(state["params"],
+                                                    eval_ds)
             run.log_metrics(step=step + 1, **metrics)
             print(f"step {step + 1}/{total_steps} "
                   + " ".join(f"{k}={v:.4g}" for k, v in metrics.items()),
@@ -252,8 +328,14 @@ def _main(argv=None) -> int:
     ckpt.save(int(state["step"]), state, force=True)
     ckpt.wait()
     ckpt.close()
+    if evaluate:
+        final_eval = evaluate(state["params"], eval_ds)
+        run.log_metrics(step=int(state["step"]),
+                        eval_accuracy=final_eval)
+        last_metrics["eval_accuracy"] = final_eval
+        print(f"final eval_accuracy={final_eval:.4f}", flush=True)
     for key, value in last_metrics.items():
-        if key in ("accuracy", "loss", "perplexity"):
+        if key in ("accuracy", "loss", "perplexity", "eval_accuracy"):
             run.log_outputs(**{key: value})
     run.end("succeeded")
     if topology and topology.is_distributed:

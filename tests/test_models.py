@@ -91,7 +91,9 @@ def test_tp_rules_cover_transformer_params():
 
     def visit(path, leaf):
         p = infer_param_spec(path, leaf, tp=True)
-        if any(ax == "tp" for ax in p):
+        flat = [n for ax in p
+                for n in (ax if isinstance(ax, tuple) else (ax,))]
+        if "tp" in flat:
             name = "/".join(str(getattr(k, "key", k)) for k in path)
             sharded.add(name.rsplit("/", 2)[-2])
         return leaf

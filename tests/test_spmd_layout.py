@@ -1,0 +1,52 @@
+"""SPMD layout regression tests (VERDICT r1 #2).
+
+XLA prints "Involuntary full rematerialization" on stderr whenever the
+partitioner must replicate a tensor to move between layouts — a
+per-step full-tensor copy on real hardware.  The zoo models pin their
+activation layouts (parallel.constraints) and the strategy library pins
+param/opt-state layouts on both sides of the step, so a dp×fsdp×tp
+compile must be warning-free.  XLA logs from C++, so the assertion runs
+in a subprocess and greps real stderr.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import optax
+from polyaxon_tpu.models.registry import get_model
+from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+
+spec = get_model({model!r})
+model, params = spec.init_params(batch_size=4)
+mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+step = make_train_step(spec.loss_fn(model), optax.adam(1e-3), mesh)
+state = step.init_state(params)
+batch = spec.make_batch(8)
+batch = jax.device_put(batch, step.batch_sharding)
+state, metrics = step(state, batch, jax.random.PRNGKey(0))
+state, metrics = step(state, batch, jax.random.PRNGKey(1))
+loss = float(metrics["loss"])
+assert loss == loss, "NaN loss"
+print("LOSS_OK", loss)
+"""
+
+
+@pytest.mark.parametrize("model", ["gpt2-tiny", "bert-tiny"])
+def test_no_involuntary_rematerialization(model):
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT.format(model=model)],
+        capture_output=True, text=True, timeout=300,
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin:/opt/venv/bin"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "LOSS_OK" in proc.stdout
+    assert "Involuntary full rematerialization" not in proc.stderr, (
+        "XLA fell back to replicate-and-repartition:\n"
+        + proc.stderr[-3000:])
