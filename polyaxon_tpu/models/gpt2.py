@@ -110,38 +110,61 @@ class _ScanBlock(nn.Module):
 
 
 class GPT2Model(nn.Module):
+    """setup()-style so the forward decomposes into ``embed_tokens`` /
+    ``run_blocks`` / ``head`` methods — pipeline parallelism runs the
+    block stack through ``parallel.pipeline_apply`` while embedding and
+    head execute on every pipeline rank (they are small next to the
+    stack).  ``apply(..., method="embed_tokens")`` etc. reuse the same
+    param tree as ``__call__``."""
+
     cfg: GPT2Config
 
-    @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def setup(self):
         cfg = self.cfg
-        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                       name="wte")
-        # Pin the gather output before any arithmetic: the vocab-sharded
-        # table otherwise leaves the lookup in a table-derived layout that
-        # conflicts with the batch-sharded residual stream.
-        x = constrain(wte(input_ids), BATCH, None, None)
-        pos = jnp.arange(input_ids.shape[-1])
-        x = x + nn.Embed(cfg.max_position, cfg.hidden_size,
-                         dtype=cfg.dtype, name="wpe")(pos)
-        x = constrain(x, BATCH, None, None)
+        self.wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                            dtype=cfg.dtype, name="wte")
+        self.wpe = nn.Embed(cfg.max_position, cfg.hidden_size,
+                            dtype=cfg.dtype, name="wpe")
         if cfg.scan_layers:
-            # One traced block, rolled over the layer axis; params carry a
-            # leading [num_layers] dim (what pipeline_apply stacks over).
-            blocks = nn.scan(
+            # One traced block, rolled over the layer axis; params carry
+            # a leading [num_layers] dim (what pipeline_apply stacks
+            # over).
+            self.h = nn.scan(
                 _ScanBlock,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="h")
-            x, _ = blocks(x, None)
         else:
             block_cls = nn.remat(GPT2Block) if cfg.remat else GPT2Block
-            for i in range(cfg.num_layers):
-                x = block_cls(cfg, name=f"h_{i}")(x)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                         name="ln_f")(x)
-        logits = wte.attend(x.astype(cfg.dtype)).astype(jnp.float32)
+            self.h_blocks = tuple(block_cls(cfg, name=f"h_{i}")
+                                  for i in range(cfg.num_layers))
+        self.ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                 dtype=jnp.float32, name="ln_f")
+
+    def embed_tokens(self, input_ids):
+        # Pin the gather output before any arithmetic: the vocab-sharded
+        # table otherwise leaves the lookup in a table-derived layout
+        # that conflicts with the batch-sharded residual stream.
+        x = constrain(self.wte(input_ids), BATCH, None, None)
+        pos = jnp.arange(input_ids.shape[-1])
+        x = x + self.wpe(pos)
+        return constrain(x, BATCH, None, None)
+
+    def run_blocks(self, x):
+        if self.cfg.scan_layers:
+            x, _ = self.h(x, None)
+            return x
+        for block in self.h_blocks:
+            x = block(x)
+        return x
+
+    def head(self, x):
+        x = self.ln_f(x)
+        logits = self.wte.attend(x.astype(self.cfg.dtype))
         # LM head shards the vocab dim with the tied embedding.
-        return constrain(logits, BATCH, None, "tp")
+        return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
+
+    def __call__(self, input_ids, *, train: bool = False):
+        return self.head(self.run_blocks(self.embed_tokens(input_ids)))

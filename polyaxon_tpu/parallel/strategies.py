@@ -64,12 +64,19 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+# Scan-stacked block params ("h/block/...", "layers/layer/...") carry a
+# leading [num_layers] axis; with pipeline parallelism each stage's
+# slice of that axis lives on its pipeline rank.
+_STACK_RE = re.compile(r"(^|/)(h|layers)/")
+
+
 def infer_param_spec(
     path,
     leaf,
     *,
     tp: bool = False,
     fsdp: bool = False,
+    pp: bool = False,
     fsdp_min_size: int = 2 ** 16,
 ) -> P:
     """PartitionSpec for one parameter."""
@@ -89,6 +96,10 @@ def infer_param_spec(
                 else:
                     spec = cand[len(cand) - len(shape):]
                 break
+
+    if pp and len(shape) >= 2 and spec[0] is None and \
+            _STACK_RE.search(name):
+        spec[0] = "pp"
 
     def _names(entry):
         return entry if isinstance(entry, tuple) else \
@@ -120,9 +131,10 @@ def make_param_shardings(
     """NamedShardings for a param pytree based on the mesh's active axes."""
     tp = mesh.shape.get("tp", 1) > 1
     fsdp = mesh.shape.get("fsdp", 1) > 1
+    pp = mesh.shape.get("pp", 1) > 1
 
     def leaf_sharding(path, leaf):
-        spec = infer_param_spec(path, leaf, tp=tp, fsdp=fsdp,
+        spec = infer_param_spec(path, leaf, tp=tp, fsdp=fsdp, pp=pp,
                                 fsdp_min_size=fsdp_min_size)
         # Drop axes that don't divide the dim (tuple entries shrink
         # greedily from the right until the product divides).

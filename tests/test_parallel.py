@@ -141,6 +141,42 @@ class TestPipeline:
             pipeline_apply(lambda i, p, x: x, jnp.zeros((8, 1)), x, mesh,
                            n_micro=4)
 
+    def test_gpt2_trains_pp2_dp4_matching_dp_only(self):
+        """VERDICT r1 #5: strategy {pp: N} trains a real zoo model.
+
+        gpt2-tiny under pp=2 x dp=4 (blocks through pipeline_apply,
+        stacked stage params) must track the dp=8 loss trajectory."""
+        import optax
+
+        from polyaxon_tpu.models.gpt2 import GPT2Block
+        from polyaxon_tpu.models.registry import get_model
+        from polyaxon_tpu.parallel import make_train_step
+        from polyaxon_tpu.parallel.pipeline import pipelined_lm_loss
+
+        spec = get_model("gpt2-tiny")
+        model, params = spec.init_params(batch_size=4)
+        batch = spec.make_batch(16)
+
+        mesh_dp = local_mesh(dp=8)
+        step_dp = make_train_step(spec.loss_fn(model), optax.sgd(1e-2),
+                                  mesh_dp, donate=False)
+        state_dp = step_dp.init_state(params)
+
+        mesh_pp = local_mesh(dp=4, pp=2)
+        loss_pp = pipelined_lm_loss(model, GPT2Block(model.cfg), mesh_pp)
+        step_pp = make_train_step(loss_pp, optax.sgd(1e-2), mesh_pp,
+                                  donate=False)
+        state_pp = step_pp.init_state(params)
+
+        for _ in range(3):
+            state_dp, m_dp = step_dp(state_dp, batch, None)
+            state_pp, m_pp = step_pp(state_pp, batch, None)
+        loss_dp, loss_pp_v = float(m_dp["loss"]), float(m_pp["loss"])
+        assert np.isfinite(loss_pp_v)
+        np.testing.assert_allclose(loss_dp, loss_pp_v, rtol=2e-2)
+        # Training moved: the loss dropped from its init value.
+        assert loss_pp_v < 7.5
+
 
 class TestMoE:
     def test_routing_and_shapes(self):
