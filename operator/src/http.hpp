@@ -1,0 +1,214 @@
+// Minimal blocking HTTP/1.1 client over POSIX sockets.
+//
+// Transport for the operator's kube-apiserver mode (SURVEY.md 2.14: the
+// reference operator talks to the API server through client-go; ours
+// speaks the same REST surface directly).  Plaintext only: in-cluster
+// the operator sits behind `kubectl proxy`/a localhost sidecar, and the
+// test harness is the stub apiserver (polyaxon_tpu/k8s/stub.py).
+// Handles Content-Length and chunked responses; one connection per
+// request (the apiserver keeps-alive, but reconnect-per-poll keeps the
+// failure model trivial and the poll rate is ~10 Hz).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+
+struct HttpResponse {
+  int status = 0;           // 0 = transport error
+  std::string body;
+  std::string error;        // transport-level failure description
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+class HttpClient {
+ public:
+  // base_url: "http://host:port" (optionally with a path prefix).
+  explicit HttpClient(const std::string& base_url,
+                      std::string bearer_token = "",
+                      int timeout_ms = 5000)
+      : token_(std::move(bearer_token)), timeout_ms_(timeout_ms) {
+    std::string rest = base_url;
+    const std::string scheme = "http://";
+    if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+    size_t slash = rest.find('/');
+    std::string hostport = rest.substr(0, slash);
+    if (slash != std::string::npos) prefix_ = rest.substr(slash);
+    size_t colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      host_ = hostport.substr(0, colon);
+      std::string port_str = hostport.substr(colon + 1);
+      try {
+        size_t used = 0;
+        port_ = std::stoi(port_str, &used);
+        if (used != port_str.size() || port_ <= 0 || port_ > 65535)
+          throw std::invalid_argument(port_str);
+      } catch (const std::exception&) {
+        // Surface a usage error, not std::terminate (a malformed
+        // --kube-api in a pod spec would otherwise CrashLoopBackOff
+        // with an opaque abort).
+        throw std::runtime_error("invalid port in URL: " + base_url);
+      }
+    } else {
+      host_ = hostport;
+      port_ = 80;
+    }
+    if (host_.empty())
+      throw std::runtime_error("invalid URL (no host): " + base_url);
+  }
+
+  HttpResponse get(const std::string& path) {
+    return request("GET", path, "", "");
+  }
+  HttpResponse post(const std::string& path, const std::string& body) {
+    return request("POST", path, body, "application/json");
+  }
+  HttpResponse patch_merge(const std::string& path,
+                           const std::string& body) {
+    return request("PATCH", path, body, "application/merge-patch+json");
+  }
+  HttpResponse del(const std::string& path) {
+    return request("DELETE", path, "", "");
+  }
+
+  HttpResponse request(const std::string& method, const std::string& path,
+                       const std::string& body,
+                       const std::string& content_type) {
+    HttpResponse resp;
+    int fd = connect_socket(resp);
+    if (fd < 0) return resp;
+
+    std::string req = method + " " + prefix_ + path + " HTTP/1.1\r\n";
+    req += "Host: " + host_ + ":" + std::to_string(port_) + "\r\n";
+    req += "Accept: application/json\r\n";
+    req += "Connection: close\r\n";
+    if (!token_.empty()) req += "Authorization: Bearer " + token_ + "\r\n";
+    if (!content_type.empty())
+      req += "Content-Type: " + content_type + "\r\n";
+    req += "Content-Length: " + std::to_string(body.size()) + "\r\n\r\n";
+    req += body;
+
+    size_t sent = 0;
+    while (sent < req.size()) {
+      ssize_t n = ::send(fd, req.data() + sent, req.size() - sent, 0);
+      if (n <= 0) {
+        resp.error = "send failed";
+        ::close(fd);
+        return resp;
+      }
+      sent += static_cast<size_t>(n);
+    }
+
+    std::string raw;
+    char buf[8192];
+    for (;;) {
+      ssize_t n = ::recv(fd, buf, sizeof buf, 0);
+      if (n < 0) {
+        resp.error = "recv failed";
+        ::close(fd);
+        return resp;
+      }
+      if (n == 0) break;
+      raw.append(buf, static_cast<size_t>(n));
+    }
+    ::close(fd);
+    parse(raw, &resp);
+    return resp;
+  }
+
+ private:
+  int connect_socket(HttpResponse& resp) {
+    struct addrinfo hints {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host_.c_str(), std::to_string(port_).c_str(), &hints,
+                    &res) != 0 || res == nullptr) {
+      resp.error = "resolve failed: " + host_;
+      return -1;
+    }
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+      freeaddrinfo(res);
+      resp.error = "socket failed";
+      return -1;
+    }
+    struct timeval tv {};
+    tv.tv_sec = timeout_ms_ / 1000;
+    tv.tv_usec = (timeout_ms_ % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      resp.error = "connect failed: " + host_ + ":" +
+                   std::to_string(port_);
+      ::close(fd);
+      freeaddrinfo(res);
+      return -1;
+    }
+    freeaddrinfo(res);
+    return fd;
+  }
+
+  static void parse(const std::string& raw, HttpResponse* resp) {
+    size_t header_end = raw.find("\r\n\r\n");
+    if (header_end == std::string::npos) {
+      resp->error = "malformed response";
+      return;
+    }
+    size_t line_end = raw.find("\r\n");
+    std::string status_line = raw.substr(0, line_end);
+    size_t sp = status_line.find(' ');
+    if (sp != std::string::npos)
+      resp->status = std::atoi(status_line.c_str() + sp + 1);
+
+    std::string headers = raw.substr(0, header_end);
+    std::string body = raw.substr(header_end + 4);
+    // lowercase header scan for transfer-encoding: chunked
+    std::string lower;
+    lower.reserve(headers.size());
+    for (char c : headers)
+      lower += static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+    if (lower.find("transfer-encoding: chunked") != std::string::npos) {
+      resp->body = dechunk(body);
+    } else {
+      resp->body = body;  // Connection: close → body runs to EOF
+    }
+  }
+
+  static std::string dechunk(const std::string& body) {
+    std::string out;
+    size_t pos = 0;
+    while (pos < body.size()) {
+      size_t crlf = body.find("\r\n", pos);
+      if (crlf == std::string::npos) break;
+      long len = std::strtol(body.c_str() + pos, nullptr, 16);
+      if (len <= 0) break;
+      pos = crlf + 2;
+      if (pos + static_cast<size_t>(len) > body.size()) break;
+      out.append(body, pos, static_cast<size_t>(len));
+      pos += static_cast<size_t>(len) + 2;  // skip trailing CRLF
+    }
+    return out;
+  }
+
+  std::string host_;
+  int port_ = 80;
+  std::string prefix_;
+  std::string token_;
+  int timeout_ms_;
+};
+
+}  // namespace ptpu

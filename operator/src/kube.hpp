@@ -1,0 +1,295 @@
+// kube-apiserver transport: the operator's in-cluster mode.
+//
+// Parity target: the reference's Go operator watches Operation CRs and
+// creates pods through the Kubernetes API (SURVEY.md 2.14, the
+// controller-runtime client).  Ours speaks the same REST surface
+// through http.hpp:
+//
+//   KubeCRStore    — GET  /apis/core.polyaxon-tpu.io/v1/namespaces/NS/
+//                         operations          (list, once per tick)
+//                    PATCH .../operations/NAME/status   (merge-patch)
+//   KubePodRuntime — POST /api/v1/namespaces/NS/pods
+//                    GET  /api/v1/namespaces/NS/pods/NAME   (poll phase)
+//                    DELETE .../pods/NAME                   (teardown)
+//
+// Change detection uses metadata.generation (bumped by the apiserver on
+// spec writes only), so our own status PATCHes never re-trigger a
+// reconcile.  Tested against the stub apiserver
+// (polyaxon_tpu/k8s/stub.py) — the envtest analogue: real HTTP, fake
+// kubelet.
+
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+#include "podruntime.hpp"
+#include "reconciler.hpp"
+
+namespace ptpu {
+
+inline const std::string kOperationsGroup = "core.polyaxon-tpu.io";
+inline const std::string kOperationsVersion = "v1";
+
+class KubeCRStore : public CRStore {
+ public:
+  KubeCRStore(HttpClient* http, std::string ns)
+      : http_(http), ns_(std::move(ns)) {}
+
+  std::vector<std::string> list() override {
+    names_.clear();
+    cache_.clear();
+    HttpResponse resp = http_->get(ops_path());
+    if (!resp.ok()) {
+      // Transport blip: report nothing new; the reconciler keeps its
+      // current state and retries next tick (a transient apiserver
+      // outage must not read as "every CR was deleted").
+      return last_names_;
+    }
+    try {
+      Json doc = Json::parse(resp.body);
+      for (const auto& item : doc["items"].items()) {
+        std::string name = item["metadata"]["name"].as_string();
+        names_.push_back(name);
+        cache_[name] = item;
+      }
+    } catch (const std::exception&) {
+      return last_names_;
+    }
+    last_names_ = names_;
+    return names_;
+  }
+
+  CRRead read(const std::string& name, long known_generation, Json* cr,
+              long* generation, std::string* error) override {
+    (void)error;
+    auto it = cache_.find(name);
+    if (it == cache_.end()) return CRRead::NotFound;
+    *generation = it->second["metadata"]["generation"].as_int(1);
+    if (*generation == known_generation) return CRRead::Unchanged;
+    *cr = it->second;
+    return CRRead::Updated;
+  }
+
+  void write_status(const std::string& name, const Json& status) override {
+    Json patch = Json::object();
+    patch.set("status", status);
+    http_->patch_merge(ops_path() + "/" + name + "/status", patch.dump());
+  }
+
+  void clear_status(const std::string& name) override {
+    (void)name;  // the CR is gone; there is no status object to clear
+  }
+
+  Json prior_status(const std::string& name) override {
+    auto it = cache_.find(name);
+    return it == cache_.end() ? Json() : it->second["status"];
+  }
+
+  std::string log_dir(const std::string& op_name) override {
+    (void)op_name;
+    return "";  // kubelet owns container logs in-cluster
+  }
+
+  bool local_network() const override { return false; }
+
+ private:
+  std::string ops_path() const {
+    return "/apis/" + kOperationsGroup + "/" + kOperationsVersion +
+           "/namespaces/" + ns_ + "/operations";
+  }
+
+  HttpClient* http_;
+  std::string ns_;
+  std::vector<std::string> names_;
+  std::vector<std::string> last_names_;
+  std::map<std::string, Json> cache_;
+};
+
+class KubePodRuntime : public PodRuntime {
+ public:
+  explicit KubePodRuntime(HttpClient* http) : http_(http) {}
+
+  int launch(const PodSpec& spec) override {
+    int id = next_id_++;
+    Pod pod;
+    pod.name = spec.name;
+    pod.ns = spec.ns;
+    Json obj = Json::object();
+    obj.set("apiVersion", Json("v1"));
+    obj.set("kind", Json("Pod"));
+    Json meta = Json::object();
+    meta.set("name", Json(spec.name));
+    meta.set("namespace", Json(spec.ns));
+    if (spec.labels.is_object()) meta.set("labels", spec.labels);
+    if (spec.annotations.is_object())
+      meta.set("annotations", spec.annotations);
+    obj.set("metadata", meta);
+    obj.set("spec", with_env(spec.raw_template, spec.extra_env));
+    pod.manifest = obj.dump();
+    pods_[id] = pod;
+    try_create(pods_[id]);
+    return id;
+  }
+
+  PodPhase poll(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    if (it == pods_.end()) return PodPhase::Failed;
+    Pod& pod = it->second;
+    if (pod.phase == PodPhase::Succeeded || pod.phase == PodPhase::Failed)
+      return pod.phase;
+    if (!pod.created) {
+      // Still waiting out a name collision / transport blip from
+      // launch(); keep retrying the POST until it lands.
+      try_create(pod);
+      return pod.phase;
+    }
+    HttpResponse resp =
+        http_->get(pods_path(pod.ns) + "/" + pod.name);
+    if (resp.status == 404) {
+      // Deleted out from under us (node drain, chaos): the replica is
+      // gone — gang semantics treat that as a failure.
+      pod.phase = PodPhase::Failed;
+      pod.exit_code = 137;
+      return pod.phase;
+    }
+    if (!resp.ok()) return pod.phase;  // transport blip: keep last known
+    try {
+      Json obj = Json::parse(resp.body);
+      const std::string& phase = obj["status"]["phase"].as_string();
+      if (phase == "Running") pod.phase = PodPhase::Running;
+      else if (phase == "Succeeded") pod.phase = PodPhase::Succeeded;
+      else if (phase == "Failed") pod.phase = PodPhase::Failed;
+      else pod.phase = PodPhase::Pending;
+      pod.exit_code = terminated_exit_code(obj, pod.phase);
+    } catch (const std::exception&) {
+      // unparseable response: keep last known phase
+    }
+    return pod.phase;
+  }
+
+  int exit_code(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    return it == pods_.end() ? -1 : it->second.exit_code;
+  }
+
+  void terminate_pod(int pod_id) override {
+    // DELETE starts the kubelet's own grace period (SIGTERM → grace →
+    // SIGKILL), so terminate and kill collapse into one call here.
+    kill_pod(pod_id);
+  }
+
+  void kill_pod(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    if (it == pods_.end()) return;
+    Pod& pod = it->second;
+    if (pod.phase == PodPhase::Running || pod.phase == PodPhase::Pending) {
+      http_->del(pods_path(pod.ns) + "/" + pod.name);
+      pod.phase = PodPhase::Failed;
+      pod.exit_code = 137;
+    }
+    pod.deleted = true;
+  }
+
+  void remove(int pod_id) override {
+    auto it = pods_.find(pod_id);
+    if (it == pods_.end()) return;
+    if (!it->second.deleted)
+      http_->del(pods_path(it->second.ns) + "/" + it->second.name);
+    pods_.erase(it);
+  }
+
+ private:
+  struct Pod {
+    std::string name;
+    std::string ns;
+    std::string manifest;  // serialized Pod object for (re)creation
+    PodPhase phase = PodPhase::Pending;
+    int exit_code = -1;
+    bool created = false;
+    bool deleted = false;
+  };
+
+  // POST the pod; on 409 the name is taken by a prior attempt's pod
+  // (DELETE is asynchronous on a real apiserver — the object lingers
+  // with a deletionTimestamp through its grace period), so delete it
+  // and let poll() retry the POST until the old object is gone.
+  // Gang restarts reuse pod names deliberately: stable replica DNS.
+  void try_create(Pod& pod) {
+    HttpResponse resp = http_->post(pods_path(pod.ns), pod.manifest);
+    if (resp.ok()) {
+      pod.created = true;
+      pod.phase = PodPhase::Pending;
+      return;
+    }
+    if (resp.status == 409) {
+      http_->del(pods_path(pod.ns) + "/" + pod.name);
+      pod.phase = PodPhase::Pending;  // retry next poll
+      return;
+    }
+    if (resp.status == 0) {
+      pod.phase = PodPhase::Pending;  // transport blip: retry next poll
+      return;
+    }
+    pod.phase = PodPhase::Failed;  // 4xx/5xx: rejected outright
+    pod.exit_code = 127;
+  }
+
+  static std::string pods_path(const std::string& ns) {
+    return "/api/v1/namespaces/" + ns + "/pods";
+  }
+
+  static int terminated_exit_code(const Json& pod, PodPhase phase) {
+    for (const auto& cs : pod["status"]["containerStatuses"].items()) {
+      const Json& term = cs["state"]["terminated"];
+      if (term.is_object() && term.contains("exitCode"))
+        return static_cast<int>(term["exitCode"].as_int());
+    }
+    if (phase == PodPhase::Succeeded) return 0;
+    if (phase == PodPhase::Failed) return 1;
+    return -1;
+  }
+
+  // Merge the reconciler's per-replica env (process ids) into every
+  // container of the template — the same contract LocalProcessRuntime
+  // gets via ContainerSpec.env.
+  static Json with_env(
+      const Json& tmpl,
+      const std::vector<std::pair<std::string, std::string>>& extra) {
+    Json spec = tmpl;
+    Json containers = Json::array();
+    for (const auto& c : tmpl["containers"].items()) {
+      Json out = c;
+      Json env = c["env"].is_array() ? c["env"] : Json::array();
+      for (const auto& kv : extra) {
+        bool replaced = false;
+        for (auto& e : env.items())
+          if (e["name"].as_string() == kv.first) {
+            e.set("value", Json(kv.second));
+            replaced = true;
+          }
+        if (!replaced) {
+          Json e = Json::object();
+          e.set("name", Json(kv.first));
+          e.set("value", Json(kv.second));
+          env.push_back(e);
+        }
+      }
+      out.set("env", env);
+      containers.push_back(out);
+    }
+    spec.set("containers", containers);
+    if (!spec.contains("restartPolicy"))
+      spec.set("restartPolicy", Json("Never"));
+    return spec;
+  }
+
+  HttpClient* http_;
+  int next_id_ = 1;
+  std::map<int, Pod> pods_;
+};
+
+}  // namespace ptpu

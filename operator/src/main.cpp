@@ -1,18 +1,28 @@
 // ptpu-operator: native controller reconciling Operation CRs.
 //
-// Usage: ptpu-operator --cluster-dir DIR [--poll-ms 100] [--once]
+// Modes:
+//   ptpu-operator --cluster-dir DIR [--poll-ms 100] [--once]
+//     File protocol: watches DIR/operations/*.json, runs pods via the
+//     local process runtime, writes DIR/status/<name>.json.
+//   ptpu-operator --kube-api URL --namespace NS [--token T|--token-file F]
+//     API-server transport (VERDICT r1 #7): lists Operation CRs from a
+//     kube-apiserver, creates Pod objects, PATCHes /status back.  URL is
+//     plaintext http (in-cluster: a kubectl-proxy/localhost sidecar; in
+//     tests: the stub apiserver).
 //
-// Watches DIR/operations/*.json, runs pods via the local process
-// runtime, writes DIR/status/<name>.json.  SIGTERM/SIGINT drain
-// gracefully (pods killed, statuses flushed).
+// SIGTERM/SIGINT drain gracefully (pods killed, statuses flushed).
 
 #include <csignal>
 #include <cstdlib>
 #include <cstring>
+#include <fstream>
 #include <iostream>
+#include <memory>
+#include <sstream>
 #include <string>
 #include <thread>
 
+#include "kube.hpp"
 #include "podruntime.hpp"
 #include "reconciler.hpp"
 
@@ -22,6 +32,9 @@ static void on_signal(int) { g_stop = 1; }
 
 int main(int argc, char** argv) {
   std::string cluster_dir;
+  std::string kube_api;
+  std::string ns = "default";
+  std::string token;
   int poll_ms = 100;
   int grace_ms = 10000;
   bool once = false;
@@ -30,6 +43,20 @@ int main(int argc, char** argv) {
     std::string arg = argv[i];
     if (arg == "--cluster-dir" && i + 1 < argc) {
       cluster_dir = argv[++i];
+    } else if (arg == "--kube-api" && i + 1 < argc) {
+      kube_api = argv[++i];
+    } else if (arg == "--namespace" && i + 1 < argc) {
+      ns = argv[++i];
+    } else if (arg == "--token" && i + 1 < argc) {
+      token = argv[++i];
+    } else if (arg == "--token-file" && i + 1 < argc) {
+      std::ifstream f(argv[++i]);
+      std::ostringstream ss;
+      ss << f.rdbuf();
+      token = ss.str();
+      while (!token.empty() &&
+             (token.back() == '\n' || token.back() == '\r'))
+        token.pop_back();
     } else if (arg == "--poll-ms" && i + 1 < argc) {
       poll_ms = std::atoi(argv[++i]);
     } else if (arg == "--grace-ms" && i + 1 < argc) {
@@ -38,26 +65,47 @@ int main(int argc, char** argv) {
       once = true;
     } else if (arg == "--help") {
       std::cout << "ptpu-operator --cluster-dir DIR [--poll-ms N]"
-                   " [--grace-ms N] [--once]\n";
+                   " [--grace-ms N] [--once]\n"
+                   "ptpu-operator --kube-api URL [--namespace NS]"
+                   " [--token T | --token-file F] [--poll-ms N] [--once]\n";
       return 0;
     } else {
       std::cerr << "unknown arg: " << arg << "\n";
       return 2;
     }
   }
-  if (cluster_dir.empty()) {
-    std::cerr << "--cluster-dir is required\n";
+  if (cluster_dir.empty() == kube_api.empty()) {
+    std::cerr << "exactly one of --cluster-dir / --kube-api is required\n";
     return 2;
   }
 
   std::signal(SIGTERM, on_signal);
   std::signal(SIGINT, on_signal);
 
-  ptpu::LocalProcessRuntime runtime(grace_ms);
-  ptpu::Reconciler reconciler(cluster_dir, &runtime);
+  std::unique_ptr<ptpu::HttpClient> http;
+  std::unique_ptr<ptpu::CRStore> store;
+  std::unique_ptr<ptpu::PodRuntime> runtime;
+  std::unique_ptr<ptpu::Reconciler> reconciler;
+
+  if (!kube_api.empty()) {
+    try {
+      http = std::make_unique<ptpu::HttpClient>(kube_api, token);
+    } catch (const std::exception& e) {
+      std::cerr << "bad --kube-api: " << e.what() << "\n";
+      return 2;
+    }
+    store = std::make_unique<ptpu::KubeCRStore>(http.get(), ns);
+    runtime = std::make_unique<ptpu::KubePodRuntime>(http.get());
+    reconciler =
+        std::make_unique<ptpu::Reconciler>(store.get(), runtime.get());
+  } else {
+    runtime = std::make_unique<ptpu::LocalProcessRuntime>(grace_ms);
+    reconciler =
+        std::make_unique<ptpu::Reconciler>(cluster_dir, runtime.get());
+  }
 
   do {
-    reconciler.tick();
+    reconciler->tick();
     if (once) break;
     std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
   } while (!g_stop);

@@ -8,9 +8,8 @@
 //    a per-pod log file.  This is the cluster-less harness the Python
 //    agent's ManifestBackend talks to in tests AND the single-box
 //    deployment path.
-//  - An api-server transport would implement the same interface with
-//    POST /pods + watch; out of scope for the local build (no cluster in
-//    the environment), the reconciler core does not change.
+//  - KubePodRuntime (kube.hpp): the api-server transport — POST /pods,
+//    poll phases, DELETE on teardown (VERDICT r1 #7).
 
 #pragma once
 
@@ -28,6 +27,8 @@
 #include <string>
 #include <utility>
 #include <vector>
+
+#include "json.hpp"
 
 namespace ptpu {
 
@@ -54,6 +55,14 @@ struct PodSpec {
   std::vector<ContainerSpec> init_containers;
   ContainerSpec main;
   std::string log_path;
+  // Cluster runtimes re-emit the converter's pod template as a real Pod
+  // object instead of exec'ing parsed argv; the local runtime ignores
+  // these.
+  Json raw_template;  // the CR's pod template .spec
+  std::vector<std::pair<std::string, std::string>> extra_env;
+  Json labels;        // owning Operation's labels (selector parity)
+  Json annotations;   // pod template metadata.annotations, passed through
+  std::string ns = "default";
 };
 
 class PodRuntime {

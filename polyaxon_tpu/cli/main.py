@@ -667,14 +667,15 @@ def server(host, port, schedules, auth_token):
 @click.option("--host", default=None,
               help="Control plane URL (default: POLYAXON_TPU_HOST, else "
                    "in-process over the local store).")
-@click.option("--backend", type=click.Choice(["local", "manifest"]),
+@click.option("--backend", type=click.Choice(["local", "manifest", "kube"]),
               default="local")
 @click.option("--cluster-dir", default=None,
               help="Manifest backend: directory the operator watches.")
 @click.option("--max-concurrent", default=8, type=int)
 def agent(name, host, backend, cluster_dir, max_concurrent):
     """Run an agent: claim queued runs and execute them."""
-    from polyaxon_tpu.runner.agent import Agent, LocalBackend, ManifestBackend
+    from polyaxon_tpu.runner.agent import (Agent, KubeBackend, LocalBackend,
+                                           ManifestBackend)
     from polyaxon_tpu.scheduler import ControlPlane
 
     host = host or os.environ.get("POLYAXON_TPU_HOST")
@@ -690,6 +691,9 @@ def agent(name, host, backend, cluster_dir, max_concurrent):
             raise click.ClickException(
                 "--backend manifest requires --cluster-dir")
         be = ManifestBackend(cluster_dir)
+    elif backend == "kube":
+        # API server + token from PTPU_K8S_* env or in-cluster config.
+        be = KubeBackend()
     else:
         store = getattr(plane, "store", plane)
         be = LocalBackend(store)

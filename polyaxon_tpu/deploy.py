@@ -30,6 +30,15 @@ class DeploymentConfig:
     # None -> a random token is generated at render time.
     auth_secret_name: str = "polyaxon-tpu-auth"
     auth_token: Optional[str] = None
+    # Cluster transport: "kube" — agent applies Operation CRs through the
+    # kube-apiserver and the operator reconciles them into real pods
+    # (``--kube-api``); "manifest" — single-box file protocol over a
+    # shared emptyDir (no pods are created; everything runs inside the
+    # agent pod).
+    transport: str = "kube"
+    # The operator's HTTP client is plaintext; in-cluster it reaches the
+    # apiserver through a kubectl-proxy sidecar on localhost.
+    kube_proxy_port: int = 8001
 
 
 def _meta(name: str, config: DeploymentConfig) -> Dict[str, Any]:
@@ -182,11 +191,81 @@ def control_plane(config: DeploymentConfig) -> List[Dict[str, Any]]:
 
 
 def agent(config: DeploymentConfig) -> List[Dict[str, Any]]:
-    """Agent + operator share ONE pod so the manifest hand-off directory
-    (agent writes Operation CRs, operator reconciles them) is a single
-    shared emptyDir — split pods would each get a private volume and
-    the operator would never see the agent's manifests."""
+    """The agent deployment, per transport.
+
+    ``kube``: agent submits Operation CRs to the apiserver
+    (``--backend kube``); the operator container reconciles them into
+    real pods via ``--kube-api`` through a kubectl-proxy sidecar
+    (the operator's HTTP client is plaintext; the proxy terminates TLS
+    with the pod's service account).  RBAC for both is the Role below.
+
+    ``manifest``: agent + operator share ONE pod so the manifest
+    hand-off directory (agent writes CRs, operator reconciles them) is
+    a single shared emptyDir — split pods would each get a private
+    volume and the operator would never see the agent's manifests."""
     host = f"http://polyaxon-tpu-api.{config.namespace}:{config.api_port}"
+    if config.transport == "kube":
+        proxy = f"http://127.0.0.1:{config.kube_proxy_port}"
+        containers = [
+            {
+                "name": "agent",
+                "image": config.image,
+                "command": ["python", "-m", "polyaxon_tpu.cli",
+                            "agent", "--name", config.agent_name,
+                            "--backend", "kube"],
+                "env": _env_list(config, {
+                    "POLYAXON_TPU_HOST": host,
+                    "PTPU_K8S_NAMESPACE": config.namespace,
+                }),
+            },
+            {
+                "name": "operator",
+                "image": config.operator_image,
+                "command": ["/ptpu-operator",
+                            "--kube-api", proxy,
+                            "--namespace", config.namespace],
+            },
+            {
+                "name": "kubectl-proxy",
+                "image": "bitnami/kubectl:latest",
+                "command": ["kubectl", "proxy",
+                            f"--port={config.kube_proxy_port}",
+                            "--address=127.0.0.1"],
+            },
+        ]
+        pod_spec = {"serviceAccountName": config.service_account,
+                    "containers": containers}
+    else:
+        pod_spec = {
+            "serviceAccountName": config.service_account,
+            "containers": [
+                {
+                    "name": "agent",
+                    "image": config.image,
+                    "command": ["python", "-m",
+                                "polyaxon_tpu.cli",
+                                "agent", "--name",
+                                config.agent_name,
+                                "--backend", "manifest",
+                                "--cluster-dir", "/ptpu-cluster"],
+                    "env": _env_list(config,
+                                     {"POLYAXON_TPU_HOST": host}),
+                    "volumeMounts": [{"name": "cluster",
+                                      "mountPath":
+                                      "/ptpu-cluster"}],
+                },
+                {
+                    "name": "operator",
+                    "image": config.operator_image,
+                    "command": ["/ptpu-operator", "--cluster-dir",
+                                "/ptpu-cluster"],
+                    "volumeMounts": [{"name": "cluster",
+                                      "mountPath":
+                                      "/ptpu-cluster"}],
+                },
+            ],
+            "volumes": [{"name": "cluster", "emptyDir": {}}],
+        }
     return [{
         "apiVersion": "apps/v1", "kind": "Deployment",
         "metadata": _meta("polyaxon-tpu-agent", config),
@@ -198,36 +277,7 @@ def agent(config: DeploymentConfig) -> List[Dict[str, Any]]:
                 "metadata": {"labels":
                              {"app.kubernetes.io/name":
                               "polyaxon-tpu-agent"}},
-                "spec": {
-                    "serviceAccountName": config.service_account,
-                    "containers": [
-                        {
-                            "name": "agent",
-                            "image": config.image,
-                            "command": ["python", "-m",
-                                        "polyaxon_tpu.cli",
-                                        "agent", "--name",
-                                        config.agent_name,
-                                        "--backend", "manifest",
-                                        "--cluster-dir", "/ptpu-cluster"],
-                            "env": _env_list(config,
-                                             {"POLYAXON_TPU_HOST": host}),
-                            "volumeMounts": [{"name": "cluster",
-                                              "mountPath":
-                                              "/ptpu-cluster"}],
-                        },
-                        {
-                            "name": "operator",
-                            "image": config.operator_image,
-                            "command": ["/ptpu-operator", "--cluster-dir",
-                                        "/ptpu-cluster"],
-                            "volumeMounts": [{"name": "cluster",
-                                              "mountPath":
-                                              "/ptpu-cluster"}],
-                        },
-                    ],
-                    "volumes": [{"name": "cluster", "emptyDir": {}}],
-                },
+                "spec": pod_spec,
             },
         },
     }]

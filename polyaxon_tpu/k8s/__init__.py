@@ -14,6 +14,7 @@ from .converter import (
     ConverterConfig,
     ConverterError,
     convert,
+    cluster_ip_service,
     headless_service,
 )
 from .tpu import (
@@ -41,6 +42,7 @@ __all__ = [
     "accelerator_for",
     "convert",
     "default_topology",
+    "cluster_ip_service",
     "headless_service",
     "slice_node_selector",
     "tpu_resources",

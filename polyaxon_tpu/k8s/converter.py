@@ -378,6 +378,31 @@ def convert(
     }
 
 
+def cluster_ip_service(cr: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Companion Service for service kinds (notebooks/TensorBoard): the
+    operator publishes ``status.endpoints`` as ``<name>.<namespace>``,
+    which only resolves if something creates this Service."""
+    spec = cr.get("spec", {})
+    ports = spec.get("ports")
+    if not ports or "replicaSpecs" in spec:
+        return None
+    meta = cr["metadata"]
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": meta["name"],
+            "namespace": meta.get("namespace"),
+            "labels": dict(meta.get("labels", {})),
+        },
+        "spec": {
+            "selector": {"polyaxon-tpu/run-uuid":
+                         meta["labels"]["polyaxon-tpu/run-uuid"]},
+            "ports": [{"port": int(p)} for p in ports],
+        },
+    }
+
+
 def headless_service(cr: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Companion headless Service giving replica pods stable DNS —
     the operator applies it alongside distributed Operations."""

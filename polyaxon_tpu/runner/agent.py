@@ -30,7 +30,8 @@ from typing import Any, Dict, Optional
 
 from ..client.store import FileRunStore
 from ..flow import V1Operation
-from ..k8s import ConverterConfig, convert, headless_service
+from ..k8s import (ConverterConfig, cluster_ip_service, convert,
+                   headless_service)
 from ..lifecycle import V1Statuses, is_done
 from .local import LocalExecutor
 
@@ -131,6 +132,29 @@ class LocalBackend(Backend):
         pass  # cooperative: executor reacts to the run's `stopping` status
 
 
+def convert_record(record: Dict[str, Any], operation: V1Operation,
+                   store, config: ConverterConfig):
+    """Resolve + convert one claimed run into (CR, [services]).
+
+    Shared by every cluster transport (file protocol, kube API): the
+    manifests are identical; only the apply mechanism differs."""
+    from ..compiler import resolve
+
+    from .joins import get_joins, resolve_joins
+
+    join_values = None
+    if get_joins(operation) and store is not None:
+        join_values = resolve_joins(operation, store,
+                                    project=record.get("project"))
+    compiled = resolve(operation, run_uuid=record["uuid"],
+                       project=record.get("project"),
+                       join_values=join_values)
+    cr = convert(compiled, record["uuid"], record.get("project"), config)
+    services = [svc for svc in (headless_service(cr),
+                                cluster_ip_service(cr)) if svc]
+    return cr, services
+
+
 class ManifestBackend(Backend):
     """File-protocol cluster transport.
 
@@ -158,26 +182,13 @@ class ManifestBackend(Backend):
         os.makedirs(os.path.join(cluster_dir, "status"), exist_ok=True)
 
     def submit(self, record, operation):
-        from ..compiler import resolve
-
-        from .joins import get_joins, resolve_joins
-
-        join_values = None
-        if get_joins(operation) and self.store is not None:
-            join_values = resolve_joins(operation, self.store,
-                                        project=record.get("project"))
-        compiled = resolve(operation, run_uuid=record["uuid"],
-                           project=record.get("project"),
-                           join_values=join_values)
-        cr = convert(compiled, record["uuid"], record.get("project"),
-                     self.config)
+        cr, services = convert_record(record, operation, self.store,
+                                      self.config)
         name = cr["metadata"]["name"]
-        svc = headless_service(cr)
         path = os.path.join(self.cluster_dir, "operations", f"{name}.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"operation": cr, "services":
-                       [svc] if svc else []}, f, indent=1)
+            json.dump({"operation": cr, "services": services}, f, indent=1)
         os.replace(tmp, path)
         return name
 
@@ -217,6 +228,84 @@ class ManifestBackend(Backend):
                 os.remove(os.path.join(self.cluster_dir, sub,
                                        f"{handle}.json"))
             except OSError:
+                pass
+
+
+class KubeBackend(Backend):
+    """kube-apiserver transport (VERDICT r1 #7).
+
+    Applies converted Operation CRs + headless Services through the k8s
+    REST API (SURVEY.md §3.1 step 8: converter output → k8s API); the
+    operator — ours in ``--kube-api`` mode, reconciling the same CRD
+    ``deploy.py`` registers — turns them into pods and writes
+    ``.status`` back, which ``read_status``/``check`` poll."""
+
+    _PHASES = ManifestBackend._PHASES
+
+    def __init__(self, client=None,
+                 config: Optional[ConverterConfig] = None,
+                 store: Optional[FileRunStore] = None):
+        from ..k8s.kubeclient import KubeClient
+
+        self.client = client or KubeClient()
+        self.config = config or ConverterConfig()
+        self.store = store
+
+    def submit(self, record, operation):
+        from ..k8s.kubeclient import KubeApiError, OPERATIONS_GROUP
+
+        cr, services = convert_record(record, operation, self.store,
+                                      self.config)
+        name = cr["metadata"]["name"]
+        try:
+            self.client.create("operations", cr, group=OPERATIONS_GROUP)
+        except KubeApiError as e:
+            if e.code != 409:  # already applied (agent restart): adopt
+                raise
+        for svc in services:
+            try:
+                self.client.create("services", svc)
+            except KubeApiError as e:
+                if e.code != 409:
+                    raise
+        return name
+
+    def read_status(self, handle) -> Optional[Dict[str, Any]]:
+        from ..k8s.kubeclient import KubeApiError, OPERATIONS_GROUP
+
+        try:
+            obj = self.client.get("operations", handle,
+                                  group=OPERATIONS_GROUP)
+        except KubeApiError:
+            return None
+        return obj.get("status") or None
+
+    def check(self, handle):
+        status = self.read_status(handle)
+        if status is None:
+            return None
+        return self._PHASES.get(status.get("phase"))
+
+    def stop(self, handle):
+        from ..k8s.kubeclient import KubeApiError, OPERATIONS_GROUP
+
+        try:
+            self.client.patch("operations", handle,
+                              {"spec": {"stopped": True}},
+                              group=OPERATIONS_GROUP)
+        except KubeApiError:
+            pass
+
+    def cleanup(self, handle):
+        from ..k8s.kubeclient import KubeApiError, OPERATIONS_GROUP
+
+        for plural, group, name in (("operations", OPERATIONS_GROUP,
+                                     handle),
+                                    ("services", "", f"{handle}-hs"),
+                                    ("services", "", handle)):
+            try:
+                self.client.delete(plural, name, group=group)
+            except KubeApiError:
                 pass
 
 

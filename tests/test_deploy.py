@@ -18,7 +18,7 @@ class TestDeployManifests:
                 assert m["metadata"]["namespace"] == "ns1"
 
     def test_agent_and_operator_share_cluster_volume(self):
-        manifests = render_all(DeploymentConfig())
+        manifests = render_all(DeploymentConfig(transport="manifest"))
         pod = next(m for m in manifests
                    if m["kind"] == "Deployment"
                    and m["metadata"]["name"] == "polyaxon-tpu-agent"
@@ -28,6 +28,25 @@ class TestDeployManifests:
         for c in pod["containers"]:
             assert {"name": "cluster", "mountPath": "/ptpu-cluster"} in \
                 c["volumeMounts"]
+
+    def test_kube_transport_agent_pod(self):
+        """Default transport: agent submits via the apiserver; operator
+        reconciles through the kubectl-proxy sidecar (VERDICT r1 #7)."""
+        manifests = render_all(DeploymentConfig(namespace="ns3"))
+        pod = next(m for m in manifests
+                   if m["kind"] == "Deployment"
+                   and m["metadata"]["name"] == "polyaxon-tpu-agent"
+                   )["spec"]["template"]["spec"]
+        by_name = {c["name"]: c for c in pod["containers"]}
+        assert set(by_name) == {"agent", "operator", "kubectl-proxy"}
+        assert "--backend" in by_name["agent"]["command"]
+        assert "kube" in by_name["agent"]["command"]
+        assert "--kube-api" in by_name["operator"]["command"]
+        assert "http://127.0.0.1:8001" in by_name["operator"]["command"]
+        assert pod["serviceAccountName"] == "polyaxon-tpu"
+        env = {e["name"]: e.get("value")
+               for e in by_name["agent"]["env"]}
+        assert env["PTPU_K8S_NAMESPACE"] == "ns3"
 
     def test_artifacts_claim_sets_store_home(self):
         manifests = render_all(DeploymentConfig(artifacts_claim="pvc-a"))
