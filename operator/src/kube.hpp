@@ -111,7 +111,12 @@ class KubeCRStore : public CRStore {
 
 class KubePodRuntime : public PodRuntime {
  public:
-  explicit KubePodRuntime(HttpClient* http) : http_(http) {}
+  // cache_ms: age bound on the shared pod LIST used by poll() — one
+  // LIST per window serves every replica, instead of a GET per pod per
+  // reconcile tick (a 64-replica gang at --poll-ms 100 would otherwise
+  // hammer the proxy with ~640 req/s).
+  explicit KubePodRuntime(HttpClient* http, long long cache_ms = 50)
+      : http_(http), cache_ms_(cache_ms) {}
 
   int launch(const PodSpec& spec) override {
     int id = next_id_++;
@@ -131,7 +136,20 @@ class KubePodRuntime : public PodRuntime {
     obj.set("spec", with_env(spec.raw_template, spec.extra_env));
     pod.manifest = obj.dump();
     pods_[id] = pod;
+    gc_pending_deletes();
     try_create(pods_[id]);
+    return id;
+  }
+
+  // Operator restart: pick up an already-running pod by name instead of
+  // recreating it (reconciler adoption of Running operations).
+  int adopt(const PodSpec& spec) override {
+    int id = next_id_++;
+    Pod pod;
+    pod.name = spec.name;
+    pod.ns = spec.ns;
+    pod.created = true;  // it exists in the cluster; 404 => Failed
+    pods_[id] = pod;
     return id;
   }
 
@@ -143,31 +161,23 @@ class KubePodRuntime : public PodRuntime {
       return pod.phase;
     if (!pod.created) {
       // Still waiting out a name collision / transport blip from
-      // launch(); keep retrying the POST until it lands.
-      try_create(pod);
+      // launch(); keep retrying the POST — unless this pod is being
+      // torn down (creating workload during a stop would be wrong).
+      if (!pod.deleted) try_create(pod);
       return pod.phase;
     }
-    HttpResponse resp =
-        http_->get(pods_path(pod.ns) + "/" + pod.name);
-    if (resp.status == 404) {
-      // Deleted out from under us (node drain, chaos): the replica is
-      // gone — gang semantics treat that as a failure.
+    refresh(pod.ns);
+    if (!have_list_) return pod.phase;  // no successful LIST yet
+    auto entry = list_cache_.find(pod.ns + "/" + pod.name);
+    if (entry == list_cache_.end()) {
+      // Absent from a successful LIST: deleted out from under us (node
+      // drain, chaos) — gang semantics treat that as a failure.
       pod.phase = PodPhase::Failed;
       pod.exit_code = 137;
       return pod.phase;
     }
-    if (!resp.ok()) return pod.phase;  // transport blip: keep last known
-    try {
-      Json obj = Json::parse(resp.body);
-      const std::string& phase = obj["status"]["phase"].as_string();
-      if (phase == "Running") pod.phase = PodPhase::Running;
-      else if (phase == "Succeeded") pod.phase = PodPhase::Succeeded;
-      else if (phase == "Failed") pod.phase = PodPhase::Failed;
-      else pod.phase = PodPhase::Pending;
-      pod.exit_code = terminated_exit_code(obj, pod.phase);
-    } catch (const std::exception&) {
-      // unparseable response: keep last known phase
-    }
+    pod.phase = entry->second.phase;
+    pod.exit_code = entry->second.exit_code;
     return pod.phase;
   }
 
@@ -186,19 +196,17 @@ class KubePodRuntime : public PodRuntime {
     auto it = pods_.find(pod_id);
     if (it == pods_.end()) return;
     Pod& pod = it->second;
+    if (!pod.deleted) delete_pod(pod);
     if (pod.phase == PodPhase::Running || pod.phase == PodPhase::Pending) {
-      http_->del(pods_path(pod.ns) + "/" + pod.name);
       pod.phase = PodPhase::Failed;
       pod.exit_code = 137;
     }
-    pod.deleted = true;
   }
 
   void remove(int pod_id) override {
     auto it = pods_.find(pod_id);
     if (it == pods_.end()) return;
-    if (!it->second.deleted)
-      http_->del(pods_path(it->second.ns) + "/" + it->second.name);
+    if (!it->second.deleted) delete_pod(it->second);
     pods_.erase(it);
   }
 
@@ -213,6 +221,11 @@ class KubePodRuntime : public PodRuntime {
     bool deleted = false;
   };
 
+  struct CachedPhase {
+    PodPhase phase = PodPhase::Pending;
+    int exit_code = -1;
+  };
+
   // POST the pod; on 409 the name is taken by a prior attempt's pod
   // (DELETE is asynchronous on a real apiserver — the object lingers
   // with a deletionTimestamp through its grace period), so delete it
@@ -223,6 +236,7 @@ class KubePodRuntime : public PodRuntime {
     if (resp.ok()) {
       pod.created = true;
       pod.phase = PodPhase::Pending;
+      invalidate_cache();
       return;
     }
     if (resp.status == 409) {
@@ -236,6 +250,66 @@ class KubePodRuntime : public PodRuntime {
     }
     pod.phase = PodPhase::Failed;  // 4xx/5xx: rejected outright
     pod.exit_code = 127;
+  }
+
+  // DELETE with failure tracking: a blip must not orphan a running
+  // workload holding the TPU slice, so failed deletes queue for retry
+  // (drained on every launch/refresh).
+  void delete_pod(Pod& pod) {
+    HttpResponse resp = http_->del(pods_path(pod.ns) + "/" + pod.name);
+    if (resp.ok() || resp.status == 404 || resp.status == 409) {
+      pod.deleted = true;
+      invalidate_cache();
+    } else {
+      pending_deletes_.push_back(pods_path(pod.ns) + "/" + pod.name);
+      pod.deleted = true;  // ownership handed to the retry queue
+    }
+  }
+
+  void gc_pending_deletes() {
+    std::vector<std::string> still;
+    for (const auto& path : pending_deletes_) {
+      HttpResponse resp = http_->del(path);
+      if (!(resp.ok() || resp.status == 404 || resp.status == 409))
+        still.push_back(path);
+    }
+    pending_deletes_.swap(still);
+  }
+
+  void invalidate_cache() { last_list_ms_ = 0; }
+
+  static long long mono_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<long long>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+  }
+
+  // One namespace-wide pod LIST per cache window feeds every poll().
+  void refresh(const std::string& ns) {
+    long long now = mono_ms();
+    if (have_list_ && now - last_list_ms_ < cache_ms_) return;
+    if (!pending_deletes_.empty()) gc_pending_deletes();
+    HttpResponse resp = http_->get(pods_path(ns));
+    if (!resp.ok()) return;  // keep the stale cache on blips
+    try {
+      Json doc = Json::parse(resp.body);
+      list_cache_.clear();
+      for (const auto& item : doc["items"].items()) {
+        const std::string& phase = item["status"]["phase"].as_string();
+        CachedPhase entry;
+        if (phase == "Running") entry.phase = PodPhase::Running;
+        else if (phase == "Succeeded") entry.phase = PodPhase::Succeeded;
+        else if (phase == "Failed") entry.phase = PodPhase::Failed;
+        else entry.phase = PodPhase::Pending;
+        entry.exit_code = terminated_exit_code(item, entry.phase);
+        list_cache_[ns + "/" + item["metadata"]["name"].as_string()] =
+            entry;
+      }
+      have_list_ = true;
+      last_list_ms_ = now;
+    } catch (const std::exception&) {
+      // unparseable response: keep the stale cache
+    }
   }
 
   static std::string pods_path(const std::string& ns) {
@@ -288,8 +362,13 @@ class KubePodRuntime : public PodRuntime {
   }
 
   HttpClient* http_;
+  long long cache_ms_;
   int next_id_ = 1;
   std::map<int, Pod> pods_;
+  std::map<std::string, CachedPhase> list_cache_;
+  bool have_list_ = false;
+  long long last_list_ms_ = 0;
+  std::vector<std::string> pending_deletes_;
 };
 
 }  // namespace ptpu

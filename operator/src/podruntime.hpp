@@ -69,6 +69,10 @@ class PodRuntime {
  public:
   virtual ~PodRuntime() = default;
   virtual int launch(const PodSpec& spec) = 0;
+  // Re-attach to a pod that already exists (operator restart over a
+  // Running operation).  Local processes cannot be re-attached — the
+  // restarted operator has no pids — so the default relaunches.
+  virtual int adopt(const PodSpec& spec) { return launch(spec); }
   virtual PodPhase poll(int pod_id) = 0;
   virtual int exit_code(int pod_id) = 0;
   // Non-blocking SIGTERM: starts the grace clock so several pods can

@@ -304,14 +304,38 @@ class Reconciler {
             ops_[name] = op;
             return;
           }
+          if (prior_phase == "Running" && !store_->local_network() &&
+              adopt_running(op, prior)) {
+            // Cluster pods survive an operator restart: re-attach to
+            // the live gang instead of deleting + recreating it (a
+            // restarted operator must not reset healthy long trainings
+            // to their last checkpoint).  Local processes cannot be
+            // re-attached (no pids), so file mode relaunches below.
+            ops_[name] = op;
+            break;  // supervise() polls the adopted pods
+          }
           ops_[name] = op;
           launch(ops_[name]);
         } else {
           // Spec update: only `stopped` is acted on mid-flight (parity:
           // reference stops via CR patch); other edits take effect on
           // the next attempt.
-          it->second.cr = cr;
-          it->second.generation = generation;
+          OperationState& op = it->second;
+          bool was_invalid = op.phase == "Failed" &&
+                             op.message.rfind("invalid CR", 0) == 0 &&
+                             op.replicas.empty();
+          op.cr = cr;
+          op.generation = generation;
+          if (was_invalid) {
+            // A CR that failed to parse has been rewritten with valid
+            // JSON (non-atomic writer finished): recover instead of
+            // staying Failed forever.
+            op.phase = "Pending";
+            op.message.clear();
+            op.started_at = now_s();
+            op.attempt = 0;
+            launch(op);
+          }
         }
         break;
     }
@@ -437,6 +461,30 @@ class Reconciler {
       }
     }
     publish(op);
+  }
+
+  // Re-attach to the pods a previous operator instance launched, using
+  // the replica names it published.  Returns false when the prior
+  // status carries no replicas (nothing to adopt -> caller relaunches).
+  bool adopt_running(OperationState& op, const Json& prior) {
+    const Json& reps = prior["replicaStatuses"];
+    if (!reps.is_object() || reps.members().empty()) return false;
+    op.phase = "Running";
+    op.message = prior["message"].as_string();
+    std::string ns = op.cr["metadata"]["namespace"].is_string()
+                         ? op.cr["metadata"]["namespace"].as_string()
+                         : "default";
+    for (const auto& kv : reps.members()) {
+      PodSpec spec;
+      spec.name = kv.first;
+      spec.ns = ns;
+      ReplicaState rep;
+      rep.pod_name = kv.first;
+      rep.restarts = op.attempt;
+      rep.pod_id = runtime_->adopt(spec);
+      op.replicas.push_back(rep);
+    }
+    return true;
   }
 
   static std::string run_uuid(const OperationState& op) {

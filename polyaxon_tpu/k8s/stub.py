@@ -225,6 +225,13 @@ class StubApiServer:
                     if obj_name in items:
                         return self._deny(409, f"{obj_name} exists")
                     meta = obj.setdefault("metadata", {})
+                    if meta.get("namespace") and \
+                            meta["namespace"] != key[1]:
+                        # real apiserver semantics: body namespace must
+                        # match the request path
+                        return self._deny(
+                            400, f"namespace {meta['namespace']!r} does "
+                                 f"not match request {key[1]!r}")
                     meta["resourceVersion"] = str(state.next_rv())
                     meta["generation"] = 1
                     meta["namespace"] = key[1]

@@ -248,7 +248,11 @@ class KubeBackend(Backend):
         from ..k8s.kubeclient import KubeClient
 
         self.client = client or KubeClient()
-        self.config = config or ConverterConfig()
+        # CR metadata.namespace must match the namespace objects are
+        # POSTed to — a real apiserver 400s on mismatch (the converter
+        # default is only right for the default deployment namespace).
+        self.config = config or ConverterConfig(
+            namespace=self.client.namespace)
         self.store = store
 
     def submit(self, record, operation):

@@ -361,6 +361,47 @@ class TestOperatorKubeMode:
             proc.send_signal(signal.SIGTERM)
             proc.wait(timeout=5)
 
+    def test_operator_restart_adopts_running_gang(self, stub,
+                                                  operator_binary, client):
+        """A restarted operator must re-attach to a healthy Running gang
+        — not delete + recreate its pods (code review r2)."""
+        client.create("operations",
+                      operation_cr("kj-live", replicas=2, hold=True),
+                      group=OPERATIONS_GROUP)
+        proc = subprocess.Popen(
+            [operator_binary, "--kube-api", stub.url, "--namespace",
+             "default", "--token", "stub-token", "--poll-ms", "20"])
+        try:
+            wait_for(lambda: len(stub.objects("pods")) == 2 or None,
+                     message="gang pods up")
+            wait_for(lambda: (client.get("operations", "kj-live",
+                                         group=OPERATIONS_GROUP)
+                              .get("status", {}).get("phase") == "Running")
+                     or None, message="Running status")
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=5)
+        pods_before = {name: pod["metadata"]["resourceVersion"]
+                       for name, pod in stub.objects("pods").items()}
+        proc = subprocess.Popen(
+            [operator_binary, "--kube-api", stub.url, "--namespace",
+             "default", "--token", "stub-token", "--poll-ms", "20"])
+        try:
+            time.sleep(1.0)
+            pods_after = {name: pod["metadata"]["resourceVersion"]
+                          for name, pod in stub.objects("pods").items()}
+            assert pods_after == pods_before, \
+                "restarted operator recreated healthy Running pods"
+            # adoption is live supervision, not a frozen status: release
+            # the hold and the adopted gang completes.
+            for name in pods_before:
+                stub.set_pod_phase(name, "Succeeded", exit_code=0)
+            status = wait_phase(client, "kj-live")
+            assert status["phase"] == "Succeeded"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=5)
+
     def test_pod_name_conflict_retries_create(self, kube_operator,
                                               client):
         """A leftover pod with the gang's name (asynchronous DELETE on a
