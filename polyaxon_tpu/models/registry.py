@@ -21,6 +21,7 @@ from .bert import BertConfig, BertModel
 from .convnet import ConvNet
 from .gpt2 import GPT2Config, GPT2Model
 from .mlp import MLP
+from .moe_gpt import MoEGPTConfig, MoEGPTModel
 from .resnet import ResNet, ResNet50
 
 
@@ -89,6 +90,20 @@ def _lm_loss(model):
         # Next-token prediction: shift by one.
         l = softmax_xent(logits[:, :-1], tokens[:, 1:])
         return l, {"perplexity": jnp.exp(l)}
+    return loss
+
+
+def _moe_lm_loss(model):
+    """LM loss + weighted switch load-balance aux (the model returns
+    ``(logits, aux)``)."""
+    aux_weight = model.cfg.aux_weight
+
+    def loss(params, batch, rng):
+        tokens = batch["inputs"]
+        logits, aux = model.apply(params, tokens, train=True)
+        lm = softmax_xent(logits[:, :-1], tokens[:, 1:])
+        return lm + aux_weight * aux, {"perplexity": jnp.exp(lm),
+                                       "aux_loss": aux}
     return loss
 
 
@@ -187,6 +202,24 @@ _register(ModelSpec(
     make_model=lambda **kw: GPT2Model(GPT2Config.tiny(), **kw),
     make_batch=lambda b: _token_batch(b, 64, GPT2Config.tiny().vocab_size),
     loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="moe-gpt-small",
+    make_model=lambda **kw: MoEGPTModel(MoEGPTConfig.small(), **kw),
+    make_batch=lambda b: _token_batch(b, 1024,
+                                      MoEGPTConfig.small().vocab_size),
+    loss_fn=_moe_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="moe-gpt-tiny",
+    make_model=lambda **kw: MoEGPTModel(MoEGPTConfig.tiny(), **kw),
+    make_batch=lambda b: _token_batch(b, 64,
+                                      MoEGPTConfig.tiny().vocab_size),
+    loss_fn=_moe_lm_loss,
     default_batch_size=8,
 ))
 

@@ -52,6 +52,11 @@ TP_RULES: List[Tuple[str, Callable[[tuple], P]]] = [
     # whole tensor (involuntary full rematerialization).
     (r"(embed|embedding|wte|lm_head)[^/]*/(embedding|kernel)",
      lambda shape: P(("tp", "fsdp"), None)),
+    # Expert-parallel params [E, in, out]: shard the expert dim over ep —
+    # the layout moe_layer's shard_map expects, so no reshard precedes
+    # the all-to-all dispatch.
+    (r"experts_w[12]$",
+     lambda shape: P("ep", None, None)),
 ]
 
 
@@ -77,6 +82,7 @@ def infer_param_spec(
     tp: bool = False,
     fsdp: bool = False,
     pp: bool = False,
+    ep: bool = False,
     fsdp_min_size: int = 2 ** 16,
 ) -> P:
     """PartitionSpec for one parameter."""
@@ -84,7 +90,10 @@ def infer_param_spec(
     spec = [None] * len(shape)
     name = _path_str(path)
 
-    if tp:
+    # The rule table carries both tp- and ep-named axes; names whose
+    # mesh axis has size 1 are no-ops, so running the table when either
+    # axis is active is safe.
+    if tp or ep:
         for pattern, builder in TP_RULES:
             if re.search(pattern, name):
                 cand = list(builder(shape))
@@ -132,10 +141,11 @@ def make_param_shardings(
     tp = mesh.shape.get("tp", 1) > 1
     fsdp = mesh.shape.get("fsdp", 1) > 1
     pp = mesh.shape.get("pp", 1) > 1
+    ep = mesh.shape.get("ep", 1) > 1
 
     def leaf_sharding(path, leaf):
         spec = infer_param_spec(path, leaf, tp=tp, fsdp=fsdp, pp=pp,
-                                fsdp_min_size=fsdp_min_size)
+                                ep=ep, fsdp_min_size=fsdp_min_size)
         # Drop axes that don't divide the dim (tuple entries shrink
         # greedily from the right until the product divides).
         shape = getattr(leaf, "shape", ())

@@ -43,7 +43,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", default="adamw",
                    choices=["adamw", "sgd", "adam"])
     p.add_argument("--strategy", default=None,
-                   help='Mesh axes JSON, e.g. \'{"dp": -1, "tp": 2}\' '
+                   help='Mesh axes: JSON (\'{"dp": -1, "tp": 2}\') or '
+                        'compact "dp:2,tp:2" / "dp=2,tp=2" '
                         "(default: PTPU_STRATEGY env, else pure DP).")
     p.add_argument("--sp-mode", default="ring",
                    choices=["ring", "ulysses"],
@@ -143,23 +144,77 @@ def make_datasets(args, spec, batch_size: int):
 
 def make_eval_fn(model, mesh, batch_sharding):
     """Jitted held-out accuracy over an ArrayDataset."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
+    # The final partial batch of an eval split is rarely divisible by
+    # the data-sharded mesh axes; pad it up and mask the padding out of
+    # the correct-count (a real 103-sample digits split on an 8-way
+    # mesh must not crash the run).
+    from .parallel.mesh import active_batch_axes
+
+    divisor = 1
+    for name in active_batch_axes(mesh, ("dp", "fsdp")) or ():
+        divisor *= mesh.shape.get(name, 1)
+
     @jax.jit
-    def eval_batch(params, batch):
+    def eval_batch(params, batch, valid):
         logits = model.apply(params, batch["inputs"], train=False)
-        return (logits.argmax(-1) == batch["labels"]).sum()
+        hit = (logits.argmax(-1) == batch["labels"]) & valid
+        return hit.sum()
 
     def evaluate(params, dataset):
         correct, total = 0, 0
         for batch in dataset.epoch(0):
+            n = len(batch["labels"])
+            pad = (-n) % divisor
+            if pad:
+                batch = {k: np.concatenate(
+                    [v, np.repeat(v[-1:], pad, axis=0)])
+                    for k, v in batch.items()}
+            valid = np.arange(n + pad) < n
             batch = jax.device_put(batch, batch_sharding)
-            correct += int(eval_batch(params, batch))
-            total += len(batch["labels"])
+            valid = jax.device_put(jnp.asarray(valid), batch_sharding)
+            correct += int(eval_batch(params, batch, valid))
+            total += n
         return correct / max(total, 1)
 
     return evaluate
+
+
+def parse_strategy(raw):
+    """``--strategy`` accepts JSON or ``axis:size[,axis:size...]``."""
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        pass
+    else:
+        if not isinstance(parsed, dict):
+            raise SystemExit(
+                f"--strategy: expected an object of axis sizes, got "
+                f"{raw!r}; use JSON ('{{\"dp\": 2, \"ep\": 4}}') or "
+                '"dp:2,ep:4"')
+        return parsed
+    out = {}
+    for part in raw.split(","):
+        part = part.strip()
+        sep = ":" if ":" in part else ("=" if "=" in part else None)
+        if not sep:
+            raise SystemExit(
+                f"--strategy: cannot parse {raw!r}; use JSON "
+                '(\'{"dp": 2, "ep": 4}\') or "dp:2,ep:4"')
+        name, _, value = part.partition(sep)
+        try:
+            out[name.strip()] = int(value)
+        except ValueError:
+            raise SystemExit(
+                f"--strategy: axis size {value!r} is not an integer "
+                f"in {raw!r}") from None
+    return out
 
 
 def main(argv=None) -> int:
@@ -198,9 +253,10 @@ def _main(argv=None) -> int:
     from .parallel import MeshSpec, build_mesh, make_train_step
     from . import tracking
 
-    # 2. mesh from the strategy spec.
+    # 2. mesh from the strategy spec: JSON ('{"dp": 2, "ep": 4}') or the
+    # compact axis list ("dp:2,ep:4" / "dp=2,ep=4").
     strategy_raw = args.strategy or os.environ.get("PTPU_STRATEGY")
-    strategy = json.loads(strategy_raw) if strategy_raw else {}
+    strategy = parse_strategy(strategy_raw)
     mesh = build_mesh(MeshSpec.from_dict(strategy))
     n_chips = mesh.devices.size
 
