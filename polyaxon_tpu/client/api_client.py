@@ -106,6 +106,13 @@ class ApiRunStore:
             "kind": kind, "name": name, "events": events,
         })
 
+    def touch_heartbeat(self, run_uuid: str) -> None:
+        self._request("POST", f"/runs/{run_uuid}/heartbeat")
+
+    def heartbeat_at(self, run_uuid: str) -> Optional[float]:
+        out = self._request("GET", f"/runs/{run_uuid}/heartbeat") or {}
+        return out.get("heartbeat_at")
+
     def read_events(self, run_uuid: str, kind: str, name: str,
                     offset: int = 0) -> List[Dict[str, Any]]:
         return self._request("GET", f"/runs/{run_uuid}/events", params={

@@ -136,6 +136,10 @@ class RunClient:
 
     # -- events / metrics / logs -----------------------------------------
 
+    def touch_heartbeat(self) -> None:
+        self._require_run()
+        self.store.touch_heartbeat(self.run_uuid)
+
     def append_events(self, kind: str, name: str,
                       events: List[Dict[str, Any]]) -> None:
         self._require_run()

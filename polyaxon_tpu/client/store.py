@@ -274,6 +274,28 @@ class FileRunStore:
                     out.append(V1StatusCondition.from_dict(json.loads(line)))
         return out
 
+    # -- heartbeat (zombie detection, SURVEY.md 5.3) ---------------------
+
+    def touch_heartbeat(self, run_uuid: str) -> None:
+        """Record liveness: the tracking writer touches this while the
+        training process is alive; the control plane's zombie sweep
+        fails RUNNING runs whose heartbeat goes stale."""
+        path = os.path.join(self.run_path(run_uuid), "heartbeat")
+        try:
+            os.utime(path)
+        except OSError:
+            with open(path, "w") as f:
+                f.write("")
+
+    def heartbeat_at(self, run_uuid: str) -> Optional[float]:
+        """mtime of the last heartbeat, or None if the run never sent
+        one (runs without tracking must never be declared zombies)."""
+        try:
+            return os.stat(
+                os.path.join(self.run_path(run_uuid), "heartbeat")).st_mtime
+        except OSError:
+            return None
+
     # -- events (metrics & co) -------------------------------------------
 
     def append_events(self, run_uuid: str, kind: str, name: str,

@@ -79,6 +79,64 @@ class ControlPlane:
                     return self.store.get_run(record["uuid"])
         return None
 
+    # -- failure detection (SURVEY.md 5.3) -------------------------------
+
+    def sweep_zombies(self, threshold_s: float = 300.0,
+                      now: Optional[float] = None) -> List[str]:
+        """Fail RUNNING runs whose tracking heartbeat went stale.
+
+        Second line of defense behind the operator's pod supervision:
+        catches trainers that died without the pod failing (network
+        partition from the store, wedged accelerator runtime, kill -9 of
+        the python process inside a living pod).  Runs that never sent a
+        heartbeat (no tracking — services, bare shell jobs) are NEVER
+        swept.  Returns the uuids marked failed.
+        """
+        import time as _time
+
+        now = now if now is not None else _time.time()
+        swept: List[str] = []
+        running = self.store.list_runs(
+            query=f"status:{V1Statuses.RUNNING}")
+        for record in running:
+            try:
+                beat = self.store.heartbeat_at(record["uuid"])
+                if beat is None:
+                    continue
+                age = now - beat
+                if age <= threshold_s:
+                    continue
+                # The heartbeat may belong to a PREVIOUS attempt
+                # (restart/resume reuses the uuid): only sweep when this
+                # attempt's RUNNING transition is itself older than the
+                # threshold and predates no fresher beat.
+                running_since = None
+                for cond in reversed(self.store.get_statuses(
+                        record["uuid"])):
+                    if cond.type == V1Statuses.RUNNING:
+                        running_since = cond.last_transition_time
+                        break
+                # 1s slack: file mtimes are coarser than time.time(), so
+                # a beat touched right after the transition can stat
+                # marginally older than the condition timestamp.
+                if running_since is not None and (
+                        now - running_since <= threshold_s
+                        or beat < running_since - 1.0):
+                    continue
+                # No force: if the run reached a terminal status between
+                # the list and this call, can_transition rejects the
+                # overwrite (RUNNING -> FAILED itself is legal).
+                ok = self.store.set_status(
+                    record["uuid"], V1Statuses.FAILED,
+                    reason="ZombieDetection",
+                    message=f"no heartbeat for {int(age)}s "
+                            f"(threshold {int(threshold_s)}s)")
+                if ok:
+                    swept.append(record["uuid"])
+            except Exception:  # a deleted/corrupt run must not end the
+                continue      # sweep (or the daemon calling it)
+        return swept
+
     # -- streams --------------------------------------------------------
 
     def read_logs_from(self, run_uuid: str, replica: Optional[str],
@@ -165,6 +223,10 @@ _ROUTES: List[Tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/logs$"), "read_logs"),
     ("POST", re.compile(r"^/runs/(?P<u>[^/]+)/lineage$"), "add_lineage"),
     ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/lineage$"), "get_lineage"),
+    ("POST", re.compile(r"^/runs/(?P<u>[^/]+)/heartbeat$"),
+     "touch_heartbeat"),
+    ("GET", re.compile(r"^/runs/(?P<u>[^/]+)/heartbeat$"),
+     "get_heartbeat"),
     ("POST", re.compile(r"^/agent/claim$"), "agent_claim"),
     ("GET", re.compile(r"^/healthz$"), "healthz"),
 ]
@@ -295,6 +357,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _h_last_metrics(self, body, params, u):
         return self.plane.store.last_metrics(u)
+
+    def _h_touch_heartbeat(self, body, params, u):
+        self.plane.store.touch_heartbeat(u)
+        return {"ok": True}
+
+    def _h_get_heartbeat(self, body, params, u):
+        return {"heartbeat_at": self.plane.store.heartbeat_at(u)}
 
     def _h_append_log(self, body, params, u):
         self.plane.store.append_log(u, body.get("text", ""),

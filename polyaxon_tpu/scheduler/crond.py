@@ -10,12 +10,15 @@ schedule.  Pure-stdlib cron matcher; no external deps.
 from __future__ import annotations
 
 import datetime as dt
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from ..client.store import FileRunStore
 from ..lifecycle import V1Statuses
+
+logger = logging.getLogger(__name__)
 
 _FIELD_RANGES = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
 
@@ -118,11 +121,30 @@ def next_fire_time(schedule: Dict[str, Any], after: float,
 
 
 class ScheduleService:
-    """Background loop materializing scheduled operations into child runs."""
+    """Background loop materializing scheduled operations into child runs
+    and sweeping zombie runs (stale tracking heartbeats — SURVEY.md 5.3).
 
-    def __init__(self, store: FileRunStore, poll_interval: float = 1.0):
+    ``zombie_threshold_s``: seconds without a heartbeat before a RUNNING
+    run is failed (``POLYAXON_TPU_ZOMBIE_THRESHOLD`` env overrides;
+    0 disables the sweep).
+    """
+
+    def __init__(self, store: FileRunStore, poll_interval: float = 1.0,
+                 zombie_threshold_s: Optional[float] = None):
+        import os
+
         self.store = store
         self.poll_interval = poll_interval
+        if zombie_threshold_s is None:
+            zombie_threshold_s = float(
+                os.environ.get("POLYAXON_TPU_ZOMBIE_THRESHOLD", "300"))
+        self.zombie_threshold_s = zombie_threshold_s
+        # The sweep scans every run record; at a 1s poll interval that
+        # would double the store scan each tick for a 300s-granularity
+        # check.  Throttle it to a fraction of the threshold.
+        self._sweep_interval = max(10.0, zombie_threshold_s / 10.0)
+        self._last_sweep = 0.0
+        self._plane = None
         self._stop = threading.Event()
 
     def stop(self):
@@ -136,6 +158,18 @@ class ScheduleService:
     def tick(self, now: Optional[float] = None) -> List[str]:
         """Fire due schedules; returns uuids of created child runs."""
         now = now if now is not None else time.time()
+        if self.zombie_threshold_s > 0 and \
+                now - self._last_sweep >= self._sweep_interval:
+            self._last_sweep = now
+            if self._plane is None:
+                from .api import ControlPlane
+
+                self._plane = ControlPlane(self.store)
+            try:
+                self._plane.sweep_zombies(self.zombie_threshold_s,
+                                          now=now)
+            except Exception:  # the daemon must outlive a bad sweep
+                logger.exception("zombie sweep failed")
         created: List[str] = []
         controllers = self.store.list_runs(
             query=f"status:{V1Statuses.ON_SCHEDULE}")

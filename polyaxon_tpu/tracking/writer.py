@@ -17,7 +17,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 class AsyncEventWriter:
     def __init__(self, client, flush_interval: float = 2.0,
-                 max_batch: int = 512):
+                 max_batch: int = 512,
+                 heartbeat_interval: float = 10.0):
         self._client = client
         self._queue: "queue.Queue[Optional[Tuple[str, str, Dict[str, Any]]]]" = \
             queue.Queue()
@@ -27,6 +28,13 @@ class AsyncEventWriter:
         self._closed = threading.Event()
         self._flushed = threading.Condition()
         self._pending = 0
+        # Liveness signal for the control plane's zombie sweep
+        # (SURVEY.md 5.3): touched from this daemon thread, so it tracks
+        # PROCESS liveness — a slow/wedged training step still beats
+        # (hang enforcement is activeDeadlineSeconds' job, not the
+        # sweep's).
+        self._heartbeat_interval = heartbeat_interval
+        self._last_heartbeat = 0.0
 
     def start(self) -> None:
         if self._thread is not None:
@@ -45,9 +53,22 @@ class AsyncEventWriter:
             self._pending += 1
         self._queue.put((kind, name, event))
 
+    def _heartbeat(self) -> None:
+        import time
+
+        now = time.monotonic()
+        if now - self._last_heartbeat < self._heartbeat_interval:
+            return
+        self._last_heartbeat = now
+        try:
+            self._client.touch_heartbeat()
+        except Exception:  # liveness is best-effort; never kill the loop
+            pass
+
     def _loop(self) -> None:
         while True:
             batch: List[Tuple[str, str, Dict[str, Any]]] = []
+            self._heartbeat()
             try:
                 item = self._queue.get(timeout=self._flush_interval)
             except queue.Empty:

@@ -1,0 +1,182 @@
+"""Failure detection: heartbeats + zombie sweep (SURVEY.md §5.3,
+VERDICT r1 §5.3 'partial' — no heartbeat existed).
+
+The tracking writer's daemon thread touches a per-run heartbeat; the
+control plane fails RUNNING runs whose heartbeat goes stale (trainer
+died without its pod failing).  Runs that never heartbeat are exempt.
+"""
+
+import time
+
+import pytest
+
+from polyaxon_tpu.client.store import FileRunStore
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.scheduler.api import ControlPlane
+from polyaxon_tpu.scheduler.crond import ScheduleService
+
+
+@pytest.fixture
+def store(tmp_path):
+    return FileRunStore(str(tmp_path / "home"))
+
+
+def make_running(store, name="r"):
+    record = store.create_run(name=name)
+    store.set_status(record["uuid"], V1Statuses.RUNNING, force=True)
+    return record["uuid"]
+
+
+class TestHeartbeatStore:
+    def test_touch_and_read(self, store):
+        uuid = make_running(store)
+        assert store.heartbeat_at(uuid) is None
+        store.touch_heartbeat(uuid)
+        beat = store.heartbeat_at(uuid)
+        assert beat is not None and time.time() - beat < 5
+
+    def test_touch_missing_run_raises(self, store):
+        with pytest.raises(OSError):
+            store.touch_heartbeat("doesnotexist00")
+
+
+class TestZombieSweep:
+    def test_stale_running_run_failed(self, store):
+        plane = ControlPlane(store)
+        uuid = make_running(store)
+        store.touch_heartbeat(uuid)
+        swept = plane.sweep_zombies(threshold_s=60,
+                                    now=time.time() + 120)
+        assert swept == [uuid]
+        record = store.get_run(uuid)
+        assert record["status"] == V1Statuses.FAILED
+        conditions = store.get_statuses(uuid)
+        assert conditions[-1].reason == "ZombieDetection"
+
+    def test_fresh_heartbeat_untouched(self, store):
+        plane = ControlPlane(store)
+        uuid = make_running(store)
+        store.touch_heartbeat(uuid)
+        assert plane.sweep_zombies(threshold_s=60) == []
+        assert store.get_run(uuid)["status"] == V1Statuses.RUNNING
+
+    def test_no_heartbeat_never_swept(self, store):
+        """Services / bare jobs without tracking must never be declared
+        zombies."""
+        plane = ControlPlane(store)
+        uuid = make_running(store)
+        assert plane.sweep_zombies(threshold_s=60,
+                                   now=time.time() + 9999) == []
+        assert store.get_run(uuid)["status"] == V1Statuses.RUNNING
+
+    def test_stale_beat_from_previous_attempt_not_swept(self, store):
+        """restart/resume reuses the uuid: a heartbeat that predates the
+        current attempt's RUNNING transition must not fail the fresh
+        attempt before its writer sends the first beat."""
+        plane = ControlPlane(store)
+        record = store.create_run(name="retry")
+        uuid = record["uuid"]
+        store.set_status(uuid, V1Statuses.RUNNING, force=True)
+        store.touch_heartbeat(uuid)  # attempt 1's beat
+        store.set_status(uuid, V1Statuses.FAILED, force=True)
+        store.set_status(uuid, V1Statuses.RETRYING, force=True)
+        time.sleep(0.05)
+        store.set_status(uuid, V1Statuses.RUNNING, force=True)
+        # long after the OLD beat went stale, but the new RUNNING
+        # transition is recent -> exempt
+        swept = plane.sweep_zombies(threshold_s=0.01,
+                                    now=time.time())
+        assert swept == []
+        assert store.get_run(uuid)["status"] == V1Statuses.RUNNING
+
+    def test_terminal_race_not_overwritten(self, store):
+        """A run that completes between the sweep's listing and its
+        set_status must keep its terminal status (no force)."""
+        plane = ControlPlane(store)
+        uuid = make_running(store)
+        store.touch_heartbeat(uuid)
+
+        original = store.set_status
+
+        def complete_then_set(run_uuid, status, **kwargs):
+            # simulate the run finishing just before the sweep writes
+            if kwargs.get("reason") == "ZombieDetection":
+                original(run_uuid, V1Statuses.SUCCEEDED, force=True)
+            return original(run_uuid, status, **kwargs)
+
+        store.set_status = complete_then_set
+        try:
+            swept = plane.sweep_zombies(threshold_s=60,
+                                        now=time.time() + 120)
+        finally:
+            store.set_status = original
+        assert swept == []
+        assert store.get_run(uuid)["status"] == V1Statuses.SUCCEEDED
+
+    def test_non_running_not_swept(self, store):
+        plane = ControlPlane(store)
+        record = store.create_run(name="done")
+        store.set_status(record["uuid"], V1Statuses.SUCCEEDED, force=True)
+        store.touch_heartbeat(record["uuid"])
+        assert plane.sweep_zombies(threshold_s=60,
+                                   now=time.time() + 120) == []
+        assert store.get_run(record["uuid"])["status"] == \
+            V1Statuses.SUCCEEDED
+
+    def test_schedule_service_runs_sweep(self, store):
+        uuid = make_running(store)
+        store.touch_heartbeat(uuid)
+        service = ScheduleService(store, zombie_threshold_s=60)
+        service.tick(now=time.time() + 120)
+        assert store.get_run(uuid)["status"] == V1Statuses.FAILED
+
+    def test_sweep_disabled_by_zero_threshold(self, store):
+        uuid = make_running(store)
+        store.touch_heartbeat(uuid)
+        service = ScheduleService(store, zombie_threshold_s=0)
+        service.tick(now=time.time() + 9999)
+        assert store.get_run(uuid)["status"] == V1Statuses.RUNNING
+
+
+class TestTrackingHeartbeat:
+    def test_tracking_writer_heartbeats(self, store, monkeypatch,
+                                        tmp_path):
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        from polyaxon_tpu.client.run_client import RunClient
+        from polyaxon_tpu.tracking.run import Run
+
+        run = Run(client=RunClient(store=store),
+                  collect_system_metrics=False, track_env=False,
+                  track_code=False)
+        uuid = run.run_uuid
+        deadline = time.time() + 10
+        beat = None
+        while time.time() < deadline:
+            beat = store.heartbeat_at(uuid)
+            if beat is not None:
+                break
+            time.sleep(0.1)
+        run.end()
+        assert beat is not None, "writer never heartbeat"
+
+    def test_api_roundtrip(self, tmp_path):
+        import threading
+
+        from polyaxon_tpu.client.api_client import ApiRunStore
+        from polyaxon_tpu.scheduler.api import make_server
+
+        store = FileRunStore(str(tmp_path / "home"))
+        server = make_server("127.0.0.1", 0, store)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            api = ApiRunStore(f"http://127.0.0.1:{port}")
+            uuid = make_running(store)
+            assert api.heartbeat_at(uuid) is None
+            api.touch_heartbeat(uuid)
+            assert api.heartbeat_at(uuid) is not None
+        finally:
+            server.shutdown()
+            server.server_close()
