@@ -14,6 +14,7 @@ mesh natively:
 
 from .bootstrap import initialize_from_env, topology_from_env
 from .constraints import BATCH, ambient_mesh, constrain, current_mesh
+from .health import SliceHealth, check_slice_health
 from .collectives import (
     all_gather,
     all_reduce,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import os
 import shutil
 import time
@@ -25,6 +26,8 @@ from ..lifecycle import V1Statuses
 from .events import EventKind, artifact_event, make_event, metric_event
 from .processors import SystemMetricsMonitor
 from .writer import AsyncEventWriter
+
+logger = logging.getLogger(__name__)
 
 
 class Run:
@@ -55,8 +58,44 @@ class Run:
                     "tracking.init: no run to attach to (env not injected) "
                     "and auto_create disabled"
                 )
-            self.client.create(name=name, kind="job", managed_by="tracking")
-            created = True
+            create_error: Optional[BaseException] = None
+            if self._is_chief:
+                try:
+                    self.client.create(name=name, kind="job",
+                                       managed_by="tracking")
+                    created = True
+                except Exception as e:  # noqa: BLE001 - must still join
+                    # the broadcast below: bailing out here while the
+                    # other processes wait in the collective would wedge
+                    # the whole gang.
+                    create_error = e
+            # UNMANAGED distributed runs (no env-injected identity, e.g.
+            # `python -m polyaxon_tpu.train` launched by hand on N
+            # hosts): every process must share ONE run — separate runs
+            # per process also mean separate checkpoint directories,
+            # and orbax's cross-process barrier keys (derived from the
+            # directory name) then never match: the final async save
+            # deadlocks the whole gang.  Broadcast the chief's uuid.
+            shared = self._broadcast_run_uuid(
+                self.client.run_uuid if self._is_chief else None)
+            if create_error is not None:
+                raise create_error
+            if not self._is_chief:
+                if shared:
+                    self.client = RunClient(
+                        run_uuid=shared,
+                        project=getattr(self.client, "project", project),
+                        store=self.client.store)
+                else:
+                    # Degraded: broadcast unavailable/timed out — track a
+                    # separate run rather than leave this process with no
+                    # run at all (every client API would raise).
+                    logger.warning(
+                        "no shared run uuid received; this process "
+                        "tracks its own run")
+                    self.client.create(name=name, kind="job",
+                                       managed_by="tracking")
+                    created = True
         self._owns_status = created or (is_new or False)
 
         self._writer = AsyncEventWriter(self.client)
@@ -82,6 +121,60 @@ class Run:
                 self._monitor.start()
 
     # -- internals --------------------------------------------------------
+
+    @staticmethod
+    def _broadcast_run_uuid(chief_uuid: Optional[str],
+                            timeout_s: float = 60.0) -> Optional[str]:
+        """Collective: every process returns the chief's run uuid.
+
+        No-op (returns the input) when jax.distributed is not active.
+        The active-check reads the distributed client handle directly —
+        ``jax.process_count()`` would INITIALIZE the backend as a side
+        effect, poisoning a later ``jax.distributed.initialize`` when
+        ``tracking.init`` runs before the bootstrap (and hanging outright
+        on the axon tunnel platform).
+
+        The collective itself runs under a deadline in a worker thread:
+        if any process fails to join (misconfigured gang, chief crashed
+        pre-broadcast), the others degrade to separate runs instead of
+        hanging forever — ``broadcast_one_to_all`` has no timeout of its
+        own."""
+        if int(os.environ.get("PTPU_NUM_PROCESSES", "1")) <= 1:
+            return chief_uuid
+        try:
+            from jax._src import distributed
+
+            if getattr(distributed.global_state, "client", None) is None:
+                return chief_uuid  # bootstrap not active in this process
+        except Exception:  # noqa: BLE001 - private API moved: stay safe
+            return chief_uuid
+
+        import threading
+
+        result: dict = {}
+
+        def broadcast():
+            try:
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                payload = (chief_uuid or "").encode()[:64].ljust(64, b"\0")
+                arr = np.frombuffer(payload, dtype=np.uint8).copy()
+                out = multihost_utils.broadcast_one_to_all(arr)
+                result["uuid"] = \
+                    bytes(out.tolist()).rstrip(b"\0").decode() or None
+            except Exception:  # noqa: BLE001 - reported by the caller
+                logger.exception("run-uuid broadcast failed")
+
+        thread = threading.Thread(target=broadcast, daemon=True,
+                                  name="ptpu-uuid-broadcast")
+        thread.start()
+        thread.join(timeout=timeout_s)
+        if thread.is_alive():
+            logger.error("run-uuid broadcast timed out after %.0fs; "
+                         "processes may track separate runs", timeout_s)
+            return chief_uuid
+        return result.get("uuid", chief_uuid)
 
     def _install_finalizers(self) -> None:
         """Ensure the run never ends up stuck in `running` if the script

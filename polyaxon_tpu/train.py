@@ -245,6 +245,19 @@ def _main(argv=None) -> int:
 
     topology = initialize_from_env()
 
+    # 1b. slice health gate (SURVEY 5.3): prove the fabric computes and
+    #     communicates BEFORE restoring checkpoints / tracing the step.
+    #     Unhealthy -> exit nonzero so the operator reschedules the gang.
+    if topology is not None and topology.is_distributed:
+        from .parallel.health import check_slice_health
+
+        health = check_slice_health(
+            timeout_s=float(os.environ.get(
+                "PTPU_SLICE_HEALTH_TIMEOUT", "120")))
+        print(f"slice health: {health.detail}", flush=True)
+        if not health.ok:
+            raise SystemExit(f"unhealthy slice: {health.detail}")
+
     import jax.numpy as jnp
     import numpy as np
 

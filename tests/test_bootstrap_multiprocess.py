@@ -115,6 +115,42 @@ def _run_two_procs(worker, local_devices):
     return outputs
 
 
+TRACKING_WORKER = textwrap.dedent("""
+    import os, sys
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from polyaxon_tpu.parallel.bootstrap import initialize_from_env
+
+    initialize_from_env(timeout_s=60)
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from polyaxon_tpu import tracking
+    from polyaxon_tpu.checkpoint import CheckpointManager
+
+    # UNMANAGED distributed run: no env-injected run identity -> the
+    # chief's auto-created uuid must be broadcast so every process
+    # shares ONE run (separate checkpoint dirs deadlock orbax's
+    # cross-process barriers - regression for the train.py hang).
+    run = tracking.init(name="shared", collect_system_metrics=False,
+                        track_env=False, track_code=False)
+    print("UUID=" + run.run_uuid, flush=True)
+
+    mesh = Mesh(jax.devices(), ("dp",))
+    rep = NamedSharding(mesh, P())
+    state = {"w": jax.device_put(jnp.ones((4,)), rep)}
+    ckpt = CheckpointManager(run_uuid=run.run_uuid, async_save=True)
+    ckpt.save(1, state, force=True)
+    ckpt.wait()
+    ckpt.close()
+    run.end()
+    print("CKPT OK", flush=True)
+""")
+
+
 def test_two_process_bootstrap_and_psum():
     outputs = _run_two_procs(WORKER, local_devices=1)
     for out in outputs:
@@ -127,3 +163,16 @@ def test_two_process_train_step_descends():
     outputs = _run_two_procs(TRAIN_WORKER, local_devices=4)
     for out in outputs:
         assert "train OK" in out
+
+
+def test_unmanaged_distributed_run_shares_uuid_and_checkpoints(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+    outputs = _run_two_procs(TRACKING_WORKER, local_devices=1)
+    uuids = set()
+    for out in outputs:
+        assert "CKPT OK" in out, out
+        for line in out.splitlines():
+            if line.startswith("UUID="):
+                uuids.add(line.split("=", 1)[1])
+    assert len(uuids) == 1, f"processes tracked separate runs: {uuids}"

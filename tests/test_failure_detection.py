@@ -138,6 +138,52 @@ class TestZombieSweep:
         assert store.get_run(uuid)["status"] == V1Statuses.RUNNING
 
 
+class TestSliceHealth:
+    def test_healthy_mesh(self):
+        from polyaxon_tpu.parallel import (MeshSpec, build_mesh,
+                                           check_slice_health)
+
+        mesh = build_mesh(MeshSpec(dp=-1))
+        health = check_slice_health(mesh, timeout_s=60)
+        assert health.ok, health.detail
+        assert health.n_devices == mesh.devices.size
+        assert health.latency_s is not None
+
+    def test_wedged_runtime_times_out(self, monkeypatch):
+        """A collective that hangs (wedged accelerator runtime) must
+        surface as unhealthy within the deadline — not hang the
+        trainer."""
+        import jax
+
+        from polyaxon_tpu.parallel.health import check_slice_health
+
+        def hanging_jit(*args, **kwargs):
+            def run(arr):
+                time.sleep(30)
+
+            return run
+
+        monkeypatch.setattr(jax, "jit", hanging_jit)
+        start = time.monotonic()
+        health = check_slice_health(timeout_s=0.5)
+        assert time.monotonic() - start < 5
+        assert not health.ok
+        assert "hung" in health.detail
+
+    def test_probe_error_reported(self, monkeypatch):
+        import jax
+
+        from polyaxon_tpu.parallel.health import check_slice_health
+
+        def broken_jit(*args, **kwargs):
+            raise RuntimeError("DEVICE_LOST: chip fell off the torus")
+
+        monkeypatch.setattr(jax, "jit", broken_jit)
+        health = check_slice_health(timeout_s=5)
+        assert not health.ok
+        assert "DEVICE_LOST" in health.detail
+
+
 class TestTrackingHeartbeat:
     def test_tracking_writer_heartbeats(self, store, monkeypatch,
                                         tmp_path):
