@@ -17,7 +17,6 @@ from typing import Any, Dict, List, Optional
 from ..flow.run import (
     RunKind,
     V1MPIJob,
-    V1PytorchJob,
     V1SliceSpec,
     V1TFJob,
     V1TPUJob,
@@ -26,6 +25,18 @@ from ..flow.run import (
 
 class TopologyError(ValueError):
     pass
+
+
+# Compat kinds that collapse to "primary role (process 0, the
+# jax.distributed coordinator) + secondary roles in one SPMD gang"
+# (SURVEY 2.5): kind -> (primary role, secondary roles in order).
+_COMPAT_ROLES = {
+    RunKind.PYTORCHJOB: ("master", ("worker",)),
+    RunKind.PADDLEJOB: ("master", ("worker",)),
+    RunKind.XGBOOSTJOB: ("master", ("worker",)),
+    RunKind.RAYJOB: ("head", ("worker",)),
+    RunKind.DASKJOB: ("scheduler", ("job", "worker")),
+}
 
 
 @dataclass
@@ -134,17 +145,6 @@ def normalize(run: Any) -> ProcessTopology:
             raise TopologyError("tfjob needs chief and/or worker replicas")
         return ProcessTopology(kind=RunKind.TFJOB, slice=slice_spec, groups=groups)
 
-    if isinstance(run, V1PytorchJob) or kind == RunKind.PYTORCHJOB:
-        groups = []
-        if run.master and _nonzero(run.master):
-            groups.append(ReplicaGroup("master", _nonzero(run.master), run.master))
-        if run.worker and _nonzero(run.worker):
-            groups.append(ReplicaGroup("worker", _nonzero(run.worker), run.worker))
-        if not groups:
-            raise TopologyError("pytorchjob needs master and/or worker replicas")
-        return ProcessTopology(kind=RunKind.PYTORCHJOB, slice=slice_spec,
-                               groups=groups)
-
     if isinstance(run, V1MPIJob) or kind == RunKind.MPIJOB:
         # The MPI launcher does not participate in collectives; on TPU the
         # coordinator is worker 0, so the launcher role dissolves.
@@ -154,5 +154,22 @@ def normalize(run: Any) -> ProcessTopology:
         if not groups:
             raise TopologyError("mpijob needs worker replicas")
         return ProcessTopology(kind=RunKind.MPIJOB, slice=slice_spec, groups=groups)
+
+    if kind in _COMPAT_ROLES:
+        primary_role, secondary_roles = _COMPAT_ROLES[kind]
+        groups = []
+        for role in (primary_role,) + tuple(secondary_roles):
+            rep = getattr(run, role, None)
+            if rep is not None and _nonzero(rep):
+                groups.append(ReplicaGroup(role, _nonzero(rep), rep))
+        # rayjob: named worker groups (the reference's `workers` dict);
+        # insertion order defines their process-id offsets.
+        for group_name, rep in (getattr(run, "workers", None) or {}).items():
+            if rep is not None and _nonzero(rep):
+                groups.append(ReplicaGroup(group_name, _nonzero(rep), rep))
+        if not groups:
+            raise TopologyError(
+                f"{kind} needs {primary_role} and/or worker replicas")
+        return ProcessTopology(kind=kind, slice=slice_spec, groups=groups)
 
     raise TopologyError(f"Run kind {kind!r} is not a distributed kind")

@@ -11,9 +11,11 @@ Parity with the reference's runtime union (SURVEY.md 2.4/2.5; expected at
   delegated Kubeflow kinds.
 - ``V1TFJob`` / ``V1PytorchJob`` / ``V1MPIJob`` — compatibility kinds with
   the reference's replica vocabulary (chief/worker/ps, master/worker,
-  launcher/worker).  The compiler normalizes all three onto TPU replica
-  topology so existing polyaxonfiles run unchanged on TPU (BASELINE
-  configs 2/3/5).
+  launcher/worker), normalized onto TPU replica topology so existing
+  polyaxonfiles run unchanged on TPU (BASELINE configs 2/3/5).
+- ``V1PaddleJob`` / ``V1XGBoostJob`` / ``V1RayJob`` / ``V1DaskJob`` —
+  later-version reference kinds (SURVEY 2.5 long tail), same
+  normalization: primary role (master/head/scheduler) is process 0.
 - ``V1TunerJob`` / ``V1NotifierJob`` / ``V1CleanerJob`` — auxiliary kinds.
 
 Scheduling-time kinds (``V1Schedule*``) say *when* runs materialize.
@@ -38,11 +40,16 @@ class RunKind:
     TFJOB = "tfjob"
     PYTORCHJOB = "pytorchjob"
     MPIJOB = "mpijob"
+    PADDLEJOB = "paddlejob"
+    XGBOOSTJOB = "xgboostjob"
+    RAYJOB = "rayjob"
+    DASKJOB = "daskjob"
     TUNER = "tuner"
     NOTIFIER = "notifier"
     CLEANER = "cleaner"
 
-    DISTRIBUTED = {TPUJOB, TFJOB, PYTORCHJOB, MPIJOB}
+    DISTRIBUTED = {TPUJOB, TFJOB, PYTORCHJOB, MPIJOB,
+                   PADDLEJOB, XGBOOSTJOB, RAYJOB, DASKJOB}
 
 
 class V1Job(BaseSchema):
@@ -212,6 +219,70 @@ class V1MPIJob(BaseSchema):
     worker: Optional[V1KFReplica] = None
 
 
+class V1PaddleJob(BaseSchema):
+    """Compatibility kind: reference ``V1PaddleJob`` (master/worker).
+
+    Paddle's fleet collectives become XLA AllReduce over ICI."""
+
+    kind: Literal["paddlejob"] = "paddlejob"
+    clean_pod_policy: Optional[str] = None
+    scheduling_policy: Optional[Dict[str, Any]] = None
+    slice: Optional[V1SliceSpec] = None
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+
+
+class V1XGBoostJob(BaseSchema):
+    """Compatibility kind: reference ``V1XGBoostJob`` (master/worker).
+
+    Rabit allreduce becomes XLA AllReduce; trees build data-parallel."""
+
+    kind: Literal["xgboostjob"] = "xgboostjob"
+    clean_pod_policy: Optional[str] = None
+    scheduling_policy: Optional[Dict[str, Any]] = None
+    slice: Optional[V1SliceSpec] = None
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+
+
+class V1RayJob(BaseSchema):
+    """Compatibility kind: reference ``V1RayJob`` (head + worker groups,
+    entrypoint/rayVersion/runtimeEnv metadata).
+
+    The head role maps to process 0 (the jax.distributed coordinator);
+    named worker groups each become a replica group; Ray's object-store
+    data paths have no TPU analogue — replicas run the SPMD program.
+    ``entrypoint``/``ray_version``/``runtime_env`` are accepted for
+    polyaxonfile compatibility (the container command is the program)."""
+
+    kind: Literal["rayjob"] = "rayjob"
+    clean_pod_policy: Optional[str] = None
+    scheduling_policy: Optional[Dict[str, Any]] = None
+    slice: Optional[V1SliceSpec] = None
+    entrypoint: Optional[str] = None
+    ray_version: Optional[str] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+    metadata: Optional[Dict[str, Any]] = None
+    head: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    workers: Optional[Dict[str, V1KFReplica]] = None  # named groups
+
+
+class V1DaskJob(BaseSchema):
+    """Compatibility kind: reference ``V1DaskJob`` (job/scheduler/worker).
+
+    The scheduler role maps to process 0; job + workers join the one
+    SPMD gang."""
+
+    kind: Literal["daskjob"] = "daskjob"
+    clean_pod_policy: Optional[str] = None
+    scheduling_policy: Optional[Dict[str, Any]] = None
+    slice: Optional[V1SliceSpec] = None
+    job: Optional[V1KFReplica] = None
+    scheduler: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+
+
 # ---------------------------------------------------------------------------
 # DAG
 # ---------------------------------------------------------------------------
@@ -292,6 +363,10 @@ V1Runtime = Union[
     V1TFJob,
     V1PytorchJob,
     V1MPIJob,
+    V1PaddleJob,
+    V1XGBoostJob,
+    V1RayJob,
+    V1DaskJob,
     V1TunerJob,
     V1NotifierJob,
     V1CleanerJob,
@@ -305,6 +380,10 @@ RUNTIME_BY_KIND = {
     RunKind.TFJOB: V1TFJob,
     RunKind.PYTORCHJOB: V1PytorchJob,
     RunKind.MPIJOB: V1MPIJob,
+    RunKind.PADDLEJOB: V1PaddleJob,
+    RunKind.XGBOOSTJOB: V1XGBoostJob,
+    RunKind.RAYJOB: V1RayJob,
+    RunKind.DASKJOB: V1DaskJob,
     RunKind.TUNER: V1TunerJob,
     RunKind.NOTIFIER: V1NotifierJob,
     RunKind.CLEANER: V1CleanerJob,

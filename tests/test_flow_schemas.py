@@ -131,6 +131,70 @@ class TestRuntimeKinds:
         )
         assert isinstance(rt, V1MPIJob)
 
+    def test_later_version_compat_kinds(self):
+        """SURVEY 2.5 long tail: paddle/xgboost/ray/dask kinds parse and
+        normalize — primary role is process 0 (the coordinator)."""
+        from polyaxon_tpu.compiler.topology import normalize
+
+        cases = {
+            "paddlejob": ("master", {"master": {"replicas": 1},
+                                     "worker": {"replicas": 3}}),
+            "xgboostjob": ("master", {"master": {"replicas": 1},
+                                      "worker": {"replicas": 3}}),
+            "rayjob": ("head", {"head": {"replicas": 1},
+                                "worker": {"replicas": 3}}),
+            "daskjob": ("scheduler", {"scheduler": {"replicas": 1},
+                                      "worker": {"replicas": 3}}),
+        }
+        for kind, (primary, roles) in cases.items():
+            rt = parse_runtime({"kind": kind, **roles})
+            assert rt.kind == kind
+            topo = normalize(rt)
+            assert [g.role for g in topo.groups] == [primary, "worker"]
+            assert sum(g.replicas for g in topo.groups) == 4
+
+    def test_rayjob_reference_field_surface(self):
+        """A polyaxonfile written for the reference's V1RayJob (camelCase
+        entrypoint/rayVersion/runtimeEnv + named worker groups) parses
+        and normalizes; worker-group order sets process-id offsets."""
+        from polyaxon_tpu.compiler.topology import normalize
+
+        rt = parse_runtime({
+            "kind": "rayjob",
+            "entrypoint": "python train.py",
+            "rayVersion": "2.9",
+            "runtimeEnv": {"pip": ["jax"]},
+            "head": {"replicas": 1},
+            "workers": {"small": {"replicas": 2},
+                        "big": {"replicas": 4}},
+        })
+        assert rt.ray_version == "2.9"
+        topo = normalize(rt)
+        assert [(g.role, g.replicas) for g in topo.groups] == [
+            ("head", 1), ("small", 2), ("big", 4)]
+        assert topo.num_processes == 7
+        assert topo.coordinator_role == "head"
+
+    def test_daskjob_reference_roles(self):
+        from polyaxon_tpu.compiler.topology import normalize
+
+        rt = parse_runtime({
+            "kind": "daskjob",
+            "job": {"replicas": 1},
+            "scheduler": {"replicas": 1},
+            "worker": {"replicas": 2},
+        })
+        topo = normalize(rt)
+        assert [g.role for g in topo.groups] == [
+            "scheduler", "job", "worker"]
+
+    def test_compat_kind_requires_replicas(self):
+        from polyaxon_tpu.compiler.topology import (TopologyError,
+                                                    normalize)
+
+        with pytest.raises(TopologyError, match="head and/or worker"):
+            normalize(parse_runtime({"kind": "rayjob"}))
+
     def test_unknown_kind(self):
         with pytest.raises(ValueError, match="Unknown run kind"):
             parse_runtime({"kind": "sparkjob"})

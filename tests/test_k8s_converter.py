@@ -219,6 +219,33 @@ class TestDistributedConversion:
             f"tf1-chief-0.ptpu-tf1-hs:{COORDINATOR_PORT}"
         assert chief_env["PTPU_NUM_PROCESSES"] == "2"
 
+    def test_rayjob_compat_roles(self, tmp_path):
+        """Later-version compat kinds convert through the same topology
+        path: head is process group 0 -> carries the coordinator."""
+        yaml = """
+kind: component
+name: ray-trainer
+run:
+  kind: rayjob
+  slice: {type: v5litepod-8}
+  head:
+    replicas: 1
+    container: {image: jax:latest}
+  worker:
+    replicas: 2
+    container: {image: jax:latest}
+"""
+        compiled = compile_yaml(tmp_path, yaml, run_uuid="ray1")
+        cr = convert(compiled, "ray1", "proj")
+        specs = cr["spec"]["replicaSpecs"]
+        assert set(specs) == {"head", "worker"}
+        head_env = {e["name"]: e.get("value")
+                    for e in specs["head"]["template"]["spec"]
+                    ["containers"][0]["env"]}
+        assert head_env["PTPU_COORDINATOR_ADDRESS"] == \
+            f"ray1-head-0.ptpu-ray1-hs:{COORDINATOR_PORT}"
+        assert head_env["PTPU_NUM_PROCESSES"] == "3"
+
     def test_headless_service(self, tmp_path):
         compiled = compile_yaml(tmp_path, TPUJOB_YAML, run_uuid="run42")
         cr = convert(compiled, "run42", "proj")
