@@ -161,8 +161,18 @@ def ops_ls(project, query, sort, limit, offset):
 @ops.command(name="get")
 @click.argument("run_uuid")
 def ops_get(run_uuid):
-    """Show one run's record."""
+    """Show one run's record (+ heartbeat age for running runs)."""
     record = _get_run_or_fail(run_uuid)
+    if record.get("status") == "running":
+        try:
+            beat = _store().heartbeat_at(run_uuid)
+        except Exception:  # noqa: BLE001 - informational only
+            beat = None
+        if beat is not None:
+            import time as _time
+
+            record = {**record,
+                      "heartbeat_age_s": round(_time.time() - beat, 1)}
     click.echo(json.dumps(record, indent=2, default=str))
 
 

@@ -11,6 +11,7 @@ all consume it.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -163,10 +164,27 @@ def normalize(run: Any) -> ProcessTopology:
             if rep is not None and _nonzero(rep):
                 groups.append(ReplicaGroup(role, _nonzero(rep), rep))
         # rayjob: named worker groups (the reference's `workers` dict);
-        # insertion order defines their process-id offsets.
+        # insertion order defines their process-id offsets.  Group names
+        # become pod hostnames / DNS labels and must be unique roles —
+        # a duplicate would collapse two groups into one replicaSpec
+        # while the process count still counts both (a gang that never
+        # fully assembles).
+        seen_roles = {g.role for g in groups}
         for group_name, rep in (getattr(run, "workers", None) or {}).items():
-            if rep is not None and _nonzero(rep):
-                groups.append(ReplicaGroup(group_name, _nonzero(rep), rep))
+            if rep is None or not _nonzero(rep):
+                continue
+            if not re.fullmatch(r"[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?",
+                                group_name):
+                raise TopologyError(
+                    f"worker group name {group_name!r} is not a valid "
+                    "DNS-1123 label (lowercase alphanumerics and '-', "
+                    "max 63 chars) — it becomes the pod hostname")
+            if group_name in seen_roles:
+                raise TopologyError(
+                    f"worker group name {group_name!r} collides with "
+                    "another replica role")
+            seen_roles.add(group_name)
+            groups.append(ReplicaGroup(group_name, _nonzero(rep), rep))
         if not groups:
             raise TopologyError(
                 f"{kind} needs {primary_role} and/or worker replicas")

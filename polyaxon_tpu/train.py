@@ -329,6 +329,11 @@ def _main(argv=None) -> int:
     if args.resume:
         state, restored = ckpt.restore_or_init(state)
         start_step = int(restored or 0)
+        if restored is not None:
+            # Stdout, not just the logger: the restart/preemption story
+            # is diagnosed from pod logs.
+            print(f"resuming from checkpoint step {start_step}",
+                  flush=True)
     ckpt.install_preemption_hook(lambda: state,
                                  lambda: int(state["step"]))
 

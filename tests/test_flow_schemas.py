@@ -175,6 +175,20 @@ class TestRuntimeKinds:
         assert topo.num_processes == 7
         assert topo.coordinator_role == "head"
 
+    def test_rayjob_worker_group_names_validated(self):
+        from polyaxon_tpu.compiler.topology import (TopologyError,
+                                                    normalize)
+
+        with pytest.raises(TopologyError, match="DNS-1123"):
+            normalize(parse_runtime({
+                "kind": "rayjob", "head": {"replicas": 1},
+                "workers": {"gpu_workers": {"replicas": 2}}}))
+        with pytest.raises(TopologyError, match="collides"):
+            normalize(parse_runtime({
+                "kind": "rayjob", "head": {"replicas": 1},
+                "worker": {"replicas": 2},
+                "workers": {"worker": {"replicas": 4}}}))
+
     def test_daskjob_reference_roles(self):
         from polyaxon_tpu.compiler.topology import normalize
 

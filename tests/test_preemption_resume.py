@@ -1,0 +1,127 @@
+"""Preemption -> gang restart -> checkpoint auto-resume, end to end
+through the native operator (SURVEY.md 5.3/5.4: "preemption ->
+checkpoint-and-requeue; restart with same topology").
+
+A REAL training pod (``polyaxon_tpu.train``, checkpointing every 2
+steps) crashes mid-run on its first attempt; the operator's gang
+semantics relaunch it (backoffLimit), and the second attempt must
+auto-resume from the saved checkpoint — not restart from step 0 — and
+finish.  This is the recovery path a TPU-slice reclaim exercises.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from polyaxon_tpu.client.store import FileRunStore
+
+OPERATOR_DIR = Path(__file__).resolve().parent.parent / "operator"
+
+
+@pytest.fixture(scope="session")
+def operator_binary():
+    proc = subprocess.run(["make", "-C", str(OPERATOR_DIR)],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.fail(f"operator build failed:\n{proc.stderr}")
+    return str(OPERATOR_DIR / "build" / "ptpu-operator")
+
+
+# First attempt: train 4 steps (checkpoints at 2 and 4), then die like a
+# preempted pod.  Second attempt: train to 8 — must resume from step 4.
+TRAINER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    marker = sys.argv[1]
+    first_attempt = not os.path.exists(marker)
+    if first_attempt:
+        open(marker, "w").write("x")
+    from polyaxon_tpu.train import main
+    steps = "4" if first_attempt else "8"
+    rc = main(["--model", "mlp", "--steps", steps, "--batch-size", "8",
+               "--checkpoint-every", "2", "--log-every", "2"])
+    if first_attempt:
+        print("simulating preemption crash", flush=True)
+        sys.exit(1)
+    sys.exit(rc or 0)
+""")
+
+
+def test_crash_restart_resumes_from_checkpoint(tmp_path, operator_binary,
+                                               monkeypatch):
+    home = tmp_path / "home"
+    monkeypatch.setenv("POLYAXON_TPU_HOME", str(home))
+    store = FileRunStore(str(home))
+    record = store.create_run(name="resume-e2e", project="default")
+    run_uuid = record["uuid"]
+
+    cluster = tmp_path / "cluster"
+    (cluster / "operations").mkdir(parents=True)
+    marker = tmp_path / "attempt.marker"
+    env = [{"name": "POLYAXON_TPU_HOME", "value": str(home)},
+           {"name": "POLYAXON_TPU_RUN_UUID", "value": run_uuid},
+           {"name": "JAX_PLATFORMS", "value": "cpu"},
+           {"name": "PYTHONPATH",
+            "value": str(Path(__file__).resolve().parent.parent)}]
+    cr = {"operation": {
+        "apiVersion": "core.polyaxon-tpu.io/v1",
+        "kind": "Operation",
+        "metadata": {"name": "resume-e2e",
+                     "labels": {"polyaxon-tpu/run-uuid": run_uuid}},
+        "spec": {
+            "runKind": "job",
+            "backoffLimit": 1,
+            "template": {"spec": {"containers": [{
+                "name": "ptpu-main",
+                "command": [sys.executable, "-c", TRAINER, str(marker)],
+                "env": env,
+            }]}},
+        },
+    }, "services": []}
+    path = cluster / "operations" / "resume-e2e.json"
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(cr))
+    os.replace(tmp, path)
+
+    proc = subprocess.Popen(
+        [operator_binary, "--cluster-dir", str(cluster),
+         "--poll-ms", "50", "--grace-ms", "500"])
+    try:
+        status_path = cluster / "status" / "resume-e2e.json"
+        deadline = time.time() + 180
+        status = None
+        while time.time() < deadline:
+            if status_path.exists():
+                try:
+                    status = json.loads(status_path.read_text())
+                except ValueError:
+                    pass
+                if status and status.get("phase") in ("Succeeded",
+                                                      "Failed"):
+                    break
+            time.sleep(0.1)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+
+    assert status is not None, "operator never published status"
+    assert status["phase"] == "Succeeded", status
+    assert status["attempt"] == 1  # crashed once, relaunched once
+
+    log = (cluster / "logs" / "resume-e2e" /
+           f"{run_uuid}-main-0.log").read_text()
+    assert "simulating preemption crash" in log
+    # the relaunched attempt resumed from the checkpoint, not step 0
+    assert "resuming from checkpoint step 4" in log, log[-2000:]
+    assert "step 8/8" in log
+    # and it did NOT re-train steps 1-4 after the crash
+    crash_at = log.index("simulating preemption crash")
+    assert "step 2/8" not in log[crash_at:]
