@@ -171,8 +171,12 @@ def ops_get(run_uuid):
         if beat is not None:
             import time as _time
 
+            # Clamp: in API mode `beat` is the server's clock; a few
+            # seconds of client/server skew must not print a negative
+            # age.
             record = {**record,
-                      "heartbeat_age_s": round(_time.time() - beat, 1)}
+                      "heartbeat_age_s":
+                          max(0.0, round(_time.time() - beat, 1))}
     click.echo(json.dumps(record, indent=2, default=str))
 
 

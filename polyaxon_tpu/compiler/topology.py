@@ -173,12 +173,17 @@ def normalize(run: Any) -> ProcessTopology:
         for group_name, rep in (getattr(run, "workers", None) or {}).items():
             if rep is None or not _nonzero(rep):
                 continue
-            if not re.fullmatch(r"[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?",
+            # The name is a FRAGMENT of the pod hostname
+            # ("<run-uuid>-<role>-<index>", assembled by the converter):
+            # budget 63-char DNS label minus 12-char uuid, two dashes,
+            # and up to 4 index digits -> 45 chars for the role.
+            if not re.fullmatch(r"[a-z0-9]([-a-z0-9]{0,43}[a-z0-9])?",
                                 group_name):
                 raise TopologyError(
                     f"worker group name {group_name!r} is not a valid "
-                    "DNS-1123 label (lowercase alphanumerics and '-', "
-                    "max 63 chars) — it becomes the pod hostname")
+                    "pod-hostname fragment (lowercase alphanumerics and "
+                    "'-', max 45 chars: the 63-char DNS label budget "
+                    "minus the run-uuid prefix and replica index)")
             if group_name in seen_roles:
                 raise TopologyError(
                     f"worker group name {group_name!r} collides with "

@@ -23,6 +23,7 @@ from .gpt2 import GPT2Config, GPT2Model
 from .mlp import MLP
 from .moe_gpt import MoEGPTConfig, MoEGPTModel
 from .resnet import ResNet, ResNet50
+from .vit import ViTConfig, ViTModel
 
 
 def softmax_xent(logits, labels):
@@ -202,6 +203,22 @@ _register(ModelSpec(
     make_model=lambda **kw: GPT2Model(GPT2Config.tiny(), **kw),
     make_batch=lambda b: _token_batch(b, 64, GPT2Config.tiny().vocab_size),
     loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="vit-base",
+    make_model=lambda **kw: ViTModel(ViTConfig.base(), **kw),
+    make_batch=lambda b: _image_batch(b, 224, 1000),
+    loss_fn=_classifier_loss,
+    default_batch_size=64,
+))
+
+_register(ModelSpec(
+    name="vit-tiny",
+    make_model=lambda **kw: ViTModel(ViTConfig.tiny(), **kw),
+    make_batch=lambda b: _image_batch(b, 32, 10),
+    loss_fn=_classifier_loss,
     default_batch_size=8,
 ))
 

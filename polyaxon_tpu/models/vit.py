@@ -1,0 +1,141 @@
+"""ViT — vision transformer classifier for the zoo.
+
+The reference orchestrates arbitrary user models; our zoo carries the
+standard TPU headliners, and ViT is the canonical image transformer
+(patchify -> pre-LN encoder -> CLS head).  TPU-first choices mirror the
+rest of the zoo: the patch embedding is one big conv (= matmul on the
+MXU), bf16 matmuls with f32 layernorm/softmax, fused QKV, param names
+matching ``parallel.strategies.TP_RULES`` (``qkv``/``o_proj``/``fc1``/
+``fc2``) so ``{tp: N}`` shards it with no per-model config, and the
+layer stack rolls under ``nn.scan`` (flat compile time; the stacked
+``[layers, ...]`` params are what pipeline parallelism consumes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.constraints import BATCH, constrain
+from .attention import dot_product_attention
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def base() -> "ViTConfig":
+        return ViTConfig()  # ViT-B/16
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         hidden_size=64, num_layers=2, num_heads=4,
+                         intermediate_size=128)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN encoder block (non-causal attention over patches+CLS)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln1")(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype,
+                       name="qkv")(h)
+        qkv = constrain(qkv, BATCH, None, "tp")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = h.shape[:-1] + (cfg.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        a = dot_product_attention(q, k, v, causal=False)
+        a = a.reshape(h.shape)
+        a = constrain(a, BATCH, None, "tp")
+        x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                         name="o_proj")(a)
+        x = constrain(x, BATCH, None, None)
+
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln2")(x).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="fc1")(h)
+        h = constrain(h, BATCH, None, "tp")
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
+        x = x + h
+        return constrain(x, BATCH, None, None)
+
+
+class _ScanBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        cls = nn.remat(ViTBlock, prevent_cse=False) if self.cfg.remat \
+            else ViTBlock
+        return cls(self.cfg, name="block")(x), None
+
+
+class ViTModel(nn.Module):
+    """``__call__(images[B,H,W,C]) -> logits[B,num_classes]``."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, *, train: bool = False):
+        cfg = self.cfg
+        b = images.shape[0]
+        # Patchify = strided conv; lowers to one MXU matmul per patch
+        # row. [B, H, W, C] -> [B, P, hidden]
+        x = nn.Conv(cfg.hidden_size,
+                    kernel_size=(cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, name="patch_embed")(
+                        images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.hidden_size)
+
+        cls_token = self.param("cls", nn.initializers.zeros,
+                               (1, 1, cfg.hidden_size), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls_token.astype(cfg.dtype),
+                              (b, 1, cfg.hidden_size)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.hidden_size),
+                         jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        x = constrain(x, BATCH, None, None)
+
+        blocks = nn.scan(
+            _ScanBlock,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.num_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, name="h")
+        x, _ = blocks(x, None)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
+        # CLS-token head in f32 (classifier logits stay full precision).
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])

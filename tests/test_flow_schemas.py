@@ -179,7 +179,7 @@ class TestRuntimeKinds:
         from polyaxon_tpu.compiler.topology import (TopologyError,
                                                     normalize)
 
-        with pytest.raises(TopologyError, match="DNS-1123"):
+        with pytest.raises(TopologyError, match="hostname fragment"):
             normalize(parse_runtime({
                 "kind": "rayjob", "head": {"replicas": 1},
                 "workers": {"gpu_workers": {"replicas": 2}}}))
