@@ -120,12 +120,15 @@ def compile_step(step_fn, state, batch, rng):
     returned FLOPs are per chip.
     """
     flops = None
+    compile_s = None
     try:
         from polyaxon_tpu.parallel import ambient_mesh
 
         jitted = step_fn._build()
+        t0 = time.perf_counter()
         with ambient_mesh(step_fn.mesh):  # activation constraints trace
             compiled = jitted.lower(state, batch, rng).compile()
+        compile_s = time.perf_counter() - t0  # trace + XLA compile
         step_fn._step = compiled  # reuse: same shapes, same donation
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -134,7 +137,7 @@ def compile_step(step_fn, state, batch, rng):
     except Exception as e:
         print(f"# cost analysis unavailable: {type(e).__name__}",
               file=sys.stderr)
-    return flops
+    return flops, compile_s
 
 
 def bench_model(jax, model_name: str, batch_size: int, steps: int,
@@ -156,7 +159,7 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
     batch = jax.device_put(batch, step.batch_sharding)
     rng = jax.random.PRNGKey(0)
 
-    flops = compile_step(step, state, batch, rng)
+    flops, compile_s = compile_step(step, state, batch, rng)
 
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
@@ -196,6 +199,9 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
         "unit": ("tok" if is_lm else "img") + "/sec/chip",
         "step_flops": flops,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # VERDICT r1 #3 criterion: scanned stacks keep compile time
+        # flat in depth (gpt2-medium well under 30s on the chip).
+        "compile_s": round(compile_s, 1) if compile_s else None,
         "loss": final_loss,
     }
 
