@@ -88,7 +88,9 @@ def run(files, params, presets, name, project, watch, eager, check_only):
         record = client.create(name=name or op.name, content=op.to_dict(),
                                kind=getattr(op.component.run, "kind", None)
                                if op.has_component else None,
-                               managed_by="agent")
+                               managed_by="agent",
+                               queue=op.effective_queue,
+                               priority=op.effective_priority)
         client.log_status("queued", reason="CliSubmit", force=True)
         click.echo(f"Run {record['uuid']} queued on {host}")
         return
@@ -351,6 +353,10 @@ def _restart(run_uuid: str, copy_artifacts: bool, resume: bool):
             name=record.get("name"), project=record.get("project"),
             content=content, kind=record.get("kind"), meta_info=meta,
             managed_by="agent",
+            # keep queue routing/priority: a restarted tpu-v5e run must
+            # stay claimable by queue-scoped agents
+            queue=record.get("queue"),
+            priority=record.get("priority") or 0,
         )
         store.set_status(new["uuid"], "queued", reason="CliRestart",
                          force=True)
@@ -686,7 +692,9 @@ def server(host, port, schedules, auth_token):
 @click.option("--cluster-dir", default=None,
               help="Manifest backend: directory the operator watches.")
 @click.option("--max-concurrent", default=8, type=int)
-def agent(name, host, backend, cluster_dir, max_concurrent):
+@click.option("--queue", "queues", multiple=True,
+              help="Serve only these queues (repeatable; default: all).")
+def agent(name, host, backend, cluster_dir, max_concurrent, queues):
     """Run an agent: claim queued runs and execute them."""
     from polyaxon_tpu.runner.agent import (Agent, KubeBackend, LocalBackend,
                                            ManifestBackend)
@@ -712,7 +720,8 @@ def agent(name, host, backend, cluster_dir, max_concurrent):
         store = getattr(plane, "store", plane)
         be = LocalBackend(store)
     worker = Agent(plane, backend=be, name=name,
-                   max_concurrent=max_concurrent)
+                   max_concurrent=max_concurrent,
+                   queues=list(queues) or None)
     click.echo(f"agent {name} polling "
                f"{host or 'local store'} (backend={backend})")
     try:

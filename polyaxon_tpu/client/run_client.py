@@ -58,11 +58,14 @@ class RunClient:
         pipeline: Optional[str] = None,
         meta_info: Optional[Dict[str, Any]] = None,
         managed_by: str = "local",
+        queue: Optional[str] = None,
+        priority: int = 0,
     ) -> Dict[str, Any]:
         record = self.store.create_run(
             name=name, project=self.project, description=description,
             tags=tags, content=content, kind=kind, pipeline=pipeline,
             meta_info=meta_info, managed_by=managed_by,
+            queue=queue, priority=priority,
         )
         self.run_uuid = record["uuid"]
         self._run_data = record

@@ -116,6 +116,8 @@ class FileRunStore:
         meta_info: Optional[Dict[str, Any]] = None,
         run_uuid: Optional[str] = None,
         managed_by: str = "local",
+        queue: Optional[str] = None,
+        priority: int = 0,
     ) -> Dict[str, Any]:
         run_uuid = run_uuid or uuidlib.uuid4().hex[:12]
         path = self.run_path(run_uuid)
@@ -134,6 +136,8 @@ class FileRunStore:
             "pipeline": pipeline,
             "meta_info": meta_info or {},
             "managed_by": managed_by,
+            "queue": queue,
+            "priority": int(priority or 0),
             "status": V1Statuses.CREATED,
             "created_at": time.time(),
             "updated_at": time.time(),

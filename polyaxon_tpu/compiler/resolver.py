@@ -54,6 +54,7 @@ def make_compiled(operation: V1Operation) -> V1CompiledOperation:
             "tags": sorted(set(operation.tags or []) | set(comp.tags or [])) or None,
             "presets": operation.presets,
             "queue": pick(operation.queue, comp.queue),
+            "priority": pick(operation.priority, comp.priority),
             "cache": pick(operation.cache, comp.cache),
             "termination": pick(
                 operation.termination.to_dict() if operation.termination else None,

@@ -51,6 +51,7 @@ class V1Component(BaseSchema):
     tags: Optional[List[str]] = None
     presets: Optional[List[str]] = None
     queue: Optional[str] = None
+    priority: Optional[int] = None
     cache: Optional[V1Cache] = None
     termination: Optional[V1Termination] = None
     plugins: Optional[V1Plugins] = None
@@ -122,6 +123,7 @@ class V1Operation(BaseSchema):
     tags: Optional[List[str]] = None
     presets: Optional[List[str]] = None
     queue: Optional[str] = None
+    priority: Optional[int] = None
     cache: Optional[V1Cache] = None
     termination: Optional[V1Termination] = None
     plugins: Optional[V1Plugins] = None
@@ -186,6 +188,23 @@ class V1Operation(BaseSchema):
     def has_component(self) -> bool:
         return self.component is not None
 
+    @property
+    def effective_queue(self) -> Optional[str]:
+        """None-aware op-over-component merge (the resolver's `pick`)."""
+        if self.queue is not None:
+            return self.queue
+        return self.component.queue if self.has_component else None
+
+    @property
+    def effective_priority(self) -> int:
+        # `is not None`, not truthiness: an explicit `priority: 0` on
+        # the operation must override a component's nonzero priority.
+        if self.priority is not None:
+            return self.priority
+        if self.has_component and self.component.priority is not None:
+            return self.component.priority
+        return 0
+
 
 class V1CompiledOperation(BaseSchema):
     """Operation after resolution: component inlined, params validated,
@@ -198,6 +217,7 @@ class V1CompiledOperation(BaseSchema):
     tags: Optional[List[str]] = None
     presets: Optional[List[str]] = None
     queue: Optional[str] = None
+    priority: Optional[int] = None
     cache: Optional[V1Cache] = None
     termination: Optional[V1Termination] = None
     plugins: Optional[V1Plugins] = None

@@ -323,6 +323,7 @@ class Agent:
         name: str = "agent-0",
         poll_interval: float = 0.2,
         max_concurrent: int = 8,
+        queues: Optional[list] = None,
     ):
         self.plane = plane
         # Both expose .claim(); ControlPlane wraps the store, ApiRunStore
@@ -332,6 +333,9 @@ class Agent:
         self.name = name
         self.poll_interval = poll_interval
         self.max_concurrent = max_concurrent
+        # Restrict this agent to named queues (None = serve everything,
+        # including unqueued runs).
+        self.queues = list(queues) if queues else None
         # Backends that can resolve joins need store access.
         if getattr(self.backend, "store", True) is None:
             self.backend.store = self.store
@@ -355,7 +359,7 @@ class Agent:
         # Finished runs merely awaiting TTL cleanup don't hold a slot.
         live = sum(1 for a in self.active.values() if a.done_at is None)
         if live < self.max_concurrent:
-            record = self.plane.claim(self.name)
+            record = self.plane.claim(self.name, queues=self.queues)
             if record:
                 self._launch(record)
                 progressed = True

@@ -68,6 +68,16 @@ class ControlPlane:
         with self._claim_lock:
             queued = self.store.list_runs(query=f"status:{V1Statuses.QUEUED}",
                                           sort="created_at")
+            # Priority first (higher wins), FIFO within a priority.
+            # Defensive key: a PATCHed non-numeric priority on one record
+            # must not poison claiming for every agent.
+            def neg_priority(record):
+                try:
+                    return -int(record.get("priority") or 0)
+                except (TypeError, ValueError):
+                    return 0
+
+            queued.sort(key=neg_priority)
             for record in queued:
                 if queues and record.get("queue") not in queues:
                     continue
@@ -301,6 +311,7 @@ class _Handler(BaseHTTPRequestHandler):
     _CREATE_FIELDS = frozenset({
         "name", "project", "description", "tags", "content", "kind",
         "pipeline", "meta_info", "run_uuid", "managed_by",
+        "queue", "priority",
     })
 
     def _h_create_run(self, body, params):

@@ -204,6 +204,9 @@ class ScheduleService:
                 kind=record.get("kind"),
                 pipeline=record["uuid"],
                 meta_info={"schedule_iteration": iteration},
+                # inherit queue routing/priority from the controller
+                queue=record.get("queue"),
+                priority=record.get("priority") or 0,
             )
             self.store.set_status(child["uuid"], V1Statuses.QUEUED,
                                   reason="ScheduleFire")

@@ -167,6 +167,92 @@ class TestAuth:
             server.server_close()
 
 
+class TestQueuePriority:
+    def test_claim_orders_by_priority_then_fifo(self, store):
+        plane = ControlPlane(store)
+        uuids = []
+        for name, priority in (("low-1", 0), ("high", 5), ("low-2", 0)):
+            record = store.create_run(name=name, content=JOB_CONTENT,
+                                      priority=priority)
+            store.set_status(record["uuid"], V1Statuses.QUEUED)
+            uuids.append(record["uuid"])
+        claimed = [plane.claim("a")["uuid"] for _ in range(3)]
+        # high priority first, then FIFO among equal priorities
+        assert claimed == [uuids[1], uuids[0], uuids[2]]
+
+    def test_agent_serves_only_its_queues(self, store):
+        plane = ControlPlane(store)
+        gpu = store.create_run(name="gpu-run", content=JOB_CONTENT,
+                               queue="tpu-v5e")
+        other = store.create_run(name="other", content=JOB_CONTENT,
+                                 queue="cpu")
+        for record in (gpu, other):
+            store.set_status(record["uuid"], V1Statuses.QUEUED)
+        claimed = plane.claim("a", queues=["tpu-v5e"])
+        assert claimed["uuid"] == gpu["uuid"]
+        # nothing else in the served queues
+        assert plane.claim("a", queues=["tpu-v5e"]) is None
+        # the cpu run is still queued for some other agent
+        assert store.get_run(other["uuid"])["status"] == V1Statuses.QUEUED
+
+    def test_operation_queue_priority_reach_the_record(self, store):
+        """polyaxonfile queue/priority flow through the op merge into
+        the CREATED run record (the CLI's API-mode submission path)."""
+        from polyaxon_tpu.client.run_client import RunClient
+        from polyaxon_tpu.polyaxonfile import get_op_from_files
+
+        spec = {**JOB_CONTENT, "queue": "tpu-v5e", "priority": 7}
+        op = get_op_from_files([spec])
+        client = RunClient(store=store)
+        record = client.create(name=op.name, content=op.to_dict(),
+                               queue=op.effective_queue,
+                               priority=op.effective_priority)
+        stored = store.get_run(record["uuid"])
+        assert stored["queue"] == "tpu-v5e"
+        assert stored["priority"] == 7
+
+    def test_effective_priority_zero_overrides_component(self):
+        """An explicit operation-level `priority: 0` must override a
+        component's nonzero priority (None-aware, not truthy)."""
+        from polyaxon_tpu.polyaxonfile import get_op_from_files
+
+        spec = {**JOB_CONTENT, "priority": 0}
+        spec["component"] = {**spec["component"], "priority": 5,
+                             "queue": "batch"}
+        op = get_op_from_files([spec])
+        assert op.effective_priority == 0
+        assert op.effective_queue == "batch"  # op has none -> component
+
+    def test_scheduled_children_inherit_queue_priority(self, store):
+        from polyaxon_tpu.scheduler.crond import ScheduleService
+
+        content = {**JOB_CONTENT,
+                   "schedule": {"kind": "interval", "frequency": 1,
+                                "maxRuns": 1}}
+        controller = store.create_run(name="sched", content=content,
+                                      queue="tpu-v5e", priority=3)
+        store.set_status(controller["uuid"], V1Statuses.ON_SCHEDULE,
+                         force=True)
+        service = ScheduleService(store, zombie_threshold_s=0)
+        import time as _time
+
+        now = _time.time()
+        service.tick(now=now)          # arms schedule_next_at
+        created = service.tick(now=now + 2)
+        assert created, "schedule never fired"
+        child = store.get_run(created[0])
+        assert child["queue"] == "tpu-v5e"
+        assert child["priority"] == 3
+
+    def test_claim_survives_non_numeric_priority(self, store):
+        plane = ControlPlane(store)
+        record = store.create_run(name="bad", content=JOB_CONTENT)
+        store.set_status(record["uuid"], V1Statuses.QUEUED)
+        store.update_run(record["uuid"], priority="urgent")
+        claimed = plane.claim("a")  # must not raise
+        assert claimed["uuid"] == record["uuid"]
+
+
 class TestAgent:
     def test_agent_executes_queued_job(self, store):
         plane = ControlPlane(store)
