@@ -17,6 +17,7 @@ for each child run.
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import socket
@@ -140,6 +141,15 @@ class LocalExecutor:
         self.store.set_status(run_uuid, V1Statuses.COMPILED,
                               reason="LocalExecutor")
 
+        # Run memoization (SURVEY 2.3 V1Cache): with `cache: {}` declared
+        # (and not disabled), an identical (component, inputs) run reuses
+        # a prior SUCCEEDED run's outputs instead of re-executing.
+        # Opt-in here (the reference defaults caching ON inside
+        # pipelines; explicit declaration keeps local reuse predictable).
+        cached = self._try_cache(run_uuid, operation, compiled)
+        if cached is not None:
+            return cached
+
         kind = compiled.run_kind
         termination = compiled.termination
         max_retries = (termination.max_retries if termination and
@@ -179,6 +189,101 @@ class LocalExecutor:
         self.store.set_status(run_uuid, V1Statuses.SUCCEEDED,
                               reason="LocalExecutor")
         return self._finalize(run_uuid, compiled)
+
+    def _cache_fingerprint(self, run_uuid: str, compiled, cache) -> str:
+        """sha256 over the RESOLVED run section + inputs.
+
+        Hashing the compiled run (not the raw component) means
+        ``runPatch`` edits and matrix-templated commands fingerprint
+        differently — two runs only match when the program they would
+        execute is identical.  Run-scoped values (``{{ globals.* }}``
+        paths embed the uuid) are masked so they don't defeat caching.
+        ``cache.io_keys`` restricts which declared inputs participate;
+        values already substituted into the command remain part of the
+        run-section hash.
+        """
+        import hashlib
+
+        inputs = compiled.get_io_dict()
+        if cache.io_keys:
+            inputs = {k: v for k, v in inputs.items()
+                      if k in set(cache.io_keys)}
+        run_dict = compiled.run.to_dict() if compiled.run is not None \
+            else None
+        blob = json.dumps({"run": run_dict, "inputs": inputs},
+                          sort_keys=True, default=str)
+        blob = blob.replace(run_uuid, "{run_uuid}")
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def _try_cache(self, run_uuid: str, operation, compiled):
+        """Cache lookup; returns the finished record on a hit, else None.
+
+        A hit copies the prior run's outputs (record fields, the
+        artifacts/outputs tree, AND tracked events — the tuner joins on
+        metrics) and marks this run succeeded with
+        ``meta_info.cache_hit``.
+        """
+        cache = compiled.cache
+        if cache is None or cache.disable:
+            return None
+
+        fingerprint = self._cache_fingerprint(run_uuid, compiled, cache)
+        self.store.update_run(run_uuid,
+                              meta_info={"cache_fingerprint": fingerprint})
+
+        now = time.time()
+        # Newest-first, succeeded-only, bounded scan: the cache is an
+        # optimization — missing a hit older than the window is fine,
+        # reading every record in a huge store every run is not.
+        candidates = self.store.list_runs(
+            project=self.project,
+            query=f"status:{V1Statuses.SUCCEEDED}",
+            sort="-created_at", limit=500)
+        for record in candidates:
+            if record["uuid"] == run_uuid:
+                continue
+            meta = record.get("meta_info") or {}
+            if meta.get("cache_fingerprint") != fingerprint:
+                continue
+            finished = record.get("finished_at") or record.get(
+                "updated_at") or 0
+            if cache.ttl and now - float(finished or 0) > cache.ttl:
+                continue
+            if self._copy_cached(record["uuid"], run_uuid):
+                self.store.update_run(
+                    run_uuid,
+                    outputs=record.get("outputs") or {},
+                    meta_info={"cache_hit": record["uuid"]})
+                self.store.set_status(
+                    run_uuid, V1Statuses.SUCCEEDED, reason="CacheHit",
+                    message=f"reused outputs of {record['uuid']}",
+                    force=True)
+                return self._finalize(run_uuid, compiled)
+        return None
+
+    def _copy_cached(self, src_uuid: str, dst_uuid: str) -> bool:
+        """Copy outputs + tracked events from a prior run; on failure
+        (prior run deleted mid-copy) remove the debris and report a
+        miss."""
+        import shutil
+
+        pairs = [
+            (self.store.outputs_path(src_uuid),
+             self.store.outputs_path(dst_uuid)),
+            # events carry the metrics the tuner/queries join on
+            (os.path.join(self.store.run_path(src_uuid), "events"),
+             os.path.join(self.store.run_path(dst_uuid), "events")),
+        ]
+        try:
+            for src, dst in pairs:
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+            return True
+        except OSError:
+            for _, dst in pairs:  # no phantom artifacts from a dead run
+                shutil.rmtree(dst, ignore_errors=True)
+                os.makedirs(dst, exist_ok=True)
+            return False
 
     def _finalize(self, run_uuid: str, compiled) -> Dict[str, Any]:
         """Terminal bookkeeping: fire hooks, return the final record."""

@@ -4,6 +4,7 @@ SURVEY.md §7 step 4)."""
 import json
 import os
 import sys
+import time
 import textwrap
 
 import pytest
@@ -104,6 +105,75 @@ class TestLocalJob:
         }
         record = executor.run_operation(get_op_from_files(spec))
         assert "tpu-rocks" in executor.store.read_logs(record["uuid"])
+
+
+class TestRunCache:
+    """V1Cache memoization (SURVEY 2.3): identical (component, inputs)
+    runs reuse a prior SUCCEEDED run's outputs instead of re-executing."""
+
+    def _op(self, marker, lr=0.1, cache=None):
+        spec = {
+            "kind": "operation",
+            "name": "cached",
+            "cache": cache if cache is not None else {},
+            "component": {
+                "kind": "component",
+                "inputs": [{"name": "lr", "type": "float",
+                            "value": lr, "isOptional": True}],
+                "run": {
+                    "kind": "job",
+                    "container": {"command": [
+                        sys.executable, "-c",
+                        f"open({str(marker)!r}, 'a').write('x'); "
+                        "from polyaxon_tpu import tracking; "
+                        "r = tracking.init(collect_system_metrics=False,"
+                        "track_env=False, track_code=False); "
+                        "r.log_metric('loss', 0.5, step=1); "
+                        "r.log_outputs(score=0.9); r.end()"]},
+                },
+            },
+        }
+        return get_op_from_files(spec)
+
+    def test_identical_run_hits_cache(self, executor, tmp_path):
+        marker = tmp_path / "exec.count"
+        first = executor.run_operation(self._op(str(marker)))
+        assert first["status"] == V1Statuses.SUCCEEDED
+        assert marker.read_text() == "x"
+        second = executor.run_operation(self._op(str(marker)))
+        assert second["status"] == V1Statuses.SUCCEEDED
+        assert marker.read_text() == "x"  # did NOT re-execute
+        assert second["meta_info"]["cache_hit"] == first["uuid"]
+        assert second["outputs"]["score"] == 0.9
+        # events copy over too: the tuner joins on metrics
+        assert executor.store.last_metrics(second["uuid"]) == {"loss": 0.5}
+        conditions = executor.store.get_statuses(second["uuid"])
+        assert conditions[-1].reason == "CacheHit"
+
+    def test_different_inputs_miss(self, executor, tmp_path):
+        marker = tmp_path / "exec.count"
+        executor.run_operation(self._op(str(marker), lr=0.1))
+        second = executor.run_operation(self._op(str(marker), lr=0.2))
+        assert marker.read_text() == "xx"  # re-executed
+        assert "cache_hit" not in (second.get("meta_info") or {})
+
+    def test_disabled_cache_always_executes(self, executor, tmp_path):
+        marker = tmp_path / "exec.count"
+        executor.run_operation(
+            self._op(str(marker), cache={"disable": True}))
+        executor.run_operation(
+            self._op(str(marker), cache={"disable": True}))
+        assert marker.read_text() == "xx"
+
+    def test_expired_ttl_misses(self, executor, tmp_path):
+        marker = tmp_path / "exec.count"
+        first = executor.run_operation(
+            self._op(str(marker), cache={"ttl": 60}))
+        # age the prior run past the ttl
+        executor.store.update_run(first["uuid"],
+                                  finished_at=time.time() - 120)
+        executor.run_operation(self._op(str(marker), cache={"ttl": 60}))
+        assert marker.read_text() == "xx"
 
 
 class TestLocalDistributed:
