@@ -165,6 +165,41 @@ class TestRunCache:
             self._op(str(marker), cache={"disable": True}))
         assert marker.read_text() == "xx"
 
+    def test_sweep_warm_start_via_cache(self, executor, tmp_path):
+        """Re-running a sweep with cache declared reuses every completed
+        trial (sweep resume for free): matrix values flow into declared
+        inputs, so each trial fingerprints distinctly but stably."""
+        marker = tmp_path / "exec.count"
+        spec = {
+            "kind": "operation",
+            "name": "sweep",
+            "cache": {},
+            "matrix": {"kind": "mapping",
+                       "values": [{"lr": 0.1}, {"lr": 0.2}, {"lr": 0.3}]},
+            "component": {
+                "kind": "component",
+                "inputs": [{"name": "lr", "type": "float"}],
+                "run": {
+                    "kind": "job",
+                    "container": {"command": [
+                        sys.executable, "-c",
+                        f"import sys; open({str(marker)!r}, 'a')"
+                        ".write('x'); print(sys.argv[1])"],
+                        "args": ["{{ lr }}"]},
+                },
+            },
+        }
+        first = executor.run_operation(get_op_from_files(spec))
+        assert first["status"] == V1Statuses.SUCCEEDED
+        assert marker.read_text() == "xxx"
+        second = executor.run_operation(get_op_from_files(spec))
+        assert second["status"] == V1Statuses.SUCCEEDED
+        assert marker.read_text() == "xxx"  # all 3 trials cache-hit
+        children = executor.store.list_runs(pipeline=second["uuid"])
+        assert len(children) == 3
+        assert all((c.get("meta_info") or {}).get("cache_hit")
+                   for c in children)
+
     def test_expired_ttl_misses(self, executor, tmp_path):
         marker = tmp_path / "exec.count"
         first = executor.run_operation(
