@@ -191,6 +191,49 @@ def _get_run_or_fail(run_uuid: str) -> Dict[str, Any]:
         raise click.ClickException(str(e))
 
 
+@ops.command(name="compare")
+@click.argument("run_uuids", nargs=-1, required=True)
+def ops_compare(run_uuids):
+    """Compare runs side by side: status, inputs, last metrics."""
+    from polyaxon_tpu.client.store import StoreError
+
+    store = _store()
+    records, metrics = [], []
+    for u in run_uuids:
+        try:
+            records.append(store.get_run(u))
+        except StoreError as e:
+            raise click.ClickException(str(e))
+        try:
+            metrics.append(store.last_metrics(u))
+        except Exception:  # noqa: BLE001 - missing metrics show as '-'
+            metrics.append({})
+
+    def fmt(value):
+        return f"{value:.6g}" if isinstance(value, float) else str(value)
+
+    input_keys = sorted({k for r in records
+                         for k in (r.get("inputs") or {})})
+    metric_keys = sorted({k for m in metrics for k in m})
+    rows: List[Tuple[str, List[str]]] = [
+        ("status", [r.get("status") or "-" for r in records]),
+        ("duration", [f"{r['duration']:.1f}s" if r.get("duration")
+                      else "-" for r in records]),
+    ]
+    rows += [(f"in:{k}", [fmt((r.get("inputs") or {}).get(k, "-"))
+                          for r in records]) for k in input_keys]
+    rows += [(f"metric:{k}", [fmt(m.get(k, "-")) for m in metrics])
+             for k in metric_keys]
+
+    label_w = max(16, max(len(k) for k, _ in rows) + 1)
+    width = 22
+    header = " ".join(f"{u[:12]:>{width}}" for u in run_uuids)
+    click.echo(f"{'':<{label_w}}{header}")
+    for key, values in rows:
+        cells = " ".join(f"{v:>{width}}" for v in values)
+        click.echo(f"{key:<{label_w}}{cells}")
+
+
 @ops.command(name="logs")
 @click.argument("run_uuid")
 @click.option("--replica", default=None)

@@ -378,6 +378,23 @@ class TestCli:
         assert result.exit_code == 0
         assert "polyaxon-tpu" in result.output
 
+    def test_ops_compare(self, tmp_home):
+        store = FileRunStore(str(tmp_home))
+        uuids = []
+        for lr, loss in ((0.1, 0.5), (0.2, 0.3)):
+            record = store.create_run(name=f"t{lr}")
+            store.update_run(record["uuid"], inputs={"lr": lr})
+            store.append_events(record["uuid"], "metric", "loss",
+                                [{"step": 1, "value": loss}])
+            store.set_status(record["uuid"], "running", force=True)
+            store.set_status(record["uuid"], "succeeded", force=True)
+            uuids.append(record["uuid"])
+        result = self._invoke(tmp_home, ["ops", "compare", *uuids])
+        assert result.exit_code == 0
+        assert "in:lr" in result.output
+        assert "metric:loss" in result.output
+        assert "0.5" in result.output and "0.3" in result.output
+
     def test_run_and_ops_flow(self, tmp_home, tmp_path):
         f = tmp_path / "job.yaml"
         f.write_text(textwrap.dedent(f"""
