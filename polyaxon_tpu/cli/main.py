@@ -65,7 +65,12 @@ def cli():
               help="Force local in-process execution even in API mode.")
 @click.option("--check-only", is_flag=True, default=False,
               help="Validate and print the operation without running.")
-def run(files, params, presets, name, project, watch, eager, check_only):
+@click.option("--queue", default=None,
+              help="Queue override (API mode; else from the spec).")
+@click.option("--priority", default=None, type=int,
+              help="Priority override, higher claims first (API mode).")
+def run(files, params, presets, name, project, watch, eager, check_only,
+        queue, priority):
     """Run a polyaxonfile: compile, execute, track."""
     from polyaxon_tpu.polyaxonfile import check_polyaxonfile
     from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
@@ -89,14 +94,19 @@ def run(files, params, presets, name, project, watch, eager, check_only):
                                kind=getattr(op.component.run, "kind", None)
                                if op.has_component else None,
                                managed_by="agent",
-                               queue=op.effective_queue,
-                               priority=op.effective_priority)
+                               queue=queue or op.effective_queue,
+                               priority=priority if priority is not None
+                               else op.effective_priority)
         client.log_status("queued", reason="CliSubmit", force=True)
         click.echo(f"Run {record['uuid']} queued on {host}")
         return
 
     from polyaxon_tpu.runner import LocalExecutor
 
+    if queue or priority is not None:
+        click.echo("note: --queue/--priority apply to queued (API-mode) "
+                   "submission; this local run executes immediately.",
+                   err=True)
     if name:
         op = op.model_copy(update={"name": name})
     executor = LocalExecutor(project=project, stream_logs=watch)
@@ -150,13 +160,15 @@ def ops_ls(project, query, sort, limit, offset):
     if not runs:
         click.echo("No runs found.")
         return
-    fmt = "{:<14} {:<24} {:<12} {:<11} {:>9}"
-    click.echo(fmt.format("UUID", "NAME", "KIND", "STATUS", "DURATION"))
+    fmt = "{:<14} {:<24} {:<12} {:<11} {:<12} {:>3} {:>9}"
+    click.echo(fmt.format("UUID", "NAME", "KIND", "STATUS", "QUEUE",
+                          "PRI", "DURATION"))
     for r in runs:
         dur = r.get("duration")
         click.echo(fmt.format(
             r["uuid"], (r.get("name") or "")[:24], str(r.get("kind") or "-"),
-            r.get("status") or "-", f"{dur:.1f}s" if dur else "-",
+            r.get("status") or "-", (r.get("queue") or "-")[:12],
+            str(r.get("priority") or 0), f"{dur:.1f}s" if dur else "-",
         ))
 
 
