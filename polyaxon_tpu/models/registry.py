@@ -38,6 +38,13 @@ class ModelSpec:
     make_batch: Callable[[int], Dict[str, np.ndarray]]
     loss_fn: Callable[[Any], Callable]  # model -> loss(params, batch, rng)
     default_batch_size: int = 32
+    # Analytic train-step FLOPs (fwd + bwd) as a function of batch size —
+    # the MFU numerator.  XLA's compiled-module cost_analysis() is NOT a
+    # substitute: it can't see inside pallas custom kernels (flash
+    # attention reports zero flops) and the axon tunnel's cost data is
+    # unreliable, so benchmarks use these standard closed forms
+    # (6*N_matmul*tokens + attention term; 3x-forward for convnets).
+    train_flops: Optional[Callable[[int], float]] = None
 
     def init_params(self, batch_size: int = 2, seed: int = 0,
                     **overrides):
@@ -123,6 +130,71 @@ def _mlm_loss(model, mask_rate: float = 0.15, mask_id: int = 0):
     return loss
 
 
+def _transformer_train_flops(batch: int, *, layers: int, hidden: int,
+                             seq: int, head_params: int,
+                             intermediate: Optional[int] = None,
+                             extra_matmul_params: int = 0,
+                             causal: bool = False) -> float:
+    """Standard analytic train FLOPs (fwd + 2x bwd) for a transformer.
+
+    dense = 6 * N_matmul * tokens  (N_matmul: qkv/o/mlp kernels + head;
+    embedding *lookups* are gathers, not matmuls, and are excluded).
+    attention = 12 * layers * tokens * seq * hidden  (the two S^2 matmuls,
+    fwd 4*S*h per token per layer, x3 for training), halved for causal
+    models — the standard MFU convention of counting only the needed
+    (lower-triangle) work; the kernel may compute more than that when its
+    block size doesn't let it skip fully-masked blocks.
+    """
+    inter = 4 * hidden if intermediate is None else intermediate
+    n_matmul = layers * (4 * hidden * hidden + 2 * hidden * inter) \
+        + head_params + extra_matmul_params
+    tokens = batch * seq
+    dense = 6.0 * n_matmul * tokens
+    attn = 12.0 * layers * tokens * seq * hidden
+    if causal:
+        attn /= 2.0
+    return dense + attn
+
+
+def _gpt2_train_flops(cfg: GPT2Config, seq: int):
+    return lambda b: _transformer_train_flops(
+        b, layers=cfg.num_layers, hidden=cfg.hidden_size, seq=seq,
+        head_params=cfg.hidden_size * cfg.vocab_size, causal=True)
+
+
+def _bert_train_flops(cfg: BertConfig, seq: int):
+    return lambda b: _transformer_train_flops(
+        b, layers=cfg.num_layers, hidden=cfg.hidden_size, seq=seq,
+        head_params=cfg.hidden_size * cfg.vocab_size,
+        intermediate=cfg.intermediate_size)
+
+
+def _moe_train_flops(cfg: MoEGPTConfig, seq: int):
+    # Top-1 switch routing: each token runs ONE expert MLP + the router.
+    return lambda b: _transformer_train_flops(
+        b, layers=cfg.num_layers, hidden=cfg.hidden_size, seq=seq,
+        head_params=cfg.hidden_size * cfg.vocab_size,
+        extra_matmul_params=cfg.num_layers * cfg.hidden_size
+        * cfg.num_experts,
+        causal=True)
+
+
+def _vit_train_flops(cfg: "ViTConfig"):
+    patches = cfg.num_patches + 1  # + [CLS]
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+    return lambda b: _transformer_train_flops(
+        b, layers=cfg.num_layers, hidden=cfg.hidden_size, seq=patches,
+        head_params=cfg.hidden_size * cfg.num_classes,
+        intermediate=cfg.intermediate_size,
+        extra_matmul_params=patch_dim * cfg.hidden_size)
+
+
+# ResNet-50 at 224x224: ~4.1 GMACs fwd (8.2 GFLOPs); training ~= 3x fwd
+# (bwd is two matmul-sized passes).  Matches the XLA compiled-module
+# count (23.9 GFLOPs/img) within 3%.
+_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 8.2e9
+
+
 _REGISTRY: Dict[str, ModelSpec] = {}
 
 
@@ -153,6 +225,7 @@ _register(ModelSpec(
     make_batch=lambda b: _image_batch(b, 224, 1000),
     loss_fn=_classifier_loss,
     default_batch_size=128,
+    train_flops=lambda b: b * _RESNET50_TRAIN_FLOPS_PER_IMG,
 ))
 
 _register(ModelSpec(
@@ -170,6 +243,7 @@ _register(ModelSpec(
     make_batch=lambda b: _token_batch(b, 512, BertConfig.base().vocab_size),
     loss_fn=_mlm_loss,
     default_batch_size=32,
+    train_flops=_bert_train_flops(BertConfig.base(), 512),
 ))
 
 _register(ModelSpec(
@@ -187,6 +261,7 @@ _register(ModelSpec(
                                       GPT2Config.medium().vocab_size),
     loss_fn=_lm_loss,
     default_batch_size=8,
+    train_flops=_gpt2_train_flops(GPT2Config.medium(), 1024),
 ))
 
 _register(ModelSpec(
@@ -196,6 +271,7 @@ _register(ModelSpec(
                                       GPT2Config.small().vocab_size),
     loss_fn=_lm_loss,
     default_batch_size=8,
+    train_flops=_gpt2_train_flops(GPT2Config.small(), 1024),
 ))
 
 _register(ModelSpec(
@@ -212,6 +288,7 @@ _register(ModelSpec(
     make_batch=lambda b: _image_batch(b, 224, 1000),
     loss_fn=_classifier_loss,
     default_batch_size=64,
+    train_flops=_vit_train_flops(ViTConfig.base()),
 ))
 
 _register(ModelSpec(
@@ -229,6 +306,7 @@ _register(ModelSpec(
                                       MoEGPTConfig.small().vocab_size),
     loss_fn=_moe_lm_loss,
     default_batch_size=8,
+    train_flops=_moe_train_flops(MoEGPTConfig.small(), 1024),
 ))
 
 _register(ModelSpec(

@@ -142,3 +142,41 @@ def test_flash_fully_masked_row_is_finite(monkeypatch):
     g = jax.grad(lambda q: flash_attention(
         q, k, v, scale=128 ** -0.5, kv_mask=kv_mask).sum())(q)
     assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_pick_block_divides_seq():
+    """Blocks must DIVIDE the sequence (seq=1280 with a 1024 cap must
+    fall back to 640, not truncate the grid)."""
+    from polyaxon_tpu.ops.flash import _pick_block
+    assert _pick_block(1280, 1024) == 640
+    assert _pick_block(1024, 1024) == 1024
+    assert _pick_block(4096, 1024) == 1024
+    assert _pick_block(128, 1024) == 128
+    assert _pick_block(384, 256) == 128  # 256 does not divide 384
+
+
+def test_flash_nondividing_cap_matches_xla(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_FLASH_INTERPRET", "1")
+    import polyaxon_tpu.ops.flash as fl
+    monkeypatch.setattr(fl, "BLOCK_Q", 1024)
+    monkeypatch.setattr(fl, "BLOCK_KV", 1024)
+    q, k, v = _qkv(s=1280, h=1, d=128)
+    out = fl.flash_attention(q, k, v, causal=True, scale=128 ** -0.5)
+    ref = _xla_attention(q, k, v, None, True, 128 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_registry_analytic_train_flops():
+    """Headline models carry analytic MFU numerators (VERDICT r1 #1:
+    MFU = analytic FLOPs / step time / peak; XLA cost analysis cannot
+    see pallas kernel FLOPs)."""
+    from polyaxon_tpu.models.registry import get_model
+    # gpt2-medium at batch 8, seq 1024: ~18.6 TFLOPs/step (6*N*T-scale).
+    f = get_model("gpt2-medium").train_flops(8)
+    assert 15e12 < f < 25e12
+    # resnet50 at batch 128: ~3.1 TFLOPs/step.
+    f = get_model("resnet50").train_flops(128)
+    assert 2.5e12 < f < 4e12
+    for name in ("bert-base", "vit-base", "moe-gpt-small"):
+        assert get_model(name).train_flops is not None
