@@ -207,9 +207,12 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
         "sec_per_step": round(sec_per_step, 5),
         "per_sec_per_chip": round(per_sec / n_chips, 2),
         "unit": ("tok" if is_lm else "img") + "/sec/chip",
-        # Global (all-chip) FLOPs per step; the raw per-chip XLA count
-        # rides separately so old results.jsonl rows stay comparable.
+        # Global (all-chip) FLOPs per step.  flops_src marks the MFU
+        # numerator regime: rows before 2026-07-30 used the per-chip
+        # XLA count (which can't see pallas-kernel FLOPs) and have no
+        # flops_src field.
         "step_flops": analytic or (flops * n_chips if flops else None),
+        "flops_src": "analytic" if analytic else "xla",
         "step_flops_per_chip_xla": flops,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mfu_xla": round(mfu_xla, 4) if mfu_xla is not None else None,
@@ -288,8 +291,11 @@ def main() -> int:
 
     results = []
     for name in models:
+        # gpt2-medium: batch 4 is both the fastest measured config and
+        # the largest whose no-remat backward the one-chip tunnel's
+        # compile helper accepts (see GPT2Config.remat for bigger).
         batch = args.batch or (
-            {"resnet50": 128, "gpt2-medium": 8, "bert-base": 16}.get(
+            {"resnet50": 128, "gpt2-medium": 4, "bert-base": 16}.get(
                 name, 16) if on_accel else 8)
         try:
             r = bench_model(jax, name, batch, args.steps, args.warmup,

@@ -19,15 +19,12 @@ from dataclasses import dataclass
 from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from ..parallel.constraints import BATCH, constrain
 from .attention import dot_product_attention
-
-
-def _remat_policy(name: Optional[str]):
-    return getattr(jax.checkpoint_policies, name) if name else None
+from .scan_stack import remat_policy as _remat_policy
+from .scan_stack import scan_stack
 
 
 @dataclass(frozen=True)
@@ -109,19 +106,6 @@ class GPT2Block(nn.Module):
         return constrain(x, BATCH, None, None)
 
 
-class _ScanBlock(nn.Module):
-    """nn.scan body: (carry, _) -> (carry, None) around one GPT2Block."""
-
-    cfg: GPT2Config
-
-    @nn.compact
-    def __call__(self, x, _):
-        cls = nn.remat(GPT2Block, prevent_cse=False,
-                       policy=_remat_policy(self.cfg.remat_policy)) \
-            if self.cfg.remat else GPT2Block
-        return cls(self.cfg, name="block")(x), None
-
-
 class GPT2Model(nn.Module):
     """setup()-style so the forward decomposes into ``embed_tokens`` /
     ``run_blocks`` / ``head`` methods — pipeline parallelism runs the
@@ -142,13 +126,7 @@ class GPT2Model(nn.Module):
             # One traced block, rolled over the layer axis; params carry
             # a leading [num_layers] dim (what pipeline_apply stacks
             # over).
-            self.h = nn.scan(
-                _ScanBlock,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="h")
+            self.h = scan_stack(GPT2Block, cfg, name="h")
         else:
             block_cls = nn.remat(
                 GPT2Block, policy=_remat_policy(cfg.remat_policy)) \

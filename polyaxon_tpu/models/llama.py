@@ -1,0 +1,175 @@
+"""Llama-family decoder — the zoo's modern-LLM flagship.
+
+The reference orchestrates user-supplied torch Llama code (SURVEY.md
+§0/§2.5); here the architecture is TPU-native: RMSNorm (f32 stats),
+RoPE (``ops.rotary``), SwiGLU MLP, grouped-query attention, bf16 MXU
+matmuls, flash attention via ``ops.attention``, and an ``nn.scan``'d
+layer stack (one traced block; stacked ``[layers, ...]`` params feed
+pipeline parallelism directly).
+
+Param names line up with ``parallel.strategies.TP_RULES``
+(``q_proj``/``k_proj``/``v_proj``/``o_proj`` column/row,
+``gate_proj``/``up_proj`` column, ``down_proj`` row, ``embed`` vocab-
+sharded) so ``strategy: {tp: N}`` works with no per-model config, and
+activations are pinned with ``parallel.constrain`` to keep mixed
+dp×fsdp×tp meshes off XLA's replicate-then-repartition fallback.
+
+GQA note: K/V heads are repeated up to the query head count right
+before attention, so the repeated K/V *activations* are materialized
+at full head count for the kernel (a head-sharing BlockSpec in the
+flash kernel would avoid that; future work).  What GQA does shrink
+here is the K/V params, their gradients, and optimizer state — at
+``num_kv_heads``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.constraints import BATCH, constrain
+from ..ops.rotary import apply_rotary
+from .attention import dot_product_attention
+from .scan_stack import remat_policy, scan_stack
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    max_position: int = 2048
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+    remat_policy: Optional[str] = None
+    scan_layers: bool = True
+
+    def __post_init__(self):
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be divisible by "
+                f"num_kv_heads ({self.num_kv_heads}) for GQA sharing")
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden_size ({self.hidden_size}) must be divisible "
+                f"by num_heads ({self.num_heads})")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tinyllama() -> "LlamaConfig":
+        return LlamaConfig()  # TinyLlama-1.1B dims
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, hidden_size=64,
+                           intermediate_size=128, num_layers=2,
+                           num_heads=4, num_kv_heads=2, max_position=128)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=cfg.dtype, name=name)
+        q = dense(cfg.num_heads * hd, "q_proj")(x)
+        k = dense(cfg.num_kv_heads * hd, "k_proj")(x)
+        v = dense(cfg.num_kv_heads * hd, "v_proj")(x)
+        q = constrain(q, BATCH, None, "tp")
+        b, s = x.shape[:2]
+        q = q.reshape(b, s, cfg.num_heads, hd)
+        k = k.reshape(b, s, cfg.num_kv_heads, hd)
+        v = v.reshape(b, s, cfg.num_kv_heads, hd)
+        q, k = apply_rotary(q, k, theta=cfg.rope_theta)
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        a = dot_product_attention(q, k, v, causal=True)
+        a = constrain(a.reshape(b, s, cfg.num_heads * hd),
+                      BATCH, None, "tp")
+        return dense(cfg.hidden_size, "o_proj")(a)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        norm = lambda name: nn.RMSNorm(  # noqa: E731
+            epsilon=cfg.rms_norm_eps, dtype=jnp.float32, name=name)
+        x = x + LlamaAttention(cfg, name="attn")(
+            norm("input_norm")(x).astype(cfg.dtype))
+        x = constrain(x, BATCH, None, None)
+        h = norm("post_attn_norm")(x).astype(cfg.dtype)
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False,
+                        dtype=cfg.dtype, name="gate_proj")(h)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False,
+                      dtype=cfg.dtype, name="up_proj")(h)
+        h = constrain(nn.silu(gate) * up, BATCH, None, "tp")
+        x = x + nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                         name="down_proj")(h)
+        return constrain(x, BATCH, None, None)
+
+
+class LlamaModel(nn.Module):
+    """Same setup()-decomposition as GPT2Model (``embed_tokens`` /
+    ``run_blocks`` / ``head``) so pipeline parallelism and the trainer
+    treat every decoder in the zoo uniformly."""
+
+    cfg: LlamaConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                              dtype=cfg.dtype, name="embed")
+        if cfg.scan_layers:
+            self.layers = scan_stack(LlamaBlock, cfg, name="h")
+        else:
+            cls = nn.remat(LlamaBlock,
+                           policy=remat_policy(cfg.remat_policy)) \
+                if cfg.remat else LlamaBlock
+            self.blocks = tuple(cls(cfg, name=f"h_{i}")
+                                for i in range(cfg.num_layers))
+        self.final_norm = nn.RMSNorm(epsilon=cfg.rms_norm_eps,
+                                     dtype=jnp.float32, name="final_norm")
+
+    def embed_tokens(self, input_ids):
+        return constrain(self.embed(input_ids), BATCH, None, None)
+
+    def run_blocks(self, x):
+        if self.cfg.scan_layers:
+            x, _ = self.layers(x, None)
+            return x
+        for block in self.blocks:
+            x = block(x)
+        return x
+
+    def head(self, x):
+        x = self.final_norm(x)
+        logits = self.embed.attend(x.astype(self.cfg.dtype))
+        return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
+
+    def __call__(self, input_ids, *, train: bool = False):
+        if input_ids.shape[-1] > self.cfg.max_position:
+            raise ValueError(
+                f"sequence length {input_ids.shape[-1]} exceeds "
+                f"max_position {self.cfg.max_position}; raise it (RoPE "
+                f"needs no new params) or shorten the batch")
+        return self.head(self.run_blocks(self.embed_tokens(input_ids)))

@@ -20,6 +20,7 @@ import optax
 from .bert import BertConfig, BertModel
 from .convnet import ConvNet
 from .gpt2 import GPT2Config, GPT2Model
+from .llama import LlamaConfig, LlamaModel
 from .mlp import MLP
 from .moe_gpt import MoEGPTConfig, MoEGPTModel
 from .resnet import ResNet, ResNet50
@@ -179,6 +180,22 @@ def _moe_train_flops(cfg: MoEGPTConfig, seq: int):
         causal=True)
 
 
+def _llama_train_flops(cfg: LlamaConfig, seq: int):
+    # SwiGLU = 3 MLP matmuls (gate/up/down); GQA shrinks only the k/v
+    # projections; attention score/PV FLOPs follow the QUERY head count.
+    h, hd = cfg.hidden_size, cfg.head_dim
+    per_layer = (2 * h * h                       # q_proj + o_proj
+                 + 2 * h * cfg.num_kv_heads * hd  # k_proj + v_proj
+                 + 3 * h * cfg.intermediate_size)
+    n_matmul = cfg.num_layers * per_layer + h * cfg.vocab_size
+
+    def flops(b: int) -> float:
+        tokens = b * seq
+        return (6.0 * n_matmul * tokens
+                + 12.0 * cfg.num_layers * tokens * seq * h / 2.0)
+    return flops
+
+
 def _vit_train_flops(cfg: "ViTConfig"):
     patches = cfg.num_patches + 1  # + [CLS]
     patch_dim = cfg.patch_size * cfg.patch_size * 3
@@ -278,6 +295,24 @@ _register(ModelSpec(
     name="gpt2-tiny",
     make_model=lambda **kw: GPT2Model(GPT2Config.tiny(), **kw),
     make_batch=lambda b: _token_batch(b, 64, GPT2Config.tiny().vocab_size),
+    loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="tinyllama-1.1b",
+    make_model=lambda **kw: LlamaModel(LlamaConfig.tinyllama(), **kw),
+    make_batch=lambda b: _token_batch(b, 2048,
+                                      LlamaConfig.tinyllama().vocab_size),
+    loss_fn=_lm_loss,
+    default_batch_size=4,
+    train_flops=_llama_train_flops(LlamaConfig.tinyllama(), 2048),
+))
+
+_register(ModelSpec(
+    name="llama-tiny",
+    make_model=lambda **kw: LlamaModel(LlamaConfig.tiny(), **kw),
+    make_batch=lambda b: _token_batch(b, 64, LlamaConfig.tiny().vocab_size),
     loss_fn=_lm_loss,
     default_batch_size=8,
 ))

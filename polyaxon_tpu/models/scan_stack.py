@@ -1,0 +1,48 @@
+"""Shared scan-over-layers scaffolding for the decoder zoo.
+
+One traced block, rolled over a leading ``[num_layers]`` param axis
+(``nn.scan``): compile time stays flat in depth and the stacked params
+are exactly what pipeline parallelism consumes.  Models whose blocks
+take only the carry (GPT-2, Llama) reuse this; blocks with broadcast
+side inputs (BERT's mask) keep their own scan body.
+
+The config duck-type: ``remat: bool``, ``remat_policy: Optional[str]``
+(a ``jax.checkpoint_policies`` member name; None = save nothing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Type
+
+import flax.linen as nn
+import jax
+
+
+def remat_policy(name: Optional[str]):
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
+class ScanBlock(nn.Module):
+    """scan body: (carry, _) -> (carry, None) around one decoder block."""
+
+    block_cls: Type[nn.Module]
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x, _):
+        cls = nn.remat(self.block_cls, prevent_cse=False,
+                       policy=remat_policy(self.cfg.remat_policy)) \
+            if self.cfg.remat else self.block_cls
+        return cls(self.cfg, name="block")(x), None
+
+
+def scan_stack(block_cls: Type[nn.Module], cfg: Any, *, name: str):
+    """The scanned layer stack as a module (params live under
+    ``<name>/block/...`` with a leading [num_layers] axis)."""
+    return nn.scan(
+        ScanBlock,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        length=cfg.num_layers,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(block_cls, cfg, name=name)

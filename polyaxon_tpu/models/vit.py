@@ -14,12 +14,14 @@ layer stack rolls under ``nn.scan`` (flat compile time; the stacked
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from ..parallel.constraints import BATCH, constrain
 from .attention import dot_product_attention
+from .scan_stack import scan_stack
 
 
 @dataclass(frozen=True)
@@ -34,6 +36,8 @@ class ViTConfig:
     layer_norm_eps: float = 1e-6
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # See GPT2Config.remat_policy (jax.checkpoint_policies member name).
+    remat_policy: Optional[str] = None
 
     @property
     def num_patches(self) -> int:
@@ -86,16 +90,6 @@ class ViTBlock(nn.Module):
         return constrain(x, BATCH, None, None)
 
 
-class _ScanBlock(nn.Module):
-    cfg: ViTConfig
-
-    @nn.compact
-    def __call__(self, x, _):
-        cls = nn.remat(ViTBlock, prevent_cse=False) if self.cfg.remat \
-            else ViTBlock
-        return cls(self.cfg, name="block")(x), None
-
-
 class ViTModel(nn.Module):
     """``__call__(images[B,H,W,C]) -> logits[B,num_classes]``."""
 
@@ -125,13 +119,7 @@ class ViTModel(nn.Module):
         x = x + pos.astype(cfg.dtype)
         x = constrain(x, BATCH, None, None)
 
-        blocks = nn.scan(
-            _ScanBlock,
-            variable_axes={"params": 0},
-            split_rngs={"params": True},
-            length=cfg.num_layers,
-            metadata_params={nn.PARTITION_NAME: "layers"},
-        )(cfg, name="h")
+        blocks = scan_stack(ViTBlock, cfg, name="h")
         x, _ = blocks(x, None)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,

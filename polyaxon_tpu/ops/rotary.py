@@ -1,0 +1,58 @@
+"""Rotary position embeddings (RoPE) — Su et al., RoFormer.
+
+The reference delegates model code entirely to user containers
+(SURVEY.md §0); the TPU build's zoo owns its ops.  RoPE is implemented
+the TPU-friendly way: the half-split convention (rotate_half) over the
+head dim, precomputing cos/sin once per (seq, head_dim) at trace time so
+XLA hoists them out of the layer scan and fuses the elementwise rotation
+into the surrounding matmul epilogues.  No gather/scatter, no dynamic
+shapes — everything is iota-based and static.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _cos_sin(seq: int, dim: int, theta: float):
+    # [S, dim/2] angle table in f32; bf16 angles lose too much precision
+    # for long sequences (position 8191 * smallest freq needs ~13 bits).
+    half = dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(q: jax.Array, k: jax.Array, *,
+                 theta: float = 10000.0,
+                 position_offset: int = 0):
+    """Rotate q/k ([B, S, H, D]) by their positions; returns (q, k).
+
+    ``position_offset`` shifts positions (decode-time KV append).  The
+    rotation preserves dtype (bf16 in, bf16 out) while the trig and the
+    rotation arithmetic run in f32.
+    """
+    seq, d = q.shape[1], q.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head dim; got {d}")
+    if k.shape[1] != seq:
+        # One angle table serves both tensors; rotating a short q
+        # against a long k (decode against a cache) must go through two
+        # calls — the cached k are already rotated at their positions.
+        raise ValueError(
+            f"apply_rotary needs matching q/k seq lengths (got "
+            f"{seq} vs {k.shape[1]}); rotate new k at its own "
+            f"position_offset and reuse the cached rotated keys")
+    cos, sin = _cos_sin(seq + position_offset, d, theta)
+    cos = cos[position_offset:][None, :, None, :]  # [1, S, 1, D/2]
+    sin = sin[position_offset:][None, :, None, :]
+
+    def rot(x):
+        x = x.astype(jnp.float32)
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out
+
+    return rot(q).astype(q.dtype), rot(k).astype(k.dtype)

@@ -1,0 +1,140 @@
+"""Llama family: RoPE, RMSNorm/SwiGLU/GQA block, TP rules, tp-mesh
+training.  (The reference orchestrates user torch Llama code; the zoo
+owns the architecture natively — SURVEY.md §0/§2.5.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from polyaxon_tpu.models import get_model
+from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
+from polyaxon_tpu.ops.rotary import apply_rotary
+
+
+def test_rotary_matches_reference_formula():
+    """Half-split RoPE against the direct complex-rotation reference."""
+    b, s, h, d = 2, 16, 3, 8
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+    qr, kr = apply_rotary(q, k, theta=10000.0)
+
+    half = d // 2
+    freqs = 10000.0 ** (-np.arange(half) / half)
+    ang = np.arange(s)[:, None] * freqs[None, :]  # [S, d/2]
+    qc = np.asarray(q).reshape(b, s, h, 2, half)  # split convention
+    ref_first = qc[..., 0, :] * np.cos(ang)[None, :, None] \
+        - qc[..., 1, :] * np.sin(ang)[None, :, None]
+    ref_second = qc[..., 1, :] * np.cos(ang)[None, :, None] \
+        + qc[..., 0, :] * np.sin(ang)[None, :, None]
+    ref = np.concatenate([ref_first, ref_second], axis=-1)
+    np.testing.assert_allclose(np.asarray(qr), ref, atol=1e-5)
+
+
+def test_rotary_preserves_inner_products_shift():
+    """RoPE's defining property: q.k depends only on relative position."""
+    d = 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 1, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 1, d))
+    q1, k1 = apply_rotary(q, k)
+    q2, k2 = apply_rotary(q, k, position_offset=3)
+    # <q_i, k_j> must equal <q_{i+3}, k_{j+3}>.
+    dots1 = np.einsum("bqhd,bkhd->bqk", np.asarray(q1), np.asarray(k1))
+    dots2 = np.einsum("bqhd,bkhd->bqk", np.asarray(q2), np.asarray(k2))
+    np.testing.assert_allclose(dots1, dots2, atol=1e-4)
+
+
+def test_rotary_rejects_odd_dim():
+    q = jnp.zeros((1, 4, 1, 7))
+    with pytest.raises(ValueError, match="even"):
+        apply_rotary(q, q)
+
+
+def test_llama_forward_and_causality():
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    batch = spec.make_batch(2)
+    tokens = jnp.asarray(batch["inputs"])
+    out = model.apply(variables, tokens)
+    assert out.shape == (2, 64, 512) and out.dtype == jnp.float32
+    tokens2 = tokens.at[0, -1].set((tokens[0, -1] + 1) % 512)
+    out2 = model.apply(variables, tokens2)
+    np.testing.assert_allclose(np.asarray(out[0, :-1]),
+                               np.asarray(out2[0, :-1]), atol=1e-4)
+
+
+def test_llama_gqa_param_shapes():
+    """K/V params stay at num_kv_heads (the memory GQA saves)."""
+    spec = get_model("llama-tiny")
+    _, variables = spec.init_params(batch_size=1)
+    cfg = LlamaConfig.tiny()
+    blk = variables["params"]["h"]["block"]
+    hd = cfg.head_dim
+    # scan-stacked: leading [num_layers] axis.
+    assert blk["attn"]["q_proj"]["kernel"].shape == \
+        (cfg.num_layers, cfg.hidden_size, cfg.num_heads * hd)
+    assert blk["attn"]["k_proj"]["kernel"].shape == \
+        (cfg.num_layers, cfg.hidden_size, cfg.num_kv_heads * hd)
+
+
+def test_llama_tp_rules_cover_params():
+    from polyaxon_tpu.parallel.strategies import infer_param_spec
+    spec = get_model("llama-tiny")
+    _, variables = spec.init_params(batch_size=1)
+    sharded = set()
+
+    def visit(path, leaf):
+        p = infer_param_spec(path, leaf, tp=True)
+        flat = [n for ax in p
+                for n in (ax if isinstance(ax, tuple) else (ax,))]
+        if "tp" in flat:
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            sharded.add(name.rsplit("/", 2)[-2])
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, variables["params"])
+    for expect in ["q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+                   "up_proj", "down_proj", "embed"]:
+        assert expect in sharded, f"{expect} not tensor-sharded: {sharded}"
+
+
+def test_llama_trains_on_tp_mesh():
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+
+    spec = get_model("llama-tiny")
+    mesh = build_mesh(MeshSpec(dp=-1, tp=2))
+    model, params = spec.init_params(batch_size=4)
+    step = make_train_step(spec.loss_fn(model), optax.adamw(1e-3), mesh)
+    state = step.init_state(params)
+    batch = {k: jnp.asarray(v) for k, v in spec.make_batch(8).items()}
+    batch = jax.device_put(batch, step.batch_sharding)
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, batch, jax.random.PRNGKey(0))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_llama_remat_matches_noremat():
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, (2, 64)))
+    m1 = LlamaModel(LlamaConfig.tiny())
+    v = m1.init(jax.random.PRNGKey(0), tokens)
+    m2 = LlamaModel(
+        LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    max_position=128, remat=True,
+                    remat_policy="dots_with_no_batch_dims_saveable"))
+    def loss(m):
+        def f(p):
+            return m.apply(p, tokens).astype(jnp.float32).mean()
+        return f
+    l1, g1 = jax.value_and_grad(loss(m1))(v)
+    l2, g2 = jax.value_and_grad(loss(m2))(v)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
