@@ -212,7 +212,8 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
         # XLA count (which can't see pallas-kernel FLOPs) and have no
         # flops_src field.
         "step_flops": analytic or (flops * n_chips if flops else None),
-        "flops_src": "analytic" if analytic else "xla",
+        "flops_src": ("analytic" if analytic
+                      else ("xla" if flops else None)),
         "step_flops_per_chip_xla": flops,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mfu_xla": round(mfu_xla, 4) if mfu_xla is not None else None,

@@ -49,6 +49,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
+    # Llama-family checkpoints use an UNTIED lm_head (unlike GPT-2's
+    # weight-tied wte.attend); tie only for small-vocab experiments.
+    tie_embeddings: bool = False
     remat: bool = False
     remat_policy: Optional[str] = None
     scan_layers: bool = True
@@ -149,6 +152,9 @@ class LlamaModel(nn.Module):
                                 for i in range(cfg.num_layers))
         self.final_norm = nn.RMSNorm(epsilon=cfg.rms_norm_eps,
                                      dtype=jnp.float32, name="final_norm")
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                    dtype=cfg.dtype, name="lm_head")
 
     def embed_tokens(self, input_ids):
         return constrain(self.embed(input_ids), BATCH, None, None)
@@ -162,8 +168,11 @@ class LlamaModel(nn.Module):
         return x
 
     def head(self, x):
-        x = self.final_norm(x)
-        logits = self.embed.attend(x.astype(self.cfg.dtype))
+        x = self.final_norm(x).astype(self.cfg.dtype)
+        if self.cfg.tie_embeddings:
+            logits = self.embed.attend(x)
+        else:
+            logits = self.lm_head(x)
         return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
 
     def __call__(self, input_ids, *, train: bool = False):

@@ -45,12 +45,18 @@ TP_RULES: List[Tuple[str, Callable[[tuple], P]]] = [
      lambda shape: P(None, "tp")),
     (r"(fc1|wi|up_proj|gate_proj|intermediate)[^/]*/kernel",
      lambda shape: P(None, "tp")),
-    # Embeddings / LM head: shard the vocab dim over BOTH tp and fsdp
-    # (axes of size 1 are no-ops).  Sharding the hidden dim instead makes
-    # every token lookup emit a hidden-sharded [B,S,H] that XLA can only
-    # reconcile with the batch-sharded residual stream by replicating the
-    # whole tensor (involuntary full rematerialization).
-    (r"(embed|embedding|wte|lm_head)[^/]*/(embedding|kernel)",
+    # Untied LM head (a Dense, kernel [hidden, vocab]): vocab is the
+    # OUTPUT axis — must outrank the embedding rule below, whose axis-0
+    # vocab convention would shard the hidden dim here.
+    (r"lm_head[^/]*/kernel",
+     lambda shape: P(None, ("tp", "fsdp"))),
+    # Embeddings (tables [vocab, hidden]): shard the vocab dim over BOTH
+    # tp and fsdp (axes of size 1 are no-ops).  Sharding the hidden dim
+    # instead makes every token lookup emit a hidden-sharded [B,S,H]
+    # that XLA can only reconcile with the batch-sharded residual stream
+    # by replicating the whole tensor (involuntary full
+    # rematerialization).
+    (r"(embed|embedding|wte)[^/]*/embedding",
      lambda shape: P(("tp", "fsdp"), None)),
     # Expert-parallel params [E, in, out]: shard the expert dim over ep —
     # the layout moe_layer's shard_map expects, so no reshard precedes

@@ -96,8 +96,27 @@ def test_llama_tp_rules_cover_params():
 
     jax.tree_util.tree_map_with_path(visit, variables["params"])
     for expect in ["q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
-                   "up_proj", "down_proj", "embed"]:
+                   "up_proj", "down_proj", "embed", "lm_head"]:
         assert expect in sharded, f"{expect} not tensor-sharded: {sharded}"
+
+
+def test_llama_lm_head_untied_by_default():
+    """Llama checkpoints use an untied lm_head (vs GPT-2's tied
+    wte.attend); the kernel shards vocab on its OUTPUT axis."""
+    from polyaxon_tpu.parallel.strategies import infer_param_spec
+    spec = get_model("llama-tiny")
+    _, variables = spec.init_params(batch_size=1)
+    cfg = LlamaConfig.tiny()
+    head = variables["params"]["lm_head"]["kernel"]
+    assert head.shape == (cfg.hidden_size, cfg.vocab_size)
+
+    class _K:  # minimal tree-path key
+        def __init__(self, key):
+            self.key = key
+
+    p = infer_param_spec((_K("lm_head"), _K("kernel")), head, tp=True)
+    assert p[0] is None and "tp" in (p[1] if isinstance(p[1], tuple)
+                                     else (p[1],))
 
 
 def test_llama_trains_on_tp_mesh():
