@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import threading
 from typing import Optional
 
@@ -110,19 +109,12 @@ def _xla_attention(q, k, v, mask, causal, scale):
 
 
 def _flash_supported(q, k, mask, platform) -> bool:
-    if platform != "tpu" or os.environ.get("POLYAXON_TPU_NO_FLASH"):
-        return False
-    if mask is not None and not (
-            mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1
-            and mask.shape[3] == k.shape[1]):
-        # The pallas kernels take key-padding masks ([B,1,1,Sk] — every
-        # real padded-batch fine-tune); denser masks use the XLA path.
-        return False
-    # Tiling: seq multiple of the block; head_dim a multiple of 64 (the
-    # zoo's transformers use 64 — mosaic pads the 128-lane tile, still
-    # far cheaper than materializing the [S, S] scores).
-    return (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
-            and q.shape[-1] % 64 == 0)
+    # One shared predicate for every flash consumer (kill-switch, TPU
+    # or interpret-mode, lane/MXU alignment, key-padding-mask-only —
+    # denser masks use the fused-XLA path).
+    from .flash import flash_eligible
+
+    return flash_eligible(q.shape[1], k.shape[1], q.shape[-1], mask)
 
 
 def dot_product_attention(

@@ -47,6 +47,33 @@ def _interpret() -> bool:
     return bool(os.environ.get("POLYAXON_TPU_FLASH_INTERPRET"))
 
 
+def flash_eligible(sq: int, sk: int, head_dim: int, mask=None, *,
+                   mask_kv_len: int = None) -> bool:
+    """Single routing predicate for every flash consumer (the local
+    attention router, ring's per-rotation blocks, Ulysses' post-all-to-
+    all inner): env kill-switch, TPU backend (or the interpret-mode
+    tests), 128-lane seq alignment, MXU-aligned head dim, and at most a
+    key-padding mask [B, 1, 1, kv_len].  ``mask_kv_len`` overrides the
+    expected mask column count when the kernel consumes kv in slices of
+    a longer mask (ring)."""
+    if os.environ.get("POLYAXON_TPU_NO_FLASH"):
+        return False
+    if not (jax.default_backend() == "tpu"
+            or os.environ.get("POLYAXON_TPU_FLASH_INTERPRET")):
+        return False
+    if sq % 128 or sk % 128 or head_dim % 64:
+        return False
+    return mask is None or (
+        mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1
+        and mask.shape[3] == (mask_kv_len if mask_kv_len is not None
+                              else sk))
+
+
+def narrow_kv_mask(mask, batch: int, sk: int):
+    """[B?, 1, 1, Sk] boolean -> the [batch, sk] form the kernels take."""
+    return jnp.broadcast_to(mask[:, 0, 0, :], (batch, sk))
+
+
 def _pick_block(seq: int, cap: int) -> int:
     """Largest 128-multiple block that divides ``seq`` and is <= cap."""
     best = 128
@@ -325,7 +352,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float):
+def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
+                    dlse=None):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     block_q = _pick_block(sq, BLOCK_Q)
@@ -334,8 +362,14 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float):
     padded = kvm is not None
 
     # delta = rowsum(dO * O): one fused XLA pass, [B, H, Sq, 128].
+    # With an LSE cotangent (the blockwise/ring combination
+    # differentiates through lse), dS gains a +P*dlse term; since
+    # dS = P * (dP - delta), folding it in is just delta -= dlse —
+    # the kernels themselves are unchanged.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (batch, heads, sq, 128))
 
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
@@ -437,6 +471,47 @@ def _flash_bwd(causal, scale, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_lse(q, k, v, kvm, causal, scale):
+    """Like ``_flash`` but also returns the row logsumexp [B, H, Sq] —
+    what blockwise consumers (ring attention) need to combine
+    per-block normalized outputs exactly."""
+    out, lse = _flash_forward(q, k, v, kvm, causal, scale)
+    return out, lse[..., 0]
+
+
+def _flash_lse_fwd(q, k, v, kvm, causal, scale):
+    out, lse = _flash_forward(q, k, v, kvm, causal, scale)
+    return (out, lse[..., 0]), (q, k, v, kvm, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, res, cts):
+    q, k, v, kvm, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_backward(q, k, v, kvm, o, lse, do, causal, scale,
+                                 dlse=dlse)
+    return dq, dk, dv, None
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, *, causal: bool = False,
+                        scale: float = 1.0, kv_mask=None):
+    """Flash attention over BSHD tensors returning ``(out, lse)``.
+
+    ``out``: [B, Sq, H, D] (same as :func:`flash_attention`);
+    ``lse``: [B, H, Sq] f32 row logsumexp of the scaled scores
+    (NEG_INF on fully-masked rows, whose out-rows are zero).  This is
+    the building block for blockwise/ring attention: normalized block
+    outputs combine exactly via o = sum_r o_r * exp(lse_r - lse_total).
+    """
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    kvm = None if kv_mask is None else _pack_kv_mask(kv_mask, k.shape[2])
+    out, lse = _flash_lse(q, k, v, kvm, causal, scale)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale: float = 1.0,

@@ -27,6 +27,17 @@ from .mesh import active_batch_axes
 BIG_NEG = -1e30
 
 
+def _ring_flash_eligible(q, s_blk: int, mask) -> bool:
+    """Static routing: run per-rotation blocks through the pallas flash
+    kernel?  Shared predicate; the kernels see s_blk-length q/kv blocks
+    while the key-padding mask keeps FULL kv columns (sliced per
+    rotation), hence mask_kv_len."""
+    from ..ops.flash import flash_eligible
+
+    return flash_eligible(s_blk, s_blk, q.shape[-1], mask,
+                          mask_kv_len=q.shape[1])
+
+
 def _block_attend(q, k, v, *, scale, q_offset, kv_offset, causal,
                   mask_blk=None):
     """One blockwise attention contribution.
@@ -62,12 +73,20 @@ def _block_attend(q, k, v, *, scale, q_offset, kv_offset, causal,
 
 
 def _ring_attention_shard(q, k, v, mask, *, axis_name: str, causal: bool,
-                          scale: Optional[float], axis_size: int):
+                          scale: Optional[float], axis_size: int,
+                          use_flash: bool = False):
     """Per-shard body: q/k/v are the LOCAL sequence blocks [B, Sblk, H, D].
 
     ``mask``: None, or boolean with kv dim FULL-length (each shard holds
     its q-rows but every key column, so each rotation slices the arriving
     block's columns out of it): broadcastable to [B, H, Sq_blk, S_full].
+
+    ``use_flash``: run each block contribution through the pallas flash
+    kernel (MXU path; decided statically by the driver) and combine the
+    normalized per-block outputs exactly via their logsumexp:
+    o = sum_r o_r * exp(lse_r - lse_total).  Future blocks of a causal
+    ring skip their kernels entirely (lax.switch), which is where ring
+    attention's causal FLOP saving actually materializes.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -75,6 +94,10 @@ def _ring_attention_shard(q, k, v, mask, *, axis_name: str, causal: bool,
     my_idx = jax.lax.axis_index(axis_name)
     s_blk = q.shape[1]
     perm = [(j, (j + 1) % n) for j in range(n)]
+    if use_flash:
+        return _ring_flash_shard(q, k, v, mask, scale=scale, causal=causal,
+                                 n=n, my_idx=my_idx, perm=perm,
+                                 axis_name=axis_name)
 
     def attend(acc, k_cur, v_cur, r):
         o, m, l = acc
@@ -126,6 +149,73 @@ def _ring_attention_shard(q, k, v, mask, *, axis_name: str, causal: bool,
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _ring_flash_shard(q, k, v, mask, *, scale, causal, n, my_idx, perm,
+                      axis_name):
+    """Flash-kernel ring body.  ``mask`` here is None or a key-padding
+    mask [B, S_full] bool (the driver narrows the 4-d form)."""
+    from ..ops.flash import flash_attention_lse
+
+    s_blk = q.shape[1]
+
+    def block(k_cur, v_cur, src, diag: bool, skip: bool = False):
+        if skip:
+            o = jnp.zeros(q.shape, jnp.float32)
+            lse = jnp.full(q.shape[:2] + q.shape[2:3], BIG_NEG,
+                           jnp.float32)
+            return o, lse
+        kvm = None
+        if mask is not None:
+            kvm = jax.lax.dynamic_slice_in_dim(mask, src * s_blk, s_blk,
+                                               axis=1)
+        o, lse = flash_attention_lse(q, k_cur, v_cur, causal=diag,
+                                     scale=scale, kv_mask=kvm)
+        # flash lse is [B, H, Sq] -> ring's [B, Sq, H] accumulator
+        # convention.
+        return o.astype(jnp.float32), jnp.transpose(lse, (0, 2, 1))
+
+    def attend(acc, k_cur, v_cur, r):
+        o, lse_acc = acc
+        src = (my_idx - r) % n
+        if causal:
+            # past -> full attend; diagonal -> causal kernel; future ->
+            # no kernel at all (the causal FLOP saving).
+            idx = jnp.where(src == my_idx, 1,
+                            jnp.where(src < my_idx, 0, 2)).astype(jnp.int32)
+            o_r, lse_r = jax.lax.switch(
+                idx,
+                [lambda kc, vc, s: block(kc, vc, s, diag=False),
+                 lambda kc, vc, s: block(kc, vc, s, diag=True),
+                 lambda kc, vc, s: block(kc, vc, s, diag=False,
+                                         skip=True)],
+                k_cur, v_cur, src)
+        else:
+            o_r, lse_r = block(k_cur, v_cur, src, diag=False)
+        new_lse = jnp.logaddexp(lse_acc, lse_r)
+        w_old = jnp.where(lse_acc > BIG_NEG / 2,
+                          jnp.exp(lse_acc - new_lse), 0.0)
+        w_new = jnp.where(lse_r > BIG_NEG / 2,
+                          jnp.exp(lse_r - new_lse), 0.0)
+        o = o * w_old[..., None] + o_r * w_new[..., None]
+        return o, jnp.where(new_lse > BIG_NEG / 2, new_lse, BIG_NEG)
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full(q.shape[:2] + q.shape[2:3], BIG_NEG, jnp.float32)
+
+    def step(carry, r):
+        o, lse, k_cur, v_cur = carry
+        o, lse = attend((o, lse), k_cur, v_cur, r)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, lse, k_nxt, v_nxt), None
+
+    k_cur, v_cur = k, v
+    if n > 1:
+        (o, lse, k_cur, v_cur), _ = jax.lax.scan(
+            step, (o, lse, k, v), jnp.arange(n - 1))
+    o, lse = attend((o, lse), k_cur, v_cur, n - 1)
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -150,9 +240,11 @@ def ring_attention(
 
     batch = active_batch_axes(mesh, batch_axes)
     spec = P(batch, axis_name, None, None)
+    sp = mesh.shape.get(axis_name, 1)
+    use_flash = _ring_flash_eligible(q, q.shape[1] // max(sp, 1), mask)
     body = functools.partial(_ring_attention_shard, axis_name=axis_name,
                              causal=causal, scale=scale,
-                             axis_size=mesh.shape.get(axis_name, 1))
+                             axis_size=sp, use_flash=use_flash)
     if mask is None:
         return shard_map(
             lambda q, k, v: body(q, k, v, None), mesh=mesh,
@@ -162,6 +254,18 @@ def ring_attention(
         )(q, k, v)
     if mask.ndim != 4:
         raise ValueError(f"mask must be 4-d [B,H,Sq,Sk]; got {mask.shape}")
+    if use_flash:
+        from ..ops.flash import narrow_kv_mask
+
+        # Key-padding mask: the flash body consumes the narrow [B, S]
+        # bool form (kv dim full on every shard; sliced per rotation).
+        kvm = narrow_kv_mask(mask, q.shape[0], k.shape[1])
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, spec, P(batch, None)),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v, kvm)
     mask_spec = P(batch if mask.shape[0] > 1 else None,
                   None,
                   axis_name if mask.shape[2] > 1 else None,

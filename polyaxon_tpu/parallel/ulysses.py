@@ -58,6 +58,26 @@ def _ulysses_shard(q, k, v, mask, *, axis_name: str, attn_fn):
     return head2seq(o_full)
 
 
+def _default_inner(q, k, v, mask=None, *, causal: bool,
+                   scale: Optional[float]):
+    """Per-shard attention after the all-to-all: each rank holds the
+    FULL sequence for a head subset — exactly the flash kernel's shape,
+    so route through it when eligible (TPU or the interpret-mode tests,
+    lane-aligned seq, MXU-aligned head dim, at most a key-padding
+    mask); otherwise the fused-XLA fallback."""
+    from ..ops.flash import flash_attention, flash_eligible, \
+        narrow_kv_mask
+
+    if flash_eligible(q.shape[1], k.shape[1], q.shape[-1], mask):
+        kvm = None if mask is None else \
+            narrow_kv_mask(mask, q.shape[0], k.shape[1])
+        return flash_attention(
+            q, k, v, causal=causal,
+            scale=q.shape[-1] ** -0.5 if scale is None else scale,
+            kv_mask=kvm)
+    return _plain_attention(q, k, v, mask, causal=causal, scale=scale)
+
+
 def _plain_attention(q, k, v, mask=None, *, causal: bool,
                      scale: Optional[float]):
     if scale is None:
@@ -117,7 +137,7 @@ def ulysses_attention(
         if mask.shape[1] > 1 and mask.shape[1] % sp:
             raise ValueError(
                 f"mask head dim ({mask.shape[1]}) must divide sp ({sp})")
-    inner = attn_fn or functools.partial(_plain_attention, causal=causal,
+    inner = attn_fn or functools.partial(_default_inner, causal=causal,
                                          scale=scale)
     batch = active_batch_axes(mesh, batch_axes)
     spec = P(batch, axis_name, None, None)
