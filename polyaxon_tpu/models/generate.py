@@ -1,0 +1,96 @@
+"""Autoregressive generation with a KV cache.
+
+The reference orchestrates serving as opaque user containers
+(`V1Service`); the TPU build's zoo owns decoding natively.  The loop is
+a single jitted ``lax.scan`` over positions — one compiled program for
+the whole generation, no per-token dispatch — with the per-layer KV
+cache living in the model's flax "cache" collection (stacked [layers,
+...] by ``scan_stack``, so it shards the same way the params do).
+
+Prefill also steps through the scan (one token at a time) with teacher
+forcing: positions below the prompt length keep the prompt token,
+positions above take the sampled one.  For the zoo's decode-capable
+models (Llama) on a single program this is compile-once and
+bandwidth-bound — the right shape for TPU decode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, variables, batch_size: int):
+    """Allocate the stacked per-layer KV cache for ``model``, all
+    zeros with cache_index 0.  (Abstract init only: running a real
+    init decode step would advance the index and write a garbage
+    token-0 entry.)"""
+    tokens = jnp.zeros((batch_size, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens, decode=True))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        # lax.top_k, not a full vocab sort — this runs once per decoded
+        # token.
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(model, variables, prompt, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    ``prompt``: [B, P] int32 (a shared prompt length; pad upstream for
+    ragged prompts and mask via teacher forcing).  Returns [B, P +
+    max_new_tokens].  ``temperature=0`` is greedy; ``eos_id`` freezes
+    finished rows (they keep emitting eos).
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    total = p_len + max_new_tokens
+    max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
+    if max_pos is not None and total > max_pos:
+        # Overflow would silently clamp the cache write index (garbage
+        # output, no error) — refuse up front.
+        raise ValueError(
+            f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_position ({max_pos})")
+    cache = init_cache(model, variables, b)
+
+    def step(carry, t):
+        cache, tok, rng, done = carry
+        logits, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            tok[:, None], decode=True, decode_position=t,
+            mutable=["cache"])
+        rng, key = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], key, temperature, top_k)
+        # Teacher-force the prompt: positions still inside it emit the
+        # prompt token regardless of the model's prediction.
+        in_prompt = t + 1 < p_len
+        forced = jnp.where(in_prompt,
+                           prompt[:, jnp.minimum(t + 1, p_len - 1)], nxt)
+        if eos_id is not None:
+            forced = jnp.where(done, eos_id, forced)
+            done = done | (~in_prompt & (forced == eos_id))
+        return (mut["cache"], forced.astype(jnp.int32), rng, done), forced
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng, done0), jnp.arange(total - 1))
+    out = jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+    return out

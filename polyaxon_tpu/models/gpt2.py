@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..parallel.constraints import BATCH, constrain
@@ -73,7 +74,7 @@ class GPT2Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
 
@@ -86,7 +87,32 @@ class GPT2Block(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = h.shape[:-1] + (cfg.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        a = dot_product_attention(q, k, v, causal=True)
+        mask = None
+        if decode:
+            # Single-token KV-cache step (see LlamaAttention for the
+            # pattern; GPT-2 has no RoPE — positions enter via wpe at
+            # the embedding).
+            b, s = x.shape[:2]
+            if s != 1:
+                raise ValueError(
+                    f"decode steps take one token at a time; got seq={s}")
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, cfg.max_position, cfg.num_heads,
+                                head_dim), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, cfg.max_position, cfg.num_heads,
+                                head_dim), cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.array(0, jnp.int32))
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, idx.value, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, idx.value, 0, 0))
+            idx.value = idx.value + s
+            k, v = ck.value, cv.value
+            mask = (jnp.arange(cfg.max_position)
+                    < idx.value)[None, None, None, :]
+        a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")
         # Row-parallel o_proj: XLA inserts the partial-sum allreduce and
@@ -136,21 +162,23 @@ class GPT2Model(nn.Module):
         self.ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                  dtype=jnp.float32, name="ln_f")
 
-    def embed_tokens(self, input_ids):
+    def embed_tokens(self, input_ids, position=None):
         # Pin the gather output before any arithmetic: the vocab-sharded
         # table otherwise leaves the lookup in a table-derived layout
         # that conflicts with the batch-sharded residual stream.
         x = constrain(self.wte(input_ids), BATCH, None, None)
         pos = jnp.arange(input_ids.shape[-1])
+        if position is not None:  # decode: absolute position of token 0
+            pos = pos + position
         x = x + self.wpe(pos)
         return constrain(x, BATCH, None, None)
 
-    def run_blocks(self, x):
+    def run_blocks(self, x, decode: bool = False):
         if self.cfg.scan_layers:
-            x, _ = self.h(x, None)
+            x, _ = self.h(x, decode or None)
             return x
         for block in self.h_blocks:
-            x = block(x)
+            x = block(x, decode=decode)
         return x
 
     def head(self, x):
@@ -159,5 +187,8 @@ class GPT2Model(nn.Module):
         # LM head shards the vocab dim with the tied embedding.
         return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
 
-    def __call__(self, input_ids, *, train: bool = False):
-        return self.head(self.run_blocks(self.embed_tokens(input_ids)))
+    def __call__(self, input_ids, *, train: bool = False,
+                 decode: bool = False, decode_position=None):
+        x = self.embed_tokens(
+            input_ids, position=decode_position if decode else None)
+        return self.head(self.run_blocks(x, decode=decode))

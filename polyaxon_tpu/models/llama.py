@@ -85,7 +85,7 @@ class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         hd = cfg.head_dim
         dense = lambda feats, name: nn.Dense(  # noqa: E731
@@ -98,12 +98,44 @@ class LlamaAttention(nn.Module):
         q = q.reshape(b, s, cfg.num_heads, hd)
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
         v = v.reshape(b, s, cfg.num_kv_heads, hd)
-        q, k = apply_rotary(q, k, theta=cfg.rope_theta)
+
+        mask = None
+        if decode:
+            # Single-token KV-cache step (the flax cache-variable
+            # pattern): rotate at the cache position, append, attend
+            # over the filled prefix.  Decode is GEMV-shaped — the
+            # fused-XLA attention path is the right kernel for it.
+            if s != 1:
+                raise ValueError(
+                    f"decode steps take one token at a time; got seq={s}"
+                    " (prefill by stepping the prompt)")
+            max_len = cfg.max_position
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, max_len, cfg.num_kv_heads, hd),
+                               cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, max_len, cfg.num_kv_heads, hd),
+                               cfg.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.array(0, jnp.int32))
+            pos = idx.value + jnp.arange(s)
+            q, k = apply_rotary(q, k, theta=cfg.rope_theta,
+                                positions=pos)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, idx.value, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, idx.value, 0, 0))
+            idx.value = idx.value + s
+            k, v = ck.value, cv.value
+            # [B, 1, 1, max_len]: attend only to the filled prefix.
+            mask = (jnp.arange(max_len) < idx.value)[None, None, None, :]
+        else:
+            q, k = apply_rotary(q, k, theta=cfg.rope_theta)
         if cfg.num_kv_heads != cfg.num_heads:
             rep = cfg.num_heads // cfg.num_kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        a = dot_product_attention(q, k, v, causal=True)
+        a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = constrain(a.reshape(b, s, cfg.num_heads * hd),
                       BATCH, None, "tp")
         return dense(cfg.hidden_size, "o_proj")(a)
@@ -113,12 +145,12 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         norm = lambda name: nn.RMSNorm(  # noqa: E731
             epsilon=cfg.rms_norm_eps, dtype=jnp.float32, name=name)
         x = x + LlamaAttention(cfg, name="attn")(
-            norm("input_norm")(x).astype(cfg.dtype))
+            norm("input_norm")(x).astype(cfg.dtype), decode=decode)
         x = constrain(x, BATCH, None, None)
         h = norm("post_attn_norm")(x).astype(cfg.dtype)
         gate = nn.Dense(cfg.intermediate_size, use_bias=False,
@@ -159,12 +191,12 @@ class LlamaModel(nn.Module):
     def embed_tokens(self, input_ids):
         return constrain(self.embed(input_ids), BATCH, None, None)
 
-    def run_blocks(self, x):
+    def run_blocks(self, x, decode: bool = False):
         if self.cfg.scan_layers:
-            x, _ = self.layers(x, None)
+            x, _ = self.layers(x, decode or None)
             return x
         for block in self.blocks:
-            x = block(x)
+            x = block(x, decode=decode)
         return x
 
     def head(self, x):
@@ -175,10 +207,15 @@ class LlamaModel(nn.Module):
             logits = self.lm_head(x)
         return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
 
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False,
+                 decode: bool = False, decode_position=None):
+        # decode_position is accepted for generate()'s uniform calling
+        # convention; RoPE positions come from the per-layer cache
+        # index, so it is unused here.
         if input_ids.shape[-1] > self.cfg.max_position:
             raise ValueError(
                 f"sequence length {input_ids.shape[-1]} exceeds "
                 f"max_position {self.cfg.max_position}; raise it (RoPE "
                 f"needs no new params) or shorten the batch")
-        return self.head(self.run_blocks(self.embed_tokens(input_ids)))
+        return self.head(
+            self.run_blocks(self.embed_tokens(input_ids), decode=decode))

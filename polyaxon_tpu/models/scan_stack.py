@@ -23,13 +23,21 @@ def remat_policy(name: Optional[str]):
 
 
 class ScanBlock(nn.Module):
-    """scan body: (carry, _) -> (carry, None) around one decoder block."""
+    """scan body: (carry, decode?) -> (carry, None) around one decoder
+    block.  ``decode`` rides as an nn.broadcast input (a static Python
+    bool/None shared by every layer) so ONE scanned stack — one param
+    tree — serves both training and KV-cache decoding."""
 
     block_cls: Type[nn.Module]
     cfg: Any
 
     @nn.compact
-    def __call__(self, x, _):
+    def __call__(self, x, decode=None):
+        if decode:
+            # No gradients in decode; remat would only re-run the
+            # cache mutation.
+            return self.block_cls(self.cfg, name="block")(
+                x, decode=True), None
         cls = nn.remat(self.block_cls, prevent_cse=False,
                        policy=remat_policy(self.cfg.remat_policy)) \
             if self.cfg.remat else self.block_cls
@@ -38,10 +46,14 @@ class ScanBlock(nn.Module):
 
 def scan_stack(block_cls: Type[nn.Module], cfg: Any, *, name: str):
     """The scanned layer stack as a module (params live under
-    ``<name>/block/...`` with a leading [num_layers] axis)."""
+    ``<name>/block/...`` with a leading [num_layers] axis; the decode
+    path's KV cache stacks the same way).  Call as ``stack(x, decode)``
+    where decode is None/False (train) or True (single-token KV-cache
+    steps, for blocks that support it)."""
     return nn.scan(
         ScanBlock,
-        variable_axes={"params": 0},
+        variable_axes={"params": 0, "cache": 0},
+        in_axes=nn.broadcast,
         split_rngs={"params": True},
         length=cfg.num_layers,
         metadata_params={nn.PARTITION_NAME: "layers"},

@@ -108,13 +108,6 @@ def _xla_attention(q, k, v, mask, causal, scale):
     return out.astype(orig_dtype)
 
 
-def _flash_supported(q, k, mask, platform) -> bool:
-    # One shared predicate for every flash consumer (kill-switch, TPU
-    # or interpret-mode, lane/MXU alignment, key-padding-mask-only —
-    # denser masks use the fused-XLA path).
-    from .flash import flash_eligible
-
-    return flash_eligible(q.shape[1], k.shape[1], q.shape[-1], mask)
 
 
 def dot_product_attention(
@@ -141,10 +134,12 @@ def dot_product_attention(
 
         return ring_attention(q, k, v, mesh, mask=mask, causal=causal,
                               scale=scale)
-    platform = jax.default_backend()
-    if _flash_supported(q, k, mask, platform):
-        from .flash import flash_attention
+    from .flash import flash_attention, flash_eligible
 
+    # One shared predicate for every flash consumer (kill-switch, TPU
+    # or interpret-mode, lane/MXU alignment, key-padding-mask-only —
+    # denser masks use the fused-XLA path).
+    if flash_eligible(q.shape[1], k.shape[1], q.shape[-1], mask):
         kv_mask = None if mask is None else mask[:, 0, 0, :]
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                kv_mask=kv_mask)

@@ -15,23 +15,27 @@ import jax
 import jax.numpy as jnp
 
 
-def _cos_sin(seq: int, dim: int, theta: float):
+def _cos_sin(dim: int, theta: float, positions: jax.Array):
     # [S, dim/2] angle table in f32; bf16 angles lose too much precision
     # for long sequences (position 8191 * smallest freq needs ~13 bits).
+    # ``positions`` may be traced (the decode path's cache index) — ONE
+    # formula serves train and decode, so they cannot drift.
     half = dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     return jnp.cos(angles), jnp.sin(angles)
 
 
 def apply_rotary(q: jax.Array, k: jax.Array, *,
                  theta: float = 10000.0,
-                 position_offset: int = 0):
+                 position_offset: int = 0,
+                 positions: jax.Array = None):
     """Rotate q/k ([B, S, H, D]) by their positions; returns (q, k).
 
-    ``position_offset`` shifts positions (decode-time KV append).  The
-    rotation preserves dtype (bf16 in, bf16 out) while the trig and the
-    rotation arithmetic run in f32.
+    ``position_offset`` (static int) shifts positions; ``positions``
+    ([S] int array, may be traced — the decode path's cache index)
+    overrides it.  The rotation preserves dtype (bf16 in, bf16 out)
+    while the trig and the rotation arithmetic run in f32.
     """
     seq, d = q.shape[1], q.shape[-1]
     if d % 2:
@@ -44,9 +48,11 @@ def apply_rotary(q: jax.Array, k: jax.Array, *,
             f"apply_rotary needs matching q/k seq lengths (got "
             f"{seq} vs {k.shape[1]}); rotate new k at its own "
             f"position_offset and reuse the cached rotated keys")
-    cos, sin = _cos_sin(seq + position_offset, d, theta)
-    cos = cos[position_offset:][None, :, None, :]  # [1, S, 1, D/2]
-    sin = sin[position_offset:][None, :, None, :]
+    if positions is None:
+        positions = position_offset + jnp.arange(seq)
+    cos, sin = _cos_sin(d, theta, positions)
+    cos = cos[None, :, None, :]  # [1, S, 1, D/2]
+    sin = sin[None, :, None, :]
 
     def rot(x):
         x = x.astype(jnp.float32)

@@ -157,3 +157,65 @@ def test_llama_remat_matches_noremat():
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_decode_matches_full_forward():
+    """KV-cache single-token decode must reproduce the full-forward
+    logits position by position."""
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    batch = spec.make_batch(2)
+    tokens = jnp.asarray(batch["inputs"][:, :16])
+    full = model.apply(variables, tokens)
+
+    from polyaxon_tpu.models.generate import init_cache
+    cache = init_cache(model, variables, 2)
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            tokens[:, i:i + 1], decode=True, mutable=["cache"])
+        cache = mut["cache"]
+        outs.append(logits[:, 0])
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_generate_greedy_continues_full_forward():
+    """Greedy generate's first new token == argmax of the full forward
+    at the last prompt position; output shape/prompt echo are right."""
+    from polyaxon_tpu.models.generate import generate
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    prompt = jnp.asarray(spec.make_batch(2)["inputs"][:, :12])
+    out = jax.jit(lambda v, p: generate(
+        model, v, p, max_new_tokens=6))(variables, prompt)
+    assert out.shape == (2, 18)
+    np.testing.assert_array_equal(np.asarray(out[:, :12]),
+                                  np.asarray(prompt))
+    full = model.apply(variables, prompt)
+    expect_first = np.asarray(full[:, -1].argmax(-1))
+    np.testing.assert_array_equal(np.asarray(out[:, 12]), expect_first)
+
+
+def test_generate_eos_freezes_rows():
+    from polyaxon_tpu.models.generate import generate
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    prompt = jnp.asarray(spec.make_batch(2)["inputs"][:, :4])
+    full = model.apply(variables, prompt)
+    eos = int(np.asarray(full[0, -1].argmax(-1)))  # row 0 emits eos first
+    out = generate(model, variables, prompt, max_new_tokens=8, eos_id=eos)
+    row = np.asarray(out[0, 4:])
+    first = np.argmax(row == eos)
+    assert row[first] == eos and (row[first:] == eos).all()
+
+
+def test_generate_rejects_cache_overflow():
+    from polyaxon_tpu.models.generate import generate
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=1)
+    prompt = jnp.asarray(spec.make_batch(1)["inputs"][:, :8])
+    with pytest.raises(ValueError, match="max_position"):
+        generate(model, variables, prompt, max_new_tokens=128)
