@@ -22,14 +22,15 @@ import jax
 import jax.numpy as jnp
 
 
-def init_cache(model, variables, batch_size: int):
+def init_cache(model, batch_size: int):
     """Allocate the stacked per-layer KV cache for ``model``, all
     zeros with cache_index 0.  (Abstract init only: running a real
     init decode step would advance the index and write a garbage
     token-0 entry.)"""
     tokens = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), tokens, decode=True))
+        lambda: model.init(jax.random.PRNGKey(0), tokens, decode=True,
+                           decode_position=0))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         shapes["cache"])
 
@@ -69,7 +70,7 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
         raise ValueError(
             f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_position ({max_pos})")
-    cache = init_cache(model, variables, b)
+    cache = init_cache(model, b)
 
     def step(carry, t):
         cache, tok, rng, done = carry

@@ -189,6 +189,13 @@ class GPT2Model(nn.Module):
 
     def __call__(self, input_ids, *, train: bool = False,
                  decode: bool = False, decode_position=None):
+        if decode and decode_position is None:
+            # Unlike Llama (whose RoPE reads the per-layer cache index),
+            # GPT-2's learned wpe needs the absolute position — omitting
+            # it would silently give every token position 0.
+            raise ValueError(
+                "GPT-2 decode needs decode_position (the absolute "
+                "position of this token; generate() supplies it)")
         x = self.embed_tokens(
             input_ids, position=decode_position if decode else None)
         return self.head(self.run_blocks(x, decode=decode))

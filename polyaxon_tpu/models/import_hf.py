@@ -1,0 +1,108 @@
+"""HuggingFace checkpoint import for the zoo's decoders.
+
+Users of the reference platform bring torch models; this converts HF
+``state_dict``s (GPT-2, Llama families) into the zoo's flax param
+trees, including the scan-stacked ``[num_layers, ...]`` layout.  Parity
+is proven in tests by comparing logits against ``transformers``' own
+forward pass on identical tokens (see tests/test_import_hf.py).
+
+Conventions handled:
+
+- GPT-2 stores Conv1D weights as ``[in, out]`` (flax Dense layout —
+  taken as-is); Llama stores torch Linear ``[out, in]`` (transposed).
+- Per-layer tensors are stacked along a new leading axis to match
+  ``scan_stack``'s parameter layout.
+- GPT-2 ties ``lm_head`` to ``wte`` (our model does too); Llama's
+  untied ``lm_head.weight`` maps to the separate Dense kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _stack(sd: Dict[str, Any], fmt: str, n: int, *,
+           transpose: bool = False) -> jnp.ndarray:
+    ws = [_np(sd[fmt.format(i=i)]) for i in range(n)]
+    if transpose:
+        ws = [w.T for w in ws]
+    return jnp.asarray(np.stack(ws, axis=0))
+
+
+def load_hf_gpt2(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``GPT2LMHeadModel.state_dict()`` -> ``{"params": ...}`` for
+    :class:`~polyaxon_tpu.models.gpt2.GPT2Model` (scan_layers=True)."""
+    sd = {k.removeprefix("transformer."): v
+          for k, v in state_dict.items()}
+    n = cfg.num_layers
+
+    def ln(prefix):
+        return {"scale": _stack(sd, prefix + ".weight", n),
+                "bias": _stack(sd, prefix + ".bias", n)}
+
+    def conv1d(prefix):  # HF Conv1D is already [in, out]
+        return {"kernel": _stack(sd, prefix + ".weight", n),
+                "bias": _stack(sd, prefix + ".bias", n)}
+
+    block = {
+        "ln1": ln("h.{i}.ln_1"),
+        "qkv": conv1d("h.{i}.attn.c_attn"),
+        "o_proj": conv1d("h.{i}.attn.c_proj"),
+        "ln2": ln("h.{i}.ln_2"),
+        "fc1": conv1d("h.{i}.mlp.c_fc"),
+        "fc2": conv1d("h.{i}.mlp.c_proj"),
+    }
+    params = {
+        "wte": {"embedding": jnp.asarray(_np(sd["wte.weight"]))},
+        "wpe": {"embedding": jnp.asarray(_np(sd["wpe.weight"]))},
+        "h": {"block": block},
+        "ln_f": {"scale": jnp.asarray(_np(sd["ln_f.weight"])),
+                 "bias": jnp.asarray(_np(sd["ln_f.bias"]))},
+    }
+    return {"params": params}
+
+
+def load_hf_llama(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``LlamaForCausalLM.state_dict()`` -> ``{"params": ...}`` for
+    :class:`~polyaxon_tpu.models.llama.LlamaModel` (scan_layers=True,
+    tie_embeddings=False)."""
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = cfg.num_layers
+
+    def lin(prefix):  # torch Linear [out, in] -> kernel [in, out]
+        return {"kernel": _stack(sd, prefix + ".weight", n,
+                                 transpose=True)}
+
+    block = {
+        "input_norm": {
+            "scale": _stack(sd, "layers.{i}.input_layernorm.weight", n)},
+        "attn": {
+            "q_proj": lin("layers.{i}.self_attn.q_proj"),
+            "k_proj": lin("layers.{i}.self_attn.k_proj"),
+            "v_proj": lin("layers.{i}.self_attn.v_proj"),
+            "o_proj": lin("layers.{i}.self_attn.o_proj"),
+        },
+        "post_attn_norm": {
+            "scale": _stack(
+                sd, "layers.{i}.post_attention_layernorm.weight", n)},
+        "gate_proj": lin("layers.{i}.mlp.gate_proj"),
+        "up_proj": lin("layers.{i}.mlp.up_proj"),
+        "down_proj": lin("layers.{i}.mlp.down_proj"),
+    }
+    params = {
+        "embed": {"embedding": jnp.asarray(_np(sd["embed_tokens.weight"]))},
+        "h": {"block": block},
+        "final_norm": {"scale": jnp.asarray(_np(sd["norm.weight"]))},
+        "lm_head": {"kernel": jnp.asarray(
+            _np(state_dict["lm_head.weight"]).T)},
+    }
+    return {"params": params}

@@ -108,8 +108,6 @@ def _xla_attention(q, k, v, mask, causal, scale):
     return out.astype(orig_dtype)
 
 
-
-
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

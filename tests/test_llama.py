@@ -169,7 +169,7 @@ def test_decode_matches_full_forward():
     full = model.apply(variables, tokens)
 
     from polyaxon_tpu.models.generate import init_cache
-    cache = init_cache(model, variables, 2)
+    cache = init_cache(model, 2)
     outs = []
     for i in range(tokens.shape[1]):
         logits, mut = model.apply(
