@@ -35,6 +35,21 @@ def init_cache(model, batch_size: int):
                         shapes["cache"])
 
 
+def extract_logits(out) -> jax.Array:
+    """The zoo's output contract: a model's __call__ returns either
+    ``logits`` or ``(logits, aux)`` (MoE load-balance loss).  This is
+    the same contract the registry loss fns rely on; anything else is
+    an error here rather than a silent mis-slice."""
+    if isinstance(out, jax.Array):
+        return out
+    if isinstance(out, tuple) and len(out) == 2 and \
+            isinstance(out[0], jax.Array):
+        return out[0]
+    raise TypeError(
+        f"model output must be logits or (logits, aux); got "
+        f"{type(out).__name__}")
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -74,10 +89,11 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
 
     def step(carry, t):
         cache, tok, rng, done = carry
-        logits, mut = model.apply(
+        out, mut = model.apply(
             {"params": variables["params"], "cache": cache},
             tok[:, None], decode=True, decode_position=t,
             mutable=["cache"])
+        logits = extract_logits(out)
         rng, key = jax.random.split(rng)
         nxt = _sample(logits[:, -1], key, temperature, top_k)
         # Teacher-force the prompt: positions still inside it emit the

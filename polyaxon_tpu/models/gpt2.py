@@ -19,11 +19,11 @@ from dataclasses import dataclass
 from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from ..parallel.constraints import BATCH, constrain
 from .attention import dot_product_attention
+from .kv_cache import append_kv_cache
 from .scan_stack import remat_policy as _remat_policy
 from .scan_stack import scan_stack
 
@@ -89,29 +89,9 @@ class GPT2Block(nn.Module):
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         mask = None
         if decode:
-            # Single-token KV-cache step (see LlamaAttention for the
-            # pattern; GPT-2 has no RoPE — positions enter via wpe at
-            # the embedding).
-            b, s = x.shape[:2]
-            if s != 1:
-                raise ValueError(
-                    f"decode steps take one token at a time; got seq={s}")
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, cfg.max_position, cfg.num_heads,
-                                head_dim), cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, cfg.max_position, cfg.num_heads,
-                                head_dim), cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.array(0, jnp.int32))
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, idx.value, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, idx.value, 0, 0))
-            idx.value = idx.value + s
-            k, v = ck.value, cv.value
-            mask = (jnp.arange(cfg.max_position)
-                    < idx.value)[None, None, None, :]
+            # Single-token KV-cache step (GPT-2 has no RoPE — positions
+            # enter via wpe at the embedding).
+            k, v, mask = append_kv_cache(self, k, v, cfg.max_position)
         a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")

@@ -1,0 +1,39 @@
+"""Decode-time KV cache shared by the zoo's non-RoPE decoders.
+
+One helper owns the flax cache-variable dance (GPT-2 and MoE-GPT
+attention are identical here; Llama keeps its own copy because RoPE
+must rotate k at the cache position BEFORE the append).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def append_kv_cache(mod, k, v, max_position: int):
+    """Append this step's k/v ([B, 1, H, D]) to ``mod``'s decode cache.
+
+    Creates ``cached_key``/``cached_value``/``cache_index`` variables in
+    the "cache" collection on ``mod`` and returns ``(k_full, v_full,
+    mask)`` where the mask ([1, 1, 1, max_position]) admits only the
+    filled prefix (including this token).
+    """
+    b, s, h, d = k.shape
+    if s != 1:
+        raise ValueError(
+            f"decode steps take one token at a time; got seq={s} "
+            "(prefill by stepping the prompt)")
+    ck = mod.variable("cache", "cached_key", jnp.zeros,
+                      (b, max_position, h, d), k.dtype)
+    cv = mod.variable("cache", "cached_value", jnp.zeros,
+                      (b, max_position, h, d), v.dtype)
+    idx = mod.variable("cache", "cache_index",
+                       lambda: jnp.array(0, jnp.int32))
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                            (0, idx.value, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                            (0, idx.value, 0, 0))
+    idx.value = idx.value + s
+    mask = (jnp.arange(max_position) < idx.value)[None, None, None, :]
+    return ck.value, cv.value, mask

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..parallel.constraints import BATCH, constrain, current_mesh
 from ..parallel.moe import moe_layer, top1_dispatch
 from .attention import dot_product_attention
+from .kv_cache import append_kv_cache
 
 
 @dataclass(frozen=True)
@@ -56,6 +57,26 @@ class MoEGPTConfig:
                             num_heads=4, num_experts=4, max_position=128)
 
 
+def _switch_ffn_decode(flat, router_w, w1, w2, activation):
+    """Per-token top-1 FFN for decode: gather ONLY the routed expert's
+    weights per token instead of running every expert (the dense
+    dispatch path costs num_experts x the FLOPs and, under an
+    ep-sharded mesh, an all-gather of every expert's weights per
+    generated token).  Identical math to drop-free dispatch: out =
+    p_e * w2_e(act(w1_e x))."""
+    logits = flat.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)                      # [T]
+    gate = jnp.take_along_axis(probs, idx[:, None], 1)    # [T, 1]
+    w1_t = jnp.take(w1.astype(jnp.float32), idx, axis=0)  # [T, d, f]
+    w2_t = jnp.take(w2.astype(jnp.float32), idx, axis=0)  # [T, f, d]
+    h = activation(jnp.einsum("td,tdf->tf", flat.astype(jnp.float32),
+                              w1_t))
+    out = jnp.einsum("tf,tfd->td", h, w2_t) * gate
+    # Aux (load-balance) loss is a training signal; decode returns 0.
+    return out, jnp.zeros((), jnp.float32)
+
+
 def _switch_ffn_dense(flat, router_w, w1, w2, capacity: int, activation):
     """The ep=1 semantics of ``moe_layer`` without collectives (used for
     init and meshless runs; also the single-device reference in tests)."""
@@ -76,7 +97,7 @@ class MoEMlp(nn.Module):
     cfg: MoEGPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         d, e, f = cfg.hidden_size, cfg.num_experts, cfg.intermediate_size
         init = nn.initializers.normal(0.02)
@@ -85,16 +106,21 @@ class MoEMlp(nn.Module):
         w2 = self.param("experts_w2", init, (e, f, d), jnp.float32)
 
         mesh = current_mesh()
-        if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        if not decode and mesh is not None and \
+                mesh.shape.get("ep", 1) > 1:
             out, aux = moe_layer(
                 x, router_w, w1, w2, mesh,
                 capacity_factor=cfg.capacity_factor,
                 activation=nn.gelu)
             return out.astype(cfg.dtype), aux
         b, s, _ = x.shape
-        capacity = max(1, int(cfg.capacity_factor * b * s / e))
-        out, aux = _switch_ffn_dense(x.reshape(b * s, d), router_w, w1,
-                                     w2, capacity, nn.gelu)
+        if decode:
+            out, aux = _switch_ffn_decode(x.reshape(b * s, d), router_w,
+                                          w1, w2, nn.gelu)
+        else:
+            capacity = max(1, int(cfg.capacity_factor * b * s / e))
+            out, aux = _switch_ffn_dense(x.reshape(b * s, d), router_w,
+                                         w1, w2, capacity, nn.gelu)
         return out.reshape(x.shape).astype(cfg.dtype), aux
 
 
@@ -104,7 +130,7 @@ class MoEBlock(nn.Module):
     cfg: MoEGPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
 
@@ -116,7 +142,12 @@ class MoEBlock(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = h.shape[:-1] + (cfg.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        a = dot_product_attention(q, k, v, causal=True)
+        mask = None
+        if decode:
+            # KV-cache step; the switch FFN below routes the single
+            # token exactly as in training (top-1, dense path).
+            k, v, mask = append_kv_cache(self, k, v, cfg.max_position)
+        a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")
         x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
@@ -125,20 +156,24 @@ class MoEBlock(nn.Module):
 
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln2")(x).astype(cfg.dtype)
-        ffn, aux = MoEMlp(cfg, name="moe")(h)
+        ffn, aux = MoEMlp(cfg, name="moe")(h, decode=decode)
         x = x + ffn
         return constrain(x, BATCH, None, None), aux
 
 
 class _ScanMoEBlock(nn.Module):
     """nn.scan body: carries (x, aux_sum) so the load-balance loss flows
-    out of the rolled layer stack without mutable collections."""
+    out of the rolled layer stack without mutable collections.
+    ``decode`` rides as an nn.broadcast input (see scan_stack)."""
 
     cfg: MoEGPTConfig
 
     @nn.compact
-    def __call__(self, carry, _):
+    def __call__(self, carry, decode=None):
         x, aux_sum = carry
+        if decode:
+            x, aux = MoEBlock(self.cfg, name="block")(x, decode=True)
+            return (x, aux_sum + aux), None
         cls = nn.remat(MoEBlock, prevent_cse=False) if self.cfg.remat \
             else MoEBlock
         x, aux = cls(self.cfg, name="block")(x)
@@ -159,7 +194,8 @@ class MoEGPTModel(nn.Module):
                             dtype=cfg.dtype, name="wpe")
         self.h = nn.scan(
             _ScanMoEBlock,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
+            in_axes=nn.broadcast,
             split_rngs={"params": True},
             length=cfg.num_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -167,12 +203,20 @@ class MoEGPTModel(nn.Module):
         self.ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                  dtype=jnp.float32, name="ln_f")
 
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False,
+                 decode: bool = False, decode_position=None):
+        if decode and decode_position is None:
+            raise ValueError(
+                "MoE-GPT decode needs decode_position (learned wpe; "
+                "generate() supplies it)")
         x = constrain(self.wte(input_ids), BATCH, None, None)
         pos = jnp.arange(input_ids.shape[-1])
+        if decode:
+            pos = pos + decode_position
         x = x + self.wpe(pos)
         x = constrain(x, BATCH, None, None)
-        (x, aux), _ = self.h((x, jnp.zeros((), jnp.float32)), None)
+        (x, aux), _ = self.h((x, jnp.zeros((), jnp.float32)),
+                             decode or None)
         x = self.ln_f(x)
         logits = self.wte.attend(x.astype(self.cfg.dtype))
         logits = constrain(logits.astype(jnp.float32), BATCH, None, "tp")
