@@ -30,7 +30,27 @@ from typing import Any, Dict, Iterator, Optional
 import numpy as np
 
 
-class ArrayDataset:
+def _epoch_rng(seed: int, epoch: int) -> np.random.RandomState:
+    """One seed-mixing formula for every dataset (deterministic per
+    (seed, epoch), distinct across epochs)."""
+    return np.random.RandomState((seed * 100003 + epoch) % (2 ** 31))
+
+
+class _EpochIterable:
+    """Shared epoch chaining: subclasses define ``epoch(e)``."""
+
+    def __iter__(self):
+        return self.epoch(0)
+
+    def epochs(self, n: Optional[int] = None
+               ) -> Iterator[Dict[str, np.ndarray]]:
+        e = 0
+        while n is None or e < n:
+            yield from self.epoch(e)
+            e += 1
+
+
+class ArrayDataset(_EpochIterable):
     """Dict-of-arrays -> iterator of shuffled, fixed-size batches.
 
     Iterating yields one epoch.  ``epochs(n)`` chains n epochs (n=None
@@ -67,24 +87,13 @@ class ArrayDataset:
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         order = np.arange(self.n)
         if self.shuffle:
-            np.random.RandomState((self.seed * 100003 + epoch)
-                                  % (2 ** 31)).shuffle(order)
+            _epoch_rng(self.seed, epoch).shuffle(order)
         stop = self.n - (self.n % self.batch_size) \
             if self.drop_remainder else self.n
         for lo in range(0, stop, self.batch_size):
             idx = order[lo:lo + self.batch_size]
             idx.sort()  # monotone gather: fast on memmapped arrays
             yield {k: np.asarray(v[idx]) for k, v in self.arrays.items()}
-
-    def __iter__(self):
-        return self.epoch(0)
-
-    def epochs(self, n: Optional[int] = None
-               ) -> Iterator[Dict[str, np.ndarray]]:
-        e = 0
-        while n is None or e < n:
-            yield from self.epoch(e)
-            e += 1
 
 
 def npy_dataset(data_dir: str, batch_size: int, *, shuffle: bool = True,
@@ -143,6 +152,75 @@ def digits_dataset(batch_size: int, *, split: str = "train",
     return ArrayDataset({"inputs": images[idx], "labels": labels[idx]},
                         min(batch_size, len(idx)),
                         shuffle=train, drop_remainder=train, seed=seed)
+
+
+class TokenWindowDataset(_EpochIterable):
+    """Contiguous token stream -> random fixed-length training windows.
+
+    The standard LM data layout (one long token array on disk, sampled
+    at random offsets): ``tokens`` is a 1-D integer array (memmap
+    welcome — sampling reads only the touched windows).  Each epoch
+    yields ``len(tokens) // (batch * seq_len)`` batches of
+    ``{"inputs": [batch, seq_len]}``, offsets drawn deterministically
+    from (seed, epoch); the registry's LM losses shift inputs
+    internally, so no separate labels array exists.
+    """
+
+    def __init__(self, tokens: np.ndarray, batch_size: int,
+                 seq_len: int, *, seed: int = 0):
+        if tokens.ndim != 1:
+            raise ValueError(f"tokens must be 1-D; got {tokens.shape}")
+        if len(tokens) < seq_len + 1:
+            raise ValueError(
+                f"{len(tokens)} tokens can't fill a window of {seq_len}")
+        self.tokens = tokens
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.seed = seed
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(1, len(self.tokens) //
+                   (self.batch_size * self.seq_len))
+
+    def sample(self, n: int = 2) -> Dict[str, np.ndarray]:
+        # Clamp offsets: a stream longer than one window but shorter
+        # than n non-overlapping windows still yields full-length rows.
+        hi = len(self.tokens) - self.seq_len
+        win = np.stack([self.tokens[o:o + self.seq_len]
+                        for o in (min(i * self.seq_len, hi)
+                                  for i in range(n))])
+        return {"inputs": win.astype(np.int32)}
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        rs = _epoch_rng(self.seed, epoch)
+        hi = len(self.tokens) - self.seq_len
+        for _ in range(self.steps_per_epoch):
+            offs = np.sort(rs.randint(0, hi + 1, size=self.batch_size))
+            batch = np.stack([self.tokens[o:o + self.seq_len]
+                              for o in offs])
+            yield {"inputs": batch.astype(np.int32)}
+
+
+def token_dataset(path: str, batch_size: int, seq_len: int, *,
+                  seed: int = 0) -> TokenWindowDataset:
+    """Load a token stream: ``tokens.npy`` (any int dtype) or a raw
+    ``tokens.bin`` of uint16 (the common GPT-2-vocab packing).  ``path``
+    may be the file or a directory containing it."""
+    if os.path.isdir(path):
+        for name in ("tokens.npy", "tokens.bin"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"no tokens.npy/tokens.bin under {path}")
+    if path.endswith(".npy"):
+        tokens = np.load(path, mmap_mode="r")
+    else:
+        tokens = np.memmap(path, dtype=np.uint16, mode="r")
+    return TokenWindowDataset(tokens, batch_size, seq_len, seed=seed)
 
 
 def prefetch_to_device(batches: Iterator[Dict[str, np.ndarray]],

@@ -65,10 +65,15 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="Directory of inputs.npy/labels.npy (else "
                         "synthetic).")
     p.add_argument("--dataset", default=None,
-                   choices=["synthetic", "digits", "npy"],
+                   choices=["synthetic", "digits", "npy", "tokens"],
                    help="Input source (default: npy when --data-dir is "
                         "given, else synthetic).  'digits' is the real "
-                        "offline 10-class image set (BASELINE config 1).")
+                        "offline 10-class image set (BASELINE config 1); "
+                        "'tokens' samples LM windows from tokens.npy/"
+                        "tokens.bin under --data-dir.")
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="Window length for --dataset tokens (default: "
+                        "the model's synthetic batch seq length).")
     p.add_argument("--eval-every", type=int, default=0,
                    help="Steps between held-out evals (0 = end only; "
                         "needs a dataset with an eval split).")
@@ -133,6 +138,13 @@ def make_datasets(args, spec, batch_size: int):
             raise SystemExit("--dataset npy requires --data-dir")
         return data.npy_dataset(args.data_dir, batch_size,
                                 seed=args.seed), None
+    if kind == "tokens":
+        if not args.data_dir:
+            raise SystemExit("--dataset tokens requires --data-dir")
+        seq_len = args.seq_len or \
+            spec.make_batch(1)["inputs"].shape[-1]
+        return data.token_dataset(args.data_dir, batch_size, seq_len,
+                                  seed=args.seed), None
     if kind == "digits":
         train = data.digits_dataset(batch_size, split="train",
                                     seed=args.seed)
