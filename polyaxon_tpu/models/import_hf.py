@@ -1,24 +1,27 @@
-"""HuggingFace checkpoint import for the zoo's decoders.
+"""HuggingFace checkpoint interop (both directions) for the zoo.
 
 Users of the reference platform bring torch models; this converts HF
 ``state_dict``s (GPT-2, Llama families) into the zoo's flax param
-trees, including the scan-stacked ``[num_layers, ...]`` layout.  Parity
-is proven in tests by comparing logits against ``transformers``' own
-forward pass on identical tokens (see tests/test_import_hf.py).
+trees — including the scan-stacked ``[num_layers, ...]`` layout — and
+back.  Parity is proven in tests by comparing logits against
+``transformers``' own forward pass on identical tokens, in BOTH
+directions (see tests/test_import_hf.py).
 
-Conventions handled:
+Each architecture has ONE per-layer mapping table driving import and
+export, so the two directions cannot drift.  Layout conventions:
 
 - GPT-2 stores Conv1D weights as ``[in, out]`` (flax Dense layout —
   taken as-is); Llama stores torch Linear ``[out, in]`` (transposed).
 - Per-layer tensors are stacked along a new leading axis to match
   ``scan_stack``'s parameter layout.
 - GPT-2 ties ``lm_head`` to ``wte`` (our model does too); Llama's
-  untied ``lm_head.weight`` maps to the separate Dense kernel.
+  ``lm_head.weight`` maps to the separate Dense kernel unless the
+  model was built with ``tie_embeddings=True``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -30,12 +33,74 @@ def _np(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def _stack(sd: Dict[str, Any], fmt: str, n: int, *,
-           transpose: bool = False) -> jnp.ndarray:
-    ws = [_np(sd[fmt.format(i=i)]) for i in range(n)]
-    if transpose:
-        ws = [w.T for w in ws]
-    return jnp.asarray(np.stack(ws, axis=0))
+# Per-layer tables: (hf_prefix_under_layer, ours_path, kind).
+# kind: "ln" (weight/bias -> scale/bias), "conv1d" (HF [in,out] taken
+# as-is, with bias), "linear" (torch [out,in] -> kernel transposed, no
+# bias).  ours_path is the nested path under the stacked block dict.
+_GPT2_LAYERS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("ln_1", ("ln1",), "ln"),
+    ("attn.c_attn", ("qkv",), "conv1d"),
+    ("attn.c_proj", ("o_proj",), "conv1d"),
+    ("ln_2", ("ln2",), "ln"),
+    ("mlp.c_fc", ("fc1",), "conv1d"),
+    ("mlp.c_proj", ("fc2",), "conv1d"),
+)
+
+_LLAMA_LAYERS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("input_layernorm", ("input_norm",), "rms"),
+    ("self_attn.q_proj", ("attn", "q_proj"), "linear"),
+    ("self_attn.k_proj", ("attn", "k_proj"), "linear"),
+    ("self_attn.v_proj", ("attn", "v_proj"), "linear"),
+    ("self_attn.o_proj", ("attn", "o_proj"), "linear"),
+    ("post_attention_layernorm", ("post_attn_norm",), "rms"),
+    ("mlp.gate_proj", ("gate_proj",), "linear"),
+    ("mlp.up_proj", ("up_proj",), "linear"),
+    ("mlp.down_proj", ("down_proj",), "linear"),
+)
+
+# kind -> list of (hf_suffix, ours_leaf, transpose_on_load)
+_KIND_LEAVES = {
+    "ln": [("weight", "scale", False), ("bias", "bias", False)],
+    "rms": [("weight", "scale", False)],
+    "conv1d": [("weight", "kernel", False), ("bias", "bias", False)],
+    "linear": [("weight", "kernel", True)],
+}
+
+
+def _set_path(tree: Dict[str, Any], path: Tuple[str, ...], leaf) -> None:
+    for key in path[:-1]:
+        tree = tree.setdefault(key, {})
+    tree[path[-1]] = leaf
+
+
+def _get_path(tree: Dict[str, Any], path: Tuple[str, ...]):
+    for key in path:
+        tree = tree[key]
+    return tree
+
+
+def _load_blocks(sd, table, layer_fmt: str, n: int) -> Dict[str, Any]:
+    block: Dict[str, Any] = {}
+    for hf_prefix, ours, kind in table:
+        for hf_suffix, leaf, transpose in _KIND_LEAVES[kind]:
+            ws = [_np(sd[f"{layer_fmt.format(i=i)}.{hf_prefix}"
+                        f".{hf_suffix}"]) for i in range(n)]
+            if transpose:
+                ws = [w.T for w in ws]
+            _set_path(block, ours + (leaf,),
+                      jnp.asarray(np.stack(ws, axis=0)))
+    return block
+
+
+def _export_blocks(block, table, layer_fmt: str, n: int,
+                   out: Dict[str, Any]) -> None:
+    for hf_prefix, ours, kind in table:
+        for hf_suffix, leaf, transpose in _KIND_LEAVES[kind]:
+            stacked = np.asarray(_get_path(block, ours + (leaf,)))
+            for i in range(n):
+                w = stacked[i]
+                out[f"{layer_fmt.format(i=i)}.{hf_prefix}"
+                    f".{hf_suffix}"] = w.T if transpose else w
 
 
 def load_hf_gpt2(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
@@ -43,32 +108,33 @@ def load_hf_gpt2(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
     :class:`~polyaxon_tpu.models.gpt2.GPT2Model` (scan_layers=True)."""
     sd = {k.removeprefix("transformer."): v
           for k, v in state_dict.items()}
-    n = cfg.num_layers
-
-    def ln(prefix):
-        return {"scale": _stack(sd, prefix + ".weight", n),
-                "bias": _stack(sd, prefix + ".bias", n)}
-
-    def conv1d(prefix):  # HF Conv1D is already [in, out]
-        return {"kernel": _stack(sd, prefix + ".weight", n),
-                "bias": _stack(sd, prefix + ".bias", n)}
-
-    block = {
-        "ln1": ln("h.{i}.ln_1"),
-        "qkv": conv1d("h.{i}.attn.c_attn"),
-        "o_proj": conv1d("h.{i}.attn.c_proj"),
-        "ln2": ln("h.{i}.ln_2"),
-        "fc1": conv1d("h.{i}.mlp.c_fc"),
-        "fc2": conv1d("h.{i}.mlp.c_proj"),
-    }
     params = {
         "wte": {"embedding": jnp.asarray(_np(sd["wte.weight"]))},
         "wpe": {"embedding": jnp.asarray(_np(sd["wpe.weight"]))},
-        "h": {"block": block},
+        "h": {"block": _load_blocks(sd, _GPT2_LAYERS, "h.{i}",
+                                    cfg.num_layers)},
         "ln_f": {"scale": jnp.asarray(_np(sd["ln_f.weight"])),
                  "bias": jnp.asarray(_np(sd["ln_f.bias"]))},
     }
     return {"params": params}
+
+
+def export_hf_gpt2(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Our GPT-2 params -> an HF ``GPT2LMHeadModel`` state_dict of
+    numpy arrays (load with ``model.load_state_dict({k:
+    torch.tensor(v) for k, v in sd.items()}, strict=False)`` — HF's
+    non-param attention-mask buffers are not emitted)."""
+    p = variables["params"]
+    sd: Dict[str, Any] = {
+        "transformer.wte.weight": np.asarray(p["wte"]["embedding"]),
+        "transformer.wpe.weight": np.asarray(p["wpe"]["embedding"]),
+        "transformer.ln_f.weight": np.asarray(p["ln_f"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(p["ln_f"]["bias"]),
+        "lm_head.weight": np.asarray(p["wte"]["embedding"]),  # tied
+    }
+    _export_blocks(p["h"]["block"], _GPT2_LAYERS, "transformer.h.{i}",
+                   cfg.num_layers, sd)
+    return sd
 
 
 def load_hf_llama(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
@@ -76,33 +142,31 @@ def load_hf_llama(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
     :class:`~polyaxon_tpu.models.llama.LlamaModel` (scan_layers=True,
     tie_embeddings=False)."""
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
-    n = cfg.num_layers
-
-    def lin(prefix):  # torch Linear [out, in] -> kernel [in, out]
-        return {"kernel": _stack(sd, prefix + ".weight", n,
-                                 transpose=True)}
-
-    block = {
-        "input_norm": {
-            "scale": _stack(sd, "layers.{i}.input_layernorm.weight", n)},
-        "attn": {
-            "q_proj": lin("layers.{i}.self_attn.q_proj"),
-            "k_proj": lin("layers.{i}.self_attn.k_proj"),
-            "v_proj": lin("layers.{i}.self_attn.v_proj"),
-            "o_proj": lin("layers.{i}.self_attn.o_proj"),
-        },
-        "post_attn_norm": {
-            "scale": _stack(
-                sd, "layers.{i}.post_attention_layernorm.weight", n)},
-        "gate_proj": lin("layers.{i}.mlp.gate_proj"),
-        "up_proj": lin("layers.{i}.mlp.up_proj"),
-        "down_proj": lin("layers.{i}.mlp.down_proj"),
-    }
     params = {
         "embed": {"embedding": jnp.asarray(_np(sd["embed_tokens.weight"]))},
-        "h": {"block": block},
+        "h": {"block": _load_blocks(sd, _LLAMA_LAYERS, "layers.{i}",
+                                    cfg.num_layers)},
         "final_norm": {"scale": jnp.asarray(_np(sd["norm.weight"]))},
         "lm_head": {"kernel": jnp.asarray(
             _np(state_dict["lm_head.weight"]).T)},
     }
     return {"params": params}
+
+
+def export_hf_llama(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Our Llama params -> an HF ``LlamaForCausalLM`` state_dict of
+    numpy arrays.  ``tie_embeddings=True`` models emit the embedding as
+    ``lm_head.weight`` (pair with ``tie_word_embeddings=True`` on the
+    HF config)."""
+    p = variables["params"]
+    embed = np.asarray(p["embed"]["embedding"])
+    head = embed if cfg.tie_embeddings else \
+        np.asarray(p["lm_head"]["kernel"]).T
+    sd: Dict[str, Any] = {
+        "model.embed_tokens.weight": embed,
+        "model.norm.weight": np.asarray(p["final_norm"]["scale"]),
+        "lm_head.weight": head,
+    }
+    _export_blocks(p["h"]["block"], _LLAMA_LAYERS, "model.layers.{i}",
+                   cfg.num_layers, sd)
+    return sd

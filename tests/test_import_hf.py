@@ -58,3 +58,81 @@ def test_llama_matches_transformers():
     variables = load_hf_llama(hf.state_dict(), cfg)
     ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_export_roundtrip_into_transformers():
+    """Our randomly-initialized GPT-2 exported to HF format must make
+    transformers produce OUR logits (the reverse parity direction)."""
+    from polyaxon_tpu.models.import_hf import export_hf_gpt2
+
+    cfg = GPT2Config(vocab_size=1024, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position=128, dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    tokens = np.random.RandomState(2).randint(0, 1024, (2, 16))
+    import jax
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+    ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=1024, n_embd=64, n_layer=2, n_head=4,
+        n_positions=128, layer_norm_epsilon=1e-5,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    sd = {k: torch.tensor(v)
+          for k, v in export_hf_gpt2(variables, cfg).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    # HF keeps non-param buffers (attn.bias masks); no params may miss.
+    assert all(".attn.bias" in m or ".attn.masked_bias" in m
+               for m in missing), missing
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_export_roundtrip_into_transformers():
+    from polyaxon_tpu.models.import_hf import export_hf_llama
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, max_position=128,
+                      rms_norm_eps=1e-5, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    tokens = np.random.RandomState(3).randint(0, 512, (2, 16))
+    import jax
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+    ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_dropout=0.0, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    sd = {k: torch.tensor(v)
+          for k, v in export_hf_llama(variables, cfg).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected and not missing, (missing, unexpected)
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_export_tied_embeddings():
+    """tie_embeddings=True models export the embedding as lm_head
+    (no KeyError on the missing separate head)."""
+    from polyaxon_tpu.models.import_hf import export_hf_llama
+    import jax
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_layers=1, num_heads=2,
+                      num_kv_heads=1, max_position=32,
+                      tie_embeddings=True, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(
+        __import__("jax").random.PRNGKey(0),
+        jnp.zeros((1, 4), jnp.int32))
+    sd = export_hf_llama(variables, cfg)
+    np.testing.assert_array_equal(sd["lm_head.weight"],
+                                  sd["model.embed_tokens.weight"])
