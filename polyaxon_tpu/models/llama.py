@@ -48,6 +48,12 @@ class LlamaConfig:
     max_position: int = 2048
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
+    # Sliding-window (local) attention: position i attends to
+    # [i-window, i] — window+1 visible keys.  NOTE: HF transformers'
+    # Mistral masking keeps W keys ((i-W, i]); when importing an HF
+    # checkpoint with sliding_window=W, set this to W-1 for logit
+    # parity.  None = full causal attention.
+    sliding_window: Optional[int] = None
     dtype: jnp.dtype = jnp.bfloat16
     # Llama-family checkpoints use an UNTIED lm_head (unlike GPT-2's
     # weight-tied wte.attend); tie only for small-vocab experiments.
@@ -57,6 +63,11 @@ class LlamaConfig:
     scan_layers: bool = True
 
     def __post_init__(self):
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1 or None; got "
+                f"{self.sliding_window} (0 would silently disable "
+                "windowing)")
         if self.num_heads % self.num_kv_heads:
             raise ValueError(
                 f"num_heads ({self.num_heads}) must be divisible by "
@@ -127,15 +138,23 @@ class LlamaAttention(nn.Module):
                 cv.value, v, (0, idx.value, 0, 0))
             idx.value = idx.value + s
             k, v = ck.value, cv.value
-            # [B, 1, 1, max_len]: attend only to the filled prefix.
-            mask = (jnp.arange(max_len) < idx.value)[None, None, None, :]
+            # [B, 1, 1, max_len]: attend only to the filled prefix —
+            # clipped to the sliding window when one is configured
+            # (current position is idx-1 post-update).
+            keys = jnp.arange(max_len)
+            valid = keys < idx.value
+            if cfg.sliding_window is not None:
+                valid &= keys >= idx.value - 1 - cfg.sliding_window
+            mask = valid[None, None, None, :]
         else:
             q, k = apply_rotary(q, k, theta=cfg.rope_theta)
         if cfg.num_kv_heads != cfg.num_heads:
             rep = cfg.num_heads // cfg.num_kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
+        a = dot_product_attention(q, k, v, causal=not decode, mask=mask,
+                                  window=None if decode
+                                  else cfg.sliding_window)
         a = constrain(a.reshape(b, s, cfg.num_heads * hd),
                       BATCH, None, "tp")
         return dense(cfg.hidden_size, "o_proj")(a)

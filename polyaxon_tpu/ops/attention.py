@@ -92,13 +92,17 @@ def _sp_route(q, k, v, mask, causal, scale):
     return mesh, mode
 
 
-def _xla_attention(q, k, v, mask, causal, scale):
+def _xla_attention(q, k, v, mask, causal, scale, window=None):
     orig_dtype = q.dtype
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window:
+            # Sliding window: position i attends to [i-window, i].
+            cmask &= jnp.triu(jnp.ones((sq, sk), bool),
+                              k=sk - sq - window)
         scores = jnp.where(cmask[None, None], scores, BIG_NEG)
     if mask is not None:
         # mask: broadcastable to [B, H, Sq, Sk]; True = attend.
@@ -116,11 +120,31 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
-    """Attention over [B, S, H, D] tensors; returns [B, Sq, H, D]."""
+    """Attention over [B, S, H, D] tensors; returns [B, Sq, H, D].
+
+    ``window``: sliding-window (local) attention — position i attends
+    to [i-window, i] (window+1 keys; HF/Mistral's convention keeps
+    ``W`` keys, so pass ``hf_window - 1`` for parity); requires
+    ``causal=True`` and ``window >= 1``.  The flash kernels skip the
+    MXU work of fully-out-of-window blocks (the grid still walks and
+    DMAs every tile; a kv index remap is future work)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    route = _sp_route(q, k, v, mask, causal, scale)
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "sliding window attention requires causal=True")
+        if window < 1:
+            raise ValueError(
+                f"window must be >= 1 (got {window}); 0 would silently "
+                "disable windowing in the falsy checks downstream")
+    route = None if window else _sp_route(q, k, v, mask, causal, scale)
+    if window and getattr(_SP_STATE, "ctx", None) is not None:
+        logger.warning("sequence_parallel: sliding-window attention "
+                       "runs the local kernel (ring windowing not "
+                       "implemented)")
     if route is not None:
         mesh, mode = route
         if mode == "ulysses":
@@ -140,5 +164,5 @@ def dot_product_attention(
     if flash_eligible(q.shape[1], k.shape[1], q.shape[-1], mask):
         kv_mask = None if mask is None else mask[:, 0, 0, :]
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               kv_mask=kv_mask)
-    return _xla_attention(q, k, v, mask, causal, scale)
+                               kv_mask=kv_mask, window=window)
+    return _xla_attention(q, k, v, mask, causal, scale, window=window)

@@ -83,8 +83,33 @@ def _pick_block(seq: int, cap: int) -> int:
     return best
 
 
-def _causal_needed(iq, ikv, block_q, block_kv, q_shift):
-    return ikv * block_kv <= iq * block_q + q_shift + block_q - 1
+def _block_needed(iq, ikv, block_q, block_kv, q_shift, causal: bool,
+                  window: int):
+    """Does (q-block iq, kv-block ikv) contain any unmasked position?
+
+    Causal skips blocks entirely in the future; a sliding window
+    (``window`` > 0: position i attends to [i-window, i]) additionally
+    skips blocks entirely in the past.  The skip removes the MXU work
+    (the dominant cost) — the grid still visits every (iq, ikv) pair
+    and the BlockSpec pipeline still DMAs each K/V tile, so HBM
+    traffic remains O(S^2/block); remapping the kv grid dimension per
+    q-block is future work.
+    """
+    q_lo = iq * block_q + q_shift
+    q_hi = q_lo + block_q - 1
+    kv_lo = ikv * block_kv
+    kv_hi = ikv * block_kv + block_kv - 1
+    conds = []  # iq/ikv are traced program ids: combine with &, not and
+    if causal:
+        conds.append(kv_lo <= q_hi)
+    if window > 0:
+        conds.append(kv_hi >= q_lo - window)
+    if not conds:
+        return True
+    needed = conds[0]
+    for c in conds[1:]:
+        needed = needed & c
+    return needed
 
 
 def _block_ids(iq, ikv, block_q, block_kv, q_shift):
@@ -102,7 +127,7 @@ def _block_ids(iq, ikv, block_q, block_kv, q_shift):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
                 block_q: int, block_kv: int, q_shift: int,
-                padded: bool = False):
+                padded: bool = False, window: int = 0):
     # Optional key-padding mask rides as a 4th input ref ([1, block_kv,
     # 128] f32; column 0 = 1.0 for valid keys).
     if padded:
@@ -120,8 +145,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    needed = (not causal) or _causal_needed(iq, ikv, block_q, block_kv,
-                                            q_shift)
+    needed = _block_needed(iq, ikv, block_q, block_kv, q_shift,
+                           causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -131,9 +156,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window > 0:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
-            scores = jnp.where(q_ids >= k_ids, scores, NEG_INF)
+            if causal:
+                scores = jnp.where(q_ids >= k_ids, scores, NEG_INF)
+            if window > 0:
+                scores = jnp.where(q_ids - k_ids <= window, scores,
+                                   NEG_INF)
         if padded:
             valid = kvm_ref[0][:, 0][None, :] > 0.0  # [1, block_kv]
             scores = jnp.where(valid, scores, NEG_INF)
@@ -173,7 +202,8 @@ def _pack_kv_mask(kv_mask, sk):
     return jnp.broadcast_to(m, (kv_mask.shape[0], sk, 128))
 
 
-def _flash_forward(q, k, v, kvm, causal: bool, scale: float):
+def _flash_forward(q, k, v, kvm, causal: bool, scale: float,
+                   window: int = 0):
     """q/k/v: [B, H, S, D] -> (out, lse[B, H, Sq, 128]).
 
     ``kvm``: None or packed key-padding mask [B, Sk, 128] f32."""
@@ -191,7 +221,8 @@ def _flash_forward(q, k, v, kvm, causal: bool, scale: float):
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_kv=block_kv, q_shift=sk - sq, padded=padded)
+        block_kv=block_kv, q_shift=sk - sq, padded=padded,
+        window=window)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
                      lambda b, h, i, j: (b, h, i, 0)),
@@ -245,7 +276,7 @@ def _flash_forward(q, k, v, kvm, causal: bool, scale: float):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, causal: bool, scale: float,
                    block_q: int, block_kv: int, q_shift: int,
-                   padded: bool = False):
+                   padded: bool = False, window: int = 0):
     if padded:
         kvm_ref, dq_ref, dq_acc = refs
     else:
@@ -259,8 +290,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    needed = (not causal) or _causal_needed(iq, ikv, block_q, block_kv,
-                                            q_shift)
+    needed = _block_needed(iq, ikv, block_q, block_kv, q_shift,
+                           causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -274,9 +305,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(scores - lse)       # exp(NEG_INF-ish) -> 0
-        if causal:
+        if causal or window > 0:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
-            p = jnp.where(q_ids >= k_ids, p, 0.0)
+            if causal:
+                p = jnp.where(q_ids >= k_ids, p, 0.0)
+            if window > 0:
+                p = jnp.where(q_ids - k_ids <= window, p, 0.0)
         if padded:
             # Select (not multiply) so a fully-masked row's inf p terms
             # (lse == NEG_INF) cannot produce NaN.
@@ -297,7 +331,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, causal: bool, scale: float, block_q: int,
-                    block_kv: int, q_shift: int, padded: bool = False):
+                    block_kv: int, q_shift: int, padded: bool = False,
+                    window: int = 0):
     if padded:
         kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
     else:
@@ -312,8 +347,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    needed = (not causal) or _causal_needed(iq, ikv, block_q, block_kv,
-                                            q_shift)
+    needed = _block_needed(iq, ikv, block_q, block_kv, q_shift,
+                           causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -327,9 +362,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(scores - lse)
-        if causal:
+        if causal or window > 0:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
-            p = jnp.where(q_ids >= k_ids, p, 0.0)
+            if causal:
+                p = jnp.where(q_ids >= k_ids, p, 0.0)
+            if window > 0:
+                p = jnp.where(q_ids - k_ids <= window, p, 0.0)
         if padded:
             valid = kvm_ref[0][:, 0][None, :] > 0.0  # this kv block
             p = jnp.where(valid, p, 0.0)
@@ -353,7 +391,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
-                    dlse=None):
+                    dlse=None, window: int = 0):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     block_q = _pick_block(sq, BLOCK_Q)
@@ -386,7 +424,8 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_kv=block_kv,
-                          q_shift=q_shift, padded=padded),
+                          q_shift=q_shift, padded=padded,
+                          window=window),
         grid=(batch, heads, sq // block_q, sk // block_kv),
         in_specs=dq_in_specs,
         out_specs=qspec,
@@ -416,7 +455,8 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_kv=block_kv,
-                          q_shift=q_shift, padded=padded),
+                          q_shift=q_shift, padded=padded,
+                          window=window),
         grid=(batch, heads, sk // block_kv, sq // block_q),
         in_specs=dkv_in_specs,
         out_specs=[kspec_t, kspec_t],
@@ -437,18 +477,18 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, kvm, causal, scale):
-    out, _ = _flash_forward(q, k, v, kvm, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, kvm, causal, scale, window=0):
+    out, _ = _flash_forward(q, k, v, kvm, causal, scale, window)
     return out
 
 
-def _flash_fwd(q, k, v, kvm, causal, scale):
-    out, lse = _flash_forward(q, k, v, kvm, causal, scale)
+def _flash_fwd(q, k, v, kvm, causal, scale, window=0):
+    out, lse = _flash_forward(q, k, v, kvm, causal, scale, window)
     return out, (q, k, v, kvm, out, lse)
 
 
-def _flash_bwd(causal, scale, res, g):
+def _flash_bwd(causal, scale, window, res, g):
     q, k, v, kvm, o, lse = res
     if os.environ.get("POLYAXON_TPU_FLASH_XLA_BWD"):
         # Escape hatch: XLA-recompute backward (materializes [S, S]).
@@ -461,12 +501,13 @@ def _flash_bwd(causal, scale, res, g):
             out = _xla_attention(q.transpose(0, 2, 1, 3),
                                  k.transpose(0, 2, 1, 3),
                                  v.transpose(0, 2, 1, 3), mask, causal,
-                                 scale)
+                                 scale, window=window)
             return out.transpose(0, 2, 1, 3)
 
         dq, dk, dv = jax.vjp(ref, q, k, v)[1](g)
         return dq, dk, dv, None
-    dq, dk, dv = _flash_backward(q, k, v, kvm, o, lse, g, causal, scale)
+    dq, dk, dv = _flash_backward(q, k, v, kvm, o, lse, g, causal, scale,
+                                 window=window)
     return dq, dk, dv, None
 
 
@@ -515,7 +556,7 @@ def flash_attention_lse(q, k, v, *, causal: bool = False,
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale: float = 1.0,
-                    kv_mask=None) -> jax.Array:
+                    kv_mask=None, window=None) -> jax.Array:
     """Flash attention over BSHD tensors (public convention).
 
     Transposes to head-major BHSD for the kernels so each (q-block,
@@ -524,7 +565,14 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float = 1.0,
     attend) — the padded-batch case that used to force the O(S^2) XLA
     fallback.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "sliding window attention is causal: position i "
+                "attends to [i-window, i]; pass causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1; got {window}")
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     kvm = None if kv_mask is None else _pack_kv_mask(kv_mask, k.shape[2])
-    out = _flash(q, k, v, kvm, causal, scale)
+    out = _flash(q, k, v, kvm, causal, scale, int(window or 0))
     return out.transpose(0, 2, 1, 3)

@@ -219,3 +219,52 @@ def test_generate_rejects_cache_overflow():
     prompt = jnp.asarray(spec.make_batch(1)["inputs"][:, :8])
     with pytest.raises(ValueError, match="max_position"):
         generate(model, variables, prompt, max_new_tokens=128)
+
+
+def test_llama_sliding_window_limits_receptive_field():
+    """With window=W, logits at position i must not depend on tokens
+    before i-W... after one block (residual carries nothing else)."""
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                      intermediate_size=64, num_layers=1, num_heads=2,
+                      num_kv_heads=2, max_position=64,
+                      sliding_window=4, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    t = jnp.asarray(np.random.RandomState(0).randint(0, 128, (1, 32)))
+    v = model.init(jax.random.PRNGKey(0), t)
+    out = model.apply(v, t)
+    # Changing token 0 must not affect position 20 (20 - 0 > window=4
+    # with a single layer).
+    t2 = t.at[0, 0].set((t[0, 0] + 1) % 128)
+    out2 = model.apply(v, t2)
+    np.testing.assert_allclose(np.asarray(out[0, 20]),
+                               np.asarray(out2[0, 20]), atol=1e-5)
+    # But it MUST affect position 2 (inside the window).
+    assert not np.allclose(np.asarray(out[0, 2]),
+                           np.asarray(out2[0, 2]), atol=1e-5)
+
+
+def test_llama_sliding_window_decode_parity():
+    """KV-cache decode with a sliding window matches the windowed full
+    forward position by position."""
+    from polyaxon_tpu.models.generate import init_cache
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                      intermediate_size=64, num_layers=2, num_heads=2,
+                      num_kv_heads=1, max_position=64,
+                      sliding_window=5, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 16)))
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    full = model.apply(variables, tokens)
+
+    cache = init_cache(model, 2)
+    outs = []
+    for i in range(tokens.shape[1]):
+        logits, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            tokens[:, i:i + 1], decode=True, decode_position=i,
+            mutable=["cache"])
+        cache = mut["cache"]
+        outs.append(logits[:, 0])
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               atol=1e-4, rtol=1e-4)

@@ -180,3 +180,76 @@ def test_registry_analytic_train_flops():
     assert 2.5e12 < f < 4e12
     for name in ("bert-base", "vit-base", "moe-gpt-small"):
         assert get_model(name).train_flops is not None
+
+
+@pytest.mark.parametrize("window", [64, 128, 200])
+def test_flash_sliding_window_matches_xla(window, monkeypatch):
+    """Windowed kernels (block-skip + in-block mask) match the XLA
+    reference, including windows that don't align to blocks."""
+    monkeypatch.setenv("POLYAXON_TPU_FLASH_INTERPRET", "1")
+    import polyaxon_tpu.ops.flash as fl
+    monkeypatch.setattr(fl, "BLOCK_Q", 128)
+    monkeypatch.setattr(fl, "BLOCK_KV", 128)
+    q, k, v = _qkv(b=2, s=512, d=128)
+    out = fl.flash_attention(q, k, v, causal=True, scale=128 ** -0.5,
+                             window=window)
+    ref = _xla_attention(q, k, v, None, True, 128 ** -0.5,
+                         window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_flash_sliding_window_gradients(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_FLASH_INTERPRET", "1")
+    import polyaxon_tpu.ops.flash as fl
+    monkeypatch.setattr(fl, "BLOCK_Q", 128)
+    monkeypatch.setattr(fl, "BLOCK_KV", 128)
+    q, k, v = _qkv(b=1, s=384, d=128)
+
+    def f_flash(q, k, v):
+        o = fl.flash_attention(q, k, v, causal=True, scale=128 ** -0.5,
+                               window=100)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    def f_ref(q, k, v):
+        o = _xla_attention(q, k, v, None, True, 128 ** -0.5, window=100)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, rtol=5e-3)
+
+
+def test_flash_window_requires_causal():
+    from polyaxon_tpu.ops.flash import flash_attention
+    q = jnp.zeros((1, 128, 1, 64))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, window=16)
+    with pytest.raises(ValueError, match="causal"):
+        dot_product_attention(q, q, q, window=16)
+
+
+def test_window_block_skip_logic():
+    """Blocks entirely outside [i-window, i] are skipped."""
+    from polyaxon_tpu.ops.flash import _block_needed
+    # q block 3 (rows 384-511), window 64: kv block 0 (cols 0-127) has
+    # max col 127 < 384-64 -> skipped; kv block 2 (cols 256-383) needed.
+    assert not _block_needed(3, 0, 128, 128, 0, True, 64)
+    assert _block_needed(3, 2, 128, 128, 0, True, 64)
+    assert _block_needed(3, 3, 128, 128, 0, True, 64)
+    assert not _block_needed(0, 1, 128, 128, 0, True, 64)  # future
+
+
+def test_window_zero_rejected():
+    """window=0 must error, not silently disable windowing."""
+    from polyaxon_tpu.ops.flash import flash_attention
+    q = jnp.zeros((1, 128, 1, 64))
+    with pytest.raises(ValueError, match=">= 1"):
+        flash_attention(q, q, q, causal=True, window=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        dot_product_attention(q, q, q, causal=True, window=0)
+    from polyaxon_tpu.models.llama import LlamaConfig
+    with pytest.raises(ValueError, match="sliding_window"):
+        LlamaConfig(sliding_window=0)
