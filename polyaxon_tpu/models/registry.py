@@ -310,6 +310,18 @@ _register(ModelSpec(
 ))
 
 _register(ModelSpec(
+    name="mistral-tiny",  # Llama + sliding-window local attention + GQA
+    make_model=lambda **kw: LlamaModel(
+        LlamaConfig(vocab_size=512, hidden_size=64,
+                    intermediate_size=128, num_layers=2, num_heads=4,
+                    num_kv_heads=2, max_position=256,
+                    sliding_window=31), **kw),
+    make_batch=lambda b: _token_batch(b, 128, 512),
+    loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
     name="llama-tiny",
     make_model=lambda **kw: LlamaModel(LlamaConfig.tiny(), **kw),
     make_batch=lambda b: _token_batch(b, 64, LlamaConfig.tiny().vocab_size),

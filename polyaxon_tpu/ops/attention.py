@@ -142,9 +142,13 @@ def dot_product_attention(
                 "disable windowing in the falsy checks downstream")
     route = None if window else _sp_route(q, k, v, mask, causal, scale)
     if window and getattr(_SP_STATE, "ctx", None) is not None:
-        logger.warning("sequence_parallel: sliding-window attention "
-                       "runs the local kernel (ring windowing not "
-                       "implemented)")
+        # A silent local fallback here would process the FULL sequence
+        # on every device (~sp x the expected activation memory) — the
+        # exact regime sequence parallelism was chosen for.
+        raise ValueError(
+            "sliding-window attention under sequence parallelism is "
+            "not implemented (ring windowing); train windowed models "
+            "without an sp axis, or drop the window")
     if route is not None:
         mesh, mode = route
         if mode == "ulysses":

@@ -11,7 +11,7 @@ from polyaxon_tpu.models.registry import _REGISTRY
 
 
 TINY = ["mlp", "convnet", "resnet50-tiny", "bert-tiny", "gpt2-tiny",
-        "vit-tiny", "llama-tiny"]
+        "vit-tiny", "llama-tiny", "mistral-tiny"]
 
 
 def test_registry_lists_baseline_models():

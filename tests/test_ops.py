@@ -253,3 +253,15 @@ def test_window_zero_rejected():
     from polyaxon_tpu.models.llama import LlamaConfig
     with pytest.raises(ValueError, match="sliding_window"):
         LlamaConfig(sliding_window=0)
+
+
+def test_window_under_sp_is_hard_error():
+    """window + active sequence parallelism must error, not silently
+    process the full sequence per device."""
+    from polyaxon_tpu.ops.attention import sequence_parallel
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh
+    mesh = build_mesh(MeshSpec(dp=-1, sp=2))
+    q = jnp.zeros((2, 128, 2, 64))
+    with sequence_parallel(mesh, "ring"):
+        with pytest.raises(ValueError, match="sequence parallelism"):
+            dot_product_attention(q, q, q, causal=True, window=16)
