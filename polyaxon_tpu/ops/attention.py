@@ -140,26 +140,19 @@ def dot_product_attention(
             raise ValueError(
                 f"window must be >= 1 (got {window}); 0 would silently "
                 "disable windowing in the falsy checks downstream")
-    route = None if window else _sp_route(q, k, v, mask, causal, scale)
-    if window and getattr(_SP_STATE, "ctx", None) is not None:
-        # A silent local fallback here would process the FULL sequence
-        # on every device (~sp x the expected activation memory) — the
-        # exact regime sequence parallelism was chosen for.
-        raise ValueError(
-            "sliding-window attention under sequence parallelism is "
-            "not implemented (ring windowing); train windowed models "
-            "without an sp axis, or drop the window")
+    route = _sp_route(q, k, v, mask, causal, scale)
     if route is not None:
         mesh, mode = route
         if mode == "ulysses":
             from ..parallel.ulysses import ulysses_attention
 
             return ulysses_attention(q, k, v, mesh, mask=mask,
-                                     causal=causal, scale=scale)
+                                     causal=causal, scale=scale,
+                                     window=window)
         from ..parallel.ring import ring_attention
 
         return ring_attention(q, k, v, mesh, mask=mask, causal=causal,
-                              scale=scale)
+                              scale=scale, window=window)
     from .flash import flash_attention, flash_eligible
 
     # One shared predicate for every flash consumer (kill-switch, TPU

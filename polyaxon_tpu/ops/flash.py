@@ -102,7 +102,7 @@ def _block_needed(iq, ikv, block_q, block_kv, q_shift, causal: bool,
     conds = []  # iq/ikv are traced program ids: combine with &, not and
     if causal:
         conds.append(kv_lo <= q_hi)
-    if window > 0:
+    if window is not None:
         conds.append(kv_hi >= q_lo - window)
     if not conds:
         return True
@@ -127,7 +127,7 @@ def _block_ids(iq, ikv, block_q, block_kv, q_shift):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
                 block_q: int, block_kv: int, q_shift: int,
-                padded: bool = False, window: int = 0):
+                padded: bool = False, window=None):
     # Optional key-padding mask rides as a 4th input ref ([1, block_kv,
     # 128] f32; column 0 = 1.0 for valid keys).
     if padded:
@@ -156,11 +156,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal or window > 0:
+        if causal or window is not None:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             if causal:
                 scores = jnp.where(q_ids >= k_ids, scores, NEG_INF)
-            if window > 0:
+            if window is not None:
                 scores = jnp.where(q_ids - k_ids <= window, scores,
                                    NEG_INF)
         if padded:
@@ -203,7 +203,7 @@ def _pack_kv_mask(kv_mask, sk):
 
 
 def _flash_forward(q, k, v, kvm, causal: bool, scale: float,
-                   window: int = 0):
+                   window=None):
     """q/k/v: [B, H, S, D] -> (out, lse[B, H, Sq, 128]).
 
     ``kvm``: None or packed key-padding mask [B, Sk, 128] f32."""
@@ -276,7 +276,7 @@ def _flash_forward(q, k, v, kvm, causal: bool, scale: float,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, causal: bool, scale: float,
                    block_q: int, block_kv: int, q_shift: int,
-                   padded: bool = False, window: int = 0):
+                   padded: bool = False, window=None):
     if padded:
         kvm_ref, dq_ref, dq_acc = refs
     else:
@@ -305,11 +305,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(scores - lse)       # exp(NEG_INF-ish) -> 0
-        if causal or window > 0:
+        if causal or window is not None:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             if causal:
                 p = jnp.where(q_ids >= k_ids, p, 0.0)
-            if window > 0:
+            if window is not None:
                 p = jnp.where(q_ids - k_ids <= window, p, 0.0)
         if padded:
             # Select (not multiply) so a fully-masked row's inf p terms
@@ -332,7 +332,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, causal: bool, scale: float, block_q: int,
                     block_kv: int, q_shift: int, padded: bool = False,
-                    window: int = 0):
+                    window=None):
     if padded:
         kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
     else:
@@ -362,11 +362,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(scores - lse)
-        if causal or window > 0:
+        if causal or window is not None:
             q_ids, k_ids = _block_ids(iq, ikv, block_q, block_kv, q_shift)
             if causal:
                 p = jnp.where(q_ids >= k_ids, p, 0.0)
-            if window > 0:
+            if window is not None:
                 p = jnp.where(q_ids - k_ids <= window, p, 0.0)
         if padded:
             valid = kvm_ref[0][:, 0][None, :] > 0.0  # this kv block
@@ -391,7 +391,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
-                    dlse=None, window: int = 0):
+                    dlse=None, window=None):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
     block_q = _pick_block(sq, BLOCK_Q)
@@ -478,12 +478,12 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, kvm, causal, scale, window=0):
+def _flash(q, k, v, kvm, causal, scale, window=None):
     out, _ = _flash_forward(q, k, v, kvm, causal, scale, window)
     return out
 
 
-def _flash_fwd(q, k, v, kvm, causal, scale, window=0):
+def _flash_fwd(q, k, v, kvm, causal, scale, window=None):
     out, lse = _flash_forward(q, k, v, kvm, causal, scale, window)
     return out, (q, k, v, kvm, out, lse)
 
@@ -514,25 +514,28 @@ def _flash_bwd(causal, scale, window, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_lse(q, k, v, kvm, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_lse(q, k, v, kvm, causal, scale, window=None):
     """Like ``_flash`` but also returns the row logsumexp [B, H, Sq] —
     what blockwise consumers (ring attention) need to combine
-    per-block normalized outputs exactly."""
-    out, lse = _flash_forward(q, k, v, kvm, causal, scale)
+    per-block normalized outputs exactly.  ``window`` here is the RAW
+    kernel semantics (None = off; any int masks q_pos - k_pos <=
+    window, including non-positive values — ring's boundary
+    rotations)."""
+    out, lse = _flash_forward(q, k, v, kvm, causal, scale, window)
     return out, lse[..., 0]
 
 
-def _flash_lse_fwd(q, k, v, kvm, causal, scale):
-    out, lse = _flash_forward(q, k, v, kvm, causal, scale)
+def _flash_lse_fwd(q, k, v, kvm, causal, scale, window=None):
+    out, lse = _flash_forward(q, k, v, kvm, causal, scale, window)
     return (out, lse[..., 0]), (q, k, v, kvm, out, lse)
 
 
-def _flash_lse_bwd(causal, scale, res, cts):
+def _flash_lse_bwd(causal, scale, window, res, cts):
     q, k, v, kvm, o, lse = res
     do, dlse = cts
     dq, dk, dv = _flash_backward(q, k, v, kvm, o, lse, do, causal, scale,
-                                 dlse=dlse)
+                                 dlse=dlse, window=window)
     return dq, dk, dv, None
 
 
@@ -540,7 +543,7 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention_lse(q, k, v, *, causal: bool = False,
-                        scale: float = 1.0, kv_mask=None):
+                        scale: float = 1.0, kv_mask=None, window=None):
     """Flash attention over BSHD tensors returning ``(out, lse)``.
 
     ``out``: [B, Sq, H, D] (same as :func:`flash_attention`);
@@ -551,7 +554,7 @@ def flash_attention_lse(q, k, v, *, causal: bool = False,
     """
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     kvm = None if kv_mask is None else _pack_kv_mask(kv_mask, k.shape[2])
-    out, lse = _flash_lse(q, k, v, kvm, causal, scale)
+    out, lse = _flash_lse(q, k, v, kvm, causal, scale, window)
     return out.transpose(0, 2, 1, 3), lse
 
 
@@ -574,5 +577,6 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float = 1.0,
             raise ValueError(f"window must be >= 1; got {window}")
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     kvm = None if kv_mask is None else _pack_kv_mask(kv_mask, k.shape[2])
-    out = _flash(q, k, v, kvm, causal, scale, int(window or 0))
+    out = _flash(q, k, v, kvm, causal, scale,
+                 None if window is None else int(window))
     return out.transpose(0, 2, 1, 3)

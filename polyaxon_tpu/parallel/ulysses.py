@@ -59,7 +59,7 @@ def _ulysses_shard(q, k, v, mask, *, axis_name: str, attn_fn):
 
 
 def _default_inner(q, k, v, mask=None, *, causal: bool,
-                   scale: Optional[float]):
+                   scale: Optional[float], window: Optional[int] = None):
     """Per-shard attention after the all-to-all: each rank holds the
     FULL sequence for a head subset — exactly the flash kernel's shape,
     so route through it when eligible (TPU or the interpret-mode tests,
@@ -74,19 +74,27 @@ def _default_inner(q, k, v, mask=None, *, causal: bool,
         return flash_attention(
             q, k, v, causal=causal,
             scale=q.shape[-1] ** -0.5 if scale is None else scale,
-            kv_mask=kvm)
-    return _plain_attention(q, k, v, mask, causal=causal, scale=scale)
+            kv_mask=kvm, window=window)
+    return _plain_attention(q, k, v, mask, causal=causal, scale=scale,
+                            window=window)
 
 
 def _plain_attention(q, k, v, mask=None, *, causal: bool,
-                     scale: Optional[float]):
+                     scale: Optional[float],
+                     window: Optional[int] = None):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    if causal:
+    if causal:  # window implies causal (validated at every driver)
+        # Post-all-to-all each rank holds the FULL sequence, so local
+        # indices ARE global positions; the window composes directly.
         sq, sk = q.shape[1], k.shape[1]
-        cmask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(sk)[None, :]
+        cmask = qi >= ki
+        if window is not None:
+            cmask &= qi - ki <= window
         scores = jnp.where(cmask[None, :, None, :], scores, -1e30)
     if mask is not None:
         # [B, H?, Sq, Sk] -> scores' [B, Sq, H, Sk]
@@ -107,6 +115,7 @@ def ulysses_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
     attn_fn: Optional[Callable] = None,
     batch_axes=("dp", "fsdp"),
 ):
@@ -137,8 +146,17 @@ def ulysses_attention(
         if mask.shape[1] > 1 and mask.shape[1] % sp:
             raise ValueError(
                 f"mask head dim ({mask.shape[1]}) must divide sp ({sp})")
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1; got {window}")
+        if attn_fn is not None:
+            raise ValueError(
+                "window with a custom attn_fn would be silently "
+                "ignored; apply the window inside your kernel instead")
     inner = attn_fn or functools.partial(_default_inner, causal=causal,
-                                         scale=scale)
+                                         scale=scale, window=window)
     batch = active_batch_axes(mesh, batch_axes)
     spec = P(batch, axis_name, None, None)
     body = functools.partial(_ulysses_shard, axis_name=axis_name,

@@ -255,13 +255,20 @@ def test_window_zero_rejected():
         LlamaConfig(sliding_window=0)
 
 
-def test_window_under_sp_is_hard_error():
-    """window + active sequence parallelism must error, not silently
-    process the full sequence per device."""
+def test_window_routes_through_sp(monkeypatch):
+    """window + active sequence parallelism routes through the windowed
+    ring/Ulysses paths and matches local windowed attention."""
+    monkeypatch.setenv("POLYAXON_TPU_FLASH_INTERPRET", "1")
     from polyaxon_tpu.ops.attention import sequence_parallel
     from polyaxon_tpu.parallel import MeshSpec, build_mesh
     mesh = build_mesh(MeshSpec(dp=-1, sp=2))
-    q = jnp.zeros((2, 128, 2, 64))
-    with sequence_parallel(mesh, "ring"):
-        with pytest.raises(ValueError, match="sequence parallelism"):
-            dot_product_attention(q, q, q, causal=True, window=16)
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (4, 256, 2, 64)) for kk in ks)
+    ref = _xla_attention(q, k, v, None, True, 64 ** -0.5, window=100)
+    for mode in ("ring", "ulysses"):
+        with sequence_parallel(mesh, mode):
+            out = dot_product_attention(q, k, v, causal=True, window=100)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"mode={mode}")

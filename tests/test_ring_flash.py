@@ -159,3 +159,48 @@ def test_ulysses_flash_kv_mask_and_grads(qkv, flash_interp):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-3, rtol=5e-3)
+
+
+@pytest.mark.parametrize("window", [100, 250, 40])
+def test_ring_flash_window_matches_local(qkv, flash_interp, window):
+    """Windowed flash ring (static unrolled rotations + early stop)
+    matches local windowed attention, incl. windows smaller than a
+    block (40 < s_blk=128: zero rotations beyond... one boundary)."""
+    q, k, v = qkv
+    mesh = _mesh()
+    out = ring_attention(q, k, v, mesh, causal=True, window=window)
+    ref = _xla_attention(q, k, v, None, True, D ** -0.5, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_ring_flash_window_gradients(qkv, flash_interp):
+    q, k, v = qkv
+    mesh = _mesh()
+
+    def ring_loss(q, k, v):
+        o = ring_attention(q, k, v, mesh, causal=True, window=90)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        o = _xla_attention(q, k, v, None, True, D ** -0.5, window=90)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, rtol=5e-3)
+
+
+def test_ring_window_stops_rotating_early(flash_interp):
+    """The windowed ring must rotate ceil(W/s_blk) times, not n-1:
+    count ppermutes in the jaxpr."""
+    q = jnp.zeros((4, 256, 2, 64))
+    mesh = _mesh()
+    jaxpr = str(jax.make_jaxpr(
+        lambda q, k, v: ring_attention(q, k, v, mesh, causal=True,
+                                       window=100))(q, q, q))
+    # s_blk = 128, W=100 -> r_max = ceil(101/128) = 1 rotation: exactly
+    # 2 ppermutes (k and v), not 2*(n-1).
+    assert jaxpr.count("ppermute") == 2, jaxpr.count("ppermute")
