@@ -249,6 +249,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
+        if method == "GET" and parsed.path in ("/", "/ui"):
+            # Static, data-free page (its JS supplies the bearer token
+            # for the actual API calls) — safe to serve unauthenticated.
+            from .dashboard import DASHBOARD_HTML
+
+            blob = DASHBOARD_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+            return
         if not parsed.path.startswith("/api/v1"):
             return _json_response(self, 404, {"error": "not found"})
         path = parsed.path[len("/api/v1"):] or "/"

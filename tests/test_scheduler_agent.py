@@ -380,3 +380,52 @@ class TestSchedules:
             assert child["status"] == V1Statuses.QUEUED
             assert "schedule" not in child["content"]
             assert child["pipeline"] == controller["uuid"]
+
+
+def test_dashboard_served_without_auth(tmp_path):
+    """GET / and /ui serve the static dashboard page even on a
+    token-gated control plane; the API itself stays gated."""
+    import urllib.request
+    import urllib.error
+    from polyaxon_tpu.scheduler.api import ControlPlane, make_server
+    from polyaxon_tpu.client.store import FileRunStore
+
+    plane = ControlPlane(FileRunStore(str(tmp_path)), auth_token="sekrit")
+    server = make_server(host="127.0.0.1", port=0, plane=plane)
+    import threading
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        for path in ("/", "/ui"):
+            with urllib.request.urlopen(base + path) as r:
+                body = r.read().decode()
+                assert r.status == 200
+                assert "polyaxon-tpu" in body and "<table" in body
+        # The data API remains token-gated.
+        try:
+            urllib.request.urlopen(base + "/api/v1/runs")
+            raise AssertionError("unauthenticated API call succeeded")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        server.shutdown()
+
+
+def test_dashboard_escapes_api_strings():
+    """The page must escape API-sourced strings before innerHTML (a
+    hostile run name must not reach the DOM unescaped — the bearer
+    token lives in localStorage)."""
+    from polyaxon_tpu.scheduler.dashboard import DASHBOARD_HTML as page
+    # Every innerHTML interpolation of API data rides esc()/statusCell/
+    # fmtTime/fmtMetrics (which escape internally); spot-check the
+    # hot spots.
+    assert "${esc(r.name)}" in page
+    assert "${esc(c.reason)}" in page
+    assert "${esc(c.message)}" in page
+    assert "${esc(logText)" in page
+    assert "${r.name" not in page.replace("${esc(r.name)}", "")
+    # statusCell whitelists the class token instead of escaping.
+    assert '/^[a-z_]+$/.test' in page
+    # Refresh self-re-arms instead of stacking intervals.
+    assert "setInterval" not in page
