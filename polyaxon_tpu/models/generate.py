@@ -7,11 +7,10 @@ the whole generation, no per-token dispatch — with the per-layer KV
 cache living in the model's flax "cache" collection (stacked [layers,
 ...] by ``scan_stack``, so it shards the same way the params do).
 
-Prefill also steps through the scan (one token at a time) with teacher
-forcing: positions below the prompt length keep the prompt token,
-positions above take the sampled one.  For the zoo's decode-capable
-models (Llama) on a single program this is compile-once and
-bandwidth-bound — the right shape for TPU decode.
+Prefill is CHUNKED: one forward over the whole prompt fills every
+layer's cache (the causal-append mask handles S > 1), then the scan
+generates token by token.  For the zoo's decode-capable models this is
+compile-once and bandwidth-bound — the right shape for TPU decode.
 """
 
 from __future__ import annotations
@@ -68,14 +67,19 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
              eos_id: Optional[int] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
-    ``prompt``: [B, P] int32 (a shared prompt length; pad upstream for
-    ragged prompts and mask via teacher forcing).  Returns [B, P +
-    max_new_tokens].  ``temperature=0`` is greedy; ``eos_id`` freezes
-    finished rows (they keep emitting eos).
+    ``prompt``: [B, P] int32 (a shared prompt length; left-trim or pad
+    ragged prompts upstream).  Returns [B, P + max_new_tokens].
+    ``temperature=0`` is greedy; ``eos_id`` freezes finished rows (they
+    keep emitting eos).
     """
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0; got "
+                         f"{max_new_tokens}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
+    if max_new_tokens == 0:
+        return prompt
     b, p_len = prompt.shape
     total = p_len + max_new_tokens
     max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
@@ -85,29 +89,40 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
         raise ValueError(
             f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_position ({max_pos})")
+
+    # Chunked prefill: ONE forward over the whole prompt fills the KV
+    # cache (the causal-append mask handles S > 1), instead of p_len
+    # sequential decode steps.
     cache = init_cache(model, b)
+    out, mut = model.apply(
+        {"params": variables["params"], "cache": cache},
+        prompt, decode=True, decode_position=0, mutable=["cache"])
+    cache = mut["cache"]
+    rng, key = jax.random.split(rng)
+    first = _sample(extract_logits(out)[:, -1], key, temperature, top_k)
+    done = jnp.zeros((b,), bool)
+    if eos_id is not None:
+        done = first == eos_id
 
     def step(carry, t):
         cache, tok, rng, done = carry
         out, mut = model.apply(
             {"params": variables["params"], "cache": cache},
-            tok[:, None], decode=True, decode_position=t,
+            tok[:, None], decode=True, decode_position=p_len + t,
             mutable=["cache"])
         logits = extract_logits(out)
         rng, key = jax.random.split(rng)
         nxt = _sample(logits[:, -1], key, temperature, top_k)
-        # Teacher-force the prompt: positions still inside it emit the
-        # prompt token regardless of the model's prediction.
-        in_prompt = t + 1 < p_len
-        forced = jnp.where(in_prompt,
-                           prompt[:, jnp.minimum(t + 1, p_len - 1)], nxt)
         if eos_id is not None:
-            forced = jnp.where(done, eos_id, forced)
-            done = done | (~in_prompt & (forced == eos_id))
-        return (mut["cache"], forced.astype(jnp.int32), rng, done), forced
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (mut["cache"], nxt.astype(jnp.int32), rng, done), nxt
 
-    done0 = jnp.zeros((b,), bool)
-    (_, _, _, _), toks = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng, done0), jnp.arange(total - 1))
-    out = jnp.concatenate([prompt[:, :1], toks.T], axis=1)
-    return out
+    if max_new_tokens > 1:
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (cache, first.astype(jnp.int32), rng, done),
+            jnp.arange(max_new_tokens - 1))
+        new = jnp.concatenate([first[:, None], toks.T], axis=1)
+    else:
+        new = first[:, None]
+    return jnp.concatenate([prompt, new.astype(jnp.int32)], axis=1)

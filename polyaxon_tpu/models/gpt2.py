@@ -91,7 +91,8 @@ class GPT2Block(nn.Module):
         if decode:
             # Single-token KV-cache step (GPT-2 has no RoPE — positions
             # enter via wpe at the embedding).
-            k, v, mask = append_kv_cache(self, k, v, cfg.max_position)
+            k, v, mask, _ = append_kv_cache(self, k, v,
+                                            cfg.max_position)
         a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")

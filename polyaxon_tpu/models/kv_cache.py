@@ -11,29 +11,43 @@ import jax
 import jax.numpy as jnp
 
 
-def append_kv_cache(mod, k, v, max_position: int):
-    """Append this step's k/v ([B, 1, H, D]) to ``mod``'s decode cache.
+def append_kv_cache(mod, k, v, max_position: int, window=None,
+                    rotate=None):
+    """Append this step's k/v ([B, S, H, D]) to ``mod``'s decode cache.
+
+    Works for single-token steps AND chunked prefill (S > 1 — the
+    whole prompt in one forward): new token i sits at absolute position
+    ``idx + i``, so the returned mask ([1, 1, S, max_position]) admits
+    key j iff ``j <= idx + i`` (causal over the appended chunk plus the
+    previously filled prefix), clipped to ``window`` when given.
+
+    ``rotate``: optional ``fn(positions, k) -> k`` applied BEFORE the
+    append (RoPE models must store rotated keys); the returned
+    ``positions`` lets the caller rotate q to match.  (One helper owns
+    the variables because flax forbids re-declaring them in the same
+    apply.)
 
     Creates ``cached_key``/``cached_value``/``cache_index`` variables in
-    the "cache" collection on ``mod`` and returns ``(k_full, v_full,
-    mask)`` where the mask ([1, 1, 1, max_position]) admits only the
-    filled prefix (including this token).
+    the "cache" collection on ``mod``; returns ``(k_full, v_full,
+    mask, positions)``.
     """
     b, s, h, d = k.shape
-    if s != 1:
-        raise ValueError(
-            f"decode steps take one token at a time; got seq={s} "
-            "(prefill by stepping the prompt)")
     ck = mod.variable("cache", "cached_key", jnp.zeros,
                       (b, max_position, h, d), k.dtype)
     cv = mod.variable("cache", "cached_value", jnp.zeros,
                       (b, max_position, h, d), v.dtype)
     idx = mod.variable("cache", "cache_index",
                        lambda: jnp.array(0, jnp.int32))
+    pos_q = idx.value + jnp.arange(s)  # absolute positions of new rows
+    if rotate is not None:
+        k = rotate(pos_q, k)
     ck.value = jax.lax.dynamic_update_slice(ck.value, k,
                                             (0, idx.value, 0, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v,
                                             (0, idx.value, 0, 0))
     idx.value = idx.value + s
-    mask = (jnp.arange(max_position) < idx.value)[None, None, None, :]
-    return ck.value, cv.value, mask
+    keys = jnp.arange(max_position)
+    valid = keys[None, :] <= pos_q[:, None]  # [S, max_position]
+    if window is not None:
+        valid &= keys[None, :] >= pos_q[:, None] - window
+    return ck.value, cv.value, valid[None, None], pos_q

@@ -28,12 +28,12 @@ from dataclasses import dataclass
 from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from ..parallel.constraints import BATCH, constrain
 from ..ops.rotary import apply_rotary
 from .attention import dot_product_attention
+from .kv_cache import append_kv_cache
 from .scan_stack import remat_policy, scan_stack
 
 
@@ -112,40 +112,17 @@ class LlamaAttention(nn.Module):
 
         mask = None
         if decode:
-            # Single-token KV-cache step (the flax cache-variable
-            # pattern): rotate at the cache position, append, attend
-            # over the filled prefix.  Decode is GEMV-shaped — the
-            # fused-XLA attention path is the right kernel for it.
-            if s != 1:
-                raise ValueError(
-                    f"decode steps take one token at a time; got seq={s}"
-                    " (prefill by stepping the prompt)")
-            max_len = cfg.max_position
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, max_len, cfg.num_kv_heads, hd),
-                               cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, max_len, cfg.num_kv_heads, hd),
-                               cfg.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.array(0, jnp.int32))
-            pos = idx.value + jnp.arange(s)
-            q, k = apply_rotary(q, k, theta=cfg.rope_theta,
-                                positions=pos)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, idx.value, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, idx.value, 0, 0))
-            idx.value = idx.value + s
-            k, v = ck.value, cv.value
-            # [B, 1, 1, max_len]: attend only to the filled prefix —
-            # clipped to the sliding window when one is configured
-            # (current position is idx-1 post-update).
-            keys = jnp.arange(max_len)
-            valid = keys < idx.value
-            if cfg.sliding_window is not None:
-                valid &= keys >= idx.value - 1 - cfg.sliding_window
-            mask = valid[None, None, None, :]
+            # KV-cache step (single token or chunked prefill): keys
+            # rotate at their absolute cache positions inside the
+            # append (stored pre-rotated); q rotates to match with the
+            # returned positions.  The causal-append mask handles both
+            # S == 1 and whole-prompt chunks, window-clipped.
+            k, v, mask, pos = append_kv_cache(
+                self, k, v, cfg.max_position, window=cfg.sliding_window,
+                rotate=lambda p, kk: apply_rotary(
+                    kk, kk, theta=cfg.rope_theta, positions=p)[1])
+            q = apply_rotary(q, q, theta=cfg.rope_theta,
+                             positions=pos)[0]
         else:
             q, k = apply_rotary(q, k, theta=cfg.rope_theta)
         if cfg.num_kv_heads != cfg.num_heads:

@@ -114,9 +114,19 @@ class MoEMlp(nn.Module):
                 activation=nn.gelu)
             return out.astype(cfg.dtype), aux
         b, s, _ = x.shape
-        if decode:
+        if decode and s == 1:
+            # Single-token step: gather only the routed expert's
+            # weights (the dense path would run every expert).
             out, aux = _switch_ffn_decode(x.reshape(b * s, d), router_w,
                                           w1, w2, nn.gelu)
+        elif decode:
+            # Chunked prefill: per-token weight GATHERS would
+            # materialize [T, d, f] copies (~GBs at real sizes) — the
+            # dense dispatch with drop-free capacity is the right
+            # kernel for many tokens.
+            out, aux = _switch_ffn_dense(x.reshape(b * s, d), router_w,
+                                         w1, w2, b * s, nn.gelu)
+            aux = jnp.zeros((), jnp.float32)
         else:
             capacity = max(1, int(cfg.capacity_factor * b * s / e))
             out, aux = _switch_ffn_dense(x.reshape(b * s, d), router_w,
@@ -144,9 +154,10 @@ class MoEBlock(nn.Module):
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         mask = None
         if decode:
-            # KV-cache step; the switch FFN below routes the single
-            # token exactly as in training (top-1, dense path).
-            k, v, mask = append_kv_cache(self, k, v, cfg.max_position)
+            # KV-cache step (single token or chunked prefill); the
+            # switch FFN below picks its kernel by chunk size.
+            k, v, mask, _ = append_kv_cache(self, k, v,
+                                            cfg.max_position)
         a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")

@@ -337,6 +337,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _h_list_runs(self, body, params):
         limit = params.get("limit")
+        runs = self._list_runs_core(params, limit)
+        if params.get("metrics"):
+            # Inline last-metrics per run: ONE request for the
+            # dashboard instead of an N+1 fetch fan-out.
+            for r in runs:
+                try:
+                    r["last_metrics"] = \
+                        self.plane.store.last_metrics(r["uuid"])
+                except (StoreError, OSError):
+                    r["last_metrics"] = {}
+        return runs
+
+    def _list_runs_core(self, params, limit):
         return self.plane.store.list_runs(
             project=params.get("project"),
             pipeline=params.get("pipeline"),

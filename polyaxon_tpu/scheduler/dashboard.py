@@ -137,7 +137,7 @@ function fmtMetrics(m) {
 
 async function refresh() {
   try {
-    const runs = await api("/runs?sort=-created_at&limit=100");
+    const runs = await api("/runs?sort=-created_at&limit=100&metrics=1");
     $("#err").textContent = "";
     const counts = {};
     for (const r of runs) {
@@ -150,15 +150,13 @@ async function refresh() {
       '<div class="tile"><div class="n">0</div><div class="k">runs' +
       '</div></div>';
     $("#meta").textContent = runs.length + " runs";
-    const metricCells = await Promise.all(runs.map(r =>
-      api(`/runs/${encodeURIComponent(r.uuid)}/metrics/last`)
-        .then(fmtMetrics).catch(() => "—")));
     const rows = runs.map((r, i) =>
       `<tr class="row" data-u="${esc(r.uuid)}">
         <td class="muted">${esc((r.uuid || "").slice(0, 8))}</td>
         <td>${esc(r.name)}</td><td>${statusCell(r.status)}</td>
         <td>${esc(r.queue || "default")}</td>
-        <td class="muted">${esc(r.kind)}</td><td>${metricCells[i]}</td>
+        <td class="muted">${esc(r.kind)}</td>
+        <td>${fmtMetrics(r.last_metrics)}</td>
       </tr>`);
     $("#runs tbody").innerHTML = rows.join("") ||
       '<tr><td colspan="6" class="muted">no runs yet</td></tr>';
@@ -198,10 +196,13 @@ async function showDetail(u) {
 }
 
 // Self-re-arming: the next cycle starts 5 s after the previous one
-// FINISHES, so slow links never stack overlapping refreshes.
+// FINISHES (never stacking), and hidden tabs stop polling entirely.
 (async function loop() {
-  await refresh();
+  if (!document.hidden) await refresh();
   setTimeout(loop, 5000);
 })();
+document.addEventListener("visibilitychange", () => {
+  if (!document.hidden) refresh();
+});
 </script></body></html>
 """

@@ -268,3 +268,55 @@ def test_llama_sliding_window_decode_parity():
     dec = jnp.stack(outs, axis=1)
     np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_chunked_prefill_matches_stepped_decode():
+    """One prefill forward over the prompt must leave the cache in the
+    same state as stepping tokens one at a time (logits at the last
+    position AND the next decode step must agree)."""
+    from polyaxon_tpu.models.generate import init_cache
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    tokens = jnp.asarray(spec.make_batch(2)["inputs"][:, :12])
+
+    # Stepped path.
+    c1 = init_cache(model, 2)
+    for i in range(12):
+        step_logits, mut = model.apply(
+            {"params": variables["params"], "cache": c1},
+            tokens[:, i:i + 1], decode=True, decode_position=i,
+            mutable=["cache"])
+        c1 = mut["cache"]
+    # Chunked path.
+    c2 = init_cache(model, 2)
+    chunk_logits, mut = model.apply(
+        {"params": variables["params"], "cache": c2},
+        tokens, decode=True, decode_position=0, mutable=["cache"])
+    c2 = mut["cache"]
+    np.testing.assert_allclose(np.asarray(chunk_logits[:, -1]),
+                               np.asarray(step_logits[:, 0]),
+                               atol=1e-4, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(c1), jax.tree.leaves(c2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+    # And the NEXT decode step agrees from either cache.
+    nxt = tokens[:, :1]
+    l1, _ = model.apply({"params": variables["params"], "cache": c1},
+                        nxt, decode=True, decode_position=12,
+                        mutable=["cache"])
+    l2, _ = model.apply({"params": variables["params"], "cache": c2},
+                        nxt, decode=True, decode_position=12,
+                        mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_generate_zero_new_tokens_returns_prompt():
+    from polyaxon_tpu.models.generate import generate
+    spec = get_model("llama-tiny")
+    model, variables = spec.init_params(batch_size=1)
+    prompt = jnp.asarray(spec.make_batch(1)["inputs"][:, :6])
+    out = generate(model, variables, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+    with pytest.raises(ValueError, match=">= 0"):
+        generate(model, variables, prompt, max_new_tokens=-1)

@@ -429,3 +429,30 @@ def test_dashboard_escapes_api_strings():
     assert '/^[a-z_]+$/.test' in page
     # Refresh self-re-arms instead of stacking intervals.
     assert "setInterval" not in page
+
+
+def test_list_runs_inlines_metrics(tmp_path):
+    """?metrics=1 returns last_metrics per run in ONE request (the
+    dashboard's anti-N+1 path)."""
+    import json as _json
+    import urllib.request
+    from polyaxon_tpu.scheduler.api import ControlPlane, make_server
+    from polyaxon_tpu.client.store import FileRunStore
+
+    store = FileRunStore(str(tmp_path))
+    r = store.create_run(name="m")
+    store.append_events(r["uuid"], "metric", "loss",
+                        [{"step": 1, "value": 1.5}])
+    server = make_server(host="127.0.0.1", port=0,
+                         plane=ControlPlane(store))
+    import threading
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        runs = _json.load(urllib.request.urlopen(
+            base + "/api/v1/runs?metrics=1"))
+        assert runs[0]["last_metrics"] == {"loss": 1.5}
+        runs = _json.load(urllib.request.urlopen(base + "/api/v1/runs"))
+        assert "last_metrics" not in runs[0]
+    finally:
+        server.shutdown()
