@@ -96,7 +96,8 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     cache = init_cache(model, b)
     out, mut = model.apply(
         {"params": variables["params"], "cache": cache},
-        prompt, decode=True, decode_position=0, mutable=["cache"])
+        prompt, decode=True, decode_position=0, last_only=True,
+        mutable=["cache"])
     cache = mut["cache"]
     rng, key = jax.random.split(rng)
     first = _sample(extract_logits(out)[:, -1], key, temperature, top_k)
@@ -126,3 +127,108 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     else:
         new = first[:, None]
     return jnp.concatenate([prompt, new.astype(jnp.int32)], axis=1)
+
+
+def generate_beam(model, variables, prompt, *, max_new_tokens: int,
+                  num_beams: int = 4, eos_id: Optional[int] = None,
+                  length_penalty: float = 1.0) -> jax.Array:
+    """Beam-search decoding (one jitted scan, KV cache tiled per beam).
+
+    Returns the highest-scoring sequence per batch row, [B, P +
+    max_new_tokens].  Scores are summed token log-probs divided by
+    ``len ** length_penalty``; finished beams (eos) freeze their score
+    and keep emitting eos.  ``num_beams=1`` is greedy search.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1; got "
+                         f"{max_new_tokens}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1; got {num_beams}")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    k = num_beams
+    max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
+    if max_pos is not None and p_len + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_position ({max_pos})")
+
+    def logprobs(out):
+        return jax.nn.log_softmax(
+            extract_logits(out)[:, -1].astype(jnp.float32), axis=-1)
+
+    # Prefill once on [B, P], then tile the cache per beam: batch is
+    # axis 1 of the stacked [layers, B, ...] cache entries (axis 0 of
+    # cache_index-like scalars is layers too, so only rank>=2 tiles).
+    cache = init_cache(model, b)
+    out, mut = model.apply(
+        {"params": variables["params"], "cache": cache},
+        prompt, decode=True, decode_position=0, last_only=True,
+        mutable=["cache"])
+    lp = logprobs(out)                                     # [B, V]
+    vocab = lp.shape[-1]
+    scores, first = jax.lax.top_k(lp, k)                   # [B, K]
+    cache = jax.tree.map(
+        lambda x: jnp.repeat(x, k, axis=1) if x.ndim >= 2 else x,
+        mut["cache"])
+    done = (first == eos_id) if eos_id is not None \
+        else jnp.zeros((b, k), bool)
+    # Per-beam GENERATED length at finish (the length-penalty
+    # denominator); unfinished beams hold the full budget.
+    fin_len = jnp.where(done, 1, max_new_tokens).astype(jnp.float32)
+
+    def step(carry, t):
+        cache, toks_prev, scores, done, fin_len = carry    # toks [B,K]
+        out, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            toks_prev.reshape(b * k, 1), decode=True,
+            decode_position=p_len + t, mutable=["cache"])
+        lp = logprobs(out).reshape(b, k, vocab)            # [B,K,V]
+        if eos_id is not None:
+            # Finished beams contribute exactly one continuation (eos
+            # at no cost) so they compete but never fork.
+            frozen = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+            lp = jnp.where(done[..., None], frozen[None, None], lp)
+        cand = scores[..., None] + lp                      # [B,K,V]
+        scores, flat = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+        parent = flat // vocab                             # [B,K]
+        tok = (flat % vocab).astype(jnp.int32)
+        flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        cache = jax.tree.map(
+            lambda x: jnp.take(x, flat_parent, axis=1)
+            if x.ndim >= 2 else x, mut["cache"])
+        done = jnp.take_along_axis(done, parent, axis=1)
+        fin_len = jnp.take_along_axis(fin_len, parent, axis=1)
+        if eos_id is not None:
+            newly = ~done & (tok == eos_id)
+            # token emitted at scan step t is generated token #t+2
+            fin_len = jnp.where(newly, jnp.float32(t + 2), fin_len)
+            done = done | newly
+        return (cache, tok, scores, done, fin_len), (tok, parent)
+
+    carry = (cache, first.astype(jnp.int32), scores, done, fin_len)
+    if max_new_tokens > 1:
+        carry, (toks, parents) = jax.lax.scan(
+            step, carry, jnp.arange(max_new_tokens - 1))
+    else:
+        toks = jnp.zeros((0, b, k), jnp.int32)
+        parents = jnp.zeros((0, b, k), jnp.int32)
+    _, _, scores, _, fin_len = carry
+
+    # Backtrack the surviving beams from last step to first.
+    def back(beam, step_t):
+        tok_t, parent_t = step_t
+        tok = jnp.take_along_axis(tok_t, beam[:, None], 1)[:, 0]
+        beam = jnp.take_along_axis(parent_t, beam[:, None], 1)[:, 0]
+        return beam, tok
+
+    best = jnp.argmax(scores / (fin_len ** length_penalty), axis=-1)
+    beam = best
+    rev = []
+    for t in range(toks.shape[0] - 1, -1, -1):
+        beam, tok = back(beam, (toks[t], parents[t]))
+        rev.append(tok)
+    first_tok = jnp.take_along_axis(first, beam[:, None], 1)[:, 0]
+    seq = jnp.stack([first_tok] + rev[::-1], axis=1) if rev else \
+        first_tok[:, None]
+    return jnp.concatenate([prompt, seq.astype(jnp.int32)], axis=1)

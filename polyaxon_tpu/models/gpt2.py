@@ -169,7 +169,8 @@ class GPT2Model(nn.Module):
         return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
 
     def __call__(self, input_ids, *, train: bool = False,
-                 decode: bool = False, decode_position=None):
+                 decode: bool = False, decode_position=None,
+                 last_only: bool = False):
         if decode and decode_position is None:
             # Unlike Llama (whose RoPE reads the per-layer cache index),
             # GPT-2's learned wpe needs the absolute position — omitting
@@ -179,4 +180,7 @@ class GPT2Model(nn.Module):
                 "position of this token; generate() supplies it)")
         x = self.embed_tokens(
             input_ids, position=decode_position if decode else None)
-        return self.head(self.run_blocks(x, decode=decode))
+        x = self.run_blocks(x, decode=decode)
+        if last_only:  # prefill: one row of logits, not [B, P, V]
+            x = x[:, -1:]
+        return self.head(x)

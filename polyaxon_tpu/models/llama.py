@@ -204,14 +204,19 @@ class LlamaModel(nn.Module):
         return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
 
     def __call__(self, input_ids, *, train: bool = False,
-                 decode: bool = False, decode_position=None):
+                 decode: bool = False, decode_position=None,
+                 last_only: bool = False):
         # decode_position is accepted for generate()'s uniform calling
         # convention; RoPE positions come from the per-layer cache
-        # index, so it is unused here.
+        # index, so it is unused here.  last_only projects ONLY the
+        # final position through the vocab head (prefill wants one
+        # row of logits, not [B, P, V]).
         if input_ids.shape[-1] > self.cfg.max_position:
             raise ValueError(
                 f"sequence length {input_ids.shape[-1]} exceeds "
                 f"max_position {self.cfg.max_position}; raise it (RoPE "
                 f"needs no new params) or shorten the batch")
-        return self.head(
-            self.run_blocks(self.embed_tokens(input_ids), decode=decode))
+        x = self.run_blocks(self.embed_tokens(input_ids), decode=decode)
+        if last_only:
+            x = x[:, -1:]
+        return self.head(x)

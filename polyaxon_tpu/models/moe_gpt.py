@@ -215,7 +215,8 @@ class MoEGPTModel(nn.Module):
                                  dtype=jnp.float32, name="ln_f")
 
     def __call__(self, input_ids, *, train: bool = False,
-                 decode: bool = False, decode_position=None):
+                 decode: bool = False, decode_position=None,
+                 last_only: bool = False):
         if decode and decode_position is None:
             raise ValueError(
                 "MoE-GPT decode needs decode_position (learned wpe; "
@@ -228,6 +229,8 @@ class MoEGPTModel(nn.Module):
         x = constrain(x, BATCH, None, None)
         (x, aux), _ = self.h((x, jnp.zeros((), jnp.float32)),
                              decode or None)
+        if last_only:  # prefill: one row of logits, not [B, P, V]
+            x = x[:, -1:]
         x = self.ln_f(x)
         logits = self.wte.attend(x.astype(self.cfg.dtype))
         logits = constrain(logits.astype(jnp.float32), BATCH, None, "tp")

@@ -195,14 +195,21 @@ async function showDetail(u) {
   }
 }
 
-// Self-re-arming: the next cycle starts 5 s after the previous one
-// FINISHES (never stacking), and hidden tabs stop polling entirely.
+// Self-re-arming + an inflight guard: the next cycle starts 5 s
+// after the previous one FINISHES, hidden tabs stop polling, and the
+// visibility kick can never overlap a running refresh.
+let inflight = false;
+async function refreshOnce() {
+  if (inflight) return;
+  inflight = true;
+  try { await refresh(); } finally { inflight = false; }
+}
 (async function loop() {
-  if (!document.hidden) await refresh();
+  if (!document.hidden) await refreshOnce();
   setTimeout(loop, 5000);
 })();
 document.addEventListener("visibilitychange", () => {
-  if (!document.hidden) refresh();
+  if (!document.hidden) refreshOnce();
 });
 </script></body></html>
 """
