@@ -294,10 +294,12 @@ def main() -> int:
     for name in models:
         # gpt2-medium: batch 4 is both the fastest measured config and
         # the largest whose no-remat backward the one-chip tunnel's
-        # compile helper accepts (see GPT2Config.remat for bigger).
+        # compile helper accepts (see GPT2Config.remat for bigger);
+        # tinyllama at seq 2048 needs a small batch for the same reason
+        # (plus f32 optimizer state for 1.1B params on a 16 GB chip).
         batch = args.batch or (
-            {"resnet50": 128, "gpt2-medium": 4, "bert-base": 16}.get(
-                name, 16) if on_accel else 8)
+            {"resnet50": 128, "gpt2-medium": 4, "bert-base": 16,
+             "tinyllama-1.1b": 2}.get(name, 16) if on_accel else 8)
         try:
             r = bench_model(jax, name, batch, args.steps, args.warmup,
                             backend)
