@@ -51,13 +51,17 @@ def chip_peak_flops(device) -> float | None:
     return 197e12  # unknown TPU: assume v5e-class (the BASELINE target)
 
 
-def probe_backend(timeout: float) -> str | None:
+def probe_backend(timeout: float):
     """Ask a SUBPROCESS which backend initializes.
 
     A wedged axon tunnel makes jax.devices() hang forever (not raise),
     so the probe must be out-of-process with a deadline.  A hung probe
-    is abandoned, never killed: killing a process mid-TPU-init can wedge
-    the tunnel for every later process (round-1 lesson).
+    is abandoned, never killed: killing a process mid-TPU-init can
+    wedge the tunnel for every later process (round-1 lesson).
+    Returns ``(backend_or_None, hung_proc_or_None)`` — the caller keeps
+    polling abandoned probes instead of stacking new ones (concurrent
+    init attempts are the wedge-spreading hazard), and a hung probe
+    that finally answers is the tunnel-recovery signal.
     """
     import subprocess
 
@@ -69,40 +73,90 @@ def probe_backend(timeout: float) -> str | None:
             start_new_session=True, text=True)
         out, _ = proc.communicate(timeout=timeout)
         if proc.returncode == 0 and out.strip():
-            return out.strip().splitlines()[-1]
-        return None
+            return out.strip().splitlines()[-1], None
+        return None, None
     except subprocess.TimeoutExpired:
         print("# backend probe timed out (tunnel wedged?); leaving the "
               "probe to finish on its own", file=sys.stderr)
-        return None  # deliberately NOT killed
+        return None, proc  # deliberately NOT killed
+    except Exception:
+        return None, None
+
+
+def _reap_probe(proc) -> str | None:
+    """Non-blocking check of an abandoned probe; returns its backend if
+    it finally exited cleanly.  Must use communicate(), not
+    stdout.read(): the timed-out communicate() in probe_backend already
+    drained the pipe into the Popen's internal buffer, and only a
+    second communicate() returns those bytes."""
+    if proc.poll() is None:
+        return None
+    try:
+        out, _ = proc.communicate(timeout=5)
     except Exception:
         return None
+    if proc.returncode == 0 and out and out.strip():
+        return out.strip().splitlines()[-1]
+    return None
 
 
-def init_backend(force_cpu: bool, retry_delay: float = 20.0,
-                 probe_timeout: float = 90.0):
+def init_backend(force_cpu: bool, probe_timeout: float = 90.0,
+                 probe_budget: float = 1500.0,
+                 probe_interval: float = 45.0):
     """Return (jax, backend_name, fallback?) without ever raising.
 
     The axon TPU tunnel can be unavailable (raise) or wedged (hang) when
-    the driver runs the bench (BENCH_r01 died on the former); both must
-    degrade to CPU, not crash.  JAX_PLATFORMS env is ignored by the
-    tunnel plugin — only the live config update reliably forces CPU.
+    the driver runs the bench (BENCH_r01 died on the former; BENCH_r02
+    fell back after only ~3.5 min while the outage lasted hours —
+    VERDICT r2 weak #2).  So the probe loop now spends a real time
+    BUDGET (default 25 min, override via --probe-budget or
+    $BENCH_PROBE_BUDGET) re-probing until the tunnel answers "tpu",
+    falling back to CPU only when the budget is exhausted: the cost of a
+    fallback artifact is an entire round's perf evidence.  A probe that
+    answers "cpu" means the tunnel is hard down (the plugin failed fast)
+    — still worth re-probing; a hung probe means wedged (abandoned, not
+    killed: killing mid-TPU-init can spread the wedge).
     """
     import jax
 
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
         return jax, "cpu", False
-    for attempt in range(2):
-        backend = probe_backend(probe_timeout)
-        if backend:
+    deadline = time.monotonic() + probe_budget
+    first = True
+    hung = []  # abandoned (wedged) probes: polled, never killed
+    while True:
+        # A hung probe that finally exits IS the recovery signal —
+        # check those before spending another subprocess.
+        backend = None
+        for proc in list(hung):
+            b = _reap_probe(proc)
+            if proc.poll() is not None:
+                hung.remove(proc)
+            if b:
+                backend = b
+        if backend is None and len(hung) < 2:
+            # Cap outstanding hung probes at 2: stacking concurrent
+            # TPU-init attempts on a wedged tunnel is the documented
+            # wedge-spreading hazard.
+            backend, hung_proc = probe_backend(probe_timeout)
+            if hung_proc is not None:
+                hung.append(hung_proc)
+        if backend in ("tpu", "gpu"):
             try:
                 return jax, jax.default_backend(), False
             except Exception as e:  # probe ok but in-process init failed
                 print(f"# backend init failed after probe: "
                       f"{type(e).__name__}", file=sys.stderr)
-        if attempt == 0:
-            time.sleep(retry_delay)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        if first:
+            print(f"# accelerator not up (probe said {backend!r}); "
+                  f"re-probing for up to {remaining:.0f}s",
+                  file=sys.stderr)
+            first = False
+        time.sleep(min(probe_interval, max(1.0, remaining)))
     try:
         jax.config.update("jax_platforms", "cpu")
         return jax, jax.default_backend(), True
@@ -111,25 +165,18 @@ def init_backend(force_cpu: bool, retry_delay: float = 20.0,
 
 
 def compile_step(step_fn, state, batch, rng):
-    """AOT-compile the train step ONCE; return (compiled, per_chip_flops).
+    """AOT-compile via TrainStep.precompile; return (flops, compile_s).
 
-    The compiled executable is installed back into the TrainStep so the
-    timed loop reuses it — lower().compile() does not share jit's cache,
-    and a second full XLA compile of gpt2-medium costs minutes on TPU.
-    cost_analysis() describes the post-SPMD per-device module, so the
-    returned FLOPs are per chip.
+    precompile installs the executable so the timed loop reuses it
+    (lower().compile() does not share jit's cache, and a second full XLA
+    compile of gpt2-medium costs minutes on TPU).  cost_analysis()
+    describes the post-SPMD per-device module, so the returned FLOPs are
+    per chip.
     """
     flops = None
     compile_s = None
     try:
-        from polyaxon_tpu.parallel import ambient_mesh
-
-        jitted = step_fn._build()
-        t0 = time.perf_counter()
-        with ambient_mesh(step_fn.mesh):  # activation constraints trace
-            compiled = jitted.lower(state, batch, rng).compile()
-        compile_s = time.perf_counter() - t0  # trace + XLA compile
-        step_fn._step = compiled  # reuse: same shapes, same donation
+        compiled, compile_s = step_fn.precompile(state, batch, rng)
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
@@ -141,7 +188,8 @@ def compile_step(step_fn, state, batch, rng):
 
 
 def bench_model(jax, model_name: str, batch_size: int, steps: int,
-                warmup: int, backend: str):
+                warmup: int, backend: str, overrides=None, variant=None,
+                optimizer=None):
     import optax
 
     from polyaxon_tpu.models.registry import get_model
@@ -151,9 +199,9 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
     mesh = build_mesh(MeshSpec(dp=-1))
     n_chips = mesh.devices.size
 
-    model, params = spec.init_params(batch_size=2)
+    model, params = spec.init_params(batch_size=2, **(overrides or {}))
     step = make_train_step(spec.loss_fn(model),
-                           optax.sgd(0.1, momentum=0.9), mesh)
+                           optimizer or optax.sgd(0.1, momentum=0.9), mesh)
     state = step.init_state(params)
     batch = spec.make_batch(batch_size)
     batch = jax.device_put(batch, step.batch_sharding)
@@ -203,6 +251,7 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
         "model": model_name,
         "backend": backend,
         "batch": batch_size,
+        **({"variant": variant} if variant else {}),
         "n_chips": n_chips,
         "sec_per_step": round(sec_per_step, 5),
         "per_sec_per_chip": round(per_sec / n_chips, 2),
@@ -234,28 +283,71 @@ def load_baseline():
         return {}
 
 
+def last_tpu_row():
+    """Newest current-regime TPU evidence from benchmarks/results.jsonl.
+
+    A CPU-fallback artifact must still carry dated TPU evidence (VERDICT
+    r2 weak #1): the newest headline row with backend "tpu" AND a
+    flops_src field (rows without it predate the analytic-MFU regime).
+    """
+    path = os.path.join(os.path.dirname(__file__) or ".",
+                        "benchmarks", "results.jsonl")
+    best = None
+    try:
+        with open(path) as f:
+            for raw in f:
+                try:
+                    row = json.loads(raw)
+                except ValueError:
+                    continue
+                if (row.get("bench") == "headline"
+                        and row.get("backend") == "tpu"
+                        and not row.get("superseded_by")):
+                    # Prefer the headline model (BASELINE's north-star
+                    # is ResNet-50 img/sec/chip), then current-regime
+                    # rows (flops_src marks the analytic-MFU
+                    # numerator), newest first.
+                    rank = (row.get("model") == "resnet50",
+                            bool(row.get("flops_src")), row.get("ts", 0))
+                    if best is None or rank >= best["_rank"]:
+                        best = {**row, "_rank": rank}
+    except OSError:
+        return None
+    if best is None:
+        return None
+    return {k: best.get(k) for k in
+            ("model", "batch", "per_sec_per_chip", "unit", "mfu",
+             "sec_per_step", "ts")}
+
+
 def emit(result, fallback: bool) -> None:
     baseline = load_baseline()
-    vs = 1.0
-    if result:
-        key = f"{result['model']}:{result['backend']}"
-        if baseline.get(key):
-            vs = result["per_sec_per_chip"] / baseline[key]
     if result is None:
         line = {"metric": "bench unavailable", "value": 0,
-                "unit": "", "vs_baseline": 0}
-    else:
-        backend = "cpu-fallback" if fallback else result["backend"]
-        line = {
-            "metric": (f"{result['model']} {result['unit']} "
-                       f"({backend}, batch {result['batch']})"),
-            "value": result["per_sec_per_chip"],
-            "unit": result["unit"],
-            "vs_baseline": round(vs, 4),
-            "mfu": result["mfu"],
-            "backend": backend,
-            "sec_per_step": result["sec_per_step"],
-        }
+                "unit": "", "vs_baseline": None, "backend": "none",
+                "last_tpu": last_tpu_row()}
+        print(json.dumps(line))
+        return
+    backend = "cpu-fallback" if fallback else result["backend"]
+    # vs_baseline only means something measured against the committed
+    # TPU baseline on the TPU backend; a fallback run must NOT report
+    # parity (r2's degraded run published 1.0 — VERDICT weak #1).
+    vs = None
+    key = f"{result['model']}:{result['backend']}"
+    if not fallback and baseline.get(key):
+        vs = round(result["per_sec_per_chip"] / baseline[key], 4)
+    line = {
+        "metric": (f"{result['model']} {result['unit']} "
+                   f"({backend}, batch {result['batch']})"),
+        "value": result["per_sec_per_chip"],
+        "unit": result["unit"],
+        "vs_baseline": vs,
+        "mfu": result["mfu"],
+        "backend": backend,
+        "sec_per_step": result["sec_per_step"],
+    }
+    if fallback:
+        line["last_tpu"] = last_tpu_row()
     print(json.dumps(line))
 
 
@@ -272,12 +364,18 @@ def main() -> int:
                         help="Force the CPU backend (the TPU-tunnel "
                              "plugin ignores JAX_PLATFORMS).")
     parser.add_argument("--probe-timeout", type=float, default=90.0,
-                        help="Seconds before declaring the accelerator "
-                             "backend wedged.")
+                        help="Seconds before declaring one probe wedged.")
+    parser.add_argument(
+        "--probe-budget", type=float,
+        default=float(os.environ.get("BENCH_PROBE_BUDGET", 1500.0)),
+        help="Total seconds to keep re-probing a down/wedged tunnel "
+             "before falling back to CPU (the r2 outage outlasted a "
+             "3.5-minute retry; a fallback costs a round of evidence).")
     args = parser.parse_args()
 
     jax, backend, fallback = init_backend(args.cpu,
-                                          probe_timeout=args.probe_timeout)
+                                          probe_timeout=args.probe_timeout,
+                                          probe_budget=args.probe_budget)
     if backend == "none":
         emit(None, True)
         return 0

@@ -1,0 +1,128 @@
+"""Decode/serving benchmark (VERDICT r2 task 7).
+
+The zoo ships KV-cache decoding (greedy + beam) but nothing measured
+it.  This records, per model, a ``{"bench": "decode"}`` row with:
+
+- **tok/sec/chip** for the jitted end-to-end ``generate()`` (chunked
+  prefill + one lax.scan over positions — one compiled program, no
+  per-token dispatch; see models/generate.py).
+- **kv_cache_mb**: the stacked cache footprint at the benched batch.
+- **ttft_ms** at two prompt lengths, and their ratio: chunked prefill
+  does ONE parallel forward over the prompt, so time-to-first-token
+  must grow sublinearly in prompt length (the sequential-decode
+  alternative is exactly linear in wall time).  ``ttft_ratio`` <
+  len_ratio is the pass criterion recorded with the row.
+
+Run: python benchmarks/bench_decode.py [--models gpt2-medium,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench as B  # noqa: E402
+
+RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
+
+# model -> (batch, prompt_len, new_tokens, ttft_prompts)
+CONFIGS = {
+    "gpt2-medium": (8, 128, 256, (128, 512)),
+    "tinyllama-1.1b": (8, 128, 256, (128, 1024)),
+    "gpt2-tiny": (4, 16, 32, (8, 32)),  # CI-sized smoke config
+}
+
+
+def bench_decode(jax, model_name: str, backend: str):
+    import numpy as np
+
+    from polyaxon_tpu.models.generate import generate, init_cache
+    from polyaxon_tpu.models.registry import get_model
+
+    batch, p_len, new_toks, ttft_lens = CONFIGS[model_name]
+    spec = get_model(model_name)
+    model, variables = spec.init_params(batch_size=1)
+    vocab = model.cfg.vocab_size
+    rng = np.random.RandomState(0)
+
+    cache_shapes = jax.eval_shape(lambda: init_cache(model, batch))
+    kv_bytes = sum(x.size * x.dtype.itemsize
+                   for x in jax.tree.leaves(cache_shapes))
+
+    def timed(fn, *args):
+        out = fn(*args)          # compile + run
+        jax.device_get(out)      # tunnel-safe sync (bench.py rationale)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.device_get(out)
+        return time.perf_counter() - t0
+
+    gen = jax.jit(lambda p: generate(model, variables, p,
+                                     max_new_tokens=new_toks))
+    prompt = rng.randint(0, vocab, size=(batch, p_len)).astype("int32")
+    total_s = timed(gen, prompt)
+    tok_per_sec = batch * new_toks / total_s
+
+    # TTFT = prefill + first sampled token (max_new_tokens=1).
+    ttft = {}
+    for L in ttft_lens:
+        first = jax.jit(lambda p: generate(model, variables, p,
+                                           max_new_tokens=1))
+        pr = rng.randint(0, vocab, size=(batch, L)).astype("int32")
+        ttft[L] = timed(first, pr)
+    l_small, l_big = ttft_lens
+    ratio = ttft[l_big] / ttft[l_small]
+
+    return {
+        "model": model_name,
+        "backend": backend,
+        "batch": batch,
+        "prompt_len": p_len,
+        "new_tokens": new_toks,
+        "tok_per_sec_per_chip": round(tok_per_sec, 1),
+        "decode_ms_per_token": round(1000 * total_s / new_toks, 3),
+        "kv_cache_mb": round(kv_bytes / 2**20, 1),
+        "ttft_ms": {str(k): round(v * 1e3, 1) for k, v in ttft.items()},
+        "ttft_ratio": round(ratio, 2),
+        "ttft_len_ratio": round(l_big / l_small, 2),
+        "ttft_sublinear": bool(ratio < l_big / l_small),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--models", default="gpt2-medium,tinyllama-1.1b")
+    parser.add_argument("--probe-budget", type=float, default=300.0)
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+
+    jax, backend, fallback = B.init_backend(
+        args.cpu, probe_budget=args.probe_budget)
+    if fallback:
+        print(json.dumps({"bench": "decode",
+                          "skipped": f"backend={backend}"}))
+        return 0
+
+    for name in args.models.split(","):
+        name = name.strip()
+        try:
+            r = bench_decode(jax, name, backend)
+        except Exception as e:
+            print(f"# decode {name} failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+            continue
+        row = {"bench": "decode", "ts": time.time(), **r}
+        print(json.dumps(row))
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

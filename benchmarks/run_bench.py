@@ -105,6 +105,11 @@ def bench_scaling(name: str, per_chip_batch=8, steps=10):
         if base is None:
             base = r["throughput_per_chip"]
         r["bench"] = "scaling"
+        if r["backend"] == "cpu" and n > 1:
+            # N virtual devices time-slicing ONE host CPU measure
+            # process contention, not the framework (VERDICT r2
+            # weak #4) — machine-tag so nobody greps these as perf.
+            r["regime"] = "cpu-contention"
         r["scaling_efficiency"] = round(
             r["throughput_per_chip"] / base, 4) if base else None
         results.append(r)
@@ -146,6 +151,10 @@ def bench_attention(seq_lengths=(1024, 2048, 4096), heads=8, dim=64,
         out.append({
             "bench": "attention",
             "backend": jax.default_backend(),
+            # sp > 1 on virtual CPU devices measures host contention,
+            # not collective overlap (VERDICT r2 weak #4).
+            **({"regime": "cpu-contention"}
+               if jax.default_backend() == "cpu" and sp > 1 else {}),
             "seq": seq, "sp": int(mesh.shape["sp"]),
             "full_ms": round(full * 1e3, 3),
             "ring_ms": round(ring * 1e3, 3),

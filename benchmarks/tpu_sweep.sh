@@ -1,0 +1,23 @@
+#!/bin/bash
+# TPU sweep run by tunnel_watch.py the moment the tunnel answers.
+# Keep FAST things first: the tunnel died mid-round in r2, so the order
+# is (1) headline rows, (2) resnet MFU sweep, (3) decode rows.
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. Fresh current-regime headline rows (gpt2-medium, bert-base, resnet50).
+timeout 2400 python bench.py --all --probe-timeout 60 --probe-budget 120 || true
+
+# 2. tinyllama row (slow compile; separate so a hang doesn't kill row 1).
+timeout 2400 python bench.py --model tinyllama-1.1b --steps 10 --probe-budget 120 || true
+
+# 3. ResNet-50 MFU sweep: batch x variants (VERDICT r2 task 2).
+timeout 3600 python benchmarks/bench_resnet_mfu.py || true
+
+# 4. Decode/serving rows (VERDICT r2 task 7).
+timeout 2400 python benchmarks/bench_decode.py || true
+
+# 5. Windowed-attention O(W) remap A/B (VERDICT r2 task 4).
+timeout 2400 python benchmarks/bench_windowed.py || true
+
+echo "SWEEP COMPLETE $(date)"

@@ -147,6 +147,19 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     k = num_beams
+    if not getattr(getattr(model, "cfg", None), "scan_layers", True):
+        # The per-beam tile (jnp.repeat axis=1) and parent reorder
+        # (jnp.take axis=1) below address the BATCH axis of the
+        # scan-stacked [layers, B, S, ...] cache.  With unstacked
+        # layers the cache entries are [B, S, ...] — axis 1 is the
+        # POSITION axis, and the reorder would silently permute
+        # positions into garbage output (ADVICE r2).
+        raise NotImplementedError(
+            "generate_beam requires a scan-stacked cache "
+            "(cfg.scan_layers=True); with scan_layers=False the beam "
+            "reorder would gather the position axis instead of beams. "
+            "Use greedy generate(), or a scan_layers build of the "
+            "model.")
     max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
     if max_pos is not None and p_len + max_new_tokens > max_pos:
         raise ValueError(

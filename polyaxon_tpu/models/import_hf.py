@@ -139,17 +139,37 @@ def export_hf_gpt2(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
 
 def load_hf_llama(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
     """HF ``LlamaForCausalLM.state_dict()`` -> ``{"params": ...}`` for
-    :class:`~polyaxon_tpu.models.llama.LlamaModel` (scan_layers=True,
-    tie_embeddings=False)."""
+    :class:`~polyaxon_tpu.models.llama.LlamaModel` (scan_layers=True).
+
+    Checkpoints saved with ``tie_word_embeddings=True`` omit
+    ``lm_head.weight`` (it aliases ``embed_tokens``) — many small
+    Llama-family models tie.  With ``cfg.tie_embeddings=True`` the model
+    has no lm_head param (it uses ``embed.attend``); with an untied cfg
+    the embedding table is used as the head weight, which reproduces the
+    tied checkpoint's logits exactly (ADVICE r2).
+    """
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    embed = _np(sd["embed_tokens.weight"])
     params = {
-        "embed": {"embedding": jnp.asarray(_np(sd["embed_tokens.weight"]))},
+        "embed": {"embedding": jnp.asarray(embed)},
         "h": {"block": _load_blocks(sd, _LLAMA_LAYERS, "layers.{i}",
                                     cfg.num_layers)},
         "final_norm": {"scale": jnp.asarray(_np(sd["norm.weight"]))},
-        "lm_head": {"kernel": jnp.asarray(
-            _np(state_dict["lm_head.weight"]).T)},
     }
+    if not cfg.tie_embeddings:
+        head = state_dict.get("lm_head.weight")
+        head = embed if head is None else _np(head)  # tied checkpoint
+        params["lm_head"] = {"kernel": jnp.asarray(head.T)}
+    elif "lm_head.weight" in state_dict:
+        # torch state_dicts of tied models still carry lm_head.weight
+        # as an alias of the embedding; only a head that actually
+        # DIFFERS is untied, and silently dropping it would change
+        # logits — refuse loudly.
+        head = _np(state_dict["lm_head.weight"])
+        if head.shape != embed.shape or not np.array_equal(head, embed):
+            raise ValueError(
+                "cfg.tie_embeddings=True but the checkpoint has an "
+                "untied lm_head.weight; load with tie_embeddings=False")
     return {"params": params}
 
 

@@ -63,13 +63,19 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # BN compute/output dtype.  f32 is the safe default; bf16 keeps the
+    # normalize-scale-shift chain in the conv's dtype so XLA can fuse it
+    # into the convolution epilogue without a widen/narrow pair (a
+    # bandwidth knob the MFU sweep measures).  Statistics accumulation
+    # stays f32 either way (flax computes mean/var in f32).
+    norm_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         conv = functools.partial(nn.Conv, padding="SAME")
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=jnp.float32)
+            epsilon=1e-5, dtype=self.norm_dtype)
         x = x.astype(self.dtype)
         x = conv(self.width, (7, 7), (2, 2), use_bias=False,
                  dtype=self.dtype, name="stem_conv")(x)

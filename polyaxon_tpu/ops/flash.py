@@ -83,17 +83,43 @@ def _pick_block(seq: int, cap: int) -> int:
     return best
 
 
+def _win_tiles(span: int, block: int, total: int) -> int:
+    """#blocks of size ``block`` that can intersect ANY contiguous span
+    of ``span`` positions (unaligned), capped at ``total``."""
+    return min(total, (span - 1) // block + 2)
+
+
+def _kv_base(iq, block_q, block_kv, q_shift, window, n_kv, n_vis):
+    """First kv block the remapped grid visits for q-block ``iq``: the
+    tile holding position q_lo - window, clamped so the n_vis-tile
+    visit window stays inside [0, n_kv)."""
+    first = (iq * block_q + q_shift - window) // block_kv
+    return jnp.clip(first, 0, n_kv - n_vis)
+
+
+def _q_base(ikv, block_q, block_kv, q_shift, window, n_q, n_vis):
+    """dkv twin of :func:`_kv_base`: for kv-block ``ikv`` the needed q
+    blocks span global positions [kv_lo, kv_hi + window]; the first is
+    the q tile whose last row reaches kv_lo."""
+    first = (ikv * block_kv - q_shift) // block_q
+    return jnp.clip(first, 0, n_q - n_vis)
+
+
 def _block_needed(iq, ikv, block_q, block_kv, q_shift, causal: bool,
                   window: int):
     """Does (q-block iq, kv-block ikv) contain any unmasked position?
 
     Causal skips blocks entirely in the future; a sliding window
     (``window`` > 0: position i attends to [i-window, i]) additionally
-    skips blocks entirely in the past.  The skip removes the MXU work
-    (the dominant cost) — the grid still visits every (iq, ikv) pair
-    and the BlockSpec pipeline still DMAs each K/V tile, so HBM
-    traffic remains O(S^2/block); remapping the kv grid dimension per
-    q-block is future work.
+    skips blocks entirely in the past.  The skip removes the MXU work;
+    for causal windowed calls the kv grid axis is ALSO remapped to the
+    ceil(W/block)+2 tiles that can intersect the window (_kv_base /
+    _q_base), so the BlockSpec pipeline only DMAs O(W) KV bytes per q
+    block instead of O(S) — the check here still guards the clamped
+    boundary tiles the remap over-visits near the sequence edges.
+    (Non-causal windowed calls — ring's boundary rotations — keep the
+    full grid: without the causal upper bound the needed kv range is
+    unbounded above.)
     """
     q_lo = iq * block_q + q_shift
     q_hi = q_lo + block_q - 1
@@ -127,7 +153,7 @@ def _block_ids(iq, ikv, block_q, block_kv, q_shift):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
                 block_q: int, block_kv: int, q_shift: int,
-                padded: bool = False, window=None):
+                padded: bool = False, window=None, n_kv_total=None):
     # Optional key-padding mask rides as a 4th input ref ([1, block_kv,
     # 128] f32; column 0 = 1.0 for valid keys).
     if padded:
@@ -136,10 +162,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         kvm_ref = None
         o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(2)
-    ikv = pl.program_id(3)
+    j = pl.program_id(3)   # grid index along the (possibly remapped) axis
     n_kv = pl.num_programs(3)
+    ikv = j
+    if n_kv_total is not None:
+        # Windowed remap: grid axis 3 runs over the visited tiles only;
+        # recover the TRUE kv block index for the mask math (must match
+        # the BlockSpec index_map exactly).  Init/finalize stay on the
+        # grid index j — the scratch accumulator lifecycle follows grid
+        # execution order, not kv position.
+        ikv = _kv_base(iq, block_q, block_kv, q_shift, window,
+                       n_kv_total, n_kv) + j
 
-    @pl.when(ikv == 0)
+    @pl.when(j == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
@@ -185,7 +220,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal: bool, scale: float,
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(ikv == n_kv - 1)
+    @pl.when(j == n_kv - 1)
     def _finalize():
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
@@ -216,25 +251,44 @@ def _flash_forward(q, k, v, kvm, causal: bool, scale: float,
             f"ops.dot_product_attention for ragged shapes.")
     block_q = _pick_block(sq, BLOCK_Q)
     block_kv = _pick_block(sk, BLOCK_KV)
-    grid = (batch, heads, sq // block_q, sk // block_kv)
+    q_shift = sk - sq
+    n_kv = sk // block_kv
+    # Causal windowed: remap the kv grid axis to the O(W) tiles that
+    # can intersect [q_lo - window, q_hi] — HBM traffic per q block
+    # drops from O(S) to O(W) (VERDICT r2 task 4).  The env switch
+    # exists for A/B benchmarking of the remap itself.
+    remap = (window is not None and window > 0 and causal
+             and not os.environ.get("POLYAXON_TPU_FLASH_NO_REMAP"))
+    n_vis = _win_tiles(window + block_q, block_kv, n_kv) if remap \
+        else n_kv
+    if n_vis == n_kv:
+        remap = False
+    grid = (batch, heads, sq // block_q, n_vis)
     padded = kvm is not None
+
+    def kv_block(i, j):
+        if not remap:
+            return j
+        return _kv_base(i, block_q, block_kv, q_shift, window,
+                        n_kv, n_vis) + j
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_kv=block_kv, q_shift=sk - sq, padded=padded,
-        window=window)
+        block_kv=block_kv, q_shift=q_shift, padded=padded,
+        window=window, n_kv_total=n_kv if remap else None)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
                      lambda b, h, i, j: (b, h, i, 0)),
         pl.BlockSpec((1, 1, block_kv, d),
-                     lambda b, h, i, j: (b, h, j, 0)),
+                     lambda b, h, i, j: (b, h, kv_block(i, j), 0)),
         pl.BlockSpec((1, 1, block_kv, d),
-                     lambda b, h, i, j: (b, h, j, 0)),
+                     lambda b, h, i, j: (b, h, kv_block(i, j), 0)),
     ]
     inputs = [q, k, v]
     if padded:
         in_specs.append(pl.BlockSpec((1, block_kv, 128),
-                                     lambda b, h, i, j: (b, j, 0)))
+                                     lambda b, h, i, j: (b, kv_block(i, j),
+                                                         0)))
         inputs.append(kvm)
     out, lse = pl.pallas_call(
         kernel,
@@ -276,17 +330,21 @@ def _flash_forward(q, k, v, kvm, causal: bool, scale: float,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, causal: bool, scale: float,
                    block_q: int, block_kv: int, q_shift: int,
-                   padded: bool = False, window=None):
+                   padded: bool = False, window=None, n_kv_total=None):
     if padded:
         kvm_ref, dq_ref, dq_acc = refs
     else:
         kvm_ref = None
         dq_ref, dq_acc = refs
     iq = pl.program_id(2)
-    ikv = pl.program_id(3)
+    j = pl.program_id(3)
     n_kv = pl.num_programs(3)
+    ikv = j
+    if n_kv_total is not None:  # windowed kv-grid remap (see _fwd_kernel)
+        ikv = _kv_base(iq, block_q, block_kv, q_shift, window,
+                       n_kv_total, n_kv) + j
 
-    @pl.when(ikv == 0)
+    @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
@@ -324,7 +382,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ikv == n_kv - 1)
+    @pl.when(j == n_kv - 1)
     def _finalize():
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
@@ -332,17 +390,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, causal: bool, scale: float, block_q: int,
                     block_kv: int, q_shift: int, padded: bool = False,
-                    window=None):
+                    window=None, n_q_total=None):
     if padded:
         kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
     else:
         kvm_ref = None
         dk_ref, dv_ref, dk_acc, dv_acc = refs
     ikv = pl.program_id(2)
-    iq = pl.program_id(3)
+    j = pl.program_id(3)
     n_q = pl.num_programs(3)
+    iq = j
+    if n_q_total is not None:  # windowed q-grid remap (dkv is kv-major)
+        iq = _q_base(ikv, block_q, block_kv, q_shift, window,
+                     n_q_total, n_q) + j
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -384,7 +446,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(iq == n_q - 1)
+    @pl.when(j == n_q - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -398,6 +460,27 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
     block_kv = _pick_block(sk, BLOCK_KV)
     q_shift = sk - sq
     padded = kvm is not None
+    n_q, n_kv = sq // block_q, sk // block_kv
+    # Windowed remap (see _flash_forward): dq visits O(W) kv tiles per
+    # q block; dkv visits O(W) q tiles per kv block.
+    remap = (window is not None and window > 0 and causal
+             and not os.environ.get("POLYAXON_TPU_FLASH_NO_REMAP"))
+    kv_vis = _win_tiles(window + block_q, block_kv, n_kv) if remap \
+        else n_kv
+    q_vis = _win_tiles(window + block_kv, block_q, n_q) if remap \
+        else n_q
+
+    def kv_block(i, j):
+        if not remap or kv_vis == n_kv:
+            return j
+        return _kv_base(i, block_q, block_kv, q_shift, window,
+                        n_kv, kv_vis) + j
+
+    def q_block(i, j):
+        if not remap or q_vis == n_q:
+            return j
+        return _q_base(i, block_q, block_kv, q_shift, window,
+                       n_q, q_vis) + j
 
     # delta = rowsum(dO * O): one fused XLA pass, [B, H, Sq, 128].
     # With an LSE cotangent (the blockwise/ring combination
@@ -411,22 +494,26 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
     delta = jnp.broadcast_to(delta, (batch, heads, sq, 128))
 
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
-    kspec = pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h, j, 0))
+    kspec = pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b, h, i, j: (b, h, kv_block(i, j), 0))
     rowspec = pl.BlockSpec((1, 1, block_q, 128),
                            lambda b, h, i, j: (b, h, i, 0))
 
     dq_in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     dq_inputs = [q, k, v, do, lse, delta]
     if padded:
-        dq_in_specs.append(pl.BlockSpec((1, block_kv, 128),
-                                        lambda b, h, i, j: (b, j, 0)))
+        dq_in_specs.append(pl.BlockSpec(
+            (1, block_kv, 128),
+            lambda b, h, i, j: (b, kv_block(i, j), 0)))
         dq_inputs.append(kvm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_kv=block_kv,
                           q_shift=q_shift, padded=padded,
-                          window=window),
-        grid=(batch, heads, sq // block_q, sk // block_kv),
+                          window=window,
+                          n_kv_total=n_kv if remap and kv_vis < n_kv
+                          else None),
+        grid=(batch, heads, n_q, kv_vis),
         in_specs=dq_in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -439,11 +526,11 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
 
     # kv-major grid: same block index maps with (i=kv block, j=q block).
     qspec_t = pl.BlockSpec((1, 1, block_q, d),
-                           lambda b, h, i, j: (b, h, j, 0))
+                           lambda b, h, i, j: (b, h, q_block(i, j), 0))
     kspec_t = pl.BlockSpec((1, 1, block_kv, d),
                            lambda b, h, i, j: (b, h, i, 0))
     rowspec_t = pl.BlockSpec((1, 1, block_q, 128),
-                             lambda b, h, i, j: (b, h, j, 0))
+                             lambda b, h, i, j: (b, h, q_block(i, j), 0))
 
     dkv_in_specs = [qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t,
                     rowspec_t]
@@ -456,8 +543,10 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_kv=block_kv,
                           q_shift=q_shift, padded=padded,
-                          window=window),
-        grid=(batch, heads, sk // block_kv, sq // block_q),
+                          window=window,
+                          n_q_total=n_q if remap and q_vis < n_q
+                          else None),
+        grid=(batch, heads, n_kv, q_vis),
         in_specs=dkv_in_specs,
         out_specs=[kspec_t, kspec_t],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -551,6 +640,8 @@ def flash_attention_lse(q, k, v, *, causal: bool = False,
     (NEG_INF on fully-masked rows, whose out-rows are zero).  This is
     the building block for blockwise/ring attention: normalized block
     outputs combine exactly via o = sum_r o_r * exp(lse_r - lse_total).
+    Same contract as :func:`flash_attention`: Sq/Sk must be multiples
+    of 128; shorter sequences use dot_product_attention.
     """
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     kvm = None if kv_mask is None else _pack_kv_mask(kv_mask, k.shape[2])
@@ -567,6 +658,13 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float = 1.0,
     ``kv_mask``: optional [B, Sk] boolean key-padding mask (True =
     attend) — the padded-batch case that used to force the O(S^2) XLA
     fallback.
+
+    CONTRACT (tightened with the 2026-07 block-size fix): Sq and Sk
+    must be multiples of 128 — the lane-width-aligned tiles the MXU
+    needs; sequences shorter than 128 are rejected with a ValueError
+    (they used to run via a shrunken block).  Short/ragged sequences
+    belong on ``ops.attention.dot_product_attention``, which is what
+    the routed ``flash_eligible`` path already falls back to.
     """
     if window is not None:
         if not causal:

@@ -142,7 +142,7 @@ def pipelined_lm_loss(model, block, mesh, *, n_micro: int = 0,
     n_stages = mesh.shape.get("pp", 1)
     if cfg.num_layers % max(n_stages, 1):
         raise ValueError(
-            f"num_layers={cfg.num_layers} must divide pp={n_stages}")
+            f"pp={n_stages} must divide num_layers={cfg.num_layers}")
     per_stage = cfg.num_layers // max(n_stages, 1)
     micro = n_micro or 2 * n_stages
 
@@ -172,6 +172,223 @@ def pipelined_lm_loss(model, block, mesh, *, n_micro: int = 0,
         logits = model.apply(params, x, method="head")
         l = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], tokens[:, 1:]).mean()
+        return l, {"perplexity": jnp.exp(l)}
+
+    return loss
+
+
+def pipelined_lm_loss_1f1b(model, block, mesh, *, n_micro: int = 0,
+                           stack_keys=("h", "block"),
+                           axis_name: str = "pp"):
+    """1F1B pipeline schedule for any scanned decoder in the zoo
+    (GPT-2, Llama) — VERDICT r2 task 5.
+
+    Why not GPipe-with-autodiff (``pipelined_lm_loss``): reversing the
+    schedule scan stores one carried activation per TICK, i.e. O(n_micro)
+    microbatch activations per stage, which caps n_micro, and the bubble
+    fraction 2(S-1)/(2(n_micro+S-1)) shrinks only as n_micro grows.
+    Here each scan tick runs ONE fwd slot and ONE bwd slot per stage
+    (the 1F1B steady state) with a MANUAL per-stage VJP: the bwd slot
+    re-runs its stage forward from a stashed stage INPUT (remat-style)
+    and accumulates param grads inside the schedule.  Live activation
+    memory per stage is the stash ring of min(2S-1, n_micro) microbatch
+    inputs — O(S), independent of n_micro — so n_micro can grow until
+    the bubble 2(S-1)/(n_micro + 2(S-1)) is negligible.
+
+    Timeline (stage s, micro i, S stages): fwd at tick i + s; the last
+    stage runs head+loss+d(head) for the micro it just forwarded in the
+    same tick; bwd at tick i + 2(S-1) - s.  Activations ppermute right,
+    cotangents ppermute left — both ride ICI in parallel with compute.
+    Total ticks: n_micro + 2(S-1).
+
+    Grads computed inside the schedule surface through a
+    ``jax.custom_vjp`` whose forward IS the combined fwd+bwd program —
+    outer ``jax.value_and_grad`` (TrainStep) works unchanged, and the
+    embedding still differentiates through the returned x_micro
+    cotangent (summing naturally with tied-head contributions).
+    Constraint like the GPipe path: pp composes with dp/fsdp batch
+    sharding; stage-internal tp is not sharded here.
+    """
+    import numpy as np
+    import optax
+    from jax import shard_map
+
+    cfg = model.cfg
+    n_stages = mesh.shape.get(axis_name, 1)
+    if cfg.num_layers % max(n_stages, 1):
+        raise ValueError(
+            f"pp={n_stages} must divide num_layers={cfg.num_layers}")
+    micro = n_micro or 2 * n_stages
+    stack_root = stack_keys[0]
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1)
+    n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes],
+                                 dtype=np.int64)) if batch_axes else 1
+    use_remat = bool(getattr(cfg, "remat", False))
+
+    def stage_fwd(stage_params, h):
+        def one_layer(h, lp):
+            return block.apply({"params": lp}, h), None
+        body = jax.checkpoint(one_layer) if use_remat else one_layer
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    def head_loss(nonstack, y, tgt):
+        logits = model.apply({"params": nonstack}, y, method="head")
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tgt[:, 1:]).mean()
+
+    def schedule(stack, nonstack, x_micro, tgt_micro):
+        """shard_map body (per pp rank): the combined fwd+bwd 1F1B
+        program.  Returns (loss_sum_local, dstack_local, dnonstack,
+        dx_micro) — reductions over pp/batch axes applied below."""
+        s = jax.lax.axis_index(axis_name)
+        is_last = s == n_stages - 1
+        m = x_micro.shape[0]
+        depth = min(2 * n_stages - 1, m)  # stash ring: O(S) not O(m)
+        right = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        left = [(j, (j - 1) % n_stages) for j in range(n_stages)]
+        # d(global mean loss)/d(loss_i) — seeds every vjp below so the
+        # accumulated grads come out exactly scaled.
+        seed = jnp.float32(1.0 / (m * n_batch_shards))
+        act_shape = x_micro.shape[1:]
+
+        def tick(carry, t):
+            act_in, grad_in, stash, dstack, dnon, dx_mic, loss_acc = carry
+            # ---- forward slot: micro i_f = t - s
+            i_f = t - s
+            active_f = (i_f >= 0) & (i_f < m)
+            i_f_c = jnp.clip(i_f, 0, m - 1)
+            inject = jax.lax.dynamic_index_in_dim(x_micro, i_f_c, 0,
+                                                  keepdims=False)
+            x_in = jnp.where(s == 0, inject, act_in)
+            y = stage_fwd(stack, x_in)
+            stash = jax.lax.cond(
+                active_f,
+                lambda b: jax.lax.dynamic_update_index_in_dim(
+                    b, x_in, i_f_c % depth, 0),
+                lambda b: b, stash)
+            # Last stage only (lax.cond: the vocab-sized head must not
+            # burn FLOPs on every stage every tick): loss + d(head) for
+            # the micro just forwarded — its bwd slot is THIS tick.
+            tgt = jax.lax.dynamic_index_in_dim(tgt_micro, i_f_c, 0,
+                                               keepdims=False)
+
+            def run_head(args):
+                nonstack_, y_, tgt_ = args
+                loss_i, head_vjp = jax.vjp(
+                    lambda p, yy: head_loss(p, yy, tgt_), nonstack_, y_)
+                dnon_i, dy = head_vjp(seed)
+                return loss_i, dnon_i, dy
+
+            def skip_head(args):
+                nonstack_, y_, _ = args
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree.map(jnp.zeros_like, nonstack_),
+                        jnp.zeros_like(y_))
+
+            loss_i, dnon_i, dy_head = jax.lax.cond(
+                is_last & active_f, run_head, skip_head,
+                (nonstack, y, tgt))
+            loss_acc = loss_acc + loss_i
+            dnon = jax.tree.map(jnp.add, dnon, dnon_i)
+            # ---- backward slot: micro i_b = t - 2(S-1) + s
+            i_b = t - 2 * (n_stages - 1) + s
+            active_b = (i_b >= 0) & (i_b < m)
+            i_b_c = jnp.clip(i_b, 0, m - 1)
+            x_stash = jax.lax.dynamic_index_in_dim(stash, i_b_c % depth,
+                                                   0, keepdims=False)
+            dy = jnp.where(is_last, dy_head, grad_in)
+            _, stage_vjp = jax.vjp(stage_fwd, stack, x_stash)
+            dp_i, dx_i = stage_vjp(dy)
+            dstack = jax.tree.map(
+                lambda a, g: a + jnp.where(active_b, g,
+                                           jnp.zeros_like(g)),
+                dstack, dp_i)
+            dx_i = jnp.where(active_b, dx_i, jnp.zeros_like(dx_i))
+            dx_mic = jax.lax.cond(
+                active_b & (s == 0),
+                lambda d: jax.lax.dynamic_update_index_in_dim(
+                    d, dx_i.astype(d.dtype), i_b_c, 0),
+                lambda d: d, dx_mic)
+            # ---- communicate: activations right, cotangents left.
+            act_next = jax.lax.ppermute(y, axis_name, right)
+            grad_next = jax.lax.ppermute(dx_i, axis_name, left)
+            return (act_next, grad_next, stash, dstack, dnon, dx_mic,
+                    loss_acc), None
+
+        carry = (
+            jnp.zeros(act_shape, x_micro.dtype),
+            jnp.zeros(act_shape, x_micro.dtype),
+            jnp.zeros((depth,) + act_shape, x_micro.dtype),
+            jax.tree.map(jnp.zeros_like, stack),
+            jax.tree.map(jnp.zeros_like, nonstack),
+            jnp.zeros_like(x_micro),
+            jnp.zeros((), jnp.float32),
+        )
+        total = m + 2 * (n_stages - 1)
+        (_, _, _, dstack, dnon, dx_mic, loss_acc), _ = jax.lax.scan(
+            tick, carry, jnp.arange(total))
+
+        # loss/dnon live on the last stage, dx on stage 0 (zeros
+        # elsewhere) -> psum over pp; grads sum over batch shards; the
+        # loss averages over them (each shard saw different data).
+        loss = jax.lax.psum(loss_acc, axis_name) / m
+        if batch_axes:
+            loss = jax.lax.pmean(loss, batch_axes)
+            dnon = jax.tree.map(
+                lambda g: jax.lax.psum(g, batch_axes), dnon)
+            dstack = jax.tree.map(
+                lambda g: jax.lax.psum(g, batch_axes), dstack)
+        dnon = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), dnon)
+        dx_mic = jax.lax.psum(dx_mic, axis_name)
+        return loss, dstack, dnon, dx_mic
+
+    def run_schedule(stack, nonstack, x_micro, tgt_micro):
+        bspec = active_batch_axes(mesh, ("dp", "fsdp"))
+        stack_spec = jax.tree.map(lambda _: P(axis_name), stack)
+        non_spec = jax.tree.map(lambda _: P(), nonstack)
+        return shard_map(
+            schedule, mesh=mesh,
+            in_specs=(stack_spec, non_spec, P(None, bspec),
+                      P(None, bspec)),
+            out_specs=(P(), stack_spec, non_spec, P(None, bspec)),
+            check_vma=False,
+        )(stack, nonstack, x_micro, tgt_micro)
+
+    @jax.custom_vjp
+    def sched(stack, nonstack, x_micro, tgt_micro):
+        return run_schedule(stack, nonstack, x_micro, tgt_micro)[0]
+
+    def sched_fwd(stack, nonstack, x_micro, tgt_micro):
+        loss, dstack, dnon, dx = run_schedule(stack, nonstack, x_micro,
+                                              tgt_micro)
+        return loss, (dstack, dnon, dx)
+
+    def sched_bwd(res, g):
+        dstack, dnon, dx = res
+        return (jax.tree.map(lambda v: v * g, dstack),
+                jax.tree.map(lambda v: v * g, dnon),
+                dx * g, None)
+
+    sched.defvjp(sched_fwd, sched_bwd)
+
+    def loss(params, batch, rng):
+        tokens = batch["inputs"]
+        b = tokens.shape[0]
+        if b % micro:
+            raise ValueError(
+                f"batch {b} must divide into {micro} microbatches")
+        mb = b // micro
+        x = model.apply(params, tokens, method="embed_tokens")
+        x_micro = x.astype(cfg.dtype).reshape((micro, mb) + x.shape[1:])
+        tgt_micro = tokens.reshape((micro, mb) + tokens.shape[1:])
+        nonstack = {k: v for k, v in params["params"].items()
+                    if k != stack_root}
+        stack = params["params"][stack_root]
+        for key in stack_keys[1:]:
+            stack = stack[key]
+        l = sched(stack, nonstack, x_micro, tgt_micro)
         return l, {"perplexity": jnp.exp(l)}
 
     return loss

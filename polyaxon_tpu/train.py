@@ -21,6 +21,7 @@ into the same path.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import os
 import sys
@@ -196,8 +197,15 @@ def make_eval_fn(model, mesh, batch_sharding):
     return evaluate
 
 
+# --strategy keys whose values are selectors, not mesh-axis sizes.
+_STRATEGY_STR_KEYS = ("pp_schedule",)
+
+
 def parse_strategy(raw):
-    """``--strategy`` accepts JSON or ``axis:size[,axis:size...]``."""
+    """``--strategy`` accepts JSON or ``axis:size[,axis:size...]``.
+
+    Values parse as ints except the selector keys (e.g.
+    ``pp:2,pp_schedule:gpipe``), which stay strings."""
     if not raw:
         return {}
     try:
@@ -220,8 +228,12 @@ def parse_strategy(raw):
                 f"--strategy: cannot parse {raw!r}; use JSON "
                 '(\'{"dp": 2, "ep": 4}\') or "dp:2,ep:4"')
         name, _, value = part.partition(sep)
+        name = name.strip()
+        if name in _STRATEGY_STR_KEYS:
+            out[name] = value.strip()
+            continue
         try:
-            out[name.strip()] = int(value)
+            out[name] = int(value)
         except ValueError:
             raise SystemExit(
                 f"--strategy: axis size {value!r} is not an integer "
@@ -305,7 +317,9 @@ def _main(argv=None) -> int:
     # compact axis list ("dp:2,ep:4" / "dp=2,ep=4").
     strategy_raw = args.strategy or os.environ.get("PTPU_STRATEGY")
     strategy = parse_strategy(strategy_raw)
-    mesh = build_mesh(MeshSpec.from_dict(strategy))
+    # pp_schedule is a schedule selector (1f1b | gpipe), not a mesh axis.
+    mesh = build_mesh(MeshSpec.from_dict(
+        {k: v for k, v in strategy.items() if k != "pp_schedule"}))
     n_chips = mesh.devices.size
 
     # sp > 1: route every model's attention through ring/Ulysses
@@ -336,16 +350,32 @@ def _main(argv=None) -> int:
     loss_fn = spec.loss_fn(model)
     if mesh.shape.get("pp", 1) > 1:
         # strategy {pp: N}: route the block stack through the
-        # collective-permute pipeline (VERDICT r1 #5).
+        # collective-permute pipeline (VERDICT r1 #5).  Default
+        # schedule is 1F1B (O(stages) activation memory via in-schedule
+        # VJP — VERDICT r2 task 5); {pp_schedule: gpipe} selects the
+        # autodiff GPipe scan.
         from .models.gpt2 import GPT2Block, GPT2Model
-        from .parallel.pipeline import pipelined_lm_loss
+        from .models.llama import LlamaBlock, LlamaModel
+        from .parallel.pipeline import (pipelined_lm_loss,
+                                        pipelined_lm_loss_1f1b)
 
         if isinstance(model, GPT2Model) and model.cfg.scan_layers:
-            loss_fn = pipelined_lm_loss(model, GPT2Block(model.cfg), mesh)
+            pp_block = GPT2Block(model.cfg)
+        elif isinstance(model, LlamaModel) and model.cfg.scan_layers:
+            pp_block = LlamaBlock(model.cfg)
         else:
             raise SystemExit(
-                "strategy pp>1 currently supports the scanned GPT-2 "
-                f"family, not {args.model}")
+                "strategy pp>1 supports the scanned GPT-2 and Llama "
+                f"families, not {args.model}")
+        pp_sched = str(strategy.get("pp_schedule", "1f1b")).lower() \
+            if isinstance(strategy, dict) else "1f1b"
+        if pp_sched not in ("1f1b", "gpipe"):
+            raise SystemExit(
+                f"pp_schedule must be '1f1b' or 'gpipe', got "
+                f"{pp_sched!r}")
+        make_pp_loss = pipelined_lm_loss if pp_sched == "gpipe" \
+            else pipelined_lm_loss_1f1b
+        loss_fn = make_pp_loss(model, pp_block, mesh)
     step_fn = make_train_step(
         loss_fn, make_optimizer(args.optimizer, args.lr),
         mesh, grad_accum=args.grad_accum, donate=True)
@@ -401,6 +431,22 @@ def _main(argv=None) -> int:
     unit = "tok" if sample["inputs"].ndim == 2 else "img"
     per_batch = batch_size * sample["inputs"].shape[1] \
         if unit == "tok" else batch_size
+
+    # AOT-compile off the timed path so the first logged block measures
+    # steps, not trace + XLA compile (TrainStep.precompile — the
+    # supported AOT surface, VERDICT r2 weak #6).
+    first = next(batches)
+    if args.prefetch == 0:
+        first = jax.device_put(first, step_fn.batch_sharding)
+    try:
+        _, compile_s = step_fn.precompile(state, first,
+                                          jax.random.split(rng)[1])
+        run.log_metrics(step=start_step, compile_s=round(compile_s, 2))
+        print(f"compiled train step in {compile_s:.1f}s", flush=True)
+    except Exception as e:  # fall back to trace-on-first-call
+        print(f"precompile skipped ({type(e).__name__}: {e}); "
+              "first step will trace", flush=True)
+    batches = itertools.chain([first], batches)
 
     last_metrics: Dict[str, Any] = {}
     t_block = time.perf_counter()

@@ -136,3 +136,87 @@ def test_llama_export_tied_embeddings():
     sd = export_hf_llama(variables, cfg)
     np.testing.assert_array_equal(sd["lm_head.weight"],
                                   sd["model.embed_tokens.weight"])
+
+
+def test_llama_import_tied_checkpoint():
+    """Checkpoints with tie_word_embeddings=True (lm_head aliases the
+    embedding; safetensors saves drop the key entirely) must import
+    without KeyError and reproduce transformers' logits — under both a
+    tied and an untied cfg (ADVICE r2)."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_dropout=0.0, tie_word_embeddings=True)
+    torch.manual_seed(4)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    tokens = np.random.RandomState(5).randint(0, 512, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+
+    sd = hf.state_dict()
+    sd_dropped = {k: v for k, v in sd.items() if k != "lm_head.weight"}
+    for tie in (True, False):
+        cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                          intermediate_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2, max_position=128,
+                          rms_norm_eps=1e-5, tie_embeddings=tie,
+                          dtype=jnp.float32)
+        model = LlamaModel(cfg)
+        for state_dict in (sd, sd_dropped):
+            variables = load_hf_llama(state_dict, cfg)
+            ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
+            np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_import_tied_cfg_rejects_untied_head():
+    """A genuinely untied head cannot be loaded into a tied cfg —
+    dropping it would silently change logits."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=1, max_position_embeddings=32,
+        rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(6)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_layers=1, num_heads=2,
+                      num_kv_heads=1, max_position=32,
+                      tie_embeddings=True, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="untied lm_head"):
+        load_hf_llama(hf.state_dict(), cfg)
+
+
+def test_export_import_roundtrip_byte_identical():
+    """export(import(sd)) == sd array-for-array — the byte-identical
+    round-trip DESIGN.md claims (ADVICE r2: it was only claimed, never
+    tested)."""
+    import jax
+    from polyaxon_tpu.models.import_hf import (export_hf_gpt2,
+                                               export_hf_llama)
+
+    gcfg = GPT2Config(vocab_size=256, hidden_size=32, num_layers=2,
+                      num_heads=2, max_position=64, dtype=jnp.float32)
+    gmodel = GPT2Model(gcfg)
+    gvars = gmodel.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))
+    sd = export_hf_gpt2(gvars, gcfg)
+    sd2 = export_hf_gpt2(load_hf_gpt2(sd, gcfg), gcfg)
+    assert sorted(sd) == sorted(sd2)
+    for k in sd:
+        np.testing.assert_array_equal(sd[k], sd2[k], err_msg=k)
+
+    lcfg = LlamaConfig(vocab_size=256, hidden_size=32,
+                       intermediate_size=64, num_layers=2, num_heads=2,
+                       num_kv_heads=1, max_position=64,
+                       dtype=jnp.float32)
+    lmodel = LlamaModel(lcfg)
+    lvars = lmodel.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 4), jnp.int32))
+    sd = export_hf_llama(lvars, lcfg)
+    sd2 = export_hf_llama(load_hf_llama(sd, lcfg), lcfg)
+    assert sorted(sd) == sorted(sd2)
+    for k in sd:
+        np.testing.assert_array_equal(sd[k], sd2[k], err_msg=k)

@@ -369,3 +369,23 @@ def test_beam_search_jits_and_shapes():
     assert out.shape == (2, 9)
     np.testing.assert_array_equal(np.asarray(out[:, :5]),
                                   np.asarray(prompt))
+
+
+def test_beam_search_rejects_unstacked_cache():
+    """With scan_layers=False cache entries are [B, S, ...]: the beam
+    tile/reorder on axis 1 would permute POSITIONS, not beams, and
+    silently emit garbage (ADVICE r2) — it must raise instead."""
+    from polyaxon_tpu.models.generate import generate_beam
+    from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_layers=2, num_heads=2,
+                      num_kv_heads=1, max_position=32,
+                      scan_layers=False, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(NotImplementedError, match="scan-stacked"):
+        generate_beam(model, variables,
+                      jnp.zeros((1, 4), jnp.int32),
+                      max_new_tokens=3, num_beams=2)

@@ -149,3 +149,30 @@ def test_moe_generate_greedy():
     assert out.shape == (2, 10)
     np.testing.assert_array_equal(np.asarray(out[:, :6]),
                                   np.asarray(prompt))
+
+
+def test_moe_chunked_prefill_matches_full_forward():
+    """The scatter-bucketed prefill FFN (exact drop-free top-1) must
+    reproduce the full forward's logits at drop-free capacity — the
+    whole-prompt prefill path generate() runs (ADVICE r2: the old
+    dense dispatch at C = T was O(T^2 E))."""
+    import jax.numpy as jnp
+    import numpy as np
+    from polyaxon_tpu.models.generate import init_cache
+    from polyaxon_tpu.models.moe_gpt import MoEGPTConfig, MoEGPTModel
+
+    cfg = MoEGPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                       num_heads=2, num_experts=2, max_position=64,
+                       capacity_factor=8.0, dtype=jnp.float32)
+    model = MoEGPTModel(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, 256, (2, 12)))
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    full, _ = model.apply(variables, tokens)
+
+    cache = init_cache(model, 2)
+    (pre, _), _ = model.apply(
+        {"params": variables["params"], "cache": cache},
+        tokens, decode=True, decode_position=0, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(full),
+                               atol=2e-4, rtol=2e-4)

@@ -322,3 +322,99 @@ class TestTPRules:
         assert sh["mlp"]["fc1"]["kernel"].spec == (None, "tp")
         assert sh["mlp"]["fc2"]["kernel"].spec == ("tp", None)
         assert sh["ln"]["scale"].spec == (None,)
+
+
+class Test1F1B:
+    """1F1B pipeline schedule (VERDICT r2 task 5): in-schedule VJP,
+    O(stages) activation stash, grads surfaced through custom_vjp so
+    plain value_and_grad / TrainStep work unchanged."""
+
+    def _parity(self, model, block, batch_tokens, params):
+        import optax
+
+        def ref_loss(p, batch, rng):
+            logits = model.apply(p, batch["inputs"], train=True)
+            l = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], batch["inputs"][:, 1:]).mean()
+            return l
+
+        from polyaxon_tpu.parallel.pipeline import pipelined_lm_loss_1f1b
+
+        batch = {"inputs": jnp.asarray(batch_tokens)}
+        rl, rg = jax.value_and_grad(ref_loss)(params, batch, None)
+
+        mesh = local_mesh(dp=2, fsdp=2, pp=2)
+        loss_fn = pipelined_lm_loss_1f1b(model, block, mesh)
+        (pl, aux), pg = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, None)
+        np.testing.assert_allclose(float(rl), float(pl), atol=2e-5)
+        ref_flat = jax.tree_util.tree_leaves_with_path(rg)
+        pp_flat = {jax.tree_util.keystr(k): v for k, v in
+                   jax.tree_util.tree_leaves_with_path(pg)}
+        for k, v in ref_flat:
+            w = pp_flat[jax.tree_util.keystr(k)]
+            denom = float(jnp.abs(v).max()) + 1e-8
+            np.testing.assert_allclose(
+                np.asarray(w), np.asarray(v), atol=3e-4 * denom,
+                err_msg=jax.tree_util.keystr(k))
+
+    def test_gpt2_loss_and_grads_match_single_device(self):
+        from polyaxon_tpu.models.gpt2 import (GPT2Block, GPT2Config,
+                                              GPT2Model)
+
+        cfg = GPT2Config(vocab_size=256, hidden_size=64, num_layers=4,
+                         num_heads=4, max_position=64,
+                         dtype=jnp.float32)
+        model = GPT2Model(cfg)
+        tokens = np.random.RandomState(0).randint(0, 256, (32, 32))
+        params = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+        self._parity(model, GPT2Block(cfg), tokens, params)
+
+    def test_llama_loss_and_grads_match_single_device(self):
+        """The pp restriction used to be GPT-2-only (train.py raised on
+        Llama) — the realistic pipeline target must pipeline too."""
+        from polyaxon_tpu.models.llama import (LlamaBlock, LlamaConfig,
+                                               LlamaModel)
+
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_layers=4,
+                          num_heads=4, num_kv_heads=2, max_position=64,
+                          dtype=jnp.float32)
+        model = LlamaModel(cfg)
+        tokens = np.random.RandomState(1).randint(0, 256, (32, 32))
+        params = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+        self._parity(model, LlamaBlock(cfg), tokens, params)
+
+    def test_llama_trains_pp2_matching_dp_only(self):
+        """End-to-end through TrainStep: llama-tiny under pp=2 x dp=4
+        tracks the dp=8 loss trajectory (VERDICT r2 task 5 done
+        criterion)."""
+        import optax
+
+        from polyaxon_tpu.models.llama import LlamaBlock
+        from polyaxon_tpu.models.registry import get_model
+        from polyaxon_tpu.parallel import make_train_step
+        from polyaxon_tpu.parallel.pipeline import pipelined_lm_loss_1f1b
+
+        spec = get_model("llama-tiny")
+        model, params = spec.init_params(batch_size=4)
+        batch = spec.make_batch(16)
+
+        mesh_dp = local_mesh(dp=8)
+        step_dp = make_train_step(spec.loss_fn(model), optax.sgd(1e-2),
+                                  mesh_dp, donate=False)
+        state_dp = step_dp.init_state(params)
+
+        mesh_pp = local_mesh(dp=4, pp=2)
+        loss_pp = pipelined_lm_loss_1f1b(model, LlamaBlock(model.cfg),
+                                         mesh_pp)
+        step_pp = make_train_step(loss_pp, optax.sgd(1e-2), mesh_pp,
+                                  donate=False)
+        state_pp = step_pp.init_state(params)
+
+        for _ in range(3):
+            state_dp, m_dp = step_dp(state_dp, batch, None)
+            state_pp, m_pp = step_pp(state_pp, batch, None)
+        loss_dp, loss_pp_v = float(m_dp["loss"]), float(m_pp["loss"])
+        assert np.isfinite(loss_pp_v)
+        np.testing.assert_allclose(loss_dp, loss_pp_v, rtol=2e-2)
