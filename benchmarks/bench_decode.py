@@ -117,7 +117,11 @@ def main() -> int:
             print(f"# decode {name} failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", file=sys.stderr)
             continue
-        row = {"bench": "decode", "ts": time.time(), **r}
+        row = {"bench": "decode", "ts": time.time(),
+               # Non-TPU rows are smoke evidence, not perf (same
+               # machine-tag convention as run_bench.py).
+               **({"regime": "cpu-smoke"} if backend != "tpu" else {}),
+               **r}
         print(json.dumps(row))
         with open(RESULTS, "a") as f:
             f.write(json.dumps(row) + "\n")

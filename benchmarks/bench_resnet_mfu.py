@@ -52,6 +52,12 @@ def sweep_configs(quick: bool):
         (256, "bn-bf16", {"norm_dtype": jnp.bfloat16}, None),
         (512, "bn-bf16", {"norm_dtype": jnp.bfloat16}, None),
         (512, "bn-bf16+nomom", {"norm_dtype": jnp.bfloat16}, sgd_plain),
+        # MLPerf space-to-depth stem: the 7x7/s2-on-3-channels conv is
+        # the lowest-occupancy MXU op in the net (exact-equivalence
+        # pinned in tests/test_models.py::TestSpaceToDepthStem).
+        (256, "s2d-stem", {"stem": "space_to_depth"}, None),
+        (512, "s2d-stem+bn-bf16",
+         {"stem": "space_to_depth", "norm_dtype": jnp.bfloat16}, None),
     ]
     return cfgs[:3] if quick else cfgs
 

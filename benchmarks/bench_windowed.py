@@ -83,6 +83,11 @@ def main() -> int:
         row = {"bench": "windowed-attention", "backend": backend,
                "ts": time.time(), "seq": point[0], "window": point[1],
                "batch": point[2], "heads": point[3], "dim": point[4]}
+        # Base env with the A/B switch REMOVED: a stray exported
+        # POLYAXON_TPU_FLASH_NO_REMAP would otherwise disable the remap
+        # in both legs and record a bogus ~1.0 speedup.
+        base_env = {k: v for k, v in os.environ.items()
+                    if k != "POLYAXON_TPU_FLASH_NO_REMAP"}
         for label, env in (("remap_ms", {}),
                            ("no_remap_ms",
                             {"POLYAXON_TPU_FLASH_NO_REMAP": "1"})):
@@ -90,7 +95,7 @@ def main() -> int:
                 out = subprocess.run(
                     [sys.executable, __file__, "--child",
                      *map(str, point)],
-                    env={**os.environ, **env}, capture_output=True,
+                    env={**base_env, **env}, capture_output=True,
                     text=True, timeout=900, cwd=REPO)
                 row[label] = json.loads(
                     out.stdout.strip().splitlines()[-1])["ms"]

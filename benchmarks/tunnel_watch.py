@@ -24,6 +24,11 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+# Single source of truth for the wedge-handling rules (abandon-don't-
+# kill, buffered-communicate reap): bench.py's probe helpers.
+from bench import _reap_probe  # noqa: E402
 LOG = os.path.join(HERE, "tunnel_watch.log")
 STATE = os.path.join(HERE, "tunnel_state")
 SWEEP = os.path.join(HERE, "tpu_sweep.sh")
@@ -60,7 +65,20 @@ def sweep_needed() -> bool:
         return os.path.exists(SWEEP)
 
 
+_abandoned_sweep = None  # a hung sweep Popen: never start a second one
+
+
 def run_sweep() -> None:
+    global _abandoned_sweep
+    if _abandoned_sweep is not None:
+        if _abandoned_sweep.poll() is None:
+            # Two sweeps fighting for the one chip stack concurrent
+            # TPU-init attempts — the wedge-spreading hazard.
+            log("previous sweep still running; not starting another")
+            return
+        log(f"abandoned sweep finally exited "
+            f"rc={_abandoned_sweep.returncode}")
+        _abandoned_sweep = None
     set_state("sweeping")
     log("tunnel UP -> running tpu_sweep.sh")
     try:
@@ -76,38 +94,27 @@ def run_sweep() -> None:
             commit()
     except subprocess.TimeoutExpired:
         log("sweep HUNG (tunnel wedged mid-sweep?); abandoned")
+        _abandoned_sweep = proc
     except Exception as e:
         log(f"sweep error: {type(e).__name__}: {e}")
 
 
 def commit() -> None:
+    # Explicit pathspec on the commit itself: the interactive session
+    # shares this repo and may have unrelated changes staged — the
+    # watcher must never sweep those into its commit.
+    paths = ["benchmarks/results.jsonl", ".bench_baseline.json",
+             "benchmarks/sweep.log"]
     try:
-        subprocess.run(["git", "add", "benchmarks/results.jsonl",
-                        ".bench_baseline.json", "benchmarks/sweep.log"],
+        subprocess.run(["git", "add", *paths],
                        cwd=REPO, check=False, timeout=60)
         subprocess.run(["git", "commit", "-m",
                         "bench: TPU sweep rows captured by tunnel watcher",
-                        "--no-verify"],
+                        "--no-verify", "--", *paths],
                        cwd=REPO, check=False, timeout=60)
         log("committed sweep results")
     except Exception as e:
         log(f"commit failed: {e}")
-
-
-def _reap(proc):
-    """Non-blocking: backend string if an abandoned probe finally
-    exited cleanly, else None.  communicate(), not stdout.read(): the
-    timed-out communicate() already drained the pipe into the Popen's
-    internal buffer and only a second communicate() returns it."""
-    if proc.poll() is None:
-        return None
-    try:
-        out, _ = proc.communicate(timeout=5)
-    except Exception:
-        return None
-    if proc.returncode == 0 and out and out.strip():
-        return out.strip().splitlines()[-1]
-    return None
 
 
 def main() -> None:
@@ -119,7 +126,7 @@ def main() -> None:
         # cap outstanding probes at 2 — stacking concurrent TPU-init
         # attempts on a wedged tunnel can spread the wedge.
         for proc in list(hung):
-            b = _reap(proc)
+            b = _reap_probe(proc)
             if proc.poll() is not None:
                 hung.remove(proc)
             if b:

@@ -69,6 +69,14 @@ class ResNet(nn.Module):
     # bandwidth knob the MFU sweep measures).  Statistics accumulation
     # stays f32 either way (flax computes mean/var in f32).
     norm_dtype: jnp.dtype = jnp.float32
+    # "conv7": the classic 7x7/s2 stem.  "space_to_depth": the MLPerf
+    # TPU trick — 2x2 space-to-depth on the input then a 4x4/s1 conv on
+    # 4C channels.  Same function class (any 7x7/s2 stem has an exact
+    # 4x4-on-s2d equivalent via zero-padding the kernel to 8x8 — pinned
+    # by tests/test_models.py), but the MXU sees 12 input channels at
+    # half the spatial size instead of 3 at full, a large occupancy win
+    # for the stem which is otherwise the lowest-MFU conv in the net.
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -77,8 +85,24 @@ class ResNet(nn.Module):
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.norm_dtype)
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), use_bias=False,
-                 dtype=self.dtype, name="stem_conv")(x)
+        if self.stem == "space_to_depth":
+            b, h, w, c = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c) \
+                 .transpose(0, 1, 3, 2, 4, 5) \
+                 .reshape(b, h // 2, w // 2, 4 * c)
+            # Padding (1, 2): the s2d image of the 7x7/s2 SAME padding
+            # (left 2 -> one 2-pixel block; right 3 -> two blocks, the
+            # kernel's zero column covering the excess).
+            x = conv(self.width, (4, 4), (1, 1),
+                     padding=((1, 2), (1, 2)), use_bias=False,
+                     dtype=self.dtype, name="stem_conv")(x)
+        elif self.stem == "conv7":
+            x = conv(self.width, (7, 7), (2, 2), use_bias=False,
+                     dtype=self.dtype, name="stem_conv")(x)
+        else:
+            raise ValueError(
+                f"stem must be 'conv7' or 'space_to_depth', got "
+                f"{self.stem!r}")
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -84,19 +84,20 @@ TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
-def _run_two_procs(worker, local_devices):
+def _run_procs(worker, n_procs, local_devices, extra_env=None):
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
     sock.close()
 
     procs = []
-    for pid in range(2):
+    for pid in range(n_procs):
         env = {
             **os.environ,
+            **(extra_env or {}),
             "PYTHONPATH": str(REPO),
             "PTPU_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "PTPU_NUM_PROCESSES": "2",
+            "PTPU_NUM_PROCESSES": str(n_procs),
             "PTPU_PROCESS_ID": str(pid),
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS":
@@ -107,12 +108,30 @@ def _run_two_procs(worker, local_devices):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
 
     outputs = []
-    for proc in procs:
-        out, _ = proc.communicate(timeout=240)
-        outputs.append(out)
+    try:
+        for proc in procs:
+            out, _ = proc.communicate(timeout=420)
+            outputs.append(out)
+    finally:
+        # A wedged gang member (the hang class this harness exists to
+        # catch) must not orphan the others holding the coordinator
+        # port for the rest of the pytest session.  CPU-only workers:
+        # killing is safe (no TPU-tunnel init in flight).
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                try:
+                    out, _ = proc.communicate(timeout=10)
+                    outputs.append(f"[killed after hang]\n{out}")
+                except Exception:
+                    pass
     for pid, (proc, out) in enumerate(zip(procs, outputs)):
         assert proc.returncode == 0, f"proc {pid} failed:\n{out}"
     return outputs
+
+
+def _run_two_procs(worker, local_devices):
+    return _run_procs(worker, 2, local_devices)
 
 
 TRACKING_WORKER = textwrap.dedent("""
@@ -176,3 +195,96 @@ def test_unmanaged_distributed_run_shares_uuid_and_checkpoints(
             if line.startswith("UUID="):
                 uuids.add(line.split("=", 1)[1])
     assert len(uuids) == 1, f"processes tracked separate runs: {uuids}"
+
+
+SHARDED_AXES_WORKER = textwrap.dedent("""
+    import os
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from polyaxon_tpu.parallel.bootstrap import initialize_from_env
+
+    # The SAME program is the n_procs=1 reference leg (the comparison
+    # is only meaningful if worker and reference cannot drift apart).
+    n_procs = int(os.environ["PTPU_NUM_PROCESSES"])
+    topo = initialize_from_env(timeout_s=120)
+    assert jax.process_count() == n_procs, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    import jax.numpy as jnp
+    import optax
+
+    from polyaxon_tpu.models.registry import get_model
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+    from polyaxon_tpu.parallel.constraints import ambient_mesh
+
+    fsdp = int(os.environ["TEST_FSDP"])
+    tp = int(os.environ["TEST_TP"])
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=fsdp, tp=tp))
+
+    # process-id -> mesh-coordinate must follow the injected topology:
+    # jax.devices() is process-major (PTPU_PROCESS_ID order) and mesh
+    # axes fill in AXIS_ORDER with tp fastest, so the owner of
+    # mesh.devices[f, t] is fully determined by the env block.
+    local_per = 8 // n_procs
+    grid = mesh.devices.reshape(fsdp, tp)
+    for f in range(fsdp):
+        for t in range(tp):
+            expect = (f * tp + t) // local_per
+            got = grid[f, t].process_index
+            assert got == expect, (f, t, got, expect)
+
+    spec = get_model("gpt2-tiny")
+    model, params = spec.init_params(batch_size=2)
+    loss_fn = spec.loss_fn(model)
+    step = make_train_step(loss_fn, optax.sgd(0.1), mesh, donate=False)
+    state = step.init_state(params)
+    batch = {k: jnp.asarray(v) for k, v in spec.make_batch(4).items()}
+    batch = jax.device_put(batch, step.batch_sharding)
+
+    def lg(p, b):
+        (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b,
+                                                                None)
+        return l, optax.global_norm(g)
+
+    with ambient_mesh(mesh):
+        l, n = jax.jit(lg)(state["params"], batch)
+    print(f"RESULT fsdp={fsdp} tp={tp} "
+          f"LOSS={float(l):.8f} NORM={float(n):.8f}", flush=True)
+""")
+
+
+def _parse_result(out):
+    import re
+
+    m = re.search(r"LOSS=([\d.eE+-]+) NORM=([\d.eE+-]+)", out)
+    assert m, out
+    return float(m.group(1)), float(m.group(2))
+
+
+def test_four_process_gang_sharded_axes_cross_processes():
+    """VERDICT r2 task 6: 4 processes x 2 local devices with fsdp (and,
+    in the second config, tp) axes SPANNING process boundaries — where
+    process-id <-> mesh-coordinate bugs live.  Every process's
+    loss/grad-norm must match a single-process 8-device run of the
+    identical program, and device ownership must follow the injected
+    PTPU_* topology env."""
+    # (fsdp, tp): fsdp=4 puts each fsdp shard on a different process;
+    # tp=4 makes every tp group straddle two processes.
+    for fsdp, tp in ((4, 2), (2, 4)):
+        env = {"TEST_FSDP": str(fsdp), "TEST_TP": str(tp)}
+        # Reference leg: the IDENTICAL worker program, one process with
+        # all 8 devices (initialize_from_env no-ops at n=1) — worker
+        # and reference cannot drift apart.
+        ref_out, = _run_procs(SHARDED_AXES_WORKER, n_procs=1,
+                              local_devices=8, extra_env=env)
+        ref_loss, ref_norm = _parse_result(ref_out)
+        outputs = _run_procs(SHARDED_AXES_WORKER, n_procs=4,
+                             local_devices=2, extra_env=env)
+        for out in outputs:
+            loss, norm = _parse_result(out)
+            assert abs(loss - ref_loss) < 5e-5 * max(1, abs(ref_loss)), \
+                (fsdp, tp, loss, ref_loss)
+            assert abs(norm - ref_norm) < 5e-5 * max(1, abs(ref_norm)), \
+                (fsdp, tp, norm, ref_norm)
