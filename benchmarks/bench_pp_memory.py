@@ -1,0 +1,118 @@
+"""Pipeline-schedule memory evidence: 1F1B vs GPipe (VERDICT r2 #5).
+
+Same methodology as ``bench_sp_memory.py``: CPU wall-clock on a shared
+host measures contention, but XLA's compiled-module memory analysis
+reports the per-device peak temp allocation of the exact program a TPU
+would run.  The autodiff GPipe schedule stores one carried activation
+per scan tick — O(n_micro) live microbatch activations per stage —
+while 1F1B's in-schedule VJP stashes at most min(2S-1, n_micro) stage
+INPUTS.  So as n_micro grows (the knob that shrinks the bubble
+2(S-1)/(n_micro + 2(S-1))), GPipe's peak grows linearly and 1F1B's
+plateaus: that is why the 1F1B axis can actually be driven to a
+negligible bubble on real HBM.
+
+Emits one JSON row per n_micro and appends to results.jsonl:
+
+    {"bench": "pp-memory", "n_micro": .., "pp": 4,
+     "gpipe_peak_temp_mb": .., "f1b_peak_temp_mb": .., "bubble": ..}
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8
+     python benchmarks/bench_pp_memory.py [--micros 4 8 16 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from bench_sp_memory import peak_temp_mb  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--micros", type=int, nargs="+",
+                        default=[4, 8, 16, 32])
+    parser.add_argument("--pp", type=int, default=4)
+    parser.add_argument("--mb", type=int, default=2,
+                        help="per-microbatch rows (fixed; n_micro is "
+                             "the scaling axis)")
+    args = parser.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyaxon_tpu.models.gpt2 import (GPT2Block, GPT2Config,
+                                          GPT2Model)
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh
+    from polyaxon_tpu.parallel.pipeline import (pipelined_lm_loss,
+                                                pipelined_lm_loss_1f1b)
+
+    pp = args.pp
+    cfg = GPT2Config(vocab_size=512, hidden_size=128, num_layers=pp * 2,
+                     num_heads=4, max_position=128, dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    mesh = build_mesh(MeshSpec(dp=-1, pp=pp))
+    seq = 128
+    tokens0 = jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, (4, seq)))
+    params = model.init(jax.random.PRNGKey(0), tokens0)
+
+    out_path = os.path.join(REPO, "benchmarks", "results.jsonl")
+    rc = 0
+    prev = {}
+    for m in args.micros:
+        batch = {"inputs": jnp.asarray(np.random.RandomState(1).randint(
+            0, 512, (m * args.mb, seq)))}
+        peaks = {}
+        for name, make in (("gpipe", pipelined_lm_loss),
+                           ("1f1b", pipelined_lm_loss_1f1b)):
+            loss_fn = make(model, GPT2Block(cfg), mesh, n_micro=m)
+
+            def vag(p, b):
+                (l, aux), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, b, None)
+                return l, g
+
+            compiled = jax.jit(vag).lower(params, batch).compile()
+            peaks[name] = peak_temp_mb(compiled)
+        bubble = 2 * (pp - 1) / (m + 2 * (pp - 1))
+        record = {
+            "bench": "pp-memory",
+            "backend": "cpu-analysis",
+            "pp": pp,
+            "n_micro": m,
+            "mb": args.mb,
+            "seq": seq,
+            "layers": cfg.num_layers,
+            "gpipe_peak_temp_mb": round(peaks["gpipe"], 1),
+            "f1b_peak_temp_mb": round(peaks["1f1b"], 1),
+            "ratio": round(peaks["gpipe"] / peaks["1f1b"], 2)
+            if peaks["1f1b"] else None,
+            "bubble_fraction": round(bubble, 3),
+            "ts": time.time(),
+        }
+        print(json.dumps(record))
+        with open(out_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        prev[m] = peaks
+    # The value prop: at the largest n_micro the 1F1B peak must sit
+    # well under GPipe's (its stash is O(S), not O(m)).
+    big = max(args.micros)
+    if prev[big]["1f1b"] >= prev[big]["gpipe"]:
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
