@@ -82,10 +82,11 @@ def run_sweep() -> None:
     set_state("sweeping")
     log("tunnel UP -> running tpu_sweep.sh")
     try:
-        proc = subprocess.Popen(
-            ["bash", SWEEP], cwd=REPO, start_new_session=True,
-            stdout=open(os.path.join(HERE, "sweep.log"), "a"),
-            stderr=subprocess.STDOUT)
+        with open(os.path.join(HERE, "sweep.log"), "a") as out:
+            proc = subprocess.Popen(
+                ["bash", SWEEP], cwd=REPO, start_new_session=True,
+                stdout=out, stderr=subprocess.STDOUT)
+        # Child holds its own dup of the fd; ours is closed either way.
         rc = proc.wait(timeout=SWEEP_TIMEOUT)
         log(f"sweep finished rc={rc}")
         if rc == 0:
@@ -103,8 +104,10 @@ def commit() -> None:
     # Explicit pathspec on the commit itself: the interactive session
     # shares this repo and may have unrelated changes staged — the
     # watcher must never sweep those into its commit.
-    paths = ["benchmarks/results.jsonl", ".bench_baseline.json",
-             "benchmarks/sweep.log"]
+    # sweep.log is gitignored (volatile): adding it errors and, worse,
+    # an ignored+untracked pathspec on `git commit -- <paths>` aborts
+    # the WHOLE commit — losing the bench rows.  Commit results only.
+    paths = ["benchmarks/results.jsonl", ".bench_baseline.json"]
     try:
         subprocess.run(["git", "add", *paths],
                        cwd=REPO, check=False, timeout=60)

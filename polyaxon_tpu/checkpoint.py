@@ -91,6 +91,11 @@ class CheckpointManager:
         if template is not None:
             import jax
 
+            # to_shape_dtype_struct preserves each template leaf's
+            # sharding (and special-cases PRNG key arrays), so the
+            # restored arrays land directly on the train-step's layout
+            # — PROVIDED the template is committed to its shardings
+            # (see TrainStep.init_state's step counter).
             abstract = jax.tree.map(
                 self._ocp.utils.to_shape_dtype_struct, template)
             return self._manager.restore(

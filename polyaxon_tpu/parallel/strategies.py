@@ -221,8 +221,15 @@ class TrainStep:
             "opt_state": opt_shardings,
             "step": NamedSharding(self.mesh, P()),
         }
-        return {"params": params, "opt_state": opt_state,
-                "step": jnp.zeros((), jnp.int32)}
+        # The step counter must be COMMITTED to its NamedSharding, not
+        # left as an uncommitted single-device scalar: an AOT-compiled
+        # step (precompile) auto-moves uncommitted args, but a
+        # checkpoint restored through this state as template yields a
+        # committed SingleDeviceSharding scalar that the executable
+        # hard-rejects — the round-3 preemption-resume regression.
+        step0 = jax.device_put(jnp.zeros((), jnp.int32),
+                               self.state_shardings["step"])
+        return {"params": params, "opt_state": opt_state, "step": step0}
 
     def _build(self):
         loss_fn, optimizer = self.loss_fn, self.optimizer
@@ -330,7 +337,35 @@ class TrainStep:
         # Tracing happens on the first call: publish the mesh so model
         # activation `constrain` calls resolve against it (constraints.py).
         with ambient_mesh(self.mesh):
-            return self._step(state, batch, rng)
+            try:
+                return self._step(state, batch, rng)
+            except (TypeError, ValueError) as e:
+                # An AOT executable (precompile) is pinned to the exact
+                # arg shapes/dtypes/shardings it was lowered for and,
+                # unlike jit, cannot re-specialize.  The recoverable
+                # drift is layout drift — args committed to the wrong
+                # devices (a checkpoint restored without sharding
+                # info).  Reshard onto the compiled layout and retry
+                # the SAME executable: no recompile.  Shape/dtype
+                # drift is a contract violation (__call__ args must
+                # match precompile's) and re-raises.
+                if not hasattr(self._step, "call"):
+                    raise  # plain jit: a real error, not a pinned-AOT one
+                shardings = getattr(self, "state_shardings", None)
+                # Only a sharding disagreement is recoverable by a
+                # reshard; shape/dtype drift would fail identically
+                # after paying a full-state device copy.
+                if shardings is None or \
+                        "compiled for input shardings" not in str(e):
+                    raise
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "AOT step rejected args (%s); resharding onto the "
+                    "compiled layout and retrying", e)
+                state = jax.device_put(state, shardings)
+                batch = jax.device_put(batch, self.batch_sharding)
+                return self._step(state, batch, rng)
 
 
 def make_train_step(

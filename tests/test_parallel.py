@@ -303,6 +303,47 @@ class TestTrainStep:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-6)
 
+    def test_init_state_step_committed_to_mesh(self):
+        # The step counter must be committed to its NamedSharding:
+        # restoring a checkpoint through an uncommitted template yields
+        # a committed SingleDeviceSharding scalar that an AOT-compiled
+        # step hard-rejects (round-3 preemption-resume regression).
+        from jax.sharding import NamedSharding
+
+        params, batch, loss_fn = self._toy()
+        mesh = build_mesh(MeshSpec(dp=8))
+        step = make_train_step(loss_fn, optax.adam(1e-2), mesh=mesh)
+        state = step.init_state(params)
+        assert isinstance(state["step"].sharding, NamedSharding)
+        assert state["step"].sharding == step.state_shardings["step"]
+
+    def test_aot_step_falls_back_on_drifted_state(self):
+        # precompile() pins an AOT executable; a later call with a
+        # committed-but-differently-sharded state (what a checkpoint
+        # restore without sharding info produces) must reshard onto
+        # the compiled layout and retry the same executable, not crash
+        # — and the returned state lands on the pinned layout so the
+        # NEXT call hits the AOT executable directly.
+        params, batch, loss_fn = self._toy()
+        mesh = build_mesh(MeshSpec(dp=8))
+        step = make_train_step(loss_fn, optax.sgd(0.1), mesh=mesh,
+                               donate=False)
+        state = step.init_state(params)
+        rng = jax.random.PRNGKey(0)
+        compiled, _ = step.precompile(state, batch, rng)
+        assert hasattr(step._step, "call")  # AOT installed
+        # Drift: commit every leaf to device 0 (SingleDeviceSharding).
+        drifted = jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), jax.devices()[0]),
+            state)
+        out_state, metrics = step(drifted, batch, rng)
+        assert np.isfinite(float(metrics["loss"]))
+        # Output resharded onto the compiled layout: next call must use
+        # the still-installed AOT executable directly.
+        assert step._step is compiled
+        out2, _ = step(out_state, batch, rng)
+        assert int(out2["step"]) == int(state["step"]) + 2
+
 
 class TestTPRules:
     def test_attention_and_mlp_rules(self):
