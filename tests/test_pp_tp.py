@@ -1,0 +1,128 @@
+"""pp x tp composition (VERDICT r3 missing #4 / task 4).
+
+Both pipeline schedules run their shard_map PARTIAL-manual when the
+mesh has a real tp axis: pp + batch axes (+ size-1 axes) are manual,
+tp stays auto so GSPMD shards the stage-internal matmuls over tp from
+the stacked stage params' jit-level shardings
+(`parallel/pipeline.py::_manual_axes`).
+
+Coverage strategy (see _manual_axes docstring): XLA:CPU crashes
+("Invalid binary instruction opcode copy") when a whole-program jit
+contains a partial-manual region — a backend bug the TPU compiler does
+not share — so tp>1 is verified here two ways:
+
+1. EAGER loss+grad parity on the virtual CPU mesh (op-by-op dispatch
+   never hands XLA:CPU the whole partial-manual program).
+2. A deviceless v5e:2x4 compile (jax.experimental.topologies — the
+   real TPU compiler) of the full 1F1B TrainStep at pp=2 x tp=2, with
+   XLA memory analysis proving the stage params actually shard over
+   tp (per-device argument bytes shrink vs the tp=1 compile).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from polyaxon_tpu.models.llama import LlamaBlock, LlamaConfig, LlamaModel
+from polyaxon_tpu.parallel import local_mesh, make_train_step
+from polyaxon_tpu.parallel.mesh import MeshSpec, build_mesh
+from polyaxon_tpu.parallel.pipeline import (pipelined_lm_loss,
+                                            pipelined_lm_loss_1f1b)
+from polyaxon_tpu.parallel.strategies import make_param_shardings
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_layers=4, num_heads=4,
+                      num_kv_heads=2, max_position=64,
+                      dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    tokens = np.random.RandomState(1).randint(0, 256, (32, 32))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+    return model, params, tokens
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp2_tp2_loss_and_grads_match_single_device(llama_setup,
+                                                    schedule):
+    model, params, tokens = llama_setup
+    batch = {"inputs": jnp.asarray(tokens)}
+
+    def ref_loss(p, b, rng):
+        logits = model.apply(p, b["inputs"], train=True)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], b["inputs"][:, 1:]).mean()
+
+    rl, rg = jax.value_and_grad(ref_loss)(params, batch, None)
+
+    mesh = local_mesh(dp=2, tp=2, pp=2)
+    factory = pipelined_lm_loss if schedule == "gpipe" \
+        else pipelined_lm_loss_1f1b
+    loss_fn = factory(model, LlamaBlock(model.cfg), mesh)
+    (pl, _), pg = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, batch, None)
+
+    np.testing.assert_allclose(float(rl), float(pl), atol=2e-5)
+    import jax.tree_util as jtu
+
+    pp_flat = {jtu.keystr(k): v for k, v in
+               jtu.tree_leaves_with_path(pg)}
+    for k, v in jtu.tree_leaves_with_path(rg):
+        w = pp_flat[jtu.keystr(k)]
+        denom = float(jnp.abs(v).max()) + 1e-8
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(v), atol=3e-4 * denom,
+            err_msg=f"{schedule} {jtu.keystr(k)}")
+
+
+def _compile_1f1b_step(topo, mesh_spec):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from polyaxon_tpu.models.registry import get_model
+
+    spec = get_model("llama-tiny")
+    model = spec.make_model()
+    batch = spec.make_batch(16)
+    batch_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
+    mesh = build_mesh(mesh_spec, devices=topo.devices)
+    params_abs = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros(batch["inputs"].shape, batch["inputs"].dtype))
+    loss_pp = pipelined_lm_loss_1f1b(model, LlamaBlock(model.cfg), mesh)
+    step = make_train_step(loss_pp, optax.sgd(1e-2), mesh, donate=True)
+    opt_abs = jax.eval_shape(step.optimizer.init, params_abs)
+    step.state_shardings = {
+        "params": make_param_shardings(params_abs, mesh),
+        "opt_state": make_param_shardings(opt_abs, mesh),
+        "step": NamedSharding(mesh, P()),
+    }
+    state_abs = {"params": params_abs, "opt_state": opt_abs,
+                 "step": jax.ShapeDtypeStruct((), jnp.int32)}
+    return step._build().lower(state_abs, batch_abs,
+                               jax.random.PRNGKey(0)).compile()
+
+
+def test_pp_tp_tpu_compile_shards_stage_params():
+    """The REAL TPU compiler accepts the partial-manual pp x tp train
+    step, and tp actually shards the stage params: per-device argument
+    bytes at pp=2 x tp=2 must be well below the tp=1 layout (embedding
+    + head replicate; the block stack halves)."""
+    from jax.experimental import topologies
+
+    try:
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4")
+    except Exception as e:
+        pytest.skip(f"deviceless TPU topology unavailable: {e}")
+
+    c_tp1 = _compile_1f1b_step(topo, MeshSpec(dp=4, pp=2))
+    c_tp2 = _compile_1f1b_step(topo, MeshSpec(dp=2, pp=2, tp=2))
+    args_tp1 = c_tp1.memory_analysis().argument_size_in_bytes
+    args_tp2 = c_tp2.memory_analysis().argument_size_in_bytes
+    assert args_tp2 < 0.75 * args_tp1, (
+        f"tp=2 per-device args {args_tp2} not meaningfully below "
+        f"tp=1 {args_tp1} — stage params are not sharding over tp")
