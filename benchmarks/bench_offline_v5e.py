@@ -1,0 +1,186 @@
+"""Offline v5e evidence: AOT-compile the headline train steps with the
+REAL TPU compiler (deviceless ``jax.experimental.topologies``) and
+record HLO-level cost + a roofline prediction per model/variant.
+
+The axon tunnel has been down/wedged for entire rounds (r1-r3 captured
+zero driver-run TPU rows), but the TPU *compiler* works offline: this
+harness produces honest, reproducible, chip-free evidence — per-device
+HLO FLOPs/bytes, peak/argument/temp memory of the exact compiled
+program, and a bandwidth/compute roofline bound — for every headline
+config plus the ResNet stem/BN variants the (still unmeasured) MFU
+sweep was built to compare.  Rows are marked ``bench: offline-v5e``
+and ``executed: false`` so nobody mistakes a model for a measurement;
+when the tunnel answers, tpu_sweep.sh replaces predictions with steps.
+
+v5e public constants used for the roofline: 197 TFLOP/s bf16 peak,
+819 GB/s HBM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
+
+V5E_PEAK_BF16 = 197e12
+V5E_HBM_BPS = 819e9
+
+
+def compile_single_chip(jax, model_name, batch_size, overrides=None):
+    import jax.numpy as jnp
+    import optax
+    from jax.experimental import topologies
+
+    from polyaxon_tpu.models.registry import get_model
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+    from polyaxon_tpu.parallel.strategies import make_param_shardings
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    # Single-chip program: a 1-device mesh over the abstract topology.
+    mesh = build_mesh(MeshSpec(dp=1), devices=list(topo.devices)[:1])
+    spec = get_model(model_name)
+    model = spec.make_model(**(overrides or {}))
+    batch = spec.make_batch(batch_size)
+    batch_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
+    params_abs = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros(batch["inputs"].shape, batch["inputs"].dtype))
+    step = make_train_step(spec.loss_fn(model),
+                           optax.sgd(0.1, momentum=0.9), mesh,
+                           donate=True)
+    opt_abs = jax.eval_shape(step.optimizer.init, params_abs)
+    step.state_shardings = {
+        "params": make_param_shardings(params_abs, mesh),
+        "opt_state": make_param_shardings(opt_abs, mesh),
+        "step": NamedSharding(mesh, P()),
+    }
+    state_abs = {"params": params_abs, "opt_state": opt_abs,
+                 "step": jax.ShapeDtypeStruct((), jnp.int32)}
+    rng = jax.random.PRNGKey(0)
+    compiled = step._build().lower(state_abs, batch_abs, rng).compile()
+    return compiled, spec
+
+
+def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    xla_flops = float(cost.get("flops", 0.0)) or None
+    xla_bytes = float(cost.get("bytes accessed", 0.0)) or None
+    ma = compiled.memory_analysis()
+    analytic = spec.train_flops(batch_size) if spec.train_flops else None
+
+    # VALIDITY GATE: XLA's cost model counts an nn.scan loop BODY once
+    # (verified: gpt2-medium reports embed/head + exactly one layer),
+    # so for scanned transformers both its flops AND its bytes miss
+    # ~ (L-1)/L of the layer work — a roofline built on those bytes
+    # mislabels every scanned model "compute-bound".  Emit the roofline
+    # only when the XLA flop count corroborates the analytic one
+    # (within 2x); otherwise publish the (allocation-based, correct)
+    # memory_analysis numbers alone and say why.
+    cost_model_valid = bool(
+        analytic and xla_flops and 0.5 <= xla_flops / analytic <= 2.0)
+    t_compute = (analytic or xla_flops or 0) / V5E_PEAK_BF16
+    t_memory = (xla_bytes or 0) / V5E_HBM_BPS
+    t_bound = (max(t_compute, t_memory) or None) if cost_model_valid \
+        else None
+    row = {
+        "bench": "offline-v5e",
+        "executed": False,  # compile-only: a bound, not a measurement
+        "ts": time.time(),
+        "model": model_name,
+        **({"variant": variant} if variant else {}),
+        "batch": batch_size,
+        "backend": "tpu-compile-only",
+        "step_flops_analytic": analytic,
+        "step_flops_xla": xla_flops,
+        "hlo_bytes_accessed": xla_bytes,
+        "peak_hbm_bytes": getattr(ma, "peak_memory_in_bytes", None),
+        "argument_bytes": getattr(ma, "argument_size_in_bytes", None),
+        "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
+        "cost_model_valid": cost_model_valid,
+        "roofline_sec_per_step": round(t_bound, 5) if t_bound else None,
+        "roofline_bound": (("memory" if t_memory > t_compute
+                            else "compute") if cost_model_valid
+                           else "n/a: xla cost model counts scan body "
+                                "once; bytes not trustworthy"),
+        "roofline_mfu_max": (round((analytic or 0) /
+                                   (t_bound * V5E_PEAK_BF16), 4)
+                             if t_bound and analytic else None),
+    }
+    return row
+
+
+CONFIGS = [
+    # (model, batch, overrides, variant)
+    ("resnet50", 128, None, None),
+    ("resnet50", 256, {"stem": "space_to_depth"}, "s2d-stem"),
+    ("resnet50", 256, {"stem": "space_to_depth",
+                       "norm_dtype": "bf16"}, "s2d+bn-bf16"),
+    ("gpt2-medium", 4, None, None),
+    ("bert-base", 16, None, None),
+    ("tinyllama-1.1b", 2, None, None),
+]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--models", default=None,
+                        help="comma list to restrict (default: all)")
+    parser.add_argument("--no-append", action="store_true")
+    args = parser.parse_args()
+
+    # The lowering target is the TPU compiler even though the default
+    # backend is CPU: route attention through the real flash kernels,
+    # not the plain path (see flash_eligible).
+    os.environ.setdefault("POLYAXON_TPU_ASSUME_TPU", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    only = set(args.models.split(",")) if args.models else None
+    rows = []
+    for model_name, batch, overrides, variant in CONFIGS:
+        if only and model_name not in only:
+            continue
+        if overrides and overrides.get("norm_dtype") == "bf16":
+            overrides = {**overrides, "norm_dtype": jnp.bfloat16}
+        label = f"{model_name}{'/' + variant if variant else ''} b{batch}"
+        try:
+            t0 = time.time()
+            compiled, spec = compile_single_chip(jax, model_name, batch,
+                                                 overrides)
+            row = analyze(jax, model_name, batch, compiled, spec,
+                          variant)
+            row["compile_s"] = round(time.time() - t0, 1)
+            rows.append(row)
+            print(f"# {label}: roofline "
+                  f"{row['roofline_sec_per_step']}s "
+                  f"(bound: {row['roofline_bound']}, mfu_max "
+                  f"{row['roofline_mfu_max']}) peak_hbm "
+                  f"{row['peak_hbm_bytes']}", file=sys.stderr)
+        except Exception as e:
+            print(f"# {label} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:300]}", file=sys.stderr)
+    if rows and not args.no_append:
+        with open(RESULTS, "a") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    print(json.dumps({"metric": "offline-v5e rows", "value": len(rows),
+                      "unit": "rows", "vs_baseline": None}))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

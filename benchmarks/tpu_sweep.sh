@@ -1,23 +1,36 @@
 #!/bin/bash
 # TPU sweep run by tunnel_watch.py the moment the tunnel answers.
 # Keep FAST things first: the tunnel died mid-round in r2, so the order
-# is (1) headline rows, (2) resnet MFU sweep, (3) decode rows.
+# is (1) headline rows, (2) resnet MFU sweep, (3) serving/windowed.
 set -x
 cd "$(dirname "$0")/.."
 
-# 1. Fresh current-regime headline rows (gpt2-medium, bert-base, resnet50).
-timeout 2400 python bench.py --all --probe-timeout 60 --probe-budget 120 || true
+# 1. Full current-regime evidence set in ONE invocation (resnet50,
+#    gpt2-medium, bert-base, tinyllama-1.1b + a decode row), each model
+#    in its own subprocess with its own timeout (bench.py --all on an
+#    accelerator).  Outer timeout > 5 x per-model so the parent always
+#    outlives its children — an outer kill would orphan a child that
+#    still holds the one chip and poison the steps below.
+timeout 5400 python bench.py --all --probe-timeout 60 --probe-budget 120 \
+    --per-model-timeout 900 || true
 
-# 2. tinyllama row (slow compile; separate so a hang doesn't kill row 1).
-timeout 2400 python bench.py --model tinyllama-1.1b --steps 10 --probe-budget 120 || true
+# 1b. Dedicated tinyllama retry: its cold-cache seq-2048 remat compile
+#     plus tunnel dispatch can blow --all's 900 s per-model budget (the
+#     reason it had its own leg before --all covered it).  A duplicate
+#     row when --all succeeded is harmless; a fourth round with NO
+#     tinyllama row is not.
+timeout 2400 python bench.py --model tinyllama-1.1b --steps 10 \
+    --probe-budget 120 || true
 
-# 3. ResNet-50 MFU sweep: batch x variants (VERDICT r2 task 2).
+# 2. ResNet-50 MFU sweep: batch x variants (VERDICT r2 task 2 — the
+#    s2d stem + bf16-BN knobs are unmeasured).
 timeout 3600 python benchmarks/bench_resnet_mfu.py || true
 
-# 4. Decode/serving rows (VERDICT r2 task 7).
+# 3. Decode/serving rows incl. tinyllama TTFT curves (VERDICT r2 task 7).
 timeout 2400 python benchmarks/bench_decode.py || true
 
-# 5. Windowed-attention O(W) remap A/B (VERDICT r2 task 4).
+# 4. Windowed-attention O(W) remap A/B at seq 8k / window 1k (VERDICT
+#    r2 task 4).
 timeout 2400 python benchmarks/bench_windowed.py || true
 
 echo "SWEEP COMPLETE $(date)"

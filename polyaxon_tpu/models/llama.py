@@ -83,7 +83,14 @@ class LlamaConfig:
 
     @staticmethod
     def tinyllama() -> "LlamaConfig":
-        return LlamaConfig()  # TinyLlama-1.1B dims
+        # remat=True is load-bearing: the b2/seq-2048 train step needs
+        # 21.0 GiB of HBM without remat and 14.7 GiB with it (measured
+        # via the deviceless v5e compile, benchmarks/bench_offline_v5e
+        # rationale) — a single 16 GiB v5e chip cannot run the headline
+        # config at all un-remattered.  Remat trades ~30% more FLOPs
+        # for fitting; multi-chip fsdp runs that fit anyway can build
+        # LlamaConfig(remat=False) directly.
+        return LlamaConfig(remat=True)  # TinyLlama-1.1B dims
 
     @staticmethod
     def tiny() -> "LlamaConfig":

@@ -58,7 +58,14 @@ def flash_eligible(sq: int, sk: int, head_dim: int, mask=None, *,
     a longer mask (ring)."""
     if os.environ.get("POLYAXON_TPU_NO_FLASH"):
         return False
+    # POLYAXON_TPU_ASSUME_TPU: deviceless AOT compiles for a TPU
+    # topology (jax.experimental.topologies) run with a CPU default
+    # backend, but the lowering target IS the TPU compiler — without
+    # this override they would silently trace the plain-attention path
+    # and report S^2-score memory the real program never allocates
+    # (benchmarks/bench_offline_v5e.py).
     if not (jax.default_backend() == "tpu"
+            or os.environ.get("POLYAXON_TPU_ASSUME_TPU")
             or os.environ.get("POLYAXON_TPU_FLASH_INTERPRET")):
         return False
     if sq % 128 or sk % 128 or head_dim % 64:

@@ -322,6 +322,20 @@ def _main(argv=None) -> int:
         {k: v for k, v in strategy.items() if k != "pp_schedule"}))
     n_chips = mesh.devices.size
 
+    # Unsupported compositions fail LOUDLY and FAST — before datasets
+    # and (potentially multi-GiB) param init, and not with a nested
+    # shard_map trace error 40 frames deep: sp routes attention through
+    # its own shard_map and ep all-to-alls inside the MoE layer —
+    # neither composes with the pipeline's manual pp axis yet (pp x tp
+    # and pp x dp/fsdp do).
+    if mesh.shape.get("pp", 1) > 1:
+        for bad_axis in ("sp", "ep"):
+            if mesh.shape.get(bad_axis, 1) > 1:
+                raise SystemExit(
+                    f"strategy combines pp>1 with {bad_axis}>1, which "
+                    f"is not supported: pipeline stages compose with "
+                    f"dp/fsdp (batch) and tp (tensor) axes only")
+
     # sp > 1: route every model's attention through ring/Ulysses
     # sequence parallelism for the whole run (activated before any jit
     # trace; main()'s wrapper deactivates on the way out so in-process

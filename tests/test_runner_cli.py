@@ -460,3 +460,19 @@ class TestCli:
         f.write_text("kind: wat\n")
         result = self._invoke(tmp_home, ["check", "-f", str(f)])
         assert result.exit_code != 0
+
+
+class TestTrainStrategyValidation:
+    @pytest.mark.parametrize("combo", ["pp:2,sp:2", "pp:2,ep:2"])
+    def test_pp_with_sp_or_ep_fails_loudly(self, tmp_home, combo,
+                                           monkeypatch):
+        """pp composes with dp/fsdp/tp only; combining it with sp or ep
+        must exit with a clear message, not a nested shard_map trace
+        error."""
+        monkeypatch.setenv("POLYAXON_TPU_NO_TPU", "1")
+        from polyaxon_tpu.train import main
+
+        with pytest.raises(SystemExit) as e:
+            main(["--model", "gpt2-tiny", "--cpu", "--strategy", combo,
+                  "--steps", "1", "--batch-size", "8"])
+        assert "not supported" in str(e.value)
