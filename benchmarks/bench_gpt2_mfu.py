@@ -1,0 +1,114 @@
+"""GPT-2-medium single-chip MFU sweep (round-4 follow-on to the ResNet
+sweep).
+
+The offline v5e harness pinned gpt2-medium b4/seq-1024 at 15.46 G of the
+chip's 15.75 G HBM — the un-remattered config is wedged against the
+memory wall, so batch (the main MFU lever for LMs on the MXU) cannot
+move.  Remat trades ~30 % more FLOPs for O(layers) less activation HBM;
+a selective policy (``dots_saveable``: keep matmul outputs, recompute
+the cheap elementwise chain) costs far less recompute than full remat.
+This sweep walks that frontier on the real chip:
+
+- b4  base        — the committed regime (sanity anchor).
+- b8  remat+dots  — selective remat should fit b8 and amortize
+  bandwidth/launch overhead over 2x the MXU work.
+- b16 remat+dots  — bigger still; whether MFU keeps climbing tells us
+  if the model is compute- or bandwidth-bound at this size.
+- b8  remat-full  — isolates the recompute tax of full vs selective.
+
+Each point appends a ``{"bench": "gpt2-mfu-sweep"}`` row to
+``benchmarks/results.jsonl`` IMMEDIATELY (the tunnel can die mid-sweep),
+and the best point updates ``.bench_baseline.json`` under
+``gpt2-medium:tpu``.
+
+Run: python benchmarks/bench_gpt2_mfu.py [--steps 20] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench as B  # noqa: E402
+
+RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
+BASELINE = os.path.join(REPO, ".bench_baseline.json")
+
+
+def sweep_configs(quick: bool):
+    cfgs = [
+        # (batch, variant, config-field overrides)
+        (4, "base", None),
+        (8, "remat-dots", {"remat": True, "remat_policy": "dots_saveable"}),
+        (16, "remat-dots", {"remat": True, "remat_policy": "dots_saveable"}),
+        (8, "remat-full", {"remat": True}),
+    ]
+    return cfgs[:2] if quick else cfgs
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--probe-budget", type=float, default=300.0)
+    args = parser.parse_args()
+
+    jax, backend, fallback = B.init_backend(
+        False, probe_budget=args.probe_budget)
+    if backend != "tpu":
+        print(json.dumps({"bench": "gpt2-mfu-sweep",
+                          "skipped": f"backend={backend}"}))
+        return 0
+
+    best = None
+    for batch, variant, overrides in sweep_configs(args.quick):
+        t0 = time.time()
+        try:
+            r = B.bench_model(jax, "gpt2-medium", batch, args.steps,
+                              args.warmup, backend, overrides=overrides,
+                              variant=variant)
+        except Exception as e:
+            r = None
+            print(f"# {variant} b{batch} failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+        if not r:
+            row = {"bench": "gpt2-mfu-sweep", "ts": time.time(),
+                   "model": "gpt2-medium", "batch": batch,
+                   "variant": variant, "failed": True}
+        else:
+            row = {"bench": "gpt2-mfu-sweep", "ts": time.time(),
+                   "variant": variant,
+                   "wall_s": round(time.time() - t0, 1), **r}
+            print(f"# b{batch} {variant}: {r['per_sec_per_chip']} "
+                  f"tok/sec mfu={r['mfu']}", file=sys.stderr)
+            if best is None or r["mfu"] > best["mfu"]:
+                best = r
+        with open(RESULTS, "a") as f:  # append per-point: tunnel may die
+            f.write(json.dumps(row) + "\n")
+
+    if best:
+        try:
+            with open(BASELINE) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError):
+            baseline = {}
+        if best["per_sec_per_chip"] > baseline.get("gpt2-medium:tpu", 0):
+            baseline["gpt2-medium:tpu"] = best["per_sec_per_chip"]
+            with open(BASELINE, "w") as f:
+                json.dump(baseline, f, indent=1, sort_keys=True)
+        print(json.dumps({"bench": "gpt2-mfu-sweep", "best_mfu":
+                          best["mfu"], "best_batch": best["batch"],
+                          "best_variant": best.get("variant"),
+                          "tok_sec_chip": best["per_sec_per_chip"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

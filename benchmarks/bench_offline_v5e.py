@@ -67,7 +67,9 @@ def compile_single_chip(jax, model_name, batch_size, overrides=None):
     state_abs = {"params": params_abs, "opt_state": opt_abs,
                  "step": jax.ShapeDtypeStruct((), jnp.int32)}
     rng = jax.random.PRNGKey(0)
-    compiled = step._build().lower(state_abs, batch_abs, rng).compile()
+    # The supported AOT surface: traces under the ambient mesh so
+    # activation `constrain` calls resolve on multi-axis variants.
+    compiled, _ = step.precompile(state_abs, batch_abs, rng)
     return compiled, spec
 
 
@@ -86,10 +88,23 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
     # ~ (L-1)/L of the layer work — a roofline built on those bytes
     # mislabels every scanned model "compute-bound".  Emit the roofline
     # only when the XLA flop count corroborates the analytic one
-    # (within 2x); otherwise publish the (allocation-based, correct)
-    # memory_analysis numbers alone and say why.
+    # (within 2x) AND the cost model reported bytes at all — flops
+    # without bytes would yield t_memory=0 and a "compute-bound" label
+    # that never looked at memory; otherwise publish the
+    # (allocation-based, correct) memory_analysis numbers alone and
+    # say why.
     cost_model_valid = bool(
-        analytic and xla_flops and 0.5 <= xla_flops / analytic <= 2.0)
+        analytic and xla_flops and xla_bytes
+        and 0.5 <= xla_flops / analytic <= 2.0)
+    if cost_model_valid:
+        invalid_reason = None
+    elif not xla_bytes:
+        invalid_reason = "n/a: cost model reported no bytes accessed"
+    elif not (analytic and xla_flops):
+        invalid_reason = "n/a: no analytic/xla flops to cross-check"
+    else:
+        invalid_reason = ("n/a: xla cost model counts scan body once; "
+                          "bytes not trustworthy")
     t_compute = (analytic or xla_flops or 0) / V5E_PEAK_BF16
     t_memory = (xla_bytes or 0) / V5E_HBM_BPS
     t_bound = (max(t_compute, t_memory) or None) if cost_model_valid \
@@ -112,8 +127,7 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
         "roofline_sec_per_step": round(t_bound, 5) if t_bound else None,
         "roofline_bound": (("memory" if t_memory > t_compute
                             else "compute") if cost_model_valid
-                           else "n/a: xla cost model counts scan body "
-                                "once; bytes not trustworthy"),
+                           else invalid_reason),
         "roofline_mfu_max": (round((analytic or 0) /
                                    (t_bound * V5E_PEAK_BF16), 4)
                              if t_bound and analytic else None),

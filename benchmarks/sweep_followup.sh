@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-4 follow-up benches: wait for tpu_sweep.sh to print its
+# completion marker, then run the gpt2-medium remat/batch MFU sweep and
+# the on-chip roofline probe (measured HBM BW + MXU throughput ->
+# profile-backed MFU ceilings).  Runs unattended so the chip is used
+# the moment the main sweep frees it.
+set -x
+cd "$(dirname "$0")/.."
+LOG=benchmarks/sweep_r4.log
+
+for i in $(seq 1 720); do
+    grep -q "SWEEP COMPLETE" "$LOG" 2>/dev/null && break
+    # If the sweep process died without the marker, stop waiting too —
+    # but only after a grace period, so launching this a moment before
+    # tpu_sweep.sh (or across a sweep restart) can't fall through and
+    # contend with it for the one chip.
+    if [ "$i" -gt 10 ] && ! pgrep -f tpu_sweep.sh >/dev/null; then
+        break
+    fi
+    sleep 30
+done
+
+timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
+timeout 1200 python benchmarks/bench_roofline_probe.py || true
+echo "FOLLOWUP COMPLETE $(date)"

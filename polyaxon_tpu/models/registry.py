@@ -32,6 +32,17 @@ def softmax_xent(logits, labels):
         logits, labels).mean()
 
 
+def _cfg_model(model_cls, base_cfg):
+    """make_model for config-bearing models: keyword overrides patch
+    CONFIG FIELDS (``dataclasses.replace``), so ``init_params(remat=True,
+    remat_policy="dots_saveable")`` works uniformly — the MFU sweeps use
+    this to walk remat/batch trade-offs without bespoke constructors."""
+    def make(**kw):
+        cfg = dataclasses.replace(base_cfg, **kw) if kw else base_cfg
+        return model_cls(cfg)
+    return make
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelSpec:
     name: str
@@ -256,7 +267,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="bert-base",
-    make_model=lambda **kw: BertModel(BertConfig.base(), **kw),
+    make_model=_cfg_model(BertModel, BertConfig.base()),
     make_batch=lambda b: _token_batch(b, 512, BertConfig.base().vocab_size),
     loss_fn=_mlm_loss,
     default_batch_size=32,
@@ -265,7 +276,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="bert-tiny",
-    make_model=lambda **kw: BertModel(BertConfig.tiny(), **kw),
+    make_model=_cfg_model(BertModel, BertConfig.tiny()),
     make_batch=lambda b: _token_batch(b, 64, BertConfig.tiny().vocab_size),
     loss_fn=_mlm_loss,
     default_batch_size=8,
@@ -273,7 +284,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="gpt2-medium",
-    make_model=lambda **kw: GPT2Model(GPT2Config.medium(), **kw),
+    make_model=_cfg_model(GPT2Model, GPT2Config.medium()),
     make_batch=lambda b: _token_batch(b, 1024,
                                       GPT2Config.medium().vocab_size),
     loss_fn=_lm_loss,
@@ -283,7 +294,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="gpt2-small",
-    make_model=lambda **kw: GPT2Model(GPT2Config.small(), **kw),
+    make_model=_cfg_model(GPT2Model, GPT2Config.small()),
     make_batch=lambda b: _token_batch(b, 1024,
                                       GPT2Config.small().vocab_size),
     loss_fn=_lm_loss,
@@ -293,7 +304,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="gpt2-tiny",
-    make_model=lambda **kw: GPT2Model(GPT2Config.tiny(), **kw),
+    make_model=_cfg_model(GPT2Model, GPT2Config.tiny()),
     make_batch=lambda b: _token_batch(b, 64, GPT2Config.tiny().vocab_size),
     loss_fn=_lm_loss,
     default_batch_size=8,
@@ -301,7 +312,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="tinyllama-1.1b",
-    make_model=lambda **kw: LlamaModel(LlamaConfig.tinyllama(), **kw),
+    make_model=_cfg_model(LlamaModel, LlamaConfig.tinyllama()),
     make_batch=lambda b: _token_batch(b, 2048,
                                       LlamaConfig.tinyllama().vocab_size),
     loss_fn=_lm_loss,
@@ -323,7 +334,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="llama-tiny",
-    make_model=lambda **kw: LlamaModel(LlamaConfig.tiny(), **kw),
+    make_model=_cfg_model(LlamaModel, LlamaConfig.tiny()),
     make_batch=lambda b: _token_batch(b, 64, LlamaConfig.tiny().vocab_size),
     loss_fn=_lm_loss,
     default_batch_size=8,
@@ -331,7 +342,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="vit-base",
-    make_model=lambda **kw: ViTModel(ViTConfig.base(), **kw),
+    make_model=_cfg_model(ViTModel, ViTConfig.base()),
     make_batch=lambda b: _image_batch(b, 224, 1000),
     loss_fn=_classifier_loss,
     default_batch_size=64,
@@ -340,7 +351,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="vit-tiny",
-    make_model=lambda **kw: ViTModel(ViTConfig.tiny(), **kw),
+    make_model=_cfg_model(ViTModel, ViTConfig.tiny()),
     make_batch=lambda b: _image_batch(b, 32, 10),
     loss_fn=_classifier_loss,
     default_batch_size=8,
@@ -348,7 +359,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="moe-gpt-small",
-    make_model=lambda **kw: MoEGPTModel(MoEGPTConfig.small(), **kw),
+    make_model=_cfg_model(MoEGPTModel, MoEGPTConfig.small()),
     make_batch=lambda b: _token_batch(b, 1024,
                                       MoEGPTConfig.small().vocab_size),
     loss_fn=_moe_lm_loss,
@@ -358,7 +369,7 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="moe-gpt-tiny",
-    make_model=lambda **kw: MoEGPTModel(MoEGPTConfig.tiny(), **kw),
+    make_model=_cfg_model(MoEGPTModel, MoEGPTConfig.tiny()),
     make_batch=lambda b: _token_batch(b, 64,
                                       MoEGPTConfig.tiny().vocab_size),
     loss_fn=_moe_lm_loss,
