@@ -297,6 +297,67 @@ def load_baseline():
         return {}
 
 
+def baseline_entry(baseline, model, backend):
+    """One baseline entry: ``(value, config_dict_or_None)``.
+
+    Entries are either a bare number (legacy) or a dict ``{"value",
+    "batch", "overrides", "variant"}`` recording the CONFIG the best
+    number was measured at.  The config matters: once an MFU sweep
+    commits a faster variant (e.g. resnet50 b512 s2d+bf16-BN) as the
+    baseline, a driver-run default bench measuring the STOCK config
+    would score vs_baseline < 1 — a phantom regression.  The default
+    run replays the recorded config instead (main()).
+    """
+    e = baseline.get(f"{model}:{backend}")
+    if isinstance(e, dict):
+        return e.get("value"), e
+    return e, None
+
+
+def decode_overrides(ov):
+    """JSON-stored model overrides -> constructor values.
+
+    Dtype-valued config fields are stored by name ("bf16"/"f32") since
+    baselines live in a JSON file; everything else passes through.
+    """
+    if not ov:
+        return None
+    import jax.numpy as jnp
+
+    dtypes = {"bf16": jnp.bfloat16, "f32": jnp.float32}
+    return {k: dtypes.get(v, v) if isinstance(v, str) else v
+            for k, v in ov.items()}
+
+
+def decode_optimizer(name):
+    """JSON-stored optimizer name -> optax optimizer (None = bench
+    default, sgd+momentum).  Recorded alongside the winning config so a
+    nomom-variant baseline is replayed with the optimizer it was
+    actually measured with."""
+    if name is None:
+        return None
+    import optax
+
+    if name == "sgd-nomom":
+        return optax.sgd(0.1)
+    raise ValueError(f"unknown recorded optimizer {name!r}")
+
+
+def config_matches(result, cfg):
+    """Did this run measure the baseline's recorded config?
+
+    vs_baseline against a DIFFERENT config (stock fallback after the
+    recorded one failed, or an explicit --batch) is the phantom
+    regression baseline_entry exists to avoid — suppress it instead.
+    Legacy numeric entries recorded no config; treat as matching.
+    """
+    if cfg is None:
+        return True
+    return (result.get("batch") == cfg.get("batch")
+            and (result.get("variant") or None)
+            == (cfg.get("variant") or None))
+
+
 def last_tpu_row():
     """Newest current-regime TPU evidence from benchmarks/results.jsonl.
 
@@ -347,12 +408,15 @@ def emit(result, fallback: bool) -> None:
     # TPU baseline on the TPU backend; a fallback run must NOT report
     # parity (r2's degraded run published 1.0 — VERDICT weak #1).
     vs = None
-    key = f"{result['model']}:{result['backend']}"
-    if not fallback and baseline.get(key):
-        vs = round(result["per_sec_per_chip"] / baseline[key], 4)
+    base_val, base_cfg = baseline_entry(baseline, result["model"],
+                                        result["backend"])
+    if not fallback and base_val and config_matches(result, base_cfg):
+        vs = round(result["per_sec_per_chip"] / base_val, 4)
+    variant = result.get("variant")
     line = {
         "metric": (f"{result['model']} {result['unit']} "
-                   f"({backend}, batch {result['batch']})"),
+                   f"({backend}, batch {result['batch']}"
+                   + (f", {variant}" if variant else "") + ")"),
         "value": result["per_sec_per_chip"],
         "unit": result["unit"],
         "vs_baseline": vs,
@@ -363,6 +427,90 @@ def emit(result, fallback: bool) -> None:
     if fallback:
         line["last_tpu"] = last_tpu_row()
     print(json.dumps(line))
+
+
+def run_mfu_sweep(model_name: str, configs, *, steps: int = 20,
+                  warmup: int = 3, probe_budget: float = 300.0) -> int:
+    """Shared driver for the per-model MFU sweeps
+    (benchmarks/bench_resnet_mfu.py, bench_gpt2_mfu.py).
+
+    ``configs``: ``(batch, variant, overrides, optimizer_name)`` tuples.
+    Overrides are JSON-safe (dtypes by name — see decode_overrides) and
+    the optimizer is a name decode_optimizer resolves, so the WINNING
+    config can be recorded verbatim in ``.bench_baseline.json`` and the
+    default bench replays exactly what was measured (incl. the
+    optimizer — a nomom variant is meaningless under the default
+    momentum SGD).
+
+    Appends one ``{"bench": "<model>-mfu-sweep"}`` row per point to
+    benchmarks/results.jsonl IMMEDIATELY (the tunnel can die mid-sweep)
+    and updates the baseline entry if the best point beats it.
+    """
+    tag = f"{model_name}-mfu-sweep"
+    here = os.path.dirname(os.path.abspath(__file__))
+    results_path = os.path.join(here, "benchmarks", "results.jsonl")
+    baseline_path = os.path.join(here, ".bench_baseline.json")
+
+    jax, backend, fallback = init_backend(False,
+                                          probe_budget=probe_budget)
+    if backend != "tpu":
+        print(json.dumps({"bench": tag, "skipped": f"backend={backend}"}))
+        return 0
+
+    best = best_cfg = best_key = None
+    for batch, variant, overrides, opt_name in configs:
+        t0 = time.time()
+        try:
+            r = bench_model(jax, model_name, batch, steps, warmup,
+                            backend,
+                            overrides=decode_overrides(overrides),
+                            variant=variant,
+                            optimizer=decode_optimizer(opt_name))
+        except Exception as e:
+            r = None
+            print(f"# {variant} b{batch} failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+        if not r:
+            row = {"bench": tag, "ts": time.time(), "model": model_name,
+                   "batch": batch, "variant": variant, "failed": True}
+        else:
+            row = {"bench": tag, "ts": time.time(),
+                   "wall_s": round(time.time() - t0, 1), **r}
+            print(f"# b{batch} {variant}: {r['per_sec_per_chip']} "
+                  f"{r['unit']} mfu={r['mfu']}", file=sys.stderr)
+            # Rank by MFU when the chip's peak is known, else by raw
+            # throughput (mfu=None on unrecognized device kinds must
+            # not make the FIRST point win every 0>0 tie).
+            key = (r["mfu"] is not None, r["mfu"] or 0.0,
+                   r["per_sec_per_chip"])
+            if best is None or key > best_key:
+                best, best_cfg, best_key = r, (overrides, opt_name), key
+        with open(results_path, "a") as f:  # per-point: tunnel may die
+            f.write(json.dumps(row) + "\n")
+
+    if best:
+        try:
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError):
+            baseline = {}
+        prev, _ = baseline_entry(baseline, model_name, "tpu")
+        if best["per_sec_per_chip"] > (prev or 0):
+            baseline[f"{model_name}:tpu"] = {
+                "value": best["per_sec_per_chip"],
+                "batch": best["batch"],
+                "variant": best.get("variant"),
+                "overrides": best_cfg[0],
+                "optimizer": best_cfg[1],
+            }
+            with open(baseline_path, "w") as f:
+                json.dump(baseline, f, indent=1, sort_keys=True)
+        print(json.dumps({"bench": tag, "best_mfu": best["mfu"],
+                          "best_batch": best["batch"],
+                          "best_variant": best.get("variant"),
+                          "per_sec_per_chip":
+                          best["per_sec_per_chip"]}))
+    return 0
 
 
 def bench_decode_row(jax, model_name: str, backend: str):
@@ -553,13 +701,44 @@ def main() -> int:
         batch = args.batch or (
             {"resnet50": 128, "gpt2-medium": 4, "bert-base": 16,
              "tinyllama-1.1b": 2}.get(name, 16) if on_accel else 8)
-        try:
-            r = bench_model(jax, name, batch, args.steps, args.warmup,
-                            backend)
-        except Exception as e:  # degrade, never crash the driver
-            print(f"# bench {name} failed: {type(e).__name__}: "
-                  f"{str(e)[:300]}", file=sys.stderr)
-            r = None
+        # The committed baseline records the CONFIG its best number was
+        # measured at; replay it first (see baseline_entry), falling
+        # back to the stock config if it fails (e.g. the best batch no
+        # longer fits after an unrelated model change).
+        attempts = []
+        _, base_cfg = baseline_entry(load_baseline(), name, backend)
+        if not args.batch and base_cfg and base_cfg.get("batch"):
+            try:
+                attempts.append(
+                    (base_cfg["batch"],
+                     decode_overrides(base_cfg.get("overrides")),
+                     base_cfg.get("variant"),
+                     decode_optimizer(base_cfg.get("optimizer"))))
+            except Exception as e:
+                # An undecodable recorded config (unknown optimizer
+                # name, bad override) must degrade to the stock config,
+                # never crash the driver (module contract).
+                print(f"# baseline config for {name} undecodable "
+                      f"({type(e).__name__}: {e}); using stock config",
+                      file=sys.stderr)
+        if not any(b == batch and not ov and not var
+                   for b, ov, var, _o in attempts):
+            attempts.append((batch, None, None, None))
+        r = None
+        for try_batch, overrides, variant, optimizer in attempts:
+            try:
+                r = bench_model(jax, name, try_batch, args.steps,
+                                args.warmup, backend,
+                                overrides=overrides, variant=variant,
+                                optimizer=optimizer)
+            except Exception as e:  # degrade, never crash the driver
+                print(f"# bench {name} b{try_batch}"
+                      f"{' ' + variant if variant else ''} failed: "
+                      f"{type(e).__name__}: {str(e)[:300]}",
+                      file=sys.stderr)
+                r = None
+            if r:
+                break
         if r:
             results.append(r)
             print(f"# {r['model']}: {r['per_sec_per_chip']} {r['unit']} "

@@ -16,10 +16,11 @@ This sweep walks that frontier on the real chip:
   if the model is compute- or bandwidth-bound at this size.
 - b8  remat-full  — isolates the recompute tax of full vs selective.
 
-Each point appends a ``{"bench": "gpt2-mfu-sweep"}`` row to
+Each point appends a ``{"bench": "gpt2-medium-mfu-sweep"}`` row to
 ``benchmarks/results.jsonl`` IMMEDIATELY (the tunnel can die mid-sweep),
 and the best point updates ``.bench_baseline.json`` under
-``gpt2-medium:tpu``.
+``gpt2-medium:tpu`` with its full config so the default bench replays
+it.
 
 Run: python benchmarks/bench_gpt2_mfu.py [--steps 20] [--quick]
 """
@@ -27,27 +28,25 @@ Run: python benchmarks/bench_gpt2_mfu.py [--steps 20] [--quick]
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench as B  # noqa: E402
 
-RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
-BASELINE = os.path.join(REPO, ".bench_baseline.json")
-
 
 def sweep_configs(quick: bool):
+    # (batch, variant, JSON-safe overrides, optimizer name) — see
+    # bench.run_mfu_sweep for the encoding contract.
     cfgs = [
-        # (batch, variant, config-field overrides)
-        (4, "base", None),
-        (8, "remat-dots", {"remat": True, "remat_policy": "dots_saveable"}),
-        (16, "remat-dots", {"remat": True, "remat_policy": "dots_saveable"}),
-        (8, "remat-full", {"remat": True}),
+        (4, "base", None, None),
+        (8, "remat-dots",
+         {"remat": True, "remat_policy": "dots_saveable"}, None),
+        (16, "remat-dots",
+         {"remat": True, "remat_policy": "dots_saveable"}, None),
+        (8, "remat-full", {"remat": True}, None),
     ]
     return cfgs[:2] if quick else cfgs
 
@@ -59,56 +58,10 @@ def main() -> int:
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--probe-budget", type=float, default=300.0)
     args = parser.parse_args()
-
-    jax, backend, fallback = B.init_backend(
-        False, probe_budget=args.probe_budget)
-    if backend != "tpu":
-        print(json.dumps({"bench": "gpt2-mfu-sweep",
-                          "skipped": f"backend={backend}"}))
-        return 0
-
-    best = None
-    for batch, variant, overrides in sweep_configs(args.quick):
-        t0 = time.time()
-        try:
-            r = B.bench_model(jax, "gpt2-medium", batch, args.steps,
-                              args.warmup, backend, overrides=overrides,
-                              variant=variant)
-        except Exception as e:
-            r = None
-            print(f"# {variant} b{batch} failed: {type(e).__name__}: "
-                  f"{str(e)[:200]}", file=sys.stderr)
-        if not r:
-            row = {"bench": "gpt2-mfu-sweep", "ts": time.time(),
-                   "model": "gpt2-medium", "batch": batch,
-                   "variant": variant, "failed": True}
-        else:
-            row = {"bench": "gpt2-mfu-sweep", "ts": time.time(),
-                   "variant": variant,
-                   "wall_s": round(time.time() - t0, 1), **r}
-            print(f"# b{batch} {variant}: {r['per_sec_per_chip']} "
-                  f"tok/sec mfu={r['mfu']}", file=sys.stderr)
-            if best is None or r["mfu"] > best["mfu"]:
-                best = r
-        with open(RESULTS, "a") as f:  # append per-point: tunnel may die
-            f.write(json.dumps(row) + "\n")
-
-    if best:
-        try:
-            with open(BASELINE) as f:
-                baseline = json.load(f)
-        except (OSError, ValueError):
-            baseline = {}
-        if best["per_sec_per_chip"] > baseline.get("gpt2-medium:tpu", 0):
-            baseline["gpt2-medium:tpu"] = best["per_sec_per_chip"]
-            with open(BASELINE, "w") as f:
-                json.dump(baseline, f, indent=1, sort_keys=True)
-        print(json.dumps({"bench": "gpt2-mfu-sweep", "best_mfu":
-                          best["mfu"], "best_batch": best["batch"],
-                          "best_variant": best.get("variant"),
-                          "tok_sec_chip": best["per_sec_per_chip"]}))
-    return 0
+    return B.run_mfu_sweep("gpt2-medium", sweep_configs(args.quick),
+                           steps=args.steps, warmup=args.warmup,
+                           probe_budget=args.probe_budget)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -27,6 +27,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+import bench as B  # noqa: E402  (lazy jax imports only — safe pre-env)
+
 RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
 
 V5E_PEAK_BF16 = 197e12
@@ -161,15 +163,15 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     only = set(args.models.split(",")) if args.models else None
     rows = []
     for model_name, batch, overrides, variant in CONFIGS:
         if only and model_name not in only:
             continue
-        if overrides and overrides.get("norm_dtype") == "bf16":
-            overrides = {**overrides, "norm_dtype": jnp.bfloat16}
+        # CONFIGS store dtype-valued fields by name; one canonical
+        # decoder (bench.decode_overrides) maps them to real dtypes.
+        overrides = B.decode_overrides(overrides)
         label = f"{model_name}{'/' + variant if variant else ''} b{batch}"
         try:
             t0 = time.time()

@@ -11,10 +11,11 @@ conv-net MFU on a v5e chip:
 - **BN dtype** f32 vs bf16: the normalize-scale-shift chain in bf16
   halves its HBM traffic and fuses into the conv epilogue.
 
-Each point appends a ``{"bench": "resnet-mfu-sweep"}`` row to
+Each point appends a ``{"bench": "resnet50-mfu-sweep"}`` row to
 ``benchmarks/results.jsonl`` IMMEDIATELY (the tunnel can die mid-sweep
 — r2 lost its queued sweep to exactly that), and the best point updates
-``.bench_baseline.json`` under ``resnet50:tpu``.
+``.bench_baseline.json`` under ``resnet50:tpu`` with its full config
+(batch/overrides/optimizer) so the default bench replays it.
 
 Run: python benchmarks/bench_resnet_mfu.py [--steps 30] [--quick]
 """
@@ -22,42 +23,32 @@ Run: python benchmarks/bench_resnet_mfu.py [--steps 30] [--quick]
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench as B  # noqa: E402
 
-RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
-BASELINE = os.path.join(REPO, ".bench_baseline.json")
-
 
 def sweep_configs(quick: bool):
-    import jax.numpy as jnp
-    import optax
-
-    def sgd_plain():
-        return optax.sgd(0.1)
-
+    # (batch, variant, JSON-safe overrides, optimizer name) — see
+    # bench.run_mfu_sweep for the encoding contract.
     cfgs = [
-        # (batch, variant, overrides, optimizer_factory)
         (128, "base", None, None),
         (256, "base", None, None),
         (512, "base", None, None),
-        (256, "sgd-nomom", None, sgd_plain),
-        (256, "bn-bf16", {"norm_dtype": jnp.bfloat16}, None),
-        (512, "bn-bf16", {"norm_dtype": jnp.bfloat16}, None),
-        (512, "bn-bf16+nomom", {"norm_dtype": jnp.bfloat16}, sgd_plain),
+        (256, "sgd-nomom", None, "sgd-nomom"),
+        (256, "bn-bf16", {"norm_dtype": "bf16"}, None),
+        (512, "bn-bf16", {"norm_dtype": "bf16"}, None),
+        (512, "bn-bf16+nomom", {"norm_dtype": "bf16"}, "sgd-nomom"),
         # MLPerf space-to-depth stem: the 7x7/s2-on-3-channels conv is
         # the lowest-occupancy MXU op in the net (exact-equivalence
         # pinned in tests/test_models.py::TestSpaceToDepthStem).
         (256, "s2d-stem", {"stem": "space_to_depth"}, None),
         (512, "s2d-stem+bn-bf16",
-         {"stem": "space_to_depth", "norm_dtype": jnp.bfloat16}, None),
+         {"stem": "space_to_depth", "norm_dtype": "bf16"}, None),
     ]
     return cfgs[:3] if quick else cfgs
 
@@ -69,56 +60,10 @@ def main() -> int:
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--probe-budget", type=float, default=300.0)
     args = parser.parse_args()
-
-    jax, backend, fallback = B.init_backend(
-        False, probe_budget=args.probe_budget)
-    if backend != "tpu":
-        print(json.dumps({"bench": "resnet-mfu-sweep",
-                          "skipped": f"backend={backend}"}))
-        return 0
-
-    best = None
-    for batch, variant, overrides, opt_factory in sweep_configs(args.quick):
-        t0 = time.time()
-        try:
-            r = B.bench_model(
-                jax, "resnet50", batch, args.steps, args.warmup, backend,
-                overrides=overrides, variant=variant,
-                optimizer=opt_factory() if opt_factory else None)
-        except Exception as e:
-            r = None
-            print(f"# {variant} b{batch} failed: {type(e).__name__}: "
-                  f"{str(e)[:200]}", file=sys.stderr)
-        if not r:
-            row = {"bench": "resnet-mfu-sweep", "ts": time.time(),
-                   "model": "resnet50", "batch": batch,
-                   "variant": variant, "failed": True}
-        else:
-            row = {"bench": "resnet-mfu-sweep", "ts": time.time(),
-                   "wall_s": round(time.time() - t0, 1), **r}
-            print(f"# b{batch} {variant}: {r['per_sec_per_chip']} "
-                  f"img/sec mfu={r['mfu']}", file=sys.stderr)
-            if best is None or r["mfu"] > best["mfu"]:
-                best = r
-        with open(RESULTS, "a") as f:  # append per-point: tunnel may die
-            f.write(json.dumps(row) + "\n")
-
-    if best:
-        try:
-            with open(BASELINE) as f:
-                baseline = json.load(f)
-        except (OSError, ValueError):
-            baseline = {}
-        if best["per_sec_per_chip"] > baseline.get("resnet50:tpu", 0):
-            baseline["resnet50:tpu"] = best["per_sec_per_chip"]
-            with open(BASELINE, "w") as f:
-                json.dump(baseline, f, indent=1, sort_keys=True)
-        print(json.dumps({"bench": "resnet-mfu-sweep", "best_mfu":
-                          best["mfu"], "best_batch": best["batch"],
-                          "best_variant": best.get("variant"),
-                          "img_sec_chip": best["per_sec_per_chip"]}))
-    return 0
+    return B.run_mfu_sweep("resnet50", sweep_configs(args.quick),
+                           steps=args.steps, warmup=args.warmup,
+                           probe_budget=args.probe_budget)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -9,14 +9,17 @@ cd "$(dirname "$0")/.."
 LOG=benchmarks/sweep_r4.log
 
 for i in $(seq 1 720); do
-    grep -q "SWEEP COMPLETE" "$LOG" 2>/dev/null && break
-    # If the sweep process died without the marker, stop waiting too —
-    # but only after a grace period, so launching this a moment before
-    # tpu_sweep.sh (or across a sweep restart) can't fall through and
-    # contend with it for the one chip.
-    if [ "$i" -gt 10 ] && ! pgrep -f tpu_sweep.sh >/dev/null; then
-        break
+    # A LIVE sweep always wins the chip — keep waiting regardless of
+    # any (possibly stale, from a prior run) completion marker in the
+    # persistent log.
+    if pgrep -f "bash.*tpu_sweep.sh" >/dev/null; then
+        sleep 30
+        continue
     fi
+    # No sweep running.  Grace period covers launching this script a
+    # moment before tpu_sweep.sh starts; a marker short-circuits it.
+    [ "$i" -gt 10 ] && break
+    grep -q "SWEEP COMPLETE" "$LOG" 2>/dev/null && break
     sleep 30
 done
 

@@ -79,6 +79,25 @@ def run_sweep() -> None:
         log(f"abandoned sweep finally exited "
             f"rc={_abandoned_sweep.returncode}")
         _abandoned_sweep = None
+    # A chip-holding bench launched OUTSIDE this watcher (interactive
+    # session firing tpu_sweep.sh or an individual bench script
+    # directly) is the same hazard: never stack a second TPU workload
+    # on the one chip.  Match only processes that actually hold the
+    # chip: a live tpu_sweep.sh driver, or a python bench process —
+    # NOT sweep_followup.sh sitting in its wait loop (it defers to the
+    # sweep already and must not block it).
+    ext = subprocess.run(
+        ["pgrep", "-f",
+         r"bash.*tpu_sweep\.sh|python.*(bench\.py|bench_gpt2_mfu"
+         r"|bench_resnet_mfu|bench_roofline_probe|bench_decode"
+         r"|bench_windowed|bench_offline_v5e)"],
+        capture_output=True, text=True)
+    others = [p for p in ext.stdout.split()
+              if p.isdigit() and int(p) != os.getpid()]
+    if others:
+        log(f"external TPU workload running (pids {others}); not "
+            f"starting a sweep")
+        return
     set_state("sweeping")
     log("tunnel UP -> running tpu_sweep.sh")
     try:
