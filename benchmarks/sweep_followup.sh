@@ -8,18 +8,24 @@ set -x
 cd "$(dirname "$0")/.."
 LOG=benchmarks/sweep_r4.log
 
+# Markers already in the persistent log are from PRIOR sweep runs and
+# must not short-circuit the wait; only a marker appended after this
+# script started proves the current sweep finished.
+BASE_MARKERS=$(grep -c "SWEEP COMPLETE" "$LOG" 2>/dev/null || true)
+BASE_MARKERS=${BASE_MARKERS:-0}
+
 for i in $(seq 1 720); do
     # A LIVE sweep always wins the chip — keep waiting regardless of
-    # any (possibly stale, from a prior run) completion marker in the
-    # persistent log.
+    # markers.
     if pgrep -f "bash.*tpu_sweep.sh" >/dev/null; then
         sleep 30
         continue
     fi
-    # No sweep running.  Grace period covers launching this script a
-    # moment before tpu_sweep.sh starts; a marker short-circuits it.
+    NOW_MARKERS=$(grep -c "SWEEP COMPLETE" "$LOG" 2>/dev/null || true)
+    [ "${NOW_MARKERS:-0}" -gt "$BASE_MARKERS" ] && break
+    # No sweep running and no fresh marker.  Grace period covers
+    # launching this script a moment before tpu_sweep.sh starts.
     [ "$i" -gt 10 ] && break
-    grep -q "SWEEP COMPLETE" "$LOG" 2>/dev/null && break
     sleep 30
 done
 

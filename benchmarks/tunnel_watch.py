@@ -85,7 +85,12 @@ def run_sweep() -> None:
     # on the one chip.  Match only processes that actually hold the
     # chip: a live tpu_sweep.sh driver, or a python bench process —
     # NOT sweep_followup.sh sitting in its wait loop (it defers to the
-    # sweep already and must not block it).
+    # sweep already and must not block it).  bench_offline_v5e stays in
+    # the list even though it compiles devicelessly: the TPU compiler
+    # still takes the libtpu multi-process lockfile (observed: it
+    # ABORTS with "Internal error when accessing libtpu multi-process
+    # lockfile" when a bench holds the chip — the contention is real
+    # and bidirectional).
     ext = subprocess.run(
         ["pgrep", "-f",
          r"bash.*tpu_sweep\.sh|python.*(bench\.py|bench_gpt2_mfu"
