@@ -525,6 +525,120 @@ def bench_decode_row(jax, model_name: str, backend: str):
     return mod.bench_decode(jax, model_name, backend)
 
 
+_PENDING_ROWS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks", ".pending_rows.jsonl")
+
+
+def _register_pending(row_file: str, label: str) -> None:
+    """Remember an abandoned child's row file so a LATER invocation can
+    harvest it: a wedge-hung child keeps running after the parent moves
+    on, and when the tunnel unwedges it may well finish and write a
+    perfectly good TPU row that would otherwise never be read.
+
+    Takes the same lock as harvest_pending_rows so a registration
+    can't land between a concurrent harvester's read and rewrite (and
+    be erased by the rewrite).
+    """
+    import fcntl
+
+    try:
+        with open(_PENDING_ROWS + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)  # harvest holds it briefly
+            with open(_PENDING_ROWS, "a") as f:
+                f.write(json.dumps({"row_file": row_file,
+                                    "label": label,
+                                    "ts": time.time()}) + "\n")
+    except OSError:
+        pass
+
+
+def harvest_pending_rows() -> int:
+    """Collect rows from previously abandoned bench children.
+
+    Appends any complete, accelerator-backed row to results.jsonl and
+    rewrites the pending list with only the entries still worth
+    waiting for (file exists but is empty/unparsable — the child may
+    still be mid-run).  Returns the number of rows harvested.
+
+    Ordering/robustness contract: rows are appended BEFORE their
+    source files are unlinked (a failed append must not destroy
+    evidence); torn registry lines (parent killed mid-append) are
+    skipped individually, not allowed to poison the whole file; and a
+    file lock serializes concurrent invocations (sweep + follow-up
+    overlapping) so a row is neither double-appended nor a concurrent
+    registration lost in the rewrite.
+    """
+    import fcntl
+
+    try:
+        lock = open(_PENDING_ROWS + ".lock", "w")
+    except OSError:
+        return 0
+    try:
+        try:
+            fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return 0  # another invocation is harvesting; let it
+        entries = []
+        try:
+            with open(_PENDING_ROWS) as f:
+                for line in f:
+                    try:
+                        entries.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn line from a killed writer
+        except OSError:
+            return 0
+        harvested, consumed, keep = [], [], []
+        for e in entries:
+            path = e.get("row_file")
+            try:
+                with open(path) as f:
+                    row = json.load(f)
+            except (OSError, TypeError):
+                continue  # file gone: child cleaned up or /tmp purged
+            except ValueError:
+                # Exists but incomplete: the child may still finish —
+                # keep, unless it's been pending so long the child is
+                # surely dead (then drop AND clean the temp file).
+                if time.time() - e.get("ts", 0) < 48 * 3600:
+                    keep.append(e)
+                else:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                continue
+            if row.get("backend") in ("tpu", "gpu"):
+                harvested.append(row)
+                print(f"# harvested abandoned {e.get('label')} row "
+                      f"(written after its parent gave up)",
+                      file=sys.stderr)
+            consumed.append(path)
+        try:
+            if harvested:
+                _append_results(harvested)
+        except OSError:
+            return 0  # keep registry + files intact for a retry
+        for path in consumed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        try:
+            if keep:
+                with open(_PENDING_ROWS, "w") as f:
+                    for e in keep:
+                        f.write(json.dumps(e) + "\n")
+            else:
+                os.unlink(_PENDING_ROWS)
+        except OSError:
+            pass
+        return len(harvested)
+    finally:
+        lock.close()
+
+
 def _run_isolated(args_list, timeout_s: float, label: str):
     """Run one bench job as a subprocess with its own timeout.
 
@@ -542,14 +656,17 @@ def _run_isolated(args_list, timeout_s: float, label: str):
            *args_list, "--row-file", row_file, "--probe-budget", "180"]
     proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
                             stderr=sys.stderr, start_new_session=True)
+    registered = False
     try:
         try:
             rc = proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             # The abandoned child still holds row_file; leave it on
-            # disk for the child and clean the path reference only.
+            # disk for the child and register it for a later harvest.
             print(f"# bench {label} hung >{timeout_s:.0f}s; abandoned "
                   f"(not killed: wedge hazard)", file=sys.stderr)
+            _register_pending(row_file, label)
+            registered = True
             return None
         if rc != 0:
             print(f"# bench {label} exited rc={rc}", file=sys.stderr)
@@ -570,7 +687,11 @@ def _run_isolated(args_list, timeout_s: float, label: str):
             return None
         return row
     finally:
-        if proc.poll() is not None:
+        # A registered file belongs to the harvest mechanism now — the
+        # child may finish (and write its row) in the instant between
+        # registration and this poll(); unlinking here would destroy
+        # exactly the late row harvesting exists to save.
+        if not registered and proc.poll() is not None:
             try:
                 os.unlink(row_file)
             except OSError:
@@ -626,6 +747,15 @@ def main() -> int:
              "subprocess; a hung model is abandoned, not killed.")
     args = parser.parse_args()
 
+    # Rows written by children a PREVIOUS invocation abandoned (wedge
+    # hangs) are evidence too — collect them before anything else.
+    # Never let a harvest problem break a bench run (module contract).
+    try:
+        harvest_pending_rows()
+    except Exception as e:
+        print(f"# pending-row harvest failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     jax, backend, fallback = init_backend(args.cpu,
                                           probe_timeout=args.probe_timeout,
                                           probe_budget=args.probe_budget)
@@ -661,7 +791,7 @@ def main() -> int:
                 ("resnet50", "gpt2-medium", "bert-base",
                  "tinyllama-1.1b")]
         jobs.append(("decode", "gpt2-medium"))
-        results, extra_rows = [], []
+        results = []
         for kind, name in jobs:
             if kind == "train":
                 child = ["--model", name]
@@ -675,13 +805,16 @@ def main() -> int:
                                 f"{kind}:{name}")
             if not row:
                 continue
+            # Append IMMEDIATELY: if enough later jobs hang out their
+            # per-model budgets, the outer sweep timeout kills this
+            # parent before the loop ends — a batch append at the end
+            # would lose every row already measured (nearly lost the
+            # round's only TPU row to exactly this).
+            _append_results([row])
             if kind == "train":
                 results.append(row)
                 print(f"# {row['model']}: {row['per_sec_per_chip']} "
                       f"{row['unit']} mfu={row['mfu']}", file=sys.stderr)
-            else:
-                extra_rows.append(row)  # decode rows carry bench="decode"
-        _append_results(results + extra_rows)
         emit(results[0] if results else None, fallback)
         return 0
 

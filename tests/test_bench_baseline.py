@@ -204,6 +204,94 @@ class TestRunMfuSweep:
         assert rc == 0 and not baseline and not rows
 
 
+class TestHarvestPendingRows:
+    def _setup(self, tmp_path, entries):
+        B = _load_bench(tmp_path)
+        with open(B._PENDING_ROWS, "w") as f:
+            for e in entries:
+                f.write(json.dumps(e) + "\n")
+        return B
+
+    def test_harvests_completed_tpu_row(self, tmp_path):
+        row_file = tmp_path / "late_row.json"
+        row = {"model": "gpt2-medium", "backend": "tpu", "batch": 4,
+               "per_sec_per_chip": 9000.0, "unit": "tok/sec/chip"}
+        row_file.write_text(json.dumps(row))
+        B = self._setup(tmp_path, [{"row_file": str(row_file),
+                                    "label": "train:gpt2-medium",
+                                    "ts": 1.0}])
+        assert B.harvest_pending_rows() == 1
+        rows = [json.loads(l) for l in
+                (tmp_path / "benchmarks" /
+                 "results.jsonl").read_text().splitlines()]
+        assert rows[0]["model"] == "gpt2-medium"
+        assert rows[0]["bench"] == "headline"
+        assert not row_file.exists()  # consumed
+        assert not os.path.exists(B._PENDING_ROWS)  # list drained
+
+    def test_discards_cpu_fallback_row(self, tmp_path):
+        row_file = tmp_path / "cpu_row.json"
+        row_file.write_text(json.dumps({"model": "bert-base",
+                                        "backend": "cpu"}))
+        B = self._setup(tmp_path, [{"row_file": str(row_file),
+                                    "label": "train:bert-base",
+                                    "ts": 1.0}])
+        assert B.harvest_pending_rows() == 0
+        assert not (tmp_path / "benchmarks" / "results.jsonl").exists()
+        assert not row_file.exists()  # consumed either way
+
+    def test_keeps_incomplete_fresh_drops_stale(self, tmp_path):
+        import time as _time
+
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text("")  # child mid-run: empty file
+        stale = tmp_path / "stale.json"
+        stale.write_text("")
+        B = self._setup(tmp_path, [
+            {"row_file": str(fresh), "label": "a", "ts": _time.time()},
+            {"row_file": str(stale), "label": "b",
+             "ts": _time.time() - 60 * 3600},
+        ])
+        assert B.harvest_pending_rows() == 0
+        kept = [json.loads(l) for l in
+                open(B._PENDING_ROWS).read().splitlines()]
+        assert [e["label"] for e in kept] == ["a"]
+
+    def test_missing_file_dropped(self, tmp_path):
+        B = self._setup(tmp_path, [{"row_file": str(tmp_path / "gone"),
+                                    "label": "x", "ts": 1.0}])
+        assert B.harvest_pending_rows() == 0
+        assert not os.path.exists(B._PENDING_ROWS)
+
+    def test_torn_registry_line_skipped(self, tmp_path):
+        # A parent killed mid-append leaves a truncated JSON line; it
+        # must not poison the entries around it.
+        row_file = tmp_path / "good.json"
+        row_file.write_text(json.dumps({"model": "resnet50",
+                                        "backend": "tpu",
+                                        "per_sec_per_chip": 2500.0}))
+        B = _load_bench(tmp_path)
+        with open(B._PENDING_ROWS, "w") as f:
+            f.write('{"row_file": "/tmp/x", "lab\n')  # torn
+            f.write(json.dumps({"row_file": str(row_file),
+                                "label": "train:resnet50",
+                                "ts": 1.0}) + "\n")
+        assert B.harvest_pending_rows() == 1
+
+    def test_register_then_harvest_roundtrip(self, tmp_path):
+        B = _load_bench(tmp_path)
+        row_file = tmp_path / "late.json"
+        B._register_pending(str(row_file), "train:x")
+        # Child hasn't written yet (no file): entry survives as-is...
+        assert B.harvest_pending_rows() == 0
+        # (file absent -> entry dropped, matching _run_isolated's
+        # contract that a vanished file means the child cleaned up)
+        row_file.write_text(json.dumps({"backend": "tpu", "model": "x",
+                                        "per_sec_per_chip": 1.0}))
+        B._register_pending(str(row_file), "train:x")
+        assert B.harvest_pending_rows() == 1
+
+
 class TestRegistryOverrides:
     def test_config_field_overrides(self):
         from polyaxon_tpu.models.registry import get_model
