@@ -745,6 +745,11 @@ def main() -> int:
         default=float(os.environ.get("BENCH_MODEL_TIMEOUT", 1500.0)),
         help="--all on an accelerator: wall-clock budget per model "
              "subprocess; a hung model is abandoned, not killed.")
+    parser.add_argument(
+        "--require-accel", action="store_true",
+        help="Exit (with a skip JSON line) instead of benching if the "
+             "accelerator probe falls back to CPU — for sweep legs "
+             "whose CPU rows would be discarded anyway.")
     args = parser.parse_args()
 
     # Rows written by children a PREVIOUS invocation abandoned (wedge
@@ -763,6 +768,25 @@ def main() -> int:
         emit(None, True)
         return 0
     on_accel = backend in ("tpu", "gpu")
+    if (args.require_accel or args.row_file) and not on_accel:
+        # An --all child's CPU-fallback row is discarded by the parent,
+        # and a sweep leg's is worthless — yet a fallen-back child used
+        # to spend the better part of an hour CPU-benching a 1.1B model
+        # to produce it, starving every other process on the box.  Exit
+        # instead (the driver's own invocation passes neither flag and
+        # keeps the full fallback behavior).
+        if args.row_file:
+            # A non-accel marker row: if this child was registered as
+            # pending (abandoned then recovered as CPU), the next
+            # harvest parses it, discards it, and cleans up the file —
+            # instead of re-polling an empty temp file for 48h.
+            with open(args.row_file, "w") as f:
+                json.dump({"backend": backend, "skipped": True}, f)
+        print(json.dumps({"metric": "bench skipped (accel required)",
+                          "value": 0, "unit": "", "vs_baseline": None,
+                          "backend": backend,
+                          "last_tpu": last_tpu_row()}))
+        return 0
 
     if args.decode:
         # Single decode job (also the --all subprocess leg).

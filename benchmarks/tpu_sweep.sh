@@ -20,7 +20,7 @@ timeout 5400 python bench.py --all --probe-timeout 60 --probe-budget 120 \
 #     row when --all succeeded is harmless; a fourth round with NO
 #     tinyllama row is not.
 timeout 2400 python bench.py --model tinyllama-1.1b --steps 10 \
-    --probe-budget 120 || true
+    --probe-budget 120 --require-accel || true
 
 # 2. ResNet-50 MFU sweep: batch x variants (VERDICT r2 task 2 — the
 #    s2d stem + bf16-BN knobs are unmeasured).

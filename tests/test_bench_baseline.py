@@ -292,6 +292,33 @@ class TestHarvestPendingRows:
         assert B.harvest_pending_rows() == 1
 
 
+class TestRequireAccel:
+    def test_child_skips_on_cpu_fallback(self, tmp_path, monkeypatch,
+                                         capsys):
+        # A --row-file child (or --require-accel sweep leg) that falls
+        # back to CPU must exit with a skip line, NOT burn an hour
+        # CPU-benching a model whose row gets discarded anyway.
+        B = _load_bench(tmp_path)
+        B.init_backend = lambda *a, **k: (None, "cpu", True)
+        B.bench_model = lambda *a, **k: pytest.fail(
+            "bench_model must not run on a fallen-back child")
+        monkeypatch.setattr(sys, "argv",
+                            ["bench.py", "--model", "resnet50",
+                             "--row-file", str(tmp_path / "row.json")])
+        assert B.main() == 0
+        line = json.loads(capsys.readouterr().out)
+        assert "skipped" in line["metric"]
+        # The child leaves a non-accel marker row so a pending-registry
+        # entry pointing at this file is discarded (and the temp file
+        # unlinked) by the next harvest rather than re-polled for 48h.
+        marker = json.loads((tmp_path / "row.json").read_text())
+        assert marker["backend"] == "cpu"
+        B._register_pending(str(tmp_path / "row.json"), "train:x")
+        assert B.harvest_pending_rows() == 0
+        assert not (tmp_path / "row.json").exists()
+        assert not os.path.exists(B._PENDING_ROWS)
+
+
 class TestRegistryOverrides:
     def test_config_field_overrides(self):
         from polyaxon_tpu.models.registry import get_model
