@@ -1,0 +1,24 @@
+#!/bin/bash
+# Re-run the sweep legs that skipped while an abandoned decode child
+# held the chip (tpu_sweep.sh's legs probe-skip when another process
+# owns the TPU).  Waits for the child to exit, then runs the skipped
+# legs in value order — the hang-prone decode bench goes LAST so a
+# repeat of the generate-compile hang can't starve the MFU sweeps.
+set -x
+cd "$(dirname "$0")/.."
+
+# Up to 2h for the abandoned child (it is making progress; killing a
+# process mid-TPU-RPC risks wedging the tunnel for the whole round).
+for i in $(seq 1 240); do
+    pgrep -f "bench.py --decode" >/dev/null || break
+    sleep 30
+done
+# Let the tunnel settle after the child exits.
+sleep 15
+
+timeout 3600 python benchmarks/bench_resnet_mfu.py || true
+timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
+timeout 1200 python benchmarks/bench_roofline_probe.py || true
+timeout 2400 python benchmarks/bench_windowed.py || true
+timeout 2400 python benchmarks/bench_decode.py || true
+echo "RESWEEP COMPLETE $(date)"
