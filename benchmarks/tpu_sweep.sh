@@ -1,36 +1,30 @@
 #!/bin/bash
 # TPU sweep run by tunnel_watch.py the moment the tunnel answers.
-# Keep FAST things first: the tunnel died mid-round in r2, so the order
-# is (1) headline rows, (2) resnet MFU sweep, (3) serving/windowed.
+#
+# Round-4 state: the full headline set (resnet50 / gpt2-medium /
+# bert-base / tinyllama-1.1b) landed in a ~50-minute window before the
+# tunnel wedged again, so this script now carries only the STILL-
+# MISSING evidence, ordered by value-per-minute (the windows are
+# short; cheap high-value probes first, hang-prone giant compiles
+# last):
+#   1. roofline probe  — measured HBM BW + MXU TFLOP/s -> tightens the
+#                        MFU ceiling analysis in docs/SCALING.md §2b.
+#   2. resnet50 MFU sweep — batch x s2d-stem x bf16-BN x nomom
+#                        (VERDICT r2 task 2; ceilings predicted
+#                        offline, unmeasured).
+#   3. decode/serving rows — tok/sec + KV-bytes + TTFT (no decode row
+#                        has EVER landed on hardware; the gpt2-medium
+#                        generate() compiles hung the last window, so
+#                        this leg sits behind the two above).
+#   4. windowed A/B     — O(W) remap vs no-remap at seq 8k / window 1k.
+#   5. gpt2-medium MFU sweep — remat x batch (biggest compiles, last).
 set -x
 cd "$(dirname "$0")/.."
 
-# 1. Full current-regime evidence set in ONE invocation (resnet50,
-#    gpt2-medium, bert-base, tinyllama-1.1b + a decode row), each model
-#    in its own subprocess with its own timeout (bench.py --all on an
-#    accelerator).  Outer timeout > 5 x per-model so the parent always
-#    outlives its children — an outer kill would orphan a child that
-#    still holds the one chip and poison the steps below.
-timeout 5400 python bench.py --all --probe-timeout 60 --probe-budget 120 \
-    --per-model-timeout 900 || true
-
-# 1b. Dedicated tinyllama retry: its cold-cache seq-2048 remat compile
-#     plus tunnel dispatch can blow --all's 900 s per-model budget (the
-#     reason it had its own leg before --all covered it).  A duplicate
-#     row when --all succeeded is harmless; a fourth round with NO
-#     tinyllama row is not.
-timeout 2400 python bench.py --model tinyllama-1.1b --steps 10 \
-    --probe-budget 120 --require-accel || true
-
-# 2. ResNet-50 MFU sweep: batch x variants (VERDICT r2 task 2 — the
-#    s2d stem + bf16-BN knobs are unmeasured).
+timeout 1200 python benchmarks/bench_roofline_probe.py || true
 timeout 3600 python benchmarks/bench_resnet_mfu.py || true
-
-# 3. Decode/serving rows incl. tinyllama TTFT curves (VERDICT r2 task 7).
 timeout 2400 python benchmarks/bench_decode.py || true
-
-# 4. Windowed-attention O(W) remap A/B at seq 8k / window 1k (VERDICT
-#    r2 task 4).
 timeout 2400 python benchmarks/bench_windowed.py || true
+timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
 
 echo "SWEEP COMPLETE $(date)"
