@@ -21,3 +21,4 @@ from .convnet import ConvNet  # noqa: F401
 from .resnet import ResNet, ResNet50  # noqa: F401
 from .bert import BertConfig, BertModel  # noqa: F401
 from .gpt2 import GPT2Config, GPT2Model  # noqa: F401
+from .t5 import T5Config, T5Model  # noqa: F401

@@ -21,15 +21,21 @@ import jax
 import jax.numpy as jnp
 
 
-def init_cache(model, batch_size: int):
+def init_cache(model, batch_size: int, *extra, method=None):
     """Allocate the stacked per-layer KV cache for ``model``, all
     zeros with cache_index 0.  (Abstract init only: running a real
     init decode step would advance the index and write a garbage
-    token-0 entry.)"""
+    token-0 entry.)
+
+    ``extra``/``method``: for encoder-decoder models whose decode
+    entrypoint is a named flax method with side inputs —
+    ``init_cache(model, b, enc_out, method="decode")`` (see
+    :func:`generate_seq2seq`)."""
     tokens = jnp.zeros((batch_size, 1), jnp.int32)
+    kw = {} if method is None else {"method": method}
     shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), tokens, decode=True,
-                           decode_position=0))
+        lambda: model.init(jax.random.PRNGKey(0), tokens, *extra,
+                           decode=True, decode_position=0, **kw))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         shapes["cache"])
 
@@ -59,6 +65,44 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def _decode_loop(apply_step, cache, first_logits, *,
+                 max_new_tokens: int, rng, temperature: float,
+                 top_k: Optional[int], eos_id: Optional[int]):
+    """Shared sample-first + scan-over-tokens machinery for
+    :func:`generate` and :func:`generate_seq2seq` (one place owns the
+    eos-freeze and sampling semantics).
+
+    ``apply_step(cache, tok, t) -> (logits, cache)`` runs one decoder
+    step on ``tok`` [B] at scan tick ``t`` (the caller's closure maps
+    ``t`` to its absolute decode position).  Returns the generated
+    tokens [B, max_new_tokens].
+    """
+    rng, key = jax.random.split(rng)
+    first = _sample(first_logits, key, temperature, top_k)
+    done = jnp.zeros((first.shape[0],), bool)
+    if eos_id is not None:
+        done = first == eos_id
+
+    def step(carry, t):
+        cache, tok, rng, done = carry
+        logits, cache = apply_step(cache, tok, t)
+        rng, key = jax.random.split(rng)
+        nxt = _sample(logits, key, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt.astype(jnp.int32), rng, done), nxt
+
+    if max_new_tokens > 1:
+        _, toks = jax.lax.scan(
+            step, (cache, first.astype(jnp.int32), rng, done),
+            jnp.arange(max_new_tokens - 1))
+        new = jnp.concatenate([first[:, None], toks.T], axis=1)
+    else:
+        new = first[:, None]
+    return new.astype(jnp.int32)
 
 
 def generate(model, variables, prompt, *, max_new_tokens: int,
@@ -99,34 +143,74 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
         prompt, decode=True, decode_position=0, last_only=True,
         mutable=["cache"])
     cache = mut["cache"]
-    rng, key = jax.random.split(rng)
-    first = _sample(extract_logits(out)[:, -1], key, temperature, top_k)
-    done = jnp.zeros((b,), bool)
-    if eos_id is not None:
-        done = first == eos_id
 
-    def step(carry, t):
-        cache, tok, rng, done = carry
+    def apply_step(cache, tok, t):
         out, mut = model.apply(
             {"params": variables["params"], "cache": cache},
             tok[:, None], decode=True, decode_position=p_len + t,
             mutable=["cache"])
-        logits = extract_logits(out)
-        rng, key = jax.random.split(rng)
-        nxt = _sample(logits[:, -1], key, temperature, top_k)
-        if eos_id is not None:
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
-        return (mut["cache"], nxt.astype(jnp.int32), rng, done), nxt
+        return extract_logits(out)[:, -1], mut["cache"]
 
-    if max_new_tokens > 1:
-        (_, _, _, _), toks = jax.lax.scan(
-            step, (cache, first.astype(jnp.int32), rng, done),
-            jnp.arange(max_new_tokens - 1))
-        new = jnp.concatenate([first[:, None], toks.T], axis=1)
-    else:
-        new = first[:, None]
-    return jnp.concatenate([prompt, new.astype(jnp.int32)], axis=1)
+    new = _decode_loop(apply_step, cache, extract_logits(out)[:, -1],
+                       max_new_tokens=max_new_tokens, rng=rng,
+                       temperature=temperature, top_k=top_k,
+                       eos_id=eos_id)
+    return jnp.concatenate([prompt, new], axis=1)
+
+
+def generate_seq2seq(model, variables, enc_tokens, *,
+                     max_new_tokens: int, temperature: float = 0.0,
+                     top_k: Optional[int] = None,
+                     rng: Optional[jax.Array] = None,
+                     eos_id: Optional[int] = None,
+                     enc_mask: Optional[jax.Array] = None,
+                     start_id: Optional[int] = None) -> jax.Array:
+    """Seq2seq generation (T5-style encoder-decoder models).
+
+    Encodes ``enc_tokens`` [B, S] ONCE, then runs the decoder token by
+    token through its KV cache in a single ``lax.scan`` (same
+    compile-once shape as :func:`generate`).  The model must expose
+    ``encode``/``decode`` flax methods (see models/t5.py).  Returns the
+    GENERATED tokens [B, max_new_tokens] (no prompt prefix — the
+    decoder's start token is bookkeeping, not output).
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1; got "
+                         f"{max_new_tokens}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if start_id is None:
+        start_id = model.cfg.pad_id
+    max_pos = getattr(model.cfg, "max_position", None)
+    if max_pos is not None and max_new_tokens > max_pos:
+        # Cache slots used: the start token at 0 plus the fed-back
+        # generated tokens at 1..max_new_tokens-1 (the last token is
+        # never fed back) — exactly max_new_tokens slots.
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds the decoder's "
+            f"max_position ({max_pos})")
+    enc_tokens = jnp.asarray(enc_tokens, jnp.int32)
+    b = enc_tokens.shape[0]
+    params = {"params": variables["params"]}
+    enc_out = model.apply(params, enc_tokens, enc_mask=enc_mask,
+                          method="encode")
+
+    start = jnp.full((b, 1), start_id, jnp.int32)
+    cache = init_cache(model, b, enc_out, method="decode")
+
+    def apply_step(cache, tok, pos):
+        out, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            tok, enc_out, enc_mask=enc_mask, decode=True,
+            decode_position=pos, last_only=True, mutable=["cache"],
+            method="decode")
+        return extract_logits(out)[:, -1], mut["cache"]
+
+    logits, cache = apply_step(cache, start, 0)
+    return _decode_loop(
+        lambda cache, tok, t: apply_step(cache, tok[:, None], 1 + t),
+        cache, logits, max_new_tokens=max_new_tokens, rng=rng,
+        temperature=temperature, top_k=top_k, eos_id=eos_id)
 
 
 def generate_beam(model, variables, prompt, *, max_new_tokens: int,

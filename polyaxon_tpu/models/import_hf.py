@@ -103,6 +103,108 @@ def _export_blocks(block, table, layer_fmt: str, n: int,
                     f".{hf_suffix}"] = w.T if transpose else w
 
 
+def _t5_layer_tables(cfg):
+    """Per-layer mapping tables for T5 encoder and decoder blocks
+    (built per-config: the FF names depend on ``feed_forward``)."""
+    if cfg.feed_forward == "gated-gelu":
+        ff = (("DenseReluDense.wi_0", ("wi_0",), "linear"),
+              ("DenseReluDense.wi_1", ("wi_1",), "linear"),
+              ("DenseReluDense.wo", ("wo",), "linear"))
+    else:
+        ff = (("DenseReluDense.wi", ("wi",), "linear"),
+              ("DenseReluDense.wo", ("wo",), "linear"))
+    attn = lambda hf, ours: tuple(  # noqa: E731
+        (f"{hf}.{p}", (ours, f"{p}_proj"), "linear")
+        for p in ("q", "k", "v", "o"))
+    enc = (("layer.0.layer_norm", ("ln_self",), "rms"),
+           *attn("layer.0.SelfAttention", "attn"),
+           ("layer.1.layer_norm", ("ln_ff",), "rms"),
+           *((f"layer.1.{h}", o, k) for h, o, k in ff))
+    dec = (("layer.0.layer_norm", ("ln_self",), "rms"),
+           *attn("layer.0.SelfAttention", "attn"),
+           ("layer.1.layer_norm", ("ln_cross",), "rms"),
+           *attn("layer.1.EncDecAttention", "cross"),
+           ("layer.2.layer_norm", ("ln_ff",), "rms"),
+           *((f"layer.2.{h}", o, k) for h, o, k in ff))
+    return enc, dec
+
+
+def load_hf_t5(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``T5ForConditionalGeneration.state_dict()`` -> ``{"params":
+    ...}`` for :class:`~polyaxon_tpu.models.t5.T5Model`
+    (scan_layers=True).
+
+    The relative-position bias tables live on block 0 only in HF
+    (shared across layers — exactly our one-table-per-stack layout);
+    v1.0 checkpoints tie ``lm_head`` to ``shared`` (load with
+    ``cfg.tie_embeddings=True``), v1.1 untie it.
+    """
+    sd = state_dict
+    enc_t, dec_t = _t5_layer_tables(cfg)
+    embed = _np(sd["shared.weight"])
+    params: Dict[str, Any] = {
+        "embed": {"embedding": jnp.asarray(embed)},
+        "enc_rel": {"rel_bias": {"embedding": jnp.asarray(_np(
+            sd["encoder.block.0.layer.0.SelfAttention"
+               ".relative_attention_bias.weight"]))}},
+        "dec_rel": {"rel_bias": {"embedding": jnp.asarray(_np(
+            sd["decoder.block.0.layer.0.SelfAttention"
+               ".relative_attention_bias.weight"]))}},
+        "enc": {"block": _load_blocks(sd, enc_t, "encoder.block.{i}",
+                                      cfg.num_layers)},
+        "dec": {"block": _load_blocks(sd, dec_t, "decoder.block.{i}",
+                                      cfg.num_decoder_layers)},
+        "enc_norm": {"scale": jnp.asarray(_np(
+            sd["encoder.final_layer_norm.weight"]))},
+        "dec_norm": {"scale": jnp.asarray(_np(
+            sd["decoder.final_layer_norm.weight"]))},
+    }
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        if head is None:
+            # Unlike Llama (where the tied table IS the untied head
+            # weight), T5's tied path also scales the hidden state by
+            # d_model**-0.5 — substituting the embedding here would
+            # produce logits ~sqrt(d_model) too large.  A checkpoint
+            # without lm_head.weight is a tied (v1.0) checkpoint.
+            raise ValueError(
+                "checkpoint has no lm_head.weight (a tied v1.0 "
+                "checkpoint); load with cfg.tie_embeddings=True")
+        params["lm_head"] = {"kernel": jnp.asarray(_np(head).T)}
+    return {"params": params}
+
+
+def export_hf_t5(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Our T5 params -> an HF ``T5ForConditionalGeneration``
+    state_dict of numpy arrays (the shared/encoder/decoder embedding
+    aliases are all emitted)."""
+    p = variables["params"]
+    enc_t, dec_t = _t5_layer_tables(cfg)
+    embed = np.asarray(p["embed"]["embedding"])
+    sd: Dict[str, Any] = {
+        "shared.weight": embed,
+        "encoder.embed_tokens.weight": embed,
+        "decoder.embed_tokens.weight": embed,
+        "encoder.block.0.layer.0.SelfAttention"
+        ".relative_attention_bias.weight":
+            np.asarray(p["enc_rel"]["rel_bias"]["embedding"]),
+        "decoder.block.0.layer.0.SelfAttention"
+        ".relative_attention_bias.weight":
+            np.asarray(p["dec_rel"]["rel_bias"]["embedding"]),
+        "encoder.final_layer_norm.weight":
+            np.asarray(p["enc_norm"]["scale"]),
+        "decoder.final_layer_norm.weight":
+            np.asarray(p["dec_norm"]["scale"]),
+        "lm_head.weight": embed if cfg.tie_embeddings
+            else np.asarray(p["lm_head"]["kernel"]).T,
+    }
+    _export_blocks(p["enc"]["block"], enc_t, "encoder.block.{i}",
+                   cfg.num_layers, sd)
+    _export_blocks(p["dec"]["block"], dec_t, "decoder.block.{i}",
+                   cfg.num_decoder_layers, sd)
+    return sd
+
+
 def load_hf_gpt2(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
     """HF ``GPT2LMHeadModel.state_dict()`` -> ``{"params": ...}`` for
     :class:`~polyaxon_tpu.models.gpt2.GPT2Model` (scan_layers=True)."""

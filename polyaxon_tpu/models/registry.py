@@ -24,6 +24,7 @@ from .llama import LlamaConfig, LlamaModel
 from .mlp import MLP
 from .moe_gpt import MoEGPTConfig, MoEGPTModel
 from .resnet import ResNet, ResNet50
+from .t5 import T5Config, T5Model, shift_right
 from .vit import ViTConfig, ViTModel
 
 
@@ -127,6 +128,20 @@ def _moe_lm_loss(model):
     return loss
 
 
+def _seq2seq_loss(model):
+    """Teacher-forced seq2seq xent: decoder inputs are the shift-right
+    of ``labels`` (T5's pad-as-start convention); synthetic batches
+    reuse ``inputs`` as ``labels`` (a denoising-style self-target)."""
+    def loss(params, batch, rng):
+        src = batch["inputs"]
+        tgt = batch.get("labels", src)
+        dec_in = shift_right(jnp.asarray(tgt), model.cfg.pad_id)
+        logits = model.apply(params, src, dec_in, train=True)
+        l = softmax_xent(logits, tgt)
+        return l, {"perplexity": jnp.exp(l)}
+    return loss
+
+
 def _mlm_loss(model, mask_rate: float = 0.15, mask_id: int = 0):
     def loss(params, batch, rng):
         tokens = batch["inputs"]
@@ -204,6 +219,30 @@ def _llama_train_flops(cfg: LlamaConfig, seq: int):
         tokens = b * seq
         return (6.0 * n_matmul * tokens
                 + 12.0 * cfg.num_layers * tokens * seq * h / 2.0)
+    return flops
+
+
+def _t5_train_flops(cfg: T5Config, seq: int):
+    """Encoder + decoder + cross-attention closed form.  The attention
+    term follows the zoo convention (12 * L * tokens * S * width, where
+    width is T5's decoupled inner dim), halved for the causal decoder
+    self-attention; cross-attention is full (T_dec x S_enc)."""
+    d, inner, ff = cfg.d_model, cfg.inner_dim, cfg.d_ff
+    ff_mats = 3 if cfg.feed_forward == "gated-gelu" else 2
+    enc_layer = 4 * d * inner + ff_mats * d * ff
+    dec_layer = 8 * d * inner + ff_mats * d * ff
+    n_matmul = (cfg.num_layers * enc_layer
+                + cfg.num_decoder_layers * dec_layer
+                + d * cfg.vocab_size)
+
+    def flops(b: int) -> float:
+        tokens = b * seq
+        dense = 6.0 * n_matmul * tokens
+        attn = 12.0 * tokens * seq * inner * (
+            cfg.num_layers                       # encoder, bidirectional
+            + cfg.num_decoder_layers / 2.0       # decoder self, causal
+            + cfg.num_decoder_layers)            # cross, full
+        return dense + attn
     return flops
 
 
@@ -337,6 +376,23 @@ _register(ModelSpec(
     make_model=_cfg_model(LlamaModel, LlamaConfig.tiny()),
     make_batch=lambda b: _token_batch(b, 64, LlamaConfig.tiny().vocab_size),
     loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
+    name="t5-small",
+    make_model=_cfg_model(T5Model, T5Config.small()),
+    make_batch=lambda b: _token_batch(b, 512, T5Config.small().vocab_size),
+    loss_fn=_seq2seq_loss,
+    default_batch_size=16,
+    train_flops=_t5_train_flops(T5Config.small(), 512),
+))
+
+_register(ModelSpec(
+    name="t5-tiny",
+    make_model=_cfg_model(T5Model, T5Config.tiny()),
+    make_batch=lambda b: _token_batch(b, 64, T5Config.tiny().vocab_size),
+    loss_fn=_seq2seq_loss,
     default_batch_size=8,
 ))
 

@@ -1,0 +1,394 @@
+"""T5 encoder-decoder — the zoo's seq2seq family.
+
+The reference platform orchestrates user-supplied torch seq2seq code
+as opaque containers (SURVEY.md §0/§2.5); here the architecture is
+TPU-native and joins the zoo's uniform conventions: bf16 MXU matmuls,
+f32 RMSNorm statistics, ``nn.scan``'d encoder and decoder stacks
+(stacked ``[layers, ...]`` params feed pipeline parallelism directly),
+and attention through ``ops.attention`` — with T5's two departures
+from the decoder zoo handled explicitly:
+
+- **No attention scaling** (T5 folds the 1/sqrt(d) into the init):
+  every attention call passes ``scale=1.0``.
+- **Bucketed relative-position bias** instead of absolute/rotary
+  positions: one ``[num_buckets, num_heads]`` table per stack (shared
+  across layers, as in T5 — HF stores it on block 0 only), added to
+  the attention logits via ``dot_product_attention(bias=...)``.  The
+  bias operand routes attention down the fused-XLA path (the flash
+  kernels take no bias; see ops/attention.py).
+
+Both v1.0 (ReLU FF, tied head scaled by d_model**-0.5) and v1.1
+(gated-GELU FF, untied head) shapes are supported via
+``feed_forward``/``tie_embeddings``.
+
+Param names ride ``parallel.strategies.TP_RULES`` with no per-model
+config: ``q_proj``/``k_proj``/``v_proj`` column-, ``o_proj`` row-,
+``wi``/``wi_0``/``wi_1`` column-, ``wo`` row-parallel, ``embed``
+vocab-sharded; the relative-bias tables are replicated (no rule
+matches them, by construction of the module names).
+
+Decoding: the decoder self-attention uses the shared KV cache
+(``append_kv_cache``); cross-attention K/V are re-projected from the
+encoder output each step (per step per layer: two [S_enc, d] matmuls —
+cheap next to the decoder stack; caching them at prefill is future
+work).  ``models.generate.generate_seq2seq`` owns the jitted
+encode-once + scan-over-tokens loop.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.constraints import BATCH, constrain
+from .attention import dot_product_attention
+from .kv_cache import append_kv_cache
+from .scan_stack import remat_policy
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    # T5 decouples the per-head dim from d_model/num_heads.
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6          # encoder depth
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    rel_pos_buckets: int = 32
+    rel_pos_max_distance: int = 128
+    # Decoder KV-cache capacity (relative positions need no new params,
+    # so this bounds only decode length, not training).
+    max_position: int = 512
+    layer_norm_eps: float = 1e-6
+    feed_forward: str = "relu"   # "relu" (v1.0) | "gated-gelu" (v1.1)
+    tie_embeddings: bool = True  # v1.0 ties (and scales by d**-0.5)
+    pad_id: int = 0              # also the decoder start token, as in T5
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+    remat_policy: Optional[str] = None
+    scan_layers: bool = True
+
+    def __post_init__(self):
+        if self.feed_forward not in ("relu", "gated-gelu"):
+            raise ValueError(
+                f"feed_forward must be 'relu' or 'gated-gelu'; got "
+                f"{self.feed_forward!r}")
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.d_kv
+
+    @staticmethod
+    def small() -> "T5Config":
+        return T5Config()  # t5-small dims
+
+    @staticmethod
+    def tiny() -> "T5Config":
+        return T5Config(vocab_size=512, d_model=64, d_kv=16, d_ff=128,
+                        num_layers=2, num_decoder_layers=2, num_heads=4,
+                        max_position=128)
+
+
+def relative_position_bucket(rel, *, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """T5's bucketed relative positions (``rel = key_pos - q_pos``).
+
+    Half the buckets cover exact offsets up to ``num_buckets//2`` (//4
+    bidirectional per sign), the rest log-scale out to
+    ``max_distance``; beyond that everything shares the last bucket.
+    Matches HF's ``_relative_position_bucket`` so imported checkpoints
+    reproduce logits (tests/test_t5.py).
+    """
+    rel = jnp.asarray(rel, jnp.int32)
+    n = num_buckets
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        n //= 2
+        ret = ret + (rel > 0).astype(jnp.int32) * n
+        rel = jnp.abs(rel)
+    else:
+        # Causal: only the past (rel <= 0) gets distinct buckets.
+        rel = -jnp.minimum(rel, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    # max(rel, 1) keeps log() finite; those lanes are is_small anyway.
+    large = max_exact + (
+        jnp.log(jnp.maximum(rel, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact) * (n - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, n - 1)
+    return ret + jnp.where(is_small, rel, large)
+
+
+class T5RelativeBias(nn.Module):
+    """One ``[num_buckets, num_heads]`` bias table; call with absolute
+    query/key positions -> additive logits [1, H, Q, K]."""
+
+    cfg: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_pos, k_pos):
+        cfg = self.cfg
+        rel = k_pos[None, :] - q_pos[:, None]          # [Q, K]
+        buckets = relative_position_bucket(
+            rel, bidirectional=self.bidirectional,
+            num_buckets=cfg.rel_pos_buckets,
+            max_distance=cfg.rel_pos_max_distance)
+        table = nn.Embed(cfg.rel_pos_buckets, cfg.num_heads,
+                         dtype=jnp.float32, name="rel_bias")
+        return table(buckets).transpose(2, 0, 1)[None]  # [1, H, Q, K]
+
+
+class T5Attention(nn.Module):
+    """Self- or cross-attention, T5 style (no scaling, no biases in the
+    projections, optional additive position bias)."""
+
+    cfg: T5Config
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, bias=None,
+                 decode: bool = False):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=cfg.dtype, name=name)
+        cross = kv is not None
+        src = kv if cross else x
+        q = dense(cfg.inner_dim, "q_proj")(x)
+        k = dense(cfg.inner_dim, "k_proj")(src)
+        v = dense(cfg.inner_dim, "v_proj")(src)
+        q = constrain(q, BATCH, None, "tp")
+        b, sq = x.shape[:2]
+        sk = src.shape[1]
+        q = q.reshape(b, sq, cfg.num_heads, cfg.d_kv)
+        k = k.reshape(b, sk, cfg.num_heads, cfg.d_kv)
+        v = v.reshape(b, sk, cfg.num_heads, cfg.d_kv)
+
+        causal = self.causal
+        if decode and not cross:
+            # KV-cache step/prefill: the causal-append mask covers
+            # causality over the filled prefix; ``bias`` arrives from
+            # the caller computed at the same absolute positions.
+            k, v, mask, _ = append_kv_cache(self, k, v,
+                                            cfg.max_position)
+            causal = False
+        a = dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                  scale=1.0, bias=bias)
+        a = constrain(a.reshape(b, sq, cfg.inner_dim), BATCH, None, "tp")
+        return dense(cfg.d_model, "o_proj")(a)
+
+
+class T5Block(nn.Module):
+    """Pre-LN residual block: self-attn [+ cross-attn] + FF."""
+
+    cfg: T5Config
+    is_decoder: bool
+
+    @nn.compact
+    def __call__(self, x, self_bias=None, self_mask=None, enc_out=None,
+                 enc_mask=None, decode: bool = False):
+        cfg = self.cfg
+        norm = lambda name: nn.RMSNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name=name)
+        h = norm("ln_self")(x).astype(cfg.dtype)
+        x = x + T5Attention(cfg, causal=self.is_decoder, name="attn")(
+            h, mask=self_mask, bias=self_bias, decode=decode)
+        x = constrain(x, BATCH, None, None)
+        if self.is_decoder:
+            h = norm("ln_cross")(x).astype(cfg.dtype)
+            x = x + T5Attention(cfg, name="cross")(
+                h, kv=enc_out, mask=enc_mask)
+            x = constrain(x, BATCH, None, None)
+        h = norm("ln_ff")(x).astype(cfg.dtype)
+        if cfg.feed_forward == "gated-gelu":
+            g = nn.gelu(nn.Dense(cfg.d_ff, use_bias=False,
+                                 dtype=cfg.dtype, name="wi_0")(h))
+            u = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                         name="wi_1")(h)
+            h = g * u
+        else:
+            h = nn.relu(nn.Dense(cfg.d_ff, use_bias=False,
+                                 dtype=cfg.dtype, name="wi")(h))
+        h = constrain(h, BATCH, None, "tp")
+        x = x + nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                         name="wo")(h)
+        return constrain(x, BATCH, None, None)
+
+
+class _EncScan(nn.Module):
+    """scan body: (x; bias, mask as nn.broadcast) around one encoder
+    block (BERT's side-input pattern — scan_stack's carry-only shape
+    doesn't fit)."""
+
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias, mask):
+        cls = nn.remat(T5Block, prevent_cse=False,
+                       policy=remat_policy(self.cfg.remat_policy)) \
+            if self.cfg.remat else T5Block
+        return cls(self.cfg, is_decoder=False, name="block")(
+            x, self_bias=bias, self_mask=mask), None
+
+
+class _DecScan(nn.Module):
+    """scan body: (x; enc_out, self_bias, enc_mask, decode as
+    nn.broadcast) around one decoder block."""
+
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc_out, self_bias, enc_mask, decode):
+        if decode:
+            # No gradients in decode; remat would re-run the cache
+            # mutation (scan_stack.ScanBlock rationale).
+            return T5Block(self.cfg, is_decoder=True, name="block")(
+                x, self_bias=self_bias, enc_out=enc_out,
+                enc_mask=enc_mask, decode=True), None
+        cls = nn.remat(T5Block, prevent_cse=False,
+                       policy=remat_policy(self.cfg.remat_policy)) \
+            if self.cfg.remat else T5Block
+        return cls(self.cfg, is_decoder=True, name="block")(
+            x, self_bias=self_bias, enc_out=enc_out,
+            enc_mask=enc_mask), None
+
+
+def _scan(body_cls, cfg, length: int, name: str):
+    return nn.scan(
+        body_cls,
+        variable_axes={"params": 0, "cache": 0},
+        in_axes=nn.broadcast,
+        split_rngs={"params": True},
+        length=length,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(cfg, name=name)
+
+
+class T5Model(nn.Module):
+    """Encoder-decoder with a shared embedding and LM head.
+
+    ``__call__(input_ids, decoder_input_ids)`` is the teacher-forced
+    training path (``decoder_input_ids`` defaults to the shift-right
+    of ``input_ids`` — a denoising-style self-target that keeps the
+    registry's uniform ``model.init(rng, batch["inputs"])`` working).
+    ``encode``/``decode`` are exposed as flax methods for
+    ``generate_seq2seq``'s encode-once + KV-cache loop.
+    """
+
+    cfg: T5Config
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                              dtype=cfg.dtype, name="embed")
+        self.enc_rel = T5RelativeBias(cfg, bidirectional=True,
+                                      name="enc_rel")
+        self.dec_rel = T5RelativeBias(cfg, bidirectional=False,
+                                      name="dec_rel")
+        if cfg.scan_layers:
+            self.enc = _scan(_EncScan, cfg, cfg.num_layers, "enc")
+            self.dec = _scan(_DecScan, cfg, cfg.num_decoder_layers,
+                             "dec")
+        else:
+            self.enc_blocks = tuple(
+                T5Block(cfg, is_decoder=False, name=f"enc_{i}")
+                for i in range(cfg.num_layers))
+            self.dec_blocks = tuple(
+                T5Block(cfg, is_decoder=True, name=f"dec_{i}")
+                for i in range(cfg.num_decoder_layers))
+        self.enc_norm = nn.RMSNorm(epsilon=cfg.layer_norm_eps,
+                                   dtype=jnp.float32, name="enc_norm")
+        self.dec_norm = nn.RMSNorm(epsilon=cfg.layer_norm_eps,
+                                   dtype=jnp.float32, name="dec_norm")
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                    dtype=cfg.dtype, name="lm_head")
+
+    def encode(self, input_ids, enc_mask=None):
+        """Token ids [B, S] -> encoder output [B, S, d_model] (final-
+        norm applied).  ``enc_mask``: [B, S] 1/True = attend."""
+        cfg = self.cfg
+        s = input_ids.shape[-1]
+        x = constrain(self.embed(input_ids), BATCH, None, None)
+        pos = jnp.arange(s)
+        bias = self.enc_rel(pos, pos)
+        mask4 = None
+        if enc_mask is not None:
+            mask4 = enc_mask[:, None, None, :].astype(bool)
+        if cfg.scan_layers:
+            x, _ = self.enc(x, bias, mask4)
+        else:
+            for blk in self.enc_blocks:
+                x = blk(x, self_bias=bias, self_mask=mask4)
+        return self.enc_norm(x).astype(cfg.dtype)
+
+    def decode(self, decoder_input_ids, enc_out, enc_mask=None, *,
+               decode: bool = False, decode_position=0,
+               last_only: bool = False):
+        """Teacher-forced (decode=False) or KV-cache (decode=True)
+        decoder pass over ``decoder_input_ids`` [B, T] -> logits.
+
+        In decode mode ``decode_position`` is the absolute position of
+        the first new token (generate()'s convention: the relative-
+        position bias is computed from it, the cache index orders the
+        appends — the two agree by construction of the calling loop).
+        """
+        cfg = self.cfg
+        t = decoder_input_ids.shape[-1]
+        x = constrain(self.embed(decoder_input_ids), BATCH, None, None)
+        if decode:
+            if t > cfg.max_position:
+                raise ValueError(
+                    f"decode chunk {t} exceeds max_position "
+                    f"{cfg.max_position}")
+            q_pos = decode_position + jnp.arange(t)
+            bias = self.dec_rel(q_pos, jnp.arange(cfg.max_position))
+        else:
+            pos = jnp.arange(t)
+            bias = self.dec_rel(pos, pos)
+        mask4 = None
+        if enc_mask is not None:
+            mask4 = enc_mask[:, None, None, :].astype(bool)
+        if cfg.scan_layers:
+            x, _ = self.dec(x, enc_out, bias, mask4, decode or None)
+        else:
+            for blk in self.dec_blocks:
+                x = blk(x, self_bias=bias, enc_out=enc_out,
+                        enc_mask=mask4, decode=decode)
+        x = self.dec_norm(x)
+        if last_only:
+            x = x[:, -1:]
+        return self.head(x)
+
+    def head(self, x):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        if cfg.tie_embeddings:
+            # T5 scales the tied head's input by d**-0.5 (the scale
+            # the attention logits dropped).
+            logits = self.embed.attend(x * (cfg.d_model ** -0.5))
+        else:
+            logits = self.lm_head(x)
+        return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
+
+    def __call__(self, input_ids, decoder_input_ids=None, *,
+                 enc_mask=None, train: bool = False):
+        if decoder_input_ids is None:
+            decoder_input_ids = shift_right(input_ids, self.cfg.pad_id)
+        enc_out = self.encode(input_ids, enc_mask=enc_mask)
+        return self.decode(decoder_input_ids, enc_out,
+                           enc_mask=enc_mask)
+
+
+def shift_right(ids, start_id: int):
+    """T5's decoder-input construction: prepend the start (pad) token,
+    drop the last target."""
+    return jnp.concatenate(
+        [jnp.full_like(ids[:, :1], start_id), ids[:, :-1]], axis=1)

@@ -121,10 +121,16 @@ def _sp_route(q, k, v, mask, causal, scale):
     return mesh, mode
 
 
-def _xla_attention(q, k, v, mask, causal, scale, window=None):
+def _xla_attention(q, k, v, mask, causal, scale, window=None,
+                   bias=None):
     orig_dtype = q.dtype
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    if bias is not None:
+        # Additive logit bias (T5 relative-position bias), applied
+        # after scaling and before any masking so masked positions
+        # stay at BIG_NEG regardless of the bias value.
+        scores = scores + bias.astype(jnp.float32)
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
@@ -150,6 +156,7 @@ def dot_product_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     window: Optional[int] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over [B, S, H, D] tensors; returns [B, Sq, H, D].
 
@@ -158,7 +165,13 @@ def dot_product_attention(
     ``W`` keys, so pass ``hf_window - 1`` for parity); requires
     ``causal=True`` and ``window >= 1``.  The flash kernels skip the
     MXU work of fully-out-of-window blocks (the grid still walks and
-    DMAs every tile; a kv index remap is future work)."""
+    DMAs every tile; a kv index remap is future work).
+
+    ``bias``: additive attention-logit bias, broadcastable to
+    [B, H, Sq, Sk] (T5-style relative position bias).  Routes through
+    the fused-XLA path — the flash kernels and the sequence-parallel
+    schedules take no bias operand (a bias-carrying flash BlockSpec is
+    future work), so biased attention stays local and unfused."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if window is not None:
@@ -169,6 +182,15 @@ def dot_product_attention(
             raise ValueError(
                 f"window must be >= 1 (got {window}); 0 would silently "
                 "disable windowing in the falsy checks downstream")
+    if bias is not None:
+        ctx = getattr(_SP_STATE, "ctx", None)
+        if ctx is not None:
+            logger.warning(
+                "sequence_parallel: additive attention bias is not "
+                "supported by the ring/Ulysses schedules; falling back "
+                "to local attention for this call")
+        return _xla_attention(q, k, v, mask, causal, scale,
+                              window=window, bias=bias)
     route = _sp_route(q, k, v, mask, causal, scale)
     if route is not None:
         mesh, mode = route
