@@ -250,24 +250,48 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
             f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_position ({max_pos})")
 
-    def logprobs(out):
-        return jax.nn.log_softmax(
-            extract_logits(out)[:, -1].astype(jnp.float32), axis=-1)
-
-    # Prefill once on [B, P], then tile the cache per beam: batch is
-    # axis 1 of the stacked [layers, B, ...] cache entries (axis 0 of
-    # cache_index-like scalars is layers too, so only rank>=2 tiles).
+    # Prefill once on [B, P]; _beam_loop tiles the cache per beam.
     cache = init_cache(model, b)
     out, mut = model.apply(
         {"params": variables["params"], "cache": cache},
         prompt, decode=True, decode_position=0, last_only=True,
         mutable=["cache"])
-    lp = logprobs(out)                                     # [B, V]
+
+    def apply_step(cache, toks_flat, t):
+        out, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            toks_flat, decode=True, decode_position=p_len + t,
+            mutable=["cache"])
+        return extract_logits(out)[:, -1], mut["cache"]
+
+    seq = _beam_loop(apply_step, mut["cache"],
+                     extract_logits(out)[:, -1], b=b,
+                     max_new_tokens=max_new_tokens, num_beams=k,
+                     eos_id=eos_id, length_penalty=length_penalty)
+    return jnp.concatenate([prompt, seq], axis=1)
+
+
+def _beam_loop(apply_step, cache, first_logits, *, b: int,
+               max_new_tokens: int, num_beams: int,
+               eos_id: Optional[int], length_penalty: float):
+    """Shared beam-search machinery for :func:`generate_beam` and
+    :func:`generate_beam_seq2seq`.
+
+    ``apply_step(cache, toks_flat, t) -> (logits, cache)`` runs one
+    decoder step on ``toks_flat`` [B*K, 1] at scan tick ``t``;
+    ``first_logits`` [B, V] are the prefill's last-position logits and
+    ``cache`` the post-prefill (un-tiled, batch B) cache.  Beams live
+    b-major on axis 1 of the stacked [layers, B*K, ...] cache entries
+    (axis 0 of cache_index-like scalars is layers too, so only rank>=2
+    tiles/reorders).  Returns the generated tokens [B, max_new_tokens].
+    """
+    k = num_beams
+    lp = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
     vocab = lp.shape[-1]
     scores, first = jax.lax.top_k(lp, k)                   # [B, K]
     cache = jax.tree.map(
         lambda x: jnp.repeat(x, k, axis=1) if x.ndim >= 2 else x,
-        mut["cache"])
+        cache)
     done = (first == eos_id) if eos_id is not None \
         else jnp.zeros((b, k), bool)
     # Per-beam GENERATED length at finish (the length-penalty
@@ -276,11 +300,10 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
 
     def step(carry, t):
         cache, toks_prev, scores, done, fin_len = carry    # toks [B,K]
-        out, mut = model.apply(
-            {"params": variables["params"], "cache": cache},
-            toks_prev.reshape(b * k, 1), decode=True,
-            decode_position=p_len + t, mutable=["cache"])
-        lp = logprobs(out).reshape(b, k, vocab)            # [B,K,V]
+        logits, cache = apply_step(cache, toks_prev.reshape(b * k, 1),
+                                   t)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                axis=-1).reshape(b, k, vocab)
         if eos_id is not None:
             # Finished beams contribute exactly one continuation (eos
             # at no cost) so they compete but never fork.
@@ -293,7 +316,7 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
         flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
         cache = jax.tree.map(
             lambda x: jnp.take(x, flat_parent, axis=1)
-            if x.ndim >= 2 else x, mut["cache"])
+            if x.ndim >= 2 else x, cache)
         done = jnp.take_along_axis(done, parent, axis=1)
         fin_len = jnp.take_along_axis(fin_len, parent, axis=1)
         if eos_id is not None:
@@ -328,4 +351,65 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
     first_tok = jnp.take_along_axis(first, beam[:, None], 1)[:, 0]
     seq = jnp.stack([first_tok] + rev[::-1], axis=1) if rev else \
         first_tok[:, None]
-    return jnp.concatenate([prompt, seq.astype(jnp.int32)], axis=1)
+    return seq.astype(jnp.int32)
+
+
+def generate_beam_seq2seq(model, variables, enc_tokens, *,
+                          max_new_tokens: int, num_beams: int = 4,
+                          eos_id: Optional[int] = None,
+                          length_penalty: float = 1.0,
+                          enc_mask: Optional[jax.Array] = None,
+                          start_id: Optional[int] = None) -> jax.Array:
+    """Beam-search decoding for seq2seq (T5-style) models.
+
+    Encodes once, then beams over the decoder KV cache (same scan +
+    per-beam cache reorder as :func:`generate_beam`); the encoder
+    output and padding mask are tiled per beam so cross-attention sees
+    the beam-major [B*K, ...] batch layout.  Returns the
+    highest-scoring GENERATED tokens [B, max_new_tokens].
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1; got "
+                         f"{max_new_tokens}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1; got {num_beams}")
+    if not getattr(model.cfg, "scan_layers", True):
+        raise NotImplementedError(
+            "beam search requires a scan-stacked cache "
+            "(cfg.scan_layers=True); see generate_beam.")
+    if start_id is None:
+        start_id = model.cfg.pad_id
+    max_pos = getattr(model.cfg, "max_position", None)
+    if max_pos is not None and max_new_tokens > max_pos:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds the decoder's "
+            f"max_position ({max_pos})")
+    enc_tokens = jnp.asarray(enc_tokens, jnp.int32)
+    b = enc_tokens.shape[0]
+    params = {"params": variables["params"]}
+    enc_out = model.apply(params, enc_tokens, enc_mask=enc_mask,
+                          method="encode")
+    enc_tiled = jnp.repeat(enc_out, num_beams, axis=0)     # b-major
+    mask_tiled = None if enc_mask is None else \
+        jnp.repeat(jnp.asarray(enc_mask), num_beams, axis=0)
+
+    cache = init_cache(model, b, enc_out, method="decode")
+    start = jnp.full((b, 1), start_id, jnp.int32)
+    out, mut = model.apply(
+        {"params": variables["params"], "cache": cache},
+        start, enc_out, enc_mask=enc_mask, decode=True,
+        decode_position=0, last_only=True, mutable=["cache"],
+        method="decode")
+
+    def apply_step(cache, toks_flat, t):
+        out, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            toks_flat, enc_tiled, enc_mask=mask_tiled, decode=True,
+            decode_position=1 + t, last_only=True, mutable=["cache"],
+            method="decode")
+        return extract_logits(out)[:, -1], mut["cache"]
+
+    return _beam_loop(apply_step, mut["cache"],
+                      extract_logits(out)[:, -1], b=b,
+                      max_new_tokens=max_new_tokens, num_beams=num_beams,
+                      eos_id=eos_id, length_penalty=length_penalty)

@@ -7,7 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from polyaxon_tpu.models.generate import generate_seq2seq, init_cache
+from polyaxon_tpu.models.generate import (generate_beam_seq2seq,
+                                          generate_seq2seq, init_cache)
 from polyaxon_tpu.models.registry import get_model
 from polyaxon_tpu.models.t5 import (T5Config, T5Model,
                                     relative_position_bucket,
@@ -135,6 +136,38 @@ class TestT5Decode:
         # ...and one past it must refuse up front.
         with pytest.raises(ValueError, match="max_position"):
             generate_seq2seq(model, variables, src, max_new_tokens=9)
+
+    def test_beam1_matches_greedy(self):
+        spec, model, variables = _tiny_f32()
+        rng = np.random.RandomState(6)
+        src = jnp.asarray(rng.randint(0, 512, (2, 9)), jnp.int32)
+        greedy = np.asarray(generate_seq2seq(model, variables, src,
+                                             max_new_tokens=5))
+        beam1 = np.asarray(generate_beam_seq2seq(
+            model, variables, src, max_new_tokens=5, num_beams=1))
+        np.testing.assert_array_equal(beam1, greedy)
+
+    def test_beam_scores_at_least_greedy(self):
+        spec, model, variables = _tiny_f32()
+        rng = np.random.RandomState(7)
+        src = jnp.asarray(rng.randint(0, 512, (2, 9)), jnp.int32)
+        n = 5
+
+        def joint_logprob(seq):
+            # Teacher-forced score of the generated tokens under the
+            # model: feed [start] + seq[:-1], score each position.
+            dec_in = shift_right(jnp.asarray(seq), model.cfg.pad_id)
+            logits = model.apply(variables, src, dec_in)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            picked = jnp.take_along_axis(
+                lp, jnp.asarray(seq)[..., None], -1)[..., 0]
+            return np.asarray(picked.sum(-1))
+
+        greedy = generate_seq2seq(model, variables, src,
+                                  max_new_tokens=n)
+        beam = generate_beam_seq2seq(model, variables, src,
+                                     max_new_tokens=n, num_beams=4)
+        assert (joint_logprob(beam) >= joint_logprob(greedy) - 1e-4).all()
 
     def test_generate_seq2seq_eos_freezes(self):
         spec, model, variables = _tiny_f32()
