@@ -21,21 +21,20 @@ import jax
 import jax.numpy as jnp
 
 
-def init_cache(model, batch_size: int, *extra, method=None):
-    """Allocate the stacked per-layer KV cache for ``model``, all
-    zeros with cache_index 0.  (Abstract init only: running a real
-    init decode step would advance the index and write a garbage
-    token-0 entry.)
+def init_cache(model, batch_size: int):
+    """Allocate the stacked per-layer KV cache for a DECODER-ONLY
+    ``model``, all zeros with cache_index 0.  (Abstract init only:
+    running a real init decode step would advance the index and write
+    a garbage token-0 entry.)
 
-    ``extra``/``method``: for encoder-decoder models whose decode
-    entrypoint is a named flax method with side inputs —
-    ``init_cache(model, b, enc_out, method="decode")`` (see
-    :func:`generate_seq2seq`)."""
+    Seq2seq (encoder-decoder) models must NOT use this: their cache
+    holds the computed cross-attention K/V, which zeros would silently
+    shadow — their loops start from an empty cache dict so the prefill
+    step creates every entry (see :func:`generate_seq2seq`)."""
     tokens = jnp.zeros((batch_size, 1), jnp.int32)
-    kw = {} if method is None else {"method": method}
     shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), tokens, *extra,
-                           decode=True, decode_position=0, **kw))
+        lambda: model.init(jax.random.PRNGKey(0), tokens, decode=True,
+                           decode_position=0))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         shapes["cache"])
 
@@ -195,8 +194,11 @@ def generate_seq2seq(model, variables, enc_tokens, *,
     enc_out = model.apply(params, enc_tokens, enc_mask=enc_mask,
                           method="encode")
 
+    # EMPTY cache: the prefill step below creates the self-attn ring
+    # AND the computed cross-attention K/V (init_cache's zeros would
+    # shadow the cross projections).
     start = jnp.full((b, 1), start_id, jnp.int32)
-    cache = init_cache(model, b, enc_out, method="decode")
+    cache = {}
 
     def apply_step(cache, tok, pos):
         out, mut = model.apply(
@@ -314,9 +316,18 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
         parent = flat // vocab                             # [B,K]
         tok = (flat % vocab).astype(jnp.int32)
         flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
-        cache = jax.tree.map(
-            lambda x: jnp.take(x, flat_parent, axis=1)
-            if x.ndim >= 2 else x, cache)
+
+        def reorder(path, x):
+            # Cross-attention K/V (seq2seq) are beam-INVARIANT: every
+            # beam of a batch row holds the same encoder projections,
+            # and parents never cross batch rows, so the gather would
+            # be a no-op permutation — skip it (they still tile above
+            # so attention sees the [B*K, ...] batch layout).
+            if x.ndim < 2 or "cross_" in jax.tree_util.keystr(path):
+                return x
+            return jnp.take(x, flat_parent, axis=1)
+
+        cache = jax.tree_util.tree_map_with_path(reorder, cache)
         done = jnp.take_along_axis(done, parent, axis=1)
         fin_len = jnp.take_along_axis(fin_len, parent, axis=1)
         if eos_id is not None:
@@ -393,10 +404,11 @@ def generate_beam_seq2seq(model, variables, enc_tokens, *,
     mask_tiled = None if enc_mask is None else \
         jnp.repeat(jnp.asarray(enc_mask), num_beams, axis=0)
 
-    cache = init_cache(model, b, enc_out, method="decode")
+    # Empty cache: the prefill creates self-attn + cross K/V entries
+    # (generate_seq2seq rationale).
     start = jnp.full((b, 1), start_id, jnp.int32)
     out, mut = model.apply(
-        {"params": variables["params"], "cache": cache},
+        {"params": variables["params"], "cache": {}},
         start, enc_out, enc_mask=enc_mask, decode=True,
         decode_position=0, last_only=True, mutable=["cache"],
         method="decode")

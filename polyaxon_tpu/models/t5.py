@@ -28,11 +28,14 @@ vocab-sharded; the relative-bias tables are replicated (no rule
 matches them, by construction of the module names).
 
 Decoding: the decoder self-attention uses the shared KV cache
-(``append_kv_cache``); cross-attention K/V are re-projected from the
-encoder output each step (per step per layer: two [S_enc, d] matmuls —
-cheap next to the decoder stack; caching them at prefill is future
-work).  ``models.generate.generate_seq2seq`` owns the jitted
-encode-once + scan-over-tokens loop.
+(``append_kv_cache``); cross-attention K/V are projected ONCE at the
+prefill step and cached (they are a pure function of the encoder
+output — re-projecting them every tick would add two [S_enc, d]
+matmuls per layer per token).  ``models.generate.generate_seq2seq``
+owns the jitted encode-once + scan-over-tokens loop; seq2seq decode
+starts from an EMPTY cache dict so the prefill step creates both the
+self-attn ring and the computed cross K/V (zero-filled caches would
+silently shadow the cross projections).
 """
 
 from __future__ import annotations
@@ -162,23 +165,37 @@ class T5Attention(nn.Module):
         cross = kv is not None
         src = kv if cross else x
         q = dense(cfg.inner_dim, "q_proj")(x)
-        k = dense(cfg.inner_dim, "k_proj")(src)
-        v = dense(cfg.inner_dim, "v_proj")(src)
         q = constrain(q, BATCH, None, "tp")
         b, sq = x.shape[:2]
-        sk = src.shape[1]
-        q = q.reshape(b, sq, cfg.num_heads, cfg.d_kv)
-        k = k.reshape(b, sk, cfg.num_heads, cfg.d_kv)
-        v = v.reshape(b, sk, cfg.num_heads, cfg.d_kv)
 
+        def heads(name):
+            t = dense(cfg.inner_dim, name)(src)
+            return t.reshape(src.shape[0], src.shape[1],
+                             cfg.num_heads, cfg.d_kv)
+
+        q = q.reshape(b, sq, cfg.num_heads, cfg.d_kv)
         causal = self.causal
-        if decode and not cross:
-            # KV-cache step/prefill: the causal-append mask covers
-            # causality over the filled prefix; ``bias`` arrives from
-            # the caller computed at the same absolute positions.
-            k, v, mask, _ = append_kv_cache(self, k, v,
+        if cross and decode:
+            # Cross K/V are a pure function of the encoder output:
+            # project once (the prefill step CREATES these variables —
+            # seq2seq decode loops start from an empty cache dict, see
+            # generate_seq2seq), then every decode tick reads them
+            # back instead of re-projecting the encoder output.
+            ck = self.variable("cache", "cross_key",
+                               lambda: heads("k_proj"))
+            cv = self.variable("cache", "cross_value",
+                               lambda: heads("v_proj"))
+            k, v = ck.value, cv.value
+        elif decode:
+            # Self-attn KV-cache step/prefill: the causal-append mask
+            # covers causality over the filled prefix; ``bias`` arrives
+            # from the caller computed at the same absolute positions.
+            k, v, mask, _ = append_kv_cache(self, heads("k_proj"),
+                                            heads("v_proj"),
                                             cfg.max_position)
             causal = False
+        else:
+            k, v = heads("k_proj"), heads("v_proj")
         a = dot_product_attention(q, k, v, mask=mask, causal=causal,
                                   scale=1.0, bias=bias)
         a = constrain(a.reshape(b, sq, cfg.inner_dim), BATCH, None, "tp")
@@ -204,7 +221,7 @@ class T5Block(nn.Module):
         if self.is_decoder:
             h = norm("ln_cross")(x).astype(cfg.dtype)
             x = x + T5Attention(cfg, name="cross")(
-                h, kv=enc_out, mask=enc_mask)
+                h, kv=enc_out, mask=enc_mask, decode=decode)
             x = constrain(x, BATCH, None, None)
         h = norm("ln_ff")(x).astype(cfg.dtype)
         if cfg.feed_forward == "gated-gelu":

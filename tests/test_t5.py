@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from polyaxon_tpu.models.generate import (generate_beam_seq2seq,
-                                          generate_seq2seq, init_cache)
+                                          generate_seq2seq)
 from polyaxon_tpu.models.registry import get_model
 from polyaxon_tpu.models.t5 import (T5Config, T5Model,
                                     relative_position_bucket,
@@ -75,7 +75,7 @@ class TestT5Decode:
 
         full = np.asarray(model.apply(variables, src, dec_in))
         enc_out = model.apply(params, src, method="encode")
-        cache = init_cache(model, 2, enc_out, method="decode")
+        cache = {}  # the first step creates self-attn + cross entries
         for t in range(dec_in.shape[1]):
             out, mut = model.apply(
                 {"params": variables["params"], "cache": cache},
@@ -93,11 +93,13 @@ class TestT5Decode:
         dec_in = jnp.asarray(rng.randint(0, 512, (2, 5)), jnp.int32)
         params = {"params": variables["params"]}
         enc_out = model.apply(params, src, method="encode")
-        cache = init_cache(model, 2, enc_out, method="decode")
-        chunk, _ = model.apply(
-            {"params": variables["params"], "cache": cache},
+        chunk, mut = model.apply(
+            {"params": variables["params"], "cache": {}},
             dec_in, enc_out, decode=True, decode_position=0,
             mutable=["cache"], method="decode")
+        # The prefill caches the COMPUTED cross K/V (not zeros).
+        cross_k = mut["cache"]["dec"]["block"]["cross"]["cross_key"]
+        assert np.abs(np.asarray(cross_k)).sum() > 0
         full = np.asarray(model.apply(variables, src, dec_in))
         np.testing.assert_allclose(np.asarray(chunk), full, atol=1e-4,
                                    rtol=1e-4)
