@@ -35,14 +35,18 @@ RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
 CONFIGS = {
     "gpt2-medium": (8, 128, 256, (128, 512)),
     "tinyllama-1.1b": (8, 128, 256, (128, 1024)),
-    "gpt2-tiny": (4, 16, 32, (8, 32)),  # CI-sized smoke config
+    "t5-small": (8, 128, 256, (128, 512)),  # seq2seq: prompt = encoder
+    "gpt2-tiny": (4, 16, 32, (8, 32)),      # CI-sized smoke config
+    "t5-tiny": (4, 16, 32, (8, 32)),        # CI-sized seq2seq smoke
 }
 
 
 def bench_decode(jax, model_name: str, backend: str):
     import numpy as np
 
-    from polyaxon_tpu.models.generate import generate, init_cache
+    from polyaxon_tpu.models.generate import (generate,
+                                              generate_seq2seq,
+                                              init_cache)
     from polyaxon_tpu.models.registry import get_model
 
     batch, p_len, new_toks, ttft_lens = CONFIGS[model_name]
@@ -51,7 +55,29 @@ def bench_decode(jax, model_name: str, backend: str):
     vocab = model.cfg.vocab_size
     rng = np.random.RandomState(0)
 
-    cache_shapes = jax.eval_shape(lambda: init_cache(model, batch))
+    # Seq2seq (T5-style) models decode through generate_seq2seq: the
+    # "prompt" is the ENCODER input, TTFT = encode + one prefill step.
+    # Their cache (self-attn ring + computed cross K/V) is sized from
+    # a decode-method init; decoder-only models use init_cache.
+    seq2seq = hasattr(model, "encode")
+    if seq2seq:
+        import jax.numpy as jnp
+
+        def cache_shapes_fn():
+            enc = jax.eval_shape(
+                lambda t: model.apply(
+                    {"params": variables["params"]}, t,
+                    method="encode"),
+                jax.ShapeDtypeStruct((batch, p_len), jnp.int32))
+            return jax.eval_shape(
+                lambda: model.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((batch, 1), jnp.int32),
+                                   jnp.zeros(enc.shape, enc.dtype),
+                                   decode=True, decode_position=0,
+                                   method="decode"))["cache"]
+        cache_shapes = cache_shapes_fn()
+    else:
+        cache_shapes = jax.eval_shape(lambda: init_cache(model, batch))
     kv_bytes = sum(x.size * x.dtype.itemsize
                    for x in jax.tree.leaves(cache_shapes))
 
@@ -63,8 +89,9 @@ def bench_decode(jax, model_name: str, backend: str):
         jax.device_get(out)
         return time.perf_counter() - t0
 
-    gen = jax.jit(lambda p: generate(model, variables, p,
-                                     max_new_tokens=new_toks))
+    gen_fn = generate_seq2seq if seq2seq else generate
+    gen = jax.jit(lambda p: gen_fn(model, variables, p,
+                                   max_new_tokens=new_toks))
     prompt = rng.randint(0, vocab, size=(batch, p_len)).astype("int32")
     total_s = timed(gen, prompt)
     tok_per_sec = batch * new_toks / total_s
@@ -72,8 +99,8 @@ def bench_decode(jax, model_name: str, backend: str):
     # TTFT = prefill + first sampled token (max_new_tokens=1).
     ttft = {}
     for L in ttft_lens:
-        first = jax.jit(lambda p: generate(model, variables, p,
-                                           max_new_tokens=1))
+        first = jax.jit(lambda p: gen_fn(model, variables, p,
+                                         max_new_tokens=1))
         pr = rng.randint(0, vocab, size=(batch, L)).astype("int32")
         ttft[L] = timed(first, pr)
     l_small, l_big = ttft_lens
@@ -97,7 +124,8 @@ def bench_decode(jax, model_name: str, backend: str):
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--models", default="gpt2-medium,tinyllama-1.1b")
+    parser.add_argument(
+        "--models", default="gpt2-medium,tinyllama-1.1b,t5-small")
     parser.add_argument("--probe-budget", type=float, default=300.0)
     parser.add_argument("--cpu", action="store_true")
     args = parser.parse_args()
