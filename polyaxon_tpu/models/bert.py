@@ -31,6 +31,9 @@ class BertConfig:
     max_position: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # tanh-approximate GELU is the TPU-fast default; HF BERT uses the
+    # exact (erf) form — checkpoint import sets False for logit parity.
+    gelu_approximate: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     # Backward-pass rematerialization (see GPT2Config.remat).
     remat: bool = False
@@ -82,7 +85,7 @@ class BertLayer(nn.Module):
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                      name="fc1")(x)
         h = constrain(h, BATCH, None, "tp")
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_mlp")(x + h)
@@ -149,7 +152,7 @@ class BertModel(nn.Module):
 
         # MLM head: transform then decode with the tied embedding.
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="mlm_ln")(h)
         logits = embed.attend(h.astype(cfg.dtype))

@@ -1,14 +1,17 @@
 """HuggingFace checkpoint interop (both directions) for the zoo.
 
 Users of the reference platform bring torch models; this converts HF
-``state_dict``s (GPT-2, Llama families) into the zoo's flax param
-trees — including the scan-stacked ``[num_layers, ...]`` layout — and
-back.  Parity is proven in tests by comparing logits against
+``state_dict``s (GPT-2, Llama, BERT, T5 families) into the zoo's flax
+param trees — including the scan-stacked ``[num_layers, ...]`` layout —
+and back.  Parity is proven in tests by comparing logits against
 ``transformers``' own forward pass on identical tokens, in BOTH
-directions (see tests/test_import_hf.py).
+directions (see tests/test_import_hf.py, tests/test_t5.py).
 
 Each architecture has ONE per-layer mapping table driving import and
-export, so the two directions cannot drift.  Layout conventions:
+export, so the two directions cannot drift — with one exception: BERT's
+three ``attention.self`` Linears fuse into our single ``qkv`` Dense, so
+its concat (load) and split (export) are hand-written pairs; keep their
+query/key/value order in sync.  Layout conventions:
 
 - GPT-2 stores Conv1D weights as ``[in, out]`` (flax Dense layout —
   taken as-is); Llama stores torch Linear ``[out, in]`` (transposed).
@@ -64,6 +67,8 @@ _KIND_LEAVES = {
     "rms": [("weight", "scale", False)],
     "conv1d": [("weight", "kernel", False), ("bias", "bias", False)],
     "linear": [("weight", "kernel", True)],
+    # torch Linear with bias (BERT's Denses keep their biases).
+    "linear_b": [("weight", "kernel", True), ("bias", "bias", False)],
 }
 
 
@@ -101,6 +106,127 @@ def _export_blocks(block, table, layer_fmt: str, n: int,
                 w = stacked[i]
                 out[f"{layer_fmt.format(i=i)}.{hf_prefix}"
                     f".{hf_suffix}"] = w.T if transpose else w
+
+
+# BERT per-layer tensors OTHER than attention.self (whose three
+# q/k/v Linears fuse into our single ``qkv`` Dense — handled by hand
+# in load/export below).
+_BERT_LAYERS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("attention.output.dense", ("attn", "o_proj"), "linear_b"),
+    ("attention.output.LayerNorm", ("ln_attn",), "ln"),
+    ("intermediate.dense", ("fc1",), "linear_b"),
+    ("output.dense", ("fc2",), "linear_b"),
+    ("output.LayerNorm", ("ln_mlp",), "ln"),
+)
+
+
+def load_hf_bert(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``BertForMaskedLM.state_dict()`` -> ``{"params": ...}`` for
+    :class:`~polyaxon_tpu.models.bert.BertModel` (scan_layers=True).
+
+    The three ``attention.self.{query,key,value}`` Linears concatenate
+    into our fused ``qkv`` Dense (one big MXU matmul — bert.py
+    rationale); the MLM head maps ``cls.predictions.transform`` onto
+    ``mlm_dense``/``mlm_ln`` and the tied decoder's standalone bias
+    onto ``mlm_bias``.  Build the model with
+    ``gelu_approximate=False`` — HF BERT uses the exact (erf) GELU.
+    """
+    sd = {k.removeprefix("bert."): v for k, v in state_dict.items()}
+    n = cfg.num_layers
+    embed_w = _np(sd["embeddings.word_embeddings.weight"])
+    dec = state_dict.get("cls.predictions.decoder.weight")
+    if dec is not None:
+        # Our MLM head decodes through the tied embedding
+        # (embed.attend); a checkpoint whose decoder weight actually
+        # DIFFERS is untied, and silently dropping it would change
+        # every logit — refuse loudly (load_hf_llama convention).
+        dec = _np(dec)
+        if dec.shape != embed_w.shape or not np.array_equal(dec,
+                                                            embed_w):
+            raise ValueError(
+                "checkpoint has an untied cls.predictions.decoder."
+                "weight (differs from word_embeddings); BertModel "
+                "only supports the tied MLM decoder")
+    block = _load_blocks(sd, _BERT_LAYERS, "encoder.layer.{i}", n)
+    qkv_k, qkv_b = [], []
+    for i in range(n):
+        pre = f"encoder.layer.{i}.attention.self"
+        qkv_k.append(np.concatenate(
+            [_np(sd[f"{pre}.{p}.weight"]).T
+             for p in ("query", "key", "value")], axis=1))
+        qkv_b.append(np.concatenate(
+            [_np(sd[f"{pre}.{p}.bias"])
+             for p in ("query", "key", "value")]))
+    _set_path(block, ("attn", "qkv", "kernel"),
+              jnp.asarray(np.stack(qkv_k, 0)))
+    _set_path(block, ("attn", "qkv", "bias"),
+              jnp.asarray(np.stack(qkv_b, 0)))
+    emb = "embeddings"
+    params = {
+        "embed": {"embedding": jnp.asarray(embed_w)},
+        "pos_embed": {"embedding": jnp.asarray(_np(
+            sd[f"{emb}.position_embeddings.weight"]))},
+        "type_embed": {"embedding": jnp.asarray(_np(
+            sd[f"{emb}.token_type_embeddings.weight"]))},
+        "ln_embed": {"scale": jnp.asarray(_np(
+            sd[f"{emb}.LayerNorm.weight"])),
+            "bias": jnp.asarray(_np(sd[f"{emb}.LayerNorm.bias"]))},
+        "layers": {"layer": block},
+        "mlm_dense": {
+            "kernel": jnp.asarray(_np(
+                state_dict["cls.predictions.transform.dense.weight"]).T),
+            "bias": jnp.asarray(_np(
+                state_dict["cls.predictions.transform.dense.bias"]))},
+        "mlm_ln": {
+            "scale": jnp.asarray(_np(
+                state_dict["cls.predictions.transform.LayerNorm.weight"])),
+            "bias": jnp.asarray(_np(
+                state_dict["cls.predictions.transform.LayerNorm.bias"]))},
+        "mlm_bias": jnp.asarray(_np(state_dict["cls.predictions.bias"])),
+    }
+    return {"params": params}
+
+
+def export_hf_bert(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Our BERT params -> an HF ``BertForMaskedLM`` state_dict of numpy
+    arrays (fused ``qkv`` split back into query/key/value; the tied
+    decoder weight is emitted alongside its standalone bias)."""
+    p = variables["params"]
+    h = cfg.hidden_size
+    embed = np.asarray(p["embed"]["embedding"])
+    sd: Dict[str, Any] = {
+        "bert.embeddings.word_embeddings.weight": embed,
+        "bert.embeddings.position_embeddings.weight":
+            np.asarray(p["pos_embed"]["embedding"]),
+        "bert.embeddings.token_type_embeddings.weight":
+            np.asarray(p["type_embed"]["embedding"]),
+        "bert.embeddings.LayerNorm.weight":
+            np.asarray(p["ln_embed"]["scale"]),
+        "bert.embeddings.LayerNorm.bias":
+            np.asarray(p["ln_embed"]["bias"]),
+        "cls.predictions.transform.dense.weight":
+            np.asarray(p["mlm_dense"]["kernel"]).T,
+        "cls.predictions.transform.dense.bias":
+            np.asarray(p["mlm_dense"]["bias"]),
+        "cls.predictions.transform.LayerNorm.weight":
+            np.asarray(p["mlm_ln"]["scale"]),
+        "cls.predictions.transform.LayerNorm.bias":
+            np.asarray(p["mlm_ln"]["bias"]),
+        "cls.predictions.bias": np.asarray(p["mlm_bias"]),
+        "cls.predictions.decoder.weight": embed,  # tied
+        "cls.predictions.decoder.bias": np.asarray(p["mlm_bias"]),
+    }
+    block = p["layers"]["layer"]
+    _export_blocks(block, _BERT_LAYERS, "bert.encoder.layer.{i}",
+                   cfg.num_layers, sd)
+    qkv_k = np.asarray(_get_path(block, ("attn", "qkv", "kernel")))
+    qkv_b = np.asarray(_get_path(block, ("attn", "qkv", "bias")))
+    for i in range(cfg.num_layers):
+        for j, part in enumerate(("query", "key", "value")):
+            pre = f"bert.encoder.layer.{i}.attention.self.{part}"
+            sd[f"{pre}.weight"] = qkv_k[i][:, j * h:(j + 1) * h].T
+            sd[f"{pre}.bias"] = qkv_b[i][j * h:(j + 1) * h]
+    return sd
 
 
 def _t5_layer_tables(cfg):

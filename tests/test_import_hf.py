@@ -3,6 +3,7 @@ must reproduce transformers' own logits on identical tokens — the
 hardest proof the TPU-native architectures match what reference-
 platform users bring."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -10,9 +11,11 @@ import pytest
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
+from polyaxon_tpu.models.bert import BertConfig, BertModel
 from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
 from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
-from polyaxon_tpu.models.import_hf import load_hf_gpt2, load_hf_llama
+from polyaxon_tpu.models.import_hf import (export_hf_bert, load_hf_bert,
+                                           load_hf_gpt2, load_hf_llama)
 
 
 def test_gpt2_matches_transformers():
@@ -58,6 +61,74 @@ def test_llama_matches_transformers():
     variables = load_hf_llama(hf.state_dict(), cfg)
     ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def _bert_pair():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=128, type_vocab_size=2,
+        hidden_act="gelu",  # exact (erf) GELU, as in released BERTs
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12)
+    cfg = BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128,
+                     max_position=128, gelu_approximate=False,
+                     dtype=jnp.float32)
+    return hf_cfg, cfg
+
+
+def test_bert_matches_transformers():
+    hf_cfg, cfg = _bert_pair()
+    torch.manual_seed(0)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+
+    tokens = np.random.RandomState(2).randint(0, 1024, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+
+    model = BertModel(cfg)
+    variables = load_hf_bert(hf.state_dict(), cfg)
+    ours = np.asarray(model.apply(
+        variables, jnp.asarray(tokens),
+        token_type_ids=jnp.zeros((2, 16), jnp.int32)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_export_roundtrip_into_transformers():
+    hf_cfg, cfg = _bert_pair()
+    model = BertModel(cfg)
+    tokens = np.random.RandomState(3).randint(0, 1024, (2, 12))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(11)}, jnp.asarray(tokens),
+        token_type_ids=jnp.zeros((2, 12), jnp.int32))
+    ours = np.asarray(model.apply(
+        variables, jnp.asarray(tokens),
+        token_type_ids=jnp.zeros((2, 12), jnp.int32)))
+
+    sd = export_hf_bert(variables, cfg)
+    torch.manual_seed(1)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.tensor(np.asarray(v).copy()) for k, v in sd.items()},
+        strict=False)
+    assert not unexpected
+    # Only non-param buffers may be absent from the export.
+    assert all("position_ids" in k or "token_type_ids" in k
+               for k in missing), missing
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_import_rejects_untied_decoder():
+    hf_cfg, cfg = _bert_pair()
+    torch.manual_seed(2)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    sd = dict(hf.state_dict())
+    sd["cls.predictions.decoder.weight"] = torch.randn(1024, 64)
+    with pytest.raises(ValueError, match="untied"):
+        load_hf_bert(sd, cfg)
 
 
 def test_gpt2_export_roundtrip_into_transformers():
