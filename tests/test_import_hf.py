@@ -131,6 +131,35 @@ def test_bert_import_rejects_untied_decoder():
         load_hf_bert(sd, cfg)
 
 
+def test_mistral_matches_transformers():
+    """HF Mistral checkpoints load through load_hf_llama (same param
+    surface); proves the documented sliding-window convention — HF
+    masks W keys ((i-W, i]), ours W+1 ([i-window, i]), so an HF
+    checkpoint with sliding_window=W pairs with cfg.sliding_window=W-1
+    — against transformers' own masking on a sequence (24) long enough
+    to exercise the window (8)."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rms_norm_eps=1e-5, rope_theta=10000.0, sliding_window=8,
+        attention_dropout=0.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    tokens = np.random.RandomState(1).randint(0, 512, (2, 24))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, max_position=128,
+                      rms_norm_eps=1e-5, sliding_window=7,
+                      dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = load_hf_llama(hf.state_dict(), cfg)
+    ours = np.asarray(model.apply(variables, jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
 def test_gpt2_export_roundtrip_into_transformers():
     """Our randomly-initialized GPT-2 exported to HF format must make
     transformers produce OUR logits (the reverse parity direction)."""
