@@ -144,18 +144,34 @@ def commit() -> None:
         log(f"commit failed: {e}")
 
 
+ZOMBIE_S = 1800.0  # hung probe older than this stops counting
+
+
 def main() -> None:
     log(f"watcher started pid={os.getpid()}")
-    hung = []  # abandoned probes: polled, never killed (wedge hazard)
+    hung = []  # abandoned (proc, spawn_ts): polled, never killed
     while True:
         backend = None
         # A hung probe that finally answers IS the recovery signal;
         # cap outstanding probes at 2 — stacking concurrent TPU-init
-        # attempts on a wedged tunnel can spread the wedge.
-        for proc in list(hung):
+        # attempts on a wedged tunnel can spread the wedge.  BUT a
+        # probe can hang forever on a half-open connection that never
+        # errors even after the tunnel recovers — with the cap full,
+        # no fresh probe would ever run and recovery would go
+        # undetected (observed: a multi-hour wedge with 2 outstanding
+        # and no probe activity).  Probes hung past ZOMBIE_S stop
+        # counting toward the cap (still never killed; they idle on
+        # blocked I/O), so a fresh probe — the actual recovery
+        # detector — keeps running every interval.
+        for entry in list(hung):
+            proc, ts = entry
             b = _reap_probe(proc)
             if proc.poll() is not None:
-                hung.remove(proc)
+                hung.remove(entry)
+            elif time.time() - ts > ZOMBIE_S:
+                hung.remove(entry)
+                log(f"probe pid={proc.pid} hung >{ZOMBIE_S:.0f}s; "
+                    f"no longer counts toward the probe cap")
             if b:
                 backend = b
         if backend is None and len(hung) < 2:
@@ -168,7 +184,7 @@ def main() -> None:
                 set_state("down")
                 log(f"probe hung >{PROBE_TIMEOUT:.0f}s (wedged); "
                     f"abandoned ({len(hung) + 1} outstanding)")
-                hung.append(probe)
+                hung.append((probe, time.time()))
         if backend == "tpu":
             set_state("up")
             if sweep_needed():
