@@ -144,25 +144,28 @@ def commit() -> None:
         log(f"commit failed: {e}")
 
 
-ZOMBIE_S = 1800.0  # hung probe older than this stops counting
+ZOMBIE_S = 1800.0  # hung probe older than this stops blocking fresh ones
 
 
 def main() -> None:
     log(f"watcher started pid={os.getpid()}")
-    hung = []  # abandoned (proc, spawn_ts): polled, never killed
+    hung = []     # recent abandoned (proc, spawn_ts): block new spawns
+    zombies = []  # old abandoned procs: still polled, never killed
     while True:
         backend = None
         # A hung probe that finally answers IS the recovery signal;
-        # cap outstanding probes at 2 — stacking concurrent TPU-init
-        # attempts on a wedged tunnel can spread the wedge.  BUT a
-        # probe can hang forever on a half-open connection that never
-        # errors even after the tunnel recovers — with the cap full,
-        # no fresh probe would ever run and recovery would go
+        # cap RECENT outstanding probes at 2 — stacking concurrent
+        # TPU-init attempts on a wedged tunnel can spread the wedge.
+        # BUT a probe can hang forever on a half-open connection that
+        # never errors even after the tunnel recovers — with the cap
+        # full, no fresh probe would ever run and recovery would go
         # undetected (observed: a multi-hour wedge with 2 outstanding
-        # and no probe activity).  Probes hung past ZOMBIE_S stop
-        # counting toward the cap (still never killed; they idle on
-        # blocked I/O), so a fresh probe — the actual recovery
-        # detector — keeps running every interval.
+        # and no probe activity).  Probes hung past ZOMBIE_S move to
+        # the zombie list: they stop blocking fresh spawns but stay
+        # polled (a zombie that finally answers still signals — and
+        # gets reaped).  MAX_ABANDONED bounds the TOTAL live abandoned
+        # processes so a days-long wedge can't leak processes without
+        # limit; at the bound, existing probes are the only detectors.
         for entry in list(hung):
             proc, ts = entry
             b = _reap_probe(proc)
@@ -170,11 +173,20 @@ def main() -> None:
                 hung.remove(entry)
             elif time.time() - ts > ZOMBIE_S:
                 hung.remove(entry)
+                zombies.append(proc)
                 log(f"probe pid={proc.pid} hung >{ZOMBIE_S:.0f}s; "
-                    f"no longer counts toward the probe cap")
+                    f"no longer blocks fresh probes "
+                    f"({len(zombies)} zombie(s))")
             if b:
                 backend = b
-        if backend is None and len(hung) < 2:
+        for proc in list(zombies):
+            b = _reap_probe(proc)
+            if proc.poll() is not None:
+                zombies.remove(proc)
+            if b:
+                backend = b
+        total = len(hung) + len(zombies)
+        if backend is None and len(hung) < 2 and total < MAX_ABANDONED:
             probe = spawn_probe()
             try:
                 out, _ = probe.communicate(timeout=PROBE_TIMEOUT)

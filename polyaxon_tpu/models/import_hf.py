@@ -108,9 +108,44 @@ def _export_blocks(block, table, layer_fmt: str, n: int,
                     f".{hf_suffix}"] = w.T if transpose else w
 
 
+def _load_fused_qkv(sd, block, attn_fmt: str, n: int,
+                    path: Tuple[str, ...] = ("attn", "qkv")) -> None:
+    """Concat HF's three ``{query,key,value}`` Linears (w+b) into the
+    stacked fused ``qkv`` Dense at ``path`` under ``block`` —
+    ``attn_fmt``: e.g. ``"encoder.layer.{i}.attention.self"``.  Order
+    (query, key, value) MUST match _export_fused_qkv and the models'
+    ``jnp.split(qkv, 3, axis=-1)``."""
+    ks, bs = [], []
+    for i in range(n):
+        pre = attn_fmt.format(i=i)
+        ks.append(np.concatenate(
+            [_np(sd[f"{pre}.{p}.weight"]).T
+             for p in ("query", "key", "value")], axis=1))
+        bs.append(np.concatenate(
+            [_np(sd[f"{pre}.{p}.bias"])
+             for p in ("query", "key", "value")]))
+    _set_path(block, path + ("kernel",), jnp.asarray(np.stack(ks, 0)))
+    _set_path(block, path + ("bias",), jnp.asarray(np.stack(bs, 0)))
+
+
+def _export_fused_qkv(block, attn_fmt: str, n: int, hidden: int,
+                      out: Dict[str, Any],
+                      path: Tuple[str, ...] = ("attn", "qkv")) -> None:
+    """Split the stacked fused ``qkv`` back into HF's three Linears
+    (inverse of _load_fused_qkv; same query/key/value order)."""
+    qkv_k = np.asarray(_get_path(block, path + ("kernel",)))
+    qkv_b = np.asarray(_get_path(block, path + ("bias",)))
+    for i in range(n):
+        for j, part in enumerate(("query", "key", "value")):
+            pre = f"{attn_fmt.format(i=i)}.{part}"
+            out[f"{pre}.weight"] = qkv_k[i][:, j * hidden:(j + 1)
+                                            * hidden].T
+            out[f"{pre}.bias"] = qkv_b[i][j * hidden:(j + 1) * hidden]
+
+
 # BERT per-layer tensors OTHER than attention.self (whose three
-# q/k/v Linears fuse into our single ``qkv`` Dense — handled by hand
-# in load/export below).
+# q/k/v Linears fuse into our single ``qkv`` Dense — handled by the
+# fused-qkv helpers above).
 _BERT_LAYERS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
     ("attention.output.dense", ("attn", "o_proj"), "linear_b"),
     ("attention.output.LayerNorm", ("ln_attn",), "ln"),
@@ -148,19 +183,7 @@ def load_hf_bert(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
                 "weight (differs from word_embeddings); BertModel "
                 "only supports the tied MLM decoder")
     block = _load_blocks(sd, _BERT_LAYERS, "encoder.layer.{i}", n)
-    qkv_k, qkv_b = [], []
-    for i in range(n):
-        pre = f"encoder.layer.{i}.attention.self"
-        qkv_k.append(np.concatenate(
-            [_np(sd[f"{pre}.{p}.weight"]).T
-             for p in ("query", "key", "value")], axis=1))
-        qkv_b.append(np.concatenate(
-            [_np(sd[f"{pre}.{p}.bias"])
-             for p in ("query", "key", "value")]))
-    _set_path(block, ("attn", "qkv", "kernel"),
-              jnp.asarray(np.stack(qkv_k, 0)))
-    _set_path(block, ("attn", "qkv", "bias"),
-              jnp.asarray(np.stack(qkv_b, 0)))
+    _load_fused_qkv(sd, block, "encoder.layer.{i}.attention.self", n)
     emb = "embeddings"
     params = {
         "embed": {"embedding": jnp.asarray(embed_w)},
@@ -219,13 +242,83 @@ def export_hf_bert(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
     block = p["layers"]["layer"]
     _export_blocks(block, _BERT_LAYERS, "bert.encoder.layer.{i}",
                    cfg.num_layers, sd)
-    qkv_k = np.asarray(_get_path(block, ("attn", "qkv", "kernel")))
-    qkv_b = np.asarray(_get_path(block, ("attn", "qkv", "bias")))
-    for i in range(cfg.num_layers):
-        for j, part in enumerate(("query", "key", "value")):
-            pre = f"bert.encoder.layer.{i}.attention.self.{part}"
-            sd[f"{pre}.weight"] = qkv_k[i][:, j * h:(j + 1) * h].T
-            sd[f"{pre}.bias"] = qkv_b[i][j * h:(j + 1) * h]
+    _export_fused_qkv(block, "bert.encoder.layer.{i}.attention.self",
+                      cfg.num_layers, h, sd)
+    return sd
+
+
+# ViT per-layer tensors OTHER than attention.attention (fused qkv —
+# same helpers as BERT).  Pre-LN block: layernorm_before/after.
+_VIT_LAYERS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("layernorm_before", ("ln1",), "ln"),
+    ("attention.output.dense", ("o_proj",), "linear_b"),
+    ("layernorm_after", ("ln2",), "ln"),
+    ("intermediate.dense", ("fc1",), "linear_b"),
+    ("output.dense", ("fc2",), "linear_b"),
+)
+
+
+def load_hf_vit(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``ViTForImageClassification.state_dict()`` -> ``{"params":
+    ...}`` for :class:`~polyaxon_tpu.models.vit.ViTModel`.
+
+    torch's conv kernel is OIHW; flax wants HWIO (transpose
+    (2, 3, 1, 0)).  Patch order matches: both flatten the conv output
+    row-major.  HF ViT feeds NCHW pixel values — transpose images to
+    our NHWC at the call site.  Build with ``gelu_approximate=False``
+    (HF ViT uses the exact GELU).
+    """
+    sd = {k.removeprefix("vit."): v for k, v in state_dict.items()}
+    n = cfg.num_layers
+    block = _load_blocks(sd, _VIT_LAYERS, "encoder.layer.{i}", n)
+    _load_fused_qkv(sd, block,
+                    "encoder.layer.{i}.attention.attention", n,
+                    path=("qkv",))  # ViT blocks have no attn submodule
+    params = {
+        "cls": jnp.asarray(_np(sd["embeddings.cls_token"])),
+        "pos_embed": jnp.asarray(_np(
+            sd["embeddings.position_embeddings"])),
+        "patch_embed": {
+            "kernel": jnp.asarray(_np(
+                sd["embeddings.patch_embeddings.projection.weight"]
+            ).transpose(2, 3, 1, 0)),
+            "bias": jnp.asarray(_np(
+                sd["embeddings.patch_embeddings.projection.bias"]))},
+        "h": {"block": block},
+        "ln_f": {"scale": jnp.asarray(_np(sd["layernorm.weight"])),
+                 "bias": jnp.asarray(_np(sd["layernorm.bias"]))},
+        "head": {
+            "kernel": jnp.asarray(_np(
+                state_dict["classifier.weight"]).T),
+            "bias": jnp.asarray(_np(state_dict["classifier.bias"]))},
+    }
+    return {"params": params}
+
+
+def export_hf_vit(variables: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """Our ViT params -> an HF ``ViTForImageClassification``
+    state_dict of numpy arrays."""
+    p = variables["params"]
+    sd: Dict[str, Any] = {
+        "vit.embeddings.cls_token": np.asarray(p["cls"]),
+        "vit.embeddings.position_embeddings":
+            np.asarray(p["pos_embed"]),
+        "vit.embeddings.patch_embeddings.projection.weight":
+            np.asarray(p["patch_embed"]["kernel"]).transpose(3, 2, 0, 1),
+        "vit.embeddings.patch_embeddings.projection.bias":
+            np.asarray(p["patch_embed"]["bias"]),
+        "vit.layernorm.weight": np.asarray(p["ln_f"]["scale"]),
+        "vit.layernorm.bias": np.asarray(p["ln_f"]["bias"]),
+        "classifier.weight": np.asarray(p["head"]["kernel"]).T,
+        "classifier.bias": np.asarray(p["head"]["bias"]),
+    }
+    block = p["h"]["block"]
+    _export_blocks(block, _VIT_LAYERS, "vit.encoder.layer.{i}",
+                   cfg.num_layers, sd)
+    _export_fused_qkv(block,
+                      "vit.encoder.layer.{i}.attention.attention",
+                      cfg.num_layers, cfg.hidden_size, sd,
+                      path=("qkv",))
     return sd
 
 

@@ -34,6 +34,9 @@ class ViTConfig:
     num_heads: int = 12
     intermediate_size: int = 3072
     layer_norm_eps: float = 1e-6
+    # tanh-approximate GELU is the TPU-fast default; HF ViT uses the
+    # exact (erf) form — checkpoint import sets False for logit parity.
+    gelu_approximate: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     # See GPT2Config.remat_policy (jax.checkpoint_policies member name).
@@ -84,7 +87,7 @@ class ViTBlock(nn.Module):
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                      name="fc1")(h)
         h = constrain(h, BATCH, None, "tp")
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="fc2")(h)
         x = x + h
         return constrain(x, BATCH, None, None)

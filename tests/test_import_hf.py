@@ -131,6 +131,61 @@ def test_bert_import_rejects_untied_decoder():
         load_hf_bert(sd, cfg)
 
 
+def _vit_pair():
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, num_channels=3, hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=128, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-6, num_labels=10)
+    from polyaxon_tpu.models.vit import ViTConfig
+    cfg = ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                    hidden_size=64, num_layers=2, num_heads=4,
+                    intermediate_size=128, gelu_approximate=False,
+                    dtype=jnp.float32)
+    return hf_cfg, cfg
+
+
+def test_vit_matches_transformers():
+    from polyaxon_tpu.models.vit import ViTModel
+    from polyaxon_tpu.models.import_hf import load_hf_vit
+    hf_cfg, cfg = _vit_pair()
+    torch.manual_seed(0)
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+
+    images = np.random.RandomState(4).rand(2, 32, 32, 3).astype("f4")
+    with torch.no_grad():
+        ref = hf(torch.tensor(images.transpose(0, 3, 1, 2))) \
+            .logits.numpy()
+    model = ViTModel(cfg)
+    variables = load_hf_vit(hf.state_dict(), cfg)
+    ours = np.asarray(model.apply(variables, jnp.asarray(images)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_vit_export_roundtrip_into_transformers():
+    from polyaxon_tpu.models.vit import ViTModel
+    from polyaxon_tpu.models.import_hf import export_hf_vit
+    hf_cfg, cfg = _vit_pair()
+    model = ViTModel(cfg)
+    images = np.random.RandomState(5).rand(2, 32, 32, 3).astype("f4")
+    variables = model.init(jax.random.PRNGKey(13), jnp.asarray(images))
+    ours = np.asarray(model.apply(variables, jnp.asarray(images)))
+
+    sd = export_hf_vit(variables, cfg)
+    torch.manual_seed(1)
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.tensor(np.asarray(v).copy()) for k, v in sd.items()},
+        strict=False)
+    assert not unexpected
+    assert not missing, missing
+    with torch.no_grad():
+        ref = hf(torch.tensor(images.transpose(0, 3, 1, 2))) \
+            .logits.numpy()
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
 def test_mistral_matches_transformers():
     """HF Mistral checkpoints load through load_hf_llama (same param
     surface); proves the documented sliding-window convention — HF
