@@ -131,13 +131,26 @@ def _moe_lm_loss(model):
 def _seq2seq_loss(model):
     """Teacher-forced seq2seq xent: decoder inputs are the shift-right
     of ``labels`` (T5's pad-as-start convention); synthetic batches
-    reuse ``inputs`` as ``labels`` (a denoising-style self-target)."""
+    reuse ``inputs`` as ``labels`` (a denoising-style self-target).
+
+    Optional batch keys (emitted by ``data.SpanCorruptionDataset``):
+    ``enc_mask`` hides encoder padding; ``target_mask`` drops padded
+    target positions from the mean."""
     def loss(params, batch, rng):
         src = batch["inputs"]
         tgt = batch.get("labels", src)
         dec_in = shift_right(jnp.asarray(tgt), model.cfg.pad_id)
-        logits = model.apply(params, src, dec_in, train=True)
-        l = softmax_xent(logits, tgt)
+        logits = model.apply(params, src, dec_in,
+                             enc_mask=batch.get("enc_mask"),
+                             train=True)
+        mask = batch.get("target_mask")
+        if mask is None:
+            l = softmax_xent(logits, tgt)
+        else:
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt)
+            denom = jnp.maximum(mask.sum(), 1)
+            l = jnp.where(mask.astype(bool), per_tok, 0.0).sum() / denom
         return l, {"perplexity": jnp.exp(l)}
     return loss
 

@@ -66,7 +66,8 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="Directory of inputs.npy/labels.npy (else "
                         "synthetic).")
     p.add_argument("--dataset", default=None,
-                   choices=["synthetic", "digits", "npy", "tokens"],
+                   choices=["synthetic", "digits", "npy", "tokens",
+                            "span-corruption"],
                    help="Input source (default: npy when --data-dir is "
                         "given, else synthetic).  'digits' is the real "
                         "offline 10-class image set (BASELINE config 1); "
@@ -129,8 +130,10 @@ def make_optimizer(name: str, lr: float):
     return optax.adamw(lr, weight_decay=0.01)
 
 
-def make_datasets(args, spec, batch_size: int):
-    """(train ArrayDataset, eval ArrayDataset or None)."""
+def make_datasets(args, spec, batch_size: int, model=None):
+    """(train ArrayDataset, eval ArrayDataset or None).  ``model``:
+    the already-constructed model (span-corruption reads its config
+    and seq2seq-ness instead of building a throwaway copy)."""
     from . import data
 
     kind = args.dataset or ("npy" if args.data_dir else "synthetic")
@@ -146,6 +149,28 @@ def make_datasets(args, spec, batch_size: int):
             spec.make_batch(1)["inputs"].shape[-1]
         return data.token_dataset(args.data_dir, batch_size, seq_len,
                                   seed=args.seed), None
+    if kind == "span-corruption":
+        # T5-style denoising pretraining over a token stream
+        # (data.SpanCorruptionDataset).
+        if not args.data_dir:
+            raise SystemExit("--dataset span-corruption requires "
+                             "--data-dir")
+        model = model if model is not None else spec.make_model()
+        if not hasattr(model, "encode"):
+            raise SystemExit(
+                f"--dataset span-corruption requires a seq2seq "
+                f"(encoder-decoder) model; {args.model!r} is not "
+                f"(use a t5-* model)")
+        cfg = model.cfg
+        seq_len = args.seq_len or \
+            spec.make_batch(1)["inputs"].shape[-1]
+        stream = data.token_dataset(args.data_dir, batch_size, seq_len,
+                                    seed=args.seed)
+        return data.SpanCorruptionDataset(
+            stream.tokens, batch_size, inputs_length=seq_len,
+            targets_length=max(32, seq_len // 4),
+            vocab_size=cfg.vocab_size, pad_id=cfg.pad_id,
+            seed=args.seed), None
     if kind == "digits":
         train = data.digits_dataset(batch_size, split="train",
                                     seed=args.seed)
@@ -357,9 +382,10 @@ def _main(argv=None) -> int:
 
     # Data defines the input shapes: init params from a dataset sample
     # (e.g. digits are 8x8 where the synthetic stand-in is 28x28).
-    train_ds, eval_ds = make_datasets(args, spec, batch_size)
-    sample = train_ds.sample(2)
     model = spec.make_model()
+    train_ds, eval_ds = make_datasets(args, spec, batch_size,
+                                      model=model)
+    sample = train_ds.sample(2)
     params = model.init(jax.random.PRNGKey(args.seed), sample["inputs"])
     loss_fn = spec.loss_fn(model)
     if mesh.shape.get("pp", 1) > 1:
