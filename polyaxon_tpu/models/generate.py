@@ -54,7 +54,8 @@ def extract_logits(out) -> jax.Array:
         f"{type(out).__name__}")
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
@@ -63,12 +64,35 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         # token.
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None:
+        # Nucleus sampling: keep the smallest prefix of the sorted
+        # distribution whose mass reaches top_p (a token enters the
+        # nucleus iff the cumulative mass BEFORE it is < top_p, so the
+        # top token always survives).  One descending sort per decoded
+        # token; composes with top_k (masked lanes sort to the tail).
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        before = jnp.cumsum(probs, axis=-1) - probs
+        cut = jnp.where(before < top_p, sorted_l, jnp.inf)
+        kth = jnp.min(cut, axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def _check_top_p(top_p) -> None:
+    """top_p=0 would mask EVERY lane (before<0 is never true) and
+    degenerate to uniform noise over the full vocab — refuse anything
+    outside (0, 1] at the entry points."""
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_p must be in (0, 1]; got {top_p} (use "
+            f"temperature=0 for greedy decoding)")
 
 
 def _decode_loop(apply_step, cache, first_logits, *,
                  max_new_tokens: int, rng, temperature: float,
-                 top_k: Optional[int], eos_id: Optional[int]):
+                 top_k: Optional[int], eos_id: Optional[int],
+                 top_p: Optional[float] = None):
     """Shared sample-first + scan-over-tokens machinery for
     :func:`generate` and :func:`generate_seq2seq` (one place owns the
     eos-freeze and sampling semantics).
@@ -79,7 +103,7 @@ def _decode_loop(apply_step, cache, first_logits, *,
     tokens [B, max_new_tokens].
     """
     rng, key = jax.random.split(rng)
-    first = _sample(first_logits, key, temperature, top_k)
+    first = _sample(first_logits, key, temperature, top_k, top_p)
     done = jnp.zeros((first.shape[0],), bool)
     if eos_id is not None:
         done = first == eos_id
@@ -88,7 +112,7 @@ def _decode_loop(apply_step, cache, first_logits, *,
         cache, tok, rng, done = carry
         logits, cache = apply_step(cache, tok, t)
         rng, key = jax.random.split(rng)
-        nxt = _sample(logits, key, temperature, top_k)
+        nxt = _sample(logits, key, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
@@ -106,6 +130,7 @@ def _decode_loop(apply_step, cache, first_logits, *,
 
 def generate(model, variables, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
              eos_id: Optional[int] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
@@ -118,6 +143,7 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0; got "
                          f"{max_new_tokens}")
+    _check_top_p(top_p)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -153,13 +179,14 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     new = _decode_loop(apply_step, cache, extract_logits(out)[:, -1],
                        max_new_tokens=max_new_tokens, rng=rng,
                        temperature=temperature, top_k=top_k,
-                       eos_id=eos_id)
+                       top_p=top_p, eos_id=eos_id)
     return jnp.concatenate([prompt, new], axis=1)
 
 
 def generate_seq2seq(model, variables, enc_tokens, *,
                      max_new_tokens: int, temperature: float = 0.0,
                      top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
                      rng: Optional[jax.Array] = None,
                      eos_id: Optional[int] = None,
                      enc_mask: Optional[jax.Array] = None,
@@ -176,6 +203,7 @@ def generate_seq2seq(model, variables, enc_tokens, *,
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1; got "
                          f"{max_new_tokens}")
+    _check_top_p(top_p)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if start_id is None:
@@ -212,7 +240,8 @@ def generate_seq2seq(model, variables, enc_tokens, *,
     return _decode_loop(
         lambda cache, tok, t: apply_step(cache, tok[:, None], 1 + t),
         cache, logits, max_new_tokens=max_new_tokens, rng=rng,
-        temperature=temperature, top_k=top_k, eos_id=eos_id)
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_id=eos_id)
 
 
 def generate_beam(model, variables, prompt, *, max_new_tokens: int,

@@ -257,3 +257,54 @@ class TestSpaceToDepthStem:
         with pytest.raises(ValueError, match="stem"):
             model.init(jax.random.PRNGKey(0),
                        jnp.zeros((1, 32, 32, 3)))
+
+
+def test_nucleus_sampling_restricts_support():
+    """top_p keeps exactly the smallest prefix of the sorted
+    distribution whose mass reaches p (the top token always
+    survives), and composes with the generate() entry points."""
+    import jax
+
+    from polyaxon_tpu.models.generate import _sample
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # Cumulative-before = [0, .5, .8, .95]: top_p=0.6 -> nucleus {0,1}.
+    seen = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, None,
+                        0.6)[0]) for i in range(60)}
+    assert seen == {0, 1}, seen
+    # A tiny p keeps only the argmax.
+    assert all(int(_sample(logits, jax.random.PRNGKey(i), 1.0, None,
+                           0.01)[0]) == 0 for i in range(10))
+    # p=1.0 keeps the full support.
+    seen = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, None,
+                        1.0)[0]) for i in range(200)}
+    assert seen == {0, 1, 2, 3}, seen
+    # Composes with top_k: k=3 renormalizes {0,1,2} to
+    # [.526, .316, .158] (before = [0, .526, .842]), so p=0.8 cuts
+    # token 2 (.842 >= .8) and keeps {0, 1}.
+    seen = {int(_sample(logits, jax.random.PRNGKey(i), 1.0, 3,
+                        0.8)[0]) for i in range(60)}
+    assert seen == {0, 1}, seen
+
+
+def test_generate_with_top_p_runs():
+    from polyaxon_tpu.models.generate import generate
+    from polyaxon_tpu.models.registry import get_model
+
+    spec = get_model("gpt2-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    out = generate(model, variables, prompt, max_new_tokens=4,
+                   temperature=0.8, top_p=0.9)
+    assert out.shape == (2, 8)
+
+
+def test_top_p_zero_rejected():
+    from polyaxon_tpu.models.generate import generate
+    from polyaxon_tpu.models.registry import get_model
+
+    spec = get_model("gpt2-tiny")
+    model, variables = spec.init_params(batch_size=1)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, variables, jnp.zeros((1, 4), jnp.int32),
+                 max_new_tokens=2, temperature=1.0, top_p=0.0)
