@@ -96,6 +96,18 @@ def bench_decode(jax, model_name: str, backend: str):
     total_s = timed(gen, prompt)
     tok_per_sec = batch * new_toks / total_s
 
+    # Weight-only int8 A/B (ops/quant.py): decode at small batch is
+    # weight-bandwidth-bound, so halving the weight bytes should show
+    # directly in tok/sec.  Same jitted program shape — the dequant
+    # sits inside the scan body (generate._params).
+    from polyaxon_tpu.ops.quant import quantize_params, quantized_bytes
+    qvars = {"params": quantize_params(variables["params"])}
+    stored_b, full_b = quantized_bytes(qvars["params"])
+    gen_q = jax.jit(lambda p: gen_fn(model, qvars, p,
+                                     max_new_tokens=new_toks))
+    int8_s = timed(gen_q, prompt)
+    tok_per_sec_int8 = batch * new_toks / int8_s
+
     # TTFT = prefill + first sampled token (max_new_tokens=1).
     ttft = {}
     for L in ttft_lens:
@@ -114,6 +126,10 @@ def bench_decode(jax, model_name: str, backend: str):
         "new_tokens": new_toks,
         "tok_per_sec_per_chip": round(tok_per_sec, 1),
         "decode_ms_per_token": round(1000 * total_s / new_toks, 3),
+        "tok_per_sec_per_chip_int8": round(tok_per_sec_int8, 1),
+        "int8_speedup": round(tok_per_sec_int8 / tok_per_sec, 3),
+        "weights_mb": round(full_b / 2**20, 1),
+        "weights_mb_int8": round(stored_b / 2**20, 1),
         "kv_cache_mb": round(kv_bytes / 2**20, 1),
         "ttft_ms": {str(k): round(v * 1e3, 1) for k, v in ttft.items()},
         "ttft_ratio": round(ratio, 2),

@@ -20,6 +20,22 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..ops.quant import dequantize_params
+
+
+def _params(variables):
+    """Resolve the params tree at the point of USE.
+
+    Weight-only int8 serving (ops/quant.py) stores QuantizedTensor
+    leaves; dequantizing here — inside the apply_step closures that
+    become the decode scan's body — keeps the int8 buffers in HBM and
+    lets XLA fuse the convert+scale into each matmul's operand read.
+    Dequantizing once up front would materialize bf16 weights and
+    forfeit the bandwidth win.  Unquantized trees pass through
+    untouched.
+    """
+    return dequantize_params(variables["params"])
+
 
 def init_cache(model, batch_size: int):
     """Allocate the stacked per-layer KV cache for a DECODER-ONLY
@@ -164,14 +180,14 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     # sequential decode steps.
     cache = init_cache(model, b)
     out, mut = model.apply(
-        {"params": variables["params"], "cache": cache},
+        {"params": _params(variables), "cache": cache},
         prompt, decode=True, decode_position=0, last_only=True,
         mutable=["cache"])
     cache = mut["cache"]
 
     def apply_step(cache, tok, t):
         out, mut = model.apply(
-            {"params": variables["params"], "cache": cache},
+            {"params": _params(variables), "cache": cache},
             tok[:, None], decode=True, decode_position=p_len + t,
             mutable=["cache"])
         return extract_logits(out)[:, -1], mut["cache"]
@@ -218,7 +234,7 @@ def generate_seq2seq(model, variables, enc_tokens, *,
             f"max_position ({max_pos})")
     enc_tokens = jnp.asarray(enc_tokens, jnp.int32)
     b = enc_tokens.shape[0]
-    params = {"params": variables["params"]}
+    params = {"params": _params(variables)}
     enc_out = model.apply(params, enc_tokens, enc_mask=enc_mask,
                           method="encode")
 
@@ -230,7 +246,7 @@ def generate_seq2seq(model, variables, enc_tokens, *,
 
     def apply_step(cache, tok, pos):
         out, mut = model.apply(
-            {"params": variables["params"], "cache": cache},
+            {"params": _params(variables), "cache": cache},
             tok, enc_out, enc_mask=enc_mask, decode=True,
             decode_position=pos, last_only=True, mutable=["cache"],
             method="decode")
@@ -284,13 +300,13 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
     # Prefill once on [B, P]; _beam_loop tiles the cache per beam.
     cache = init_cache(model, b)
     out, mut = model.apply(
-        {"params": variables["params"], "cache": cache},
+        {"params": _params(variables), "cache": cache},
         prompt, decode=True, decode_position=0, last_only=True,
         mutable=["cache"])
 
     def apply_step(cache, toks_flat, t):
         out, mut = model.apply(
-            {"params": variables["params"], "cache": cache},
+            {"params": _params(variables), "cache": cache},
             toks_flat, decode=True, decode_position=p_len + t,
             mutable=["cache"])
         return extract_logits(out)[:, -1], mut["cache"]
@@ -426,7 +442,7 @@ def generate_beam_seq2seq(model, variables, enc_tokens, *,
             f"max_position ({max_pos})")
     enc_tokens = jnp.asarray(enc_tokens, jnp.int32)
     b = enc_tokens.shape[0]
-    params = {"params": variables["params"]}
+    params = {"params": _params(variables)}
     enc_out = model.apply(params, enc_tokens, enc_mask=enc_mask,
                           method="encode")
     enc_tiled = jnp.repeat(enc_out, num_beams, axis=0)     # b-major
@@ -437,14 +453,14 @@ def generate_beam_seq2seq(model, variables, enc_tokens, *,
     # (generate_seq2seq rationale).
     start = jnp.full((b, 1), start_id, jnp.int32)
     out, mut = model.apply(
-        {"params": variables["params"], "cache": {}},
+        {"params": _params(variables), "cache": {}},
         start, enc_out, enc_mask=enc_mask, decode=True,
         decode_position=0, last_only=True, mutable=["cache"],
         method="decode")
 
     def apply_step(cache, toks_flat, t):
         out, mut = model.apply(
-            {"params": variables["params"], "cache": cache},
+            {"params": _params(variables), "cache": cache},
             toks_flat, enc_tiled, enc_mask=mask_tiled, decode=True,
             decode_position=1 + t, last_only=True, mutable=["cache"],
             method="decode")

@@ -11,3 +11,8 @@ behind one stable function so models never branch on backend.
 """
 
 from .attention import dot_product_attention  # noqa: F401
+from .quant import (  # noqa: F401
+    QuantizedTensor,
+    dequantize_params,
+    quantize_params,
+)

@@ -1,0 +1,174 @@
+"""Weight-only int8 quantization for the serving path.
+
+TPU decode at small batch is bandwidth-bound on WEIGHT reads: every
+generated token streams the full parameter set out of HBM while the MXU
+idles (see docs/SCALING.md roofline and the `offline-v5e` rows in
+benchmarks/results.jsonl).  Storing weights as int8 + a per-channel
+bf16 scale halves the bytes/token; the dequantize (convert + broadcast
+multiply) is emitted INSIDE the decode step so XLA fuses it into the
+consuming matmul's operand read — HBM traffic stays int8, compute stays
+bf16 on the MXU.
+
+Design (pytree-level, zero model changes):
+
+- :class:`QuantizedTensor` is a registered pytree node ``(q: int8,
+  scale: f32-ish)`` that flows through ``jax.jit`` boundaries, device
+  placement, and checkpointing like any other leaf pair.
+- :func:`quantize_params` walks a params tree and replaces eligible
+  leaves (>=2-D, above a size floor — biases/norm scales stay exact).
+- :func:`dequantize_params` maps the tree back to arrays; call it at
+  the point of USE (inside the jitted/scanned step, as
+  models/generate.py does) so the int8 buffers are what lives in HBM.
+
+Parity: the reference has no quantization story at all (serving is an
+opaque user container behind `V1Service`, SURVEY.md §2.4); this is a
+TPU-native addition on the framework's owned decode path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Symmetric per-channel int8 weight + broadcastable scale.
+
+    ``dequantize()`` reproduces the original up to one rounding step:
+    ``|w - q*scale| <= scale/2`` elementwise (tests/test_quant.py pins
+    the bound).
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # what dequantize() will produce
+        return self.scale.dtype
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequantize(self, dtype: Optional[jnp.dtype] = None) -> jax.Array:
+        w = self.q.astype(self.scale.dtype) * self.scale
+        return w if dtype is None else w.astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return (f"QuantizedTensor(shape={tuple(self.q.shape)}, "
+                f"scale_shape={tuple(self.scale.shape)})")
+
+
+def _scale_axes(ndim: int) -> tuple:
+    """Reduction axes for the per-channel max-abs: everything except
+    the LAST axis (the output-channel axis of `x @ w` kernels), and —
+    for rank>=3 leaves — except the FIRST axis too, so scan-stacked
+    ``[layers, in, out]`` kernels get independent per-layer scales
+    (layer magnitudes differ; one shared scale would crush the small
+    layers' resolution)."""
+    if ndim >= 3:
+        return tuple(range(1, ndim - 1))
+    return tuple(range(ndim - 1))
+
+
+def quantize_array(w: jax.Array, dtype=jnp.bfloat16) -> QuantizedTensor:
+    """Symmetric int8 quantization with per-channel scales.
+
+    ``dtype`` is the dtype dequantization produces (and the scale's
+    dtype) — bf16 matches the zoo's compute dtype.
+    """
+    w32 = jnp.asarray(w, jnp.float32)
+    axes = _scale_axes(w32.ndim)
+    amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
+    # All-zero channels: any scale reproduces them exactly; use 1 to
+    # avoid 0/0.
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale.astype(dtype))
+
+
+def _is_qt(x) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+def quantize_params(params: Any, *, min_size: int = 4096,
+                    dtype=jnp.bfloat16, predicate=None) -> Any:
+    """Replace eligible param leaves with :class:`QuantizedTensor`.
+
+    Eligible: rank >= 2 (matmul/conv kernels; biases and norm
+    scales/embedding-free 1-D leaves stay exact) and at least
+    ``min_size`` elements (tiny heads aren't worth the rounding).
+    ``predicate(path, leaf) -> bool`` further restricts if given
+    (path is a jax keystr).
+    """
+    def one(path, leaf):
+        if _is_qt(leaf):
+            return leaf  # already quantized — idempotent
+        arr = jnp.asarray(leaf)
+        if arr.ndim < 2 or arr.size < min_size:
+            return leaf
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            return leaf
+        if predicate is not None and not predicate(
+                jax.tree_util.keystr(path), arr):
+            return leaf
+        return quantize_array(arr, dtype=dtype)
+
+    # is_leaf keeps already-quantized nodes atomic: without it the map
+    # would recurse INTO QuantizedTensor and re-quantize any scale
+    # large enough to pass the eligibility filter.
+    return jax.tree_util.tree_map_with_path(one, params, is_leaf=_is_qt)
+
+
+def dequantize_params(tree: Any, dtype: Optional[jnp.dtype] = None) -> Any:
+    """Map :class:`QuantizedTensor` leaves back to arrays.
+
+    Call this at the point of use — inside the jitted step — so the
+    int8 buffers are what crosses the jit boundary and lives in HBM;
+    XLA fuses the convert+scale into the consuming matmul.  A tree with
+    no quantized leaves passes through untouched (same leaf objects).
+    """
+    return jax.tree.map(
+        lambda x: x.dequantize(dtype) if _is_qt(x) else x,
+        tree, is_leaf=_is_qt)
+
+
+def has_quantized(tree: Any) -> bool:
+    return any(_is_qt(x) for x in
+               jax.tree.leaves(tree, is_leaf=_is_qt))
+
+
+def quantized_bytes(tree: Any) -> tuple:
+    """(bytes_as_stored, bytes_if_bf16) over the whole tree — the
+    serving-memory win surfaced by bench_decode's quantized rows.
+    ``bytes_if_bf16`` counts EVERY leaf at 2 bytes/element (the uniform
+    bf16-serving baseline), so the ratio isn't skewed by fp32-init
+    biases/norm scales that stay unquantized."""
+    stored = 0
+    full = 0
+    for leaf in jax.tree.leaves(tree, is_leaf=_is_qt):
+        if _is_qt(leaf):
+            stored += leaf.q.size + leaf.scale.size * leaf.scale.dtype.itemsize
+            full += leaf.q.size * 2
+        else:
+            arr = jnp.asarray(leaf)
+            stored += arr.size * arr.dtype.itemsize
+            full += arr.size * 2
+    return stored, full

@@ -1,0 +1,197 @@
+"""Weight-only int8 quantization (ops/quant.py).
+
+Parity note: the reference has no quantization (serving = opaque user
+containers, SURVEY.md §2.4); this is a TPU-native serving addition —
+decode at small batch is weight-bandwidth-bound, int8 halves the
+bytes/token.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import generate
+from polyaxon_tpu.models.registry import get_model
+from polyaxon_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize_params,
+    has_quantized,
+    quantize_array,
+    quantize_params,
+    quantized_bytes,
+)
+
+
+def test_roundtrip_error_bound():
+    """Elementwise |w - dq| <= scale/2 (symmetric rounding bound) with
+    an exact f32 scale; the default bf16 scale adds its own <=2^-9
+    relative rounding on top."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 96)) * 3.0
+    qt = quantize_array(w, dtype=jnp.float32)
+    dq = np.asarray(qt.dequantize(jnp.float32))
+    bound = np.asarray(qt.scale) / 2 + 1e-6
+    assert np.all(np.abs(np.asarray(w) - dq) <= bound)
+    assert qt.q.dtype == jnp.int8
+    # per-out-channel scales for a 2-D kernel
+    assert qt.scale.shape == (1, 96)
+    # bf16 scale (the serving default): the scale AND the q*scale
+    # product each round to bf16 (<=2^-8 rel each); bound is int8
+    # rounding + bf16 relative error on the value itself.
+    qb = quantize_array(w)
+    dqb = np.asarray(qb.dequantize(jnp.float32))
+    sb = np.asarray(qb.scale.astype(jnp.float32))
+    assert np.all(np.abs(np.asarray(w) - dqb) <=
+                  sb * 0.5 + np.abs(np.asarray(w)) * 2.0 ** -7 + 1e-6)
+
+
+def test_scanstacked_per_layer_scales():
+    """[layers, in, out] kernels get independent per-layer scales —
+    a 100x magnitude spread across layers must not crush resolution."""
+    k = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 48))
+    k = k * jnp.array([0.01, 0.1, 1.0, 10.0])[:, None, None]
+    qt = quantize_array(k)
+    assert qt.scale.shape == (4, 1, 48)
+    dq = np.asarray(qt.dequantize(jnp.float32))
+    rel = np.abs(dq - np.asarray(k)).max(axis=(1, 2)) / \
+        np.abs(np.asarray(k)).max(axis=(1, 2))
+    # every layer keeps int8-grade relative resolution
+    assert np.all(rel < 1.0 / 127)
+
+
+def test_zero_channel_safe():
+    w = jnp.zeros((16, 128))
+    qt = quantize_array(w)
+    assert np.all(np.asarray(qt.dequantize()) == 0)
+    assert np.all(np.isfinite(np.asarray(qt.scale, dtype=np.float32)))
+
+
+def test_quantize_params_eligibility():
+    """Biases/1-D leaves and small leaves stay exact."""
+    params = {
+        "dense": {"kernel": jnp.ones((128, 128)), "bias": jnp.ones((128,))},
+        "tiny": {"kernel": jnp.ones((4, 4))},
+        "norm": {"scale": jnp.ones((128,))},
+    }
+    qp = quantize_params(params, min_size=1024)
+    assert isinstance(qp["dense"]["kernel"], QuantizedTensor)
+    assert isinstance(qp["dense"]["bias"], jax.Array)
+    assert isinstance(qp["tiny"]["kernel"], jax.Array)
+    assert isinstance(qp["norm"]["scale"], jax.Array)
+    assert has_quantized(qp) and not has_quantized(params)
+    # idempotent — including when the SCALE itself is big enough to
+    # pass the eligibility filter (a stacked [32,256,256] kernel's
+    # (32,1,256) scale has 8192 elements): re-quantizing must treat
+    # QuantizedTensor as atomic, not recurse into it.
+    big = {"stack": {"kernel": jnp.ones((32, 256, 256))}}
+    qb = quantize_params(big, min_size=4096)
+    assert isinstance(qb["stack"]["kernel"], QuantizedTensor)
+    qb2 = quantize_params(qb, min_size=4096)
+    assert isinstance(qb2["stack"]["kernel"], QuantizedTensor)
+    assert isinstance(qb2["stack"]["kernel"].scale, jax.Array)
+    qp2 = quantize_params(qp, min_size=1024)
+    assert isinstance(qp2["dense"]["kernel"], QuantizedTensor)
+    # dequant of an unquantized tree returns the SAME leaves (no copy)
+    out = dequantize_params(params)
+    assert out["dense"]["kernel"] is params["dense"]["kernel"]
+
+
+def test_int8_crosses_jit_boundary():
+    """QuantizedTensor is a pytree: jit takes it as an argument and the
+    s8 buffer — not a dequantized copy — is the program input."""
+    w = jax.random.normal(jax.random.PRNGKey(2), (256, 256))
+    qt = quantize_array(w)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 256))
+
+    @jax.jit
+    def f(qt, x):
+        return x @ qt.dequantize(jnp.float32)
+
+    text = f.lower(qt, x).compile().as_text()
+    assert "s8[256,256]" in text
+    # XLA fuses the bf16 dequant multiply in f32 (no double rounding)
+    # while eager rounds the product to bf16 first — a ~2^-8 relative
+    # spread is legitimate; the test pins the s8 boundary, not bitwise
+    # numerics.
+    y_jit = np.asarray(f(qt, x))
+    y_ref = np.asarray(x @ qt.dequantize(jnp.float32))
+    assert np.abs(y_jit - y_ref).max() <= 2.0 ** -6 * np.abs(y_ref).max()
+
+
+def test_gpt2_tiny_quantized_forward_close():
+    spec = get_model("gpt2-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    tokens = jnp.asarray(spec.make_batch(2)["inputs"])
+    full = np.asarray(
+        model.apply(variables, tokens), dtype=np.float32)
+    qparams = quantize_params(variables["params"], min_size=1024)
+    deq = {"params": dequantize_params(qparams)}
+    quant = np.asarray(model.apply(deq, tokens), dtype=np.float32)
+    # int8 weight rounding perturbs logits by well under their scale
+    denom = np.abs(full).max()
+    assert np.abs(quant - full).max() / denom < 0.05
+    stored, as_bf16 = quantized_bytes(qparams)
+    assert stored < 0.62 * as_bf16  # ~half, modulo exact fp32 leaves
+
+
+@pytest.mark.parametrize("entry", ["greedy", "beam"])
+def test_generate_with_quantized_params(entry):
+    """The generation stack accepts quantized variables end-to-end
+    (dequant happens inside the scan body via generate._params)."""
+    spec = get_model("gpt2-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    prompt = jnp.asarray(spec.make_batch(2)["inputs"])[:, :8]
+    qvars = {"params": quantize_params(variables["params"],
+                                       min_size=1024)}
+    if entry == "greedy":
+        full = generate.generate(model, variables, prompt,
+                                 max_new_tokens=6)
+        quant = generate.generate(model, qvars, prompt,
+                                  max_new_tokens=6)
+    else:
+        full = generate.generate_beam(model, variables, prompt,
+                                      max_new_tokens=6, num_beams=2)
+        quant = generate.generate_beam(model, qvars, prompt,
+                                       max_new_tokens=6, num_beams=2)
+    assert quant.shape == full.shape
+    # prompts identical; generated tokens may legitimately diverge on
+    # a random-init model, but the first greedy token almost never
+    # flips when logits agree to <5% — check shape + dtype + prefix.
+    np.testing.assert_array_equal(np.asarray(quant[:, :8]),
+                                  np.asarray(prompt))
+    assert quant.dtype == jnp.int32
+
+
+def test_t5_seq2seq_quantized_runs():
+    spec = get_model("t5-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    enc = jnp.asarray(spec.make_batch(2)["inputs"])[:, :8]
+    qvars = {"params": quantize_params(variables["params"],
+                                       min_size=1024)}
+    out = generate.generate_seq2seq(model, qvars, enc, max_new_tokens=5)
+    assert out.shape == (2, 5)
+
+
+def test_dequant_in_scan_body_not_hoisted():
+    """The decode scan's while-loop body must contain the s8->f32
+    convert (dequant at point of use); XLA hoisting it out would
+    materialize full-precision weights and forfeit the bandwidth win.
+    Checked on the CPU backend's optimized HLO."""
+    w = quantize_array(
+        jax.random.normal(jax.random.PRNGKey(4), (128, 128)))
+    x0 = jnp.zeros((4, 128))
+
+    @jax.jit
+    def loop(qt, x0):
+        def body(x, _):
+            return jnp.tanh(x @ qt.dequantize(jnp.float32)), ()
+        y, _ = jax.lax.scan(body, x0, None, length=8)
+        return y
+
+    compiled = loop.lower(w, x0).compile()
+    hlo = compiled.as_text()
+    # the convert appears inside a fusion/computation reachable from
+    # the while body; weakest robust assertion: an s8 parameter exists
+    # AND a convert(s8) op survives into the optimized module.
+    assert "s8[128,128]" in hlo
+    assert "convert" in hlo
