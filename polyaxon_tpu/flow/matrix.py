@@ -277,6 +277,31 @@ class V1Hyperband(BaseSchema):
         return parse_hp_params(v)
 
 
+class V1Asha(BaseSchema):
+    """Asynchronous successive halving (Li et al. 2020) — barrier-free
+    promotions, built for straggler-heavy TPU fleets (preemptions,
+    queue delays).  An ADDITION over the reference's matrix kinds
+    (SURVEY.md 2.11 tops out at hyperband); the synchronous math lives
+    in tune/hyperband.py, the async manager in tune/asha.py."""
+
+    kind: Literal["asha"] = "asha"
+    params: Dict[str, Any]
+    num_runs: int
+    max_iterations: int
+    eta: float = 3
+    min_resource: float = 1
+    resource: V1OptimizationResource
+    metric: V1OptimizationMetric
+    seed: Optional[int] = None
+    concurrency: Optional[int] = None
+    early_stopping: Optional[List[V1EarlyStopping]] = None
+
+    @field_validator("params")
+    @classmethod
+    def _parse(cls, v):
+        return parse_hp_params(v)
+
+
 class V1Bayes(BaseSchema):
     kind: Literal["bayes"] = "bayes"
     params: Dict[str, Any]
@@ -341,14 +366,15 @@ class V1Mapping(BaseSchema):
 
 
 V1Matrix = Union[
-    V1GridSearch, V1RandomSearch, V1Hyperband, V1Bayes, V1Hyperopt,
-    V1Iterative, V1Mapping,
+    V1GridSearch, V1RandomSearch, V1Hyperband, V1Asha, V1Bayes,
+    V1Hyperopt, V1Iterative, V1Mapping,
 ]
 
 MATRIX_BY_KIND = {
     "grid": V1GridSearch,
     "random": V1RandomSearch,
     "hyperband": V1Hyperband,
+    "asha": V1Asha,
     "bayes": V1Bayes,
     "hyperopt": V1Hyperopt,
     "iterative": V1Iterative,

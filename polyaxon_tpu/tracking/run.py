@@ -229,8 +229,32 @@ class Run:
             import jax
 
             env["jax_version"] = jax.__version__
-            env["jax_backend"] = jax.default_backend()
-            env["jax_device_count"] = jax.device_count()
+            # default_backend() FORCES backend init, which can block
+            # indefinitely when another process holds the accelerator
+            # (a sweep's concurrent child runs, a sidecar next to a
+            # training proc).  init() must never hang on telemetry:
+            # probe in a daemon thread with a hard timeout and record
+            # "unavailable" if the backend doesn't answer.
+            import threading
+
+            probed: dict = {}
+
+            def probe():
+                # Guarded: an exception on this daemon thread would
+                # escape to threading.excepthook and spam stderr on
+                # every init (the old inline call degraded silently).
+                try:
+                    probed["backend"] = jax.default_backend()
+                    probed["devices"] = jax.device_count()
+                except Exception:
+                    pass
+
+            t = threading.Thread(target=probe, daemon=True)
+            t.start()
+            t.join(timeout=5.0)
+            env["jax_backend"] = probed.get("backend", "unavailable")
+            if "devices" in probed:
+                env["jax_device_count"] = probed["devices"]
         except Exception:
             pass
         self._writer.add(EventKind.ENV, "env" + self._suffix,

@@ -9,6 +9,7 @@ executor and joins on tracked metrics (SURVEY.md 2.11, call stack 3.3).
 
 from .bayes import BayesManager, GaussianProcess
 from .controller import TuneController, TuneError
+from .asha import AshaJob, ASHAManager
 from .hyperband import HyperbandManager, Rung
 from .space import (
     SpaceError,

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..flow import V1Operation
 from ..flow.matrix import (
+    V1Asha,
     V1Bayes,
     V1FailureEarlyStopping,
     V1GridSearch,
@@ -28,6 +29,7 @@ from ..flow.matrix import (
     V1RandomSearch,
 )
 from ..lifecycle import V1Statuses
+from .asha import ASHAManager
 from .bayes import BayesManager
 from .hyperband import HyperbandManager
 from .space import grid_params, sample_params
@@ -165,6 +167,8 @@ class TuneController:
                 self._run_batch(suggestions, 0)
             elif isinstance(matrix, V1Hyperband):
                 self._run_hyperband(matrix)
+            elif isinstance(matrix, V1Asha):
+                self._run_asha(matrix)
             elif isinstance(matrix, V1Bayes):
                 self._run_bayes(matrix)
             elif isinstance(matrix, V1Hyperopt):
@@ -216,6 +220,59 @@ class TuneController:
                 ]
                 if not population:
                     break
+
+    def _run_asha(self, matrix: V1Asha) -> None:
+        """Barrier-free worker pool: each free worker asks the manager
+        for a promotion or a fresh config the moment it idles; a worker
+        with nothing to do waits on the condition because a straggler's
+        completion can unlock promotions.  Contrast _run_hyperband,
+        whose rungs are batch barriers."""
+        mgr = ASHAManager(matrix)
+        cond = threading.Condition()
+        state = {"inflight": 0, "index": 0}
+
+        def worker():
+            while True:
+                with cond:
+                    if self._stop.is_set():
+                        cond.notify_all()
+                        return
+                    job = mgr.next_job()
+                    while job is None and state["inflight"] > 0 \
+                            and not self._stop.is_set():
+                        cond.wait(timeout=0.5)
+                        job = mgr.next_job()
+                    if job is None or self._stop.is_set():
+                        cond.notify_all()
+                        return
+                    state["inflight"] += 1
+                    idx = state["index"]
+                    state["index"] += 1
+                # finally-guarded: an exception escaping _run_child
+                # (e.g. early-stopping policy math on a bad metric
+                # value) must still decrement inflight, or every other
+                # worker waits on the condition forever.
+                out = None
+                try:
+                    params = {**job.params,
+                              matrix.resource.name: job.resource}
+                    out = self._run_child(idx, params, extra_meta={
+                        "rung": job.rung, "config_id": job.config_id})
+                finally:
+                    with cond:
+                        ok = out is not None and \
+                            out["status"] == V1Statuses.SUCCEEDED
+                        mgr.report(job,
+                                   out.get("metric") if ok else None)
+                        state["inflight"] -= 1
+                        cond.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     def _run_bayes(self, matrix: V1Bayes) -> None:
         mgr = BayesManager(matrix)

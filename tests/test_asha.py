@@ -1,0 +1,201 @@
+"""ASHA (async successive halving) — manager math + controller e2e.
+
+The manager tests drive promotion decisions deterministically with a
+hand-fed completion order (the async property is exactly that order
+sensitivity); the e2e test runs a real sweep through LocalExecutor the
+same way test_tune.py's hyperband test does.
+"""
+
+import sys
+
+import pytest
+
+from polyaxon_tpu.client import FileRunStore
+from polyaxon_tpu.flow.matrix import parse_matrix
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.polyaxonfile import get_op_from_files
+from polyaxon_tpu.runner import LocalExecutor
+from polyaxon_tpu.tune import ASHAManager
+
+
+def make_mgr(num_runs=8, max_iterations=4, eta=2, min_resource=1,
+             optimization="minimize", seed=7):
+    m = parse_matrix({
+        "kind": "asha",
+        "numRuns": num_runs,
+        "maxIterations": max_iterations,
+        "eta": eta,
+        "minResource": min_resource,
+        "resource": {"name": "epochs", "type": "int"},
+        "metric": {"name": "loss", "optimization": optimization},
+        "params": {"lr": {"kind": "uniform", "value": [0.0, 1.0]}},
+        "seed": seed,
+    })
+    return ASHAManager(m)
+
+
+class TestManager:
+    def test_rung_resources(self):
+        mgr = make_mgr(max_iterations=9, eta=3, min_resource=1)
+        assert mgr.max_rung == 2
+        assert [mgr.resource_at(k) for k in range(3)] == [1, 3, 9]
+
+    def test_top_rung_trains_at_R(self):
+        """Rungs anchor downward from R (hyperband convention): the
+        best configs must get the FULL budget even when R is not a
+        power of eta — an upward r0*eta^k ladder would top out at 81
+        of 100."""
+        mgr = make_mgr(max_iterations=100, eta=3, min_resource=1)
+        assert mgr.resource_at(mgr.max_rung) == 100
+        rs = [mgr.resource_at(k) for k in range(mgr.max_rung + 1)]
+        assert rs == sorted(rs) and rs[0] >= 1
+        mgr6 = make_mgr(max_iterations=6, eta=3, min_resource=1)
+        assert mgr6.resource_at(mgr6.max_rung) == 6
+
+    def test_promotes_before_rung_fills(self):
+        """The async property: with eta=2, two completions already
+        yield floor(2/2)=1 promotable — no waiting for the other six
+        rung-0 configs."""
+        mgr = make_mgr(num_runs=8, eta=2)
+        j1 = mgr.next_job()
+        j2 = mgr.next_job()
+        assert j1.rung == j2.rung == 0
+        mgr.report(j1, 0.9)
+        mgr.report(j2, 0.1)
+        j3 = mgr.next_job()
+        assert j3.rung == 1                      # promotion, not a new config
+        assert j3.config_id == j2.config_id      # the better (lower) loss
+        assert j3.params == j2.params
+        # next free worker goes back to sampling rung 0
+        assert mgr.next_job().rung == 0
+
+    def test_no_double_promotion(self):
+        mgr = make_mgr(num_runs=4, eta=2)
+        jobs = [mgr.next_job() for _ in range(2)]
+        mgr.report(jobs[0], 0.5)
+        mgr.report(jobs[1], 0.7)
+        p = mgr.next_job()
+        assert p.rung == 1 and p.config_id == jobs[0].config_id
+        # same standings: the winner is already promoted, the loser is
+        # outside the top floor(2/2)=1 — a new config instead
+        nxt = mgr.next_job()
+        assert nxt.rung == 0
+
+    def test_maximize_direction(self):
+        mgr = make_mgr(num_runs=4, eta=2, optimization="maximize")
+        j1, j2 = mgr.next_job(), mgr.next_job()
+        mgr.report(j1, 0.2)
+        mgr.report(j2, 0.8)
+        assert mgr.next_job().config_id == j2.config_id
+
+    def test_failed_trials_never_promote(self):
+        mgr = make_mgr(num_runs=4, eta=2)
+        j1, j2 = mgr.next_job(), mgr.next_job()
+        mgr.report(j1, None)   # failed child
+        mgr.report(j2, None)
+        nxt = mgr.next_job()
+        assert nxt is None or nxt.rung == 0
+
+    def test_terminates(self):
+        """Drain the whole sweep synchronously: every config sampled
+        once, promotions bounded by the rung geometry, then None."""
+        mgr = make_mgr(num_runs=6, max_iterations=4, eta=2)
+        done = 0
+        while True:
+            job = mgr.next_job()
+            if job is None:
+                break
+            mgr.report(job, float(job.config_id) / 10 + job.rung)
+            done += 1
+            assert done < 50
+        counts = mgr.counts()
+        assert counts[0] == 6
+        # top-rung population is a successive-halving cascade
+        assert counts[mgr.max_rung] <= counts[0] // 2
+        best = mgr.best()
+        assert best is not None and best[1] is not None
+
+    def test_top_rung_never_promotes(self):
+        mgr = make_mgr(num_runs=2, max_iterations=2, eta=2)
+        assert mgr.max_rung == 1
+        j = mgr.next_job()
+        mgr.report(j, 0.1)
+        # one completion: floor(1/eta)=0 — nothing promotable yet, so
+        # the second config is sampled
+        j2 = mgr.next_job()
+        assert j2.rung == 0
+        mgr.report(j2, 0.5)
+        # two completions: top-1 (config 0) promotes to the max rung
+        j3 = mgr.next_job()
+        assert j3.rung == 1 and j3.config_id == j.config_id
+        mgr.report(j3, 0.05)
+        # max rung reached: its completions must never promote further
+        final = mgr.next_job()
+        assert final is None
+
+
+# Same shape as test_tune's child: system metrics OFF so the child
+# never probes the (possibly busy) accelerator.
+CHILD_CODE = """
+import sys
+from polyaxon_tpu import tracking
+lr = float(sys.argv[1])
+tracking.init(collect_system_metrics=False, track_env=False)
+tracking.log_metric("loss", (lr - 0.3) ** 2, step=0)
+tracking.end()
+"""
+
+
+def sweep_spec(matrix):
+    return {
+        "kind": "operation",
+        "name": "asha-sweep",
+        "matrix": matrix,
+        "component": {
+            "kind": "component",
+            "inputs": [
+                {"name": "lr", "type": "float"},
+                {"name": "epochs", "type": "int", "value": 1,
+                 "isOptional": True},
+            ],
+            "run": {
+                "kind": "job",
+                "container": {
+                    "command": [sys.executable, "-c", CHILD_CODE],
+                    "args": ["{{ lr }}"],
+                },
+            },
+        },
+    }
+
+
+@pytest.fixture
+def executor(tmp_home):
+    return LocalExecutor(store=FileRunStore(str(tmp_home)), project="tune")
+
+
+class TestControllerE2E:
+    def test_asha_sweep_e2e(self, executor):
+        record = executor.run_operation(get_op_from_files(sweep_spec({
+            "kind": "asha",
+            "numRuns": 6,
+            "maxIterations": 4,
+            "eta": 2,
+            "resource": {"name": "epochs", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"lr": {"kind": "uniform", "value": [0.0, 1.0]}},
+            "seed": 11,
+            "concurrency": 3,
+        })))
+        assert record["status"] == V1Statuses.SUCCEEDED
+        outputs = record["outputs"]
+        assert outputs["num_trials"] >= 6
+        assert outputs["best_metric"] is not None
+        assert abs(outputs["best_params"]["lr"] - 0.3) < 0.35
+        children = executor.store.list_runs(pipeline=record["uuid"])
+        rungs = {c["meta_info"].get("rung") for c in children}
+        assert 0 in rungs and len(rungs) >= 2  # promotions really ran
+        # promoted trials carry the bigger resource in their params
+        for c in children:
+            if c["meta_info"].get("rung", 0) >= 1:
+                assert c["inputs"]["epochs"] >= 2

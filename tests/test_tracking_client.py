@@ -133,6 +133,40 @@ class TestTracking:
         run.end()
         assert store.get_run(uid)["status"] == V1Statuses.SUCCEEDED
 
+    def test_init_survives_blocked_jax_backend(self, store, monkeypatch):
+        """A run next to a process that HOLDS the accelerator must
+        still init: jax.default_backend() forces backend init and can
+        block indefinitely (seen with concurrent sweep children), so
+        _log_env probes it on a time-bounded daemon thread."""
+        import threading
+        import time
+
+        import jax
+
+        from polyaxon_tpu.tracking import Run
+
+        never = threading.Event()
+
+        def stuck_backend():
+            never.wait(60.0)
+            return "tpu"
+
+        monkeypatch.setattr(jax, "default_backend", stuck_backend)
+        t0 = time.monotonic()
+        run = Run(client=RunClient(store=store), name="envprobe",
+                  collect_system_metrics=False, auto_create=True,
+                  track_env=True)
+        elapsed = time.monotonic() - t0
+        run.flush()
+        try:
+            assert elapsed < 30.0  # bounded by the 5s probe, not 60s
+            events = store.read_events(run.run_uuid, "env", "env")
+            assert events and \
+                events[0]["value"]["jax_backend"] == "unavailable"
+        finally:
+            never.set()
+            run.end()
+
     def test_context_manager_failure(self, store):
         from polyaxon_tpu.tracking import Run
 
