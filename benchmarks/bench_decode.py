@@ -108,6 +108,24 @@ def bench_decode(jax, model_name: str, backend: str):
     int8_s = timed(gen_q, prompt)
     tok_per_sec_int8 = batch * new_toks / int8_s
 
+    # Fully quantized serving: int8 weights AND int8 KV cache
+    # (models/kv_cache.py) — the same params drive a model rebuilt with
+    # kv_cache_int8, halving BOTH bandwidth streams of the decode loop.
+    tok_per_sec_int8_kv = kv_bytes_int8 = None
+    if hasattr(model.cfg, "kv_cache_int8"):
+        kv_model = spec.make_model(kv_cache_int8=True)
+        if seq2seq:
+            kv_bytes_int8 = None  # sized below only for decoder-only
+        else:
+            kv_shapes = jax.eval_shape(
+                lambda: init_cache(kv_model, batch))
+            kv_bytes_int8 = sum(x.size * x.dtype.itemsize
+                                for x in jax.tree.leaves(kv_shapes))
+        gen_qkv = jax.jit(lambda p: gen_fn(kv_model, qvars, p,
+                                           max_new_tokens=new_toks))
+        qkv_s = timed(gen_qkv, prompt)
+        tok_per_sec_int8_kv = batch * new_toks / qkv_s
+
     # TTFT = prefill + first sampled token (max_new_tokens=1).
     ttft = {}
     for L in ttft_lens:
@@ -128,9 +146,14 @@ def bench_decode(jax, model_name: str, backend: str):
         "decode_ms_per_token": round(1000 * total_s / new_toks, 3),
         "tok_per_sec_per_chip_int8": round(tok_per_sec_int8, 1),
         "int8_speedup": round(tok_per_sec_int8 / tok_per_sec, 3),
+        **({"tok_per_sec_per_chip_int8_kv": round(tok_per_sec_int8_kv, 1),
+            "int8_kv_speedup": round(tok_per_sec_int8_kv / tok_per_sec, 3)}
+           if tok_per_sec_int8_kv else {}),
         "weights_mb": round(full_b / 2**20, 1),
         "weights_mb_int8": round(stored_b / 2**20, 1),
         "kv_cache_mb": round(kv_bytes / 2**20, 1),
+        **({"kv_cache_mb_int8": round(kv_bytes_int8 / 2**20, 1)}
+           if kv_bytes_int8 else {}),
         "ttft_ms": {str(k): round(v * 1e3, 1) for k, v in ttft.items()},
         "ttft_ratio": round(ratio, 2),
         "ttft_len_ratio": round(l_big / l_small, 2),

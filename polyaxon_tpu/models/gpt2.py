@@ -51,6 +51,10 @@ class GPT2Config:
     # PP-friendly).  False unrolls a Python loop (per-layer param names,
     # kept for checkpoint/debug compatibility).
     scan_layers: bool = True
+    # Serve-time option: store the decode KV cache as int8 with
+    # per-(token, head) bf16 scales (kv_cache.py) — halves the
+    # KV bytes each decoded token streams from HBM.
+    kv_cache_int8: bool = False
 
     @property
     def intermediate_size(self) -> int:
@@ -92,7 +96,8 @@ class GPT2Block(nn.Module):
             # Single-token KV-cache step (GPT-2 has no RoPE — positions
             # enter via wpe at the embedding).
             k, v, mask, _ = append_kv_cache(self, k, v,
-                                            cfg.max_position)
+                                            cfg.max_position,
+                                            quantize=cfg.kv_cache_int8)
         a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")

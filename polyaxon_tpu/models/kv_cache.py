@@ -10,9 +10,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..ops.quant import symmetric_int8
+
+
+def _quantize_chunk(x):
+    """Per-(token, head) symmetric int8 over the feature axis:
+    [B, S, H, D] -> (int8 [B, S, H, D], scale [B, S, H, 1])."""
+    return symmetric_int8(x, axes=(-1,))
+
 
 def append_kv_cache(mod, k, v, max_position: int, window=None,
-                    rotate=None):
+                    rotate=None, quantize: bool = False):
     """Append this step's k/v ([B, S, H, D]) to ``mod``'s decode cache.
 
     Works for single-token steps AND chunked prefill (S > 1 — the
@@ -27,27 +35,59 @@ def append_kv_cache(mod, k, v, max_position: int, window=None,
     the variables because flax forbids re-declaring them in the same
     apply.)
 
-    Creates ``cached_key``/``cached_value``/``cache_index`` variables in
-    the "cache" collection on ``mod``; returns ``(k_full, v_full,
-    mask, positions)``.
+    ``quantize``: store the cache as int8 with per-(token, head)
+    bf16 scales over the feature axis.  At long context the KV read is
+    the decode bandwidth bottleneck (kv_bytes/token in the decode
+    bench); int8 halves it.  The dequantize on read sits in the decode
+    step so XLA fuses the convert into the attention matmuls — HBM
+    traffic stays int8, consumers still see k.dtype.  Rotated (RoPE)
+    keys quantize AFTER rotation, so the stored rounding is the only
+    error (<= scale/2 per element).
+
+    Creates ``cached_key``/``cached_value``/``cache_index`` (plus
+    ``cached_key_scale``/``cached_value_scale`` when quantized)
+    variables in the "cache" collection on ``mod``; returns
+    ``(k_full, v_full, mask, positions)``.
     """
     b, s, h, d = k.shape
-    ck = mod.variable("cache", "cached_key", jnp.zeros,
-                      (b, max_position, h, d), k.dtype)
-    cv = mod.variable("cache", "cached_value", jnp.zeros,
-                      (b, max_position, h, d), v.dtype)
     idx = mod.variable("cache", "cache_index",
                        lambda: jnp.array(0, jnp.int32))
     pos_q = idx.value + jnp.arange(s)  # absolute positions of new rows
     if rotate is not None:
         k = rotate(pos_q, k)
-    ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+    if quantize:
+        store_dtype, out_dtype = jnp.int8, k.dtype
+        kq, k_scale = _quantize_chunk(k)
+        vq, v_scale = _quantize_chunk(v)
+    else:
+        store_dtype, out_dtype = k.dtype, k.dtype
+        kq, k_scale, vq, v_scale = k, None, v, None
+    ck = mod.variable("cache", "cached_key", jnp.zeros,
+                      (b, max_position, h, d), store_dtype)
+    cv = mod.variable("cache", "cached_value", jnp.zeros,
+                      (b, max_position, h, d), store_dtype)
+    ck.value = jax.lax.dynamic_update_slice(ck.value, kq,
                                             (0, idx.value, 0, 0))
-    cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+    cv.value = jax.lax.dynamic_update_slice(cv.value, vq,
                                             (0, idx.value, 0, 0))
+    if quantize:
+        cks = mod.variable("cache", "cached_key_scale", jnp.zeros,
+                           (b, max_position, h, 1), jnp.bfloat16)
+        cvs = mod.variable("cache", "cached_value_scale", jnp.zeros,
+                           (b, max_position, h, 1), jnp.bfloat16)
+        cks.value = jax.lax.dynamic_update_slice(
+            cks.value, k_scale, (0, idx.value, 0, 0))
+        cvs.value = jax.lax.dynamic_update_slice(
+            cvs.value, v_scale, (0, idx.value, 0, 0))
+        # Unwritten positions hold scale 0 -> dequantize to 0, exactly
+        # like the unquantized zero-init cache (masked off anyway).
+        k_full = ck.value.astype(out_dtype) * cks.value.astype(out_dtype)
+        v_full = cv.value.astype(out_dtype) * cvs.value.astype(out_dtype)
+    else:
+        k_full, v_full = ck.value, cv.value
     idx.value = idx.value + s
     keys = jnp.arange(max_position)
     valid = keys[None, :] <= pos_q[:, None]  # [S, max_position]
     if window is not None:
         valid &= keys[None, :] >= pos_q[:, None] - window
-    return ck.value, cv.value, valid[None, None], pos_q
+    return k_full, v_full, valid[None, None], pos_q

@@ -61,6 +61,10 @@ class LlamaConfig:
     remat: bool = False
     remat_policy: Optional[str] = None
     scan_layers: bool = True
+    # Serve-time option: store the decode KV cache as int8 with
+    # per-(token, head) bf16 scales (kv_cache.py) — halves the
+    # KV bytes each decoded token streams from HBM.
+    kv_cache_int8: bool = False
 
     def __post_init__(self):
         if self.sliding_window is not None and self.sliding_window < 1:
@@ -126,6 +130,7 @@ class LlamaAttention(nn.Module):
             # S == 1 and whole-prompt chunks, window-clipped.
             k, v, mask, pos = append_kv_cache(
                 self, k, v, cfg.max_position, window=cfg.sliding_window,
+                quantize=cfg.kv_cache_int8,
                 rotate=lambda p, kk: apply_rotary(
                     kk, kk, theta=cfg.rope_theta, positions=p)[1])
             q = apply_rotary(q, q, theta=cfg.rope_theta,

@@ -42,6 +42,10 @@ class MoEGPTConfig:
     layer_norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    # Serve-time option: store the decode KV cache as int8 with
+    # per-(token, head) bf16 scales (kv_cache.py) — halves the
+    # KV bytes each decoded token streams from HBM.
+    kv_cache_int8: bool = False
 
     @property
     def intermediate_size(self) -> int:
@@ -187,7 +191,8 @@ class MoEBlock(nn.Module):
             # KV-cache step (single token or chunked prefill); the
             # switch FFN below picks its kernel by chunk size.
             k, v, mask, _ = append_kv_cache(self, k, v,
-                                            cfg.max_position)
+                                            cfg.max_position,
+                                            quantize=cfg.kv_cache_int8)
         a = dot_product_attention(q, k, v, causal=not decode, mask=mask)
         a = a.reshape(h.shape)
         a = constrain(a, BATCH, None, "tp")

@@ -76,6 +76,10 @@ class T5Config:
     remat: bool = False
     remat_policy: Optional[str] = None
     scan_layers: bool = True
+    # Serve-time option: store the decoder's self-attn KV cache as
+    # int8 with per-(token, head) bf16 scales (kv_cache.py); the
+    # prefill-computed cross-attention K/V stay exact.
+    kv_cache_int8: bool = False
 
     def __post_init__(self):
         if self.feed_forward not in ("relu", "gated-gelu"):
@@ -192,7 +196,8 @@ class T5Attention(nn.Module):
             # from the caller computed at the same absolute positions.
             k, v, mask, _ = append_kv_cache(self, heads("k_proj"),
                                             heads("v_proj"),
-                                            cfg.max_position)
+                                            cfg.max_position,
+                                            quantize=cfg.kv_cache_int8)
             causal = False
         else:
             k, v = heads("k_proj"), heads("v_proj")

@@ -88,20 +88,28 @@ def _scale_axes(ndim: int) -> tuple:
     return tuple(range(ndim - 1))
 
 
+def symmetric_int8(x: jax.Array, axes, scale_dtype=jnp.bfloat16):
+    """The shared symmetric-int8 core: amax/127 scales reduced over
+    ``axes`` (keepdims), zero-amax channels get scale 1 (any scale
+    reproduces an all-zero channel; 1 avoids 0/0).  Used by weight
+    quantization here and the int8 KV cache (models/kv_cache.py) —
+    one copy of the rounding policy."""
+    x32 = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(scale_dtype)
+
+
 def quantize_array(w: jax.Array, dtype=jnp.bfloat16) -> QuantizedTensor:
     """Symmetric int8 quantization with per-channel scales.
 
     ``dtype`` is the dtype dequantization produces (and the scale's
     dtype) — bf16 matches the zoo's compute dtype.
     """
-    w32 = jnp.asarray(w, jnp.float32)
-    axes = _scale_axes(w32.ndim)
-    amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
-    # All-zero channels: any scale reproduces them exactly; use 1 to
-    # avoid 0/0.
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return QuantizedTensor(q, scale.astype(dtype))
+    q, scale = symmetric_int8(w, _scale_axes(jnp.ndim(w)),
+                              scale_dtype=dtype)
+    return QuantizedTensor(q, scale)
 
 
 def _is_qt(x) -> bool:

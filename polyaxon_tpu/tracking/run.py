@@ -233,11 +233,17 @@ class Run:
             # indefinitely when another process holds the accelerator
             # (a sweep's concurrent child runs, a sidecar next to a
             # training proc).  init() must never hang on telemetry:
-            # probe in a daemon thread with a hard timeout and record
-            # "unavailable" if the backend doesn't answer.
+            # probe in a daemon thread with a bounded wait.  The bound
+            # must clear a HEALTHY first-in-process TPU init (tens of
+            # seconds on a real slice), so the default is generous and
+            # a probe that finishes late appends a corrected env event
+            # rather than discarding its answer.
             import threading
 
+            timeout = float(os.environ.get(
+                "POLYAXON_TPU_ENV_PROBE_TIMEOUT", "30"))
             probed: dict = {}
+            timed_out = threading.Event()
 
             def probe():
                 # Guarded: an exception on this daemon thread would
@@ -247,11 +253,26 @@ class Run:
                     probed["backend"] = jax.default_backend()
                     probed["devices"] = jax.device_count()
                 except Exception:
-                    pass
+                    return
+                if timed_out.is_set():
+                    # Late but successful: correct the record.
+                    try:
+                        self._writer.add(
+                            EventKind.ENV, "env" + self._suffix,
+                            make_event(EventKind.ENV, value={
+                                **env,
+                                "jax_backend": probed["backend"],
+                                "jax_device_count": probed["devices"],
+                                "late_probe": True,
+                            }))
+                    except Exception:
+                        pass
 
             t = threading.Thread(target=probe, daemon=True)
             t.start()
-            t.join(timeout=5.0)
+            t.join(timeout=timeout)
+            if "backend" not in probed:
+                timed_out.set()
             env["jax_backend"] = probed.get("backend", "unavailable")
             if "devices" in probed:
                 env["jax_device_count"] = probed["devices"]

@@ -74,6 +74,16 @@ class ASHAManager:
         # the user's budget (R=100, eta=3: top rung 81, never 100).
         self.max_rung = int(math.floor(
             math.log(self.R / self.r0) / math.log(self.eta) + 1e-9))
+        if config.resource.cast(
+                self.R * self.eta ** (-self.max_rung)) <= 0:
+            # int resource + fractional min_resource can truncate the
+            # bottom rung to 0 — children would "train" for zero
+            # epochs yet still compete for promotion.
+            raise ValueError(
+                f"min_resource={self.r0} with resource type "
+                f"{config.resource.type!r} yields a rung-0 resource of "
+                f"0 after casting; raise min_resource so the bottom "
+                f"rung trains at >= 1")
         self.num_runs = int(config.num_runs)
         self.rng = np.random.default_rng(config.seed)
         # rung index -> completed entries (in completion order)
@@ -95,8 +105,13 @@ class ASHAManager:
         """Best unpromoted entry inside rung's top floor(n/eta), if
         any.  The top set GROWS as completions arrive — that is the
         asynchrony: early completions promote before the rung 'fills'
-        (there is no notion of full)."""
-        entries = [e for e in self.rungs[rung] if e.metric is not None]
+        (there is no notion of full).  NaN metrics (diverged trials)
+        are excluded like failures: Python's sort leaves NaN wherever
+        it lands (all comparisons False), which would let a diverged
+        config win every promotion."""
+        entries = [e for e in self.rungs[rung]
+                   if e.metric is not None
+                   and not math.isnan(e.metric)]
         k = int(math.floor(len(entries) / self.eta))
         if k <= 0:
             return None
@@ -144,7 +159,7 @@ class ASHAManager:
         top: Optional[_Entry] = None
         for entries in self.rungs.values():
             for e in entries:
-                if e.metric is None:
+                if e.metric is None or math.isnan(e.metric):
                     continue
                 if top is None or self._is_better(e.metric, top.metric):
                     top = e

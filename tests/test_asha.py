@@ -88,6 +88,31 @@ class TestManager:
         mgr.report(j2, 0.8)
         assert mgr.next_job().config_id == j2.config_id
 
+    def test_nan_metrics_never_promote(self):
+        """A diverged trial (NaN loss) must not occupy the top set —
+        Python's sort leaves NaN wherever it lands, which would let it
+        win every promotion."""
+        mgr = make_mgr(num_runs=4, eta=2)
+        j1, j2 = mgr.next_job(), mgr.next_job()
+        mgr.report(j1, float("nan"))
+        mgr.report(j2, 0.4)
+        j3, j4 = mgr.next_job(), mgr.next_job()
+        # with the NaN excluded only ONE valid completion exists:
+        # floor(1/2)=0 promotable — both next jobs sample rung 0
+        assert j3.rung == 0 and j4.rung == 0
+        mgr.report(j3, 0.2)
+        promoted = mgr.next_job()
+        assert promoted.rung == 1
+        assert promoted.config_id == j3.config_id  # best FINITE metric
+        assert mgr.best()[1] == 0.2
+
+    def test_int_resource_fractional_min_refused(self):
+        """int resource + fractional min_resource would truncate the
+        bottom rung to 0 epochs — refused at construction."""
+        with pytest.raises(ValueError, match="rung-0 resource"):
+            make_mgr(num_runs=4, max_iterations=4, eta=2,
+                     min_resource=0.5)
+
     def test_failed_trials_never_promote(self):
         mgr = make_mgr(num_runs=4, eta=2)
         j1, j2 = mgr.next_job(), mgr.next_job()

@@ -152,6 +152,7 @@ class TestTracking:
             return "tpu"
 
         monkeypatch.setattr(jax, "default_backend", stuck_backend)
+        monkeypatch.setenv("POLYAXON_TPU_ENV_PROBE_TIMEOUT", "3")
         t0 = time.monotonic()
         run = Run(client=RunClient(store=store), name="envprobe",
                   collect_system_metrics=False, auto_create=True,
@@ -159,7 +160,7 @@ class TestTracking:
         elapsed = time.monotonic() - t0
         run.flush()
         try:
-            assert elapsed < 30.0  # bounded by the 5s probe, not 60s
+            assert elapsed < 20.0  # bounded by the 3s probe, not 60s
             events = store.read_events(run.run_uuid, "env", "env")
             assert events and \
                 events[0]["value"]["jax_backend"] == "unavailable"
