@@ -59,8 +59,27 @@ def main() -> int:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--probe-budget", type=float, default=300.0)
+    parser.add_argument(
+        "--only", default=None,
+        help="comma list of batch:variant legs to run (e.g. "
+             "'512:bn-bf16,256:s2d-stem') — lets a re-armed sweep "
+             "carry only the still-missing rows after a wedge")
     args = parser.parse_args()
-    return B.run_mfu_sweep("resnet50", sweep_configs(args.quick),
+    cfgs = sweep_configs(args.quick)
+    if args.only:
+        wanted = {tuple(x.strip().split(":", 1))
+                  for x in args.only.split(",")}
+        known = {(str(c[0]), c[1]) for c in cfgs}
+        bad = {":".join(w) for w in wanted if w not in known}
+        if bad:
+            # A typo'd leg silently running an empty sweep would burn
+            # a scarce tunnel window measuring nothing.
+            raise SystemExit(
+                f"--only entries match no sweep config: "
+                f"{sorted(bad)}; known legs: "
+                f"{sorted(':'.join(k) for k in known)}")
+        cfgs = [c for c in cfgs if (str(c[0]), c[1]) in wanted]
+    return B.run_mfu_sweep("resnet50", cfgs,
                            steps=args.steps, warmup=args.warmup,
                            probe_budget=args.probe_budget)
 

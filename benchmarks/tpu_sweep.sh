@@ -1,30 +1,33 @@
 #!/bin/bash
 # TPU sweep run by tunnel_watch.py the moment the tunnel answers.
 #
-# Round-4 state: the full headline set (resnet50 / gpt2-medium /
-# bert-base / tinyllama-1.1b) landed in a ~50-minute window before the
-# tunnel wedged again, so this script now carries only the STILL-
-# MISSING evidence, ordered by value-per-minute (the windows are
-# short; cheap high-value probes first, hang-prone giant compiles
-# last):
-#   1. roofline probe  — measured HBM BW + MXU TFLOP/s -> tightens the
-#                        MFU ceiling analysis in docs/SCALING.md §2b.
-#   2. resnet50 MFU sweep — batch x s2d-stem x bf16-BN x nomom
-#                        (VERDICT r2 task 2; ceilings predicted
-#                        offline, unmeasured).
-#   3. decode/serving rows — tok/sec + KV-bytes + TTFT (no decode row
-#                        has EVER landed on hardware; the gpt2-medium
-#                        generate() compiles hung the last window, so
-#                        this leg sits behind the two above).
-#   4. windowed A/B     — O(W) remap vs no-remap at seq 8k / window 1k.
+# Round-4 state after the second window: headline rows (resnet50 /
+# gpt2-medium / bert-base / tinyllama-1.1b) are DONE, and the resnet50
+# MFU sweep landed 5 of 9 variant rows (b128/256/512 base, b256
+# sgd-nomom, b256 bn-bf16 0.3153) before the 512:bn-bf16 leg overran
+# the sweep timeout and the kill wedged the tunnel.  This script
+# carries only the still-missing evidence, value-per-minute order
+# (short windows: cheap high-value probes first, hang-prone giant
+# compiles last):
+#   1. roofline probe — measured HBM BW + MXU TFLOP/s -> tightens the
+#                       MFU ceiling analysis in docs/SCALING.md §2b.
+#   2. decode/serving rows — tok/sec + KV-bytes + TTFT + the NEW
+#                       int8-weight and int8-KV A/Bs (no decode row
+#                       has EVER landed on hardware).
+#   3. windowed A/B   — O(W) remap vs no-remap at seq 8k / window 1k.
+#   4. resnet50 MFU remainder — the 4 unmeasured variants (512-batch
+#                       bn-bf16/nomom and the s2d stems), the leg that
+#                       overran last window.
 #   5. gpt2-medium MFU sweep — remat x batch (biggest compiles, last).
 set -x
 cd "$(dirname "$0")/.."
 
 timeout 1200 python benchmarks/bench_roofline_probe.py || true
-timeout 3600 python benchmarks/bench_resnet_mfu.py || true
 timeout 2400 python benchmarks/bench_decode.py || true
 timeout 2400 python benchmarks/bench_windowed.py || true
+timeout 3600 python benchmarks/bench_resnet_mfu.py \
+    --only "512:bn-bf16,512:bn-bf16+nomom,256:s2d-stem,512:s2d-stem+bn-bf16" \
+    || true
 timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
 
 echo "SWEEP COMPLETE $(date)"

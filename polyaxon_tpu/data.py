@@ -37,16 +37,26 @@ def _epoch_rng(seed: int, epoch: int) -> np.random.RandomState:
 
 
 class _EpochIterable:
-    """Shared epoch chaining: subclasses define ``epoch(e)``."""
+    """Shared epoch chaining: subclasses define ``epoch(e, start=0)``.
+
+    Every dataset is deterministic in (seed, epoch), which makes the
+    stream CHECKPOINTABLE by position alone: ``epochs(start_step=k)``
+    resumes exactly where an uninterrupted run's k-th batch would be —
+    no iterator state to serialize.  train.py passes the restored step
+    so a preemption-resumed run continues through the data instead of
+    replaying batch 0 (exactly-once over the schedule).
+    """
 
     def __iter__(self):
         return self.epoch(0)
 
-    def epochs(self, n: Optional[int] = None
+    def epochs(self, n: Optional[int] = None, *, start_step: int = 0
                ) -> Iterator[Dict[str, np.ndarray]]:
-        e = 0
+        spe = self.steps_per_epoch
+        e, skip = divmod(int(start_step), spe) if start_step else (0, 0)
         while n is None or e < n:
-            yield from self.epoch(e)
+            yield from self.epoch(e, start=skip)
+            skip = 0
             e += 1
 
 
@@ -84,13 +94,16 @@ class ArrayDataset(_EpochIterable):
         """A shape-defining sample (model init / sharding layout)."""
         return {k: np.asarray(v[:n]) for k, v in self.arrays.items()}
 
-    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def epoch(self, epoch: int = 0, start: int = 0
+              ) -> Iterator[Dict[str, np.ndarray]]:
         order = np.arange(self.n)
         if self.shuffle:
             _epoch_rng(self.seed, epoch).shuffle(order)
         stop = self.n - (self.n % self.batch_size) \
             if self.drop_remainder else self.n
-        for lo in range(0, stop, self.batch_size):
+        # ``start`` skips whole batches without gathering them (resume
+        # through memmapped arrays costs nothing).
+        for lo in range(start * self.batch_size, stop, self.batch_size):
             idx = order[lo:lo + self.batch_size]
             idx.sort()  # monotone gather: fast on memmapped arrays
             yield {k: np.asarray(v[idx]) for k, v in self.arrays.items()}
@@ -192,11 +205,14 @@ class TokenWindowDataset(_EpochIterable):
                                   for i in range(n))])
         return {"inputs": win.astype(np.int32)}
 
-    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def epoch(self, epoch: int = 0, start: int = 0
+              ) -> Iterator[Dict[str, np.ndarray]]:
         rs = _epoch_rng(self.seed, epoch)
         hi = len(self.tokens) - self.seq_len
-        for _ in range(self.steps_per_epoch):
+        for i in range(self.steps_per_epoch):
             offs = np.sort(rs.randint(0, hi + 1, size=self.batch_size))
+            if i < start:
+                continue  # rng consumed, window gather skipped
             batch = np.stack([self.tokens[o:o + self.seq_len]
                               for o in offs])
             yield {"inputs": batch.astype(np.int32)}
@@ -343,11 +359,19 @@ class SpanCorruptionDataset(_EpochIterable):
         return {k: np.concatenate([v] * reps)[:n]
                 for k, v in batch.items()}
 
-    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def epoch(self, epoch: int = 0, start: int = 0
+              ) -> Iterator[Dict[str, np.ndarray]]:
         rs = _epoch_rng(self.seed, epoch)
         hi = len(self.tokens) - self.window_length
         limit = self.vocab_size - self.num_sentinels
-        for _ in range(self.steps_per_epoch):
+        for step_i in range(self.steps_per_epoch):
+            # ONE code path for skipped and emitted batches: the rng
+            # consumption (offset draw + data-dependent segmentation
+            # draws inside _corrupt) is identical by construction, so
+            # a resume skip can never desynchronize the stream even if
+            # the draw pattern changes later.  Skipped batches only
+            # save the pad/stack/yield tail — numpy-only cost.
+            emit = step_i >= start
             offs = np.sort(rs.randint(0, hi + 1,
                                       size=self.batch_size))
             ins, tgts, in_m, tgt_m = [], [], [], []
@@ -361,14 +385,17 @@ class SpanCorruptionDataset(_EpochIterable):
                         f"{self.vocab_size}); re-pack the stream or "
                         f"lower num_sentinels")
                 i, t = self._corrupt(window, rs)
+                if not emit:
+                    continue
                 i, im = self._pad(i, self.inputs_length)
                 t, tm = self._pad(t, self.targets_length)
                 ins.append(i); tgts.append(t)
                 in_m.append(im); tgt_m.append(tm)
-            yield {"inputs": np.stack(ins),
-                   "labels": np.stack(tgts),
-                   "enc_mask": np.stack(in_m),
-                   "target_mask": np.stack(tgt_m)}
+            if emit:
+                yield {"inputs": np.stack(ins),
+                       "labels": np.stack(tgts),
+                       "enc_mask": np.stack(in_m),
+                       "target_mask": np.stack(tgt_m)}
 
 
 def token_dataset(path: str, batch_size: int, seq_len: int, *,

@@ -449,7 +449,12 @@ def _main(argv=None) -> int:
     total_steps = args.steps or args.epochs * steps_per_epoch
     from .data import prefetch_to_device
 
-    batches = train_ds.epochs(None)  # endless, reshuffled per epoch
+    # Endless reshuffled-per-epoch stream, RESUMED at the restored
+    # step: the datasets are deterministic in (seed, epoch), so a
+    # preemption-resumed run continues through the schedule exactly
+    # where the crashed run stopped instead of replaying batch 0
+    # (data._EpochIterable.epochs).
+    batches = train_ds.epochs(None, start_step=start_step)
     if args.prefetch:
         batches = prefetch_to_device(batches, step_fn.batch_sharding,
                                      depth=args.prefetch)

@@ -301,3 +301,54 @@ class TestSpanCorruption:
         batch = next(iter(ds))
         # Auto-sizing leaves at most a few pad positions.
         assert batch["enc_mask"].sum(axis=1).min() >= 250
+
+
+class TestResumeSkip:
+    """epochs(start_step=k) must equal dropping the first k batches of
+    the uninterrupted stream — the exactly-once resume contract
+    train.py relies on after a preemption restore."""
+
+    def _assert_resumes(self, ds, k, m=3):
+        import itertools
+        expect = list(itertools.islice(ds.epochs(None), k, k + m))
+        got = list(itertools.islice(ds.epochs(None, start_step=k), m))
+        assert len(expect) == len(got) == m
+        for a, b in zip(expect, got):
+            assert sorted(a) == sorted(b)
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+
+    def test_array_dataset_mid_epoch_and_across(self):
+        from polyaxon_tpu.data import ArrayDataset
+        ds = ArrayDataset({"inputs": np.arange(40 * 3).reshape(40, 3)},
+                          batch_size=4, seed=7)
+        spe = ds.steps_per_epoch
+        self._assert_resumes(ds, 3)            # mid-epoch
+        self._assert_resumes(ds, spe)          # exactly one epoch
+        self._assert_resumes(ds, spe * 2 + 5)  # deep into epoch 2
+
+    def test_token_window_dataset(self):
+        from polyaxon_tpu.data import TokenWindowDataset
+        ds = TokenWindowDataset(np.arange(2000) % 97, batch_size=4,
+                                seq_len=16, seed=3)
+        self._assert_resumes(ds, 5)
+        self._assert_resumes(ds, ds.steps_per_epoch + 2)
+
+    def test_span_corruption_dataset(self):
+        from polyaxon_tpu.data import SpanCorruptionDataset
+        tokens = (np.arange(6000) % 300 + 2).astype(np.int32)
+        ds = SpanCorruptionDataset(tokens, batch_size=2,
+                                   inputs_length=64, targets_length=32,
+                                   vocab_size=512, seed=5)
+        self._assert_resumes(ds, 2)
+        self._assert_resumes(ds, ds.steps_per_epoch + 1)
+
+    def test_start_step_zero_is_identity(self):
+        from polyaxon_tpu.data import ArrayDataset
+        import itertools
+        ds = ArrayDataset({"x": np.arange(24).reshape(12, 2)},
+                          batch_size=4, seed=1)
+        a = list(itertools.islice(ds.epochs(None), 4))
+        b = list(itertools.islice(ds.epochs(None, start_step=0), 4))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x["x"], y["x"])
