@@ -451,13 +451,47 @@ def run_mfu_sweep(model_name: str, configs, *, steps: int = 20,
     results_path = os.path.join(here, "benchmarks", "results.jsonl")
     baseline_path = os.path.join(here, ".bench_baseline.json")
 
+    def _rank_key(mfu, per_sec):
+        # ONE ranking for best-point selection and the commit guard:
+        # MFU first when known, throughput as tiebreak.  Guarding the
+        # commit on raw throughput while ranking by MFU would let an
+        # early high-throughput/low-MFU leg permanently block the
+        # MFU-best config from being banked.
+        return (mfu is not None, mfu or 0.0, per_sec or 0.0)
+
+    def _commit_baseline(path, model, r, overrides, opt_name):
+        try:
+            with open(path) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError):
+            baseline = {}
+        prev, prev_cfg = baseline_entry(baseline, model, "tpu")
+        prev_key = _rank_key((prev_cfg or {}).get("mfu"), prev)
+        if _rank_key(r["mfu"], r["per_sec_per_chip"]) > prev_key:
+            baseline[f"{model}:tpu"] = {
+                "value": r["per_sec_per_chip"],
+                "mfu": r["mfu"],
+                "batch": r["batch"],
+                "variant": r.get("variant"),
+                "overrides": overrides,
+                "optimizer": opt_name,
+            }
+            # Atomic replace: these commits happen mid-sweep, exactly
+            # where the leg-timeout SIGKILL lands — an in-place write
+            # killed mid-json.dump would truncate the file and wipe
+            # every model's baseline.
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(baseline, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+
     jax, backend, fallback = init_backend(False,
                                           probe_budget=probe_budget)
     if backend != "tpu":
         print(json.dumps({"bench": tag, "skipped": f"backend={backend}"}))
         return 0
 
-    best = best_cfg = best_key = None
+    best = best_key = None
     for batch, variant, overrides, opt_name in configs:
         t0 = time.time()
         try:
@@ -481,30 +515,20 @@ def run_mfu_sweep(model_name: str, configs, *, steps: int = 20,
             # Rank by MFU when the chip's peak is known, else by raw
             # throughput (mfu=None on unrecognized device kinds must
             # not make the FIRST point win every 0>0 tie).
-            key = (r["mfu"] is not None, r["mfu"] or 0.0,
-                   r["per_sec_per_chip"])
+            key = _rank_key(r["mfu"], r["per_sec_per_chip"])
             if best is None or key > best_key:
-                best, best_cfg, best_key = r, (overrides, opt_name), key
+                best, best_key = r, key
+                # Bank the winning config IMMEDIATELY, not after the
+                # loop: sweeps get SIGKILLed at the leg timeout and an
+                # end-of-sweep commit loses every point already
+                # measured (this round's bn-bf16 row beat the baseline
+                # by 26% and was dropped exactly this way).
+                _commit_baseline(baseline_path, model_name, r,
+                                 overrides, opt_name)
         with open(results_path, "a") as f:  # per-point: tunnel may die
             f.write(json.dumps(row) + "\n")
 
     if best:
-        try:
-            with open(baseline_path) as f:
-                baseline = json.load(f)
-        except (OSError, ValueError):
-            baseline = {}
-        prev, _ = baseline_entry(baseline, model_name, "tpu")
-        if best["per_sec_per_chip"] > (prev or 0):
-            baseline[f"{model_name}:tpu"] = {
-                "value": best["per_sec_per_chip"],
-                "batch": best["batch"],
-                "variant": best.get("variant"),
-                "overrides": best_cfg[0],
-                "optimizer": best_cfg[1],
-            }
-            with open(baseline_path, "w") as f:
-                json.dump(baseline, f, indent=1, sort_keys=True)
         print(json.dumps({"bench": tag, "best_mfu": best["mfu"],
                           "best_batch": best["batch"],
                           "best_variant": best.get("variant"),
