@@ -178,12 +178,7 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     # Chunked prefill: ONE forward over the whole prompt fills the KV
     # cache (the causal-append mask handles S > 1), instead of p_len
     # sequential decode steps.
-    cache = init_cache(model, b)
-    out, mut = model.apply(
-        {"params": _params(variables), "cache": cache},
-        prompt, decode=True, decode_position=0, last_only=True,
-        mutable=["cache"])
-    cache = mut["cache"]
+    first_logits, cache = _prefill(model, variables, prompt)
 
     def apply_step(cache, tok, t):
         out, mut = model.apply(
@@ -192,7 +187,7 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
             mutable=["cache"])
         return extract_logits(out)[:, -1], mut["cache"]
 
-    new = _decode_loop(apply_step, cache, extract_logits(out)[:, -1],
+    new = _decode_loop(apply_step, cache, first_logits,
                        max_new_tokens=max_new_tokens, rng=rng,
                        temperature=temperature, top_k=top_k,
                        top_p=top_p, eos_id=eos_id)
@@ -260,6 +255,144 @@ def generate_seq2seq(model, variables, enc_tokens, *,
         eos_id=eos_id)
 
 
+def _prefill(model, variables, prompt):
+    """Chunked prefill shared by generate / generate_beam /
+    generate_speculative: one forward over the whole prompt fills the
+    cache; returns (last-position logits [B, V], cache)."""
+    cache = init_cache(model, prompt.shape[0])
+    out, mut = model.apply(
+        {"params": _params(variables), "cache": cache},
+        prompt, decode=True, decode_position=0, last_only=True,
+        mutable=["cache"])
+    return extract_logits(out)[:, -1], mut["cache"]
+
+
+def _rollback_cache(cache, new_index):
+    """Rewind a decode cache to ``new_index`` consumed tokens.
+
+    Stale entries past the index are invisible (the causal-append mask
+    admits only positions <= the query's) and get overwritten by the
+    next append, so rollback is just resetting every ``cache_index``
+    leaf — no data movement."""
+    def one(path, leaf):
+        if jax.tree_util.keystr(path).endswith("cache_index']"):
+            return jnp.full_like(leaf, new_index)
+        return leaf
+    return jax.tree_util.tree_map_with_path(one, cache)
+
+
+def generate_speculative(model, variables, draft_model, draft_variables,
+                         prompt, *, max_new_tokens: int, k: int = 4,
+                         eos_id: Optional[int] = None) -> jax.Array:
+    """Greedy speculative decoding: a small DRAFT model proposes ``k``
+    tokens per round; the target verifies all of them in ONE chunked
+    forward (k+1 positions through the causal-append mask) and commits
+    the longest matching prefix plus its own correction.
+
+    The output is EXACTLY ``generate(model, ...)``'s greedy output —
+    speculation changes the schedule, never the tokens (the test pins
+    this equality).  Each round costs one draft scan (k small steps)
+    plus one target forward of k+1 positions; at acceptance rate a the
+    target runs ~(a*k+1)x fewer serial steps, which is the whole win on
+    TPU where decode is latency-bound on weight reads per step.
+
+    Per round the batch advances in LOCKSTEP by the minimum acceptance
+    across rows (per-row cache indices would desynchronize the shared
+    cache_index); rows that verified further simply re-derive those
+    tokens next round — wasted work, never wrong tokens.  Commits are
+    capped at k per round (the all-accepted bonus token is dropped) so
+    the cache rollback arithmetic is uniform.
+
+    Both models must be decoder-only with the same vocab; ``eos_id``
+    freezing is applied to the finished rows after the loop (identical
+    semantics to generate()'s in-loop freeze for greedy decoding).
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1; got "
+                         f"{max_new_tokens}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1; got {k}")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    for m, nm in ((model, "target"), (draft_model, "draft")):
+        max_pos = getattr(getattr(m, "cfg", None), "max_position", None)
+        # The final round (entered at count <= max_new_tokens - 1,
+        # i.e. consumed <= p_len + max_new_tokens - 2) appends k+1
+        # entries, touching position p_len + max_new_tokens + k - 2 at
+        # most — capacity needed is one more than that.
+        if max_pos is not None and \
+                p_len + max_new_tokens + k - 1 > max_pos:
+            raise ValueError(
+                f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+                f"+ k ({k}) - 1 exceeds the {nm} model's max_position "
+                f"({max_pos}); speculative rounds need k-1 slack slots")
+
+    t_logits, t_cache = _prefill(model, variables, prompt)
+    _, d_cache = _prefill(draft_model, draft_variables, prompt)
+    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # [B]
+
+    buf = jnp.zeros((b, max_new_tokens + k), jnp.int32)
+    buf = buf.at[:, 0].set(first)
+
+    def draft_step(carry, _):
+        cache, tok, pos = carry
+        out, mut = draft_model.apply(
+            {"params": _params(draft_variables), "cache": cache},
+            tok[:, None], decode=True, decode_position=pos,
+            mutable=["cache"])
+        nxt = jnp.argmax(extract_logits(out)[:, -1],
+                         axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt, pos + 1), nxt
+
+    def round_body(state):
+        t_cache, d_cache, x, buf, count = state
+        consumed = p_len + count - 1      # tokens both caches hold
+
+        # Draft proposes d_1..d_k (feeds x, d_1..d_{k-1}).
+        (d_cache, _, _), d_toks = jax.lax.scan(
+            draft_step, (d_cache, x, consumed), None, length=k)
+        d_toks = d_toks.T                 # [B, k]
+
+        # Target verifies the whole chunk in one forward.
+        chunk = jnp.concatenate([x[:, None], d_toks], axis=1)
+        out, mut = model.apply(
+            {"params": _params(variables), "cache": t_cache},
+            chunk, decode=True, decode_position=consumed,
+            mutable=["cache"])
+        t_toks = jnp.argmax(extract_logits(out),
+                            axis=-1).astype(jnp.int32)  # [B, k+1]
+
+        # Leading-match count per row, lockstep min across the batch;
+        # commit c = min(m)+1 target tokens, capped at k.
+        matches = d_toks == t_toks[:, :k]               # [B, k]
+        m_row = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+        c = jnp.minimum(jnp.min(m_row) + 1, k)          # scalar, >= 1
+
+        # Write a static k-wide window at count; only c of it counts —
+        # the next round's window overwrites the rest.
+        buf = jax.lax.dynamic_update_slice(
+            buf, t_toks[:, :k], (0, count))
+        x = jnp.take(t_toks, c - 1, axis=1)       # column c-1, [B]
+        new_consumed = consumed + c
+        t_cache = _rollback_cache(mut["cache"], new_consumed)
+        d_cache = _rollback_cache(d_cache, new_consumed)
+        return t_cache, d_cache, x, buf, count + c
+
+    def cond(state):
+        return state[4] < max_new_tokens
+
+    state = (t_cache, d_cache, first, buf, jnp.array(1, jnp.int32))
+    *_, buf, _ = jax.lax.while_loop(cond, round_body, state)
+    new = buf[:, :max_new_tokens]
+
+    if eos_id is not None:
+        # Freeze rows after their first eos (generate()'s semantics).
+        hit = jnp.cumsum(
+            jnp.cumsum(new == eos_id, axis=1), axis=1) > 1
+        new = jnp.where(hit, eos_id, new)
+    return jnp.concatenate([prompt, new], axis=1)
+
+
 def generate_beam(model, variables, prompt, *, max_new_tokens: int,
                   num_beams: int = 4, eos_id: Optional[int] = None,
                   length_penalty: float = 1.0) -> jax.Array:
@@ -298,11 +431,7 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
             f"exceeds the model's max_position ({max_pos})")
 
     # Prefill once on [B, P]; _beam_loop tiles the cache per beam.
-    cache = init_cache(model, b)
-    out, mut = model.apply(
-        {"params": _params(variables), "cache": cache},
-        prompt, decode=True, decode_position=0, last_only=True,
-        mutable=["cache"])
+    first_logits, cache = _prefill(model, variables, prompt)
 
     def apply_step(cache, toks_flat, t):
         out, mut = model.apply(
@@ -311,8 +440,7 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
             mutable=["cache"])
         return extract_logits(out)[:, -1], mut["cache"]
 
-    seq = _beam_loop(apply_step, mut["cache"],
-                     extract_logits(out)[:, -1], b=b,
+    seq = _beam_loop(apply_step, cache, first_logits, b=b,
                      max_new_tokens=max_new_tokens, num_beams=k,
                      eos_id=eos_id, length_penalty=length_penalty)
     return jnp.concatenate([prompt, seq], axis=1)
