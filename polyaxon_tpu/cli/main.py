@@ -125,6 +125,173 @@ def run(files, params, presets, name, project, watch, eager, check_only,
 
 
 # ---------------------------------------------------------------------------
+# generate (serving)
+# ---------------------------------------------------------------------------
+
+
+def _parse_prompt(prompt: str):
+    """``"1,2,3"`` -> one row; ``@file.json`` -> list of rows (all the
+    same length — ragged prompts must be padded upstream)."""
+    import json as _json
+
+    if prompt.startswith("@"):
+        with open(prompt[1:]) as f:
+            rows = _json.load(f)
+        if not rows or not isinstance(rows[0], list):
+            rows = [rows]
+    else:
+        rows = [[t for t in prompt.split(",") if t.strip()]]
+    try:
+        rows = [[int(t) for t in r] for r in rows]
+    except (TypeError, ValueError) as e:
+        raise click.ClickException(
+            f"prompt rows must contain integer token ids: {e}")
+    if not rows or not rows[0]:
+        raise click.ClickException("prompt must contain at least one "
+                                   "token id")
+    if len({len(r) for r in rows}) != 1:
+        raise click.ClickException(
+            "All prompt rows must share one length (pad upstream)")
+    return rows
+
+
+@cli.command()
+@click.option("--model", "model_name", required=True,
+              help="Zoo model name (see models/registry.py).")
+@click.option("--prompt", required=True,
+              help="Comma-separated token ids, or @file.json with a "
+                   "list of rows.")
+@click.option("--max-new-tokens", default=32, type=int)
+@click.option("--temperature", default=0.0, type=float,
+              help="0 = greedy.")
+@click.option("--top-k", default=None, type=int)
+@click.option("--top-p", default=None, type=float,
+              help="Nucleus sampling mass.")
+@click.option("--beams", default=1, type=int,
+              help=">1 switches to beam search (greedy scoring).")
+@click.option("--eos-id", default=None, type=int)
+@click.option("--checkpoint", default=None, type=click.Path(),
+              help="Orbax checkpoint dir from `ptpu train` "
+                   "(--checkpoint-every); default: random init.")
+@click.option("--draft-model", default=None,
+              help="Zoo model for greedy SPECULATIVE decoding "
+                   "(same vocab; output identical to the target's "
+                   "greedy decode).")
+@click.option("--draft-checkpoint", default=None, type=click.Path())
+@click.option("--spec-k", default=4, type=int,
+              help="Draft proposals per speculative round.")
+@click.option("--int8-weights", is_flag=True, default=False,
+              help="Weight-only int8 (halves weight HBM reads).")
+@click.option("--int8-kv", is_flag=True, default=False,
+              help="int8 KV cache (halves KV HBM reads).")
+@click.option("--seed", default=0, type=int)
+@click.option("--cpu", is_flag=True, default=False)
+def generate(model_name, prompt, max_new_tokens, temperature, top_k,
+             top_p, beams, eos_id, checkpoint, draft_model,
+             draft_checkpoint, spec_k, int8_weights, int8_kv, seed,
+             cpu):
+    """Decode with a zoo model — the native serving surface.
+
+    The reference serves models as opaque user containers behind
+    `V1Service`; here the framework owns the decode loop (compile-once
+    scan, chunked prefill, KV cache), so sampling, beam search,
+    speculative decoding and int8 serving are first-class flags.
+    Emits one JSON object: tokens plus timing.
+    """
+    import json as _json
+    import time as _time
+
+    import jax
+
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from polyaxon_tpu.models import generate as G
+    from polyaxon_tpu.models.registry import get_model
+
+    rows = _parse_prompt(prompt)
+    b = len(rows)
+
+    def build(name, ckpt_dir, kv_int8):
+        spec = get_model(name)
+        kw = {"kv_cache_int8": True} if kv_int8 else {}
+        try:
+            if ckpt_dir:
+                # Restoring replaces the params — don't pay a full
+                # random init just to discard it.
+                model = spec.make_model(**kw)
+                variables = None
+            else:
+                model, variables = spec.init_params(batch_size=b, **kw)
+        except TypeError:
+            # mlp/convnet-style models take no such config field.
+            raise click.ClickException(
+                f"{name} has no int8 KV cache support")
+        if ckpt_dir:
+            from polyaxon_tpu.checkpoint import CheckpointManager
+
+            state = CheckpointManager(directory=ckpt_dir).restore()
+            if "params" not in state:
+                raise click.ClickException(
+                    f"checkpoint under {ckpt_dir} has no 'params'")
+            restored = state["params"]
+            # Train state stores the full flax variables dict under
+            # "params" (TrainStep.init_state) — don't re-wrap it.
+            variables = restored if isinstance(restored, dict) \
+                and "params" in restored else {"params": restored}
+        if int8_weights:
+            from polyaxon_tpu.ops.quant import quantize_params
+
+            variables = {"params": quantize_params(variables["params"])}
+        return model, variables
+
+    model, variables = build(model_name, checkpoint, int8_kv)
+    import numpy as np
+
+    toks = np.asarray(rows, dtype=np.int32)
+    t0 = _time.perf_counter()
+    if draft_model is not None:
+        if beams > 1 or temperature != 0.0 or top_k is not None \
+                or top_p is not None:
+            raise click.ClickException(
+                "speculative decoding is greedy-only (no --beams, "
+                "--temperature, --top-k or --top-p)")
+        draft, draft_vars = build(draft_model, draft_checkpoint,
+                                  int8_kv)
+        out = G.generate_speculative(
+            model, variables, draft, draft_vars, toks,
+            max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id)
+    elif beams > 1:
+        if temperature != 0.0 or top_k is not None or top_p is not None:
+            raise click.ClickException(
+                "beam search is deterministic (no --temperature, "
+                "--top-k or --top-p)")
+        out = G.generate_beam(model, variables, toks,
+                              max_new_tokens=max_new_tokens,
+                              num_beams=beams, eos_id=eos_id)
+    else:
+        out = G.generate(model, variables, toks,
+                         max_new_tokens=max_new_tokens,
+                         temperature=temperature, top_k=top_k,
+                         top_p=top_p, eos_id=eos_id,
+                         rng=jax.random.PRNGKey(seed))
+    out = np.asarray(jax.device_get(out))
+    dt = _time.perf_counter() - t0
+    p_len = toks.shape[1]
+    click.echo(_json.dumps({
+        "model": model_name,
+        "tokens": out.tolist(),
+        "new_tokens": out[:, p_len:].tolist(),
+        "wall_s": round(dt, 3),
+        "tok_per_sec": round(b * max_new_tokens / dt, 1),
+        "backend": jax.default_backend(),
+        **({"draft_model": draft_model, "spec_k": spec_k}
+           if draft_model else {}),
+        **({"int8_weights": True} if int8_weights else {}),
+        **({"int8_kv": True} if int8_kv else {}),
+    }))
+
+
+# ---------------------------------------------------------------------------
 # ops
 # ---------------------------------------------------------------------------
 
