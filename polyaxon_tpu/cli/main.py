@@ -135,8 +135,16 @@ def _parse_prompt(prompt: str):
     import json as _json
 
     if prompt.startswith("@"):
-        with open(prompt[1:]) as f:
-            rows = _json.load(f)
+        try:
+            with open(prompt[1:]) as f:
+                rows = _json.load(f)
+        except (OSError, ValueError) as e:
+            raise click.ClickException(
+                f"cannot read prompt file {prompt[1:]!r}: {e}")
+        if not isinstance(rows, list):
+            raise click.ClickException(
+                "prompt file must hold a JSON list of token ids or a "
+                "list of rows")
         if not rows or not isinstance(rows[0], list):
             rows = [rows]
     else:
@@ -153,6 +161,48 @@ def _parse_prompt(prompt: str):
         raise click.ClickException(
             "All prompt rows must share one length (pad upstream)")
     return rows
+
+
+def _build_serving_model(name: str, batch_size: int,
+                         ckpt_dir, kv_int8: bool, int8_weights: bool):
+    """Shared by ``generate`` and ``serve``: zoo model + variables
+    with the serving options applied (int8 KV config, checkpoint
+    restore, weight quantization)."""
+    from polyaxon_tpu.models.registry import get_model
+
+    spec = get_model(name)
+    kw = {"kv_cache_int8": True} if kv_int8 else {}
+    try:
+        if ckpt_dir:
+            # Restoring replaces the params — don't pay a full random
+            # init just to discard it.
+            model = spec.make_model(**kw)
+            variables = None
+        else:
+            model, variables = spec.init_params(
+                batch_size=batch_size, **kw)
+    except TypeError:
+        # mlp/convnet-style models take no such config field.
+        raise click.ClickException(
+            f"{name} has no int8 KV cache support")
+    if ckpt_dir:
+        from polyaxon_tpu.checkpoint import CheckpointManager
+
+        state = CheckpointManager(directory=ckpt_dir).restore()
+        restored = state.get("params") if isinstance(state, dict) \
+            else None
+        if restored is None:
+            raise click.ClickException(
+                f"checkpoint under {ckpt_dir} has no 'params'")
+        # Train state stores the full flax variables dict under
+        # "params" (TrainStep.init_state) — don't re-wrap it.
+        variables = restored if isinstance(restored, dict) \
+            and "params" in restored else {"params": restored}
+    if int8_weights:
+        from polyaxon_tpu.ops.quant import quantize_params
+
+        variables = {"params": quantize_params(variables["params"])}
+    return model, variables
 
 
 @cli.command()
@@ -211,69 +261,44 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
     rows = _parse_prompt(prompt)
     b = len(rows)
 
-    def build(name, ckpt_dir, kv_int8):
-        spec = get_model(name)
-        kw = {"kv_cache_int8": True} if kv_int8 else {}
-        try:
-            if ckpt_dir:
-                # Restoring replaces the params — don't pay a full
-                # random init just to discard it.
-                model = spec.make_model(**kw)
-                variables = None
-            else:
-                model, variables = spec.init_params(batch_size=b, **kw)
-        except TypeError:
-            # mlp/convnet-style models take no such config field.
-            raise click.ClickException(
-                f"{name} has no int8 KV cache support")
-        if ckpt_dir:
-            from polyaxon_tpu.checkpoint import CheckpointManager
-
-            state = CheckpointManager(directory=ckpt_dir).restore()
-            if "params" not in state:
-                raise click.ClickException(
-                    f"checkpoint under {ckpt_dir} has no 'params'")
-            restored = state["params"]
-            # Train state stores the full flax variables dict under
-            # "params" (TrainStep.init_state) — don't re-wrap it.
-            variables = restored if isinstance(restored, dict) \
-                and "params" in restored else {"params": restored}
-        if int8_weights:
-            from polyaxon_tpu.ops.quant import quantize_params
-
-            variables = {"params": quantize_params(variables["params"])}
-        return model, variables
-
-    model, variables = build(model_name, checkpoint, int8_kv)
+    model, variables = _build_serving_model(
+        model_name, b, checkpoint, int8_kv, int8_weights)
     import numpy as np
 
     toks = np.asarray(rows, dtype=np.int32)
     t0 = _time.perf_counter()
-    if draft_model is not None:
-        if beams > 1 or temperature != 0.0 or top_k is not None \
-                or top_p is not None:
-            raise click.ClickException(
-                "speculative decoding is greedy-only (no --beams, "
-                "--temperature, --top-k or --top-p)")
-        draft, draft_vars = build(draft_model, draft_checkpoint,
-                                  int8_kv)
-        out = G.generate_speculative(
-            model, variables, draft, draft_vars, toks,
-            max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id)
-    elif beams > 1:
-        if temperature != 0.0 or top_k is not None or top_p is not None:
-            raise click.ClickException(
-                "beam search is deterministic (no --temperature, "
-                "--top-k or --top-p)")
-        out = G.generate_beam(model, variables, toks,
-                              max_new_tokens=max_new_tokens,
-                              num_beams=beams, eos_id=eos_id)
-    else:
-        out = G.generate(model, variables, toks,
-                         max_new_tokens=max_new_tokens,
-                         temperature=temperature, top_k=top_k,
-                         top_p=top_p, eos_id=eos_id,
-                         rng=jax.random.PRNGKey(seed))
+    try:
+        if draft_model is not None:
+            if beams > 1 or temperature != 0.0 or top_k is not None \
+                    or top_p is not None:
+                raise click.ClickException(
+                    "speculative decoding is greedy-only (no --beams, "
+                    "--temperature, --top-k or --top-p)")
+            draft, draft_vars = _build_serving_model(
+                draft_model, b, draft_checkpoint, int8_kv,
+                int8_weights)
+            out = G.generate_speculative(
+                model, variables, draft, draft_vars, toks,
+                max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id)
+        elif beams > 1:
+            if temperature != 0.0 or top_k is not None \
+                    or top_p is not None:
+                raise click.ClickException(
+                    "beam search is deterministic (no --temperature, "
+                    "--top-k or --top-p)")
+            out = G.generate_beam(model, variables, toks,
+                                  max_new_tokens=max_new_tokens,
+                                  num_beams=beams, eos_id=eos_id)
+        else:
+            out = G.generate(model, variables, toks,
+                             max_new_tokens=max_new_tokens,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, eos_id=eos_id,
+                             rng=jax.random.PRNGKey(seed))
+    except ValueError as e:
+        # Library-level validation (max_position overflow, top_p
+        # range, ...) — surface as a clean CLI error, not a traceback.
+        raise click.ClickException(str(e))
     out = np.asarray(jax.device_get(out))
     dt = _time.perf_counter() - t0
     p_len = toks.shape[1]
@@ -289,6 +314,45 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
         **({"int8_weights": True} if int8_weights else {}),
         **({"int8_kv": True} if int8_kv else {}),
     }))
+
+
+@cli.command()
+@click.option("--model", "model_name", required=True)
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8000, type=int)
+@click.option("--checkpoint", default=None, type=click.Path())
+@click.option("--int8-weights", is_flag=True, default=False)
+@click.option("--int8-kv", is_flag=True, default=False)
+@click.option("--max-batch", default=8, type=int)
+@click.option("--cpu", is_flag=True, default=False)
+def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
+          max_batch, cpu):
+    """Serve a zoo model over HTTP (/healthz, /info, /generate).
+
+    The reference's `V1Service` schedules an opaque serving container;
+    here the framework ships the model server itself (stdlib HTTP, jit
+    compile cache, int8 serving flags — see serving.py).
+    """
+    import jax
+
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from polyaxon_tpu.serving import ModelServer, make_server
+
+    model, variables = _build_serving_model(
+        model_name, 1, checkpoint, int8_kv, int8_weights)
+    ms = ModelServer(model, variables, model_name=model_name,
+                     max_batch=max_batch,
+                     info={**({"int8_weights": True}
+                              if int8_weights else {}),
+                           **({"int8_kv": True} if int8_kv else {})})
+    srv = make_server(host, port, ms)
+    click.echo(f"serving {model_name} on http://{host}:"
+               f"{srv.server_address[1]}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.shutdown()
 
 
 # ---------------------------------------------------------------------------

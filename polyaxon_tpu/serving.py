@@ -1,0 +1,212 @@
+"""Native model server: the zoo's decode stack behind HTTP.
+
+The reference's serving story is `V1Service` — it schedules an opaque
+user container and port-forwards to it (SURVEY.md §2.4); the model
+server inside is the user's problem.  Here the framework owns the
+decode loop, so it ships the server too: one process, stdlib HTTP
+(same no-dependency stance as the control plane), jit-compiled
+generate with a shape-bucketed compile cache.
+
+Endpoints:
+
+- ``GET  /healthz``  -> ``{"status": "ok", ...}`` (readiness; also the
+  operator's gang-health convention)
+- ``GET  /info``     -> model name, config summary, quantization flags
+- ``POST /generate`` -> ``{"prompt": [ids] | [[ids], ...],
+  "max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p,
+  "eos_id": e, "num_beams": B}`` -> tokens + timing
+
+Shape discipline: each distinct (batch, prompt_len, max_new_tokens,
+decode-mode) compiles once and is cached.  Prompts are NOT padded:
+the zoo's decode path has no attention-mask input, so left-padding
+would let real tokens attend to pad positions (silently wrong
+output).  Clients with ragged traffic should bucket prompt lengths
+themselves; every row in one request must share a length.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ModelServer:
+    """Wraps one model + params; owns the compile cache and the lock
+    serializing device work (one chip — concurrent requests queue)."""
+
+    def __init__(self, model, variables, *, model_name: str = "model",
+                 max_batch: int = 8,
+                 info: Optional[Dict[str, Any]] = None):
+        self.model = model
+        self.variables = variables
+        self.model_name = model_name
+        self.max_batch = int(max_batch)
+        self.extra_info = info or {}
+        self._lock = threading.Lock()
+        # LRU-bounded: the key includes client-controlled sampling
+        # values (temperature must stay trace-static — the greedy
+        # branch is Python-level control flow), so unbounded caching
+        # would let varied traffic grow compiled programs without
+        # limit.
+        from collections import OrderedDict
+
+        self._fns: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._fn_cap = 32
+        self.requests = 0
+
+    # -- compile cache --------------------------------------------------
+
+    def _fn(self, key):
+        import jax
+
+        from .models import generate as G
+
+        if key in self._fns:
+            self._fns.move_to_end(key)
+            return self._fns[key]
+        kind, b, p_len, new, temp, top_k, top_p, eos, beams = key
+        if kind == "beam":
+            fn = jax.jit(lambda toks, rng: G.generate_beam(
+                self.model, self.variables, toks, max_new_tokens=new,
+                num_beams=beams, eos_id=eos))
+        else:
+            fn = jax.jit(lambda toks, rng: G.generate(
+                self.model, self.variables, toks, max_new_tokens=new,
+                temperature=temp, top_k=top_k, top_p=top_p,
+                eos_id=eos, rng=rng))
+        self._fns[key] = fn
+        if len(self._fns) > self._fn_cap:
+            self._fns.popitem(last=False)  # evict least-recently-used
+        return fn
+
+    # -- request handling -----------------------------------------------
+
+    def generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+
+        rows = req.get("prompt")
+        if rows is None:
+            raise ValueError("missing 'prompt'")
+        if rows and not isinstance(rows[0], list):
+            rows = [rows]
+        if not rows or not rows[0]:
+            raise ValueError("prompt must contain at least one token")
+        if len(rows) > self.max_batch:
+            raise ValueError(f"batch {len(rows)} exceeds max_batch "
+                             f"{self.max_batch}")
+        lens = [len(r) for r in rows]
+        if len(set(lens)) != 1:
+            # No silent padding: the decode path has no attention
+            # mask, so padded positions would be attended to.
+            raise ValueError(
+                "all prompt rows must share one length (the decode "
+                "path has no pad mask; bucket lengths client-side)")
+        if any(not all(isinstance(t, int) for t in r) for r in rows):
+            raise ValueError("prompt rows must be integer token ids")
+        new = int(req.get("max_new_tokens", 32))
+        if new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        temp = float(req.get("temperature", 0.0))
+        top_k = req.get("top_k")
+        top_p = req.get("top_p")
+        eos = req.get("eos_id")
+        beams = int(req.get("num_beams", 1))
+        seed = int(req.get("seed", 0))
+        if beams > 1 and (temp != 0.0 or top_k is not None
+                          or top_p is not None):
+            # Mirror the CLI: beam search is deterministic — dropping
+            # sampling params silently would let a client believe it
+            # sampled.
+            raise ValueError(
+                "beam search is deterministic; temperature/top_k/"
+                "top_p cannot be combined with num_beams > 1")
+
+        p_len = lens[0]
+        max_pos = getattr(getattr(self.model, "cfg", None),
+                          "max_position", None)
+        if max_pos is not None and p_len + new > max_pos:
+            raise ValueError(
+                f"prompt ({p_len}) + max_new_tokens ({new}) "
+                f"exceeds max_position ({max_pos})")
+        toks = np.asarray(rows, np.int32)
+
+        key = ("beam" if beams > 1 else "sample", len(rows), p_len,
+               new, temp, top_k, top_p, eos, beams)
+        t0 = time.perf_counter()
+        with self._lock:  # one chip: serialize device work
+            import jax.random as jrandom
+
+            fn = self._fn(key)
+            out = np.asarray(jax.device_get(
+                fn(toks, jrandom.PRNGKey(seed))))
+            self.requests += 1
+        dt = time.perf_counter() - t0
+        return {
+            "model": self.model_name,
+            "new_tokens": out[:, p_len:].tolist(),
+            "tokens": out.tolist(),
+            "wall_s": round(dt, 4),
+            "tok_per_sec": round(len(rows) * new / dt, 1),
+        }
+
+    def info(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = getattr(self.model, "cfg", None)
+        summary = {}
+        if cfg is not None:
+            for f in ("vocab_size", "hidden_size", "d_model",
+                      "num_layers", "num_heads", "max_position",
+                      "kv_cache_int8"):
+                v = getattr(cfg, f, None)
+                if v is not None:
+                    summary[f] = v
+        return {"model": self.model_name, "config": summary,
+                "backend": jax.default_backend(),
+                "max_batch": self.max_batch,
+                "compiled_shapes": len(self._fns),
+                "requests": self.requests, **self.extra_info}
+
+
+def make_server(host: str, port: int, ms: ModelServer
+                ) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, obj: Dict[str, Any]) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok",
+                                 "model": ms.model_name})
+            elif self.path == "/info":
+                self._send(200, ms.info())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                self._send(200, ms.generate(req))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # never kill the server thread
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return ThreadingHTTPServer((host, port), Handler)

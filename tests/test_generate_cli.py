@@ -129,6 +129,31 @@ class TestGenerateCLI:
                 "--cpu"] + extra)
             assert r.exit_code != 0, extra
 
+    def test_prompt_file_errors_clean(self, tmp_path):
+        r = CliRunner().invoke(cli, [
+            "generate", "--model", "gpt2-tiny",
+            "--prompt", "@/nope/missing.json", "--cpu"])
+        assert r.exit_code != 0 and "cannot read" in r.output
+        f = tmp_path / "bad.json"
+        f.write_text("{not json")
+        r = CliRunner().invoke(cli, [
+            "generate", "--model", "gpt2-tiny", "--prompt", f"@{f}",
+            "--cpu"])
+        assert r.exit_code != 0 and "cannot read" in r.output
+        f.write_text("5")
+        r = CliRunner().invoke(cli, [
+            "generate", "--model", "gpt2-tiny", "--prompt", f"@{f}",
+            "--cpu"])
+        assert r.exit_code != 0 and "JSON list" in r.output
+
+    def test_library_validation_clean(self):
+        r = CliRunner().invoke(cli, [
+            "generate", "--model", "gpt2-tiny", "--prompt", "1,2",
+            "--max-new-tokens", "500", "--cpu"])
+        assert r.exit_code != 0
+        assert "max_position" in r.output
+        assert "Traceback" not in r.output
+
     def test_int8_kv_unsupported_model(self):
         r = CliRunner().invoke(cli, [
             "generate", "--model", "mlp", "--prompt", "1,2", "--cpu",

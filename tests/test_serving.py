@@ -1,0 +1,112 @@
+"""Native model server (serving.py): HTTP surface over the decode
+stack.  The server runs in-process on an ephemeral port; requests go
+through real HTTP."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models.generate import generate
+from polyaxon_tpu.models.registry import get_model
+from polyaxon_tpu.serving import ModelServer, make_server
+
+
+@pytest.fixture(scope="module")
+def server():
+    spec = get_model("gpt2-tiny")
+    model, variables = spec.init_params(batch_size=2)
+    ms = ModelServer(model, variables, model_name="gpt2-tiny",
+                     max_batch=4)
+    srv = make_server("127.0.0.1", 0, ms)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, model, variables
+    srv.shutdown()
+
+
+def _post(base, payload, expect=200):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == expect
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, e.read()
+        return json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class TestServer:
+    def test_healthz_and_info(self, server):
+        base, _, _ = server
+        assert _get(base, "/healthz")["status"] == "ok"
+        info = _get(base, "/info")
+        assert info["model"] == "gpt2-tiny"
+        assert info["config"]["vocab_size"] == 1024
+
+    def test_generate_matches_library(self, server):
+        base, model, variables = server
+        out = _post(base, {"prompt": [5, 6, 7, 8],
+                           "max_new_tokens": 6})
+        want = np.asarray(generate(
+            model, variables, np.asarray([[5, 6, 7, 8]], np.int32),
+            max_new_tokens=6))
+        assert out["tokens"] == want.tolist()
+        assert len(out["new_tokens"][0]) == 6
+
+    def test_batch_and_beam(self, server):
+        base, _, _ = server
+        out = _post(base, {"prompt": [[1, 2, 3], [4, 5, 6]],
+                           "max_new_tokens": 4, "num_beams": 2})
+        assert np.asarray(out["tokens"]).shape == (2, 7)
+
+    def test_sampling_deterministic_by_seed(self, server):
+        base, _, _ = server
+        a = _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 5,
+                         "temperature": 0.9, "top_p": 0.95, "seed": 7})
+        b = _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 5,
+                         "temperature": 0.9, "top_p": 0.95, "seed": 7})
+        assert a["new_tokens"] == b["new_tokens"]
+
+    def test_compile_cache_reuse(self, server):
+        base, _, _ = server
+        _post(base, {"prompt": [9, 9, 9, 9], "max_new_tokens": 6})
+        n = _get(base, "/info")["compiled_shapes"]
+        _post(base, {"prompt": [1, 1, 1, 1], "max_new_tokens": 6})
+        assert _get(base, "/info")["compiled_shapes"] == n
+
+    def test_errors(self, server):
+        base, _, _ = server
+        assert "error" in _post(base, {}, expect=400)
+        assert "error" in _post(
+            base, {"prompt": [[1, 2], [3]]}, expect=400)  # ragged
+        assert "error" in _post(
+            base, {"prompt": [1], "max_new_tokens": 0}, expect=400)
+        big = [[1, 2]] * 10
+        assert "max_batch" in _post(
+            base, {"prompt": big}, expect=400)["error"]
+        over = {"prompt": [1] * 120, "max_new_tokens": 50}
+        assert "max_position" in _post(base, over,
+                                       expect=400)["error"]
+
+    def test_beam_rejects_sampling_params(self, server):
+        base, _, _ = server
+        out = _post(base, {"prompt": [1, 2], "num_beams": 2,
+                           "temperature": 0.9}, expect=400)
+        assert "deterministic" in out["error"]
+
+    def test_404(self, server):
+        base, _, _ = server
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
