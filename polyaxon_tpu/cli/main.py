@@ -116,6 +116,15 @@ def run(files, params, presets, name, project, watch, eager, check_only,
         raise click.ClickException(f"Run failed: {e}")
     status = record.get("status")
     _echo_record(record)
+    if status == "running" and record.get("kind") == "service":
+        # RUNNING is the service's steady state, not a failure: it
+        # stays up detached until `ops stop` reaps it.
+        svc = (record.get("meta_info") or {}).get("service") or {}
+        ports = svc.get("ports") or []
+        where = f" on port {ports[0]}" if ports else ""
+        click.echo(f"service is up{where}; stop with "
+                   f"`ptpu ops stop {record['uuid']}`")
+        return
     if status != "succeeded":
         logs = executor.store.read_logs(record["uuid"], tail=20)
         if logs:
@@ -346,7 +355,11 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),
                            **({"int8_kv": True} if int8_kv else {})})
-    srv = make_server(host, port, ms)
+    try:
+        srv = make_server(host, port, ms)
+    except OSError as e:
+        raise click.ClickException(
+            f"cannot bind {host}:{port}: {e}")
     click.echo(f"serving {model_name} on http://{host}:"
                f"{srv.server_address[1]}")
     try:
@@ -575,12 +588,47 @@ def ops_metrics(run_uuid, name):
             click.echo(f"{metric}: {value}")
 
 
+def _reap_local_service(store, run_uuid: str) -> bool:
+    """Kill a locally-spawned service (runner.local._run_service
+    records its pid/session in meta_info) and mark it stopped.  The
+    k8s path doesn't need this — the operator reconciles STOPPING —
+    but a local detached service has no operator watching it."""
+    try:
+        rec = store.get_run(run_uuid)
+    except Exception:
+        return False
+    svc = (rec.get("meta_info") or {}).get("service") or {}
+    pid = svc.get("pid")
+    if not pid or svc.get("host") not in (None, "127.0.0.1"):
+        return False
+    import signal
+
+    try:
+        os.killpg(int(pid), signal.SIGTERM)
+    except ProcessLookupError:
+        pass  # already gone — marking stopped is correct
+    except PermissionError:
+        # We could NOT signal it (pid reuse across uids, etc.) —
+        # claiming "stopped" would strand a live orphan with a
+        # terminal-status record no second `ops stop` can fix.
+        click.echo(f"cannot signal service pid {pid} "
+                   f"(permission denied); not marking stopped",
+                   err=True)
+        return False
+    store.set_status(run_uuid, "stopped", reason="CliStop", force=True)
+    return True
+
+
 @ops.command(name="stop")
 @click.argument("run_uuid")
 def ops_stop(run_uuid):
     """Request a run stop."""
     _get_run_or_fail(run_uuid)
-    ok = _store().set_status(run_uuid, "stopping", reason="CliStop")
+    store = _store()
+    ok = store.set_status(run_uuid, "stopping", reason="CliStop")
+    if ok and _reap_local_service(store, run_uuid):
+        click.echo("stopped (local service reaped)")
+        return
     click.echo("stopping" if ok else "run is already done")
 
 

@@ -8,7 +8,9 @@ Execution semantics per run kind:
   block the agent would inject in-cluster (coordinator on localhost).
   This is the "multi-node without a cluster" harness (SURVEY.md §4).
 - ``dag``:     topological execution of member operations with concurrency.
-- ``service``: refused locally (needs the operator; port-forward instead).
+- ``service``: spawned DETACHED in its own session (logs to the run's
+  log file), gated on port readiness, left RUNNING; ``ops stop`` reaps
+  it via the recorded pid (cli.main._reap_local_service).
 
 Matrix operations are handled by the tuner controller
 (``polyaxon_tpu.tune.controller``), which calls back into this executor
@@ -49,6 +51,22 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _merge_container_env(env, container) -> None:
+    """Overlay the container's literal env entries onto ``env`` (one
+    place: job, distributed, and service spawns all share it)."""
+    for e in (container.env or []):
+        if e.value is not None:
+            env[e.name] = str(e.value)
+
+
+def _port_open(host: str, port: int, timeout: float = 0.5) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
 
 
 class LocalExecutor:
@@ -165,11 +183,14 @@ class LocalExecutor:
                     self._run_distributed(run_uuid, compiled, timeout)
                 elif kind == RunKind.DAG:
                     self._run_dag(run_uuid, operation, compiled)
+                elif kind == RunKind.SERVICE:
+                    # Detached: the run stays RUNNING after we return;
+                    # `ops stop` reaps it via the recorded pid.
+                    self._run_service(run_uuid, compiled)
+                    return self.store.get_run(run_uuid)
                 else:
                     raise ExecutionError(
-                        f"Run kind {kind!r} is not executable locally "
-                        "(services need the operator; use port-forward)"
-                    )
+                        f"Run kind {kind!r} is not executable locally")
                 break
             except StopRequested:
                 self.store.set_status(run_uuid, V1Statuses.STOPPED,
@@ -402,13 +423,85 @@ class LocalExecutor:
             if proc.poll() is None:
                 proc.kill()
 
+    def _run_service(self, run_uuid: str, compiled) -> None:
+        """Run a service kind DETACHED: spawn the container in its own
+        session with logs sunk straight to the run's log file (no pipe
+        — a pump thread would die with this process and block the
+        service on a full pipe), gate on port readiness, record
+        pid/ports in meta_info, and leave it RUNNING.  `ops stop`
+        reaps it via the recorded pid (cli.main.ops_stop).
+
+        Parity: the reference runs notebooks/TensorBoard as `V1Service`
+        until stopped (SURVEY.md 2.4); locally the executor process is
+        the operator-equivalent.
+        """
+        container = compiled.run.container
+        argv = self._container_argv(container)
+        env = self._build_env(run_uuid)
+        _merge_container_env(env, container)
+        ports = [int(p) for p in (compiled.run.ports or [])]
+        if ports and _port_open("127.0.0.1", ports[0]):
+            # A stale listener would make the readiness probe pass
+            # while OUR process dies on EADDRINUSE — fail fast with
+            # the real cause instead of a phantom-RUNNING record.
+            raise ExecutionError(
+                f"port {ports[0]} is already in use (a previous "
+                f"service still in shutdown grace, or an unrelated "
+                f"listener)")
+        log_path = self.store.logs_path(run_uuid, "main")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, "a") as sink:
+            proc = subprocess.Popen(
+                argv, env=env, cwd=container.working_dir,
+                stdout=sink, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        self.store.set_status(run_uuid, V1Statuses.RUNNING,
+                              reason="LocalExecutor", force=True)
+        self.store.update_run(run_uuid, meta_info={
+            "service": {"pid": proc.pid, "ports": ports,
+                        "host": "127.0.0.1"}})
+        ready_timeout = float(os.environ.get(
+            "POLYAXON_TPU_SERVICE_READY_TIMEOUT", "60"))
+        deadline = time.time() + ready_timeout
+        while True:
+            # `ops stop` during startup reaps the pid and force-sets
+            # "stopped" — honor it instead of misreading the kill as
+            # a startup crash (FAILED) or respawning via retries.
+            try:
+                status = self.store.get_run(run_uuid).get("status")
+            except Exception:
+                status = None
+            if status in (V1Statuses.STOPPING, V1Statuses.STOPPED):
+                raise StopRequested()
+            if proc.poll() is not None:
+                raise ExecutionError(
+                    f"service exited during startup "
+                    f"(rc={proc.returncode}); see logs")
+            if not ports or _port_open("127.0.0.1", ports[0]):
+                # The port answering isn't proof OUR process owns it —
+                # re-check liveness once so a racing listener can't
+                # bless a dead service.
+                if proc.poll() is not None:
+                    raise ExecutionError(
+                        f"service exited right after port "
+                        f"{ports[0] if ports else '?'} opened "
+                        f"(rc={proc.returncode}); see logs")
+                return
+            if time.time() >= deadline:
+                try:
+                    os.killpg(proc.pid, 15)
+                except ProcessLookupError:
+                    pass
+                raise ExecutionError(
+                    f"service did not answer on port {ports[0]} "
+                    f"within {ready_timeout:.0f}s")
+            time.sleep(0.25)
+
     def _run_job(self, run_uuid: str, compiled, timeout: Optional[float]) -> None:
         container = compiled.run.container
         argv = self._container_argv(container)
         env = self._build_env(run_uuid)
-        for e in (container.env or []):
-            if e.value is not None:
-                env[e.name] = str(e.value)
+        _merge_container_env(env, container)
         self.store.set_status(run_uuid, V1Statuses.RUNNING,
                               reason="LocalExecutor", force=True)
         proc = self._spawn(run_uuid, argv, env, "main",
@@ -435,9 +528,7 @@ class LocalExecutor:
                 # Local simulation: every process is on this host.
                 topo_env["PTPU_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
                 env = self._build_env(run_uuid, topo_env)
-                for e in (container.env or []):
-                    if e.value is not None:
-                        env[e.name] = str(e.value)
+                _merge_container_env(env, container)
                 procs[replica] = self._spawn(run_uuid, argv, env, replica,
                                              cwd=container.working_dir)
         self._wait(run_uuid, procs, timeout)

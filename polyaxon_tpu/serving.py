@@ -89,9 +89,14 @@ class ModelServer:
     def generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
         import jax
 
+        if not isinstance(req, dict):
+            raise ValueError("request body must be a JSON object")
         rows = req.get("prompt")
         if rows is None:
             raise ValueError("missing 'prompt'")
+        if not isinstance(rows, list):
+            raise ValueError("'prompt' must be a list of token ids "
+                             "or a list of rows")
         if rows and not isinstance(rows[0], list):
             rows = [rows]
         if not rows or not rows[0]:
@@ -111,12 +116,20 @@ class ModelServer:
         new = int(req.get("max_new_tokens", 32))
         if new < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        temp = float(req.get("temperature", 0.0))
-        top_k = req.get("top_k")
-        top_p = req.get("top_p")
-        eos = req.get("eos_id")
-        beams = int(req.get("num_beams", 1))
-        seed = int(req.get("seed", 0))
+        try:
+            temp = float(req.get("temperature", 0.0))
+            top_k = req.get("top_k")
+            top_k = None if top_k is None else int(top_k)
+            top_p = req.get("top_p")
+            top_p = None if top_p is None else float(top_p)
+            eos = req.get("eos_id")
+            eos = None if eos is None else int(eos)
+            beams = int(req.get("num_beams", 1))
+            seed = int(req.get("seed", 0))
+        except (TypeError, ValueError):
+            raise ValueError(
+                "sampling params must be scalars (temperature/top_p "
+                "float, top_k/eos_id/num_beams/seed int)")
         if beams > 1 and (temp != 0.0 or top_k is not None
                           or top_p is not None):
             # Mirror the CLI: beam search is deterministic — dropping

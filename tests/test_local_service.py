@@ -1,0 +1,149 @@
+"""Local service-kind runs: detached spawn, port readiness, stop-reap.
+
+Parity: the reference runs notebooks/TensorBoard as `V1Service` until
+stopped (SURVEY.md 2.4).  Locally the executor spawns the service in
+its own session (logs sunk to the run's log file — no pipe to a
+process that exits) and `ops stop` reaps it via the recorded pid.
+"""
+
+import os
+import socket
+import sys
+import time
+import urllib.request
+
+import pytest
+from click.testing import CliRunner
+
+from polyaxon_tpu.cli.main import cli
+from polyaxon_tpu.client import FileRunStore
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.polyaxonfile import get_op_from_files
+from polyaxon_tpu.runner import LocalExecutor
+from polyaxon_tpu.runner.local import _free_port
+
+
+SERVER = """
+import http.server, sys
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200); self.end_headers()
+        self.wfile.write(b'{"status": "ok"}')
+    def log_message(self, *a): pass
+http.server.HTTPServer(("127.0.0.1", int(sys.argv[1])), H).serve_forever()
+"""
+
+
+def service_spec(port, command=None, args=None):
+    return {
+        "kind": "operation",
+        "name": "svc",
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "service",
+                "ports": [port],
+                "container": {
+                    "command": command or [sys.executable, "-c", SERVER],
+                    "args": args if args is not None else [str(port)],
+                },
+            },
+        },
+    }
+
+
+@pytest.fixture
+def executor(tmp_home):
+    return LocalExecutor(store=FileRunStore(str(tmp_home)),
+                         project="svc")
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+class TestLocalService:
+    def test_service_runs_detached_and_stops(self, executor,
+                                             monkeypatch):
+        port = _free_port()
+        record = executor.run_operation(
+            get_op_from_files(service_spec(port)))
+        try:
+            assert record["status"] == V1Statuses.RUNNING
+            svc = record["meta_info"]["service"]
+            assert svc["ports"] == [port]
+            assert _pid_alive(svc["pid"])
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=5) as r:
+                assert r.status == 200
+            # stop through the real CLI path
+            monkeypatch.setenv("POLYAXON_TPU_HOME",
+                               executor.store.home)
+            res = CliRunner().invoke(
+                cli, ["ops", "stop", record["uuid"]])
+            assert res.exit_code == 0 and "reaped" in res.output
+            rec = executor.store.get_run(record["uuid"])
+            assert rec["status"] == V1Statuses.STOPPED
+            # the dead child stays a zombie until reaped (this test
+            # process is its parent) — liveness is the PORT going dark
+            for _ in range(40):
+                try:
+                    os.waitpid(svc["pid"], os.WNOHANG)
+                except ChildProcessError:
+                    pass
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/", timeout=1):
+                        alive = True
+                except OSError:
+                    alive = False
+                if not alive:
+                    break
+                time.sleep(0.25)
+            assert not alive
+        finally:
+            pid = record.get("meta_info", {}).get("service", {}).get(
+                "pid")
+            if pid and _pid_alive(pid):
+                os.killpg(pid, 9)
+
+    def test_run_cli_exits_clean_for_service(self, executor,
+                                             monkeypatch, tmp_path):
+        """`ptpu run -f svc.yaml` must exit 0 with the service left
+        RUNNING — running is the steady state, not a failure."""
+        import yaml
+
+        port = _free_port()
+        f = tmp_path / "svc.yaml"
+        f.write_text(yaml.safe_dump(service_spec(port)))
+        monkeypatch.setenv("POLYAXON_TPU_HOME", executor.store.home)
+        res = CliRunner().invoke(cli, ["run", "-f", str(f),
+                                       "--project", "svc"])
+        assert res.exit_code == 0, res.output
+        assert "service is up" in res.output
+        uuid = res.output.split("ops stop ")[1].split("`")[0]
+        res = CliRunner().invoke(cli, ["ops", "stop", uuid])
+        assert res.exit_code == 0 and "reaped" in res.output
+
+    def test_startup_crash_fails(self, executor):
+        port = _free_port()
+        spec = service_spec(port,
+                            command=[sys.executable, "-c",
+                                     "import sys; sys.exit(3)"],
+                            args=[])
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.FAILED
+
+    def test_readiness_timeout_fails(self, executor, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_SERVICE_READY_TIMEOUT", "2")
+        port = _free_port()
+        spec = service_spec(port,
+                            command=[sys.executable, "-c",
+                                     "import time; time.sleep(60)"],
+                            args=[])
+        record = executor.run_operation(get_op_from_files(spec))
+        assert record["status"] == V1Statuses.FAILED

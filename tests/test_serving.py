@@ -100,6 +100,13 @@ class TestServer:
         assert "max_position" in _post(base, over,
                                        expect=400)["error"]
 
+    def test_malformed_bodies_are_400s(self, server):
+        base, _, _ = server
+        assert "error" in _post(base, {"prompt": 5}, expect=400)
+        assert "error" in _post(base, [1, 2], expect=400)
+        assert "error" in _post(base, {"prompt": [1, 2],
+                                       "top_k": [5]}, expect=400)
+
     def test_beam_rejects_sampling_params(self, server):
         base, _, _ = server
         out = _post(base, {"prompt": [1, 2], "num_beams": 2,
