@@ -167,10 +167,14 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
         return prompt
     b, p_len = prompt.shape
     total = p_len + max_new_tokens
-    max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
-    if max_pos is not None and total > max_pos:
+    cfg = getattr(model, "cfg", None)
+    max_pos = getattr(cfg, "max_position", None)
+    if max_pos is not None and total > max_pos and \
+            not getattr(cfg, "kv_cache_ring", False):
         # Overflow would silently clamp the cache write index (garbage
-        # output, no error) — refuse up front.
+        # output, no error) — refuse up front.  Ring-cache models
+        # (kv_cache_ring) stream past max_position by design: their
+        # O(window) cache is position-keyed, not capacity-bounded.
         raise ValueError(
             f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_position ({max_pos})")
@@ -319,7 +323,22 @@ def generate_speculative(model, variables, draft_model, draft_variables,
         # The final round (entered at count <= max_new_tokens - 1,
         # i.e. consumed <= p_len + max_new_tokens - 2) appends k+1
         # entries, touching position p_len + max_new_tokens + k - 2 at
-        # most — capacity needed is one more than that.
+        # most — capacity needed is one more than that.  Ring caches
+        # are position-keyed, not capacity-bounded — but the k+1-wide
+        # verify scatter destroys K/V ``capacity`` positions back,
+        # which a partial-acceptance rollback can put BACK inside the
+        # window: they need ``kv_cache_ring_slack >= k-1`` spare slots
+        # (see append_ring_kv_cache).
+        mcfg = getattr(m, "cfg", None)
+        if getattr(mcfg, "kv_cache_ring", False):
+            slack = getattr(mcfg, "kv_cache_ring_slack", 0)
+            if slack < k - 1:
+                raise ValueError(
+                    f"speculative decoding with k={k} on a ring-cache "
+                    f"{nm} model needs kv_cache_ring_slack >= {k - 1} "
+                    f"(got {slack}): the verify chunk overwrites up "
+                    f"to k-1 still-in-window slots on a rollback")
+            continue
         if max_pos is not None and \
                 p_len + max_new_tokens + k - 1 > max_pos:
             raise ValueError(
@@ -424,6 +443,13 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
             "reorder would gather the position axis instead of beams. "
             "Use greedy generate(), or a scan_layers build of the "
             "model.")
+    if getattr(getattr(model, "cfg", None), "kv_cache_ring", False):
+        # The ring cache's batch-invariant cached_pos ([layers, cap])
+        # would be mis-gathered by the rank>=2 beam reorder (axis 1 is
+        # its SLOT axis, not batch).
+        raise NotImplementedError(
+            "generate_beam does not support kv_cache_ring; use the "
+            "standard cache for beam search")
     max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
     if max_pos is not None and p_len + max_new_tokens > max_pos:
         raise ValueError(

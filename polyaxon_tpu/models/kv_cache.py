@@ -19,6 +19,105 @@ def _quantize_chunk(x):
     return symmetric_int8(x, axes=(-1,))
 
 
+def append_ring_kv_cache(mod, k, v, window: int, rotate=None,
+                         quantize: bool = False, slack: int = 0):
+    """Sliding-window decode with an O(window) RING cache — the
+    long-context serving path for Mistral-style models.
+
+    The plain cache allocates ``max_position`` slots and refuses to
+    decode past them; a sliding-window model only ever ATTENDS to the
+    last ``window+1`` positions, so the ring stores exactly a window
+    (capacity ``window + S``, S = the trace-time chunk length) keyed by
+    ``position % capacity``, and sessions stream indefinitely — RoPE
+    needs no table, so positions keep growing past ``max_position``.
+
+    Per append: (1) read the old ring (its slot order is scrambled —
+    attention is order-agnostic given the mask), (2) rotate/quantize
+    the incoming chunk at its absolute positions, (3) hand attention
+    ``concat(old_ring, chunk)`` with validity derived from ABSOLUTE
+    positions (``q_pos - window <= k_pos <= q_pos``, unwritten slots
+    hold position -1), and (4) scatter the chunk's last
+    ``min(S, capacity)`` rows into the ring (earlier rows of a long
+    chunk are already out of every future window).  Stale slots from a
+    speculative rollback hold positions ahead of the rewound index, so
+    the same position test masks them until they're overwritten —
+    speculative decoding composes with no extra bookkeeping.
+
+    ``slack``: extra capacity beyond ``window + S``.  Plain decoding
+    needs none; SPECULATIVE decoding does: a k+1-wide verify chunk's
+    scatter destroys the K/V living ``capacity`` positions back, and
+    after a partial-acceptance rollback those positions can still be
+    inside the window (destroyed max = idx+k-cap-... safe iff
+    ``slack >= k-1`` — generate_speculative enforces it).
+
+    Returns ``(k_full, v_full, mask, positions)`` shaped like
+    :func:`append_kv_cache` but with key axis ``capacity + S``.
+    """
+    b, s, h, d = k.shape
+    idx = mod.variable("cache", "cache_index",
+                       lambda: jnp.array(0, jnp.int32))
+    pos_q = idx.value + jnp.arange(s)
+    if rotate is not None:
+        k = rotate(pos_q, k)
+    store_dtype = jnp.int8 if quantize else k.dtype
+    # Capacity is fixed by whoever CREATED the variables (generate's
+    # init_cache traces a 1-token step -> window+1 slots); later
+    # chunked appends must use the existing shape, not their own chunk
+    # length, or the slot arithmetic would scatter out of bounds.
+    ck = mod.variable("cache", "cached_key", jnp.zeros,
+                      (b, window + s + slack, h, d), store_dtype)
+    cap = ck.value.shape[1]
+    cv = mod.variable("cache", "cached_value", jnp.zeros,
+                      (b, cap, h, d), store_dtype)
+    # -1 marks never-written slots (masked off by the position test).
+    cpos = mod.variable("cache", "cached_pos",
+                        lambda: jnp.full((cap,), -1, jnp.int32))
+    if quantize:
+        kq, k_scale = _quantize_chunk(k)
+        vq, v_scale = _quantize_chunk(v)
+        cks = mod.variable("cache", "cached_key_scale", jnp.zeros,
+                           (b, cap, h, 1), jnp.bfloat16)
+        cvs = mod.variable("cache", "cached_value_scale", jnp.zeros,
+                           (b, cap, h, 1), jnp.bfloat16)
+        out_dtype = k.dtype
+        k_old = ck.value.astype(out_dtype) * cks.value.astype(out_dtype)
+        v_old = cv.value.astype(out_dtype) * cvs.value.astype(out_dtype)
+    else:
+        kq, k_scale, vq, v_scale = k, None, v, None
+        k_old, v_old = ck.value, cv.value
+
+    k_full = jnp.concatenate([k_old, k], axis=1)
+    v_full = jnp.concatenate([v_old, v], axis=1)
+    pos_k = jnp.concatenate([cpos.value, pos_q])      # [cap + S]
+    valid = (pos_k[None, :] <= pos_q[:, None]) & \
+        (pos_k[None, :] >= pos_q[:, None] - window) & \
+        (pos_k[None, :] >= 0)
+    # Ring entries must be strictly OLDER than this chunk's first
+    # position: after a speculative rollback the ring still holds
+    # REJECTED K/V at positions the chunk is now re-committing, and
+    # the position test alone would admit both copies.  The chunk
+    # carries its own entries for [idx, idx+S).
+    ring_older = jnp.concatenate(
+        [cpos.value < idx.value, jnp.ones((s,), bool)])
+    valid = valid & ring_older[None, :]
+
+    # Scatter the chunk tail into the ring.  keep = min(S, cap) rows:
+    # with keep <= cap the target slots (consecutive positions mod
+    # cap) are distinct, so the scatter has no duplicate-index
+    # ambiguity.
+    keep = min(s, cap)
+    tail_pos = pos_q[s - keep:]
+    slots = tail_pos % cap
+    ck.value = ck.value.at[:, slots].set(kq[:, s - keep:])
+    cv.value = cv.value.at[:, slots].set(vq[:, s - keep:])
+    if quantize:
+        cks.value = cks.value.at[:, slots].set(k_scale[:, s - keep:])
+        cvs.value = cvs.value.at[:, slots].set(v_scale[:, s - keep:])
+    cpos.value = cpos.value.at[slots].set(tail_pos)
+    idx.value = idx.value + s
+    return k_full, v_full, valid[None, None], pos_q
+
+
 def append_kv_cache(mod, k, v, max_position: int, window=None,
                     rotate=None, quantize: bool = False):
     """Append this step's k/v ([B, S, H, D]) to ``mod``'s decode cache.

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from ..parallel.constraints import BATCH, constrain
 from ..ops.rotary import apply_rotary
 from .attention import dot_product_attention
-from .kv_cache import append_kv_cache
+from .kv_cache import append_kv_cache, append_ring_kv_cache
 from .scan_stack import remat_policy, scan_stack
 
 
@@ -65,6 +65,16 @@ class LlamaConfig:
     # per-(token, head) bf16 scales (kv_cache.py) — halves the
     # KV bytes each decoded token streams from HBM.
     kv_cache_int8: bool = False
+    # Serve-time option for sliding-window models: O(window) RING
+    # cache instead of O(max_position) — sessions stream indefinitely
+    # past max_position (RoPE needs no table).  See
+    # kv_cache.append_ring_kv_cache.
+    kv_cache_ring: bool = False
+    # Extra ring slots beyond window+1.  Speculative decoding with
+    # draft length k overwrites up to k-1 still-in-window slots on a
+    # partial-acceptance rollback — set >= k-1 (generate_speculative
+    # enforces it); plain decode needs 0.
+    kv_cache_ring_slack: int = 0
 
     def __post_init__(self):
         if self.sliding_window is not None and self.sliding_window < 1:
@@ -72,6 +82,11 @@ class LlamaConfig:
                 f"sliding_window must be >= 1 or None; got "
                 f"{self.sliding_window} (0 would silently disable "
                 "windowing)")
+        if self.kv_cache_ring and self.sliding_window is None:
+            raise ValueError(
+                "kv_cache_ring requires sliding_window (a full-"
+                "attention model needs every past position — there is "
+                "no window to ring over)")
         if self.num_heads % self.num_kv_heads:
             raise ValueError(
                 f"num_heads ({self.num_heads}) must be divisible by "
@@ -128,11 +143,19 @@ class LlamaAttention(nn.Module):
             # append (stored pre-rotated); q rotates to match with the
             # returned positions.  The causal-append mask handles both
             # S == 1 and whole-prompt chunks, window-clipped.
-            k, v, mask, pos = append_kv_cache(
-                self, k, v, cfg.max_position, window=cfg.sliding_window,
-                quantize=cfg.kv_cache_int8,
-                rotate=lambda p, kk: apply_rotary(
-                    kk, kk, theta=cfg.rope_theta, positions=p)[1])
+            rot = lambda p, kk: apply_rotary(  # noqa: E731
+                kk, kk, theta=cfg.rope_theta, positions=p)[1]
+            if cfg.kv_cache_ring:
+                # O(window) ring — unbounded streaming decode.
+                k, v, mask, pos = append_ring_kv_cache(
+                    self, k, v, cfg.sliding_window, rotate=rot,
+                    quantize=cfg.kv_cache_int8,
+                    slack=cfg.kv_cache_ring_slack)
+            else:
+                k, v, mask, pos = append_kv_cache(
+                    self, k, v, cfg.max_position,
+                    window=cfg.sliding_window,
+                    quantize=cfg.kv_cache_int8, rotate=rot)
             q = apply_rotary(q, q, theta=cfg.rope_theta,
                              positions=pos)[0]
         else:

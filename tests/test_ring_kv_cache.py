@@ -1,0 +1,131 @@
+"""Ring-buffer KV cache for sliding-window decode
+(models/kv_cache.append_ring_kv_cache, cfg.kv_cache_ring on Llama).
+
+Oracles: (1) ring decode is bit-identical to the standard windowed
+cache within max_position; (2) the ring streams PAST max_position and
+matches the same weights run with a bigger standard cache (RoPE has no
+table — positions are pure arithmetic); (3) speculative decoding
+composes (stale rolled-back slots are masked until overwritten);
+(4) cache memory is O(window), not O(max_position).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import generate as G
+from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
+
+
+def _cfgs(window=8, max_position=128, **kw):
+    base = dataclasses.replace(LlamaConfig.tiny(),
+                               sliding_window=window,
+                               max_position=max_position, **kw)
+    ring = dataclasses.replace(base, kv_cache_ring=True)
+    return base, ring
+
+
+def _init(cfg, b=2, p=10, seed=0):
+    model = LlamaModel(cfg=cfg)
+    rng = jax.random.PRNGKey(seed)
+    prompt = jax.random.randint(rng, (b, p), 0, cfg.vocab_size)
+    variables = model.init(rng, prompt)
+    return model, variables, prompt
+
+
+def test_ring_matches_standard_within_max_position():
+    base_cfg, ring_cfg = _cfgs()
+    model, variables, prompt = _init(base_cfg)
+    ring_model = LlamaModel(cfg=ring_cfg)
+    want = G.generate(model, variables, prompt, max_new_tokens=20)
+    got = G.generate(ring_model, variables, prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_ring_streams_past_max_position():
+    """A ring model with max_position=24 must decode far beyond it and
+    match the SAME weights under a roomy standard cache."""
+    _, ring_small = _cfgs(window=8, max_position=24)
+    big_cfg, _ = _cfgs(window=8, max_position=256)
+    model_big, variables, prompt = _init(big_cfg)
+    ring_model = LlamaModel(cfg=ring_small)
+    n = 60  # 10 + 60 = 70 positions, ~3x the ring model's max_position
+    want = G.generate(model_big, variables, prompt, max_new_tokens=n)
+    got = G.generate(ring_model, variables, prompt, max_new_tokens=n)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    # the standard cache refuses this length outright
+    small_std = LlamaModel(cfg=_cfgs(window=8, max_position=24)[0])
+    with pytest.raises(ValueError, match="max_position"):
+        G.generate(small_std, variables, prompt, max_new_tokens=n)
+
+
+def test_ring_cache_is_o_window():
+    _, ring_cfg = _cfgs(window=8, max_position=2048)
+    model = LlamaModel(cfg=ring_cfg)
+    cache = G.init_cache(model, 2)
+    key_shapes = [v.shape for p, v in jax.tree.leaves_with_path(cache)
+                  if "cached_key'" in str(p)]
+    assert key_shapes and all(s[2] == 8 + 1 for s in key_shapes), \
+        key_shapes  # [layers, B, window+1, H, D]
+
+
+def test_ring_speculative_composes_with_mispredicting_draft():
+    """The honest composition test: a DIFFERENT draft mispredicts, so
+    rollbacks rewind mid-chunk and exercise the slot-destruction path
+    the slack capacity exists for.  Output must still exactly match
+    the roomy-standard-cache greedy decode."""
+    k = 3
+    _, ring_cfg = _cfgs(window=8, max_position=24)
+    ring_cfg = dataclasses.replace(ring_cfg, kv_cache_ring_slack=k - 1)
+    big_cfg, _ = _cfgs(window=8, max_position=256)
+    model_big, variables, prompt = _init(big_cfg)
+    ring_model = LlamaModel(cfg=ring_cfg)
+    # independently-initialized draft: near-zero acceptance
+    _, draft_vars, _ = _init(ring_cfg, seed=99)
+    n = 30  # streams past the ring model's max_position
+    want = G.generate(model_big, variables, prompt, max_new_tokens=n)
+    got = G.generate_speculative(ring_model, variables, ring_model,
+                                 draft_vars, prompt,
+                                 max_new_tokens=n, k=k)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    # self-draft (full acceptance) still exact too
+    got2 = G.generate_speculative(ring_model, variables, ring_model,
+                                  variables, prompt,
+                                  max_new_tokens=n, k=k)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got2))
+
+
+def test_ring_speculative_requires_slack():
+    _, ring_cfg = _cfgs(window=8, max_position=24)  # slack 0
+    model, variables, prompt = _init(ring_cfg)
+    with pytest.raises(ValueError, match="kv_cache_ring_slack"):
+        G.generate_speculative(model, variables, model, variables,
+                               prompt, max_new_tokens=8, k=3)
+
+
+def test_ring_int8_composes():
+    base_cfg, ring_cfg = _cfgs(window=8)
+    ring_int8 = dataclasses.replace(ring_cfg, kv_cache_int8=True)
+    model, variables, prompt = _init(base_cfg)
+    qmodel = LlamaModel(cfg=ring_int8)
+    out = G.generate(qmodel, variables, prompt, max_new_tokens=12)
+    assert out.shape == (2, 22)
+    cache = G.init_cache(qmodel, 2)
+    dtypes = {str(x.dtype) for x in jax.tree.leaves(cache)}
+    assert "int8" in dtypes
+
+
+def test_ring_requires_window():
+    with pytest.raises(ValueError, match="sliding_window"):
+        dataclasses.replace(LlamaConfig.tiny(), kv_cache_ring=True)
+
+
+def test_ring_beam_refused():
+    _, ring_cfg = _cfgs(window=8)
+    model, variables, prompt = _init(ring_cfg)
+    with pytest.raises(NotImplementedError, match="kv_cache_ring"):
+        G.generate_beam(model, variables, prompt, max_new_tokens=4,
+                        num_beams=2)
