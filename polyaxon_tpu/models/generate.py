@@ -148,7 +148,8 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
-             eos_id: Optional[int] = None) -> jax.Array:
+             eos_id: Optional[int] = None,
+             prefill_chunk: Optional[int] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     ``prompt``: [B, P] int32 (a shared prompt length; left-trim or pad
@@ -179,10 +180,11 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
             f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_position ({max_pos})")
 
-    # Chunked prefill: ONE forward over the whole prompt fills the KV
-    # cache (the causal-append mask handles S > 1), instead of p_len
-    # sequential decode steps.
-    first_logits, cache = _prefill(model, variables, prompt)
+    # Prefill fills the KV cache in one forward (the causal-append
+    # mask handles S > 1) — or in fixed-size pieces when
+    # ``prefill_chunk`` bounds the activation memory of long prompts.
+    first_logits, cache = _prefill(model, variables, prompt,
+                                   chunk=prefill_chunk)
 
     def apply_step(cache, tok, t):
         out, mut = model.apply(
@@ -259,16 +261,67 @@ def generate_seq2seq(model, variables, enc_tokens, *,
         eos_id=eos_id)
 
 
-def _prefill(model, variables, prompt):
-    """Chunked prefill shared by generate / generate_beam /
-    generate_speculative: one forward over the whole prompt fills the
-    cache; returns (last-position logits [B, V], cache)."""
-    cache = init_cache(model, prompt.shape[0])
-    out, mut = model.apply(
-        {"params": _params(variables), "cache": cache},
-        prompt, decode=True, decode_position=0, last_only=True,
-        mutable=["cache"])
-    return extract_logits(out)[:, -1], mut["cache"]
+def _prefill(model, variables, prompt, chunk: Optional[int] = None):
+    """Prefill shared by generate / generate_beam /
+    generate_speculative; returns (last-position logits [B, V], cache).
+
+    Default: ONE forward over the whole prompt.  ``chunk`` bounds the
+    prefill's activation memory for long prompts: the prompt is
+    consumed ``chunk`` tokens at a time through a ``lax.scan`` (one
+    traced chunk step, attention cost O(chunk x visible) per step)
+    plus one remainder step — the causal-append cache machinery is
+    position-keyed, so chunking changes memory, never logits.
+    """
+    if chunk is not None and chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1; got {chunk}")
+    b, p_len = prompt.shape
+    cfg = getattr(model, "cfg", None)
+    if chunk is None and getattr(cfg, "kv_cache_ring", False):
+        max_pos = getattr(cfg, "max_position", None)
+        if max_pos is not None and p_len > max_pos:
+            # Ring models stream past max_position, but the MODEL's
+            # per-forward sequence check still caps one apply at
+            # max_position tokens — auto-chunk so the unbounded-
+            # session promise holds for long prompts too.
+            chunk = max_pos
+    cache = init_cache(model, b)
+    params = _params(variables)
+
+    def apply_chunk(cache, toks, pos):
+        out, mut = model.apply(
+            {"params": params, "cache": cache},
+            toks, decode=True, decode_position=pos, last_only=True,
+            mutable=["cache"])
+        return extract_logits(out)[:, -1], mut["cache"]
+
+    if not chunk or p_len <= chunk:
+        return apply_chunk(cache, prompt, 0)
+
+    n_full, rem = divmod(p_len, chunk)
+
+    def chunk_step(carry, toks):
+        cache, pos = carry
+        _, cache = apply_chunk(cache, toks, pos)
+        return (cache, pos + chunk), None
+
+    pos = jnp.array(0, jnp.int32)
+    if n_full > 1:
+        # All but the last full chunk run through the scan emitting
+        # NOTHING — stacking per-chunk logits would add n_full x B x
+        # vocab of dead memory to a memory-bounding feature.  The last
+        # full chunk runs standalone so its logits are the only ones
+        # materialized.
+        head = prompt[:, :(n_full - 1) * chunk].reshape(
+            b, n_full - 1, chunk).swapaxes(0, 1)  # [n-1, B, chunk]
+        (cache, pos), _ = jax.lax.scan(chunk_step, (cache, pos), head)
+    logits, cache = apply_chunk(
+        cache, jax.lax.dynamic_slice_in_dim(prompt, (n_full - 1) * chunk,
+                                            chunk, axis=1), pos)
+    pos = pos + chunk
+    if rem:
+        logits, cache = apply_chunk(cache, prompt[:, n_full * chunk:],
+                                    pos)
+    return logits, cache
 
 
 def _rollback_cache(cache, new_index):
@@ -287,7 +340,8 @@ def _rollback_cache(cache, new_index):
 
 def generate_speculative(model, variables, draft_model, draft_variables,
                          prompt, *, max_new_tokens: int, k: int = 4,
-                         eos_id: Optional[int] = None) -> jax.Array:
+                         eos_id: Optional[int] = None,
+                         prefill_chunk: Optional[int] = None) -> jax.Array:
     """Greedy speculative decoding: a small DRAFT model proposes ``k``
     tokens per round; the target verifies all of them in ONE chunked
     forward (k+1 positions through the causal-append mask) and commits
@@ -346,8 +400,10 @@ def generate_speculative(model, variables, draft_model, draft_variables,
                 f"+ k ({k}) - 1 exceeds the {nm} model's max_position "
                 f"({max_pos}); speculative rounds need k-1 slack slots")
 
-    t_logits, t_cache = _prefill(model, variables, prompt)
-    _, d_cache = _prefill(draft_model, draft_variables, prompt)
+    t_logits, t_cache = _prefill(model, variables, prompt,
+                                 chunk=prefill_chunk)
+    _, d_cache = _prefill(draft_model, draft_variables, prompt,
+                          chunk=prefill_chunk)
     first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # [B]
 
     buf = jnp.zeros((b, max_new_tokens + k), jnp.int32)
@@ -414,7 +470,8 @@ def generate_speculative(model, variables, draft_model, draft_variables,
 
 def generate_beam(model, variables, prompt, *, max_new_tokens: int,
                   num_beams: int = 4, eos_id: Optional[int] = None,
-                  length_penalty: float = 1.0) -> jax.Array:
+                  length_penalty: float = 1.0,
+                  prefill_chunk: Optional[int] = None) -> jax.Array:
     """Beam-search decoding (one jitted scan, KV cache tiled per beam).
 
     Returns the highest-scoring sequence per batch row, [B, P +
@@ -457,7 +514,8 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
             f"exceeds the model's max_position ({max_pos})")
 
     # Prefill once on [B, P]; _beam_loop tiles the cache per beam.
-    first_logits, cache = _prefill(model, variables, prompt)
+    first_logits, cache = _prefill(model, variables, prompt,
+                                   chunk=prefill_chunk)
 
     def apply_step(cache, toks_flat, t):
         out, mut = model.apply(

@@ -1,0 +1,114 @@
+"""Chunked prefill (generate._prefill chunk=): bounded activation
+memory for long prompts.  Chunking is position-keyed cache mechanics —
+it must change memory, never logits: every test pins exact token
+equality against the one-forward prefill."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import generate as G
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
+from polyaxon_tpu.ops.quant import quantize_params
+
+
+def _setup(cls, cfg, b=2, p=10, seed=0):
+    model = cls(cfg=cfg)
+    rng = jax.random.PRNGKey(seed)
+    prompt = jax.random.randint(rng, (b, p), 0, cfg.vocab_size)
+    variables = model.init(rng, prompt)
+    return model, variables, prompt
+
+
+@pytest.mark.parametrize("chunk", [3, 4, 5, 10, 16])
+def test_gpt2_chunked_prefill_exact(chunk):
+    """Divisible, remainder-carrying, exact-length, and larger-than-
+    prompt chunks all reproduce the one-forward prefill."""
+    model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
+    want = np.asarray(G.generate(model, variables, prompt,
+                                 max_new_tokens=6))
+    got = np.asarray(G.generate(model, variables, prompt,
+                                max_new_tokens=6,
+                                prefill_chunk=chunk))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_llama_ring_chunked_prefill_exact():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=6,
+                              kv_cache_ring=True)
+    model, variables, prompt = _setup(LlamaModel, cfg, p=12)
+    want = np.asarray(G.generate(model, variables, prompt,
+                                 max_new_tokens=8))
+    got = np.asarray(G.generate(model, variables, prompt,
+                                max_new_tokens=8, prefill_chunk=5))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_speculative_chunked_prefill_exact():
+    model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
+    _, draft_vars, _ = _setup(GPT2Model, GPT2Config.tiny(), seed=9)
+    want = np.asarray(G.generate(model, variables, prompt,
+                                 max_new_tokens=8))
+    got = np.asarray(G.generate_speculative(
+        model, variables, model, draft_vars, prompt,
+        max_new_tokens=8, k=3, prefill_chunk=4))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_quantized_chunked_prefill_runs():
+    model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
+    qvars = {"params": quantize_params(variables["params"])}
+    a = np.asarray(G.generate(model, qvars, prompt, max_new_tokens=5,
+                              prefill_chunk=4))
+    b = np.asarray(G.generate(model, qvars, prompt, max_new_tokens=5))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_ring_long_prompt_autochunks():
+    """A ring model fed a prompt LONGER than max_position must
+    auto-chunk its prefill — the unbounded-session promise can't
+    depend on the caller knowing to pass prefill_chunk."""
+    ring_cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=6,
+                                   max_position=16, kv_cache_ring=True)
+    big_cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=6,
+                                  max_position=256)
+    model_big = LlamaModel(cfg=big_cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (2, 40), 0, 512)  # 2.5x max_pos
+    variables = model_big.init(rng, prompt[:, :8])
+    ring = LlamaModel(cfg=ring_cfg)
+    want = np.asarray(G.generate(model_big, variables, prompt,
+                                 max_new_tokens=6))
+    got = np.asarray(G.generate(ring, variables, prompt,
+                                max_new_tokens=6))  # no prefill_chunk
+    np.testing.assert_array_equal(want, got)
+
+
+def test_beam_chunked_prefill_exact():
+    model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
+    want = np.asarray(G.generate_beam(model, variables, prompt,
+                                      max_new_tokens=5, num_beams=2))
+    got = np.asarray(G.generate_beam(model, variables, prompt,
+                                     max_new_tokens=5, num_beams=2,
+                                     prefill_chunk=4))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_bad_chunk_rejected():
+    model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        G.generate(model, variables, prompt, max_new_tokens=2,
+                   prefill_chunk=-3)
+
+
+def test_under_jit():
+    model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
+    fn = jax.jit(lambda p: G.generate(model, variables, p,
+                                      max_new_tokens=5,
+                                      prefill_chunk=4))
+    want = np.asarray(G.generate(model, variables, prompt,
+                                 max_new_tokens=5))
+    np.testing.assert_array_equal(want, np.asarray(fn(prompt)))
