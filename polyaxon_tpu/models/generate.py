@@ -276,20 +276,24 @@ def _prefill(model, variables, prompt, chunk: Optional[int] = None):
         raise ValueError(f"prefill_chunk must be >= 1; got {chunk}")
     b, p_len = prompt.shape
     cfg = getattr(model, "cfg", None)
-    if chunk is None and getattr(cfg, "kv_cache_ring", False):
+    if getattr(cfg, "kv_cache_ring", False):
         max_pos = getattr(cfg, "max_position", None)
         if max_pos is not None and p_len > max_pos:
             # Ring models stream past max_position, but the MODEL's
             # per-forward sequence check still caps one apply at
-            # max_position tokens — auto-chunk so the unbounded-
-            # session promise holds for long prompts too.
-            chunk = max_pos
+            # max_position tokens — auto-chunk (and clamp an explicit
+            # oversized chunk) so the unbounded-session promise holds
+            # regardless of what the caller passed.
+            chunk = min(chunk, max_pos) if chunk else max_pos
     cache = init_cache(model, b)
-    params = _params(variables)
 
     def apply_chunk(cache, toks, pos):
+        # _params INSIDE the closure: for int8 weights the dequant
+        # must sit in each traced step (fused into the matmul operand
+        # read), not be hoisted into a resident bf16 copy — see the
+        # _params docstring.
         out, mut = model.apply(
-            {"params": params, "cache": cache},
+            {"params": _params(variables), "cache": cache},
             toks, decode=True, decode_position=pos, last_only=True,
             mutable=["cache"])
         return extract_logits(out)[:, -1], mut["cache"]
@@ -315,8 +319,7 @@ def _prefill(model, variables, prompt, chunk: Optional[int] = None):
             b, n_full - 1, chunk).swapaxes(0, 1)  # [n-1, B, chunk]
         (cache, pos), _ = jax.lax.scan(chunk_step, (cache, pos), head)
     logits, cache = apply_chunk(
-        cache, jax.lax.dynamic_slice_in_dim(prompt, (n_full - 1) * chunk,
-                                            chunk, axis=1), pos)
+        cache, prompt[:, (n_full - 1) * chunk:n_full * chunk], pos)
     pos = pos + chunk
     if rem:
         logits, cache = apply_chunk(cache, prompt[:, n_full * chunk:],

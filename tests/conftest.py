@@ -20,6 +20,12 @@ os.environ.setdefault("POLYAXON_TPU_NO_TPU", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# The PERSISTENT compilation cache is process-shared on disk; two
+# concurrent pytest runs racing on one cache entry have produced a
+# native abort inside put_executable_and_time (observed: full suite +
+# a standalone test file running together).  Test compiles are tiny —
+# forgo cross-run reuse for crash-proof isolation.
+jax.config.update("jax_enable_compilation_cache", False)
 
 import pytest  # noqa: E402
 

@@ -87,6 +87,27 @@ def test_ring_long_prompt_autochunks():
     np.testing.assert_array_equal(want, got)
 
 
+def test_ring_oversized_explicit_chunk_clamped():
+    """An explicit prefill_chunk larger than a ring model's
+    max_position must clamp, not trip the model's sequence check."""
+    ring_cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=6,
+                                   max_position=16, kv_cache_ring=True)
+    big_cfg = dataclasses.replace(LlamaConfig.tiny(), sliding_window=6,
+                                  max_position=256)
+    model_big = LlamaModel(cfg=big_cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (2, 40), 0, 512)
+    variables = model_big.init(rng, prompt[:, :8])
+    ring = LlamaModel(cfg=ring_cfg)
+    want = np.asarray(G.generate(model_big, variables, prompt,
+                                 max_new_tokens=6))
+    for c in (20, 64):  # > max_position, and > whole prompt
+        got = np.asarray(G.generate(ring, variables, prompt,
+                                    max_new_tokens=6,
+                                    prefill_chunk=c))
+        np.testing.assert_array_equal(want, got)
+
+
 def test_beam_chunked_prefill_exact():
     model, variables, prompt = _setup(GPT2Model, GPT2Config.tiny())
     want = np.asarray(G.generate_beam(model, variables, prompt,
