@@ -38,7 +38,17 @@ CONFIGS = {
     "t5-small": (8, 128, 256, (128, 512)),  # seq2seq: prompt = encoder
     "gpt2-tiny": (4, 16, 32, (8, 32)),      # CI-sized smoke config
     "t5-tiny": (4, 16, 32, (8, 32)),        # CI-sized seq2seq smoke
+    "mistral-tiny": (4, 16, 32, (8, 32)),   # windowed: ring A/B leg
 }
+
+
+def _cache_bytes(jax, model, batch: int) -> int:
+    """KV-cache footprint of one decode session at ``batch``."""
+    from polyaxon_tpu.models.generate import init_cache
+
+    shapes = jax.eval_shape(lambda: init_cache(model, batch))
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree.leaves(shapes))
 
 
 def bench_decode(jax, model_name: str, backend: str):
@@ -108,19 +118,27 @@ def bench_decode(jax, model_name: str, backend: str):
     int8_s = timed(gen_q, prompt)
     tok_per_sec_int8 = batch * new_toks / int8_s
 
+    # Ring-cache A/B for sliding-window models: O(window) cache vs
+    # O(max_position), same tokens (exactness pinned in
+    # tests/test_ring_kv_cache.py) — the long-context serving mode.
+    ring_tok_per_sec = ring_kv_bytes = None
+    if getattr(model.cfg, "sliding_window", None) is not None and \
+            hasattr(model.cfg, "kv_cache_ring") and not seq2seq:
+        ring_model = spec.make_model(kv_cache_ring=True)
+        ring_kv_bytes = _cache_bytes(jax, ring_model, batch)
+        gen_r = jax.jit(lambda p: gen_fn(ring_model, variables, p,
+                                         max_new_tokens=new_toks))
+        ring_s = timed(gen_r, prompt)
+        ring_tok_per_sec = batch * new_toks / ring_s
+
     # Fully quantized serving: int8 weights AND int8 KV cache
     # (models/kv_cache.py) — the same params drive a model rebuilt with
     # kv_cache_int8, halving BOTH bandwidth streams of the decode loop.
     tok_per_sec_int8_kv = kv_bytes_int8 = None
     if hasattr(model.cfg, "kv_cache_int8"):
         kv_model = spec.make_model(kv_cache_int8=True)
-        if seq2seq:
-            kv_bytes_int8 = None  # sized below only for decoder-only
-        else:
-            kv_shapes = jax.eval_shape(
-                lambda: init_cache(kv_model, batch))
-            kv_bytes_int8 = sum(x.size * x.dtype.itemsize
-                                for x in jax.tree.leaves(kv_shapes))
+        kv_bytes_int8 = None if seq2seq else \
+            _cache_bytes(jax, kv_model, batch)
         gen_qkv = jax.jit(lambda p: gen_fn(kv_model, qvars, p,
                                            max_new_tokens=new_toks))
         qkv_s = timed(gen_qkv, prompt)
@@ -154,6 +172,9 @@ def bench_decode(jax, model_name: str, backend: str):
         "kv_cache_mb": round(kv_bytes / 2**20, 1),
         **({"kv_cache_mb_int8": round(kv_bytes_int8 / 2**20, 1)}
            if kv_bytes_int8 else {}),
+        **({"tok_per_sec_per_chip_ring": round(ring_tok_per_sec, 1),
+            "kv_cache_mb_ring": round(ring_kv_bytes / 2**20, 2)}
+           if ring_tok_per_sec else {}),
         "ttft_ms": {str(k): round(v * 1e3, 1) for k, v in ttft.items()},
         "ttft_ratio": round(ratio, 2),
         "ttft_len_ratio": round(l_big / l_small, 2),

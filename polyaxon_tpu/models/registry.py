@@ -374,11 +374,12 @@ _register(ModelSpec(
 
 _register(ModelSpec(
     name="mistral-tiny",  # Llama + sliding-window local attention + GQA
-    make_model=lambda **kw: LlamaModel(
-        LlamaConfig(vocab_size=512, hidden_size=64,
-                    intermediate_size=128, num_layers=2, num_heads=4,
-                    num_kv_heads=2, max_position=256,
-                    sliding_window=31), **kw),
+    # _cfg_model so serving overrides (kv_cache_int8, kv_cache_ring)
+    # patch CONFIG fields like every other config-bearing model.
+    make_model=_cfg_model(LlamaModel, LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position=256,
+        sliding_window=31)),
     make_batch=lambda b: _token_batch(b, 128, 512),
     loss_fn=_lm_loss,
     default_batch_size=8,
