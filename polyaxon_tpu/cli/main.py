@@ -813,8 +813,16 @@ def port_forward(run_uuid, port, target):
     import threading
 
     record = _get_run_or_fail(run_uuid)
+    meta = record.get("meta_info") or {}
     if target is None:
-        target = (record.get("meta_info") or {}).get("endpoint")
+        target = meta.get("endpoint")
+    if target is None:
+        # A locally-executed service records its live ports
+        # (runner.local._run_service).
+        svc = meta.get("service") or {}
+        if svc.get("ports"):
+            target = (f"{svc.get('host', '127.0.0.1')}:"
+                      f"{svc['ports'][0]}")
     if target is None:
         content = record.get("content") or {}
         run_section = (content.get("component") or {}).get("run") or {}

@@ -129,6 +129,55 @@ class TestLocalService:
         res = CliRunner().invoke(cli, ["ops", "stop", uuid])
         assert res.exit_code == 0 and "reaped" in res.output
 
+    def test_port_forward_resolves_service_meta(self, executor,
+                                                monkeypatch):
+        """`ptpu port-forward <uuid>` relays to the LIVE recorded
+        service port (meta_info.service).  The run's DECLARED content
+        port is rewritten to a dead port first, so the test fails if
+        resolution falls back to the spec instead of the live meta —
+        and the blocking CLI runs in a SUBPROCESS (a CliRunner thread
+        would never exit serve_forever and leak the stdout swap)."""
+        import subprocess
+        import urllib.request
+
+        port = _free_port()
+        record = executor.run_operation(
+            get_op_from_files(service_spec(port)))
+        proc = None
+        try:
+            # poison the declared port: only meta_info.service.ports
+            # still points at the live server
+            content = dict(record["content"])
+            content["component"]["run"]["ports"] = [1]  # dead port
+            executor.store.update_run(record["uuid"], content=content)
+
+            local = _free_port()
+            env = dict(os.environ,
+                       POLYAXON_TPU_HOME=executor.store.home)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "polyaxon_tpu.cli",
+                 "port-forward", record["uuid"], "--port", str(local)],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, start_new_session=True)
+            deadline = time.time() + 20
+            ok = False
+            while time.time() < deadline and not ok:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{local}/",
+                            timeout=2) as r:
+                        ok = r.status == 200
+                except OSError:
+                    time.sleep(0.3)
+            assert ok, "forwarded port never answered"
+        finally:
+            if proc is not None:
+                proc.kill()
+            pid = record.get("meta_info", {}).get("service", {}).get(
+                "pid")
+            if pid and _pid_alive(pid):
+                os.killpg(pid, 9)
+
     def test_startup_crash_fails(self, executor):
         port = _free_port()
         spec = service_spec(port,
