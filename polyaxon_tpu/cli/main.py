@@ -333,9 +333,13 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--int8-weights", is_flag=True, default=False)
 @click.option("--int8-kv", is_flag=True, default=False)
 @click.option("--max-batch", default=8, type=int)
+@click.option("--draft-model", default=None,
+              help="Zoo model enabling SPECULATIVE requests "
+                   "({\"speculative\": true}); same vocab as --model.")
+@click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
-          max_batch, cpu):
+          max_batch, draft_model, draft_checkpoint, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /generate).
 
     The reference's `V1Service` schedules an opaque serving container;
@@ -350,11 +354,21 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
 
     model, variables = _build_serving_model(
         model_name, 1, checkpoint, int8_kv, int8_weights)
+    if draft_checkpoint and not draft_model:
+        raise click.ClickException(
+            "--draft-checkpoint requires --draft-model")
+    draft = draft_vars = None
+    if draft_model:
+        draft, draft_vars = _build_serving_model(
+            draft_model, 1, draft_checkpoint, int8_kv, int8_weights)
     ms = ModelServer(model, variables, model_name=model_name,
                      max_batch=max_batch,
+                     draft_model=draft, draft_variables=draft_vars,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),
-                           **({"int8_kv": True} if int8_kv else {})})
+                           **({"int8_kv": True} if int8_kv else {}),
+                           **({"draft_model": draft_model}
+                              if draft_model else {})})
     try:
         srv = make_server(host, port, ms)
     except OSError as e:

@@ -14,7 +14,9 @@ Endpoints:
 - ``GET  /info``     -> model name, config summary, quantization flags
 - ``POST /generate`` -> ``{"prompt": [ids] | [[ids], ...],
   "max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p,
-  "eos_id": e, "num_beams": B}`` -> tokens + timing
+  "eos_id": e, "num_beams": B, "speculative": bool, "spec_k": K,
+  "seed": s}`` -> tokens + timing (speculative needs a server-side
+  draft model and is greedy-only)
 
 Shape discipline: each distinct (batch, prompt_len, max_new_tokens,
 decode-mode) compiles once and is cached.  Prompts are NOT padded:
@@ -41,9 +43,15 @@ class ModelServer:
 
     def __init__(self, model, variables, *, model_name: str = "model",
                  max_batch: int = 8,
+                 draft_model=None, draft_variables=None,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
         self.variables = variables
+        # Optional speculative-decoding draft: requests opt in with
+        # {"speculative": true}; greedy-only, output identical to the
+        # plain greedy decode (models/generate.generate_speculative).
+        self.draft_model = draft_model
+        self.draft_variables = draft_variables
         self.model_name = model_name
         self.max_batch = int(max_batch)
         self.extra_info = info or {}
@@ -74,6 +82,12 @@ class ModelServer:
             fn = jax.jit(lambda toks, rng: G.generate_beam(
                 self.model, self.variables, toks, max_new_tokens=new,
                 num_beams=beams, eos_id=eos))
+        elif kind == "spec":
+            k = beams  # slot reused for the draft length
+            fn = jax.jit(lambda toks, rng: G.generate_speculative(
+                self.model, self.variables, self.draft_model,
+                self.draft_variables, toks, max_new_tokens=new,
+                k=k, eos_id=eos))
         else:
             fn = jax.jit(lambda toks, rng: G.generate(
                 self.model, self.variables, toks, max_new_tokens=new,
@@ -138,18 +152,53 @@ class ModelServer:
             raise ValueError(
                 "beam search is deterministic; temperature/top_k/"
                 "top_p cannot be combined with num_beams > 1")
+        speculative = req.get("speculative", False)
+        if not isinstance(speculative, bool):
+            # bool("false") is True — a stringified flag must not
+            # silently flip the decode mode.
+            raise ValueError("'speculative' must be a JSON boolean")
+        if speculative:
+            if self.draft_model is None:
+                raise ValueError(
+                    "server has no draft model (start with "
+                    "--draft-model to enable speculative decoding)")
+            if beams > 1 or temp != 0.0 or top_k is not None \
+                    or top_p is not None:
+                raise ValueError(
+                    "speculative decoding is greedy-only (no "
+                    "num_beams/temperature/top_k/top_p)")
+            try:
+                spec_k = int(req.get("spec_k", 4))
+            except (TypeError, ValueError):
+                raise ValueError("spec_k must be an int")
+            if spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
 
         p_len = lens[0]
-        max_pos = getattr(getattr(self.model, "cfg", None),
-                          "max_position", None)
-        if max_pos is not None and p_len + new > max_pos:
+        cfg = getattr(self.model, "cfg", None)
+        max_pos = getattr(cfg, "max_position", None)
+        # Speculative rounds touch k-1 positions past the last
+        # committed token (generate_speculative's capacity guard) —
+        # include the slack here so near-limit requests fail in this
+        # cheap validation layer, not inside the locked device
+        # section at trace time.
+        slack = (spec_k - 1) if speculative else 0
+        if max_pos is not None and \
+                not getattr(cfg, "kv_cache_ring", False) and \
+                p_len + new + slack > max_pos:
             raise ValueError(
-                f"prompt ({p_len}) + max_new_tokens ({new}) "
-                f"exceeds max_position ({max_pos})")
+                f"prompt ({p_len}) + max_new_tokens ({new})"
+                + (f" + spec_k-1 ({slack})" if slack else "")
+                + f" exceeds max_position ({max_pos})")
         toks = np.asarray(rows, np.int32)
 
-        key = ("beam" if beams > 1 else "sample", len(rows), p_len,
-               new, temp, top_k, top_p, eos, beams)
+        if speculative:
+            # last slot carries the draft length (see _fn)
+            key = ("spec", len(rows), p_len, new, 0.0, None, None,
+                   eos, spec_k)
+        else:
+            key = ("beam" if beams > 1 else "sample", len(rows), p_len,
+                   new, temp, top_k, top_p, eos, beams)
         t0 = time.perf_counter()
         with self._lock:  # one chip: serialize device work
             import jax.random as jrandom

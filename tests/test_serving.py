@@ -19,8 +19,10 @@ from polyaxon_tpu.serving import ModelServer, make_server
 def server():
     spec = get_model("gpt2-tiny")
     model, variables = spec.init_params(batch_size=2)
+    # self-draft: full acceptance, output must equal plain greedy
     ms = ModelServer(model, variables, model_name="gpt2-tiny",
-                     max_batch=4)
+                     max_batch=4, draft_model=model,
+                     draft_variables=variables)
     srv = make_server("127.0.0.1", 0, ms)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -106,6 +108,28 @@ class TestServer:
         assert "error" in _post(base, [1, 2], expect=400)
         assert "error" in _post(base, {"prompt": [1, 2],
                                        "top_k": [5]}, expect=400)
+
+    def test_speculative_matches_greedy(self, server):
+        base, _, _ = server
+        want = _post(base, {"prompt": [5, 6, 7, 8],
+                            "max_new_tokens": 6})
+        got = _post(base, {"prompt": [5, 6, 7, 8],
+                           "max_new_tokens": 6, "speculative": True,
+                           "spec_k": 3})
+        assert got["new_tokens"] == want["new_tokens"]
+
+    def test_speculative_rejects_sampling(self, server):
+        base, _, _ = server
+        out = _post(base, {"prompt": [1, 2], "speculative": True,
+                           "temperature": 0.5}, expect=400)
+        assert "greedy-only" in out["error"]
+
+    def test_speculative_without_draft_400(self):
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        ms = ModelServer(model, variables)
+        with pytest.raises(ValueError, match="draft model"):
+            ms.generate({"prompt": [1, 2], "speculative": True})
 
     def test_beam_rejects_sampling_params(self, server):
         base, _, _ = server
