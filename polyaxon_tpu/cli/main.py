@@ -244,11 +244,14 @@ def _build_serving_model(name: str, batch_size: int,
 @click.option("--int8-kv", is_flag=True, default=False,
               help="int8 KV cache (halves KV HBM reads).")
 @click.option("--seed", default=0, type=int)
+@click.option("--prefill-chunk", default=None, type=int,
+              help="Prefill the prompt in fixed-size pieces to bound "
+                   "activation memory (long prompts).")
 @click.option("--cpu", is_flag=True, default=False)
 def generate(model_name, prompt, max_new_tokens, temperature, top_k,
              top_p, beams, eos_id, checkpoint, draft_model,
              draft_checkpoint, spec_k, int8_weights, int8_kv, seed,
-             cpu):
+             prefill_chunk, cpu):
     """Decode with a zoo model — the native serving surface.
 
     The reference serves models as opaque user containers behind
@@ -288,7 +291,8 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                 int8_weights)
             out = G.generate_speculative(
                 model, variables, draft, draft_vars, toks,
-                max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id)
+                max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id,
+                prefill_chunk=prefill_chunk)
         elif beams > 1:
             if temperature != 0.0 or top_k is not None \
                     or top_p is not None:
@@ -297,13 +301,15 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                     "--top-k or --top-p)")
             out = G.generate_beam(model, variables, toks,
                                   max_new_tokens=max_new_tokens,
-                                  num_beams=beams, eos_id=eos_id)
+                                  num_beams=beams, eos_id=eos_id,
+                                  prefill_chunk=prefill_chunk)
         else:
             out = G.generate(model, variables, toks,
                              max_new_tokens=max_new_tokens,
                              temperature=temperature, top_k=top_k,
                              top_p=top_p, eos_id=eos_id,
-                             rng=jax.random.PRNGKey(seed))
+                             rng=jax.random.PRNGKey(seed),
+                             prefill_chunk=prefill_chunk)
     except ValueError as e:
         # Library-level validation (max_position overflow, top_p
         # range, ...) — surface as a clean CLI error, not a traceback.
@@ -352,11 +358,13 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         jax.config.update("jax_platforms", "cpu")
     from polyaxon_tpu.serving import ModelServer, make_server
 
-    model, variables = _build_serving_model(
-        model_name, 1, checkpoint, int8_kv, int8_weights)
     if draft_checkpoint and not draft_model:
+        # pre-checkable usage error: fail before paying the full
+        # target build (checkpoint restore can take minutes)
         raise click.ClickException(
             "--draft-checkpoint requires --draft-model")
+    model, variables = _build_serving_model(
+        model_name, 1, checkpoint, int8_kv, int8_weights)
     draft = draft_vars = None
     if draft_model:
         draft, draft_vars = _build_serving_model(

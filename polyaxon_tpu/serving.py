@@ -15,7 +15,7 @@ Endpoints:
 - ``POST /generate`` -> ``{"prompt": [ids] | [[ids], ...],
   "max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p,
   "eos_id": e, "num_beams": B, "speculative": bool, "spec_k": K,
-  "seed": s}`` -> tokens + timing (speculative needs a server-side
+  "seed": s, "prefill_chunk": C}`` -> tokens + timing (speculative needs a server-side
   draft model and is greedy-only)
 
 Shape discipline: each distinct (batch, prompt_len, max_new_tokens,
@@ -77,22 +77,22 @@ class ModelServer:
         if key in self._fns:
             self._fns.move_to_end(key)
             return self._fns[key]
-        kind, b, p_len, new, temp, top_k, top_p, eos, beams = key
+        kind, b, p_len, new, temp, top_k, top_p, eos, beams, chunk = key
         if kind == "beam":
             fn = jax.jit(lambda toks, rng: G.generate_beam(
                 self.model, self.variables, toks, max_new_tokens=new,
-                num_beams=beams, eos_id=eos))
+                num_beams=beams, eos_id=eos, prefill_chunk=chunk))
         elif kind == "spec":
             k = beams  # slot reused for the draft length
             fn = jax.jit(lambda toks, rng: G.generate_speculative(
                 self.model, self.variables, self.draft_model,
                 self.draft_variables, toks, max_new_tokens=new,
-                k=k, eos_id=eos))
+                k=k, eos_id=eos, prefill_chunk=chunk))
         else:
             fn = jax.jit(lambda toks, rng: G.generate(
                 self.model, self.variables, toks, max_new_tokens=new,
                 temperature=temp, top_k=top_k, top_p=top_p,
-                eos_id=eos, rng=rng))
+                eos_id=eos, rng=rng, prefill_chunk=chunk))
         self._fns[key] = fn
         if len(self._fns) > self._fn_cap:
             self._fns.popitem(last=False)  # evict least-recently-used
@@ -173,32 +173,56 @@ class ModelServer:
                 raise ValueError("spec_k must be an int")
             if spec_k < 1:
                 raise ValueError("spec_k must be >= 1")
+        chunk = req.get("prefill_chunk")
+        try:
+            chunk = None if chunk is None else int(chunk)
+        except (TypeError, ValueError):
+            raise ValueError("prefill_chunk must be an int")
+        if chunk is not None and chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        p_len0 = lens[0]
+        if chunk is not None and chunk >= p_len0:
+            # a chunk covering the whole prompt IS the single-forward
+            # program — normalize so identical programs share one
+            # compile-cache slot
+            chunk = None
 
         p_len = lens[0]
-        cfg = getattr(self.model, "cfg", None)
-        max_pos = getattr(cfg, "max_position", None)
+        # Capacity checks for EVERY model a request will touch, so
+        # doomed requests fail in this cheap validation layer instead
+        # of inside the locked device section at jit-trace time.
         # Speculative rounds touch k-1 positions past the last
-        # committed token (generate_speculative's capacity guard) —
-        # include the slack here so near-limit requests fail in this
-        # cheap validation layer, not inside the locked device
-        # section at trace time.
+        # committed token (generate_speculative's guards).
         slack = (spec_k - 1) if speculative else 0
-        if max_pos is not None and \
-                not getattr(cfg, "kv_cache_ring", False) and \
-                p_len + new + slack > max_pos:
-            raise ValueError(
-                f"prompt ({p_len}) + max_new_tokens ({new})"
-                + (f" + spec_k-1 ({slack})" if slack else "")
-                + f" exceeds max_position ({max_pos})")
+        models = [("model", self.model)]
+        if speculative:
+            models.append(("draft model", self.draft_model))
+        for label, m in models:
+            cfg = getattr(m, "cfg", None)
+            max_pos = getattr(cfg, "max_position", None)
+            if getattr(cfg, "kv_cache_ring", False):
+                ring_slack = getattr(cfg, "kv_cache_ring_slack", 0)
+                if speculative and ring_slack < spec_k - 1:
+                    raise ValueError(
+                        f"{label} needs kv_cache_ring_slack >= "
+                        f"{spec_k - 1} for spec_k={spec_k} "
+                        f"(got {ring_slack})")
+                continue  # ring caches are position-keyed, unbounded
+            if max_pos is not None and p_len + new + slack > max_pos:
+                raise ValueError(
+                    f"prompt ({p_len}) + max_new_tokens ({new})"
+                    + (f" + spec_k-1 ({slack})" if slack else "")
+                    + f" exceeds the {label}'s max_position "
+                    f"({max_pos})")
         toks = np.asarray(rows, np.int32)
 
         if speculative:
             # last slot carries the draft length (see _fn)
             key = ("spec", len(rows), p_len, new, 0.0, None, None,
-                   eos, spec_k)
+                   eos, spec_k, chunk)
         else:
             key = ("beam" if beams > 1 else "sample", len(rows), p_len,
-                   new, temp, top_k, top_p, eos, beams)
+                   new, temp, top_k, top_p, eos, beams, chunk)
         t0 = time.perf_counter()
         with self._lock:  # one chip: serialize device work
             import jax.random as jrandom

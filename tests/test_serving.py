@@ -118,6 +118,17 @@ class TestServer:
                            "spec_k": 3})
         assert got["new_tokens"] == want["new_tokens"]
 
+    def test_prefill_chunk_matches_unchunked(self, server):
+        base, _, _ = server
+        want = _post(base, {"prompt": [5, 6, 7, 8, 9, 1, 2, 3],
+                            "max_new_tokens": 4})
+        got = _post(base, {"prompt": [5, 6, 7, 8, 9, 1, 2, 3],
+                           "max_new_tokens": 4, "prefill_chunk": 3})
+        assert got["new_tokens"] == want["new_tokens"]
+        bad = _post(base, {"prompt": [1, 2], "prefill_chunk": 0},
+                    expect=400)
+        assert "prefill_chunk" in bad["error"]
+
     def test_speculative_rejects_sampling(self, server):
         base, _, _ = server
         out = _post(base, {"prompt": [1, 2], "speculative": True,
