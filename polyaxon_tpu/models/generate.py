@@ -7,10 +7,13 @@ the whole generation, no per-token dispatch — with the per-layer KV
 cache living in the model's flax "cache" collection (stacked [layers,
 ...] by ``scan_stack``, so it shards the same way the params do).
 
-Prefill is CHUNKED: one forward over the whole prompt fills every
-layer's cache (the causal-append mask handles S > 1), then the scan
-generates token by token.  For the zoo's decode-capable models this is
-compile-once and bandwidth-bound — the right shape for TPU decode.
+Prefill runs ONE forward over the whole prompt (the causal-append
+mask handles S > 1) — or fixed-size pieces via ``prefill_chunk`` to
+bound long-prompt activation memory — then the scan generates token by
+token.  Serving options compose across every entry point: int8 weights
+(ops/quant), int8 KV cache, ring caches for sliding-window streaming,
+speculative drafts, beam search.  Compile-once and bandwidth-bound —
+the right shape for TPU decode.
 """
 
 from __future__ import annotations

@@ -1,8 +1,13 @@
-"""Decode-time KV cache shared by the zoo's non-RoPE decoders.
+"""Decode-time KV cache shared by the zoo's decoders.
 
-One helper owns the flax cache-variable dance (GPT-2 and MoE-GPT
-attention are identical here; Llama keeps its own copy because RoPE
-must rotate k at the cache position BEFORE the append).
+One helper owns the flax cache-variable dance for GPT-2, MoE-GPT,
+Llama (its RoPE rotation happens inside the append via ``rotate``),
+and T5's decoder self-attention.  Two storage disciplines:
+
+- :func:`append_kv_cache` — the standard O(max_position) cache, with
+  optional int8 storage (``quantize=True``).
+- :func:`append_ring_kv_cache` — O(window) position-keyed ring for
+  sliding-window models; sessions stream past max_position.
 """
 
 from __future__ import annotations
