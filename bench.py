@@ -770,6 +770,12 @@ def main() -> int:
         help="--all on an accelerator: wall-clock budget per model "
              "subprocess; a hung model is abandoned, not killed.")
     parser.add_argument(
+        "--append", action="store_true",
+        help="Append the result row(s) to benchmarks/results.jsonl even "
+             "without --all — lets a sweep's single-model headline "
+             "replay land driver-visible evidence (last_tpu_row reads "
+             "headline rows only).")
+    parser.add_argument(
         "--require-accel", action="store_true",
         help="Exit (with a skip JSON line) instead of benching if the "
              "accelerator probe falls back to CPU — for sweep legs "
@@ -823,6 +829,14 @@ def main() -> int:
         if r and args.row_file:
             with open(args.row_file, "w") as f:
                 json.dump({"bench": "decode", **r}, f)
+        if r and args.append:
+            # Decode rows carry their own bench tag (not "headline"):
+            # append directly rather than through _append_results.
+            out = os.path.join(os.path.dirname(__file__) or ".",
+                               "benchmarks", "results.jsonl")
+            with open(out, "a") as f:
+                f.write(json.dumps({"bench": "decode",
+                                    "ts": time.time(), **r}) + "\n")
         print(json.dumps({"metric": "decode bench", "value":
                           (r or {}).get("tok_per_sec_per_chip", 0),
                           "unit": "tok/sec/chip", "vs_baseline": None,
@@ -928,7 +942,7 @@ def main() -> int:
                 with open(args.row_file, "w") as f:
                     json.dump(r, f)
 
-    if args.all:
+    if args.all or args.append:
         _append_results(results)
 
     emit(results[0] if results else None, fallback)

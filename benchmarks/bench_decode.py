@@ -144,6 +144,43 @@ def bench_decode(jax, model_name: str, backend: str):
         qkv_s = timed(gen_qkv, prompt)
         tok_per_sec_int8_kv = batch * new_toks / qkv_s
 
+    # Speculative decoding A/B (models/generate.generate_speculative):
+    # tokens are pinned bit-identical to greedy, so the only question
+    # hardware can answer is the SCHEDULE's cost.  Two honest numbers:
+    # - spec_speedup_draft: gpt2-small draft with random weights —
+    #   acceptance is chance-level, so this measures pure round
+    #   overhead (realistic lower bound for an untrained pair).
+    # - spec_speedup_full_accept: the target drafting for itself —
+    #   every proposal verifies, so each round commits k tokens; this
+    #   is the committed-schedule win at full acceptance (with a draft
+    #   as expensive as the target, i.e. a conservative ceiling — a
+    #   real 4x-smaller trained draft sits between the two).
+    spec_fields = {}
+    if model_name == "gpt2-medium" and not seq2seq:
+        from polyaxon_tpu.models.generate import generate_speculative
+
+        draft_spec = get_model("gpt2-small")
+        draft_model, draft_vars = draft_spec.init_params(batch_size=1)
+        k = 4
+        gen_sp = jax.jit(lambda p: generate_speculative(
+            model, variables, draft_model, draft_vars, p,
+            max_new_tokens=new_toks, k=k))
+        sp_s = timed(gen_sp, prompt)
+        gen_self = jax.jit(lambda p: generate_speculative(
+            model, variables, model, variables, p,
+            max_new_tokens=new_toks, k=k))
+        self_s = timed(gen_self, prompt)
+        spec_fields = {
+            "spec_k": k,
+            "spec_draft": "gpt2-small",
+            "spec_tok_per_sec_draft":
+                round(batch * new_toks / sp_s, 1),
+            "spec_speedup_draft": round(total_s / sp_s, 3),
+            "spec_tok_per_sec_full_accept":
+                round(batch * new_toks / self_s, 1),
+            "spec_speedup_full_accept": round(total_s / self_s, 3),
+        }
+
     # TTFT = prefill + first sampled token (max_new_tokens=1).
     ttft = {}
     for L in ttft_lens:
@@ -179,6 +216,7 @@ def bench_decode(jax, model_name: str, backend: str):
         "ttft_ratio": round(ratio, 2),
         "ttft_len_ratio": round(l_big / l_small, 2),
         "ttft_sublinear": bool(ratio < l_big / l_small),
+        **spec_fields,
     }
 
 

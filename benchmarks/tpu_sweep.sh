@@ -1,33 +1,44 @@
 #!/bin/bash
 # TPU sweep run by tunnel_watch.py the moment the tunnel answers.
 #
-# Round-4 state after the second window: headline rows (resnet50 /
-# gpt2-medium / bert-base / tinyllama-1.1b) are DONE, and the resnet50
-# MFU sweep landed 5 of 9 variant rows (b128/256/512 base, b256
-# sgd-nomom, b256 bn-bf16 0.3153) before the 512:bn-bf16 leg overran
-# the sweep timeout and the kill wedged the tunnel.  This script
-# carries only the still-missing evidence, value-per-minute order
-# (short windows: cheap high-value probes first, hang-prone giant
-# compiles last):
-#   1. roofline probe — measured HBM BW + MXU TFLOP/s -> tightens the
-#                       MFU ceiling analysis in docs/SCALING.md §2b.
-#   2. decode/serving rows — tok/sec + KV-bytes + TTFT + the NEW
-#                       int8-weight and int8-KV A/Bs (no decode row
-#                       has EVER landed on hardware).
-#   3. windowed A/B   — O(W) remap vs no-remap at seq 8k / window 1k.
-#   4. resnet50 MFU remainder — the 4 unmeasured variants (512-batch
-#                       bn-bf16/nomom and the s2d stems), the leg that
-#                       overran last window.
-#   5. gpt2-medium MFU sweep — remat x batch (biggest compiles, last).
+# Round-5 ordering rule (VERDICT r4 next-1): the DRIVER-VISIBLE
+# headline replay runs FIRST in every window — BENCH_r04 shipped a
+# stale last_tpu row because the committed best config (resnet50
+# bn-bf16 b256, MFU 0.3153) was only ever measured as a sweep row and
+# the window died before a headline-class row existed.  Leg 1 replays
+# the recorded baseline config via bench.py (which reads
+# .bench_baseline.json) and appends a {"bench": "headline"} row
+# (--append), so even a 10-minute window leaves last_tpu_row() telling
+# the truth.  After that, value-per-minute order over the evidence
+# that has NEVER landed on hardware:
+#   2. decode/serving rows — tok/sec, TTFT, int8-weight, int8-KV,
+#      ring-cache, and the NEW speculative A/Bs (zero TPU decode rows
+#      exist).
+#   3. gpt2-medium remat x batch MFU sweep — the committed plan for
+#      pushing the transformer headline toward 0.45 (banks the best
+#      config into .bench_baseline.json as it goes).
+#   4. gpt2-medium headline replay — converts the sweep's banked best
+#      config into a driver-visible headline row.
+#   5. roofline probe — measured HBM BW + MXU TFLOP/s for the MFU
+#      ceiling analysis (docs/SCALING.md §2b).
+#   6. serving load bench — concurrent-client p50/p99 + aggregate
+#      tok/sec through the HTTP server (continuous batching A/B).
+#   7. windowed A/B — O(W) remap vs no-remap at seq 8k / window 1k.
+#   8. resnet50 MFU remainder — the 4 unmeasured variants.
 set -x
 cd "$(dirname "$0")/.."
 
+timeout 1500 python bench.py --model resnet50 --require-accel --append \
+    --probe-budget 300 || true
+timeout 3000 python benchmarks/bench_decode.py || true
+timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
+timeout 1500 python bench.py --model gpt2-medium --require-accel --append \
+    --probe-budget 180 || true
 timeout 1200 python benchmarks/bench_roofline_probe.py || true
-timeout 2400 python benchmarks/bench_decode.py || true
+timeout 1800 python benchmarks/bench_serving_load.py || true
 timeout 2400 python benchmarks/bench_windowed.py || true
 timeout 3600 python benchmarks/bench_resnet_mfu.py \
     --only "512:bn-bf16,512:bn-bf16+nomom,256:s2d-stem,512:s2d-stem+bn-bf16" \
     || true
-timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
 
 echo "SWEEP COMPLETE $(date)"

@@ -95,7 +95,7 @@ def run_sweep() -> None:
         ["pgrep", "-f",
          r"bash.*tpu_sweep\.sh|python.*(bench\.py|bench_gpt2_mfu"
          r"|bench_resnet_mfu|bench_roofline_probe|bench_decode"
-         r"|bench_windowed|bench_offline_v5e)"],
+         r"|bench_windowed|bench_serving_load|bench_offline_v5e)"],
         capture_output=True, text=True)
     others = [p for p in ext.stdout.split()
               if p.isdigit() and int(p) != os.getpid()]
