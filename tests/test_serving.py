@@ -152,3 +152,168 @@ class TestServer:
         base, _, _ = server
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(base + "/nope", timeout=10)
+
+    def test_boolean_tokens_rejected(self, server):
+        base, _, _ = server
+        out = _post(base, {"prompt": [True, False]}, expect=400)
+        assert "integer token ids" in out["error"]
+
+    def test_boolean_scalar_params_rejected(self, server):
+        base, _, _ = server
+        for field in ("max_new_tokens", "num_beams", "top_k", "seed",
+                      "temperature", "top_p"):
+            out = _post(base, {"prompt": [1, 2], field: True},
+                        expect=400)
+            assert "error" in out, field
+        # null where an int is required is a 400, not a 500
+        out = _post(base, {"prompt": [1, 2], "max_new_tokens": None},
+                    expect=400)
+        assert "error" in out
+
+
+class TestCoalescing:
+    """Request coalescing (serving.py module docstring): concurrent
+    greedy requests merge into one device batch, bit-identical to solo
+    execution."""
+
+    def _servers(self):
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        return ModelServer(model, variables, max_batch=8)
+
+    def test_forced_coalesce_matches_solo(self):
+        ms = self._servers()
+        prompts = [[3, 1, 4, 1], [2, 7, 1, 8], [9, 9, 2, 6]]
+        # Solo references (also pre-warms the b=1 compile; the merged
+        # n=3 batch pads to bucket 4 — a different program).
+        refs = [ms.generate({"prompt": p, "max_new_tokens": 5})
+                for p in prompts]
+        results = [None] * len(prompts)
+
+        def go(i):
+            results[i] = ms.generate({"prompt": prompts[i],
+                                      "max_new_tokens": 5})
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(prompts))]
+        # Hold the device lock so every worker ENQUEUES before any can
+        # lead — guarantees one merged batch instead of racing on
+        # thread-start timing.
+        with ms._lock:
+            for t in threads:
+                t.start()
+            deadline = 50
+            while deadline > 0 and sum(
+                    len(q) for q in ms._pending.values()) < len(prompts):
+                threading.Event().wait(0.1)
+                deadline -= 1
+            assert sum(len(q) for q in ms._pending.values()) \
+                == len(prompts)
+        for t in threads:
+            t.join(timeout=120)
+        assert ms.coalesced_batches == 1
+        assert ms.coalesced_requests == len(prompts)
+        for got, ref in zip(results, refs):
+            assert got["new_tokens"] == ref["new_tokens"]
+
+    def test_mixed_shapes_coalesce_per_key(self):
+        """Different (p_len, new) requests queue under different keys;
+        a leader only merges its own key's queue."""
+        ms = self._servers()
+        a_ref = ms.generate({"prompt": [1, 2, 3], "max_new_tokens": 4})
+        b_ref = ms.generate({"prompt": [5, 6], "max_new_tokens": 3})
+        results = {}
+
+        def go(name, payload):
+            results[name] = ms.generate(payload)
+
+        threads = [
+            threading.Thread(target=go, args=(
+                "a", {"prompt": [1, 2, 3], "max_new_tokens": 4})),
+            threading.Thread(target=go, args=(
+                "b", {"prompt": [5, 6], "max_new_tokens": 3})),
+        ]
+        with ms._lock:
+            for t in threads:
+                t.start()
+            deadline = 50
+            while deadline > 0 and sum(
+                    len(q) for q in ms._pending.values()) < 2:
+                threading.Event().wait(0.1)
+                deadline -= 1
+        for t in threads:
+            t.join(timeout=120)
+        assert results["a"]["new_tokens"] == a_ref["new_tokens"]
+        assert results["b"]["new_tokens"] == b_ref["new_tokens"]
+        # two keys -> two solo-sized batches, nothing merged
+        assert ms.coalesced_batches == 0
+
+    def test_multirow_requests_merge_within_cap(self):
+        """A 2-row and a 1-row request merge (3 rows, bucket 4); a
+        request that would overflow max_batch waits for the next
+        leader round instead of being dropped."""
+        ms = self._servers()
+        ms.max_batch = 4
+        p2 = [[1, 2, 3], [4, 5, 6]]
+        p1 = [7, 8, 9]
+        ref2 = ms.generate({"prompt": p2, "max_new_tokens": 4})
+        ref1 = ms.generate({"prompt": p1, "max_new_tokens": 4})
+        big = [[i, i + 1, i + 2] for i in range(4)]  # fills the cap
+        ref_big = ms.generate({"prompt": big, "max_new_tokens": 4})
+        results = {}
+
+        def go(name, payload):
+            results[name] = ms.generate(payload)
+
+        threads = [
+            threading.Thread(target=go, args=(
+                "two", {"prompt": p2, "max_new_tokens": 4})),
+            threading.Thread(target=go, args=(
+                "one", {"prompt": p1, "max_new_tokens": 4})),
+            threading.Thread(target=go, args=(
+                "big", {"prompt": big, "max_new_tokens": 4})),
+        ]
+        with ms._lock:
+            for t in threads:
+                t.start()
+            deadline = 50
+            while deadline > 0 and sum(
+                    len(q) for q in ms._pending.values()) < 3:
+                threading.Event().wait(0.1)
+                deadline -= 1
+        for t in threads:
+            t.join(timeout=180)
+        assert results["two"]["new_tokens"] == ref2["new_tokens"]
+        assert results["one"]["new_tokens"] == ref1["new_tokens"]
+        assert results["big"]["new_tokens"] == ref_big["new_tokens"]
+
+    def test_http_concurrent_greedy(self, server):
+        """End-to-end over HTTP: concurrent same-shape greedy clients
+        all get the same answer as a solo request."""
+        base, _, _ = server
+        solo = _post(base, {"prompt": [4, 4, 4, 4],
+                            "max_new_tokens": 5})
+        results = [None] * 4
+
+        def go(i):
+            results[i] = _post(base, {"prompt": [4, 4, 4, 4],
+                                      "max_new_tokens": 5})
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for r in results:
+            assert r["new_tokens"] == solo["new_tokens"]
+
+
+class TestRingBeamValidation:
+    def test_beam_on_ring_cache_is_400(self):
+        spec = get_model("mistral-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        ring = spec.make_model(kv_cache_ring=True)
+        ms = ModelServer(ring, variables)
+        with pytest.raises(ValueError, match="ring-cache"):
+            ms.generate({"prompt": [1, 2, 3], "num_beams": 2})
