@@ -40,6 +40,15 @@ SHAPES = {
     "gpt2-medium": (64, 64),
     "gpt2-tiny": (16, 16),
 }
+DEFAULT_SHAPE = (64, 64)
+
+
+def _post(base: str, payload, timeout: float = 600):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
 
 
 def percentile(xs, p):
@@ -63,16 +72,11 @@ def run_load(base: str, *, clients: int, requests: int, p_len: int,
     errors = []
 
     def client(i):
-        body = json.dumps({"prompt": prompts[i],
-                           "max_new_tokens": new}).encode()
+        payload = {"prompt": prompts[i], "max_new_tokens": new}
         for _ in range(requests):
             t0 = time.perf_counter()
             try:
-                req = urllib.request.Request(
-                    base + "/generate", data=body,
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=600) as r:
-                    json.loads(r.read())
+                _post(base, payload)
             except Exception as e:  # noqa: BLE001 - record, don't die
                 errors.append(f"{type(e).__name__}: {e}")
                 return
@@ -97,7 +101,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
     from polyaxon_tpu.models.registry import get_model
     from polyaxon_tpu.serving import ModelServer, make_server
 
-    p_len, new = SHAPES[model_name]
+    p_len, new = SHAPES.get(model_name, DEFAULT_SHAPE)
     spec = get_model(model_name)
     model, variables = spec.init_params(batch_size=1)
     vocab = model.cfg.vocab_size
@@ -117,26 +121,15 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
             # produce — load latencies must measure decode, not XLA.
             warm = np.random.RandomState(1).randint(
                 0, vocab, size=p_len).tolist()
-            body = json.dumps({"prompt": warm,
-                               "max_new_tokens": new}).encode()
-            req = urllib.request.Request(
-                base + "/generate", data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=900) as r:
-                r.read()
+            _post(base, {"prompt": warm, "max_new_tokens": new},
+                  timeout=900)
             if coalesce:
                 b = 1
                 while b < max(client_counts):
                     b *= 2
                     batch = [warm] * min(b, max(client_counts))
-                    body = json.dumps(
-                        {"prompt": batch,
-                         "max_new_tokens": new}).encode()
-                    req = urllib.request.Request(
-                        base + "/generate", data=body,
-                        headers={"Content-Type": "application/json"})
-                    with urllib.request.urlopen(req, timeout=900) as r:
-                        r.read()
+                    _post(base, {"prompt": batch,
+                                 "max_new_tokens": new}, timeout=900)
 
             for n in client_counts:
                 # Counters are cumulative over the server's life:
@@ -173,6 +166,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                       file=sys.stderr)
         finally:
             srv.shutdown()
+            srv.server_close()  # release the listening socket too
     return {
         "model": model_name,
         "backend": backend,

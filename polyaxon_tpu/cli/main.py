@@ -191,9 +191,14 @@ def _build_serving_model(name: str, batch_size: int,
             model, variables = spec.init_params(
                 batch_size=batch_size, **kw)
     except TypeError:
-        # mlp/convnet-style models take no such config field.
-        raise click.ClickException(
-            f"{name} has no int8 KV cache support")
+        if kw:
+            # mlp/convnet-style models take no such config field.
+            raise click.ClickException(
+                f"{name} has no int8 KV cache support")
+        # No config kwarg was passed, so the TypeError is a real bug
+        # inside model construction — masking it as a quantization
+        # message would point the user at the wrong flag.
+        raise
     if ckpt_dir:
         from polyaxon_tpu.checkpoint import CheckpointManager
 
@@ -310,9 +315,10 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                              top_p=top_p, eos_id=eos_id,
                              rng=jax.random.PRNGKey(seed),
                              prefill_chunk=prefill_chunk)
-    except ValueError as e:
+    except (ValueError, NotImplementedError) as e:
         # Library-level validation (max_position overflow, top_p
-        # range, ...) — surface as a clean CLI error, not a traceback.
+        # range, unsupported mode combinations like beam-on-ring) —
+        # surface as a clean CLI error, not a traceback.
         raise click.ClickException(str(e))
     out = np.asarray(jax.device_get(out))
     dt = _time.perf_counter() - t0

@@ -244,25 +244,44 @@ class Run:
                 "POLYAXON_TPU_ENV_PROBE_TIMEOUT", "30"))
             probed: dict = {}
             timed_out = threading.Event()
+            # One lock makes store+late-check atomic against the main
+            # thread's check+set: without it the probe could store its
+            # result after the main thread's `"backend" not in probed`
+            # but read timed_out before it's set — neither the main
+            # record nor the correction event would carry the probed
+            # backend.
+            probe_lock = threading.Lock()
+            # The correction event shares the main record's key, so a
+            # latest-wins consumer needs the correction APPENDED AFTER
+            # the main record — the probe waits for this before
+            # correcting (the lock alone orders the decision, not the
+            # two writer.add calls).
+            main_recorded = threading.Event()
 
             def probe():
                 # Guarded: an exception on this daemon thread would
                 # escape to threading.excepthook and spam stderr on
                 # every init (the old inline call degraded silently).
                 try:
-                    probed["backend"] = jax.default_backend()
-                    probed["devices"] = jax.device_count()
+                    backend = jax.default_backend()
+                    devices = jax.device_count()
                 except Exception:
                     return
-                if timed_out.is_set():
-                    # Late but successful: correct the record.
+                with probe_lock:
+                    probed["backend"] = backend
+                    probed["devices"] = devices
+                    late = timed_out.is_set()
+                if late:
+                    # Late but successful: correct the record — after
+                    # the stale main record is in the stream.
+                    main_recorded.wait(timeout=60)
                     try:
                         self._writer.add(
                             EventKind.ENV, "env" + self._suffix,
                             make_event(EventKind.ENV, value={
                                 **env,
-                                "jax_backend": probed["backend"],
-                                "jax_device_count": probed["devices"],
+                                "jax_backend": backend,
+                                "jax_device_count": devices,
                                 "late_probe": True,
                             }))
                     except Exception:
@@ -271,15 +290,20 @@ class Run:
             t = threading.Thread(target=probe, daemon=True)
             t.start()
             t.join(timeout=timeout)
-            if "backend" not in probed:
-                timed_out.set()
-            env["jax_backend"] = probed.get("backend", "unavailable")
-            if "devices" in probed:
-                env["jax_device_count"] = probed["devices"]
+            with probe_lock:
+                if "backend" not in probed:
+                    timed_out.set()
+                env["jax_backend"] = probed.get("backend",
+                                                "unavailable")
+                if "devices" in probed:
+                    env["jax_device_count"] = probed["devices"]
+            release_correction = main_recorded.set
         except Exception:
-            pass
+            release_correction = None
         self._writer.add(EventKind.ENV, "env" + self._suffix,
                          make_event(EventKind.ENV, value=env))
+        if release_correction is not None:
+            release_correction()
 
     def _log_system_metric(self, name: str, value: float,
                            timestamp: float) -> None:
