@@ -306,7 +306,7 @@ def bench_model(jax, model_name: str, batch_size: int, steps: int,
     from polyaxon_tpu.models.registry import get_model
 
     spec = get_model(model_name)
-    model, mesh, step, state, batch, rng = _setup_step(
+    _, mesh, step, state, batch, rng = _setup_step(
         jax, spec, batch_size, overrides, optimizer)
     n_chips = mesh.devices.size
 

@@ -37,7 +37,25 @@ _COMPAT_ROLES = {
     RunKind.XGBOOSTJOB: ("master", ("worker",)),
     RunKind.RAYJOB: ("head", ("worker",)),
     RunKind.DASKJOB: ("scheduler", ("job", "worker")),
+    RunKind.MXNETJOB: ("scheduler", ("worker",)),
 }
+
+# Roles with no TPU analogue, per kind: parameter-server topologies
+# dissolve into XLA collectives.
+_COMPAT_REJECT = {
+    RunKind.TFJOB: ("ps", "evaluator"),
+    RunKind.MXNETJOB: ("server",),
+}
+
+
+def _reject_roles(run: Any, kind: str) -> None:
+    for bad in _COMPAT_REJECT.get(kind, ()):
+        rep = getattr(run, bad, None)
+        if rep is not None and _nonzero(rep) > 0:
+            raise TopologyError(
+                f"{kind} role {bad!r} has no TPU analogue (parameter "
+                "servers are not used with XLA collectives); set its "
+                "replicas to 0 or use collective training")
 
 
 @dataclass
@@ -129,14 +147,7 @@ def normalize(run: Any) -> ProcessTopology:
         return ProcessTopology(kind=RunKind.TPUJOB, slice=slice_spec, groups=groups)
 
     if isinstance(run, V1TFJob) or kind == RunKind.TFJOB:
-        for bad in ("ps", "evaluator"):
-            rep = getattr(run, bad, None)
-            if rep is not None and _nonzero(rep) > 0:
-                raise TopologyError(
-                    f"tfjob role {bad!r} has no TPU analogue (parameter "
-                    "servers are not used with XLA collectives); set its "
-                    "replicas to 0 or use collective training"
-                )
+        _reject_roles(run, RunKind.TFJOB)
         groups = []
         if run.chief and _nonzero(run.chief):
             groups.append(ReplicaGroup("chief", _nonzero(run.chief), run.chief))
@@ -157,6 +168,7 @@ def normalize(run: Any) -> ProcessTopology:
         return ProcessTopology(kind=RunKind.MPIJOB, slice=slice_spec, groups=groups)
 
     if kind in _COMPAT_ROLES:
+        _reject_roles(run, kind)
         primary_role, secondary_roles = _COMPAT_ROLES[kind]
         groups = []
         for role in (primary_role,) + tuple(secondary_roles):

@@ -13,7 +13,8 @@ Parity with the reference's runtime union (SURVEY.md 2.4/2.5; expected at
   the reference's replica vocabulary (chief/worker/ps, master/worker,
   launcher/worker), normalized onto TPU replica topology so existing
   polyaxonfiles run unchanged on TPU (BASELINE configs 2/3/5).
-- ``V1PaddleJob`` / ``V1XGBoostJob`` / ``V1RayJob`` / ``V1DaskJob`` —
+- ``V1PaddleJob`` / ``V1XGBoostJob`` / ``V1RayJob`` / ``V1DaskJob`` /
+  ``V1MXNetJob`` —
   later-version reference kinds (SURVEY 2.5 long tail), same
   normalization: primary role (master/head/scheduler) is process 0.
 - ``V1TunerJob`` / ``V1NotifierJob`` / ``V1CleanerJob`` — auxiliary kinds.
@@ -44,12 +45,13 @@ class RunKind:
     XGBOOSTJOB = "xgboostjob"
     RAYJOB = "rayjob"
     DASKJOB = "daskjob"
+    MXNETJOB = "mxnetjob"
     TUNER = "tuner"
     NOTIFIER = "notifier"
     CLEANER = "cleaner"
 
     DISTRIBUTED = {TPUJOB, TFJOB, PYTORCHJOB, MPIJOB,
-                   PADDLEJOB, XGBOOSTJOB, RAYJOB, DASKJOB}
+                   PADDLEJOB, XGBOOSTJOB, RAYJOB, DASKJOB, MXNETJOB}
 
 
 class V1Job(BaseSchema):
@@ -283,6 +285,30 @@ class V1DaskJob(BaseSchema):
     worker: Optional[V1KFReplica] = None
 
 
+class V1MXNetJob(BaseSchema):
+    """Compatibility kind: reference ``V1MXJob`` (scheduler/server/worker,
+    SURVEY 2.5 long tail).
+
+    MXNet's KVStore topology collapses like tfjob's: ``server``
+    (parameter-server) replicas have no TPU analogue — gradients ride
+    XLA AllReduce on ICI — so the normalizer rejects them; the
+    ``scheduler`` maps to process 0 and workers join the SPMD gang.
+    ``tuner``/``tuner_tracker``/``tuner_server`` are accepted for
+    polyaxonfile compatibility (auto-tuning is the tuner subsystem's
+    job here) but take no processes."""
+
+    kind: Literal["mxnetjob"] = "mxnetjob"
+    clean_pod_policy: Optional[str] = None
+    scheduling_policy: Optional[Dict[str, Any]] = None
+    slice: Optional[V1SliceSpec] = None
+    scheduler: Optional[V1KFReplica] = None
+    server: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    tuner: Optional[V1KFReplica] = None
+    tuner_tracker: Optional[V1KFReplica] = None
+    tuner_server: Optional[V1KFReplica] = None
+
+
 # ---------------------------------------------------------------------------
 # DAG
 # ---------------------------------------------------------------------------
@@ -367,6 +393,7 @@ V1Runtime = Union[
     V1XGBoostJob,
     V1RayJob,
     V1DaskJob,
+    V1MXNetJob,
     V1TunerJob,
     V1NotifierJob,
     V1CleanerJob,
@@ -384,6 +411,7 @@ RUNTIME_BY_KIND = {
     RunKind.XGBOOSTJOB: V1XGBoostJob,
     RunKind.RAYJOB: V1RayJob,
     RunKind.DASKJOB: V1DaskJob,
+    RunKind.MXNETJOB: V1MXNetJob,
     RunKind.TUNER: V1TunerJob,
     RunKind.NOTIFIER: V1NotifierJob,
     RunKind.CLEANER: V1CleanerJob,

@@ -145,6 +145,8 @@ class TestRuntimeKinds:
                                 "worker": {"replicas": 3}}),
             "daskjob": ("scheduler", {"scheduler": {"replicas": 1},
                                       "worker": {"replicas": 3}}),
+            "mxnetjob": ("scheduler", {"scheduler": {"replicas": 1},
+                                       "worker": {"replicas": 3}}),
         }
         for kind, (primary, roles) in cases.items():
             rt = parse_runtime({"kind": kind, **roles})
@@ -201,6 +203,29 @@ class TestRuntimeKinds:
         topo = normalize(rt)
         assert [g.role for g in topo.groups] == [
             "scheduler", "job", "worker"]
+
+    def test_mxnetjob_server_role_rejected(self):
+        """MXNet KVStore parameter servers dissolve into XLA
+        collectives — same contract as tfjob's ps role."""
+        from polyaxon_tpu.compiler.topology import (TopologyError,
+                                                    normalize)
+
+        rt = parse_runtime({
+            "kind": "mxnetjob",
+            "scheduler": {"replicas": 1},
+            "server": {"replicas": 2},
+            "worker": {"replicas": 4},
+        })
+        with pytest.raises(TopologyError, match="no TPU analogue"):
+            normalize(rt)
+        # tuner roles are accepted surface but take no processes
+        topo = normalize(parse_runtime({
+            "kind": "mxnetjob",
+            "scheduler": {"replicas": 1},
+            "worker": {"replicas": 4},
+            "tunerTracker": {"replicas": 1},
+        }))
+        assert topo.num_processes == 5
 
     def test_compat_kind_requires_replicas(self):
         from polyaxon_tpu.compiler.topology import (TopologyError,

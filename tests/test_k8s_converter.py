@@ -246,6 +246,34 @@ run:
             f"ray1-head-0.ptpu-ray1-hs:{COORDINATOR_PORT}"
         assert head_env["PTPU_NUM_PROCESSES"] == "3"
 
+    def test_mxnetjob_compat_roles(self, tmp_path):
+        """mxnetjob (SURVEY 2.5 long tail): scheduler is process group
+        0 -> carries the coordinator; KVStore servers are rejected at
+        normalize time, before any manifest exists."""
+        yaml = """
+kind: component
+name: mx-trainer
+run:
+  kind: mxnetjob
+  slice: {type: v5litepod-8}
+  scheduler:
+    replicas: 1
+    container: {image: jax:latest}
+  worker:
+    replicas: 3
+    container: {image: jax:latest}
+"""
+        compiled = compile_yaml(tmp_path, yaml, run_uuid="mx1")
+        cr = convert(compiled, "mx1", "proj")
+        specs = cr["spec"]["replicaSpecs"]
+        assert set(specs) == {"scheduler", "worker"}
+        sched_env = {e["name"]: e.get("value")
+                     for e in specs["scheduler"]["template"]["spec"]
+                     ["containers"][0]["env"]}
+        assert sched_env["PTPU_COORDINATOR_ADDRESS"] == \
+            f"mx1-scheduler-0.ptpu-mx1-hs:{COORDINATOR_PORT}"
+        assert sched_env["PTPU_NUM_PROCESSES"] == "4"
+
     def test_headless_service(self, tmp_path):
         compiled = compile_yaml(tmp_path, TPUJOB_YAML, run_uuid="run42")
         cr = convert(compiled, "run42", "proj")
