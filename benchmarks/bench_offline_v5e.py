@@ -75,7 +75,46 @@ def compile_single_chip(jax, model_name, batch_size, overrides=None):
     return compiled, spec
 
 
-def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
+def bridge_scanned(jax, model_name, batch_size, overrides):
+    """Reconstruct full-depth XLA flops AND bytes for a scanned
+    transformer from unrolled L=1/L=2 deviceless compiles (the same
+    measured bridge as ``bench.reconcile_flops``; linearity pinned
+    <5% in tests/test_bench_baseline.py).  Returns
+    ``(flops, bytes)`` or ``(None, None)`` when the model has no
+    scanned stack to bridge.
+
+    The flash (pallas) attention kernel is invisible to the cost model
+    on this TPU-lowering path, so the reconstructed numbers cover the
+    DENSE work only: the caller adds the analytic attention flop term;
+    bytes stay dense-only, making t_memory a LOWER bound and the
+    roofline MFU ceiling correspondingly optimistic (recorded as such).
+    """
+    from polyaxon_tpu.models.registry import get_model
+
+    spec = get_model(model_name)
+    cfg = getattr(spec.make_model(**(overrides or {})), "cfg", None)
+    L = getattr(cfg, "num_layers", None)
+    if not L or not hasattr(cfg, "scan_layers"):
+        return None, None
+    ov = dict(overrides or {})
+    ov["scan_layers"] = False
+    probes = []
+    for depth in (1, 2):
+        compiled, _ = compile_single_chip(
+            jax, model_name, batch_size, {**ov, "num_layers": depth})
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        probes.append((float(cost.get("flops", 0.0)),
+                       float(cost.get("bytes accessed", 0.0))))
+    (f1, b1), (f2, b2) = probes
+    if not (f1 and f2 and b1 and b2):
+        return None, None
+    return f1 + (L - 1) * (f2 - f1), b1 + (L - 1) * (b2 - b1)
+
+
+def analyze(jax, model_name, batch_size, compiled, spec, variant=None,
+            overrides=None):
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
@@ -88,13 +127,32 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
     # (verified: gpt2-medium reports embed/head + exactly one layer),
     # so for scanned transformers both its flops AND its bytes miss
     # ~ (L-1)/L of the layer work — a roofline built on those bytes
-    # mislabels every scanned model "compute-bound".  Emit the roofline
-    # only when the XLA flop count corroborates the analytic one
-    # (within 2x) AND the cost model reported bytes at all — flops
-    # without bytes would yield t_memory=0 and a "compute-bound" label
-    # that never looked at memory; otherwise publish the
-    # (allocation-based, correct) memory_analysis numbers alone and
-    # say why.
+    # mislabels every scanned model "compute-bound".  Round 5: the
+    # measured L=1/L=2 unrolled bridge (bridge_scanned) REPAIRS both
+    # counts, so scanned models get a (dense-bytes lower-bound)
+    # roofline instead of "n/a"; the raw-count gate below still
+    # applies when the bridge can't run.
+    bridged = False
+    if analytic and xla_flops and not 0.5 <= xla_flops / analytic <= 2:
+        try:
+            bf, bb = bridge_scanned(jax, model_name, batch_size,
+                                    overrides)
+        except Exception as e:
+            print(f"#   bridge failed: {type(e).__name__}: "
+                  f"{str(e)[:120]}", file=sys.stderr)
+            bf = bb = None
+        if bf and bb:
+            # The bridged probes are dense-only (flash/pallas reports
+            # zero flops on this lowering path): add the analytic
+            # attention term back, mirroring bench.reconcile_flops.
+            from polyaxon_tpu.models.registry import get_model
+
+            mspec = get_model(model_name)
+            if mspec.attn_flops is not None:
+                cfg = getattr(mspec.make_model(**(overrides or {})),
+                              "cfg", None)
+                bf += mspec.attn_flops(batch_size, cfg)
+            xla_flops, xla_bytes, bridged = bf, bb, True
     cost_model_valid = bool(
         analytic and xla_flops and xla_bytes
         and 0.5 <= xla_flops / analytic <= 2.0)
@@ -104,6 +162,9 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
         invalid_reason = "n/a: cost model reported no bytes accessed"
     elif not (analytic and xla_flops):
         invalid_reason = "n/a: no analytic/xla flops to cross-check"
+    elif bridged:
+        invalid_reason = ("n/a: bridged count still disagrees with "
+                          "analytic by >2x — check the closed form")
     else:
         invalid_reason = ("n/a: xla cost model counts scan body once; "
                           "bytes not trustworthy")
@@ -122,6 +183,10 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None):
         "step_flops_analytic": analytic,
         "step_flops_xla": xla_flops,
         "hlo_bytes_accessed": xla_bytes,
+        # bridged: flops/bytes reconstructed from unrolled L=1/L=2
+        # probes (dense only — flash-attention bytes excluded, so
+        # t_memory is a lower bound and roofline_mfu_max optimistic).
+        "bridged": bridged,
         "peak_hbm_bytes": getattr(ma, "peak_memory_in_bytes", None),
         "argument_bytes": getattr(ma, "argument_size_in_bytes", None),
         "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
@@ -146,6 +211,15 @@ CONFIGS = [
     ("gpt2-medium", 4, None, None),
     ("bert-base", 16, None, None),
     ("tinyllama-1.1b", 2, None, None),
+    # Round-5 MFU push (VERDICT r4 next-2): predict the remat x batch
+    # frontier before burning a tunnel window on it.  dots_saveable
+    # keeps matmul outputs (cheap recompute of the elementwise chain);
+    # remat-full recomputes the whole block.
+    ("gpt2-medium", 8,
+     {"remat": True, "remat_policy": "dots_saveable"}, "remat-dots"),
+    ("gpt2-medium", 16,
+     {"remat": True, "remat_policy": "dots_saveable"}, "remat-dots"),
+    ("gpt2-medium", 8, {"remat": True}, "remat-full"),
 ]
 
 
@@ -178,7 +252,7 @@ def main() -> int:
             compiled, spec = compile_single_chip(jax, model_name, batch,
                                                  overrides)
             row = analyze(jax, model_name, batch, compiled, spec,
-                          variant)
+                          variant, overrides=overrides)
             row["compile_s"] = round(time.time() - t0, 1)
             rows.append(row)
             print(f"# {label}: roofline "

@@ -63,6 +63,59 @@ def pytest_runtest_teardown(item, nextitem):
         jax.config.update("jax_compilation_cache_dir", _JAX_CACHE_DIR)
 
 
+# -- fast tier (VERDICT r4 next-5) ------------------------------------
+#
+# The full suite takes ~25-35 min on a 1-CPU host; "suite green" must
+# stay cheap to falsify.  Modules dominated by JAX numerics (big
+# compiles, multi-process gangs, sanitizer builds) carry the `slow`
+# marker, auto-applied here so the tier lives in ONE place:
+#
+#   pytest -m "not slow" -q     # fast tier, < 5 min on 1 CPU
+#   pytest -q                   # full suite (CI parity)
+#
+# The fast tier keeps the orchestration surface — schemas, compiler,
+# scheduler/agent, kube transport, CLI, tracking, tuner, serving — so
+# a regression in the framework's control plane is caught in minutes;
+# the slow tier carries the numeric/parallel evidence.
+SLOW_MODULES = {
+    "test_bootstrap_multiprocess.py",  # real process gangs (~8 min)
+    "test_operator_chaos.py",          # ASan/TSan builds + chaos
+    "test_models.py",                  # big-compile numerics
+    "test_ring_flash.py",
+    "test_ring_kv_cache.py",
+    "test_pp_tp.py",
+    "test_parallel.py",
+    "test_spmd_layout.py",
+    "test_sp_integration.py",
+    "test_collective_overlap.py",
+    "test_moe_model.py",
+    "test_speculative.py",
+    "test_ops.py",
+    "test_chunked_prefill.py",
+    "test_sharded_decode.py",
+    "test_import_hf.py",
+    "test_mnist_example.py",
+    "test_preemption_resume.py",
+    "test_multislice.py",
+    "test_t5.py",
+    "test_llama.py",
+    "test_kv_int8.py",
+    "test_data.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: JAX-numeric / multi-process / sanitizer "
+        "tests excluded from the fast tier (pytest -m 'not slow')")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(item.fspath.strpath) in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture
 def tmp_home(tmp_path, monkeypatch):
     """Isolate user home/config so tests never touch ~/.polyaxon_tpu."""

@@ -84,7 +84,8 @@ TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
-def _run_procs(worker, n_procs, local_devices, extra_env=None):
+def _run_procs(worker, n_procs, local_devices, extra_env=None,
+               timeout=420):
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
@@ -110,7 +111,7 @@ def _run_procs(worker, n_procs, local_devices, extra_env=None):
     outputs = []
     try:
         for proc in procs:
-            out, _ = proc.communicate(timeout=420)
+            out, _ = proc.communicate(timeout=timeout)
             outputs.append(out)
     finally:
         # A wedged gang member (the hang class this harness exists to
@@ -390,6 +391,81 @@ def test_four_process_gang_ring_attention_crosses_processes():
     ref_out, = _run_procs(SP_RING_WORKER, n_procs=1, local_devices=8)
     ref_loss, ref_norm = _parse_result(ref_out)
     outputs = _run_procs(SP_RING_WORKER, n_procs=4, local_devices=2)
+    for out in outputs:
+        loss, norm = _parse_result(out)
+        assert abs(loss - ref_loss) < 5e-5 * max(1, abs(ref_loss)), \
+            (loss, ref_loss)
+        assert abs(norm - ref_norm) < 5e-5 * max(1, abs(ref_norm)), \
+            (norm, ref_norm)
+
+
+MULTISLICE_WORKER = textwrap.dedent("""
+    import os
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from polyaxon_tpu.parallel.bootstrap import initialize_from_env
+
+    n_procs = int(os.environ["PTPU_NUM_PROCESSES"])
+    topo = initialize_from_env(timeout_s=120)
+    assert jax.process_count() == n_procs, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from polyaxon_tpu.models.registry import get_model
+    from polyaxon_tpu.parallel import MeshSpec, build_mesh, make_train_step
+    from polyaxon_tpu.parallel.constraints import ambient_mesh
+
+    # The dryrun's 2-slice hybrid topology (__graft_entry__), now over
+    # a REAL 8-process gang with one device per process: dp=2 over
+    # num_slices=2 puts EVERY dp pair across the DCN (slice) boundary,
+    # and fsdp=4 spans four distinct processes inside each slice —
+    # the gradient allreduce is hierarchical (ICI reduce-scatter,
+    # DCN all-reduce, ICI all-gather) when slices are physical, and on
+    # this CPU gang it must still be NUMERICALLY identical to the
+    # 1-process run of the same program.
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=4, num_slices=2))
+    spec = get_model("gpt2-tiny")
+    model, params = spec.init_params(batch_size=2)
+    loss_fn = spec.loss_fn(model)
+    step = make_train_step(loss_fn, optax.sgd(0.1), mesh, donate=False)
+    state = step.init_state(params)
+    # batch divisible by dp x fsdp = 8
+    batch = {k: jnp.asarray(v) for k, v in spec.make_batch(8).items()}
+    batch = jax.device_put(batch, step.batch_sharding)
+
+    def lg(p, b):
+        (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b,
+                                                                None)
+        return l, optax.global_norm(g)
+
+    with ambient_mesh(mesh):
+        l, n = jax.jit(lg)(state["params"], batch)
+    assert np.isfinite(float(l)) and np.isfinite(float(n))
+    # ...and one real optimizer step must execute across the gang.
+    state, metrics = step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+    print(f"RESULT slices=2 LOSS={float(l):.8f} NORM={float(n):.8f}",
+          flush=True)
+""")
+
+
+def test_eight_process_two_slice_gang_dp_over_dcn():
+    """VERDICT r4 next-6: 8 REAL processes forming the dryrun's 2-slice
+    hybrid mesh (dp=2 x fsdp=4, num_slices=2), one device each — the
+    dp axis crosses the slice/DCN boundary and fsdp crosses process
+    boundaries within each slice.  Loss/grad-norm parity vs the
+    identical 1-process 8-device program."""
+    ref_out, = _run_procs(MULTISLICE_WORKER, n_procs=1, local_devices=8)
+    ref_loss, ref_norm = _parse_result(ref_out)
+    # 8 jax processes on a 1-CPU CI host: give the gang headroom (the
+    # uncontended run takes ~3 min; 420s flaked under suite load).
+    outputs = _run_procs(MULTISLICE_WORKER, n_procs=8, local_devices=1,
+                         timeout=720)
     for out in outputs:
         loss, norm = _parse_result(out)
         assert abs(loss - ref_loss) < 5e-5 * max(1, abs(ref_loss)), \

