@@ -40,13 +40,21 @@ import bench as B  # noqa: E402
 def sweep_configs(quick: bool):
     # (batch, variant, JSON-safe overrides, optimizer name) — see
     # bench.run_mfu_sweep for the encoding contract.
+    # Offline memory predictions (bench_offline_v5e, round 5): b8
+    # remat-dots peaks at 9.67 GB (fits), b16 at 15.80 GB — OVER the
+    # 15.75 GB chip, so b12 is the committed fallback and b16 runs
+    # LAST (an OOM there costs nothing already banked).  The b4 no-
+    # remat bridged roofline caps at MFU 0.436 (memory-bound): batch
+    # scaling under remat is the only path past it.
     cfgs = [
         (4, "base", None, None),
         (8, "remat-dots",
          {"remat": True, "remat_policy": "dots_saveable"}, None),
-        (16, "remat-dots",
+        (12, "remat-dots",
          {"remat": True, "remat_policy": "dots_saveable"}, None),
         (8, "remat-full", {"remat": True}, None),
+        (16, "remat-dots",
+         {"remat": True, "remat_policy": "dots_saveable"}, None),
     ]
     return cfgs[:2] if quick else cfgs
 

@@ -144,7 +144,11 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None,
         if bf and bb:
             # The bridged probes are dense-only (flash/pallas reports
             # zero flops on this lowering path): add the analytic
-            # attention term back, mirroring bench.reconcile_flops.
+            # attention term back, mirroring bench.reconcile_flops —
+            # and like it, REFUSE the half-bridge when no analytic
+            # attention term is registered (an attention-less count
+            # can pass the 2x gate and publish an understated
+            # roofline as if fully bridged).
             from polyaxon_tpu.models.registry import get_model
 
             mspec = get_model(model_name)
@@ -152,7 +156,11 @@ def analyze(jax, model_name, batch_size, compiled, spec, variant=None,
                 cfg = getattr(mspec.make_model(**(overrides or {})),
                               "cfg", None)
                 bf += mspec.attn_flops(batch_size, cfg)
-            xla_flops, xla_bytes, bridged = bf, bb, True
+                xla_flops, xla_bytes, bridged = bf, bb, True
+            else:
+                print(f"#   no attn_flops registered for "
+                      f"{model_name}; refusing half-bridge",
+                      file=sys.stderr)
     cost_model_valid = bool(
         analytic and xla_flops and xla_bytes
         and 0.5 <= xla_flops / analytic <= 2.0)

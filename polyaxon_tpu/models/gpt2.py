@@ -164,7 +164,11 @@ class GPT2Model(nn.Module):
             x, _ = self.h(x, decode or None)
             return x
         for block in self.h_blocks:
-            x = block(x, decode=decode)
+            # `decode or None`: under nn.remat a literal False would be
+            # traced as a bool[] operand and `if decode:` inside the
+            # block raises TracerBoolConversionError; None stays a
+            # static python literal (same trick as the scanned call).
+            x = block(x, decode=decode or None)
         return x
 
     def head(self, x):

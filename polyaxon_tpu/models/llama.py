@@ -227,7 +227,10 @@ class LlamaModel(nn.Module):
             x, _ = self.layers(x, decode or None)
             return x
         for block in self.blocks:
-            x = block(x, decode=decode)
+            # `decode or None`: a literal False would be traced under
+            # nn.remat (TracerBoolConversionError); None stays static
+            # — same convention as the scanned call above.
+            x = block(x, decode=decode or None)
         return x
 
     def head(self, x):

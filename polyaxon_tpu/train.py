@@ -62,6 +62,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "step (0 = off).")
     p.add_argument("--profile-steps", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--init-hf", default=None, metavar="STATE_DICT",
+                   help="Initialize params from a torch state_dict "
+                        "file (HF checkpoint) instead of random init "
+                        "— the fine-tuning path.  The mapping is the "
+                        "verified models/import_hf loader for the "
+                        "model family; dims must match --model.")
     p.add_argument("--data-dir", default=None,
                    help="Directory of inputs.npy/labels.npy (else "
                         "synthetic).")
@@ -118,6 +124,48 @@ def parse_target_metric(spec):
 def target_reached(value, target) -> bool:
     _, threshold, op = target
     return value <= threshold if op == "<=" else value >= threshold
+
+
+def load_hf_init(model_name: str, model, path: str):
+    """Fine-tuning init: map a torch ``state_dict`` file onto the zoo
+    model's params via the verified ``models.import_hf`` loader for
+    the family (numerics pinned vs transformers in
+    tests/test_import_hf.py).  The checkpoint's dims must match the
+    zoo config — a mismatch surfaces as a loader shape error naming
+    the offending tensor, not silent garbage."""
+    import torch
+
+    from .models import import_hf
+
+    family = model_name.split("-")[0]
+    loader_name = _HF_LOADER_BY_FAMILY.get(family)
+    if loader_name is None:
+        raise SystemExit(
+            f"--init-hf supports the {sorted(_HF_LOADER_BY_FAMILY)} "
+            f"families, not {model_name!r}")
+    state_dict = torch.load(path, map_location="cpu",
+                            weights_only=True)
+    return getattr(import_hf, loader_name)(state_dict, model.cfg)
+
+
+_HF_LOADER_BY_FAMILY = {
+    "bert": "load_hf_bert",
+    "gpt2": "load_hf_gpt2",
+    "llama": "load_hf_llama",
+    "tinyllama": "load_hf_llama",
+    "mistral": "load_hf_llama",  # same block layout
+    "vit": "load_hf_vit",
+    "t5": "load_hf_t5",
+}
+
+# Config overrides a family needs for HF-parity fine-tuning, applied
+# to make_model when --init-hf is set (kept next to the loader table
+# so a new family states both halves of its contract in one place).
+# bert/vit: HF uses the exact (erf) GELU; the zoo default is tanh.
+_HF_MODEL_KW = {
+    "bert": {"gelu_approximate": False},
+    "vit": {"gelu_approximate": False},
+}
 
 
 def make_optimizer(name: str, lr: float):
@@ -382,11 +430,18 @@ def _main(argv=None) -> int:
 
     # Data defines the input shapes: init params from a dataset sample
     # (e.g. digits are 8x8 where the synthetic stand-in is 28x28).
-    model = spec.make_model()
+    model_kw = _HF_MODEL_KW.get(args.model.split("-")[0], {}) \
+        if args.init_hf else {}
+    model = spec.make_model(**model_kw)
     train_ds, eval_ds = make_datasets(args, spec, batch_size,
                                       model=model)
     sample = train_ds.sample(2)
-    params = model.init(jax.random.PRNGKey(args.seed), sample["inputs"])
+    # --init-hf replaces the params wholesale: don't pay a full random
+    # init (a transient multi-GB allocation for the 1B models) just to
+    # discard it.
+    params = load_hf_init(args.model, model, args.init_hf) \
+        if args.init_hf else \
+        model.init(jax.random.PRNGKey(args.seed), sample["inputs"])
     loss_fn = spec.loss_fn(model)
     if mesh.shape.get("pp", 1) > 1:
         # strategy {pp: N}: route the block stack through the
