@@ -845,6 +845,11 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default=None)
     parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument(
+        "--variant", default=None,
+        help="Label stamped on the result row — marks env-driven A/B "
+             "legs (e.g. bwd flash-block tuning) whose config is not "
+             "visible in the row otherwise.")
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--all", action="store_true",
@@ -1026,6 +1031,11 @@ def main() -> int:
             attempts.append((batch, None, None, None))
         r = None
         for try_batch, overrides, variant, optimizer in attempts:
+            if args.variant:
+                # env-driven A/B tag composes with the replayed
+                # baseline variant (e.g. "bn-bf16+bwd-block-512")
+                variant = (f"{variant}+{args.variant}" if variant
+                           else args.variant)
             try:
                 r = bench_model(jax, name, try_batch, args.steps,
                                 args.warmup, backend,

@@ -34,6 +34,12 @@ timeout 3000 python benchmarks/bench_decode.py || true
 timeout 3600 python benchmarks/bench_gpt2_mfu.py || true
 timeout 1500 python bench.py --model gpt2-medium --require-accel --append \
     --probe-budget 180 || true
+# 4b. bwd flash-block A/B on the banked best gpt2-medium config: the
+#     backward kernels carry more live VMEM operands than the forward,
+#     so 512-blocks may beat the 1024 default there (fwd stays 1024).
+POLYAXON_TPU_FLASH_BLOCK_Q_BWD=512 POLYAXON_TPU_FLASH_BLOCK_KV_BWD=512 \
+    timeout 1500 python bench.py --model gpt2-medium --require-accel \
+    --append --variant bwd-block-512 --probe-budget 120 || true
 timeout 1200 python benchmarks/bench_roofline_probe.py || true
 timeout 1800 python benchmarks/bench_serving_load.py || true
 timeout 2400 python benchmarks/bench_windowed.py || true

@@ -378,7 +378,18 @@ def load_hf_t5(state_dict: Dict[str, Any], cfg) -> Dict[str, Any]:
         "dec_norm": {"scale": jnp.asarray(_np(
             sd["decoder.final_layer_norm.weight"]))},
     }
-    if not cfg.tie_embeddings:
+    if cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        if head is not None and not np.array_equal(_np(head), embed):
+            # v1.1-style untied head: decoding through the tied,
+            # d_model**-0.5-scaled embedding instead would silently
+            # change every logit (same contract as load_hf_bert's
+            # untied-decoder refusal).
+            raise ValueError(
+                "checkpoint has an untied lm_head.weight but "
+                "cfg.tie_embeddings=True; build the model with "
+                "tie_embeddings=False to keep the checkpoint's head")
+    else:
         head = sd.get("lm_head.weight")
         if head is None:
             # Unlike Llama (where the tied table IS the untied head

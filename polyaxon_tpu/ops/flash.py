@@ -40,6 +40,16 @@ from jax.experimental.pallas import tpu as pltpu
 # block that divides the seq and stays under these caps (_pick_block).
 BLOCK_Q = int(os.environ.get("POLYAXON_TPU_FLASH_BLOCK_Q", 1024))
 BLOCK_KV = int(os.environ.get("POLYAXON_TPU_FLASH_BLOCK_KV", 1024))
+# The backward kernels hold more live operands per program (q/k/v/o/do
+# + two output accumulators), so their VMEM sweet spot can sit below
+# the forward's — tunable independently for the on-chip A/B
+# (benchmarks/tpu_sweep.sh bwd-block legs).  None = follow the LIVE
+# forward caps at call time, so tests that monkeypatch BLOCK_Q/
+# BLOCK_KV keep shrinking the backward tiling too.
+_env_q_bwd = os.environ.get("POLYAXON_TPU_FLASH_BLOCK_Q_BWD")
+_env_kv_bwd = os.environ.get("POLYAXON_TPU_FLASH_BLOCK_KV_BWD")
+BLOCK_Q_BWD = int(_env_q_bwd) if _env_q_bwd else None
+BLOCK_KV_BWD = int(_env_kv_bwd) if _env_kv_bwd else None
 NEG_INF = -1e30
 
 
@@ -463,8 +473,8 @@ def _flash_backward(q, k, v, kvm, o, lse, do, causal: bool, scale: float,
                     dlse=None, window=None):
     batch, heads, sq, d = q.shape
     sk = k.shape[2]
-    block_q = _pick_block(sq, BLOCK_Q)
-    block_kv = _pick_block(sk, BLOCK_KV)
+    block_q = _pick_block(sq, BLOCK_Q_BWD or BLOCK_Q)
+    block_kv = _pick_block(sk, BLOCK_KV_BWD or BLOCK_KV)
     q_shift = sk - sq
     padded = kvm is not None
     n_q, n_kv = sq // block_q, sk // block_kv

@@ -260,6 +260,18 @@ class TestT5HF:
         with pytest.raises(ValueError, match="tie_embeddings=True"):
             load_hf_t5(sd, untied)
 
+    def test_untied_checkpoint_refuses_tied_load(self):
+        # The inverse direction: a v1.1-style checkpoint WITH a real
+        # untied lm_head must not be loaded under tie_embeddings=True
+        # — the head would be silently dropped and decoding would run
+        # through the tied, d_model**-0.5-scaled embedding instead.
+        from polyaxon_tpu.models.import_hf import load_hf_t5
+        torch, hf, cfg = self._hf_pair("gated-gelu", False)
+        import dataclasses
+        tied = dataclasses.replace(cfg, tie_embeddings=True)
+        with pytest.raises(ValueError, match="untied lm_head"):
+            load_hf_t5(hf.state_dict(), tied)
+
     def test_export_roundtrips_through_transformers(self):
         from polyaxon_tpu.models.import_hf import export_hf_t5
         torch, hf, cfg = self._hf_pair("relu", True)
