@@ -317,8 +317,8 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                              prefill_chunk=prefill_chunk)
     except (ValueError, NotImplementedError) as e:
         # Library-level validation (max_position overflow, top_p
-        # range, unsupported mode combinations like beam-on-ring) —
-        # surface as a clean CLI error, not a traceback.
+        # range, unsupported mode combinations like beam on unstacked
+        # layers) — surface as a clean CLI error, not a traceback.
         raise click.ClickException(str(e))
     out = np.asarray(jax.device_get(out))
     dt = _time.perf_counter() - t0

@@ -506,15 +506,16 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
             "reorder would gather the position axis instead of beams. "
             "Use greedy generate(), or a scan_layers build of the "
             "model.")
-    if getattr(getattr(model, "cfg", None), "kv_cache_ring", False):
-        # The ring cache's batch-invariant cached_pos ([layers, cap])
-        # would be mis-gathered by the rank>=2 beam reorder (axis 1 is
-        # its SLOT axis, not batch).
-        raise NotImplementedError(
-            "generate_beam does not support kv_cache_ring; use the "
-            "standard cache for beam search")
+    ring = getattr(getattr(model, "cfg", None), "kv_cache_ring", False)
     max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
-    if max_pos is not None and p_len + max_new_tokens > max_pos:
+    # Ring caches are position-keyed, not capacity-bounded: beam
+    # decoding streams past max_position like greedy does (RoPE is
+    # pure arithmetic).  The batch-invariant ring leaves (cached_pos
+    # [layers, cap], no batch axis) are handled inside _beam_loop —
+    # beams decode in lockstep, so every beam shares one position
+    # schedule and those leaves are never tiled or reordered.
+    if not ring and max_pos is not None \
+            and p_len + max_new_tokens > max_pos:
         raise ValueError(
             f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_position ({max_pos})")
@@ -554,8 +555,17 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
     lp = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
     vocab = lp.shape[-1]
     scores, first = jax.lax.top_k(lp, k)                   # [B, K]
-    cache = jax.tree.map(
-        lambda x: jnp.repeat(x, k, axis=1) if x.ndim >= 2 else x,
+
+    def _batch_invariant(path) -> bool:
+        # Leaves with no batch axis: the ring cache's position table
+        # (cached_pos [layers, cap] — axis 1 is SLOTS) is shared by
+        # every row and beam (lockstep decoding), so tiling or
+        # parent-gathering it would corrupt the slot arithmetic.
+        return "cached_pos" in jax.tree_util.keystr(path)
+
+    cache = jax.tree_util.tree_map_with_path(
+        lambda p, x: jnp.repeat(x, k, axis=1)
+        if x.ndim >= 2 and not _batch_invariant(p) else x,
         cache)
     done = (first == eos_id) if eos_id is not None \
         else jnp.zeros((b, k), bool)
@@ -585,8 +595,10 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
             # beam of a batch row holds the same encoder projections,
             # and parents never cross batch rows, so the gather would
             # be a no-op permutation — skip it (they still tile above
-            # so attention sees the [B*K, ...] batch layout).
-            if x.ndim < 2 or "cross_" in jax.tree_util.keystr(path):
+            # so attention sees the [B*K, ...] batch layout).  Ring
+            # position tables have no batch axis at all — skip.
+            if x.ndim < 2 or "cross_" in jax.tree_util.keystr(path) \
+                    or _batch_invariant(path):
                 return x
             return jnp.take(x, flat_parent, axis=1)
 

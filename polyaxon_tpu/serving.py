@@ -366,14 +366,6 @@ class ModelServer:
             cfg = getattr(m, "cfg", None)
             max_pos = getattr(cfg, "max_position", None)
             if getattr(cfg, "kv_cache_ring", False):
-                if beams > 1:
-                    # generate_beam has no ring-cache support; catch it
-                    # here so the client gets a 400, not a 500 from the
-                    # NotImplementedError inside the locked section.
-                    raise ValueError(
-                        f"beam search is not supported on a ring-cache "
-                        f"{label} (kv_cache_ring=True); use greedy or "
-                        f"sampled decoding")
                 ring_slack = getattr(cfg, "kv_cache_ring_slack", 0)
                 if speculative and ring_slack < spec_k - 1:
                     raise ValueError(

@@ -123,9 +123,35 @@ def test_ring_requires_window():
         dataclasses.replace(LlamaConfig.tiny(), kv_cache_ring=True)
 
 
-def test_ring_beam_refused():
-    _, ring_cfg = _cfgs(window=8)
-    model, variables, prompt = _init(ring_cfg)
-    with pytest.raises(NotImplementedError, match="kv_cache_ring"):
-        G.generate_beam(model, variables, prompt, max_new_tokens=4,
-                        num_beams=2)
+def test_ring_beam_matches_standard_within_max_position():
+    """Beam search on the ring cache (round 5: the batch-invariant
+    cached_pos table is skipped by the per-beam tile/parent-reorder —
+    beams decode in lockstep, so one position schedule serves all).
+    Oracle: bit-identical to beam search on the standard windowed
+    cache."""
+    base_cfg, ring_cfg = _cfgs()
+    model, variables, prompt = _init(base_cfg)
+    ring_model = LlamaModel(cfg=ring_cfg)
+    want = G.generate_beam(model, variables, prompt,
+                           max_new_tokens=16, num_beams=3)
+    got = G.generate_beam(ring_model, variables, prompt,
+                          max_new_tokens=16, num_beams=3)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_ring_beam_streams_past_max_position():
+    """Position-keyed ring + beam: decodes beyond the ring model's
+    max_position and matches the same weights under a roomy standard
+    cache, while the standard build refuses the length outright."""
+    _, ring_small = _cfgs(window=8, max_position=24)
+    big_cfg, _ = _cfgs(window=8, max_position=256)
+    model_big, variables, prompt = _init(big_cfg)
+    want = G.generate_beam(model_big, variables, prompt,
+                           max_new_tokens=40, num_beams=2)
+    got = G.generate_beam(LlamaModel(cfg=ring_small), variables,
+                          prompt, max_new_tokens=40, num_beams=2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    small_std = LlamaModel(cfg=_cfgs(window=8, max_position=24)[0])
+    with pytest.raises(ValueError, match="max_position"):
+        G.generate_beam(small_std, variables, prompt,
+                        max_new_tokens=40, num_beams=2)

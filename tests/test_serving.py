@@ -309,11 +309,22 @@ class TestCoalescing:
             assert r["new_tokens"] == solo["new_tokens"]
 
 
-class TestRingBeamValidation:
-    def test_beam_on_ring_cache_is_400(self):
+class TestRingBeam:
+    def test_beam_on_ring_cache_serves(self):
+        """Beam search works on ring-cache models (round 5): the
+        server must not reject it, and the response matches the
+        library's beam output on the same ring model."""
+        import numpy as np
+
+        from polyaxon_tpu.models.generate import generate_beam
+
         spec = get_model("mistral-tiny")
         model, variables = spec.init_params(batch_size=1)
         ring = spec.make_model(kv_cache_ring=True)
         ms = ModelServer(ring, variables)
-        with pytest.raises(ValueError, match="ring-cache"):
-            ms.generate({"prompt": [1, 2, 3], "num_beams": 2})
+        out = ms.generate({"prompt": [1, 2, 3], "num_beams": 2,
+                           "max_new_tokens": 4})
+        want = generate_beam(ring, variables,
+                             np.asarray([[1, 2, 3]], np.int32),
+                             max_new_tokens=4, num_beams=2)
+        assert out["tokens"] == np.asarray(want).tolist()
