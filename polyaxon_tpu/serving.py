@@ -27,16 +27,18 @@ themselves; every row in one request must share a length.
 
 Concurrency: one chip means device work is serialized, but the server
 does NOT serialize whole requests (VERDICT r4 weak/missing #4).
-Greedy requests that share a compile shape (prompt_len,
-max_new_tokens, eos, prefill_chunk) are COALESCED: whoever acquires
-the device lock drains every compatible queued request into one
-merged batch (batch-dim padded to a power-of-two bucket so varied
-client counts reuse one compiled program), runs a single jitted call,
-and hands each request its slice.  Merging is exact — decode rows
-never interact across the batch dimension — so a coalesced response
-is bit-identical to a solo one.  Sampled/beam/speculative requests
-keep the solo path (a shared PRNG key or beam schedule would change
-their outputs if merged).
+Greedy requests that share (prompt_len, eos, prefill_chunk) are
+COALESCED — max_new_tokens may differ: the merged batch decodes to
+the longest request's length and each response is sliced back to its
+own.  Whoever acquires the device lock drains every compatible queued
+request into one merged batch (batch-dim padded to a power-of-two
+bucket so varied client counts reuse one compiled program), runs a
+single jitted call, and hands each request its slice.  Merging is
+exact — decode rows never interact across the batch dimension, and
+eos-frozen rows emit eos past their budget (truncated by the slice) —
+so a coalesced response is bit-identical to a solo one.
+Sampled/beam/speculative requests keep the solo path (a shared PRNG
+key or beam schedule would change their outputs if merged).
 """
 
 from __future__ import annotations
@@ -53,10 +55,11 @@ import numpy as np
 class _Pending:
     """One coalescible request waiting for a leader to execute it."""
 
-    __slots__ = ("toks", "event", "result", "error")
+    __slots__ = ("toks", "new", "event", "result", "error")
 
-    def __init__(self, toks: np.ndarray):
+    def __init__(self, toks: np.ndarray, new: int):
         self.toks = toks          # [rows, p_len] int32
+        self.new = new            # this request's max_new_tokens
         self.event = threading.Event()
         self.result = None        # [rows, p_len + new] when done
         self.error: Optional[BaseException] = None
@@ -165,6 +168,12 @@ class ModelServer:
     def _execute_batch(self, ckey, batch) -> None:
         """Run one merged greedy batch; deliver each request's slice.
 
+        Requests may differ in max_new_tokens (ckey excludes it): the
+        batch decodes to the LONGEST request's length and each item is
+        sliced back to its own — exact, because greedy rows never
+        interact and eos-frozen rows just keep emitting eos past their
+        requested budget (truncated away by the slice).
+
         Failures are delivered through item.error, never raised: the
         executing leader may not own any row of this batch, and its
         own request must not die for a stranger's OOM.
@@ -172,9 +181,10 @@ class ModelServer:
         import jax
         import jax.random as jrandom
 
-        p_len, new, eos, chunk = ckey
+        p_len, eos, chunk = ckey
         try:
             rows = np.concatenate([it.toks for it in batch], axis=0)
+            new = max(it.new for it in batch)
             n = rows.shape[0]
             b = _batch_bucket(n, self.max_batch)
             if b > n:  # batch-dim pad: rows never interact across it
@@ -190,7 +200,7 @@ class ModelServer:
             ofs = 0
             for it in batch:
                 r = it.toks.shape[0]
-                it.result = out[ofs:ofs + r]
+                it.result = out[ofs:ofs + r, :p_len + it.new]
                 ofs += r
                 it.event.set()
             self.requests += len(batch)
@@ -213,8 +223,8 @@ class ModelServer:
         lock, an unset event implies our item is drainable and every
         drain makes progress.
         """
-        ckey = (p_len, new, eos, chunk)
-        item = _Pending(toks)
+        ckey = (p_len, eos, chunk)  # new excluded: lengths merge
+        item = _Pending(toks, new)
         with self._pending_lock:
             self._pending.setdefault(ckey, []).append(item)
         with self._lock:
@@ -365,6 +375,14 @@ class ModelServer:
         for label, m in models:
             cfg = getattr(m, "cfg", None)
             max_pos = getattr(cfg, "max_position", None)
+            if beams > 1 and not getattr(cfg, "scan_layers", True):
+                # generate_beam needs the scan-stacked cache layout;
+                # reject here so the client gets a 400 instead of a
+                # 500 from the NotImplementedError at jit-trace time
+                # inside the locked device section.
+                raise ValueError(
+                    f"beam search requires a scan-stacked {label} "
+                    f"(cfg.scan_layers=True)")
             if getattr(cfg, "kv_cache_ring", False):
                 ring_slack = getattr(cfg, "kv_cache_ring_slack", 0)
                 if speculative and ring_slack < spec_k - 1:

@@ -216,9 +216,46 @@ class TestCoalescing:
         for got, ref in zip(results, refs):
             assert got["new_tokens"] == ref["new_tokens"]
 
+    def test_heterogeneous_lengths_merge(self):
+        """Requests differing only in max_new_tokens merge into one
+        batch decoding to the longest; every response equals its solo
+        output (eos-freeze rows truncate exactly)."""
+        ms = self._servers()
+        reqs = [
+            {"prompt": [3, 1, 4, 1], "max_new_tokens": 3},
+            {"prompt": [2, 7, 1, 8], "max_new_tokens": 7},
+            {"prompt": [9, 9, 2, 6], "max_new_tokens": 5},
+        ]
+        refs = [ms.generate(dict(r)) for r in reqs]
+        results = [None] * len(reqs)
+
+        def go(i):
+            results[i] = ms.generate(dict(reqs[i]))
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(reqs))]
+        with ms._lock:
+            for t in threads:
+                t.start()
+            deadline = 50
+            while deadline > 0 and sum(
+                    len(q) for q in ms._pending.values()) < len(reqs):
+                threading.Event().wait(0.1)
+                deadline -= 1
+            # ONE key despite three different lengths
+            assert len(ms._pending) == 1
+        for t in threads:
+            t.join(timeout=120)
+        assert ms.coalesced_batches == 1
+        assert ms.coalesced_requests == len(reqs)
+        for got, ref, req in zip(results, refs, reqs):
+            assert got["new_tokens"] == ref["new_tokens"]
+            assert len(got["new_tokens"][0]) == req["max_new_tokens"]
+
     def test_mixed_shapes_coalesce_per_key(self):
-        """Different (p_len, new) requests queue under different keys;
-        a leader only merges its own key's queue."""
+        """Different prompt lengths queue under different keys (new is
+        NOT part of the key — lengths merge); a leader only merges its
+        own key's queue."""
         ms = self._servers()
         a_ref = ms.generate({"prompt": [1, 2, 3], "max_new_tokens": 4})
         b_ref = ms.generate({"prompt": [5, 6], "max_new_tokens": 3})
@@ -328,3 +365,14 @@ class TestRingBeam:
                              np.asarray([[1, 2, 3]], np.int32),
                              max_new_tokens=4, num_beams=2)
         assert out["tokens"] == np.asarray(want).tolist()
+
+    def test_beam_on_unstacked_layers_is_400(self):
+        """scan_layers=False has no beam support (position-axis cache
+        layout): the validation layer rejects it before the device
+        lock."""
+        spec = get_model("llama-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        flat = spec.make_model(scan_layers=False)
+        ms = ModelServer(flat, variables)
+        with pytest.raises(ValueError, match="scan-stacked"):
+            ms.generate({"prompt": [1, 2, 3], "num_beams": 2})
