@@ -173,14 +173,19 @@ def _parse_prompt(prompt: str):
 
 
 def _build_serving_model(name: str, batch_size: int,
-                         ckpt_dir, kv_int8: bool, int8_weights: bool):
+                         ckpt_dir, kv_int8: bool, int8_weights: bool,
+                         kv_ring: bool = False):
     """Shared by ``generate`` and ``serve``: zoo model + variables
-    with the serving options applied (int8 KV config, checkpoint
-    restore, weight quantization)."""
+    with the serving options applied (int8 KV / ring-cache config,
+    checkpoint restore, weight quantization)."""
     from polyaxon_tpu.models.registry import get_model
 
     spec = get_model(name)
-    kw = {"kv_cache_int8": True} if kv_int8 else {}
+    kw = {}
+    if kv_int8:
+        kw["kv_cache_int8"] = True
+    if kv_ring:
+        kw["kv_cache_ring"] = True
     try:
         if ckpt_dir:
             # Restoring replaces the params — don't pay a full random
@@ -192,13 +197,27 @@ def _build_serving_model(name: str, batch_size: int,
                 batch_size=batch_size, **kw)
     except TypeError:
         if kw:
-            # mlp/convnet-style models take no such config field.
+            # Name only the fields the family actually lacks: a
+            # combined --int8-kv --kv-ring on gpt2 fails on kv_ring
+            # alone, and blaming both would point the user at the
+            # wrong flag.
+            import dataclasses as _dc
+
+            cfg = getattr(spec.make_model(), "cfg", None)
+            known = {f.name for f in _dc.fields(cfg)}                 if _dc.is_dataclass(cfg) else set()
+            bad = sorted(k for k in kw if k not in known) or sorted(kw)
             raise click.ClickException(
-                f"{name} has no int8 KV cache support")
+                f"{name} does not support {bad} (no such config "
+                f"field{'s' if len(bad) > 1 else ''} on this model "
+                f"family)")
         # No config kwarg was passed, so the TypeError is a real bug
         # inside model construction — masking it as a quantization
         # message would point the user at the wrong flag.
         raise
+    except ValueError as e:
+        # Config-level validation (e.g. kv_cache_ring on a model
+        # without sliding_window) — a clean CLI error, not a traceback.
+        raise click.ClickException(str(e))
     if ckpt_dir:
         from polyaxon_tpu.checkpoint import CheckpointManager
 
@@ -248,6 +267,10 @@ def _build_serving_model(name: str, batch_size: int,
               help="Weight-only int8 (halves weight HBM reads).")
 @click.option("--int8-kv", is_flag=True, default=False,
               help="int8 KV cache (halves KV HBM reads).")
+@click.option("--kv-ring", is_flag=True, default=False,
+              help="O(window) ring KV cache for sliding-window "
+                   "models: stream past max_position (composes with "
+                   "beam and --int8-kv).")
 @click.option("--seed", default=0, type=int)
 @click.option("--prefill-chunk", default=None, type=int,
               help="Prefill the prompt in fixed-size pieces to bound "
@@ -255,8 +278,8 @@ def _build_serving_model(name: str, batch_size: int,
 @click.option("--cpu", is_flag=True, default=False)
 def generate(model_name, prompt, max_new_tokens, temperature, top_k,
              top_p, beams, eos_id, checkpoint, draft_model,
-             draft_checkpoint, spec_k, int8_weights, int8_kv, seed,
-             prefill_chunk, cpu):
+             draft_checkpoint, spec_k, int8_weights, int8_kv,
+             kv_ring, seed, prefill_chunk, cpu):
     """Decode with a zoo model — the native serving surface.
 
     The reference serves models as opaque user containers behind
@@ -279,7 +302,8 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
     b = len(rows)
 
     model, variables = _build_serving_model(
-        model_name, b, checkpoint, int8_kv, int8_weights)
+        model_name, b, checkpoint, int8_kv, int8_weights,
+        kv_ring=kv_ring)
     import numpy as np
 
     toks = np.asarray(rows, dtype=np.int32)
@@ -293,7 +317,7 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                     "--temperature, --top-k or --top-p)")
             draft, draft_vars = _build_serving_model(
                 draft_model, b, draft_checkpoint, int8_kv,
-                int8_weights)
+                int8_weights, kv_ring=kv_ring)
             out = G.generate_speculative(
                 model, variables, draft, draft_vars, toks,
                 max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id,
@@ -334,6 +358,7 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
            if draft_model else {}),
         **({"int8_weights": True} if int8_weights else {}),
         **({"int8_kv": True} if int8_kv else {}),
+        **({"kv_ring": True} if kv_ring else {}),
     }))
 
 
@@ -344,6 +369,8 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--checkpoint", default=None, type=click.Path())
 @click.option("--int8-weights", is_flag=True, default=False)
 @click.option("--int8-kv", is_flag=True, default=False)
+@click.option("--kv-ring", is_flag=True, default=False,
+              help="O(window) ring KV cache (sliding-window models).")
 @click.option("--max-batch", default=8, type=int)
 @click.option("--draft-model", default=None,
               help="Zoo model enabling SPECULATIVE requests "
@@ -351,6 +378,7 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
+          kv_ring,
           max_batch, draft_model, draft_checkpoint, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /generate).
 
@@ -370,17 +398,23 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         raise click.ClickException(
             "--draft-checkpoint requires --draft-model")
     model, variables = _build_serving_model(
-        model_name, 1, checkpoint, int8_kv, int8_weights)
+        model_name, 1, checkpoint, int8_kv, int8_weights,
+        kv_ring=kv_ring)
     draft = draft_vars = None
     if draft_model:
+        # The draft mirrors the target's cache mode: a standard-cache
+        # draft would re-impose the max_position bound --kv-ring
+        # exists to lift.
         draft, draft_vars = _build_serving_model(
-            draft_model, 1, draft_checkpoint, int8_kv, int8_weights)
+            draft_model, 1, draft_checkpoint, int8_kv, int8_weights,
+            kv_ring=kv_ring)
     ms = ModelServer(model, variables, model_name=model_name,
                      max_batch=max_batch,
                      draft_model=draft, draft_variables=draft_vars,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),
                            **({"int8_kv": True} if int8_kv else {}),
+                           **({"kv_ring": True} if kv_ring else {}),
                            **({"draft_model": draft_model}
                               if draft_model else {})})
     try:

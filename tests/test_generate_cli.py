@@ -159,4 +159,18 @@ class TestGenerateCLI:
             "generate", "--model", "mlp", "--prompt", "1,2", "--cpu",
             "--int8-kv"])
         assert r.exit_code != 0
-        assert "no int8 KV cache support" in r.output
+        assert "does not support ['kv_cache_int8']" in r.output
+
+    def test_kv_ring_flag(self):
+        """--kv-ring routes sliding-window models through the O(window)
+        ring cache (composes with --beams); unsupported families get a
+        clean error naming the flag."""
+        out = _run(["--model", "mistral-tiny", "--kv-ring",
+                    "--prompt", "1,2,3", "--max-new-tokens", "4",
+                    "--beams", "2", "--cpu"])
+        assert len(out["new_tokens"][0]) == 4
+        r = CliRunner().invoke(cli, [
+            "generate", "--model", "mlp", "--prompt", "1,2", "--cpu",
+            "--kv-ring"])
+        assert r.exit_code != 0
+        assert "does not support ['kv_cache_ring']" in r.output
