@@ -174,7 +174,7 @@ def _parse_prompt(prompt: str):
 
 def _build_serving_model(name: str, batch_size: int,
                          ckpt_dir, kv_int8: bool, int8_weights: bool,
-                         kv_ring: bool = False):
+                         kv_ring: bool = False, kv_ring_slack: int = 0):
     """Shared by ``generate`` and ``serve``: zoo model + variables
     with the serving options applied (int8 KV / ring-cache config,
     checkpoint restore, weight quantization)."""
@@ -186,6 +186,10 @@ def _build_serving_model(name: str, batch_size: int,
         kw["kv_cache_int8"] = True
     if kv_ring:
         kw["kv_cache_ring"] = True
+        if kv_ring_slack:
+            # speculative decoding on a ring cache needs spare slots
+            # for rollback overwrites (generate_speculative's guard)
+            kw["kv_cache_ring_slack"] = int(kv_ring_slack)
     try:
         if ckpt_dir:
             # Restoring replaces the params — don't pay a full random
@@ -204,7 +208,8 @@ def _build_serving_model(name: str, batch_size: int,
             import dataclasses as _dc
 
             cfg = getattr(spec.make_model(), "cfg", None)
-            known = {f.name for f in _dc.fields(cfg)}                 if _dc.is_dataclass(cfg) else set()
+            known = ({f.name for f in _dc.fields(cfg)}
+                     if _dc.is_dataclass(cfg) else set())
             bad = sorted(k for k in kw if k not in known) or sorted(kw)
             raise click.ClickException(
                 f"{name} does not support {bad} (no such config "
@@ -215,9 +220,14 @@ def _build_serving_model(name: str, batch_size: int,
         # message would point the user at the wrong flag.
         raise
     except ValueError as e:
-        # Config-level validation (e.g. kv_cache_ring on a model
-        # without sliding_window) — a clean CLI error, not a traceback.
-        raise click.ClickException(str(e))
+        if kw:
+            # Config-level validation of a passed flag (e.g.
+            # kv_cache_ring on a model without sliding_window) — a
+            # clean CLI error, not a traceback.
+            raise click.ClickException(str(e))
+        # No serving flag was passed: a real library bug, keep the
+        # stack (same contract as the TypeError branch above).
+        raise
     if ckpt_dir:
         from polyaxon_tpu.checkpoint import CheckpointManager
 
@@ -301,9 +311,13 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
     rows = _parse_prompt(prompt)
     b = len(rows)
 
+    # Speculative rounds on a ring cache overwrite up to k-1 still-
+    # in-window slots on rollback: build both models with that slack
+    # so --kv-ring + --draft-model works out of the box.
+    ring_slack = (spec_k - 1) if (kv_ring and draft_model) else 0
     model, variables = _build_serving_model(
         model_name, b, checkpoint, int8_kv, int8_weights,
-        kv_ring=kv_ring)
+        kv_ring=kv_ring, kv_ring_slack=ring_slack)
     import numpy as np
 
     toks = np.asarray(rows, dtype=np.int32)
@@ -317,7 +331,8 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                     "--temperature, --top-k or --top-p)")
             draft, draft_vars = _build_serving_model(
                 draft_model, b, draft_checkpoint, int8_kv,
-                int8_weights, kv_ring=kv_ring)
+                int8_weights, kv_ring=kv_ring,
+                kv_ring_slack=ring_slack)
             out = G.generate_speculative(
                 model, variables, draft, draft_vars, toks,
                 max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id,
@@ -371,6 +386,10 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--int8-kv", is_flag=True, default=False)
 @click.option("--kv-ring", is_flag=True, default=False,
               help="O(window) ring KV cache (sliding-window models).")
+@click.option("--kv-ring-slack", default=0, type=int,
+              help="Spare ring slots beyond the window; speculative "
+                   "requests need >= spec_k - 1 (default 0 rejects "
+                   "them).")
 @click.option("--max-batch", default=8, type=int)
 @click.option("--draft-model", default=None,
               help="Zoo model enabling SPECULATIVE requests "
@@ -378,7 +397,7 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
-          kv_ring,
+          kv_ring, kv_ring_slack,
           max_batch, draft_model, draft_checkpoint, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /generate).
 
@@ -399,7 +418,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
             "--draft-checkpoint requires --draft-model")
     model, variables = _build_serving_model(
         model_name, 1, checkpoint, int8_kv, int8_weights,
-        kv_ring=kv_ring)
+        kv_ring=kv_ring, kv_ring_slack=kv_ring_slack)
     draft = draft_vars = None
     if draft_model:
         # The draft mirrors the target's cache mode: a standard-cache
@@ -407,7 +426,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         # exists to lift.
         draft, draft_vars = _build_serving_model(
             draft_model, 1, draft_checkpoint, int8_kv, int8_weights,
-            kv_ring=kv_ring)
+            kv_ring=kv_ring, kv_ring_slack=kv_ring_slack)
     ms = ModelServer(model, variables, model_name=model_name,
                      max_batch=max_batch,
                      draft_model=draft, draft_variables=draft_vars,
