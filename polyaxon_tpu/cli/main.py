@@ -267,9 +267,11 @@ def _build_serving_model(name: str, batch_size: int,
               help="Orbax checkpoint dir from `ptpu train` "
                    "(--checkpoint-every); default: random init.")
 @click.option("--draft-model", default=None,
-              help="Zoo model for greedy SPECULATIVE decoding "
-                   "(same vocab; output identical to the target's "
-                   "greedy decode).")
+              help="Zoo model for SPECULATIVE decoding (same vocab). "
+                   "Greedy by default (output identical to the "
+                   "target's greedy decode); with --temperature it "
+                   "runs rejection speculative sampling — exact "
+                   "target-distribution samples for any draft.")
 @click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--spec-k", default=4, type=int,
               help="Draft proposals per speculative round.")
@@ -324,19 +326,29 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
     t0 = _time.perf_counter()
     try:
         if draft_model is not None:
-            if beams > 1 or temperature != 0.0 or top_k is not None \
-                    or top_p is not None:
+            if beams > 1:
                 raise click.ClickException(
-                    "speculative decoding is greedy-only (no --beams, "
-                    "--temperature, --top-k or --top-p)")
+                    "speculative decoding cannot combine with --beams "
+                    "(greedy or sampled only)")
+            if temperature == 0.0 and (top_k is not None
+                                       or top_p is not None):
+                raise click.ClickException(
+                    "speculative --top-k/--top-p need --temperature "
+                    "> 0 (temperature=0 is greedy and would ignore "
+                    "them)")
             draft, draft_vars = _build_serving_model(
                 draft_model, b, draft_checkpoint, int8_kv,
                 int8_weights, kv_ring=kv_ring,
                 kv_ring_slack=ring_slack)
+            # temperature>0 runs rejection speculative sampling: exact
+            # target-distribution samples for any draft (generate.py).
             out = G.generate_speculative(
                 model, variables, draft, draft_vars, toks,
                 max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                rng=jax.random.PRNGKey(seed)
+                if temperature != 0.0 else None)
         elif beams > 1:
             if temperature != 0.0 or top_k is not None \
                     or top_p is not None:

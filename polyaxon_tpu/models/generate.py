@@ -73,10 +73,11 @@ def extract_logits(out) -> jax.Array:
         f"{type(out).__name__}")
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int],
-            top_p: Optional[float] = None):
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
+def _modified_logits(logits, temperature: float, top_k: Optional[int],
+                     top_p: Optional[float] = None):
+    """The temp/top-k/top-p-shaped logits ``_sample`` draws from —
+    factored out so speculative rejection sampling can evaluate the
+    EXACT draft/target densities the samplers use."""
     logits = logits / temperature
     if top_k is not None:
         # lax.top_k, not a full vocab sort — this runs once per decoded
@@ -95,7 +96,16 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
         cut = jnp.where(before < top_p, sorted_l, jnp.inf)
         kth = jnp.min(cut, axis=-1, keepdims=True)
         logits = jnp.where(logits < kth, -1e30, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return logits
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        rng, _modified_logits(logits, temperature, top_k, top_p),
+        axis=-1)
 
 
 def _check_top_p(top_p) -> None:
@@ -347,35 +357,58 @@ def _rollback_cache(cache, new_index):
 def generate_speculative(model, variables, draft_model, draft_variables,
                          prompt, *, max_new_tokens: int, k: int = 4,
                          eos_id: Optional[int] = None,
-                         prefill_chunk: Optional[int] = None) -> jax.Array:
-    """Greedy speculative decoding: a small DRAFT model proposes ``k``
-    tokens per round; the target verifies all of them in ONE chunked
-    forward (k+1 positions through the causal-append mask) and commits
-    the longest matching prefix plus its own correction.
+                         prefill_chunk: Optional[int] = None,
+                         temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None,
+                         rng: Optional[jax.Array] = None) -> jax.Array:
+    """Speculative decoding: a small DRAFT model proposes ``k`` tokens
+    per round; the target verifies all of them in ONE chunked forward
+    (k+1 positions through the causal-append mask).
 
-    The output is EXACTLY ``generate(model, ...)``'s greedy output —
-    speculation changes the schedule, never the tokens (the test pins
-    this equality).  Each round costs one draft scan (k small steps)
-    plus one target forward of k+1 positions; at acceptance rate a the
-    target runs ~(a*k+1)x fewer serial steps, which is the whole win on
-    TPU where decode is latency-bound on weight reads per step.
+    **Greedy (temperature=0, the default):** commits the longest
+    draft/target-argmax matching prefix plus the target's correction —
+    output EXACTLY equals ``generate(model, ...)``'s greedy output
+    (pinned in tests).  **Sampled (temperature>0):** standard
+    rejection speculative sampling — proposal ``x ~ q`` is accepted
+    with probability ``min(1, p(x)/q(x))``; the first rejected
+    position resamples from the residual ``norm(max(p - q, 0))``.
+    Each committed token is therefore distributed EXACTLY as a sample
+    from the target's (temp/top-k/top-p-shaped) distribution, for any
+    draft — the draft only changes the schedule.  The shaping is
+    applied to BOTH densities via the same ``_modified_logits`` the
+    plain sampler uses.
+
+    Each round costs one draft scan (k small steps) plus one target
+    forward of k+1 positions; at acceptance rate a the target runs
+    ~(a*k+1)x fewer serial steps, which is the whole win on TPU where
+    decode is latency-bound on weight reads per step.
 
     Per round the batch advances in LOCKSTEP by the minimum acceptance
     across rows (per-row cache indices would desynchronize the shared
     cache_index); rows that verified further simply re-derive those
-    tokens next round — wasted work, never wrong tokens.  Commits are
-    capped at k per round (the all-accepted bonus token is dropped) so
-    the cache rollback arithmetic is uniform.
+    tokens next round — wasted work, never wrong tokens (sampled mode
+    re-derives with FRESH randomness, which is still an exact sample
+    from the target conditional).  Commits are capped at k per round
+    (the all-accepted bonus token is dropped) so the cache rollback
+    arithmetic is uniform.
 
     Both models must be decoder-only with the same vocab; ``eos_id``
     freezing is applied to the finished rows after the loop (identical
-    semantics to generate()'s in-loop freeze for greedy decoding).
+    semantics to generate()'s in-loop freeze).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1; got "
                          f"{max_new_tokens}")
     if k < 1:
         raise ValueError(f"k must be >= 1; got {k}")
+    sampled = temperature != 0.0
+    if sampled and rng is None:
+        raise ValueError("temperature > 0 requires an rng key "
+                         "(use temperature=0 for greedy decoding)")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0; got {temperature}")
+    _check_top_p(top_p)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     for m, nm in ((model, "target"), (draft_model, "draft")):
@@ -410,28 +443,46 @@ def generate_speculative(model, variables, draft_model, draft_variables,
                                  chunk=prefill_chunk)
     _, d_cache = _prefill(draft_model, draft_variables, prompt,
                           chunk=prefill_chunk)
-    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # [B]
+    if sampled:
+        rng, key = jax.random.split(rng)
+        first = _sample(t_logits, key, temperature, top_k,
+                        top_p).astype(jnp.int32)          # [B]
+    else:
+        rng = jax.random.PRNGKey(0)  # unused; keeps one loop carry
+        first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
 
     buf = jnp.zeros((b, max_new_tokens + k), jnp.int32)
     buf = buf.at[:, 0].set(first)
 
     def draft_step(carry, _):
-        cache, tok, pos = carry
+        cache, tok, pos, key = carry
         out, mut = draft_model.apply(
             {"params": _params(draft_variables), "cache": cache},
             tok[:, None], decode=True, decode_position=pos,
             mutable=["cache"])
-        nxt = jnp.argmax(extract_logits(out)[:, -1],
-                         axis=-1).astype(jnp.int32)
-        return (mut["cache"], nxt, pos + 1), nxt
+        logits = extract_logits(out)[:, -1]
+        if sampled:
+            key, sub = jax.random.split(key)
+            q_logits = _modified_logits(logits, temperature, top_k,
+                                        top_p)
+            nxt = jax.random.categorical(sub, q_logits,
+                                         axis=-1).astype(jnp.int32)
+            q_row = jax.nn.softmax(q_logits.astype(jnp.float32),
+                                   axis=-1)               # [B, V]
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            q_row = jnp.zeros((0,), jnp.float32)  # greedy: no density
+        return (mut["cache"], nxt, pos + 1, key), (nxt, q_row)
 
     def round_body(state):
-        t_cache, d_cache, x, buf, count = state
+        t_cache, d_cache, x, buf, count, rng = state
         consumed = p_len + count - 1      # tokens both caches hold
 
         # Draft proposes d_1..d_k (feeds x, d_1..d_{k-1}).
-        (d_cache, _, _), d_toks = jax.lax.scan(
-            draft_step, (d_cache, x, consumed), None, length=k)
+        rng, r_draft, r_accept, r_res = jax.random.split(rng, 4)
+        (d_cache, _, _, _), (d_toks, q_rows) = jax.lax.scan(
+            draft_step, (d_cache, x, consumed, r_draft), None,
+            length=k)
         d_toks = d_toks.T                 # [B, k]
 
         # Target verifies the whole chunk in one forward.
@@ -440,30 +491,59 @@ def generate_speculative(model, variables, draft_model, draft_variables,
             {"params": _params(variables), "cache": t_cache},
             chunk, decode=True, decode_position=consumed,
             mutable=["cache"])
-        t_toks = jnp.argmax(extract_logits(out),
-                            axis=-1).astype(jnp.int32)  # [B, k+1]
+        t_logits_all = extract_logits(out)                # [B, k+1, V]
 
-        # Leading-match count per row, lockstep min across the batch;
-        # commit c = min(m)+1 target tokens, capped at k.
-        matches = d_toks == t_toks[:, :k]               # [B, k]
-        m_row = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
-        c = jnp.minimum(jnp.min(m_row) + 1, k)          # scalar, >= 1
+        if sampled:
+            # Rejection speculative sampling: accept x_i ~ q_i with
+            # prob min(1, p_i(x_i)/q_i(x_i)); the first rejection
+            # resamples from the residual norm(max(p_i - q_i, 0)).
+            p_logits = _modified_logits(
+                t_logits_all[:, :k], temperature, top_k, top_p)
+            p_rows = jax.nn.softmax(p_logits.astype(jnp.float32),
+                                    axis=-1)              # [B, k, V]
+            q_rows = jnp.moveaxis(q_rows, 0, 1)           # [B, k, V]
+            px = jnp.take_along_axis(
+                p_rows, d_toks[..., None], axis=-1)[..., 0]  # [B, k]
+            qx = jnp.take_along_axis(
+                q_rows, d_toks[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(r_accept, (b, k))
+            accept = u * qx < px          # u < p/q without the divide
+            m_row = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+            c = jnp.minimum(jnp.min(m_row) + 1, k)        # scalar >= 1
+            # Residual resample at EVERY position (vectorized); only
+            # each row's first-rejection column is ever committed.
+            resid = jnp.clip(p_rows - q_rows, 0.0, None)
+            res = jax.random.categorical(
+                r_res, jnp.log(resid + 1e-20),
+                axis=-1).astype(jnp.int32)                # [B, k]
+            cols = jnp.arange(k)[None, :]
+            out_toks = jnp.where(cols < m_row[:, None], d_toks, res)
+        else:
+            t_toks = jnp.argmax(t_logits_all,
+                                axis=-1).astype(jnp.int32)  # [B, k+1]
+            # Leading-match count per row, lockstep min across the
+            # batch; commit c = min(m)+1 target tokens, capped at k.
+            matches = d_toks == t_toks[:, :k]             # [B, k]
+            m_row = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+            c = jnp.minimum(jnp.min(m_row) + 1, k)        # scalar >= 1
+            out_toks = t_toks[:, :k]
 
         # Write a static k-wide window at count; only c of it counts —
         # the next round's window overwrites the rest.
         buf = jax.lax.dynamic_update_slice(
-            buf, t_toks[:, :k], (0, count))
-        x = jnp.take(t_toks, c - 1, axis=1)       # column c-1, [B]
+            buf, out_toks, (0, count))
+        x = jnp.take(out_toks, c - 1, axis=1)     # column c-1, [B]
         new_consumed = consumed + c
         t_cache = _rollback_cache(mut["cache"], new_consumed)
         d_cache = _rollback_cache(d_cache, new_consumed)
-        return t_cache, d_cache, x, buf, count + c
+        return t_cache, d_cache, x, buf, count + c, rng
 
     def cond(state):
         return state[4] < max_new_tokens
 
-    state = (t_cache, d_cache, first, buf, jnp.array(1, jnp.int32))
-    *_, buf, _ = jax.lax.while_loop(cond, round_body, state)
+    state = (t_cache, d_cache, first, buf, jnp.array(1, jnp.int32),
+             rng)
+    *_, buf, _, _ = jax.lax.while_loop(cond, round_body, state)
     new = buf[:, :max_new_tokens]
 
     if eos_id is not None:

@@ -15,8 +15,10 @@ Endpoints:
 - ``POST /generate`` -> ``{"prompt": [ids] | [[ids], ...],
   "max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p,
   "eos_id": e, "num_beams": B, "speculative": bool, "spec_k": K,
-  "seed": s, "prefill_chunk": C}`` -> tokens + timing (speculative needs a server-side
-  draft model and is greedy-only)
+  "seed": s, "prefill_chunk": C}`` -> tokens + timing (speculative
+  needs a server-side draft model; greedy by default, and with
+  temperature/top_k/top_p it runs rejection speculative sampling —
+  exact target-distribution samples for any draft)
 
 Shape discipline: each distinct (batch, prompt_len, max_new_tokens,
 decode-mode) compiles once and is cached.  Prompts are NOT padded:
@@ -89,8 +91,9 @@ class ModelServer:
         # the A/B baseline for benchmarks/bench_serving_load.py.
         self.coalesce = bool(coalesce)
         # Optional speculative-decoding draft: requests opt in with
-        # {"speculative": true}; greedy-only, output identical to the
-        # plain greedy decode (models/generate.generate_speculative).
+        # {"speculative": true}; greedy by default (output identical
+        # to plain greedy decode), rejection-sampled with temperature
+        # (models/generate.generate_speculative).
         self.draft_model = draft_model
         self.draft_variables = draft_variables
         self.model_name = model_name
@@ -135,7 +138,9 @@ class ModelServer:
             fn = jax.jit(lambda toks, rng: G.generate_speculative(
                 self.model, self.variables, self.draft_model,
                 self.draft_variables, toks, max_new_tokens=new,
-                k=k, eos_id=eos, prefill_chunk=chunk))
+                k=k, eos_id=eos, prefill_chunk=chunk,
+                temperature=temp, top_k=top_k, top_p=top_p,
+                rng=rng if temp != 0.0 else None))
         else:
             fn = jax.jit(lambda toks, rng: G.generate(
                 self.model, self.variables, toks, max_new_tokens=new,
@@ -337,11 +342,17 @@ class ModelServer:
                 raise ValueError(
                     "server has no draft model (start with "
                     "--draft-model to enable speculative decoding)")
-            if beams > 1 or temp != 0.0 or top_k is not None \
-                    or top_p is not None:
+            if beams > 1:
                 raise ValueError(
-                    "speculative decoding is greedy-only (no "
-                    "num_beams/temperature/top_k/top_p)")
+                    "speculative decoding cannot combine with beam "
+                    "search (greedy or sampled only)")
+            if temp == 0.0 and (top_k is not None
+                                or top_p is not None):
+                # dropping the flags silently would let a client
+                # believe it sampled (same contract as num_beams)
+                raise ValueError(
+                    "speculative top_k/top_p need temperature > 0 "
+                    "(temperature=0 is greedy and would ignore them)")
             try:
                 spec_k = _int(req.get("spec_k", 4))
             except (TypeError, ValueError):
@@ -413,8 +424,8 @@ class ModelServer:
         else:
             if speculative:
                 # last slot carries the draft length (see _fn)
-                key = ("spec", len(rows), p_len, new, 0.0, None, None,
-                       eos, spec_k, chunk)
+                key = ("spec", len(rows), p_len, new, temp, top_k,
+                       top_p, eos, spec_k, chunk)
             else:
                 key = ("beam", len(rows), p_len,
                        new, temp, top_k, top_p, eos, beams, chunk) \

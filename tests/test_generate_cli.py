@@ -88,12 +88,7 @@ class TestGenerateCLI:
         assert out["new_tokens"] != base["new_tokens"]
 
     def test_bad_flag_combos(self):
-        r = CliRunner().invoke(cli, [
-            "generate", "--model", "gpt2-tiny", "--prompt", "1,2",
-            "--cpu", "--draft-model", "gpt2-tiny",
-            "--temperature", "0.5"])
-        assert r.exit_code != 0
-        assert "greedy-only" in r.output
+        # beam search stays deterministic: sampling flags still reject
         r = CliRunner().invoke(cli, [
             "generate", "--model", "gpt2-tiny", "--prompt", "1,2",
             "--cpu", "--beams", "2", "--temperature", "0.5"])
@@ -121,13 +116,25 @@ class TestGenerateCLI:
             "--cpu"])
         assert r.exit_code != 0 and "token id" in r.output
 
-    def test_sampling_flags_rejected_on_beam_and_spec(self):
+    def test_invalid_mode_combos_rejected(self):
         for extra in (["--beams", "2", "--top-p", "0.9"],
-                      ["--draft-model", "gpt2-tiny", "--top-k", "5"]):
+                      ["--draft-model", "gpt2-tiny", "--beams", "2"]):
             r = CliRunner().invoke(cli, [
                 "generate", "--model", "gpt2-tiny", "--prompt", "1,2",
                 "--cpu"] + extra)
             assert r.exit_code != 0, extra
+
+    def test_sampled_speculative(self):
+        """round 5: --draft-model + --temperature runs rejection
+        speculative sampling — deterministic by --seed."""
+        args = ["--model", "gpt2-tiny", "--draft-model", "gpt2-tiny",
+                "--spec-k", "3", "--prompt", "5,6,7,8",
+                "--max-new-tokens", "5", "--temperature", "0.9",
+                "--top-k", "16", "--seed", "7", "--cpu"]
+        a = _run(args)
+        b = _run(args)
+        assert a["new_tokens"] == b["new_tokens"]
+        assert len(a["new_tokens"][0]) == 5
 
     def test_prompt_file_errors_clean(self, tmp_path):
         r = CliRunner().invoke(cli, [

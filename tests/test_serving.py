@@ -129,12 +129,6 @@ class TestServer:
                     expect=400)
         assert "prefill_chunk" in bad["error"]
 
-    def test_speculative_rejects_sampling(self, server):
-        base, _, _ = server
-        out = _post(base, {"prompt": [1, 2], "speculative": True,
-                           "temperature": 0.5}, expect=400)
-        assert "greedy-only" in out["error"]
-
     def test_speculative_without_draft_400(self):
         spec = get_model("gpt2-tiny")
         model, variables = spec.init_params(batch_size=1)
@@ -376,3 +370,30 @@ class TestRingBeam:
         ms = ModelServer(flat, variables)
         with pytest.raises(ValueError, match="scan-stacked"):
             ms.generate({"prompt": [1, 2, 3], "num_beams": 2})
+
+
+class TestSampledSpeculative:
+    def test_sampled_speculative_serves_and_is_seeded(self, server):
+        """Rejection speculative sampling through the server: sampled
+        speculative requests are accepted (round 5 — no longer
+        greedy-only), deterministic by seed, and vary across seeds."""
+        base, _, _ = server
+        req = {"prompt": [5, 6, 7, 8], "max_new_tokens": 6,
+               "speculative": True, "spec_k": 3,
+               "temperature": 0.9, "top_k": 16, "seed": 7}
+        a = _post(base, dict(req))
+        b = _post(base, dict(req))
+        assert a["new_tokens"] == b["new_tokens"]
+        c = _post(base, {**req, "seed": 8})
+        assert len(c["new_tokens"][0]) == 6
+        # a different seed must change the sample — this is the guard
+        # against the server silently falling back to greedy
+        assert c["new_tokens"] != a["new_tokens"]
+        # sampling flags without temperature are rejected, not dropped
+        out = _post(base, {"prompt": [1, 2], "speculative": True,
+                           "top_k": 5}, expect=400)
+        assert "temperature" in out["error"]
+        # beam + speculative is still rejected
+        out = _post(base, {"prompt": [1, 2], "speculative": True,
+                           "num_beams": 2}, expect=400)
+        assert "beam" in out["error"]
