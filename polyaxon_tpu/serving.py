@@ -117,6 +117,15 @@ class ModelServer:
         self._pending_lock = threading.Lock()
         self.coalesced_batches = 0
         self.coalesced_requests = 0
+        # /metrics counters.  _stats_lock guards errors/latency/token
+        # tallies (mutated from handler threads); requests/coalesced_*
+        # are mutated under the DEVICE lock and read unlocked by
+        # metrics_text — consistent enough for monotonic counters.
+        self._stats_lock = threading.Lock()
+        self.errors = 0
+        self._lat_sum = 0.0
+        self._lat_count = 0
+        self._tokens_out = 0
 
     # -- compile cache --------------------------------------------------
 
@@ -440,6 +449,10 @@ class ModelServer:
                     fn(toks, jrandom.PRNGKey(seed))))
                 self.requests += 1
         dt = time.perf_counter() - t0
+        with self._stats_lock:
+            self._lat_sum += dt
+            self._lat_count += 1
+            self._tokens_out += len(rows) * new
         return {
             "model": self.model_name,
             "new_tokens": out[:, p_len:].tolist(),
@@ -469,17 +482,49 @@ class ModelServer:
                 "coalesced_requests": self.coalesced_requests,
                 **self.extra_info}
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the serving counters —
+        the observability surface a scraping stack expects from an
+        in-cluster `V1Service` (SURVEY §5.5)."""
+        with self._stats_lock:
+            lat_sum, lat_count = self._lat_sum, self._lat_count
+            toks, errs = self._tokens_out, self.errors
+        lines = [
+            "# TYPE ptpu_serving_requests_total counter",
+            f"ptpu_serving_requests_total {self.requests}",
+            "# TYPE ptpu_serving_errors_total counter",
+            f"ptpu_serving_errors_total {errs}",
+            "# TYPE ptpu_serving_tokens_generated_total counter",
+            f"ptpu_serving_tokens_generated_total {toks}",
+            "# TYPE ptpu_serving_coalesced_batches_total counter",
+            f"ptpu_serving_coalesced_batches_total "
+            f"{self.coalesced_batches}",
+            "# TYPE ptpu_serving_coalesced_requests_total counter",
+            f"ptpu_serving_coalesced_requests_total "
+            f"{self.coalesced_requests}",
+            "# TYPE ptpu_serving_request_seconds summary",
+            f"ptpu_serving_request_seconds_sum {lat_sum:.6f}",
+            f"ptpu_serving_request_seconds_count {lat_count}",
+            "# TYPE ptpu_serving_compiled_programs gauge",
+            f"ptpu_serving_compiled_programs {len(self._fns)}",
+        ]
+        return "\n".join(lines) + "\n"
+
 
 def make_server(host: str, port: int, ms: ModelServer
                 ) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
-        def _send(self, code: int, obj: Dict[str, Any]) -> None:
-            body = json.dumps(obj).encode()
+        def _send_raw(self, code: int, body: bytes,
+                      ctype: str) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send(self, code: int, obj: Dict[str, Any]) -> None:
+            self._send_raw(code, json.dumps(obj).encode(),
+                           "application/json")
 
         def log_message(self, fmt, *args):  # quiet by default
             pass
@@ -490,6 +535,9 @@ def make_server(host: str, port: int, ms: ModelServer
                                  "model": ms.model_name})
             elif self.path == "/info":
                 self._send(200, ms.info())
+            elif self.path == "/metrics":
+                self._send_raw(200, ms.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -497,13 +545,24 @@ def make_server(host: str, port: int, ms: ModelServer
             if self.path != "/generate":
                 self._send(404, {"error": f"no route {self.path}"})
                 return
+            # Generate FIRST, send after: a client hanging up while a
+            # successful response streams out must not count as a
+            # serving error (nor trigger a doomed second send).
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                self._send(200, ms.generate(req))
+                code, resp = 200, ms.generate(req)
             except ValueError as e:
-                self._send(400, {"error": str(e)})
+                with ms._stats_lock:
+                    ms.errors += 1
+                code, resp = 400, {"error": str(e)}
             except Exception as e:  # never kill the server thread
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                with ms._stats_lock:
+                    ms.errors += 1
+                code, resp = 500, {"error": f"{type(e).__name__}: {e}"}
+            try:
+                self._send(code, resp)
+            except OSError:
+                pass  # client went away mid-write; nothing to do
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -397,3 +397,27 @@ class TestSampledSpeculative:
         out = _post(base, {"prompt": [1, 2], "speculative": True,
                            "num_beams": 2}, expect=400)
         assert "beam" in out["error"]
+
+
+class TestMetrics:
+    def test_metrics_endpoint(self, server):
+        """GET /metrics: Prometheus text with the serving counters,
+        advancing with traffic (incl. the error counter)."""
+        base, _, _ = server
+        _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        _post(base, {"prompt": [1], "max_new_tokens": 0}, expect=400)
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=30) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        metrics = {}
+        for line in body.splitlines():
+            if line and not line.startswith("#"):
+                name, _, value = line.rpartition(" ")
+                metrics[name] = float(value)
+        assert metrics["ptpu_serving_requests_total"] >= 1
+        assert metrics["ptpu_serving_errors_total"] >= 1
+        assert metrics["ptpu_serving_tokens_generated_total"] >= 4
+        assert metrics["ptpu_serving_request_seconds_count"] >= 1
+        assert metrics["ptpu_serving_request_seconds_sum"] > 0
