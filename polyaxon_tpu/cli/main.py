@@ -402,6 +402,11 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Spare ring slots beyond the window; speculative "
                    "requests need >= spec_k - 1 (default 0 rejects "
                    "them).")
+@click.option("--prefix-cache", default=4, type=int,
+              help="Prefix-cache entries (POST /prefill registers a "
+                   "system prompt; matching /generate requests skip "
+                   "its prefill). 0 disables; each entry holds a full "
+                   "KV cache on device.")
 @click.option("--max-batch", default=8, type=int)
 @click.option("--draft-model", default=None,
               help="Zoo model enabling SPECULATIVE requests "
@@ -409,9 +414,11 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
-          kv_ring, kv_ring_slack,
+          kv_ring, kv_ring_slack, prefix_cache,
           max_batch, draft_model, draft_checkpoint, cpu):
-    """Serve a zoo model over HTTP (/healthz, /info, /generate).
+    """Serve a zoo model over HTTP (/healthz, /info, /metrics,
+    /generate, /prefill — the last registers a prompt prefix whose
+    prefill later /generate requests skip).
 
     The reference's `V1Service` schedules an opaque serving container;
     here the framework ships the model server itself (stdlib HTTP, jit
@@ -440,7 +447,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
             draft_model, 1, draft_checkpoint, int8_kv, int8_weights,
             kv_ring=kv_ring, kv_ring_slack=kv_ring_slack)
     ms = ModelServer(model, variables, model_name=model_name,
-                     max_batch=max_batch,
+                     max_batch=max_batch, prefix_cache=prefix_cache,
                      draft_model=draft, draft_variables=draft_vars,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),

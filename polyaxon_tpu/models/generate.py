@@ -198,19 +198,80 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
     # ``prefill_chunk`` bounds the activation memory of long prompts.
     first_logits, cache = _prefill(model, variables, prompt,
                                    chunk=prefill_chunk)
+    new = generate_continue(
+        model, variables, cache, first_logits, p_len,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, rng=rng, eos_id=eos_id,
+        _validated=True)
+    return jnp.concatenate([prompt, new], axis=1)
+
+
+def prefill(model, variables, prompt, *, chunk: Optional[int] = None,
+            cache=None, position: int = 0):
+    """Fill — or EXTEND — a decode cache with ``prompt`` tokens.
+
+    With ``cache=None`` this is the standalone prefill: a fresh cache
+    is created and filled from position 0.  Passing an existing
+    ``cache`` (and the ``position`` it has consumed up to) APPENDS the
+    tokens instead — the causal-append machinery is position-keyed,
+    so ``prefill(suffix, cache=c, position=n)`` after
+    ``prefill(prefix)`` produces bit-identical state to one
+    ``prefill(prefix ++ suffix)`` (the chunked-prefill exactness
+    contract).  This is the building block for serving-side PREFIX
+    CACHING: reuse a stored prefill across requests sharing a prompt
+    prefix and pay only for the suffix.
+
+    Returns ``(last_position_logits [B, V], cache)`` — feed both to
+    :func:`generate_continue`.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    return _prefill(model, variables, prompt, chunk=chunk,
+                    cache=cache, position=position)
+
+
+def generate_continue(model, variables, cache, last_logits,
+                      position: int, *, max_new_tokens: int,
+                      temperature: float = 0.0,
+                      top_k: Optional[int] = None,
+                      top_p: Optional[float] = None,
+                      rng: Optional[jax.Array] = None,
+                      eos_id: Optional[int] = None,
+                      _validated: bool = False) -> jax.Array:
+    """Decode ``max_new_tokens`` from a prefilled cache (see
+    :func:`prefill`): returns the NEW tokens [B, max_new_tokens].
+
+    Exactness contract: ``generate(model, vars, prompt, ...)`` equals
+    ``prompt ++ generate_continue(model, vars, *prefill(model, vars,
+    prompt), len(prompt), ...)`` with the same rng — they are the same
+    program split at the prefill/decode boundary.
+    """
+    if not _validated:
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1; got "
+                             f"{max_new_tokens}")
+        _check_top_p(top_p)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        cfg = getattr(model, "cfg", None)
+        max_pos = getattr(cfg, "max_position", None)
+        if max_pos is not None and position + max_new_tokens > max_pos \
+                and not getattr(cfg, "kv_cache_ring", False):
+            raise ValueError(
+                f"position ({position}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the model's max_position "
+                f"({max_pos})")
 
     def apply_step(cache, tok, t):
         out, mut = model.apply(
             {"params": _params(variables), "cache": cache},
-            tok[:, None], decode=True, decode_position=p_len + t,
+            tok[:, None], decode=True, decode_position=position + t,
             mutable=["cache"])
         return extract_logits(out)[:, -1], mut["cache"]
 
-    new = _decode_loop(apply_step, cache, first_logits,
-                       max_new_tokens=max_new_tokens, rng=rng,
-                       temperature=temperature, top_k=top_k,
-                       top_p=top_p, eos_id=eos_id)
-    return jnp.concatenate([prompt, new], axis=1)
+    return _decode_loop(apply_step, cache, last_logits,
+                        max_new_tokens=max_new_tokens, rng=rng,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, eos_id=eos_id)
 
 
 def generate_seq2seq(model, variables, enc_tokens, *,
@@ -274,7 +335,8 @@ def generate_seq2seq(model, variables, enc_tokens, *,
         eos_id=eos_id)
 
 
-def _prefill(model, variables, prompt, chunk: Optional[int] = None):
+def _prefill(model, variables, prompt, chunk: Optional[int] = None,
+             cache=None, position: int = 0):
     """Prefill shared by generate / generate_beam /
     generate_speculative; returns (last-position logits [B, V], cache).
 
@@ -284,6 +346,11 @@ def _prefill(model, variables, prompt, chunk: Optional[int] = None):
     traced chunk step, attention cost O(chunk x visible) per step)
     plus one remainder step — the causal-append cache machinery is
     position-keyed, so chunking changes memory, never logits.
+
+    ``cache``/``position`` extend an EXISTING cache instead of
+    creating one (the public :func:`prefill` surface) — the appends
+    start at ``position``, so the result equals one prefill of the
+    concatenated tokens.
     """
     if chunk is not None and chunk < 1:
         raise ValueError(f"prefill_chunk must be >= 1; got {chunk}")
@@ -298,7 +365,9 @@ def _prefill(model, variables, prompt, chunk: Optional[int] = None):
             # oversized chunk) so the unbounded-session promise holds
             # regardless of what the caller passed.
             chunk = min(chunk, max_pos) if chunk else max_pos
-    cache = init_cache(model, b)
+    if cache is None:
+        cache = init_cache(model, b)
+        position = 0
 
     def apply_chunk(cache, toks, pos):
         # _params INSIDE the closure: for int8 weights the dequant
@@ -312,7 +381,7 @@ def _prefill(model, variables, prompt, chunk: Optional[int] = None):
         return extract_logits(out)[:, -1], mut["cache"]
 
     if not chunk or p_len <= chunk:
-        return apply_chunk(cache, prompt, 0)
+        return apply_chunk(cache, prompt, position)
 
     n_full, rem = divmod(p_len, chunk)
 
@@ -321,7 +390,7 @@ def _prefill(model, variables, prompt, chunk: Optional[int] = None):
         _, cache = apply_chunk(cache, toks, pos)
         return (cache, pos + chunk), None
 
-    pos = jnp.array(0, jnp.int32)
+    pos = jnp.array(position, jnp.int32)
     if n_full > 1:
         # All but the last full chunk run through the scan emitting
         # NOTHING — stacking per-chunk logits would add n_full x B x

@@ -12,6 +12,12 @@ Endpoints:
 - ``GET  /healthz``  -> ``{"status": "ok", ...}`` (readiness; also the
   operator's gang-health convention)
 - ``GET  /info``     -> model name, config summary, quantization flags
+- ``POST /prefill``  -> register a prompt (prefix) in the PREFIX
+  CACHE: its KV prefill is stored on device (LRU, ``prefix_cache``
+  entries) and later /generate requests whose prompt starts with it
+  skip that prefill — the system-prompt serving win.  Hits extend and
+  re-store, so growing sessions stay warm.  Exact by the
+  prefill/continue split contract (models/generate.py).
 - ``POST /generate`` -> ``{"prompt": [ids] | [[ids], ...],
   "max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p,
   "eos_id": e, "num_beams": B, "speculative": bool, "spec_k": K,
@@ -54,6 +60,47 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 
+def _int_param(v):
+    """int() that refuses booleans: int(True) == 1 would silently
+    accept {"num_beams": true} / {"prefill_chunk": true}."""
+    if isinstance(v, bool):
+        raise ValueError("expected an integer, got a boolean")
+    return int(v)
+
+
+def _parse_prompt_rows(req, max_batch: int):
+    """Shared /generate + /prefill prompt validation: returns the
+    row-wrapped token lists (one shared length, ints-not-bools,
+    batch-capped)."""
+    if not isinstance(req, dict):
+        raise ValueError("request body must be a JSON object")
+    rows = req.get("prompt")
+    if rows is None:
+        raise ValueError("missing 'prompt'")
+    if not isinstance(rows, list):
+        raise ValueError("'prompt' must be a list of token ids "
+                         "or a list of rows")
+    if rows and not isinstance(rows[0], list):
+        rows = [rows]
+    if not rows or not rows[0]:
+        raise ValueError("prompt must contain at least one token")
+    if len(rows) > max_batch:
+        raise ValueError(f"batch {len(rows)} exceeds max_batch "
+                         f"{max_batch}")
+    if len({len(r) for r in rows}) != 1:
+        # No silent padding: the decode path has no attention
+        # mask, so padded positions would be attended to.
+        raise ValueError(
+            "all prompt rows must share one length (the decode "
+            "path has no pad mask; bucket lengths client-side)")
+    if any(not all(isinstance(t, int) and not isinstance(t, bool)
+                   for t in r) for r in rows):
+        # bool is an int subclass: [true, false] must not silently
+        # decode as tokens [1, 0].
+        raise ValueError("prompt rows must be integer token ids")
+    return rows
+
+
 class _Pending:
     """One coalescible request waiting for a leader to execute it."""
 
@@ -83,6 +130,7 @@ class ModelServer:
 
     def __init__(self, model, variables, *, model_name: str = "model",
                  max_batch: int = 8, coalesce: bool = True,
+                 prefix_cache: int = 4,
                  draft_model=None, draft_variables=None,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
@@ -126,6 +174,21 @@ class ModelServer:
         self._lat_sum = 0.0
         self._lat_count = 0
         self._tokens_out = 0
+        # PREFIX CACHE: post-prefill KV caches keyed by the exact
+        # prompt batch, LRU-bounded (entries cost O(max_position)
+        # device memory each — the system-prompt serving win).  A
+        # request whose prompt extends a stored entry pays prefill
+        # only for the suffix (models/generate.prefill's extension
+        # contract); greedy/sampled solo requests only — beam/spec
+        # tile or roll back the cache.  prefix_cache=0 disables.
+        self.prefix_cache_size = int(prefix_cache)
+        if not hasattr(model, "encode"):
+            self._prefix_enabled = self.prefix_cache_size > 0
+        else:
+            self._prefix_enabled = False  # seq2seq: encoder != prefix
+        self._prefix: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefix_lock = threading.Lock()
+        self.prefix_hits = 0
 
     # -- compile cache --------------------------------------------------
 
@@ -159,6 +222,143 @@ class ModelServer:
         if len(self._fns) > self._fn_cap:
             self._fns.popitem(last=False)  # evict least-recently-used
         return fn
+
+    # -- prefix cache ----------------------------------------------------
+
+    def _split_fns(self, b: int, p_or_s: int, kind: str, chunk,
+                   new=None, temp=None, top_k=None, top_p=None,
+                   eos=None):
+        """Jitted split programs for the prefix-cache path:
+        ``pfill``/``extend`` produce (logits, cache); ``cont`` decodes
+        from a cache.  Cached in the same LRU as the fused programs."""
+        import jax
+
+        from .models import generate as G
+
+        # "cont" does not depend on chunk — keying it would compile
+        # duplicate identical decode programs per chunk value.
+        key = (kind, b, p_or_s, new, temp, top_k, top_p, eos, None,
+               chunk if kind != "cont" else None)
+        if key in self._fns:
+            self._fns.move_to_end(key)
+            return self._fns[key]
+        if kind == "pfill":
+            fn = jax.jit(lambda toks: G.prefill(
+                self.model, self.variables, toks, chunk=chunk))
+        elif kind == "extend":
+            fn = jax.jit(lambda cache, toks, pos: G.prefill(
+                self.model, self.variables, toks, chunk=chunk,
+                cache=cache, position=pos))
+        else:  # cont
+            fn = jax.jit(lambda cache, logits, pos, rng:
+                         G.generate_continue(
+                             self.model, self.variables, cache,
+                             logits, pos, max_new_tokens=new,
+                             temperature=temp, top_k=top_k,
+                             top_p=top_p, rng=rng, eos_id=eos,
+                             _validated=True))
+        self._fns[key] = fn
+        if len(self._fns) > self._fn_cap:
+            self._fns.popitem(last=False)
+        return fn
+
+    def _prefix_lookup(self, toks: np.ndarray):
+        """Longest stored entry whose prompt is a prefix of ``toks``
+        (same batch): returns (key, p_cached, logits, cache) or None."""
+        b, p_len = toks.shape
+        with self._prefix_lock:
+            best = None
+            for key, (rows, logits, cache) in self._prefix.items():
+                pc = rows.shape[1]
+                if rows.shape[0] != b or pc > p_len:
+                    continue
+                if (best is None or pc > best[1]) and \
+                        np.array_equal(rows, toks[:, :pc]):
+                    best = (key, pc, logits, cache)
+            if best is not None:
+                self._prefix.move_to_end(best[0])
+        return best
+
+    def _prefix_store(self, toks: np.ndarray, logits, cache) -> None:
+        key = (toks.shape[0], toks.shape[1], toks.tobytes())
+        with self._prefix_lock:
+            if key in self._prefix:
+                self._prefix.move_to_end(key)
+                return
+            self._prefix[key] = (toks.copy(), logits, cache)
+            while len(self._prefix) > self.prefix_cache_size:
+                self._prefix.popitem(last=False)
+
+    def prefill_prompt(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /prefill: register a prompt (prefix) in the prefix
+        cache — the system-prompt workflow.  Later /generate requests
+        whose prompt starts with it skip its prefill entirely."""
+        if not self._prefix_enabled:
+            raise ValueError(
+                "prefix cache is disabled on this server "
+                "(start with --prefix-cache N)")
+        import jax
+
+        rows = _parse_prompt_rows(req, self.max_batch)
+        cfg = getattr(self.model, "cfg", None)
+        max_pos = getattr(cfg, "max_position", None)
+        if max_pos is not None and len(rows[0]) > max_pos \
+                and not getattr(cfg, "kv_cache_ring", False):
+            # same contract as /generate: doomed requests fail in the
+            # cheap validation layer, not at jit-trace time inside
+            # the device lock (an over-capacity prefill would clamp
+            # the cache write index into garbage).
+            raise ValueError(
+                f"prompt ({len(rows[0])}) exceeds the model's "
+                f"max_position ({max_pos})")
+        chunk = req.get("prefill_chunk")
+        chunk = None if chunk is None else _int_param(chunk)
+        if chunk is not None and chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        toks = np.asarray(rows, np.int32)
+        t0 = time.perf_counter()
+        with self._lock:
+            logits, cache = self._split_fns(
+                toks.shape[0], toks.shape[1], "pfill", chunk)(toks)
+            jax.block_until_ready(logits)
+            self._prefix_store(toks, logits, cache)
+            self.requests += 1
+        with self._stats_lock:
+            self._lat_sum += time.perf_counter() - t0
+            self._lat_count += 1
+        return {"cached_rows": toks.shape[0],
+                "cached_len": toks.shape[1],
+                "entries": len(self._prefix)}
+
+    def _generate_prefix_cached(self, toks: np.ndarray, p_len: int,
+                                new: int, temp, top_k, top_p, eos,
+                                chunk, seed, hit):
+        """Solo decode through the split prefill/continue programs on
+        a prefix-cache HIT, paying prefill only for the suffix (which
+        is stored back, so sessions grow).  Exact: the split is the
+        same program as fused generate (generate_continue's contract),
+        and extension equals one-shot prefill (chunked-prefill
+        contract)."""
+        import jax
+        import jax.random as jrandom
+
+        b = toks.shape[0]
+        with self._lock:
+            _, pc, logits, cache = hit
+            if pc < p_len:  # extend with the suffix, store back
+                suffix = toks[:, pc:]
+                logits, cache = self._split_fns(
+                    b, suffix.shape[1], "extend", chunk)(
+                        cache, suffix, pc)
+                jax.block_until_ready(logits)
+                self._prefix_store(toks, logits, cache)
+            out_new = np.asarray(jax.device_get(self._split_fns(
+                b, None, "cont", chunk, new=new, temp=temp,
+                top_k=top_k, top_p=top_p, eos=eos)(
+                    cache, logits, p_len, jrandom.PRNGKey(seed))))
+            self.requests += 1
+            self.prefix_hits += 1
+        return np.concatenate([toks, out_new], axis=1)
 
     # -- coalesced execution --------------------------------------------
 
@@ -273,40 +473,9 @@ class ModelServer:
     def generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
         import jax
 
-        if not isinstance(req, dict):
-            raise ValueError("request body must be a JSON object")
-        rows = req.get("prompt")
-        if rows is None:
-            raise ValueError("missing 'prompt'")
-        if not isinstance(rows, list):
-            raise ValueError("'prompt' must be a list of token ids "
-                             "or a list of rows")
-        if rows and not isinstance(rows[0], list):
-            rows = [rows]
-        if not rows or not rows[0]:
-            raise ValueError("prompt must contain at least one token")
-        if len(rows) > self.max_batch:
-            raise ValueError(f"batch {len(rows)} exceeds max_batch "
-                             f"{self.max_batch}")
+        rows = _parse_prompt_rows(req, self.max_batch)
         lens = [len(r) for r in rows]
-        if len(set(lens)) != 1:
-            # No silent padding: the decode path has no attention
-            # mask, so padded positions would be attended to.
-            raise ValueError(
-                "all prompt rows must share one length (the decode "
-                "path has no pad mask; bucket lengths client-side)")
-        if any(not all(isinstance(t, int) and not isinstance(t, bool)
-                       for t in r) for r in rows):
-            # bool is an int subclass: [true, false] must not silently
-            # decode as tokens [1, 0].
-            raise ValueError("prompt rows must be integer token ids")
-
-        def _int(v):
-            # Same bool trap for scalar params: int(True) == 1 would
-            # silently accept {"num_beams": true}.
-            if isinstance(v, bool):
-                raise ValueError("expected an integer, got a boolean")
-            return int(v)
+        _int = _int_param
 
         def _float(v):
             # float(True) == 1.0: {"temperature": true} must not
@@ -420,10 +589,20 @@ class ModelServer:
         toks = np.asarray(rows, np.int32)
 
         t0 = time.perf_counter()
+        # Prefix-cache hit (registered via /prefill): greedy/sampled
+        # solo requests decode from the stored prefill — beam tiles
+        # and speculative rolls back the cache, so they stay cold.
+        prefix_hit = None
+        if self._prefix_enabled and beams == 1 and not speculative:
+            prefix_hit = self._prefix_lookup(toks)
         coalescible = (self.coalesce and not speculative
                        and beams == 1 and temp == 0.0
                        and top_k is None and top_p is None)
-        if coalescible:
+        if prefix_hit is not None:
+            out = self._generate_prefix_cached(
+                toks, p_len, new, temp, top_k, top_p, eos, chunk,
+                seed, prefix_hit)
+        elif coalescible:
             # Exactness argument for ignoring ``seed`` here: greedy
             # decoding never consults the PRNG, so requests with
             # different seeds still produce identical outputs merged
@@ -459,6 +638,8 @@ class ModelServer:
             "tokens": out.tolist(),
             "wall_s": round(dt, 4),
             "tok_per_sec": round(len(rows) * new / dt, 1),
+            **({"prefix_hit_len": prefix_hit[1]}
+               if prefix_hit is not None else {}),
         }
 
     def info(self) -> Dict[str, Any]:
@@ -480,6 +661,8 @@ class ModelServer:
                 "requests": self.requests,
                 "coalesced_batches": self.coalesced_batches,
                 "coalesced_requests": self.coalesced_requests,
+                "prefix_entries": len(self._prefix),
+                "prefix_hits": self.prefix_hits,
                 **self.extra_info}
 
     def metrics_text(self) -> str:
@@ -507,6 +690,10 @@ class ModelServer:
             f"ptpu_serving_request_seconds_count {lat_count}",
             "# TYPE ptpu_serving_compiled_programs gauge",
             f"ptpu_serving_compiled_programs {len(self._fns)}",
+            "# TYPE ptpu_serving_prefix_hits_total counter",
+            f"ptpu_serving_prefix_hits_total {self.prefix_hits}",
+            "# TYPE ptpu_serving_prefix_entries gauge",
+            f"ptpu_serving_prefix_entries {len(self._prefix)}",
         ]
         return "\n".join(lines) + "\n"
 
@@ -542,16 +729,18 @@ def make_server(host: str, port: int, ms: ModelServer
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/prefill"):
                 self._send(404, {"error": f"no route {self.path}"})
                 return
+            handler = ms.generate if self.path == "/generate" \
+                else ms.prefill_prompt
             # Generate FIRST, send after: a client hanging up while a
             # successful response streams out must not count as a
             # serving error (nor trigger a doomed second send).
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                code, resp = 200, ms.generate(req)
+                code, resp = 200, handler(req)
             except ValueError as e:
                 with ms._stats_lock:
                     ms.errors += 1

@@ -421,3 +421,106 @@ class TestMetrics:
         assert metrics["ptpu_serving_tokens_generated_total"] >= 4
         assert metrics["ptpu_serving_request_seconds_count"] >= 1
         assert metrics["ptpu_serving_request_seconds_sum"] > 0
+
+
+class TestPrefixCache:
+    """Prefix caching (round 5): /prefill registers a prompt's KV
+    prefill; /generate requests extending it skip that prefill and
+    must be BIT-IDENTICAL to cold responses."""
+
+    def _server(self, **kw):
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        ms = ModelServer(model, variables, max_batch=4, **kw)
+        srv = make_server("127.0.0.1", 0, ms)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return ms, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def _post_to(self, base, path, payload, expect=200):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == expect
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            assert e.code == expect, e.read()
+            return json.loads(e.read())
+
+    def test_hit_is_bit_identical_to_cold(self):
+        ms, srv, base = self._server()
+        try:
+            system = [7, 3, 9, 2, 5, 1]
+            user = system + [4, 8]
+            # cold responses first (greedy + sampled)
+            cold_g = self._post_to(base, "/generate",
+                                   {"prompt": user,
+                                    "max_new_tokens": 5})
+            cold_s = self._post_to(base, "/generate",
+                                   {"prompt": user, "max_new_tokens": 5,
+                                    "temperature": 0.8, "seed": 9})
+            assert "prefix_hit_len" not in cold_g
+            # register the system prefix
+            r = self._post_to(base, "/prefill", {"prompt": system})
+            assert r["cached_len"] == len(system)
+            warm_g = self._post_to(base, "/generate",
+                                   {"prompt": user,
+                                    "max_new_tokens": 5})
+            assert warm_g["prefix_hit_len"] == len(system)
+            assert warm_g["new_tokens"] == cold_g["new_tokens"]
+            warm_s = self._post_to(base, "/generate",
+                                   {"prompt": user, "max_new_tokens": 5,
+                                    "temperature": 0.8, "seed": 9})
+            assert warm_s["new_tokens"] == cold_s["new_tokens"]
+            info = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            assert info["prefix_hits"] == 2
+            # the extension stored the longer prompt: exact repeat now
+            # hits at FULL length (session growth)
+            again = self._post_to(base, "/generate",
+                                  {"prompt": user,
+                                   "max_new_tokens": 5})
+            assert again["prefix_hit_len"] == len(user)
+            assert again["new_tokens"] == cold_g["new_tokens"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_prefill_validation(self):
+        ms, srv, base = self._server()
+        try:
+            # over max_position: 400 in the validation layer
+            out = self._post_to(base, "/prefill",
+                                {"prompt": [1] * 500}, expect=400)
+            assert "max_position" in out["error"]
+            # boolean prefill_chunk refused like /generate's
+            out = self._post_to(base, "/prefill",
+                                {"prompt": [1, 2],
+                                 "prefill_chunk": True}, expect=400)
+            assert "boolean" in out["error"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_lru_bound_and_disable(self):
+        ms, srv, base = self._server(prefix_cache=2)
+        try:
+            for i in range(3):
+                self._post_to(base, "/prefill",
+                              {"prompt": [i + 1, i + 2, i + 3]})
+            info = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            assert info["prefix_entries"] == 2  # LRU evicted the first
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        ms2, srv2, base2 = self._server(prefix_cache=0)
+        try:
+            out = self._post_to(base2, "/prefill", {"prompt": [1, 2]},
+                                expect=400)
+            assert "disabled" in out["error"]
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
