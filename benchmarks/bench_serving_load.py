@@ -167,6 +167,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         finally:
             srv.shutdown()
             srv.server_close()  # release the listening socket too
+    prefix = bench_prefix_cache(model, variables, model_name, vocab)
     return {
         "model": model_name,
         "backend": backend,
@@ -177,7 +178,61 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         # Headline comparison: best coalesced vs best serialized
         # aggregate throughput at the max client count.
         "speedup_at_max_clients": _speedup(rows, max(client_counts)),
+        **prefix,
     }
+
+
+def bench_prefix_cache(model, variables, model_name: str, vocab: int):
+    """Prefix-cache A/B: a LONG registered system prompt + a short
+    user suffix.  The warm timed request repeats a prompt the cache
+    has seen (the session-repeat case — first warm request extended
+    and stored it), so the latency gap is the whole prefill cost
+    saved per request; exactness vs the cold response is asserted."""
+    import numpy as np
+
+    from polyaxon_tpu.serving import ModelServer, make_server
+
+    sys_len, user_len, new = 512, 16, 32
+    max_pos = getattr(getattr(model, "cfg", None), "max_position",
+                      None) or 10**9
+    if sys_len + user_len + new >= max_pos:
+        sys_len = max(8, max_pos - user_len - new - 1)
+    rng = np.random.RandomState(3)
+    system = rng.randint(0, vocab, size=sys_len).tolist()
+    prompt = system + rng.randint(0, vocab, size=user_len).tolist()
+
+    ms = ModelServer(model, variables, model_name=model_name,
+                     max_batch=1)
+    srv = make_server("127.0.0.1", 0, ms)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        body = {"prompt": prompt, "max_new_tokens": new}
+        _post(base, body, timeout=900)  # compile warm (cold program)
+        t0 = time.perf_counter()
+        cold = _post(base, body)
+        cold_s = time.perf_counter() - t0
+        req = urllib.request.Request(
+            base + "/prefill",
+            data=json.dumps({"prompt": system}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=900) as r:
+            r.read()
+        _post(base, body, timeout=900)  # compile warm (split program)
+        t0 = time.perf_counter()
+        warm = _post(base, body)
+        warm_s = time.perf_counter() - t0
+        assert warm["new_tokens"] == cold["new_tokens"]  # exactness
+        return {
+            "prefix_system_len": sys_len,
+            "prefix_cold_ms": round(1e3 * cold_s, 1),
+            "prefix_warm_ms": round(1e3 * warm_s, 1),
+            "prefix_speedup": round(cold_s / warm_s, 3),
+        }
+    finally:
+        srv.shutdown()
+        srv.server_close()
 
 
 def _speedup(rows, n):
