@@ -642,19 +642,13 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     k = num_beams
-    if not getattr(getattr(model, "cfg", None), "scan_layers", True):
-        # The per-beam tile (jnp.repeat axis=1) and parent reorder
-        # (jnp.take axis=1) below address the BATCH axis of the
-        # scan-stacked [layers, B, S, ...] cache.  With unstacked
-        # layers the cache entries are [B, S, ...] — axis 1 is the
-        # POSITION axis, and the reorder would silently permute
-        # positions into garbage output (ADVICE r2).
-        raise NotImplementedError(
-            "generate_beam requires a scan-stacked cache "
-            "(cfg.scan_layers=True); with scan_layers=False the beam "
-            "reorder would gather the position axis instead of beams. "
-            "Use greedy generate(), or a scan_layers build of the "
-            "model.")
+    # The per-beam tile and parent reorder address the BATCH axis of
+    # the cache entries: axis 1 for the scan-stacked [layers, B, S,
+    # ...] layout, axis 0 for unstacked [B, S, ...] entries (round 5
+    # — previously refused; gathering the wrong axis would permute
+    # POSITIONS into garbage, ADVICE r2, so the axis is layout-keyed).
+    batch_axis = 1 if getattr(getattr(model, "cfg", None),
+                              "scan_layers", True) else 0
     ring = getattr(getattr(model, "cfg", None), "kv_cache_ring", False)
     max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
     # Ring caches are position-keyed, not capacity-bounded: beam
@@ -682,13 +676,15 @@ def generate_beam(model, variables, prompt, *, max_new_tokens: int,
 
     seq = _beam_loop(apply_step, cache, first_logits, b=b,
                      max_new_tokens=max_new_tokens, num_beams=k,
-                     eos_id=eos_id, length_penalty=length_penalty)
+                     eos_id=eos_id, length_penalty=length_penalty,
+                     batch_axis=batch_axis)
     return jnp.concatenate([prompt, seq], axis=1)
 
 
 def _beam_loop(apply_step, cache, first_logits, *, b: int,
                max_new_tokens: int, num_beams: int,
-               eos_id: Optional[int], length_penalty: float):
+               eos_id: Optional[int], length_penalty: float,
+               batch_axis: int = 1):
     """Shared beam-search machinery for :func:`generate_beam` and
     :func:`generate_beam_seq2seq`.
 
@@ -696,9 +692,12 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
     decoder step on ``toks_flat`` [B*K, 1] at scan tick ``t``;
     ``first_logits`` [B, V] are the prefill's last-position logits and
     ``cache`` the post-prefill (un-tiled, batch B) cache.  Beams live
-    b-major on axis 1 of the stacked [layers, B*K, ...] cache entries
-    (axis 0 of cache_index-like scalars is layers too, so only rank>=2
-    tiles/reorders).  Returns the generated tokens [B, max_new_tokens].
+    b-major on the cache entries' BATCH axis — ``batch_axis`` keys the
+    layout: 1 for scan-stacked [layers, B*K, ...] entries, 0 for
+    unstacked [B*K, ...] ones.  Only rank>=2 leaves tile/reorder
+    (cache_index scalars/[layers] vectors skip by rank; the ring's
+    batch-less cached_pos by name).  Returns the generated tokens
+    [B, max_new_tokens].
     """
     k = num_beams
     lp = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
@@ -713,7 +712,7 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
         return "cached_pos" in jax.tree_util.keystr(path)
 
     cache = jax.tree_util.tree_map_with_path(
-        lambda p, x: jnp.repeat(x, k, axis=1)
+        lambda p, x: jnp.repeat(x, k, axis=batch_axis)
         if x.ndim >= 2 and not _batch_invariant(p) else x,
         cache)
     done = (first == eos_id) if eos_id is not None \
@@ -749,7 +748,7 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
             if x.ndim < 2 or "cross_" in jax.tree_util.keystr(path) \
                     or _batch_invariant(path):
                 return x
-            return jnp.take(x, flat_parent, axis=1)
+            return jnp.take(x, flat_parent, axis=batch_axis)
 
         cache = jax.tree_util.tree_map_with_path(reorder, cache)
         done = jnp.take_along_axis(done, parent, axis=1)
@@ -808,10 +807,9 @@ def generate_beam_seq2seq(model, variables, enc_tokens, *,
                          f"{max_new_tokens}")
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1; got {num_beams}")
-    if not getattr(model.cfg, "scan_layers", True):
-        raise NotImplementedError(
-            "beam search requires a scan-stacked cache "
-            "(cfg.scan_layers=True); see generate_beam.")
+    # Cache-entry batch axis follows the layout (see generate_beam):
+    # 1 for scanned [layers, B, ...], 0 for unstacked [B, ...].
+    batch_axis = 1 if getattr(model.cfg, "scan_layers", True) else 0
     if start_id is None:
         start_id = model.cfg.pad_id
     max_pos = getattr(model.cfg, "max_position", None)
@@ -848,4 +846,5 @@ def generate_beam_seq2seq(model, variables, enc_tokens, *,
     return _beam_loop(apply_step, mut["cache"],
                       extract_logits(out)[:, -1], b=b,
                       max_new_tokens=max_new_tokens, num_beams=num_beams,
-                      eos_id=eos_id, length_penalty=length_penalty)
+                      eos_id=eos_id, length_penalty=length_penalty,
+                      batch_axis=batch_axis)

@@ -564,14 +564,6 @@ class ModelServer:
         for label, m in models:
             cfg = getattr(m, "cfg", None)
             max_pos = getattr(cfg, "max_position", None)
-            if beams > 1 and not getattr(cfg, "scan_layers", True):
-                # generate_beam needs the scan-stacked cache layout;
-                # reject here so the client gets a 400 instead of a
-                # 500 from the NotImplementedError at jit-trace time
-                # inside the locked device section.
-                raise ValueError(
-                    f"beam search requires a scan-stacked {label} "
-                    f"(cfg.scan_layers=True)")
             if getattr(cfg, "kv_cache_ring", False):
                 ring_slack = getattr(cfg, "kv_cache_ring_slack", 0)
                 if speculative and ring_slack < spec_k - 1:

@@ -371,21 +371,37 @@ def test_beam_search_jits_and_shapes():
                                   np.asarray(prompt))
 
 
-def test_beam_search_rejects_unstacked_cache():
-    """With scan_layers=False cache entries are [B, S, ...]: the beam
-    tile/reorder on axis 1 would permute POSITIONS, not beams, and
-    silently emit garbage (ADVICE r2) — it must raise instead."""
+def test_beam_search_unstacked_matches_scanned():
+    """Beam search works on UNSTACKED (scan_layers=False) caches
+    (round 5 — previously refused): the per-beam tile/reorder targets
+    the layout's batch axis (0 for [B, S, ...] entries vs 1 for
+    scanned [layers, B, S, ...]).  Oracle: identical weights carried
+    across layouts (h_i params stacked into the scanned [L, ...]
+    layout) must produce bit-identical beam output."""
+    import dataclasses
+
     from polyaxon_tpu.models.generate import generate_beam
     from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
 
-    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
-                      intermediate_size=64, num_layers=2, num_heads=2,
-                      num_kv_heads=1, max_position=32,
-                      scan_layers=False, dtype=jnp.float32)
-    model = LlamaModel(cfg)
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 4), jnp.int32))
-    with pytest.raises(NotImplementedError, match="scan-stacked"):
-        generate_beam(model, variables,
-                      jnp.zeros((1, 4), jnp.int32),
-                      max_new_tokens=3, num_beams=2)
+    flat_cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                           intermediate_size=64, num_layers=2,
+                           num_heads=2, num_kv_heads=1,
+                           max_position=32, scan_layers=False,
+                           dtype=jnp.float32)
+    flat = LlamaModel(flat_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0, 64)
+    variables = flat.init(jax.random.PRNGKey(0), prompt)
+
+    got = generate_beam(flat, variables, prompt, max_new_tokens=6,
+                        num_beams=3)
+
+    # Same weights, scanned layout: stack h_0..h_{L-1} leaf-wise.
+    p = dict(variables["params"])
+    blocks = [p.pop(f"h_{i}") for i in range(flat_cfg.num_layers)]
+    p["h"] = {"block": jax.tree.map(lambda *xs: jnp.stack(xs),
+                                    *blocks)}
+    scanned = LlamaModel(dataclasses.replace(flat_cfg,
+                                             scan_layers=True))
+    want = generate_beam(scanned, {"params": p}, prompt,
+                         max_new_tokens=6, num_beams=3)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))

@@ -155,3 +155,20 @@ def test_ring_beam_streams_past_max_position():
     with pytest.raises(ValueError, match="max_position"):
         G.generate_beam(small_std, variables, prompt,
                         max_new_tokens=40, num_beams=2)
+
+
+def test_ring_beam_unstacked_layers():
+    """Ring cache + UNSTACKED layers + beam (all three newly compose
+    in round 5): the unstacked ring's cached_pos is [cap] (rank 1 —
+    skipped by rank, not name) and K/V are [B, cap, ...] (batch axis
+    0).  Oracle: bit-identical to beam on the standard windowed cache
+    in the same unstacked layout."""
+    base_cfg, ring_cfg = _cfgs()
+    flat_base = dataclasses.replace(base_cfg, scan_layers=False)
+    flat_ring = dataclasses.replace(ring_cfg, scan_layers=False)
+    model, variables, prompt = _init(flat_base)
+    want = G.generate_beam(model, variables, prompt,
+                           max_new_tokens=12, num_beams=3)
+    got = G.generate_beam(LlamaModel(cfg=flat_ring), variables,
+                          prompt, max_new_tokens=12, num_beams=3)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))

@@ -360,16 +360,27 @@ class TestRingBeam:
                              max_new_tokens=4, num_beams=2)
         assert out["tokens"] == np.asarray(want).tolist()
 
-    def test_beam_on_unstacked_layers_is_400(self):
-        """scan_layers=False has no beam support (position-axis cache
-        layout): the validation layer rejects it before the device
-        lock."""
+    def test_beam_on_unstacked_layers_serves(self):
+        """Beam on scan_layers=False models works (round 5: the beam
+        tile/reorder targets the layout's batch axis) — the server
+        must serve it, matching the library's output."""
+        import numpy as np
+
+        from polyaxon_tpu.models.generate import generate_beam
+
         spec = get_model("llama-tiny")
-        model, variables = spec.init_params(batch_size=1)
         flat = spec.make_model(scan_layers=False)
+        import jax
+        import jax.numpy as jnp
+        variables = flat.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 3), jnp.int32))
         ms = ModelServer(flat, variables)
-        with pytest.raises(ValueError, match="scan-stacked"):
-            ms.generate({"prompt": [1, 2, 3], "num_beams": 2})
+        out = ms.generate({"prompt": [1, 2, 3], "num_beams": 2,
+                           "max_new_tokens": 4})
+        want = generate_beam(flat, variables,
+                             np.asarray([[1, 2, 3]], np.int32),
+                             max_new_tokens=4, num_beams=2)
+        assert out["tokens"] == np.asarray(want).tolist()
 
 
 class TestSampledSpeculative:

@@ -292,3 +292,36 @@ class TestT5HF:
             ref = hf(input_ids=torch.tensor(src),
                      decoder_input_ids=torch.tensor(dec)).logits.numpy()
         np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_beam_unstacked_matches_scanned_seq2seq():
+    """Seq2seq beam on scan_layers=False (round 5 — previously
+    refused): identical weights carried across layouts must produce
+    bit-identical beam output (cross-attention K/V tile on the
+    layout's batch axis; reorder still skips them)."""
+    import dataclasses
+
+    from polyaxon_tpu.models.generate import generate_beam_seq2seq
+
+    spec = get_model("t5-tiny")
+    _, flat_vars = spec.init_params(batch_size=2, dtype=jnp.float32,
+                                    scan_layers=False)
+    flat = spec.make_model(dtype=jnp.float32, scan_layers=False)
+    cfg = flat.cfg
+    rng = np.random.RandomState(8)
+    src = jnp.asarray(rng.randint(0, 512, (2, 7)), jnp.int32)
+    got = np.asarray(generate_beam_seq2seq(
+        flat, flat_vars, src, max_new_tokens=5, num_beams=3))
+
+    p = dict(flat_vars["params"])
+    # stack encoder + decoder block params into the scanned layout
+    # (flat: top-level enc_0..enc_{n-1}; scanned: enc -> block)
+    for stack, n in (("enc", cfg.num_layers),
+                     ("dec", cfg.num_decoder_layers)):
+        blocks = [p.pop(f"{stack}_{i}") for i in range(n)]
+        p[stack] = {"block": jax.tree.map(
+            lambda *xs: jnp.stack(xs), *blocks)}
+    scanned = spec.make_model(dtype=jnp.float32)
+    want = np.asarray(generate_beam_seq2seq(
+        scanned, {"params": p}, src, max_new_tokens=5, num_beams=3))
+    np.testing.assert_array_equal(want, got)
