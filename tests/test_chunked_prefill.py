@@ -133,3 +133,90 @@ def test_under_jit():
     want = np.asarray(G.generate(model, variables, prompt,
                                  max_new_tokens=5))
     np.testing.assert_array_equal(want, np.asarray(fn(prompt)))
+
+
+class TestPrefillContinueSplit:
+    """The public prefill/generate_continue split (round 5 — the
+    prefix-cache building blocks): the same program as fused
+    generate(), cut at the prefill/decode boundary."""
+
+    def _setup(self):
+        from polyaxon_tpu.models.registry import get_model
+
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=2)
+        p = np.random.RandomState(0).randint(
+            0, model.cfg.vocab_size, (2, 10)).astype("int32")
+        return model, variables, p
+
+    def test_split_equals_fused_greedy_and_sampled(self):
+        from polyaxon_tpu.models.generate import (generate,
+                                                  generate_continue,
+                                                  prefill)
+
+        model, variables, p = self._setup()
+        want = generate(model, variables, p, max_new_tokens=6)
+        lg, cache = prefill(model, variables, p)
+        new = generate_continue(model, variables, cache, lg, 10,
+                                max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(want)[:, 10:],
+                                      np.asarray(new))
+        rng = jax.random.PRNGKey(5)
+        want_s = generate(model, variables, p, max_new_tokens=6,
+                          temperature=0.8, rng=rng)
+        lg, cache = prefill(model, variables, p)
+        new_s = generate_continue(model, variables, cache, lg, 10,
+                                  max_new_tokens=6, temperature=0.8,
+                                  rng=rng)
+        np.testing.assert_array_equal(np.asarray(want_s)[:, 10:],
+                                      np.asarray(new_s))
+
+    def test_extension_equals_one_shot(self):
+        """prefill(suffix, cache=, position=) after prefill(prefix)
+        must equal prefill(prefix ++ suffix) — logits AND the decode
+        that follows."""
+        from polyaxon_tpu.models.generate import (generate_continue,
+                                                  prefill)
+
+        model, variables, p = self._setup()
+        lg1, c1 = prefill(model, variables, p[:, :6])
+        lg2, c2 = prefill(model, variables, p[:, 6:], cache=c1,
+                          position=6)
+        lgf, cf = prefill(model, variables, p)
+        np.testing.assert_allclose(np.asarray(lg2), np.asarray(lgf),
+                                   atol=1e-5, rtol=1e-5)
+        a = generate_continue(model, variables, c2, lg2, 10,
+                              max_new_tokens=6)
+        bb = generate_continue(model, variables, cf, lgf, 10,
+                               max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+
+    def test_chunked_extension_composes(self):
+        """Chunked extension (chunk smaller than the suffix) through
+        the public surface still matches one-shot."""
+        from polyaxon_tpu.models.generate import prefill
+
+        model, variables, p = self._setup()
+        lg1, c1 = prefill(model, variables, p[:, :4])
+        lg2, _ = prefill(model, variables, p[:, 4:], cache=c1,
+                         position=4, chunk=2)
+        lgf, _ = prefill(model, variables, p)
+        np.testing.assert_allclose(np.asarray(lg2), np.asarray(lgf),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_continue_validates_capacity(self):
+        from polyaxon_tpu.models.generate import (generate_continue,
+                                                  prefill)
+
+        model, variables, p = self._setup()
+        lg, cache = prefill(model, variables, p)
+        max_pos = model.cfg.max_position
+        # exactly filling the remaining capacity is accepted...
+        out = generate_continue(model, variables, cache, lg, 10,
+                                max_new_tokens=max_pos - 10)
+        assert out.shape == (2, max_pos - 10)
+        # ...one past it refuses (tight boundary)
+        lg, cache = prefill(model, variables, p)
+        with pytest.raises(ValueError, match="max_position"):
+            generate_continue(model, variables, cache, lg, 10,
+                              max_new_tokens=max_pos - 10 + 1)
