@@ -535,3 +535,70 @@ class TestPrefixCache:
         finally:
             srv2.shutdown()
             srv2.server_close()
+
+
+@pytest.mark.slow
+class TestRequestSpace:
+    """Seeded property test over the request-combination space the
+    round-5 features opened up (lengths x greedy/sampled/beam/
+    speculative/sampled-speculative x eos x chunk x prefix hits):
+    every response is well-formed and greedy repeats replay
+    bit-identically across the cold, warm-prefix, and solo paths.
+    (Concurrent coalescing and the HTTP error surface have their own
+    dedicated tests above.)"""
+
+    def test_randomized_requests_deterministic(self):
+        import random
+
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=2)
+        ms = ModelServer(model, variables, max_batch=4,
+                         draft_model=model, draft_variables=variables)
+        rng = random.Random(12345)
+        vocab = model.cfg.vocab_size
+        # one registered prefix so hits interleave with cold paths
+        ms.prefill_prompt({"prompt": [3, 1, 4]})
+
+        greedy_outputs = {}
+        for i in range(60):
+            p_len = rng.choice([2, 3, 4, 6])
+            b = rng.choice([1, 1, 1, 2])
+            rows = [[rng.randrange(0, vocab) for _ in range(p_len)]
+                    for _ in range(b)]
+            if rng.random() < 0.3:  # force prefix-hit candidates
+                rows = [[3, 1, 4] + r[:p_len - 3] for r in rows] \
+                    if p_len > 3 and b == 1 else rows
+            new = rng.choice([1, 3, 5])
+            req = {"prompt": rows if b > 1 else rows[0],
+                   "max_new_tokens": new}
+            mode = rng.choice(["greedy", "sampled", "beam", "spec",
+                               "spec-sampled"])
+            if mode == "sampled":
+                req.update(temperature=0.8, seed=rng.randrange(99))
+            elif mode == "beam":
+                req.update(num_beams=2)
+            elif mode == "spec":
+                req.update(speculative=True, spec_k=2)
+            elif mode == "spec-sampled":
+                req.update(speculative=True, spec_k=2,
+                           temperature=0.7, seed=rng.randrange(99))
+            if rng.random() < 0.2 and p_len > 2:
+                req["prefill_chunk"] = 2
+            if rng.random() < 0.2:
+                req["eos_id"] = rng.randrange(0, vocab)
+            out = ms.generate(dict(req))
+            # well-formed: every row has exactly `new` new tokens in
+            # vocab range
+            assert len(out["new_tokens"]) == b
+            for row in out["new_tokens"]:
+                assert len(row) == new
+                assert all(0 <= t < vocab for t in row)
+            if mode == "greedy":
+                key = json.dumps(req, sort_keys=True)
+                prev = greedy_outputs.get(key)
+                if prev is not None:
+                    # replay determinism across cold/warm/coalesced
+                    assert prev == out["new_tokens"], key
+                greedy_outputs[key] = out["new_tokens"]
+        # the run exercised prefix hits
+        assert ms.prefix_hits > 0
