@@ -227,6 +227,27 @@ def _module_flops(compiled) -> float:
     return float(cost.get("flops", 0.0))
 
 
+def scan_bridge(probes, num_layers: int):
+    """The ONE place that owns the scanned-transformer bridge
+    arithmetic (shared by reconcile_flops and
+    benchmarks/bench_offline_v5e.bridge_scanned — keep the two
+    callers' corrections consistent by changing it HERE).
+
+    ``probes``: per-depth measurements ``[(value_at_L1, ...),
+    (value_at_L2, ...)]`` — any number of parallel quantities (flops,
+    bytes).  Returns the full-depth reconstruction
+    ``v1 + (L-1)*(v2-v1)`` per quantity, or None if any probe value
+    is falsy (cost analysis unavailable).
+    """
+    (p1, p2) = probes
+    out = []
+    for v1, v2 in zip(p1, p2):
+        if not v1 or not v2:
+            return None
+        out.append(v1 + (num_layers - 1) * (v2 - v1))
+    return tuple(out)
+
+
 def _probe_cost_flops(jax, spec, batch_size: int, overrides,
                       optimizer) -> float:
     """Per-chip XLA cost-analysis FLOPs of one train step compiled
@@ -276,10 +297,11 @@ def reconcile_flops(jax, spec, batch_size: int, overrides, optimizer,
                            {**ov, "num_layers": 1}, optimizer)
     f2 = _probe_cost_flops(jax, spec, batch_size,
                            {**ov, "num_layers": 2}, optimizer)
-    if not f1 or not f2:
+    bridged = scan_bridge([(f1,), (f2,)], L)
+    if bridged is None:
         return None
+    (xla_unrolled,) = bridged
     body = f2 - f1
-    xla_unrolled = f1 + (L - 1) * body
     attn = 0.0
     if backend == "tpu":
         if spec.attn_flops is None:

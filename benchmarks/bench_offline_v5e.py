@@ -107,10 +107,15 @@ def bridge_scanned(jax, model_name, batch_size, overrides):
             cost = cost[0] if cost else {}
         probes.append((float(cost.get("flops", 0.0)),
                        float(cost.get("bytes accessed", 0.0))))
-    (f1, b1), (f2, b2) = probes
-    if not (f1 and f2 and b1 and b2):
+    # One shared reconstruction (bench.scan_bridge) — the flops-only
+    # TPU-side bridge (bench.reconcile_flops) and this flops+bytes
+    # deviceless one must never drift on the arithmetic.  The callers
+    # still differ deliberately on the attention add-back: per-chip
+    # normalized there, global here (deviceless single-chip module).
+    bridged = B.scan_bridge(probes, L)
+    if bridged is None:
         return None, None
-    return f1 + (L - 1) * (f2 - f1), b1 + (L - 1) * (b2 - b1)
+    return bridged
 
 
 def analyze(jax, model_name, batch_size, compiled, spec, variant=None,

@@ -711,10 +711,24 @@ def _beam_loop(apply_step, cache, first_logits, *, b: int,
         # parent-gathering it would corrupt the slot arithmetic.
         return "cached_pos" in jax.tree_util.keystr(path)
 
-    cache = jax.tree_util.tree_map_with_path(
-        lambda p, x: jnp.repeat(x, k, axis=batch_axis)
-        if x.ndim >= 2 and not _batch_invariant(p) else x,
-        cache)
+    def _tile(path, x):
+        if x.ndim < 2 or _batch_invariant(path):
+            return x
+        if x.shape[batch_axis] != b:
+            # Structural guard (ADVICE r2 failure class): a rank>=2
+            # cache leaf whose expected batch axis is NOT batch-sized
+            # would be tiled/gathered along slots or positions and
+            # silently emit garbage — fail loudly naming the leaf so
+            # a new batch-less cache table gets added to the skip
+            # list instead of corrupting beams.
+            raise ValueError(
+                f"beam search cannot tile cache leaf "
+                f"{jax.tree_util.keystr(path)}: axis {batch_axis} has "
+                f"size {x.shape[batch_axis]}, expected batch {b} "
+                f"(batch-less tables must be skipped explicitly)")
+        return jnp.repeat(x, k, axis=batch_axis)
+
+    cache = jax.tree_util.tree_map_with_path(_tile, cache)
     done = (first == eos_id) if eos_id is not None \
         else jnp.zeros((b, k), bool)
     # Per-beam GENERATED length at finish (the length-penalty

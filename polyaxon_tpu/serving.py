@@ -312,7 +312,12 @@ class ModelServer:
                 f"prompt ({len(rows[0])}) exceeds the model's "
                 f"max_position ({max_pos})")
         chunk = req.get("prefill_chunk")
-        chunk = None if chunk is None else _int_param(chunk)
+        try:
+            chunk = None if chunk is None else _int_param(chunk)
+        except (TypeError, ValueError):
+            # normalized 400, same contract as /generate (a list or
+            # string here must not surface as a 500 TypeError)
+            raise ValueError("prefill_chunk must be an int")
         if chunk is not None and chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         toks = np.asarray(rows, np.int32)

@@ -272,3 +272,19 @@ class TestShippedExamples:
             if getattr(run, "kind", None) in RunKind.DISTRIBUTED:
                 topo = topo_normalize(run)
                 assert topo.num_processes >= 1, f
+
+    def test_longcontext_strategy_tracks_param(self):
+        """The longcontext example's run.strategy templates its sp
+        axis from the input, so -P sp=N keeps the compiled spec's
+        metadata and the worker's --strategy in sync."""
+        from pathlib import Path
+
+        from polyaxon_tpu.compiler import resolve as compile_resolve
+
+        repo = Path(__file__).resolve().parent.parent
+        f = str(repo / "examples" / "longcontext" / "polyaxonfile.yaml")
+        op = check_polyaxonfile(f, params={"sp": "4"})
+        assert compile_resolve(op, "u1").run.strategy == \
+            {"dp": -1, "sp": 4}
+        assert compile_resolve(check_polyaxonfile(f),
+                               "u2").run.strategy == {"dp": -1, "sp": 8}

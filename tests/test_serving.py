@@ -506,11 +506,14 @@ class TestPrefixCache:
             out = self._post_to(base, "/prefill",
                                 {"prompt": [1] * 500}, expect=400)
             assert "max_position" in out["error"]
-            # boolean prefill_chunk refused like /generate's
-            out = self._post_to(base, "/prefill",
-                                {"prompt": [1, 2],
-                                 "prefill_chunk": True}, expect=400)
-            assert "boolean" in out["error"]
+            # boolean / non-scalar prefill_chunk: normalized 400s,
+            # same message contract as /generate
+            for bad in (True, [1], "x"):
+                out = self._post_to(base, "/prefill",
+                                    {"prompt": [1, 2],
+                                     "prefill_chunk": bad},
+                                    expect=400)
+                assert "prefill_chunk must be an int" in out["error"]
         finally:
             srv.shutdown()
             srv.server_close()
