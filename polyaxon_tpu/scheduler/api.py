@@ -25,6 +25,10 @@ Endpoints (all under ``/api/v1``):
     GET    /runs/<u>/lineage
     POST   /agent/claim                  {agent, queues?} -> next queued run
     GET    /healthz
+    GET    /metrics                      Prometheus text (runs by
+                                         status, queue depth, agents);
+                                         also served at the ROOT path
+                                         /metrics for scrapers
 """
 
 from __future__ import annotations
@@ -37,7 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..client.store import FileRunStore, StoreError
-from ..lifecycle import V1Statuses
+from ..lifecycle import V1Statuses, is_done as _is_done_status
 
 
 class ApiError(Exception):
@@ -59,6 +63,42 @@ class ControlPlane:
         self.store = store or FileRunStore()
         self.auth_token = auth_token  # None = open (single-user/local)
         self._claim_lock = threading.Lock()
+
+    # -- observability ---------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of control-plane state: runs by
+        status, queue depth per queue, claimed-agent count (SURVEY
+        §5.5 — the scrape surface an in-cluster deployment pairs with
+        the model server's /metrics)."""
+        from collections import Counter
+
+        runs = self.store.list_runs()
+        by_status = Counter((r.get("status") or "unknown")
+                            for r in runs)
+        queued_by_queue = Counter(
+            (r.get("queue") or "default") for r in runs
+            if r.get("status") == V1Statuses.QUEUED)
+        agents = {r.get("agent") for r in runs
+                  if r.get("agent") and not _is_done_status(
+                      r.get("status"))}
+        def esc(v: str) -> str:
+            # Prometheus label-value escaping: a user-supplied queue
+            # name with a quote/newline must not invalidate the WHOLE
+            # scrape.
+            return (str(v).replace("\\", "\\\\")
+                    .replace('"', '\\"').replace("\n", "\\n"))
+
+        lines = ["# TYPE ptpu_runs gauge"]
+        for status, n in sorted(by_status.items()):
+            lines.append(f'ptpu_runs{{status="{esc(status)}"}} {n}')
+        lines.append("# TYPE ptpu_queue_depth gauge")
+        for queue, n in sorted(queued_by_queue.items()):
+            lines.append(
+                f'ptpu_queue_depth{{queue="{esc(queue)}"}} {n}')
+        lines += ["# TYPE ptpu_active_agents gauge",
+                  f"ptpu_active_agents {len(agents)}"]
+        return "\n".join(lines) + "\n"
 
     # -- queue ----------------------------------------------------------
 
@@ -247,6 +287,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- dispatch -------------------------------------------------------
 
+    def _authorized(self) -> bool:
+        """ONE bearer-token check for every protected route (API and
+        /metrics) — auth fixes must not diverge between them."""
+        if not self.plane.auth_token:
+            return True
+        import hmac
+
+        supplied = (self.headers.get("Authorization") or "")
+        return hmac.compare_digest(supplied.removeprefix("Bearer "),
+                                   self.plane.auth_token)
+
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
         if method == "GET" and parsed.path in ("/", "/ui"):
@@ -261,16 +312,24 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(blob)
             return
+        if method == "GET" and parsed.path in ("/metrics",
+                                               "/api/v1/metrics"):
+            if not self._authorized():
+                return _json_response(self, 401,
+                                      {"error": "unauthorized"})
+            blob = self.plane.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+            return
         if not parsed.path.startswith("/api/v1"):
             return _json_response(self, 404, {"error": "not found"})
         path = parsed.path[len("/api/v1"):] or "/"
-        if self.plane.auth_token and path != "/healthz":
-            import hmac
-
-            supplied = (self.headers.get("Authorization") or "")
-            if not hmac.compare_digest(supplied.removeprefix("Bearer "),
-                                       self.plane.auth_token):
-                return _json_response(self, 401, {"error": "unauthorized"})
+        if path != "/healthz" and not self._authorized():
+            return _json_response(self, 401, {"error": "unauthorized"})
         params = {k: v[0] for k, v in
                   urllib.parse.parse_qs(parsed.query).items()}
         body: Dict[str, Any] = {}

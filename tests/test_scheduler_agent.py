@@ -456,3 +456,77 @@ def test_list_runs_inlines_metrics(tmp_path):
         assert "last_metrics" not in runs[0]
     finally:
         server.shutdown()
+
+
+class TestControlPlaneMetrics:
+    def test_metrics_endpoint(self, store, api):
+        """GET /metrics: Prometheus text with runs-by-status, queue
+        depth per queue, and active-agent gauges (SURVEY 5.5)."""
+        import urllib.request
+
+        r1 = store.create_run(name="m1", project="p",
+                              content=JOB_CONTENT, queue="fast")
+        store.set_status(r1["uuid"], V1Statuses.QUEUED)
+        r2 = store.create_run(name="m2", project="p",
+                              content=JOB_CONTENT)
+        store.set_status(r2["uuid"], V1Statuses.QUEUED)
+        r3 = store.create_run(name="m3", project="p",
+                              content=JOB_CONTENT)
+        store.set_status(r3["uuid"], V1Statuses.RUNNING)
+        store.update_run(r3["uuid"], agent="agent-7")
+
+        base = api.host
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=30) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        metrics = {}
+        for line in body.splitlines():
+            if line and not line.startswith("#"):
+                name, _, value = line.rpartition(" ")
+                metrics[name] = float(value)
+        assert metrics['ptpu_runs{status="queued"}'] == 2
+        assert metrics['ptpu_runs{status="running"}'] == 1
+        assert metrics['ptpu_queue_depth{queue="fast"}'] == 1
+        assert metrics['ptpu_queue_depth{queue="default"}'] == 1
+        assert metrics["ptpu_active_agents"] == 1
+
+    def test_metrics_escapes_label_values(self, store, api):
+        """A user-supplied queue name with a quote must not invalidate
+        the whole scrape (Prometheus label escaping)."""
+        import urllib.request
+
+        r = store.create_run(name="mq", project="p",
+                             content=JOB_CONTENT, queue='fa"st')
+        store.set_status(r["uuid"], V1Statuses.QUEUED)
+        with urllib.request.urlopen(api.host + "/metrics",
+                                    timeout=30) as resp:
+            body = resp.read().decode()
+        assert 'ptpu_queue_depth{queue="fa\\"st"} 1' in body
+
+    def test_metrics_requires_token_when_set(self, store):
+        import urllib.error
+        import urllib.request
+
+        from polyaxon_tpu.scheduler import ControlPlane
+
+        port = _free_port()
+        server = make_server(
+            "127.0.0.1", port, store,
+            plane=ControlPlane(store, auth_token="s3c"))
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10)
+            assert err.value.code == 401
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Authorization": "Bearer s3c"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+        finally:
+            server.shutdown()
+            server.server_close()
