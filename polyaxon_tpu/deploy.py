@@ -145,7 +145,14 @@ def control_plane(config: DeploymentConfig) -> List[Dict[str, Any]]:
             "template": {
                 "metadata": {"labels":
                              {"app.kubernetes.io/name":
-                              "polyaxon-tpu-api"}},
+                              "polyaxon-tpu-api"},
+                             # the control plane serves Prometheus
+                             # text at /metrics (scheduler/api.py)
+                             "annotations": {
+                                 "prometheus.io/scrape": "true",
+                                 "prometheus.io/path": "/metrics",
+                                 "prometheus.io/port":
+                                     str(config.api_port)}},
                 "spec": {
                     "serviceAccountName": config.service_account,
                     "containers": [{

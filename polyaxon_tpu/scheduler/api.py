@@ -66,13 +66,25 @@ class ControlPlane:
 
     # -- observability ---------------------------------------------------
 
+    _METRICS_TTL_S = 10.0
+
     def metrics_text(self) -> str:
         """Prometheus text exposition of control-plane state: runs by
         status, queue depth per queue, claimed-agent count (SURVEY
         §5.5 — the scrape surface an in-cluster deployment pairs with
-        the model server's /metrics)."""
+        the model server's /metrics).  Served WITHOUT auth (aggregate
+        counts only — see the dispatch comment).
+
+        The snapshot is TTL-cached: list_runs() re-reads every run's
+        meta file from disk, and a 15s scrape interval against a
+        long-lived store would otherwise turn /metrics into recurring
+        full-store I/O growing with run history."""
+        import time as _time
         from collections import Counter
 
+        cached = getattr(self, "_metrics_cache", None)
+        if cached and _time.monotonic() - cached[0] < self._METRICS_TTL_S:
+            return cached[1]
         runs = self.store.list_runs()
         by_status = Counter((r.get("status") or "unknown")
                             for r in runs)
@@ -98,7 +110,9 @@ class ControlPlane:
                 f'ptpu_queue_depth{{queue="{esc(queue)}"}} {n}')
         lines += ["# TYPE ptpu_active_agents gauge",
                   f"ptpu_active_agents {len(agents)}"]
-        return "\n".join(lines) + "\n"
+        text = "\n".join(lines) + "\n"
+        self._metrics_cache = (_time.monotonic(), text)
+        return text
 
     # -- queue ----------------------------------------------------------
 
@@ -314,9 +328,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if method == "GET" and parsed.path in ("/metrics",
                                                "/api/v1/metrics"):
-            if not self._authorized():
-                return _json_response(self, 401,
-                                      {"error": "unauthorized"})
+            # Unauthenticated like /healthz: annotation-driven
+            # Prometheus scrapes send no Authorization header, and the
+            # rendered in-cluster deployment ALWAYS sets a token — an
+            # auth-gated /metrics would 401 every scrape of the
+            # endpoint its own annotations advertise.  Exposes only
+            # aggregate gauges (counts), no run content.
             blob = self.plane.metrics_text().encode()
             self.send_response(200)
             self.send_header("Content-Type",

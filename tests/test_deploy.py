@@ -76,3 +76,17 @@ class TestDeployManifests:
         pod = api["spec"]["template"]["spec"]
         assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
             "pvc-a"
+
+
+def test_control_plane_prometheus_annotations():
+    """The API pod template advertises its /metrics endpoint to
+    Prometheus scrapers (pairs with scheduler/api.py's exposition)."""
+    from polyaxon_tpu.deploy import DeploymentConfig, control_plane
+
+    cfg = DeploymentConfig(namespace="ml")
+    dep = next(m for m in control_plane(cfg)
+               if m["kind"] == "Deployment")
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    assert ann["prometheus.io/port"] == str(cfg.api_port)
