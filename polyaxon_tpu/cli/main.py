@@ -1102,7 +1102,8 @@ def admin_deploy(namespace, image, operator_image, artifacts_claim, output):
 @click.option("--auth-token", default=None, envvar="POLYAXON_TPU_AUTH_TOKEN",
               help="Require this bearer token on every request.")
 def server(host, port, schedules, auth_token):
-    """Serve the control plane API (runs DB, queue, streams)."""
+    """Serve the control plane API (runs DB, queue, streams,
+    dashboard at /ui, Prometheus gauges at /metrics)."""
     import threading
 
     from polyaxon_tpu.client.store import FileRunStore
