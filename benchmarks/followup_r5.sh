@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-5 follow-up legs: evidence NOT covered by tpu_sweep.sh, run
+# after it completes (the main sweep owns the chip first — its legs
+# are strictly higher value-per-minute).
+#
+#  1. bert-base headline refresh: the committed TPU row (64.6k tok/s,
+#     "mfu" 0.0617) predates both the flash 512-block fix and the
+#     analytic-MFU numerator (PARITY.md "Known gaps"); its honest MFU
+#     at the same step time is ~0.23 and the step time itself should
+#     drop.  A fresh row replaces the stale-regime number with one
+#     carrying flops_src + the measured bridge.
+#  2. bert-base batch probe: b32/b64 — encoder-only at seq 128 is
+#     small; bigger batches should lift MFU the same way resnet's
+#     b128->b256 did.  Banked into .bench_baseline.json if better.
+#  3. tinyllama-1.1b headline refresh on the same honest numerator.
+#  4. tinyllama decode row (bench_decode.py only queues gpt2-medium
+#     first; make sure the 1.1B decode lands even in a short window).
+set -x
+cd "$(dirname "$0")/.."
+
+timeout 1500 python bench.py --model bert-base --require-accel --append \
+    --probe-budget 180 || true
+timeout 1200 python bench.py --model bert-base --batch 32 --require-accel \
+    --append --probe-budget 120 || true
+timeout 1200 python bench.py --model bert-base --batch 64 --require-accel \
+    --append --probe-budget 120 || true
+timeout 1800 python bench.py --model tinyllama-1.1b --require-accel \
+    --append --probe-budget 120 || true
+timeout 1800 python benchmarks/bench_decode.py --models tinyllama-1.1b \
+    || true
+
+echo "FOLLOWUP COMPLETE $(date)"
