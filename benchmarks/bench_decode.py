@@ -51,7 +51,7 @@ def _cache_bytes(jax, model, batch: int) -> int:
                for x in jax.tree.leaves(shapes))
 
 
-def bench_decode(jax, model_name: str, backend: str):
+def bench_decode(jax, model_name: str, backend: str, checkpoint=None):
     import numpy as np
 
     from polyaxon_tpu.models.generate import (generate,
@@ -64,6 +64,18 @@ def bench_decode(jax, model_name: str, backend: str):
     model, variables = spec.init_params(batch_size=1)
     vocab = model.cfg.vocab_size
     rng = np.random.RandomState(0)
+
+    # The tunnel flaps (round-5: answered for ~5 min, then wedged for
+    # the next hour mid-leg, costing the whole decode row).  Build the
+    # row incrementally and checkpoint after EVERY measured variant so
+    # a wedge only loses the variant in flight, never the window.
+    fields = {"model": model_name, "backend": backend, "batch": batch,
+              "prompt_len": p_len, "new_tokens": new_toks}
+
+    def ck(**kw):
+        fields.update(kw)
+        if checkpoint is not None:
+            checkpoint(dict(fields))
 
     # Seq2seq (T5-style) models decode through generate_seq2seq: the
     # "prompt" is the ENCODER input, TTFT = encode + one prefill step.
@@ -105,6 +117,9 @@ def bench_decode(jax, model_name: str, backend: str):
     prompt = rng.randint(0, vocab, size=(batch, p_len)).astype("int32")
     total_s = timed(gen, prompt)
     tok_per_sec = batch * new_toks / total_s
+    ck(tok_per_sec_per_chip=round(tok_per_sec, 1),
+       decode_ms_per_token=round(1000 * total_s / new_toks, 3),
+       kv_cache_mb=round(kv_bytes / 2**20, 1))
 
     # Weight-only int8 A/B (ops/quant.py): decode at small batch is
     # weight-bandwidth-bound, so halving the weight bytes should show
@@ -117,6 +132,10 @@ def bench_decode(jax, model_name: str, backend: str):
                                      max_new_tokens=new_toks))
     int8_s = timed(gen_q, prompt)
     tok_per_sec_int8 = batch * new_toks / int8_s
+    ck(tok_per_sec_per_chip_int8=round(tok_per_sec_int8, 1),
+       int8_speedup=round(tok_per_sec_int8 / tok_per_sec, 3),
+       weights_mb=round(full_b / 2**20, 1),
+       weights_mb_int8=round(stored_b / 2**20, 1))
 
     # Ring-cache A/B for sliding-window models: O(window) cache vs
     # O(max_position), same tokens (exactness pinned in
@@ -130,6 +149,8 @@ def bench_decode(jax, model_name: str, backend: str):
                                          max_new_tokens=new_toks))
         ring_s = timed(gen_r, prompt)
         ring_tok_per_sec = batch * new_toks / ring_s
+        ck(tok_per_sec_per_chip_ring=round(ring_tok_per_sec, 1),
+           kv_cache_mb_ring=round(ring_kv_bytes / 2**20, 2))
 
     # Fully quantized serving: int8 weights AND int8 KV cache
     # (models/kv_cache.py) — the same params drive a model rebuilt with
@@ -143,6 +164,27 @@ def bench_decode(jax, model_name: str, backend: str):
                                            max_new_tokens=new_toks))
         qkv_s = timed(gen_qkv, prompt)
         tok_per_sec_int8_kv = batch * new_toks / qkv_s
+        ck(tok_per_sec_per_chip_int8_kv=round(tok_per_sec_int8_kv, 1),
+           int8_kv_speedup=round(tok_per_sec_int8_kv / tok_per_sec, 3),
+           **({"kv_cache_mb_int8": round(kv_bytes_int8 / 2**20, 1)}
+              if kv_bytes_int8 else {}))
+
+    # TTFT = prefill + first sampled token (max_new_tokens=1).
+    # Measured BEFORE the speculative A/B: its two jits are cheap next
+    # to the speculative-loop compiles, so a flapping tunnel banks the
+    # latency evidence first.
+    ttft = {}
+    for L in ttft_lens:
+        first = jax.jit(lambda p: gen_fn(model, variables, p,
+                                         max_new_tokens=1))
+        pr = rng.randint(0, vocab, size=(batch, L)).astype("int32")
+        ttft[L] = timed(first, pr)
+    l_small, l_big = ttft_lens
+    ratio = ttft[l_big] / ttft[l_small]
+    ck(ttft_ms={str(k): round(v * 1e3, 1) for k, v in ttft.items()},
+       ttft_ratio=round(ratio, 2),
+       ttft_len_ratio=round(l_big / l_small, 2),
+       ttft_sublinear=bool(ratio < l_big / l_small))
 
     # Speculative decoding A/B (models/generate.generate_speculative):
     # tokens are pinned bit-identical to greedy, so the only question
@@ -180,44 +222,9 @@ def bench_decode(jax, model_name: str, backend: str):
                 round(batch * new_toks / self_s, 1),
             "spec_speedup_full_accept": round(total_s / self_s, 3),
         }
+        ck(**spec_fields)
 
-    # TTFT = prefill + first sampled token (max_new_tokens=1).
-    ttft = {}
-    for L in ttft_lens:
-        first = jax.jit(lambda p: gen_fn(model, variables, p,
-                                         max_new_tokens=1))
-        pr = rng.randint(0, vocab, size=(batch, L)).astype("int32")
-        ttft[L] = timed(first, pr)
-    l_small, l_big = ttft_lens
-    ratio = ttft[l_big] / ttft[l_small]
-
-    return {
-        "model": model_name,
-        "backend": backend,
-        "batch": batch,
-        "prompt_len": p_len,
-        "new_tokens": new_toks,
-        "tok_per_sec_per_chip": round(tok_per_sec, 1),
-        "decode_ms_per_token": round(1000 * total_s / new_toks, 3),
-        "tok_per_sec_per_chip_int8": round(tok_per_sec_int8, 1),
-        "int8_speedup": round(tok_per_sec_int8 / tok_per_sec, 3),
-        **({"tok_per_sec_per_chip_int8_kv": round(tok_per_sec_int8_kv, 1),
-            "int8_kv_speedup": round(tok_per_sec_int8_kv / tok_per_sec, 3)}
-           if tok_per_sec_int8_kv else {}),
-        "weights_mb": round(full_b / 2**20, 1),
-        "weights_mb_int8": round(stored_b / 2**20, 1),
-        "kv_cache_mb": round(kv_bytes / 2**20, 1),
-        **({"kv_cache_mb_int8": round(kv_bytes_int8 / 2**20, 1)}
-           if kv_bytes_int8 else {}),
-        **({"tok_per_sec_per_chip_ring": round(ring_tok_per_sec, 1),
-            "kv_cache_mb_ring": round(ring_kv_bytes / 2**20, 2)}
-           if ring_tok_per_sec else {}),
-        "ttft_ms": {str(k): round(v * 1e3, 1) for k, v in ttft.items()},
-        "ttft_ratio": round(ratio, 2),
-        "ttft_len_ratio": round(l_big / l_small, 2),
-        "ttft_sublinear": bool(ratio < l_big / l_small),
-        **spec_fields,
-    }
+    return fields
 
 
 def main() -> int:
@@ -235,10 +242,22 @@ def main() -> int:
                           "skipped": f"backend={backend}"}))
         return 0
 
+    def tpu_partial_writer(f):
+        # Partial rows are superseded by any later row for the same
+        # model without "partial": true; only TPU measurements are
+        # worth checkpointing (cpu-smoke reruns in seconds).
+        row = {"bench": "decode", "ts": time.time(), "partial": True,
+               **f}
+        with open(RESULTS, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+
     for name in args.models.split(","):
         name = name.strip()
         try:
-            r = bench_decode(jax, name, backend)
+            r = bench_decode(
+                jax, name, backend,
+                checkpoint=tpu_partial_writer if backend == "tpu"
+                else None)
         except Exception as e:
             print(f"# decode {name} failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", file=sys.stderr)
