@@ -98,8 +98,29 @@ def mark_done(name: str) -> None:
         f.write(name + "\n")
 
 
+_abandoned = []  # hung probes: never killed, but polled — a hung
+                 # probe that finally exits with "tpu" IS the up-signal
+MAX_ABANDONED = 6
+
+
 def tunnel_up() -> bool:
     """Out-of-process probe; abandon (never kill) a hung one."""
+    global _abandoned
+    still = []
+    answered = False
+    for p in _abandoned:
+        if p.poll() is None:
+            still.append(p)
+        elif (p.stdout.read() or "").strip().endswith("tpu"):
+            answered = True
+    _abandoned = still
+    if answered:
+        log("an abandoned probe finally answered tpu — tunnel is back")
+        return True
+    if len(_abandoned) >= MAX_ABANDONED:
+        # Don't stack more jax processes against a wedged tunnel; the
+        # existing hung probes will announce recovery themselves.
+        return False
     p = subprocess.Popen(
         [PY, "-c", "import jax; print(jax.default_backend())"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
@@ -110,7 +131,9 @@ def tunnel_up() -> bool:
             out = (p.stdout.read() or "").strip()
             return out.endswith("tpu")
         time.sleep(2)
-    log("probe hung — tunnel wedged; abandoning probe process")
+    log(f"probe hung — tunnel wedged; abandoning probe process "
+        f"({len(_abandoned) + 1} outstanding)")
+    _abandoned.append(p)
     return False
 
 
