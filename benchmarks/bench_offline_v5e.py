@@ -233,6 +233,22 @@ CONFIGS = [
     ("gpt2-medium", 16,
      {"remat": True, "remat_policy": "dots_saveable"}, "remat-dots"),
     ("gpt2-medium", 8, {"remat": True}, "remat-full"),
+    # Round-5 follow-up legs (followup_r5.sh / resume_sweep.py):
+    # predict before measuring.  bert-base at seq 128 is small — batch
+    # is its MFU lever exactly as b128->b256 was for resnet; b12
+    # remat-dots is the gpt2 sweep's committed fallback if b16 hits
+    # the 15.75 GB wall as the b16 prediction says it will.
+    ("bert-base", 32, None, None),
+    ("bert-base", 64, None, None),
+    # bert-base b16/seq-512 IS its memory wall: b32 un-remattered
+    # needs 16.49 GB (> 15.75, measured by the compile above failing).
+    # BertConfig.remat is all-or-nothing (no dots_saveable policy —
+    # the encoder block is one scan'd layer), so predict the full-
+    # remat batch frontier before spending a tunnel window on it.
+    ("bert-base", 32, {"remat": True}, "remat"),
+    ("bert-base", 64, {"remat": True}, "remat"),
+    ("gpt2-medium", 12,
+     {"remat": True, "remat_policy": "dots_saveable"}, "remat-dots"),
 ]
 
 
@@ -240,6 +256,10 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--models", default=None,
                         help="comma list to restrict (default: all)")
+    parser.add_argument("--only", default=None,
+                        help="comma list of model:batch[:variant] "
+                             "points (e.g. bert-base:64,"
+                             "gpt2-medium:12:remat-dots)")
     parser.add_argument("--no-append", action="store_true")
     args = parser.parse_args()
 
@@ -252,9 +272,15 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
 
     only = set(args.models.split(",")) if args.models else None
+    only_points = ({tuple(p.split(":", 2)) + ("",) * (3 - len(p.split(":", 2)))
+                    for p in args.only.split(",")}
+                   if args.only else None)
     rows = []
     for model_name, batch, overrides, variant in CONFIGS:
         if only and model_name not in only:
+            continue
+        if only_points is not None and \
+                (model_name, str(batch), variant or "") not in only_points:
             continue
         # CONFIGS store dtype-valued fields by name; one canonical
         # decoder (bench.decode_overrides) maps them to real dtypes.
