@@ -1,0 +1,90 @@
+"""Unit tests for the window-resilient sweep runner's bookkeeping.
+
+benchmarks/resume_sweep.py is the round-5 TPU-evidence capture path
+(the tunnel flaps; legs resume across windows).  The measurement legs
+themselves need hardware, but the bookkeeping that decides *which* leg
+runs next and *whether it counts* is pure logic — and a bug there
+silently drops evidence (a leg marked done off a partial row) or burns
+windows (a done leg re-run).  No jax import.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "resume_sweep",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "benchmarks", "resume_sweep.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "RESULTS", str(tmp_path / "results.jsonl"))
+    monkeypatch.setattr(mod, "DONE", str(tmp_path / ".resume_done"))
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "log"))
+    return mod
+
+
+def test_tpu_rows_counts_only_complete_tpu_rows(tmp_path, monkeypatch):
+    mod = _load(tmp_path, monkeypatch)
+    rows = [
+        {"bench": "decode", "backend": "tpu", "tok_per_sec_per_chip": 1},
+        # partial checkpoint: wedge salvage, must NOT count
+        {"bench": "decode", "backend": "tpu", "partial": True},
+        # cpu smoke: must not count
+        {"bench": "decode", "backend": "cpu"},
+        {"bench": "headline", "backend": "tpu", "mfu": 0.3},
+    ]
+    with open(mod.RESULTS, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert mod.tpu_rows() == 2
+
+
+def test_tpu_rows_missing_file_is_zero(tmp_path, monkeypatch):
+    mod = _load(tmp_path, monkeypatch)
+    assert mod.tpu_rows() == 0
+
+
+def test_done_stamps_round_trip(tmp_path, monkeypatch):
+    mod = _load(tmp_path, monkeypatch)
+    assert mod.done_set() == set()
+    mod.mark_done("decode-gpt2")
+    mod.mark_done("roofline")
+    assert mod.done_set() == {"decode-gpt2", "roofline"}
+    # restart-safe: a fresh read sees the same stamps
+    assert mod.done_set() == {"decode-gpt2", "roofline"}
+
+
+def test_leg_table_shape(tmp_path, monkeypatch):
+    """Every leg is (name, argv, timeout, max_attempts, min_rows) with
+    unique names — the done-stamp file keys on the name."""
+    mod = _load(tmp_path, monkeypatch)
+    names = [l[0] for l in mod.LEGS]
+    assert len(names) == len(set(names))
+    for name, argv, timeout_s, max_attempts, min_rows in mod.LEGS:
+        assert argv[0] == sys.executable
+        assert timeout_s > 0 and max_attempts >= 1 and min_rows >= 1
+
+
+def test_run_leg_success_requires_rc0_and_rows(tmp_path, monkeypatch):
+    mod = _load(tmp_path, monkeypatch)
+    results = str(tmp_path / "results.jsonl")
+    open(results, "w").close()
+
+    # rc=0 but no new rows (probe-skip shape) -> not done
+    assert mod.run_leg("x", [sys.executable, "-c", "pass"], 30, 1) \
+        is False
+
+    # writes a complete tpu row and exits 0 -> done
+    script = (f"import json; open({results!r}, 'a').write("
+              "json.dumps({'backend': 'tpu', 'bench': 't'}) + '\\n')")
+    assert mod.run_leg("x", [sys.executable, "-c", script], 30, 1) \
+        is True
+
+    # writes a row but exits nonzero (wedge-killed shape) -> not done
+    script2 = script + "; raise SystemExit(1)"
+    assert mod.run_leg("x", [sys.executable, "-c", script2], 30, 1) \
+        is False
