@@ -99,22 +99,45 @@ def mark_done(name: str) -> None:
         f.write(name + "\n")
 
 
-_abandoned = []  # hung probes: never killed, but polled — a hung
-                 # probe that finally exits with "tpu" IS the up-signal
+_abandoned = []  # (proc, spawn_ts) hung probes: never killed, but
+                 # polled — one that finally exits "tpu" IS the
+                 # up-signal
+_forgotten = []  # aged-out hung probes: no longer counted against the
+                 # cap, but still polled so they get reaped (no
+                 # zombies/fd leak) and can still deliver an up-signal
 MAX_ABANDONED = 6
+ABANDON_FORGET_S = 1800.0
+
+
+def _reap(procs):
+    """Poll a probe list; return (still_running, answered_tpu)."""
+    still = []
+    answered = False
+    for p, ts in procs:
+        if p.poll() is None:
+            still.append((p, ts))
+        elif (p.stdout.read() or "").strip().endswith("tpu"):
+            answered = True
+    return still, answered
 
 
 def tunnel_up() -> bool:
     """Out-of-process probe; abandon (never kill) a hung one."""
-    global _abandoned
-    still = []
-    answered = False
-    for p in _abandoned:
-        if p.poll() is None:
-            still.append(p)
-        elif (p.stdout.read() or "").strip().endswith("tpu"):
-            answered = True
-    _abandoned = still
+    global _abandoned, _forgotten
+    _abandoned, answered_a = _reap(_abandoned)
+    _forgotten, answered_f = _reap(_forgotten)
+    answered = answered_a or answered_f
+    # A probe hung on a DEAD connection may never return even after
+    # the tunnel recovers; after 30 min stop counting it against the
+    # cap (but keep polling it above) so fresh probes — which would
+    # see the recovered tunnel — keep flowing.
+    now = time.time()
+    aged = [(p, ts) for p, ts in _abandoned
+            if now - ts >= ABANDON_FORGET_S]
+    if aged:
+        _forgotten.extend(aged)
+        _abandoned = [(p, ts) for p, ts in _abandoned
+                      if now - ts < ABANDON_FORGET_S]
     if answered:
         log("an abandoned probe finally answered tpu — tunnel is back")
         return True
@@ -134,7 +157,7 @@ def tunnel_up() -> bool:
         time.sleep(2)
     log(f"probe hung — tunnel wedged; abandoning probe process "
         f"({len(_abandoned) + 1} outstanding)")
-    _abandoned.append(p)
+    _abandoned.append((p, time.time()))
     return False
 
 
