@@ -39,10 +39,10 @@ PY = sys.executable
 # (gpt2-mfu: 5 points but the b16 point is allowed to OOM -> 4).
 LEGS = [
     ("decode-gpt2", [PY, "benchmarks/bench_decode.py",
-                     "--models", "gpt2-medium"], 2400, 4, 1),
+                     "--models", "gpt2-medium"], 2400, 6, 1),
     ("decode-tinyllama", [PY, "benchmarks/bench_decode.py",
-                          "--models", "tinyllama-1.1b"], 2400, 3, 1),
-    ("gpt2-mfu-sweep", [PY, "benchmarks/bench_gpt2_mfu.py"], 3600, 3, 4),
+                          "--models", "tinyllama-1.1b"], 2400, 5, 1),
+    ("gpt2-mfu-sweep", [PY, "benchmarks/bench_gpt2_mfu.py"], 3600, 6, 4),
     ("gpt2-headline", [PY, "bench.py", "--model", "gpt2-medium",
                        "--require-accel", "--append",
                        "--probe-budget", "120"], 1500, 3, 1),
@@ -51,12 +51,12 @@ LEGS = [
                         "--variant", "bwd-block-512",
                         "--probe-budget", "120"], 1500, 2, 1),
     ("roofline", [PY, "benchmarks/bench_roofline_probe.py"], 1200, 3, 1),
-    ("serving-load", [PY, "benchmarks/bench_serving_load.py"], 1800, 3, 1),
+    ("serving-load", [PY, "benchmarks/bench_serving_load.py"], 1800, 4, 1),
     ("windowed", [PY, "benchmarks/bench_windowed.py"], 2400, 2, 1),
     # bert: b32 un-remattered measures 16.49 GB offline (> 15.75 GB
     # chip) — batch scaling needs full remat, so run the sweep (which
     # banks its best config) and then a headline-class replay of it.
-    ("bert-mfu-sweep", [PY, "benchmarks/bench_bert_mfu.py"], 2400, 3, 2),
+    ("bert-mfu-sweep", [PY, "benchmarks/bench_bert_mfu.py"], 2400, 5, 2),
     ("bert-headline", [PY, "bench.py", "--model", "bert-base",
                        "--require-accel", "--append",
                        "--probe-budget", "120"], 1500, 3, 1),
