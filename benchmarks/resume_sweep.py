@@ -176,7 +176,12 @@ def tpu_rows() -> int:
     return n
 
 
-def run_leg(name, argv, timeout_s, min_rows) -> bool:
+def run_leg(name, argv, timeout_s, min_rows):
+    """Returns (done, attempted): ``done`` when rc==0 and the leg
+    banked >= min_rows complete TPU rows; ``attempted`` False for the
+    probe-skip shape (clean fast exit, nothing banked — the tunnel
+    flapped between the runner's probe and the leg's own, which should
+    not burn one of the leg's bounded attempts)."""
     before = tpu_rows()
     env = dict(os.environ, **ENV_OVERRIDES.get(name, {}))
     # Persistent compile cache: a leg retried after a wedge replays
@@ -195,10 +200,13 @@ def run_leg(name, argv, timeout_s, min_rows) -> bool:
             timeout=timeout_s + 300).returncode
     except subprocess.TimeoutExpired:
         log(f"leg {name}: outer timeout (timeout -k did not reap)")
+    dur = time.time() - t0
     gained = tpu_rows() - before
-    log(f"leg {name}: finished rc={rc} in {time.time()-t0:.0f}s, "
+    log(f"leg {name}: finished rc={rc} in {dur:.0f}s, "
         f"+{gained} tpu rows (need {min_rows})")
-    return rc == 0 and gained >= min_rows
+    done = rc == 0 and gained >= min_rows
+    attempted = not (rc == 0 and gained == 0 and dur < 360)
+    return done, attempted
 
 
 def main() -> int:
@@ -218,8 +226,10 @@ def main() -> int:
             time.sleep(WEDGE_SLEEP)
             continue
         name, argv, timeout_s, _, min_rows = pending[0]
-        attempts[name] = attempts.get(name, 0) + 1
-        if run_leg(name, argv, timeout_s, min_rows):
+        done, attempted = run_leg(name, argv, timeout_s, min_rows)
+        if attempted:
+            attempts[name] = attempts.get(name, 0) + 1
+        if done:
             mark_done(name)
         # No sleep on success: ride the window while it lasts.
     log("deadline reached; exiting")

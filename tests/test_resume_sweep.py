@@ -74,17 +74,19 @@ def test_run_leg_success_requires_rc0_and_rows(tmp_path, monkeypatch):
     results = str(tmp_path / "results.jsonl")
     open(results, "w").close()
 
-    # rc=0 but no new rows (probe-skip shape) -> not done
+    # rc=0, no rows, fast exit: the probe-skip shape — not done AND
+    # not attempted (must not burn the leg's bounded attempts)
     assert mod.run_leg("x", [sys.executable, "-c", "pass"], 30, 1) \
-        is False
+        == (False, False)
 
     # writes a complete tpu row and exits 0 -> done
     script = (f"import json; open({results!r}, 'a').write("
               "json.dumps({'backend': 'tpu', 'bench': 't'}) + '\\n')")
     assert mod.run_leg("x", [sys.executable, "-c", script], 30, 1) \
-        is True
+        == (True, True)
 
-    # writes a row but exits nonzero (wedge-killed shape) -> not done
+    # writes a row but exits nonzero (wedge-killed shape) -> not done,
+    # but it did attempt (it measured something before dying)
     script2 = script + "; raise SystemExit(1)"
     assert mod.run_leg("x", [sys.executable, "-c", script2], 30, 1) \
-        is False
+        == (False, True)
