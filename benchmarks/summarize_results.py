@@ -1,0 +1,123 @@
+"""Render benchmarks/results.jsonl as a compact evidence table.
+
+results.jsonl is append-only and heterogeneous (headline rows, MFU
+sweeps, decode A/Bs, serving load, offline rooflines, partial wedge
+checkpoints...).  This prints the CURRENT evidence state: for every
+(bench, model, variant, batch, regime) key, the newest row wins;
+superseded and ``partial`` rows are dropped when a newer complete row
+for the same key exists.
+
+Usage:
+  python benchmarks/summarize_results.py            # markdown table
+  python benchmarks/summarize_results.py --tpu-only # hardware rows only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULTS = os.path.join(HERE, "results.jsonl")
+
+
+def load_rows(path=RESULTS):
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
+
+def key_of(r):
+    return (r.get("bench"), r.get("model"), r.get("variant") or "",
+            r.get("batch"), r.get("regime") or "",
+            r.get("backend"))
+
+
+def current_state(rows):
+    """Newest row per key; a complete row beats any partial one."""
+    best = {}
+    for r in rows:
+        if r.get("skipped") or r.get("failed"):
+            continue
+        k = key_of(r)
+        prev = best.get(k)
+        if prev is None:
+            best[k] = r
+            continue
+        # Completeness first (partial rows are wedge salvage), then
+        # recency.
+        rank = (not r.get("partial"), r.get("ts", 0))
+        prev_rank = (not prev.get("partial"), prev.get("ts", 0))
+        if rank >= prev_rank:
+            best[k] = r
+    return sorted(best.values(),
+                  key=lambda r: (r.get("bench") or "",
+                                 r.get("model") or "",
+                                 str(r.get("batch")),
+                                 r.get("variant") or ""))
+
+
+def headline_value(r):
+    """The one number a row is 'about', with its unit."""
+    for field, unit in (
+            ("per_sec_per_chip", None),
+            ("tok_per_sec_per_chip", "tok/s/chip"),
+            ("roofline_mfu_max", "mfu ceiling"),
+            ("hbm_gbps", "GB/s"),
+    ):
+        v = r.get(field)
+        if v is not None:
+            return v, (unit or r.get("unit") or "")
+    if r.get("load"):
+        pts = r["load"]
+        last = pts[-1]
+        return last.get("agg_tok_per_sec"), \
+            f"agg tok/s @ {last.get('clients')} clients"
+    return None, ""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu-only", action="store_true")
+    args = ap.parse_args()
+    rows = current_state(load_rows())
+    if args.tpu_only:
+        rows = [r for r in rows
+                if r.get("backend") in ("tpu", "tpu-compile-only")]
+    print("| bench | model | variant | batch | backend | value | unit "
+          "| mfu | age |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    now = time.time()
+    for r in rows:
+        v, unit = headline_value(r)
+        age_h = (now - r.get("ts", now)) / 3600
+        flags = []
+        if r.get("partial"):
+            flags.append("partial")
+        if r.get("executed") is False:
+            flags.append("predicted")
+        if r.get("regime"):
+            flags.append(r["regime"])
+        print(f"| {r.get('bench')} | {r.get('model')} "
+              f"| {r.get('variant') or ''} | {r.get('batch')} "
+              f"| {r.get('backend')}{'/' + ','.join(flags) if flags else ''} "
+              f"| {v if v is not None else ''} | {unit} "
+              f"| {r.get('mfu', '')} | {age_h:.0f}h |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
