@@ -46,12 +46,18 @@ def sweep_configs(quick: bool):
     # LAST (an OOM there costs nothing already banked).  The b4 no-
     # remat bridged roofline caps at MFU 0.436 (memory-bound): batch
     # scaling under remat is the only path past it.
+    # Value-per-minute order for FLAPPING-tunnel windows (~5 min):
+    # the b8 remat-dots point is the VERDICT-r4 "MFU >= 0.45" money
+    # shot (predicted ceiling 0.753) and runs FIRST; the b4 anchor was
+    # already measured live in round 4 (0.375) and drops to third;
+    # b16 stays last (predicted to brush the 15.75 GB limit — an OOM
+    # there costs nothing already banked).
     cfgs = [
-        (4, "base", None, None),
         (8, "remat-dots",
          {"remat": True, "remat_policy": "dots_saveable"}, None),
         (12, "remat-dots",
          {"remat": True, "remat_policy": "dots_saveable"}, None),
+        (4, "base", None, None),
         (8, "remat-full", {"remat": True}, None),
         (16, "remat-dots",
          {"remat": True, "remat_policy": "dots_saveable"}, None),
