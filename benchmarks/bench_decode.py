@@ -197,7 +197,6 @@ def bench_decode(jax, model_name: str, backend: str, checkpoint=None):
     #   is the committed-schedule win at full acceptance (with a draft
     #   as expensive as the target, i.e. a conservative ceiling — a
     #   real 4x-smaller trained draft sits between the two).
-    spec_fields = {}
     if model_name == "gpt2-medium" and not seq2seq:
         from polyaxon_tpu.models.generate import generate_speculative
 
