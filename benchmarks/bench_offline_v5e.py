@@ -234,7 +234,7 @@ CONFIGS = [
      {"remat": True, "remat_policy": "dots_saveable"}, "remat-dots"),
     ("gpt2-medium", 8, {"remat": True}, "remat-full"),
     # Round-5 follow-up legs (followup_r5.sh / resume_sweep.py):
-    # predict before measuring.  bert-base at seq 128 is small — batch
+    # predict before measuring.  bert-base at seq 512 is small — batch
     # is its MFU lever exactly as b128->b256 was for resnet; b12
     # remat-dots is the gpt2 sweep's committed fallback if b16 hits
     # the 15.75 GB wall as the b16 prediction says it will.
@@ -272,9 +272,24 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
 
     only = set(args.models.split(",")) if args.models else None
-    only_points = ({tuple(p.split(":", 2)) + ("",) * (3 - len(p.split(":", 2)))
-                    for p in args.only.split(",")}
-                   if args.only else None)
+    only_points = None
+    if args.only:
+        known = {(m, str(b), v or "") for m, b, _, v in CONFIGS}
+        only_points, bad = set(), []
+        for entry in args.only.split(","):
+            parts = entry.split(":", 2)
+            point = tuple(parts) + ("",) * (3 - len(parts))
+            only_points.add(point)
+            if point not in known:
+                bad.append(entry)
+        if bad:
+            # A typo'd point silently selecting zero configs would
+            # read as a clean "nothing to predict" run (same contract
+            # as bench_resnet_mfu.py's --only).
+            raise SystemExit(
+                f"--only entries match no CONFIGS point: "
+                f"{sorted(bad)}; known points: "
+                f"{sorted(':'.join(x for x in k if x) for k in known)}")
     rows = []
     for model_name, batch, overrides, variant in CONFIGS:
         if only and model_name not in only:

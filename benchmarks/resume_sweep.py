@@ -21,6 +21,7 @@ Run: python benchmarks/resume_sweep.py [--deadline-hours 8]
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -73,6 +74,32 @@ LEGS = [
 ENV_OVERRIDES = {
     "gpt2-bwd-block": {"POLYAXON_TPU_FLASH_BLOCK_Q_BWD": "512",
                        "POLYAXON_TPU_FLASH_BLOCK_KV_BWD": "512"},
+}
+
+# Row attribution: which results.jsonl rows each leg is allowed to
+# claim.  A leg is marked done only off rows matching ITS bench/model
+# key (field -> required value; "variant": None requires the field be
+# absent, matching bench.py's omit-when-empty), never off a raw
+# row-count delta — another leg's wedge-salvaged rows or a concurrent
+# harvest landing mid-attempt must not stamp a skipped leg done.
+LEG_MATCH = {
+    "decode-gpt2": {"bench": "decode", "model": "gpt2-medium"},
+    "decode-tinyllama": {"bench": "decode", "model": "tinyllama-1.1b"},
+    "gpt2-mfu-sweep": {"bench": "gpt2-medium-mfu-sweep"},
+    "gpt2-headline": {"bench": "headline", "model": "gpt2-medium",
+                      "variant": None},
+    "gpt2-bwd-block": {"bench": "headline", "model": "gpt2-medium",
+                       "variant": "bwd-block-512"},
+    "roofline": {"bench": "roofline-probe"},
+    "serving-load": {"bench": "serving-load"},
+    "windowed": {"bench": "windowed-attention"},
+    "bert-mfu-sweep": {"bench": "bert-base-mfu-sweep"},
+    "bert-headline": {"bench": "headline", "model": "bert-base",
+                      "variant": None},
+    "tinyllama-headline": {"bench": "headline",
+                           "model": "tinyllama-1.1b", "variant": None},
+    "decode-t5": {"bench": "decode", "model": "t5-small"},
+    "resnet-rest": {"bench": "resnet50-mfu-sweep"},
 }
 
 PROBE_TIMEOUT = 90.0
@@ -161,16 +188,24 @@ def tunnel_up() -> bool:
     return False
 
 
-def tpu_rows() -> int:
-    """Non-partial TPU rows: partial checkpoints are wedge salvage,
-    not leg completion."""
+def tpu_rows(match=None) -> int:
+    """Non-partial TPU rows (partial checkpoints are wedge salvage,
+    not leg completion), optionally restricted to rows matching a
+    LEG_MATCH spec so each leg counts only its own evidence."""
     n = 0
     try:
         with open(RESULTS) as f:
             for line in f:
-                if '"backend": "tpu"' in line and \
-                        '"partial": true' not in line:
-                    n += 1
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("backend") != "tpu" or row.get("partial"):
+                    continue
+                if match and any(row.get(k) != v
+                                 for k, v in match.items()):
+                    continue
+                n += 1
     except OSError:
         pass
     return n
@@ -178,11 +213,14 @@ def tpu_rows() -> int:
 
 def run_leg(name, argv, timeout_s, min_rows):
     """Returns (done, attempted): ``done`` when rc==0 and the leg
-    banked >= min_rows complete TPU rows; ``attempted`` False for the
-    probe-skip shape (clean fast exit, nothing banked — the tunnel
+    banked >= min_rows complete TPU rows ATTRIBUTED TO IT (LEG_MATCH
+    — rows another run harvests into the shared results.jsonl during
+    the attempt must not stamp this leg done); ``attempted`` False for
+    the probe-skip shape (clean fast exit, nothing banked — the tunnel
     flapped between the runner's probe and the leg's own, which should
     not burn one of the leg's bounded attempts)."""
-    before = tpu_rows()
+    match = LEG_MATCH.get(name)
+    before = tpu_rows(match)
     env = dict(os.environ, **ENV_OVERRIDES.get(name, {}))
     # Persistent compile cache: a leg retried after a wedge replays
     # its earlier compiles from disk instead of burning the new
@@ -193,15 +231,16 @@ def run_leg(name, argv, timeout_s, min_rows):
     t0 = time.time()
     rc = -1
     try:
-        rc = subprocess.run(
-            ["timeout", "-k", "120", str(timeout_s)] + argv,
-            cwd=REPO, env=env,
-            stdout=open(LOG, "a"), stderr=subprocess.STDOUT,
-            timeout=timeout_s + 300).returncode
+        with open(LOG, "a") as leg_log:
+            rc = subprocess.run(
+                ["timeout", "-k", "120", str(timeout_s)] + argv,
+                cwd=REPO, env=env,
+                stdout=leg_log, stderr=subprocess.STDOUT,
+                timeout=timeout_s + 300).returncode
     except subprocess.TimeoutExpired:
         log(f"leg {name}: outer timeout (timeout -k did not reap)")
     dur = time.time() - t0
-    gained = tpu_rows() - before
+    gained = tpu_rows(match) - before
     log(f"leg {name}: finished rc={rc} in {dur:.0f}s, "
         f"+{gained} tpu rows (need {min_rows})")
     done = rc == 0 and gained >= min_rows

@@ -408,21 +408,46 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                    "its prefill). 0 disables; each entry holds a full "
                    "KV cache on device.")
 @click.option("--max-batch", default=8, type=int)
+@click.option("--batching", default="continuous",
+              type=click.Choice(["continuous", "coalesce", "off"]),
+              help="Greedy batching policy: continuous (slot-based "
+                   "engine, default), coalesce (legacy whole-request "
+                   "merging), off (serialize).")
+@click.option("--slots", "n_slots", default=8, type=int,
+              help="Continuous-batching decode slots (physical batch "
+                   "width; KV memory = slots x one request cache).")
+@click.option("--queue-depth", default=64, type=int,
+              help="Admission-queue bound (rows); a full queue "
+                   "returns 429 + Retry-After.")
+@click.option("--prefill-chunk", default=None, type=int,
+              help="Default interleaved-prefill chunk (tokens); long "
+                   "prompts prefill one chunk per decode boundary.")
+@click.option("--decode-window", default=8, type=int,
+              help="Max decode steps fused per device dispatch when "
+                   "no admission could happen sooner (the engine "
+                   "drops to single steps under admission pressure).")
 @click.option("--draft-model", default=None,
               help="Zoo model enabling SPECULATIVE requests "
                    "({\"speculative\": true}); same vocab as --model.")
 @click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
-          kv_ring, kv_ring_slack, prefix_cache,
-          max_batch, draft_model, draft_checkpoint, cpu):
+          kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
+          n_slots, queue_depth, prefill_chunk, decode_window,
+          draft_model, draft_checkpoint, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
     prefill later /generate requests skip).
 
     The reference's `V1Service` schedules an opaque serving container;
     here the framework ships the model server itself (stdlib HTTP, jit
-    compile cache, int8 serving flags — see serving.py).
+    compile cache, int8 serving flags — see the serving package).
+
+    Greedy traffic runs through the continuous-batching engine by
+    default: a fixed pool of decode slots with step-boundary
+    admission, eos-eviction, interleaved chunked prefill, and 429
+    backpressure once the admission queue fills (--batching selects
+    the legacy coalescing or serialized baselines for A/Bs).
     """
     import jax
 
@@ -447,7 +472,11 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
             draft_model, 1, draft_checkpoint, int8_kv, int8_weights,
             kv_ring=kv_ring, kv_ring_slack=kv_ring_slack)
     ms = ModelServer(model, variables, model_name=model_name,
-                     max_batch=max_batch, prefix_cache=prefix_cache,
+                     max_batch=max_batch, batching=batching,
+                     n_slots=n_slots, queue_depth=queue_depth,
+                     prefill_chunk=prefill_chunk,
+                     decode_window=decode_window,
+                     prefix_cache=prefix_cache,
                      draft_model=draft, draft_variables=draft_vars,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),

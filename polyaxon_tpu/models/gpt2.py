@@ -69,6 +69,23 @@ class GPT2Config:
         return GPT2Config(hidden_size=768, num_layers=12, num_heads=12)
 
     @staticmethod
+    def mini() -> "GPT2Config":
+        # Between tiny and small: big enough that one decode step's
+        # compute dominates per-dispatch overhead on a CPU backend
+        # (the regime real accelerators are in — what the serving
+        # load benchmark needs to compare batching POLICIES rather
+        # than dispatch counts), small enough to stay CI-sized.
+        # f32 compute: CPU has no native bf16 MXU (emulated = slower),
+        # and bf16's coarse logit grid makes a random-init model's
+        # greedy argmax tie at one ulp — which differently-shaped XLA
+        # programs (vmapped slot decode, split vs one-shot prefill)
+        # may round apart, breaking the serving benches' cross-path
+        # token-equality asserts on ties that carry no signal.
+        return GPT2Config(vocab_size=4096, hidden_size=256,
+                          num_layers=4, num_heads=8, max_position=512,
+                          dtype=jnp.float32)
+
+    @staticmethod
     def tiny() -> "GPT2Config":
         return GPT2Config(vocab_size=1024, hidden_size=64, num_layers=2,
                           num_heads=4, max_position=128)

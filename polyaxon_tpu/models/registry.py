@@ -380,6 +380,15 @@ _register(ModelSpec(
 ))
 
 _register(ModelSpec(
+    name="gpt2-mini",  # serving-benchmark-sized (GPT2Config.mini)
+    make_model=_cfg_model(GPT2Model, GPT2Config.mini()),
+    make_batch=lambda b: _token_batch(b, 256,
+                                      GPT2Config.mini().vocab_size),
+    loss_fn=_lm_loss,
+    default_batch_size=8,
+))
+
+_register(ModelSpec(
     name="gpt2-tiny",
     make_model=_cfg_model(GPT2Model, GPT2Config.tiny()),
     make_batch=lambda b: _token_batch(b, 64, GPT2Config.tiny().vocab_size),

@@ -1,0 +1,28 @@
+"""Serving package: the zoo's decode stack behind HTTP.
+
+Split from the old single-module ``serving.py`` when the decode hot
+path moved from request coalescing to continuous batching:
+
+- ``server.py``    — ModelServer (validation, solo decode paths,
+  prefix cache, metrics) + the stdlib HTTP front-end with bounded
+  admission and 429 backpressure.
+- ``engine.py``    — the continuous-batching decode engine: step-level
+  scheduling over a fixed slot pool.
+- ``slots.py``     — slot-indexed KV memory (stacked per-slot caches,
+  the vmapped one-token step program).
+- ``scheduler.py`` — admission queue, scheduler policy knobs, request
+  and stream state.
+- ``legacy.py``    — the seed request-coalescing path, kept as the
+  measured A/B baseline (``batching="coalesce"``).
+
+The public surface is unchanged: ``from polyaxon_tpu.serving import
+ModelServer, make_server``.
+"""
+
+from .engine import DecodeEngine
+from .scheduler import QueueFullError, SchedulerPolicy
+from .server import ModelServer, make_server
+from .slots import SlotKVManager
+
+__all__ = ["ModelServer", "make_server", "DecodeEngine",
+           "SchedulerPolicy", "SlotKVManager", "QueueFullError"]

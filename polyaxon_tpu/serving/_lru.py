@@ -1,0 +1,17 @@
+"""Bounded LRU shared by the serving package's compile and prefix
+caches — one recency/eviction policy, one place to change it."""
+
+from collections import OrderedDict
+
+
+def lru_get(cache: OrderedDict, key, cap: int, build):
+    """Return ``cache[key]`` (refreshing its recency) or ``build()``,
+    insert, and evict the least-recently-used entry past ``cap``."""
+    if key in cache:
+        cache.move_to_end(key)
+        return cache[key]
+    val = build()
+    cache[key] = val
+    if len(cache) > cap:
+        cache.popitem(last=False)
+    return val

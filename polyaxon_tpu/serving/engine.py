@@ -1,0 +1,481 @@
+"""Continuous-batching decode engine.
+
+Replaces the request-coalescing path (whole ``generate()`` calls
+merged per compile shape) with STEP-LEVEL scheduling: a fixed pool of
+decode slots (slots.py) advances one token per tick, and the gaps the
+old design wasted are reclaimed at step boundaries —
+
+- a request hitting EOS (or its budget) frees its slot the same step,
+  instead of decoding frozen eos tokens until the longest batch
+  member finishes;
+- a queued request is admitted into a free slot between two decode
+  steps, instead of waiting for the whole running batch to drain;
+- long prompts prefill in bounded chunks INTERLEAVED between decode
+  steps (one chunk per boundary while decodes run), so a 2k-token
+  prompt delays resident requests by one chunk forward, not a full
+  prefill.
+
+This is the decoupling of logical workload from physical batch that
+VirtualFlow (arXiv:2009.09523) argues for, applied to the decode
+loop.  Greedy requests only: per-slot greedy argmax is exact (rows
+never interact, eos-frozen rows pad to budget — identical to solo
+``generate``, pinned in tests/test_serving.py); sampled/beam/
+speculative requests keep the solo path, where one request owns the
+PRNG schedule.
+
+Threading: ``submit`` may be called from any handler thread; all slot
+and queue mutation happens on the engine loop thread (or, in tests,
+via manual ``tick()`` calls with the loop not started — never both).
+Device work (prefill chunks, decode steps) runs under ``device_lock``
+shared with the solo path, so engine ticks and solo requests
+interleave at step granularity.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ._lru import lru_get
+from .scheduler import (AdmissionQueue, QueueFullError, RequestGroup,
+                        SchedulerPolicy, Stream)
+from .slots import SlotKVManager
+
+__all__ = ["DecodeEngine", "QueueFullError"]
+
+
+class DecodeEngine:
+    def __init__(self, model, variables, *,
+                 policy: Optional[SchedulerPolicy] = None,
+                 device_lock: Optional[threading.Lock] = None,
+                 autostart: bool = True,
+                 prefill_fns=None):
+        self.model = model
+        self.variables = variables
+        self.policy = policy or SchedulerPolicy()
+        self.device_lock = device_lock or threading.Lock()
+        # autostart=False: no loop thread — the owner drives tick()
+        # manually (deterministic tests, offline batch use).
+        self.autostart = bool(autostart)
+        self.slots = SlotKVManager(model, variables,
+                                   self.policy.n_slots)
+        self.queue = AdmissionQueue(self.policy)
+        # streams resident in a slot: slot index -> Stream
+        self._resident: Dict[int, Stream] = {}
+        # prefill/extend programs keyed by piece length (LRU-bounded:
+        # remainder pieces vary with prompt length).  ``prefill_fns``
+        # ((s_len, first) -> jitted fn) lets an owner share ONE
+        # compile cache — ModelServer passes its _split_fns so engine
+        # traffic and /prefill never compile the same program twice.
+        self._prefill_fns = prefill_fns
+        self._pf_fns: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._pf_cap = 16
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        self._wake = threading.Condition()
+        self._stop = False
+        # counters (read unlocked by metrics — monotonic ints)
+        self.admitted_total = 0
+        self.evicted_total = 0
+        self.decode_steps_total = 0
+        self.prefill_chunks_total = 0
+        self.completed_total = 0
+
+    # -- submission (any thread) ----------------------------------------
+
+    def submit(self, rows: np.ndarray, new: int,
+               eos_id: Optional[int], prefill_chunk: Optional[int],
+               *, prefix=None, on_prefilled=None) -> RequestGroup:
+        """Enqueue a greedy request (may raise QueueFullError) and make
+        sure the loop is running.  Returns the group; callers block on
+        ``group.event``.
+
+        ``prefix=(p_cached, logits, cache)`` seeds a SINGLE-ROW request
+        with an existing prefill state (the prefix-cache hit path): the
+        stream starts ``p_cached`` tokens in, so it prefills only the
+        suffix — or skips prefill entirely on a full-length hit — and
+        decodes in a slot like any other request, instead of holding
+        the device lock for a whole solo decode.  ``on_prefilled``
+        fires on the engine thread once the prompt is fully consumed
+        (the cache store-back hook)."""
+        if prefix is None:
+            pieces = self.policy.chunk_plan(rows.shape[1],
+                                            prefill_chunk)
+            group = RequestGroup(rows, new, eos_id, pieces)
+        else:
+            if rows.shape[0] != 1:
+                raise ValueError(
+                    "prefix-seeded submit takes a single-row request "
+                    f"(got batch {rows.shape[0]})")
+            p_cached, logits, cache = prefix
+            suffix = rows.shape[1] - p_cached
+            pieces = self.policy.chunk_plan(suffix, prefill_chunk) \
+                if suffix > 0 else []
+            group = RequestGroup(rows, new, eos_id, pieces)
+            stream = group.streams[0]
+            stream.filled = p_cached
+            stream.logits = logits
+            stream.cache = cache
+        group.on_prefilled = on_prefilled
+        self.queue.submit(group)          # raises when full
+        if self.autostart:
+            self._ensure_thread()
+            with self._wake:
+                self._wake.notify()
+        return group
+
+    def generate(self, rows: np.ndarray, new: int,
+                 eos_id: Optional[int],
+                 prefill_chunk: Optional[int]) -> np.ndarray:
+        """Blocking submit -> [B, p_len + new] tokens (the /generate
+        engine path)."""
+        group = self.submit(rows, new, eos_id, prefill_chunk)
+        group.event.wait()
+        if group.error is not None:
+            raise group.error
+        return group.result()
+
+    # -- engine loop ----------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._thread_lock:
+            t = self._thread
+            if t is not None and t.is_alive():
+                if not self._stop:
+                    return
+                # A concurrent close() is in flight: the exiting loop's
+                # final drain may have run before this caller's enqueue
+                # landed, which would strand the group with no thread
+                # to process or fail it.  Wait the old loop out, then
+                # start a fresh one that owns the queue.  (If the old
+                # drain DID see the group, it failed it with "decode
+                # engine closed" — an error, never a hang.)
+                t.join()
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, name="decode-engine",
+                daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        # Under _thread_lock so a concurrent submit's _ensure_thread
+        # restart serializes against the stop-join-drain sequence:
+        # its group is either failed by a drain (error, never a hang)
+        # or owned by a loop thread started strictly after close.
+        with self._thread_lock:
+            self._stop = True
+            with self._wake:
+                self._wake.notify_all()
+            t = self._thread
+            if t is not None and t.is_alive():
+                t.join(timeout=5)
+            # In-flight groups must fail (a generate() caller blocked
+            # on group.event has to wake with an error, not wait
+            # forever), but _resident and the slot free-list are
+            # loop-thread state: a live loop thread (join timed out
+            # mid-device-call) drains them itself on exit — see _loop
+            # — so only drain here when no loop thread can race us.
+            if t is None or not t.is_alive():
+                self._fail_all(RuntimeError("decode engine closed"))
+
+    def _fail_all(self, err: BaseException) -> None:
+        """Fail every in-flight group (resident and queued) and free
+        their slots — shutdown, or last-resort cleanup when a tick
+        crashes outside the device-call try blocks that attribute
+        errors to their own group."""
+        for slot, stream in list(self._resident.items()):
+            stream.group.fail(err)
+            try:
+                self.slots.release(slot)
+            except ValueError:
+                pass
+        self._resident.clear()
+        while True:
+            stream = self.queue.pop_head()
+            if stream is None:
+                break
+            stream.group.fail(err)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            try:
+                worked = self.tick()
+            except BaseException as e:
+                # Device errors inside prefill/admit/decode already
+                # failed their own group; anything landing here is a
+                # scheduling-layer crash with no owner.  Surface it
+                # and fail everything in flight — retrying the same
+                # tick at 20 Hz would spin forever while the stuck
+                # groups' clients hang.
+                traceback.print_exc(file=sys.stderr)
+                self._fail_all(
+                    RuntimeError(f"decode engine error: "
+                                 f"{type(e).__name__}: {e}"))
+                worked = False
+            if not worked:
+                with self._wake:
+                    if self._stop:
+                        break
+                    self._wake.wait(timeout=0.05)
+        # Shutdown drain on the loop thread itself, where touching
+        # _resident and the slot free-list can never race a tick.
+        self._fail_all(RuntimeError("decode engine closed"))
+
+    # -- one scheduling round -------------------------------------------
+
+    def tick(self) -> bool:
+        """One step boundary: admit/prefill within the policy budget,
+        then one decode step over the resident batch.  Returns whether
+        any work was done.  Single-threaded by contract (loop thread,
+        or tests driving it manually)."""
+        worked = False
+        budget = self.policy.prefill_budget(bool(self._resident),
+                                            self.slots.free_slots)
+        while budget > 0:
+            stream = self.queue.head()
+            if stream is None:
+                break
+            if stream.group.error is not None:
+                self.queue.drop_group(stream.group)
+                continue
+            if stream.pf_done and self.slots.free_slots == 0:
+                break       # prefilled, waiting on an eviction
+            self._advance_prefill(stream)
+            worked = True
+            budget -= 1
+        if self._resident:
+            self._decode_step()
+            worked = True
+        return worked
+
+    def run_until_idle(self, max_ticks: int = 100000) -> None:
+        """Drain queue + slots synchronously (tests/offline use)."""
+        for _ in range(max_ticks):
+            if not self.tick():
+                return
+        raise RuntimeError("engine did not go idle within max_ticks")
+
+    # -- prefill + admission --------------------------------------------
+
+    def _pf_fn(self, s_len: int, first: bool):
+        """Jitted prefill (fresh cache) / extend (append at position)
+        program for one piece length — the engine-side twin of the
+        server's prefix-cache split programs."""
+        import jax
+
+        from ..models import generate as G
+
+        if self._prefill_fns is not None:
+            return self._prefill_fns(s_len, first)
+        model, variables = self.model, self.variables
+
+        def build():
+            if first:
+                return jax.jit(
+                    lambda toks: G.prefill(model, variables, toks))
+            return jax.jit(lambda cache, toks, pos: G.prefill(
+                model, variables, toks, cache=cache, position=pos))
+
+        return lru_get(self._pf_fns,
+                       ("pfill" if first else "extend", s_len),
+                       self._pf_cap, build)
+
+    def _advance_prefill(self, stream: Stream) -> None:
+        """Run ONE prefill piece for the head-of-queue stream; admit it
+        into a slot when the prompt is fully consumed AND a slot is
+        free (prefill works AHEAD while all slots are busy, so a
+        freshly evicted slot admits an already-prefilled request the
+        same boundary).  Chunked prefill is position-keyed cache
+        extension (models/generate._prefill): piecewise equals
+        one-shot, so interleaving changes latency, never tokens."""
+        import jax
+
+        group = stream.group
+        if stream.t_prefill_start is None:
+            stream.t_prefill_start = time.perf_counter()
+            if group.t_first_prefill is None:
+                group.t_first_prefill = stream.t_prefill_start
+        if stream.pieces:               # full-length prefix hits skip
+            piece = stream.pieces[0]
+            toks = stream.toks[:, stream.filled:stream.filled + piece]
+            try:
+                with self.device_lock:
+                    if stream.cache is None:
+                        logits, cache = self._pf_fn(piece, True)(toks)
+                    else:
+                        logits, cache = self._pf_fn(piece, False)(
+                            stream.cache, toks, stream.filled)
+                    jax.block_until_ready(logits)
+            except BaseException as e:
+                self._fail_group(group, e)
+                return
+            stream.cache = cache
+            stream.logits = logits
+            stream.filled += piece
+            stream.pieces.pop(0)
+            self.prefill_chunks_total += 1
+            if stream.pieces:
+                return                  # more prompt to consume
+        if not stream.pf_done:
+            stream.pf_done = True
+            if group.on_prefilled is not None:
+                try:
+                    group.on_prefilled(stream)
+                except Exception:
+                    pass  # cache store-back must not fail the request
+        if self.slots.free_slots == 0:
+            return          # wait, fully prefilled, for an eviction
+        self.queue.pop_head()
+        self._admit(stream)
+
+    def _admit(self, stream: Stream) -> None:
+        """Step-boundary admission: first token from the prefill
+        logits (greedy argmax — np and jnp agree on first-max
+        tie-breaking), cache into a free slot.  Device failures
+        (including the FIRST insert's lazy stacked-pool allocation —
+        the engine's largest device buy) release the slot and fail
+        the group: a waiter must never hang on an admission that
+        silently died."""
+        import jax
+
+        slot = self.slots.acquire()
+        assert slot is not None, "admission without a free slot"
+        try:
+            logits = np.asarray(jax.device_get(stream.logits))[0]
+        except BaseException as e:
+            self.slots.release(slot)
+            self._fail_group(stream.group, e)
+            return
+        first = int(np.argmax(logits))
+        stream.out.append(first)
+        stream.t_admit = time.perf_counter()
+        stream.group.t_last_admit = stream.t_admit
+        stream.logits = None
+        if stream.done():               # new == 1, or instant eos
+            stream.cache = None
+            self.slots.release(slot)
+            self._complete(stream)
+            self.admitted_total += 1
+            self.evicted_total += 1
+            return
+        try:
+            with self.device_lock:
+                self.slots.insert(slot, stream.cache, first,
+                                  stream.p_len)
+        except BaseException as e:
+            self.slots.release(slot)
+            self._fail_group(stream.group, e)
+            return
+        stream.cache = None             # pool owns the KV now
+        stream.slot = slot
+        self._resident[slot] = stream
+        self.admitted_total += 1
+
+    # -- decode ---------------------------------------------------------
+
+    def _pick_window(self) -> int:
+        """Decode steps to fuse into the next device dispatch.
+
+        Window = 1 whenever a smaller granularity could make forward
+        progress sooner: a queued request with a free slot is
+        admissible at the very next boundary, an eos-capable resident
+        might free one at any step, and a queued prompt still mid-
+        prefill earns one chunk per BOUNDARY (prefill_budget) — fusing
+        would starve its prefill-ahead and leave the next evicted slot
+        waiting on an unfinished prompt.  Otherwise the only capacity
+        event is a BUDGET eviction, and ``min(remaining)`` lands the
+        window end exactly on the earliest one — so fusing up to
+        ``decode_window`` steps (rounded down to a power of two to
+        bound compiled programs) saves per-step dispatch + host-sync
+        overhead without delaying a single admission."""
+        cap = self.policy.decode_window
+        if cap <= 1:
+            return 1
+        head = self.queue.head()
+        if head is not None and (
+                not head.pf_done
+                or self.slots.free_slots > 0
+                or any(s.eos_id is not None
+                       for s in self._resident.values())):
+            return 1
+        rem = min(s.new - len(s.out)
+                  for s in self._resident.values())
+        w, cap = 1, min(cap, max(1, rem))
+        while w * 2 <= cap:
+            w *= 2
+        return w
+
+    def _decode_step(self) -> None:
+        """Advance every resident stream by one fused window of decode
+        steps; evict finished streams so their slots are admissible
+        the SAME boundary.  Within a window a stream stops consuming
+        at its own eos/budget (each token depends only on its prefix
+        and rows never interact, so the window's later tokens for that
+        stream are discardable garbage — exactness is untouched)."""
+        window = self._pick_window()
+        try:
+            with self.device_lock:
+                toks_w = self.slots.step(window)       # [W, S]
+        except BaseException as e:
+            for slot, stream in list(self._resident.items()):
+                self._fail_group(stream.group, e)
+            return
+        self.decode_steps_total += window
+        for slot, stream in list(self._resident.items()):
+            for w in range(window):
+                stream.out.append(int(toks_w[w, slot]))
+                if stream.done():
+                    break
+            if stream.done():
+                del self._resident[slot]
+                self.slots.release(slot)
+                stream.slot = None
+                self.evicted_total += 1
+                self._complete(stream)
+
+    # -- completion -----------------------------------------------------
+
+    def _complete(self, stream: Stream) -> None:
+        group = stream.group
+        group.complete_row(stream)
+        if group.event.is_set() and group.error is None:
+            self.completed_total += 1
+
+    def _fail_group(self, group: RequestGroup,
+                    err: BaseException) -> None:
+        """Deliver ``err`` to every thread waiting on ``group`` and
+        reclaim its resources; OTHER groups' streams keep running (a
+        stranger's OOM must not kill the batch)."""
+        self.queue.drop_group(group)
+        for slot, stream in list(self._resident.items()):
+            if stream.group is group:
+                del self._resident[slot]
+                self.slots.release(slot)
+                self.evicted_total += 1
+        group.fail(err)
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        # Per-request queue/prefill/decode timing lives in ModelServer
+        # (_note_breakdown, fed from group.breakdown()) — one source
+        # of truth for /metrics; the engine exposes scheduling
+        # counters only.
+        return {
+            "slots": self.slots.n_slots,
+            "slots_active": self.slots.active_slots,
+            "queue_len": len(self.queue),
+            "queue_depth": self.policy.queue_depth,
+            "admitted_total": self.admitted_total,
+            "evicted_total": self.evicted_total,
+            "decode_steps_total": self.decode_steps_total,
+            "prefill_chunks_total": self.prefill_chunks_total,
+            "completed_total": self.completed_total,
+            "rejected_total": self.queue.rejected,
+        }

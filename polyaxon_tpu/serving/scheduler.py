@@ -1,0 +1,264 @@
+"""Scheduler policy for the continuous-batching engine.
+
+The engine (engine.py) decouples the LOGICAL workload (a stream of
+requests with arbitrary prompt lengths and token budgets) from the
+PHYSICAL batch (a fixed pool of decode slots): requests wait in a
+bounded admission queue, are prefilled chunk-by-chunk between decode
+steps, and enter a slot at a decode-step boundary.  This module owns
+the passive pieces of that design:
+
+- :class:`RequestGroup` / :class:`Stream` — one /generate request and
+  its per-row decode streams (a B-row request is B independent
+  streams: decode rows never interact, so rows of one request need not
+  occupy adjacent slots or even be resident together).
+- :class:`AdmissionQueue` — the bounded FIFO between the HTTP
+  front-end and the engine.  Submission is all-or-nothing per request;
+  a full queue raises :class:`QueueFullError`, which the front-end
+  maps to 429 + Retry-After (explicit backpressure instead of an
+  unbounded thread pile-up).
+- :class:`SchedulerPolicy` — the knobs: slot count, queue depth, the
+  default prefill chunk, and how much prefill work may run per decode
+  boundary (1 chunk while decodes are active — prefill must never
+  starve the running batch — bursting only when the batch is idle).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity: the front-end returns 429 with
+    ``Retry-After: retry_after`` (seconds).  Deliberately NOT a
+    ValueError — a full queue is backpressure, not a client error."""
+
+    def __init__(self, msg: str, retry_after: int = 1):
+        super().__init__(msg)
+        self.retry_after = int(retry_after)
+
+
+class SchedulerPolicy:
+    """Continuous-batching knobs (docs/SERVING.md).
+
+    ``n_slots``: decode-slot pool size — the physical batch width of
+    every decode step and the KV memory bound (n_slots x one full
+    per-request cache).  ``queue_depth``: max ROWS waiting for a slot
+    before the front-end sheds load.  ``prefill_chunk``: default
+    prompt-chunk length for interleaved prefill (None = whole prompt
+    in one piece; per-request ``prefill_chunk`` overrides).
+    ``idle_prefill_burst``: prefill chunks per tick while NO decode is
+    running (when decodes are active, exactly one chunk per step
+    boundary).  ``decode_window``: max decode steps fused into one
+    device dispatch when no admission could happen sooner anyway
+    (engine._pick_window drops to single steps whenever a queued
+    request or a possible eos eviction is in play, and never fuses
+    past the earliest budget eviction — the window saves dispatch
+    overhead, never scheduling granularity).  ``retry_after_s``: the
+    Retry-After hint on 429s.
+    """
+
+    def __init__(self, *, n_slots: int = 8, queue_depth: int = 64,
+                 prefill_chunk: Optional[int] = None,
+                 idle_prefill_burst: int = 4, decode_window: int = 8,
+                 retry_after_s: int = 1):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1; got {n_slots}")
+        if queue_depth < 1:
+            raise ValueError(
+                f"queue_depth must be >= 1; got {queue_depth}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1; got {prefill_chunk}")
+        if decode_window < 1:
+            raise ValueError(
+                f"decode_window must be >= 1; got {decode_window}")
+        self.n_slots = int(n_slots)
+        self.queue_depth = int(queue_depth)
+        self.prefill_chunk = prefill_chunk
+        self.idle_prefill_burst = max(1, int(idle_prefill_burst))
+        self.decode_window = int(decode_window)
+        self.retry_after_s = int(retry_after_s)
+
+    def prefill_budget(self, decodes_active: bool,
+                       free_slots: int = 1) -> int:
+        """Prefill chunks allowed at this step boundary.  While
+        decodes run, at least one chunk per boundary (interleaved
+        prefill must make progress) and up to one per FREE slot — an
+        empty slot burns a full-width decode step on garbage every
+        boundary it stays empty, which costs more than the prefill
+        chunks that would fill it.  Idle batch: burst."""
+        if not decodes_active:
+            return max(self.idle_prefill_burst, free_slots)
+        return max(1, free_slots)
+
+    def chunk_plan(self, p_len: int, req_chunk: Optional[int]
+                   ) -> List[int]:
+        """Split a ``p_len`` prompt into per-boundary prefill pieces.
+        Chunking is position-keyed cache mechanics (models/generate
+        ``_prefill``): it changes scheduling and memory, never logits.
+        """
+        chunk = req_chunk if req_chunk is not None else self.prefill_chunk
+        if chunk is None or chunk >= p_len:
+            return [p_len]
+        n_full, rem = divmod(p_len, chunk)
+        return [chunk] * n_full + ([rem] if rem else [])
+
+
+class Stream:
+    """One prompt ROW moving through the engine: queued -> prefilling
+    (chunk by chunk) -> resident in a decode slot -> done."""
+
+    __slots__ = ("group", "row", "toks", "new", "eos_id", "pieces",
+                 "filled", "cache", "logits", "out", "slot",
+                 "pf_done", "t_prefill_start", "t_admit")
+
+    def __init__(self, group: "RequestGroup", row: int,
+                 toks: np.ndarray, new: int, eos_id: Optional[int],
+                 pieces: List[int]):
+        self.group = group
+        self.row = row
+        self.toks = toks          # [1, p_len] int32
+        self.new = new
+        self.eos_id = eos_id
+        self.pieces = pieces      # remaining prefill piece lengths
+        self.filled = 0           # prompt tokens already prefilled
+        self.cache = None         # partial B=1 cache during prefill
+        self.logits = None        # last-position logits once filled
+        self.out: List[int] = []  # committed new tokens
+        self.slot: Optional[int] = None
+        self.pf_done = False      # prompt fully consumed (may still
+        #                           be queued, waiting for a slot)
+        self.t_prefill_start: Optional[float] = None
+        self.t_admit: Optional[float] = None
+
+    @property
+    def p_len(self) -> int:
+        return self.toks.shape[1]
+
+    def done(self) -> bool:
+        if len(self.out) >= self.new:
+            return True
+        return self.eos_id is not None and bool(self.out) \
+            and self.out[-1] == self.eos_id
+
+    def result_row(self) -> np.ndarray:
+        """prompt ++ new tokens, eos-padded to the requested budget —
+        exactly solo ``generate``'s eos-freeze semantics (finished rows
+        keep emitting eos), so engine responses are comparable
+        token-for-token with solo ones."""
+        toks = list(self.out)
+        if len(toks) < self.new:
+            toks += [self.eos_id] * (self.new - len(toks))
+        return np.concatenate(
+            [self.toks[0], np.asarray(toks, np.int32)])
+
+
+class RequestGroup:
+    """One /generate request: B streams plus completion/timing state."""
+
+    def __init__(self, rows: np.ndarray, new: int,
+                 eos_id: Optional[int], pieces_per_row: List[int]):
+        self.rows = rows
+        self.new = new
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        # Called (with the stream) on the engine thread the moment a
+        # stream's prompt is fully prefilled, before slot admission —
+        # the prefix cache's store-back hook (server._store_stream_
+        # prefix), so sessions grow warm without a solo detour.
+        self.on_prefilled = None
+        self.results: List[Optional[np.ndarray]] = [None] * rows.shape[0]
+        self._pending = rows.shape[0]
+        self.t_submit = time.perf_counter()
+        self.t_first_prefill: Optional[float] = None
+        self.t_last_admit: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.streams = [
+            Stream(self, i, rows[i:i + 1], new, eos_id,
+                   list(pieces_per_row))
+            for i in range(rows.shape[0])]
+
+    def complete_row(self, stream: Stream) -> None:
+        self.results[stream.row] = stream.result_row()
+        self._pending -= 1
+        if self._pending == 0:
+            self.t_done = time.perf_counter()
+            self.event.set()
+
+    def fail(self, err: BaseException) -> None:
+        if not self.event.is_set():
+            self.error = err
+            self.t_done = time.perf_counter()
+            self.event.set()
+
+    def result(self) -> np.ndarray:
+        return np.stack(self.results, axis=0)
+
+    def breakdown(self):
+        """(queue_s, prefill_s, decode_s) wall-clock phase split."""
+        t0 = self.t_submit
+        tp = self.t_first_prefill if self.t_first_prefill is not None \
+            else (self.t_done or t0)
+        ta = self.t_last_admit if self.t_last_admit is not None \
+            else (self.t_done or tp)
+        td = self.t_done if self.t_done is not None else ta
+        return max(0.0, tp - t0), max(0.0, ta - tp), max(0.0, td - ta)
+
+
+class AdmissionQueue:
+    """Bounded FIFO of streams awaiting prefill + a slot.
+
+    ``submit`` is atomic per request (all B streams or none) so a
+    multi-row request can never deadlock half-admitted against the
+    depth bound.  The engine pops from the head only (FIFO — no
+    reordering policy yet; the policy hook is SchedulerPolicy).
+    """
+
+    def __init__(self, policy: SchedulerPolicy):
+        self.policy = policy
+        self._q: "deque[Stream]" = deque()
+        self._lock = threading.Lock()
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, group: RequestGroup) -> None:
+        n = len(group.streams)
+        if n > self.policy.queue_depth:
+            # Usage error, not backpressure: a request wider than the
+            # whole queue can never be admitted even when idle, so a
+            # retryable 429 would have a well-behaved client retry
+            # forever.  ValueError maps to 400 at the HTTP layer.
+            raise ValueError(
+                f"request has {n} rows but the admission queue holds "
+                f"{self.policy.queue_depth}; raise --queue-depth or "
+                f"split the batch")
+        with self._lock:
+            if len(self._q) + n > self.policy.queue_depth:
+                self.rejected += 1
+                raise QueueFullError(
+                    f"admission queue full ({len(self._q)}/"
+                    f"{self.policy.queue_depth} rows waiting); retry "
+                    f"after {self.policy.retry_after_s}s",
+                    retry_after=self.policy.retry_after_s)
+            self._q.extend(group.streams)
+
+    def head(self) -> Optional[Stream]:
+        with self._lock:
+            return self._q[0] if self._q else None
+
+    def pop_head(self) -> Optional[Stream]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def drop_group(self, group: RequestGroup) -> None:
+        """Remove a failed group's still-queued streams."""
+        with self._lock:
+            self._q = deque(s for s in self._q if s.group is not group)

@@ -1,0 +1,189 @@
+"""Slot-indexed KV memory for the continuous-batching engine.
+
+The zoo's decode machinery keys everything off per-cache ``cache_index``
+variables and a ``decode_position`` argument — both traceable — so a
+pool of S independent per-request caches can be STACKED on one leading
+slot axis and stepped under ``jax.vmap``: one compiled program per
+model advances every resident request by one token, each at its OWN
+position.  This sidesteps the shared-``cache_index`` limitation that
+forced the old coalescing path to require a single prompt length per
+merged batch: slots are fully independent (ring caches, int8 KV and
+scan-stacked layers stack uniformly, because the slot axis is ADDED
+rather than reusing the model's internal batch axis — the exact
+layout-keying headache beam search has to solve does not exist here).
+
+Three device programs, compiled once each per model:
+
+- ``step``:   [S]-stacked cache + toks [S] + positions [S]
+              -> next greedy tokens [W, S] + updated stacked cache,
+              for a WINDOW of W decode steps fused into one program
+              (``lax.scan`` over the vmapped one-token body; one
+              compiled program per power-of-two W, so a window costs
+              one dispatch + one host sync instead of W — the
+              engine picks W so scheduling granularity is never
+              sacrificed, see engine._pick_window)
+- ``insert``: write one finished prefill (a B=1 cache) into slot i
+              (``dynamic_update_index_in_dim`` per leaf; the slot
+              index is traced, so one program serves every slot)
+- the prefill/extend programs live in engine.py (they are keyed by
+  chunk length, not slot count)
+
+Idle slots still step (the batch shape is fixed) — they decode garbage
+into their own cache, which the next ``insert`` overwrites wholesale.
+That is the standard continuous-batching trade: a fixed physical batch
+so there is exactly ONE compiled decode program, with logical
+occupancy managed above it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SlotKVManager:
+    """Fixed pool of ``n_slots`` decode slots over one model.
+
+    Owns the stacked cache pytree (every leaf gains a leading
+    ``n_slots`` axis), the free-slot list, and the jitted step/insert
+    programs.  Device work only — request bookkeeping lives in
+    engine.py/scheduler.py.
+    """
+
+    def __init__(self, model, variables, n_slots: int):
+        self.model = model
+        self.variables = variables
+        self.n_slots = int(n_slots)
+        self._stacked = None          # pytree, leaves [S, ...]
+        self._free = list(range(self.n_slots))
+        self._step_fns = {}           # window length -> jitted scan
+        self._insert_fn = None
+        # Host-side per-slot decode state (fed to the step program).
+        self.tokens = np.zeros((self.n_slots,), np.int32)
+        self.positions = np.zeros((self.n_slots,), np.int32)
+
+    # -- slot accounting ------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def acquire(self) -> Optional[int]:
+        return self._free.pop(0) if self._free else None
+
+    def release(self, slot: int) -> None:
+        """Evict: the slot is reusable the SAME step — no device work,
+        the stale KV is invisible (nothing reads it) until the next
+        insert overwrites it."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} already free")
+        self._free.append(slot)
+        self._free.sort()
+        # Park the idle slot at position 0 so its dead stepping never
+        # drifts into out-of-range position-embedding lookups.
+        self.tokens[slot] = 0
+        self.positions[slot] = 0
+
+    # -- device programs ------------------------------------------------
+
+    def _ensure_stacked(self, template_cache) -> None:
+        """Allocate the stacked pool lazily from the FIRST prefilled
+        cache's tree (guarantees the template matches what prefill
+        actually produces — int8 scale leaves, ring position tables,
+        scan-stacked layers all included)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._stacked is None:
+            self._stacked = jax.tree.map(
+                lambda l: jnp.zeros((self.n_slots,) + l.shape, l.dtype),
+                template_cache)
+
+    def insert(self, slot: int, cache, first_token: int,
+               position: int) -> None:
+        """Admit a prefilled request into ``slot`` at a step boundary:
+        write its B=1 cache into the pool and arm the slot's decode
+        state (``first_token`` at ``position`` is the next step's
+        input, matching solo generate's sample-first contract)."""
+        import jax
+
+        self._ensure_stacked(cache)
+        if self._insert_fn is None:
+            def _insert(stacked, one, idx):
+                return jax.tree.map(
+                    lambda s, n: jax.lax.dynamic_update_index_in_dim(
+                        s, n.astype(s.dtype), idx, 0), stacked, one)
+            self._insert_fn = jax.jit(_insert)
+        self._stacked = self._insert_fn(self._stacked, cache, slot)
+        self.tokens[slot] = first_token
+        self.positions[slot] = position
+
+    def _build_step(self, window: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import generate as G
+
+        model, variables = self.model, self.variables
+
+        def one(cache, tok, pos):
+            # One decoder step for one slot: tok [] at absolute
+            # position pos [].  _params inside the closure keeps int8
+            # weights int8 in HBM (generate._params contract).
+            out, mut = model.apply(
+                {"params": G._params(variables), "cache": cache},
+                tok[None, None], decode=True, decode_position=pos,
+                mutable=["cache"])
+            logits = G.extract_logits(out)[:, -1][0]        # [V]
+            nxt = jnp.argmax(logits).astype(jnp.int32)      # greedy
+            return nxt, mut["cache"]
+
+        def step(stacked, toks, positions):
+            def body(carry, _):
+                cache, tok, pos = carry
+                nxt, cache = jax.vmap(one)(cache, tok, pos)
+                return (cache, nxt, pos + 1), nxt
+            (cache, _, _), outs = jax.lax.scan(
+                body, (stacked, toks, positions), None, length=window)
+            return outs, cache                              # [W, S]
+
+        return jax.jit(step)
+
+    def step(self, window: int = 1) -> np.ndarray:
+        """``window`` fused decode steps across the whole pool;
+        returns the greedy tokens [window, S] (garbage for idle slots
+        — the caller masks by occupancy).  Greedy argmax and the
+        token feedback run inside one scanned program, so a window
+        costs ONE dispatch + ONE host round-trip regardless of its
+        length; the caller (engine._pick_window) sizes the window so
+        no admission or budget-eviction boundary lands inside it."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._stacked is None:
+            raise RuntimeError("step() before any insert()")
+        fn = self._step_fns.get(window)
+        if fn is None:
+            fn = self._step_fns[window] = self._build_step(window)
+        outs, self._stacked = fn(
+            self._stacked, jnp.asarray(self.tokens),
+            jnp.asarray(self.positions))
+        outs = np.asarray(jax.device_get(outs))
+        # Arm the next step: every slot feeds back its own last token
+        # at the next position; idle slots' state is overwritten by
+        # the insert that reactivates them.
+        self.tokens = outs[-1].copy()
+        self.positions = self.positions + window
+        # Re-park free slots at position 0 so their dead stepping
+        # stays bounded by one window and can never drift past
+        # max_position on a long-lived resident batch.
+        if self._free:
+            idle = np.asarray(self._free, np.int32)
+            self.tokens[idle] = 0
+            self.positions[idle] = 0
+        return outs

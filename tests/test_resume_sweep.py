@@ -48,6 +48,37 @@ def test_tpu_rows_missing_file_is_zero(tmp_path, monkeypatch):
     assert mod.tpu_rows() == 0
 
 
+def test_tpu_rows_match_restricts_to_leg_key(tmp_path, monkeypatch):
+    """Attribution: a leg counts only rows matching its bench/model
+    key; "variant": None requires the field be ABSENT (bench.py omits
+    it), so a variant row can't satisfy the plain headline leg."""
+    mod = _load(tmp_path, monkeypatch)
+    rows = [
+        {"bench": "headline", "model": "gpt2-medium", "backend": "tpu"},
+        {"bench": "headline", "model": "gpt2-medium", "backend": "tpu",
+         "variant": "bwd-block-512"},
+        {"bench": "headline", "model": "bert-base", "backend": "tpu"},
+        {"bench": "decode", "model": "gpt2-medium", "backend": "tpu"},
+    ]
+    with open(mod.RESULTS, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert mod.tpu_rows() == 4
+    assert mod.tpu_rows(mod.LEG_MATCH["gpt2-headline"]) == 1
+    assert mod.tpu_rows(mod.LEG_MATCH["gpt2-bwd-block"]) == 1
+    assert mod.tpu_rows(mod.LEG_MATCH["bert-headline"]) == 1
+    assert mod.tpu_rows(mod.LEG_MATCH["decode-gpt2"]) == 1
+    assert mod.tpu_rows(mod.LEG_MATCH["decode-tinyllama"]) == 0
+
+
+def test_every_leg_has_a_match_spec(tmp_path, monkeypatch):
+    """A leg without a LEG_MATCH entry would fall back to the raw
+    row-count delta the attribution fix removed."""
+    mod = _load(tmp_path, monkeypatch)
+    for leg in mod.LEGS:
+        assert leg[0] in mod.LEG_MATCH, leg[0]
+
+
 def test_done_stamps_round_trip(tmp_path, monkeypatch):
     mod = _load(tmp_path, monkeypatch)
     assert mod.done_set() == set()
@@ -90,3 +121,25 @@ def test_run_leg_success_requires_rc0_and_rows(tmp_path, monkeypatch):
     script2 = script + "; raise SystemExit(1)"
     assert mod.run_leg("x", [sys.executable, "-c", script2], 30, 1) \
         == (False, True)
+
+
+def test_run_leg_not_done_off_foreign_rows(tmp_path, monkeypatch):
+    """Attribution end-to-end: a TPU row for a DIFFERENT bench landing
+    during the attempt (a concurrent harvest into the shared
+    results.jsonl) must not stamp this leg done."""
+    mod = _load(tmp_path, monkeypatch)
+    results = str(tmp_path / "results.jsonl")
+    open(results, "w").close()
+    monkeypatch.setitem(mod.LEG_MATCH, "x", {"bench": "mine"})
+
+    foreign = (f"import json; open({results!r}, 'a').write("
+               "json.dumps({'backend': 'tpu', 'bench': 'other'})"
+               " + '\\n')")
+    done, _ = mod.run_leg("x", [sys.executable, "-c", foreign], 30, 1)
+    assert not done
+
+    owned = (f"import json; open({results!r}, 'a').write("
+             "json.dumps({'backend': 'tpu', 'bench': 'mine'})"
+             " + '\\n')")
+    assert mod.run_leg("x", [sys.executable, "-c", owned], 30, 1) \
+        == (True, True)
