@@ -20,8 +20,19 @@ against all three batching modes —
 
 — recording per-class p50/p99 latency, aggregate tok/sec, and the
 engine/coalescing counters, plus the headline before/after ratios
-(``continuous_vs_coalesce``).  Rows land in benchmarks/results.jsonl
-as ``{"bench": "serving-load"}`` with a cpu-smoke regime tag off-TPU.
+(``continuous_vs_coalesce``).
+
+A second SAMPLED-MIX leg runs the same client structure with every
+other client sampling (varied temperature/top-k/top-p, per-client
+seeds) — the workload the per-slot RNG work exists for.  Under
+``coalesce``/``off`` a sampled request decodes solo holding the
+device lock for its whole decode, so a realistic mixed stream
+re-serializes; under the engine sampled streams occupy slots like
+greedy ones (position-keyed RNG keeps them schedule-invariant).  The
+sampled rows land beside the greedy ones (``load_sampled`` +
+``sampled_continuous_vs_coalesce``).  Rows land in
+benchmarks/results.jsonl as ``{"bench": "serving-load"}`` with a
+cpu-smoke regime tag off-TPU.
 
 Run: python benchmarks/bench_serving_load.py [--model gpt2-medium]
      [--short-clients 12] [--long-clients 4] [--requests 6]
@@ -48,6 +59,18 @@ RESULTS = os.path.join(REPO, "benchmarks", "results.jsonl")
 # p_len per model so the coalescer merges short and long freely (its
 # merge key excludes max_new_tokens) — the tail-latency pathology is
 # the budget gap, not a merge failure.
+# Sampled-mix leg: every odd-indexed client samples with one of these
+# (cycled), plus a per-client seed.  Varied on purpose — the engine
+# compiles ONE sampled step program regardless (shaping params are
+# run-time inputs), and the solo baselines' "sample_pos" program is
+# likewise shape-keyed only, so variety costs the baselines nothing.
+SAMPLED_PARAMS = (
+    {"temperature": 0.8, "top_k": 64},
+    {"temperature": 1.0, "top_p": 0.95},
+    {"temperature": 0.7, "top_k": 32, "top_p": 0.9},
+    {"temperature": 1.2},
+)
+
 SHAPES = {
     "gpt2-medium": {"short": (128, 16), "long": (128, 128)},
     # gpt2-mini is the CPU-smoke default: sized so a decode step's
@@ -84,9 +107,12 @@ def pct_ms(xs, p):
 
 
 def run_mixed_load(base: str, *, n_short: int, n_long: int,
-                   requests: int, shapes, vocab: int):
-    """N_short + N_long threads x R sequential greedy requests each;
-    returns per-class latencies + aggregate wall."""
+                   requests: int, shapes, vocab: int,
+                   sampled_mix: bool = False):
+    """N_short + N_long threads x R sequential requests each; returns
+    per-class latencies + aggregate wall.  ``sampled_mix`` switches
+    every other client to sampling (SAMPLED_PARAMS cycled, per-client
+    seed) — the 50/50 greedy/sampled traffic of the sampled leg."""
     import numpy as np
 
     rng = np.random.RandomState(0)
@@ -103,6 +129,10 @@ def run_mixed_load(base: str, *, n_short: int, n_long: int,
         cls = clients[i]
         _, new = shapes[cls]
         payload = {"prompt": prompts[i], "max_new_tokens": new}
+        if sampled_mix and i % 2 == 1:
+            payload.update(SAMPLED_PARAMS[(i // 2)
+                                          % len(SAMPLED_PARAMS)])
+            payload["seed"] = i
         for _ in range(requests):
             t0 = time.perf_counter()
             try:
@@ -142,6 +172,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
     n_slots = min(16, max(2, (n_short + n_long) // 4))
 
     rows = []
+    rows_sampled = []
     for mode in ("continuous", "coalesce", "off"):
         ms = ModelServer(model, variables, model_name=model_name,
                          max_batch=n_slots,
@@ -166,6 +197,13 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                 warm = warm_rng.randint(0, vocab, size=p_len).tolist()
                 _post(base, {"prompt": warm, "max_new_tokens": new},
                       timeout=900)
+                # Sampled warm: one request per shape covers EVERY
+                # sampled param combo — the engine's sampled step
+                # programs and the solo "sample_pos" program both
+                # take the shaping params at run time.
+                _post(base, {"prompt": warm, "max_new_tokens": new,
+                             "temperature": 0.9, "top_k": 64,
+                             "top_p": 0.95, "seed": 1}, timeout=900)
                 if mode == "coalesce":
                     # every bucket _batch_bucket can land on: powers
                     # of two AND the min(b, max_batch) cap — a
@@ -179,46 +217,64 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                               timeout=900)
                         b *= 2
 
-            pre = json.loads(urllib.request.urlopen(
-                base + "/info", timeout=30).read())
-            lats, wall, errors = run_mixed_load(
-                base, n_short=n_short, n_long=n_long,
-                requests=requests, shapes=shapes, vocab=vocab)
-            if errors:
-                print(f"# load mode={mode} errors: {errors[:3]}",
+            def timed_leg(sampled_mix):
+                pre = json.loads(urllib.request.urlopen(
+                    base + "/info", timeout=30).read())
+                lats, wall, errors = run_mixed_load(
+                    base, n_short=n_short, n_long=n_long,
+                    requests=requests, shapes=shapes, vocab=vocab,
+                    sampled_mix=sampled_mix)
+                leg = "sampled-mix" if sampled_mix else "greedy"
+                if errors:
+                    print(f"# load mode={mode} leg={leg} errors: "
+                          f"{errors[:3]}", file=sys.stderr)
+                    return None
+                total_toks = (len(lats["short"]) * shapes["short"][1]
+                              + len(lats["long"]) * shapes["long"][1])
+                info = json.loads(urllib.request.urlopen(
+                    base + "/info", timeout=30).read())
+                row = {
+                    "mode": mode,
+                    "workload": leg,
+                    "requests": len(lats["short"])
+                    + len(lats["long"]),
+                    "short_p50_ms": pct_ms(lats["short"], 50),
+                    "short_p99_ms": pct_ms(lats["short"], 99),
+                    "long_p50_ms": pct_ms(lats["long"], 50),
+                    "long_p99_ms": pct_ms(lats["long"], 99),
+                    "agg_tok_per_sec": round(total_toks / wall, 1),
+                }
+                if mode == "continuous":
+                    row["admitted"] = info.get("admitted_total", 0) \
+                        - pre.get("admitted_total", 0)
+                    row["decode_steps"] = \
+                        info.get("decode_steps_total", 0) \
+                        - pre.get("decode_steps_total", 0)
+                    if sampled_mix:
+                        row["admitted_sampled"] = \
+                            info.get("admitted_sampled_total", 0) \
+                            - pre.get("admitted_sampled_total", 0)
+                if mode == "coalesce":
+                    row["coalesced_batches"] = \
+                        info["coalesced_batches"] \
+                        - pre["coalesced_batches"]
+                    row["coalesced_requests"] = \
+                        info["coalesced_requests"] \
+                        - pre["coalesced_requests"]
+                print(f"# mode={mode} leg={leg}: short "
+                      f"p50={row['short_p50_ms']}ms "
+                      f"p99={row['short_p99_ms']}ms, long "
+                      f"p50={row['long_p50_ms']}ms, "
+                      f"agg={row['agg_tok_per_sec']} tok/s",
                       file=sys.stderr)
-                continue
-            total_toks = (len(lats["short"]) * shapes["short"][1]
-                          + len(lats["long"]) * shapes["long"][1])
-            info = json.loads(urllib.request.urlopen(
-                base + "/info", timeout=30).read())
-            row = {
-                "mode": mode,
-                "requests": len(lats["short"]) + len(lats["long"]),
-                "short_p50_ms": pct_ms(lats["short"], 50),
-                "short_p99_ms": pct_ms(lats["short"], 99),
-                "long_p50_ms": pct_ms(lats["long"], 50),
-                "long_p99_ms": pct_ms(lats["long"], 99),
-                "agg_tok_per_sec": round(total_toks / wall, 1),
-            }
-            if mode == "continuous":
-                row["admitted"] = info.get("admitted_total", 0) \
-                    - pre.get("admitted_total", 0)
-                row["decode_steps"] = \
-                    info.get("decode_steps_total", 0) \
-                    - pre.get("decode_steps_total", 0)
-            if mode == "coalesce":
-                row["coalesced_batches"] = info["coalesced_batches"] \
-                    - pre["coalesced_batches"]
-                row["coalesced_requests"] = \
-                    info["coalesced_requests"] \
-                    - pre["coalesced_requests"]
-            rows.append(row)
-            print(f"# mode={mode}: short p50={row['short_p50_ms']}ms "
-                  f"p99={row['short_p99_ms']}ms, long "
-                  f"p50={row['long_p50_ms']}ms, "
-                  f"agg={row['agg_tok_per_sec']} tok/s",
-                  file=sys.stderr)
+                return row
+
+            row = timed_leg(False)
+            if row is not None:
+                rows.append(row)
+            row = timed_leg(True)
+            if row is not None:
+                rows_sampled.append(row)
         finally:
             srv.shutdown()
             srv.server_close()  # release the listening socket too
@@ -232,10 +288,18 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         "long_clients": n_long,
         "requests_per_client": requests,
         "load": rows,
+        "load_sampled": rows_sampled,
         # Headline before/after: the engine vs the seed coalescing
-        # path (and vs the serialized floor) on the same traffic.
+        # path (and vs the serialized floor) on the same traffic —
+        # once for the all-greedy stream, once for the 50/50
+        # greedy/sampled mix (where the baselines decode every
+        # sampled request solo).
         "continuous_vs_coalesce": _ab(rows, "continuous", "coalesce"),
         "continuous_vs_serialized": _ab(rows, "continuous", "off"),
+        "sampled_continuous_vs_coalesce":
+            _ab(rows_sampled, "continuous", "coalesce"),
+        "sampled_continuous_vs_serialized":
+            _ab(rows_sampled, "continuous", "off"),
         **prefix,
     }
 
@@ -344,11 +408,11 @@ def main() -> int:
     row = {"bench": "serving-load", "ts": time.time(),
            **({"regime": "cpu-smoke"} if backend != "tpu" else {}),
            **r}
-    # A mode that errored out is missing from load[]: mark the row
-    # partial so resume_sweep's leg attribution (non-partial rows
-    # only) retries the leg instead of stamping it done without the
-    # headline A/B measurement.
-    if len(r.get("load", [])) < 3:
+    # A mode that errored out is missing from load[]/load_sampled[]:
+    # mark the row partial so resume_sweep's leg attribution
+    # (non-partial rows only) retries the leg instead of stamping it
+    # done without the headline A/B measurements.
+    if len(r.get("load", [])) < 3 or len(r.get("load_sampled", [])) < 3:
         row["partial"] = True
     print(json.dumps(row))
     with open(RESULTS, "a") as f:

@@ -325,6 +325,13 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
     toks = np.asarray(rows, dtype=np.int32)
     t0 = _time.perf_counter()
     try:
+        # Uniform sampling-param validation (same messages as the
+        # server): an explicit --top-k 0 / --top-p 0 must be refused
+        # on every decode path, not silently treated as "disabled" by
+        # the positional branch's internal 0-encoding.
+        G._check_top_k(top_k, getattr(getattr(model, "cfg", None),
+                                      "vocab_size", None))
+        G._check_top_p(top_p)
         if draft_model is not None:
             if beams > 1:
                 raise click.ClickException(
@@ -359,6 +366,19 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                                   max_new_tokens=max_new_tokens,
                                   num_beams=beams, eos_id=eos_id,
                                   prefill_chunk=prefill_chunk)
+        elif G.positional_eligible(model, temperature):
+            # Decoder-only sampled decode uses the POSITION-KEYED
+            # schedule (token i's key is a function of --seed, row,
+            # and i alone), the same contract the server's
+            # continuous-batching engine samples under — so `ptpu
+            # generate --seed N` and a served request with seed N
+            # return the same tokens.
+            out = G.generate_positional(model, variables, toks,
+                                        max_new_tokens=max_new_tokens,
+                                        temperature=temperature,
+                                        top_k=top_k, top_p=top_p,
+                                        eos_id=eos_id, seed=seed,
+                                        prefill_chunk=prefill_chunk)
         else:
             out = G.generate(model, variables, toks,
                              max_new_tokens=max_new_tokens,
@@ -410,9 +430,10 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--max-batch", default=8, type=int)
 @click.option("--batching", default="continuous",
               type=click.Choice(["continuous", "coalesce", "off"]),
-              help="Greedy batching policy: continuous (slot-based "
-                   "engine, default), coalesce (legacy whole-request "
-                   "merging), off (serialize).")
+              help="Batching policy: continuous (slot-based engine "
+                   "serving greedy AND sampled requests, default), "
+                   "coalesce (legacy whole-request merging of greedy "
+                   "traffic; sampled decodes solo), off (serialize).")
 @click.option("--slots", "n_slots", default=8, type=int,
               help="Continuous-batching decode slots (physical batch "
                    "width; KV memory = slots x one request cache).")
@@ -443,11 +464,15 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     here the framework ships the model server itself (stdlib HTTP, jit
     compile cache, int8 serving flags — see the serving package).
 
-    Greedy traffic runs through the continuous-batching engine by
-    default: a fixed pool of decode slots with step-boundary
-    admission, eos-eviction, interleaved chunked prefill, and 429
-    backpressure once the admission queue fills (--batching selects
-    the legacy coalescing or serialized baselines for A/Bs).
+    Greedy AND sampled traffic runs through the continuous-batching
+    engine by default: a fixed pool of decode slots with
+    step-boundary admission, eos-eviction, interleaved chunked
+    prefill, and 429 backpressure once the admission queue fills
+    (--batching selects the legacy coalescing or serialized baselines
+    for A/Bs).  Sampled slots draw from position-keyed PRNG streams —
+    a request's tokens depend on its (seed, token index) only, never
+    on what else shares the pool — so responses are reproducible
+    under any concurrency.  Beam/speculative requests decode solo.
     """
     import jax
 

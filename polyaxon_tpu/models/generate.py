@@ -118,6 +118,315 @@ def _check_top_p(top_p) -> None:
             f"temperature=0 for greedy decoding)")
 
 
+def _check_temperature(temperature) -> None:
+    """A typo'd negative temperature must not silently decode greedy
+    — one message shared by the server, the positional entry points,
+    and speculative decoding."""
+    if temperature < 0.0:
+        raise ValueError(
+            f"temperature must be >= 0; got {temperature}")
+
+
+def _check_top_k(top_k, vocab=None) -> None:
+    """top_k outside [1, vocab] would fail at jit-trace time inside
+    lax.top_k (opaque shape error, possibly under a server's device
+    lock) — refuse it at the entry points, with ONE message every
+    serving path shares."""
+    if top_k is None:
+        return
+    if top_k < 1 or (vocab is not None and top_k > vocab):
+        hi = vocab if vocab is not None else "vocab_size"
+        raise ValueError(f"top_k must be in [1, {hi}]; got {top_k}")
+
+
+def _check_positional_sampling(top_k, top_p, temperature,
+                               vocab=None) -> None:
+    """Shared validation for the positional entry points — only for
+    CONCRETE params (jitted callers pass traced scalars and validate
+    in the server layer instead).  ``0`` is the internal "disabled"
+    encoding, so it passes here; the public HTTP surface rejects it
+    per the uniform-validation contract (server-side _check_top_k)."""
+    if isinstance(top_k, int) and top_k:
+        _check_top_k(top_k, vocab)
+    if isinstance(top_p, (int, float)) and top_p:
+        _check_top_p(float(top_p))
+    if isinstance(temperature, (int, float)):
+        _check_temperature(temperature)
+
+
+def positional_eligible(model, temperature) -> bool:
+    """Whether a request decodes under the POSITION-KEYED sampling
+    schedule: sampled (temperature != 0) on a decoder-only model.
+    The single predicate behind the server's solo + prefix-hit paths
+    and the CLI, so every surface routes — and therefore samples —
+    identically (seq2seq models keep the chain-rng generate_seq2seq
+    path; greedy never consults the PRNG at all)."""
+    return temperature != 0.0 and not hasattr(model, "encode")
+
+
+# -- position-keyed sampling ---------------------------------------------
+#
+# The chain schedule above (``rng, key = split(rng)`` per token) makes
+# a request's i-th sample depend on how many times the chain was split
+# before it — fine solo, but hostile to the continuous-batching engine,
+# where a stream's tokens are produced by whatever fused step windows
+# the scheduler happened to run.  The POSITION-KEYED schedule below
+# derives row r's i-th token key as fold_in(fold_in(PRNGKey(seed), r),
+# i): a pure function of (seed, row, token index) — never of batch
+# shape, decode-slot id, engine step count, or co-tenancy — so the
+# engine's per-slot streams and the solo reference draw identical
+# samples for one request, under ANY admission schedule.
+
+
+def sample_stream_keys(seed: int, rows: int) -> jax.Array:
+    """Per-row base keys for the position-keyed schedule: row ``r``
+    gets ``fold_in(PRNGKey(seed), r)``; its i-th generated token is
+    then drawn with ``fold_in(base, i)`` (:func:`_sample_positional_row`)."""
+    base = jax.random.PRNGKey(seed)
+    return jax.vmap(lambda r: jax.random.fold_in(base, r))(
+        jnp.arange(rows))
+
+
+def _sortable_bits(x):
+    """f32 -> uint32 order-preserving key (IEEE total order, NaN-free
+    inputs): unsigned comparison on the keys == value comparison on
+    the floats.  Positive floats get the sign bit set; negative
+    floats are bit-complemented (their bit patterns grow as the value
+    shrinks)."""
+    b = jax.lax.bitcast_convert_type(x.astype(jnp.float32),
+                                     jnp.uint32)
+    return jnp.where((b >> 31) == 0, b | jnp.uint32(0x80000000), ~b)
+
+
+def _bitwise_threshold(pred):
+    """Largest uint32 ``t`` with ``pred(t)`` true, for a predicate
+    monotone non-increasing in ``t``: greedy MSB-first bit
+    construction, 32 fixed iterations.  This is branchless exact
+    SELECTION — the returned threshold lands exactly on a data key —
+    at O(32 V) elementwise work, replacing the O(V log V) vocab sort
+    a per-slot-per-token sampler cannot afford (a 4096-wide XLA CPU
+    sort costs more than the decode step it follows)."""
+    def body(i, t):
+        t_try = t | (jnp.uint32(1)
+                     << (jnp.uint32(31) - i.astype(jnp.uint32)))
+        return jnp.where(pred(t_try), t_try, t)
+    return jax.lax.fori_loop(0, 32, body, jnp.uint32(0))
+
+
+def _shape_logits_positional(logits, temperature, top_k, top_p):
+    """Temperature/top-k/top-p shaping with TRACED per-row params —
+    the engine's slot step feeds per-slot arrays through ``vmap``,
+    the solo positional path broadcasts request scalars; both run
+    THIS function, so the two paths shape identically bit-for-bit.
+
+    Returns ``(shaped f32 logits, greedy flag)``.  ``temperature <=
+    0`` marks the row greedy (shaping still runs — in a dead lane —
+    because a mixed pool shares one program); ``top_k <= 0`` /
+    ``top_p <= 0`` disable those masks, and ``top_p >= 1`` is a no-op
+    by definition (the nucleus is the whole distribution).
+
+    Both cutoffs are found by 32-step bitwise binary search over the
+    float bit-space (:func:`_bitwise_threshold`) instead of a vocab
+    sort.  The selected VALUES are exactly the sort-based ones:
+
+    - top-k keeps ``{x : x >= k-th largest}`` (ties at the threshold
+      survive, like the static ``lax.top_k`` kth-value mask);
+    - top-p keeps ``{x : mass(values > x) < top_p}`` — the value
+      formulation of the sorted-prefix cumsum rule (provably the same
+      kept set: mass-above is monotone in the value, so the sorted
+      cut and the value test agree, ties included, and the top token
+      always survives since mass above it is 0).
+    """
+    v = logits.shape[-1]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    greedy = temperature <= 0.0
+    # greedy rows divide by 1 (not 0) so the dead sampling lane stays
+    # finite instead of poisoning the where with inf/nan
+    l = logits.astype(jnp.float32) / jnp.where(greedy, 1.0,
+                                               temperature)
+    # top-k: threshold = the k-th largest value = max t with
+    # |{keys >= t}| >= k
+    lbits = _sortable_bits(l)
+    k = jnp.clip(top_k, 1, v)
+    t_k = _bitwise_threshold(lambda t: jnp.sum(lbits >= t) >= k)
+    l = jnp.where((top_k > 0) & (lbits < t_k), -1e30, l)
+    # nucleus over the top-k-masked logits (masked lanes underflow to
+    # probability 0): boundary = max t whose strictly-above mass
+    # still holds >= top_p of the total
+    lbits = _sortable_bits(l)
+    e = jnp.exp(l - jnp.max(l))
+    pz = top_p * jnp.sum(e)
+    t_p = _bitwise_threshold(
+        lambda t: jnp.sum(jnp.where(lbits > t, e, 0.0)) >= pz)
+    l = jnp.where((top_p > 0.0) & (top_p < 1.0) & (lbits <= t_p),
+                  -1e30, l)
+    return l, greedy
+
+
+def _sample_positional_row(logits, base_key, index, temperature,
+                           top_k, top_p):
+    """Sample ONE token for ONE row under the position-keyed RNG
+    contract.  Every argument may be traced (the engine feeds
+    per-slot arrays, the solo path broadcasts request scalars).
+    ``temperature <= 0`` rows take argmax over the raw logits — the
+    greedy lane, identical to the greedy decode programs.  Shaping
+    runs in f32 (:func:`_shape_logits_positional`) so bf16 models
+    sample from the same grid the f32 solo reference uses."""
+    key = jax.random.fold_in(base_key, index)
+    l, greedy = _shape_logits_positional(logits, temperature, top_k,
+                                         top_p)
+    sampled = jax.random.categorical(key, l)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
+def _sample_positional(logits, keys, index, temperature, top_k, top_p):
+    """Batch wrapper over :func:`_sample_positional_row`: [B, V]
+    logits + [B] base keys -> [B] tokens, one request's scalar params
+    broadcast to every row."""
+    return jax.vmap(lambda l, k: _sample_positional_row(
+        l, k, index, temperature, top_k, top_p))(logits, keys)
+
+
+def _decode_loop_positional(apply_step, cache, first_logits, *,
+                            max_new_tokens: int, keys,
+                            temperature, top_k, top_p,
+                            eos_id: Optional[int]):
+    """Position-keyed twin of :func:`_decode_loop`: token i draws with
+    ``fold_in(base, i)`` instead of a split chain, so a prefill/
+    continue split — or the engine's slot schedule — can never shift
+    the stream."""
+    first = _sample_positional(first_logits, keys, 0, temperature,
+                               top_k, top_p)
+    done = jnp.zeros((first.shape[0],), bool)
+    if eos_id is not None:
+        done = first == eos_id
+
+    def step(carry, t):
+        cache, tok, done = carry
+        logits, cache = apply_step(cache, tok, t)
+        nxt = _sample_positional(logits, keys, t + 1, temperature,
+                                 top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt.astype(jnp.int32), done), nxt
+
+    if max_new_tokens > 1:
+        _, toks = jax.lax.scan(
+            step, (cache, first.astype(jnp.int32), done),
+            jnp.arange(max_new_tokens - 1))
+        new = jnp.concatenate([first[:, None], toks.T], axis=1)
+    else:
+        new = first[:, None]
+    return new.astype(jnp.int32)
+
+
+def generate_positional(model, variables, prompt, *,
+                        max_new_tokens: int, seed: int = 0,
+                        keys: Optional[jax.Array] = None,
+                        temperature=1.0, top_k=None, top_p=None,
+                        eos_id: Optional[int] = None,
+                        prefill_chunk: Optional[int] = None
+                        ) -> jax.Array:
+    """:func:`generate` under the position-keyed sampling schedule —
+    the solo REFERENCE the continuous-batching engine's sampled slots
+    are pinned against.
+
+    Row r's i-th generated token is sampled with
+    ``fold_in(fold_in(PRNGKey(seed), r), i)`` — a function of (seed,
+    row, token index) only — so the same request returns identical
+    tokens solo, in a full slot pool, or admitted mid-flight.
+    ``temperature``/``top_k``/``top_p`` may be traced scalars (the
+    server jits ONE program per shape and feeds them at run time);
+    ``top_k=None``/``0`` and ``top_p=None``/``0`` disable the masks,
+    ``temperature=0`` decodes greedily.  ``keys`` overrides the
+    seed-derived per-row base keys ([B]-batched PRNG keys).
+    """
+    if max_new_tokens < 0:
+        # same contract as generate(): 0 echoes the prompt
+        raise ValueError(f"max_new_tokens must be >= 0; got "
+                         f"{max_new_tokens}")
+    cfg = getattr(model, "cfg", None)
+    _check_positional_sampling(top_k, top_p, temperature,
+                               getattr(cfg, "vocab_size", None))
+    if top_k is None:
+        top_k = 0
+    if top_p is None:
+        top_p = 0.0
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if max_new_tokens == 0:
+        return prompt
+    b, p_len = prompt.shape
+    max_pos = getattr(cfg, "max_position", None)
+    if max_pos is not None and p_len + max_new_tokens > max_pos and \
+            not getattr(cfg, "kv_cache_ring", False):
+        raise ValueError(
+            f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_position ({max_pos})")
+    if keys is None:
+        keys = sample_stream_keys(seed, b)
+    first_logits, cache = _prefill(model, variables, prompt,
+                                   chunk=prefill_chunk)
+    new = generate_continue_positional(
+        model, variables, cache, first_logits, p_len,
+        max_new_tokens=max_new_tokens, keys=keys,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_id=eos_id, _validated=True)
+    return jnp.concatenate([prompt, new], axis=1)
+
+
+def generate_continue_positional(model, variables, cache, last_logits,
+                                 position: int, *, max_new_tokens: int,
+                                 seed: int = 0,
+                                 keys: Optional[jax.Array] = None,
+                                 temperature=1.0, top_k=None,
+                                 top_p=None,
+                                 eos_id: Optional[int] = None,
+                                 _validated: bool = False
+                                 ) -> jax.Array:
+    """Decode from a prefilled cache under the position-keyed schedule
+    (:func:`generate_positional`'s split form — same contract as
+    :func:`generate_continue` vs :func:`generate`).  Token indices
+    start at 0 for the first NEW token regardless of ``position``, so
+    a prefix-cache hit draws the same stream as a cold request."""
+    if not _validated:
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1; got "
+                             f"{max_new_tokens}")
+        cfg = getattr(model, "cfg", None)
+        _check_positional_sampling(top_k, top_p, temperature,
+                                   getattr(cfg, "vocab_size", None))
+        max_pos = getattr(cfg, "max_position", None)
+        if max_pos is not None and position + max_new_tokens > max_pos \
+                and not getattr(cfg, "kv_cache_ring", False):
+            raise ValueError(
+                f"position ({position}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the model's max_position "
+                f"({max_pos})")
+    if top_k is None:
+        top_k = 0
+    if top_p is None:
+        top_p = 0.0
+    if keys is None:
+        keys = sample_stream_keys(seed, last_logits.shape[0])
+
+    def apply_step(cache, tok, t):
+        out, mut = model.apply(
+            {"params": _params(variables), "cache": cache},
+            tok[:, None], decode=True, decode_position=position + t,
+            mutable=["cache"])
+        return extract_logits(out)[:, -1], mut["cache"]
+
+    return _decode_loop_positional(
+        apply_step, cache, last_logits,
+        max_new_tokens=max_new_tokens, keys=keys,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_id=eos_id)
+
+
 def _decode_loop(apply_step, cache, first_logits, *,
                  max_new_tokens: int, rng, temperature: float,
                  top_k: Optional[int], eos_id: Optional[int],
@@ -174,6 +483,8 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
         raise ValueError(f"max_new_tokens must be >= 0; got "
                          f"{max_new_tokens}")
     _check_top_p(top_p)
+    cfg = getattr(model, "cfg", None)
+    _check_top_k(top_k, getattr(cfg, "vocab_size", None))
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -181,7 +492,6 @@ def generate(model, variables, prompt, *, max_new_tokens: int,
         return prompt
     b, p_len = prompt.shape
     total = p_len + max_new_tokens
-    cfg = getattr(model, "cfg", None)
     max_pos = getattr(cfg, "max_position", None)
     if max_pos is not None and total > max_pos and \
             not getattr(cfg, "kv_cache_ring", False):
@@ -250,9 +560,10 @@ def generate_continue(model, variables, cache, last_logits,
             raise ValueError(f"max_new_tokens must be >= 1; got "
                              f"{max_new_tokens}")
         _check_top_p(top_p)
+        cfg = getattr(model, "cfg", None)
+        _check_top_k(top_k, getattr(cfg, "vocab_size", None))
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        cfg = getattr(model, "cfg", None)
         max_pos = getattr(cfg, "max_position", None)
         if max_pos is not None and position + max_new_tokens > max_pos \
                 and not getattr(cfg, "kv_cache_ring", False):
@@ -295,6 +606,7 @@ def generate_seq2seq(model, variables, enc_tokens, *,
         raise ValueError(f"max_new_tokens must be >= 1; got "
                          f"{max_new_tokens}")
     _check_top_p(top_p)
+    _check_top_k(top_k, getattr(model.cfg, "vocab_size", None))
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if start_id is None:
@@ -475,9 +787,10 @@ def generate_speculative(model, variables, draft_model, draft_variables,
     if sampled and rng is None:
         raise ValueError("temperature > 0 requires an rng key "
                          "(use temperature=0 for greedy decoding)")
-    if temperature < 0.0:
-        raise ValueError(f"temperature must be >= 0; got {temperature}")
+    _check_temperature(temperature)
     _check_top_p(top_p)
+    _check_top_k(top_k, getattr(getattr(model, "cfg", None),
+                                "vocab_size", None))
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     for m, nm in ((model, "target"), (draft_model, "draft")):

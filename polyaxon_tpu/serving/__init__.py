@@ -20,9 +20,11 @@ ModelServer, make_server``.
 """
 
 from .engine import DecodeEngine
-from .scheduler import QueueFullError, SchedulerPolicy
+from .scheduler import (QueueFullError, SamplingSpec,
+                        SchedulerPolicy)
 from .server import ModelServer, make_server
 from .slots import SlotKVManager
 
 __all__ = ["ModelServer", "make_server", "DecodeEngine",
-           "SchedulerPolicy", "SlotKVManager", "QueueFullError"]
+           "SchedulerPolicy", "SamplingSpec", "SlotKVManager",
+           "QueueFullError"]

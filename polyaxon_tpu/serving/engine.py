@@ -17,11 +17,19 @@ old design wasted are reclaimed at step boundaries —
 
 This is the decoupling of logical workload from physical batch that
 VirtualFlow (arXiv:2009.09523) argues for, applied to the decode
-loop.  Greedy requests only: per-slot greedy argmax is exact (rows
-never interact, eos-frozen rows pad to budget — identical to solo
-``generate``, pinned in tests/test_serving.py); sampled/beam/
-speculative requests keep the solo path, where one request owns the
-PRNG schedule.
+loop.  Greedy AND sampled (non-beam, non-speculative) requests share
+one slot pool and one compiled step program: per-slot greedy argmax
+is exact (rows never interact, eos-frozen rows pad to budget —
+identical to solo ``generate``, pinned in tests/test_serving.py),
+and sampled slots draw through the POSITION-KEYED RNG contract
+(models/generate): a stream's i-th token key is
+``fold_in(fold_in(PRNGKey(seed), row), i)`` — a function of (seed,
+row, token index) only, never of slot id, engine step count, or
+co-tenancy — so sampled output is bit-identical to the solo
+``generate_positional`` reference under any admission schedule
+(pinned in tests/test_sampled_engine.py).  Beam/speculative requests
+keep the solo path (they tile or roll back the cache, which the slot
+pool doesn't speak).
 
 Threading: ``submit`` may be called from any handler thread; all slot
 and queue mutation happens on the engine loop thread (or, in tests,
@@ -44,7 +52,7 @@ import numpy as np
 
 from ._lru import lru_get
 from .scheduler import (AdmissionQueue, QueueFullError, RequestGroup,
-                        SchedulerPolicy, Stream)
+                        SamplingSpec, SchedulerPolicy, Stream)
 from .slots import SlotKVManager
 
 __all__ = ["DecodeEngine", "QueueFullError"]
@@ -80,21 +88,35 @@ class DecodeEngine:
         self._thread_lock = threading.Lock()
         self._wake = threading.Condition()
         self._stop = False
-        # counters (read unlocked by metrics — monotonic ints)
+        # jitted first-token sampler for sampled admissions (token
+        # index 0, drawn from the prefill logits) — compiled once,
+        # shared by every stream
+        self._admit_sample_fn = None
+        # counters (read unlocked by metrics — monotonic ints);
+        # admitted/completed split by mode so pool utilization under
+        # mixed greedy/sampled load is observable
         self.admitted_total = 0
+        self.admitted_greedy_total = 0
+        self.admitted_sampled_total = 0
         self.evicted_total = 0
         self.decode_steps_total = 0
         self.prefill_chunks_total = 0
         self.completed_total = 0
+        self.completed_greedy_total = 0
+        self.completed_sampled_total = 0
 
     # -- submission (any thread) ----------------------------------------
 
     def submit(self, rows: np.ndarray, new: int,
                eos_id: Optional[int], prefill_chunk: Optional[int],
-               *, prefix=None, on_prefilled=None) -> RequestGroup:
-        """Enqueue a greedy request (may raise QueueFullError) and make
-        sure the loop is running.  Returns the group; callers block on
-        ``group.event``.
+               *, sampling: Optional[SamplingSpec] = None,
+               prefix=None, on_prefilled=None) -> RequestGroup:
+        """Enqueue a request (may raise QueueFullError) and make sure
+        the loop is running.  Returns the group; callers block on
+        ``group.event``.  ``sampling`` carries the per-request
+        (seed, temperature, top_k, top_p) — None (or temperature 0)
+        is greedy; sampled streams draw through the position-keyed
+        RNG contract, so their tokens are independent of co-tenancy.
 
         ``prefix=(p_cached, logits, cache)`` seeds a SINGLE-ROW request
         with an existing prefill state (the prefix-cache hit path): the
@@ -107,7 +129,7 @@ class DecodeEngine:
         if prefix is None:
             pieces = self.policy.chunk_plan(rows.shape[1],
                                             prefill_chunk)
-            group = RequestGroup(rows, new, eos_id, pieces)
+            group = RequestGroup(rows, new, eos_id, pieces, sampling)
         else:
             if rows.shape[0] != 1:
                 raise ValueError(
@@ -117,7 +139,7 @@ class DecodeEngine:
             suffix = rows.shape[1] - p_cached
             pieces = self.policy.chunk_plan(suffix, prefill_chunk) \
                 if suffix > 0 else []
-            group = RequestGroup(rows, new, eos_id, pieces)
+            group = RequestGroup(rows, new, eos_id, pieces, sampling)
             stream = group.streams[0]
             stream.filled = p_cached
             stream.logits = logits
@@ -132,10 +154,12 @@ class DecodeEngine:
 
     def generate(self, rows: np.ndarray, new: int,
                  eos_id: Optional[int],
-                 prefill_chunk: Optional[int]) -> np.ndarray:
+                 prefill_chunk: Optional[int],
+                 sampling: Optional[SamplingSpec] = None) -> np.ndarray:
         """Blocking submit -> [B, p_len + new] tokens (the /generate
         engine path)."""
-        group = self.submit(rows, new, eos_id, prefill_chunk)
+        group = self.submit(rows, new, eos_id, prefill_chunk,
+                            sampling=sampling)
         group.event.wait()
         if group.error is not None:
             raise group.error
@@ -334,10 +358,37 @@ class DecodeEngine:
         self.queue.pop_head()
         self._admit(stream)
 
+    def _first_token(self, stream: Stream, logits: np.ndarray) -> int:
+        """Token 0 for an admitted stream, from the prefill logits.
+        Greedy: host argmax (np and jnp agree on first-max
+        tie-breaking).  Sampled: the SAME position-keyed sampler the
+        slot step program runs, at token index 0, with the stream's
+        fold_in(PRNGKey(seed), row) base key — jitted once so
+        admission stays cheap."""
+        import jax
+
+        spec = stream.sampling
+        if not spec.sampled:
+            return int(np.argmax(logits))
+        from ..models import generate as G
+
+        if stream.base_key is None:
+            stream.base_key = np.asarray(jax.random.fold_in(
+                jax.random.PRNGKey(spec.seed), stream.row))
+        if self._admit_sample_fn is None:
+            self._admit_sample_fn = jax.jit(
+                lambda l, k, t, tk, tp:
+                G._sample_positional_row(l, k, 0, t, tk, tp))
+        with self.device_lock:
+            return int(self._admit_sample_fn(
+                logits, stream.base_key,
+                np.float32(spec.temperature), np.int32(spec.top_k),
+                np.float32(spec.top_p)))
+
     def _admit(self, stream: Stream) -> None:
         """Step-boundary admission: first token from the prefill
-        logits (greedy argmax — np and jnp agree on first-max
-        tie-breaking), cache into a free slot.  Device failures
+        logits (argmax, or the position-keyed sampler for sampled
+        streams), cache into a free slot.  Device failures
         (including the FIRST insert's lazy stacked-pool allocation —
         the engine's largest device buy) release the slot and fail
         the group: a waiter must never hang on an admission that
@@ -346,13 +397,14 @@ class DecodeEngine:
 
         slot = self.slots.acquire()
         assert slot is not None, "admission without a free slot"
+        spec = stream.sampling
         try:
             logits = np.asarray(jax.device_get(stream.logits))[0]
+            first = self._first_token(stream, logits)
         except BaseException as e:
             self.slots.release(slot)
             self._fail_group(stream.group, e)
             return
-        first = int(np.argmax(logits))
         stream.out.append(first)
         stream.t_admit = time.perf_counter()
         stream.group.t_last_admit = stream.t_admit
@@ -361,13 +413,16 @@ class DecodeEngine:
             stream.cache = None
             self.slots.release(slot)
             self._complete(stream)
-            self.admitted_total += 1
+            self._count_admitted(spec)
             self.evicted_total += 1
             return
         try:
             with self.device_lock:
-                self.slots.insert(slot, stream.cache, first,
-                                  stream.p_len)
+                self.slots.insert(
+                    slot, stream.cache, first, stream.p_len,
+                    base_key=stream.base_key, next_index=1,
+                    temperature=spec.temperature, top_k=spec.top_k,
+                    top_p=spec.top_p)
         except BaseException as e:
             self.slots.release(slot)
             self._fail_group(stream.group, e)
@@ -375,7 +430,14 @@ class DecodeEngine:
         stream.cache = None             # pool owns the KV now
         stream.slot = slot
         self._resident[slot] = stream
+        self._count_admitted(spec)
+
+    def _count_admitted(self, spec: SamplingSpec) -> None:
         self.admitted_total += 1
+        if spec.sampled:
+            self.admitted_sampled_total += 1
+        else:
+            self.admitted_greedy_total += 1
 
     # -- decode ---------------------------------------------------------
 
@@ -419,9 +481,14 @@ class DecodeEngine:
         and rows never interact, so the window's later tokens for that
         stream are discardable garbage — exactness is untouched)."""
         window = self._pick_window()
+        # One sampled resident switches the whole pool to the sampled
+        # step program (greedy co-tenants ride its argmax lane); an
+        # all-greedy pool keeps the cheaper greedy program.
+        sampled = any(s.sampling.sampled
+                      for s in self._resident.values())
         try:
             with self.device_lock:
-                toks_w = self.slots.step(window)       # [W, S]
+                toks_w = self.slots.step(window, sampled)  # [W, S]
         except BaseException as e:
             for slot, stream in list(self._resident.items()):
                 self._fail_group(stream.group, e)
@@ -446,6 +513,10 @@ class DecodeEngine:
         group.complete_row(stream)
         if group.event.is_set() and group.error is None:
             self.completed_total += 1
+            if group.sampling.sampled:
+                self.completed_sampled_total += 1
+            else:
+                self.completed_greedy_total += 1
 
     def _fail_group(self, group: RequestGroup,
                     err: BaseException) -> None:
@@ -470,12 +541,18 @@ class DecodeEngine:
         return {
             "slots": self.slots.n_slots,
             "slots_active": self.slots.active_slots,
+            "slot_occupancy": round(
+                self.slots.active_slots / self.slots.n_slots, 4),
             "queue_len": len(self.queue),
             "queue_depth": self.policy.queue_depth,
             "admitted_total": self.admitted_total,
+            "admitted_greedy_total": self.admitted_greedy_total,
+            "admitted_sampled_total": self.admitted_sampled_total,
             "evicted_total": self.evicted_total,
             "decode_steps_total": self.decode_steps_total,
             "prefill_chunks_total": self.prefill_chunks_total,
             "completed_total": self.completed_total,
+            "completed_greedy_total": self.completed_greedy_total,
+            "completed_sampled_total": self.completed_sampled_total,
             "rejected_total": self.queue.rejected,
         }

@@ -11,6 +11,10 @@ the passive pieces of that design:
   its per-row decode streams (a B-row request is B independent
   streams: decode rows never interact, so rows of one request need not
   occupy adjacent slots or even be resident together).
+- :class:`SamplingSpec` — the per-request (seed, temperature, top_k,
+  top_p) every stream carries into its slot; temperature 0 is greedy,
+  and sampled streams draw under the position-keyed RNG contract
+  (models/generate), so tokens never depend on the schedule.
 - :class:`AdmissionQueue` — the bounded FIFO between the HTTP
   front-end and the engine.  Submission is all-or-nothing per request;
   a full queue raises :class:`QueueFullError`, which the front-end
@@ -30,6 +34,44 @@ from collections import deque
 from typing import List, Optional
 
 import numpy as np
+
+
+class SamplingSpec:
+    """Per-request sampling parameters carried by every engine stream.
+
+    ``temperature == 0`` is greedy (the default — top_k/top_p are
+    inert then, matching solo ``generate``); ``top_k=0`` / ``top_p=0``
+    encode "disabled" so the whole spec vmaps into the slot step
+    program as plain numbers.  ``seed`` anchors the position-keyed
+    RNG contract (models/generate.sample_stream_keys): row ``r``'s
+    i-th generated token is drawn with
+    ``fold_in(fold_in(PRNGKey(seed), r), i)`` — a function of (seed,
+    row, token index) only, never of slot id, engine step count, or
+    co-tenancy — which is what makes engine output independent of the
+    admission schedule.
+    """
+
+    __slots__ = ("seed", "temperature", "top_k", "top_p")
+
+    def __init__(self, seed: int = 0, temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
+        self.seed = int(seed)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k) if top_k else 0
+        self.top_p = float(top_p) if top_p else 0.0
+
+    @property
+    def sampled(self) -> bool:
+        return self.temperature > 0.0
+
+    def __repr__(self) -> str:  # debuggability in engine dumps
+        return (f"SamplingSpec(seed={self.seed}, "
+                f"temperature={self.temperature}, top_k={self.top_k}, "
+                f"top_p={self.top_p})")
+
+
+GREEDY = SamplingSpec()
 
 
 class QueueFullError(RuntimeError):
@@ -113,18 +155,24 @@ class Stream:
     """One prompt ROW moving through the engine: queued -> prefilling
     (chunk by chunk) -> resident in a decode slot -> done."""
 
-    __slots__ = ("group", "row", "toks", "new", "eos_id", "pieces",
-                 "filled", "cache", "logits", "out", "slot",
-                 "pf_done", "t_prefill_start", "t_admit")
+    __slots__ = ("group", "row", "toks", "new", "eos_id", "sampling",
+                 "base_key", "pieces", "filled", "cache", "logits",
+                 "out", "slot", "pf_done", "t_prefill_start",
+                 "t_admit")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
-                 pieces: List[int]):
+                 pieces: List[int],
+                 sampling: Optional[SamplingSpec] = None):
         self.group = group
         self.row = row
         self.toks = toks          # [1, p_len] int32
         self.new = new
         self.eos_id = eos_id
+        self.sampling = sampling or GREEDY
+        # fold_in(PRNGKey(seed), row) — materialized lazily (engine
+        # _admit) so greedy streams never touch the PRNG at all
+        self.base_key = None
         self.pieces = pieces      # remaining prefill piece lengths
         self.filled = 0           # prompt tokens already prefilled
         self.cache = None         # partial B=1 cache during prefill
@@ -162,9 +210,11 @@ class RequestGroup:
     """One /generate request: B streams plus completion/timing state."""
 
     def __init__(self, rows: np.ndarray, new: int,
-                 eos_id: Optional[int], pieces_per_row: List[int]):
+                 eos_id: Optional[int], pieces_per_row: List[int],
+                 sampling: Optional[SamplingSpec] = None):
         self.rows = rows
         self.new = new
+        self.sampling = sampling or GREEDY
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
         # Called (with the stream) on the engine thread the moment a
@@ -180,7 +230,7 @@ class RequestGroup:
         self.t_done: Optional[float] = None
         self.streams = [
             Stream(self, i, rows[i:i + 1], new, eos_id,
-                   list(pieces_per_row))
+                   list(pieces_per_row), self.sampling)
             for i in range(rows.shape[0])]
 
     def complete_row(self, stream: Stream) -> None:

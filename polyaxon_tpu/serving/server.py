@@ -36,17 +36,22 @@ batching engine mixes LENGTHS freely across requests — only rows
 within one request body share a shape).
 
 Concurrency — the CONTINUOUS-BATCHING engine (engine.py, default):
-greedy requests become per-row decode streams over a fixed pool of
-decode slots; admission happens at decode-step boundaries into slots
-freed by eos/budget eviction, long prompts prefill in chunks
-interleaved between decode steps, and the front-end sheds load with
-429 + Retry-After once the bounded admission queue fills.  Engine
-responses are exact vs solo execution (greedy rows never interact;
-eos-frozen rows pad to budget).  ``batching="coalesce"`` selects the
-legacy whole-request coalescer (legacy.py — the measured baseline),
-``batching="off"`` serializes every request (the A/B floor).
-Sampled/beam/speculative requests always take the solo path (a shared
-PRNG key or beam schedule would change their outputs if merged).
+greedy AND sampled (non-beam, non-speculative) requests become
+per-row decode streams over a fixed pool of decode slots; admission
+happens at decode-step boundaries into slots freed by eos/budget
+eviction, long prompts prefill in chunks interleaved between decode
+steps, and the front-end sheds load with 429 + Retry-After once the
+bounded admission queue fills.  Engine responses are exact vs solo
+execution: greedy rows never interact (eos-frozen rows pad to
+budget), and sampled rows draw through the POSITION-KEYED RNG
+contract (models/generate.generate_positional — token i's key is
+fold_in(fold_in(PRNGKey(seed), row), i), a function of the request
+alone), so co-tenancy never changes a sampled response.
+``batching="coalesce"`` selects the legacy whole-request coalescer
+(legacy.py — the measured baseline; sampled requests decode solo
+there), ``batching="off"`` serializes every request (the A/B floor).
+Beam/speculative requests always take the solo path (a beam schedule
+or draft rollback would change their outputs if merged).
 """
 
 from __future__ import annotations
@@ -62,7 +67,7 @@ import numpy as np
 from ._lru import lru_get
 from .engine import DecodeEngine
 from .legacy import RequestCoalescer
-from .scheduler import QueueFullError, SchedulerPolicy
+from .scheduler import QueueFullError, SamplingSpec, SchedulerPolicy
 
 BATCHING_MODES = ("continuous", "coalesce", "off")
 
@@ -243,6 +248,20 @@ class ModelServer:
                     self.model, self.variables, toks,
                     max_new_tokens=new, num_beams=beams, eos_id=eos,
                     prefill_chunk=chunk))
+            if kind == "sample_pos":
+                # Position-keyed sampled solo path: the shaping params
+                # are RUN-TIME arguments (traced scalars), so every
+                # sampled (temperature, top_k, top_p, seed) combo of
+                # one shape shares a single compiled program — and the
+                # math is the same _sample_positional_row the engine's
+                # slot step runs.
+                return jax.jit(
+                    lambda toks, keys, temp, tk, tp:
+                    G.generate_positional(
+                        self.model, self.variables, toks,
+                        max_new_tokens=new, keys=keys,
+                        temperature=temp, top_k=tk, top_p=tp,
+                        eos_id=eos, prefill_chunk=chunk))
             if kind == "spec":
                 k = beams  # slot reused for the draft length
                 return jax.jit(lambda toks, rng: G.generate_speculative(
@@ -270,10 +289,10 @@ class ModelServer:
 
         from ..models import generate as G
 
-        # "cont" does not depend on chunk — keying it would compile
-        # duplicate identical decode programs per chunk value.
+        # "cont"/"cont_pos" do not depend on chunk — keying them would
+        # compile duplicate identical decode programs per chunk value.
         key = (kind, b, p_or_s, new, temp, top_k, top_p, eos, None,
-               chunk if kind != "cont" else None)
+               chunk if kind not in ("cont", "cont_pos") else None)
 
         def build():
             if kind == "pfill":
@@ -283,6 +302,17 @@ class ModelServer:
                 return jax.jit(lambda cache, toks, pos: G.prefill(
                     self.model, self.variables, toks, chunk=chunk,
                     cache=cache, position=pos))
+            if kind == "cont_pos":
+                # position-keyed sampled continue (prefix-cache hits
+                # that stay solo): one program per shape, shaping
+                # params at run time — mirrors "sample_pos"
+                return jax.jit(
+                    lambda cache, logits, pos, keys, temp, tk, tp:
+                    G.generate_continue_positional(
+                        self.model, self.variables, cache, logits,
+                        pos, max_new_tokens=new, keys=keys,
+                        temperature=temp, top_k=tk, top_p=tp,
+                        eos_id=eos, _validated=True))
             return jax.jit(lambda cache, logits, pos, rng:
                            G.generate_continue(
                                self.model, self.variables, cache,
@@ -381,9 +411,13 @@ class ModelServer:
         is stored back, so sessions grow).  Exact: the split is the
         same program as fused generate (generate_continue's contract),
         and extension equals one-shot prefill (chunked-prefill
-        contract)."""
+        contract).  Sampled hits run the position-keyed continue —
+        token indices restart at 0 for the new tokens, so a warm hit
+        draws the same stream a cold request would."""
         import jax
         import jax.random as jrandom
+
+        from ..models import generate as G
 
         b = toks.shape[0]
         with self._lock:
@@ -395,10 +429,18 @@ class ModelServer:
                         cache, suffix, pc)
                 jax.block_until_ready(logits)
                 self._prefix_store(toks, logits, cache)
-            out_new = np.asarray(jax.device_get(self._split_fns(
-                b, None, "cont", chunk, new=new, temp=temp,
-                top_k=top_k, top_p=top_p, eos=eos)(
-                    cache, logits, p_len, jrandom.PRNGKey(seed))))
+            if G.positional_eligible(self.model, temp):
+                keys = np.asarray(G.sample_stream_keys(seed, b))
+                fn = self._split_fns(b, None, "cont_pos", chunk,
+                                     new=new, eos=eos)
+                out_new = np.asarray(jax.device_get(fn(
+                    cache, logits, p_len, keys, np.float32(temp),
+                    np.int32(top_k or 0), np.float32(top_p or 0.0))))
+            else:
+                out_new = np.asarray(jax.device_get(self._split_fns(
+                    b, None, "cont", chunk, new=new, temp=temp,
+                    top_k=top_k, top_p=top_p, eos=eos)(
+                        cache, logits, p_len, jrandom.PRNGKey(seed))))
         with self._stats_lock:
             self.requests += 1
             self.prefix_hits += 1
@@ -438,6 +480,18 @@ class ModelServer:
                 "int, not booleans)")
         if new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        # Uniform sampling-param validation: ONE message for every
+        # path (engine, coalesce, solo, speculative, prefix hits),
+        # raised here so doomed requests fail in this cheap layer —
+        # never at jit-trace time inside the device lock, and never
+        # differently depending on which batching mode fields them.
+        from ..models.generate import (_check_temperature,
+                                       _check_top_k, _check_top_p)
+
+        _check_top_k(top_k, getattr(getattr(self.model, "cfg", None),
+                                    "vocab_size", None))
+        _check_top_p(top_p)
+        _check_temperature(temp)
         if beams > 1 and (temp != 0.0 or top_k is not None
                           or top_p is not None):
             # Mirror the CLI: beam search is deterministic — dropping
@@ -517,19 +571,31 @@ class ModelServer:
         toks = np.asarray(rows, np.int32)
 
         t0 = time.perf_counter()
-        # Prefix-cache hit (registered via /prefill): greedy B=1 hits
-        # ride the engine seeded with the stored prefill; sampled and
-        # multi-row hits decode from it on the solo split path — beam
-        # tiles and speculative rolls back the cache, so they stay
-        # cold.
+        # Prefix-cache hit (registered via /prefill): engine-eligible
+        # B=1 hits (greedy OR sampled) ride the engine seeded with the
+        # stored prefill; multi-row and engine-less hits decode from
+        # it on the solo split path — beam tiles and speculative rolls
+        # back the cache, so they stay cold.
         prefix_hit = None
         if self._prefix_enabled and beams == 1 and not speculative:
             prefix_hit = self._prefix_lookup(toks)
-        greedy = (not speculative and beams == 1 and temp == 0.0
-                  and top_k is None and top_p is None)
+        # Engine eligibility: any non-beam, non-speculative request on
+        # a decoder-only model.  temperature==0 streams are greedy
+        # (top_k/top_p are inert then, exactly like solo _sample);
+        # temperature>0 streams sample per-slot under the position-
+        # keyed RNG contract, so co-tenancy never changes tokens.
+        engine_ok = (self.engine is not None and beams == 1
+                     and not speculative)
+        sampling = SamplingSpec(seed, temp, top_k, top_p) \
+            if temp != 0.0 else None
+        # The coalescer merges plain greedy requests ONLY — beam and
+        # speculative greedy requests must keep their solo programs
+        # (a coalesced argmax batch would silently answer a beam
+        # request with greedy tokens).
+        greedy = temp == 0.0 and beams == 1 and not speculative
         breakdown = None
-        if prefix_hit is not None and greedy \
-                and self.engine is not None and toks.shape[0] == 1:
+        if prefix_hit is not None and engine_ok \
+                and toks.shape[0] == 1:
             # Prefix hit on the engine path: seed a stream with the
             # stored prefill so the request pays only its suffix (or
             # no prefill at all on a full-length hit) and DECODES IN A
@@ -537,7 +603,8 @@ class ModelServer:
             # whole-decode device-lock hold stalling resident streams.
             _, pc, lg, cache = prefix_hit
             group = self.engine.submit(
-                toks, new, eos, chunk, prefix=(pc, lg, cache),
+                toks, new, eos, chunk, sampling=sampling,
+                prefix=(pc, lg, cache),
                 on_prefilled=self._store_stream_prefix)
             group.event.wait()
             if group.error is not None:
@@ -551,13 +618,16 @@ class ModelServer:
             out = self._generate_prefix_cached(
                 toks, p_len, new, temp, top_k, top_p, eos, chunk,
                 seed, prefix_hit)
-        elif greedy and self.engine is not None:
+        elif engine_ok:
             # CONTINUOUS BATCHING: per-row decode streams through the
-            # slot pool.  Exactness argument for ignoring ``seed``:
-            # greedy decoding never consults the PRNG, so requests
-            # with different seeds still produce identical outputs in
-            # a slot or solo.  May raise QueueFullError -> 429.
-            group = self.engine.submit(toks, new, eos, chunk)
+            # slot pool.  Greedy streams ignore ``seed`` (greedy
+            # decoding never consults the PRNG — identical output in
+            # a slot or solo); sampled streams carry (seed,
+            # temperature, top_k, top_p) into their slot and draw
+            # token i with fold_in(fold_in(PRNGKey(seed), row), i).
+            # May raise QueueFullError -> 429.
+            group = self.engine.submit(toks, new, eos, chunk,
+                                       sampling=sampling)
             group.event.wait()
             if group.error is not None:
                 raise group.error
@@ -569,24 +639,44 @@ class ModelServer:
             out = self._coalescer.generate(toks, p_len, new, eos,
                                            chunk)
         else:
+            from ..models import generate as G
+
+            positional = (not speculative and beams == 1
+                          and G.positional_eligible(self.model, temp))
             if speculative:
                 # last slot carries the draft length (see _fn)
                 key = ("spec", len(rows), p_len, new, temp, top_k,
                        top_p, eos, spec_k, chunk)
+            elif beams > 1:
+                key = ("beam", len(rows), p_len, new, temp, top_k,
+                       top_p, eos, beams, chunk)
+            elif positional:
+                # decoder-only sampled solo (batching off/coalesce):
+                # the position-keyed reference program — shaping
+                # params fed at RUN TIME, so one compiled program per
+                # shape serves every sampled combo, and the tokens
+                # equal the engine's for the same request + seed
+                key = ("sample_pos", len(rows), p_len, new, None,
+                       None, None, eos, 1, chunk)
             else:
-                key = ("beam", len(rows), p_len,
-                       new, temp, top_k, top_p, eos, beams, chunk) \
-                    if beams > 1 else \
-                    ("sample", len(rows), p_len, new, temp, top_k,
-                     top_p, eos, beams, chunk)
+                key = ("sample", len(rows), p_len, new, temp, top_k,
+                       top_p, eos, beams, chunk)
             t_lock = time.perf_counter()
             with self._lock:  # one chip: serialize device work
                 import jax.random as jrandom
 
                 queue_s = time.perf_counter() - t_lock
                 fn = self._fn(key)
-                out = np.asarray(jax.device_get(
-                    fn(toks, jrandom.PRNGKey(seed))))
+                if positional:
+                    keys = np.asarray(
+                        G.sample_stream_keys(seed, len(rows)))
+                    out = np.asarray(jax.device_get(fn(
+                        toks, keys, np.float32(temp),
+                        np.int32(top_k or 0),
+                        np.float32(top_p or 0.0))))
+                else:
+                    out = np.asarray(jax.device_get(
+                        fn(toks, jrandom.PRNGKey(seed))))
             with self._stats_lock:
                 self.requests += 1
             breakdown = (queue_s, 0.0,
@@ -636,9 +726,13 @@ class ModelServer:
                 "prefix_entries": len(self._prefix),
                 "prefix_hits": self.prefix_hits,
                 **{k: engine[k] for k in
-                   ("slots", "slots_active", "queue_len",
-                    "queue_depth", "admitted_total", "evicted_total",
-                    "decode_steps_total", "prefill_chunks_total",
+                   ("slots", "slots_active", "slot_occupancy",
+                    "queue_len", "queue_depth", "admitted_total",
+                    "admitted_greedy_total", "admitted_sampled_total",
+                    "evicted_total", "decode_steps_total",
+                    "prefill_chunks_total", "completed_total",
+                    "completed_greedy_total",
+                    "completed_sampled_total",
                     "rejected_total") if k in engine},
                 **self.extra_info}
 
@@ -701,10 +795,32 @@ class ModelServer:
                 f"ptpu_serving_slots {es['slots']}",
                 "# TYPE ptpu_serving_slots_active gauge",
                 f"ptpu_serving_slots_active {es['slots_active']}",
+                # resident/total as a ready-made 0..1 ratio, so pool
+                # utilization under mixed load needs no PromQL join
+                "# TYPE ptpu_serving_slot_occupancy gauge",
+                f"ptpu_serving_slot_occupancy {es['slot_occupancy']}",
                 "# TYPE ptpu_serving_queue_len gauge",
                 f"ptpu_serving_queue_len {es['queue_len']}",
                 "# TYPE ptpu_serving_admitted_total counter",
                 f"ptpu_serving_admitted_total {es['admitted_total']}",
+                # admissions/completions split by decode mode: how
+                # much of the pool mixed traffic actually gives to
+                # sampled streams
+                "# TYPE ptpu_serving_admitted_greedy_total counter",
+                f"ptpu_serving_admitted_greedy_total "
+                f"{es['admitted_greedy_total']}",
+                "# TYPE ptpu_serving_admitted_sampled_total counter",
+                f"ptpu_serving_admitted_sampled_total "
+                f"{es['admitted_sampled_total']}",
+                "# TYPE ptpu_serving_completed_total counter",
+                f"ptpu_serving_completed_total "
+                f"{es['completed_total']}",
+                "# TYPE ptpu_serving_completed_greedy_total counter",
+                f"ptpu_serving_completed_greedy_total "
+                f"{es['completed_greedy_total']}",
+                "# TYPE ptpu_serving_completed_sampled_total counter",
+                f"ptpu_serving_completed_sampled_total "
+                f"{es['completed_sampled_total']}",
                 "# TYPE ptpu_serving_evicted_total counter",
                 f"ptpu_serving_evicted_total {es['evicted_total']}",
                 "# TYPE ptpu_serving_decode_steps_total counter",

@@ -15,13 +15,24 @@ layout-keying headache beam search has to solve does not exist here).
 Three device programs, compiled once each per model:
 
 - ``step``:   [S]-stacked cache + toks [S] + positions [S]
-              -> next greedy tokens [W, S] + updated stacked cache,
+              -> next tokens [W, S] + updated stacked cache,
               for a WINDOW of W decode steps fused into one program
               (``lax.scan`` over the vmapped one-token body; one
               compiled program per power-of-two W, so a window costs
               one dispatch + one host sync instead of W — the
               engine picks W so scheduling granularity is never
-              sacrificed, see engine._pick_window)
+              sacrificed, see engine._pick_window).  Two variants per
+              window: the pure-greedy body (argmax only — what an
+              all-greedy pool runs, unchanged from before sampling
+              support), and the SAMPLED body, selected whenever any
+              resident stream samples: every slot additionally
+              carries (base PRNG key, next token index, temperature,
+              top_k, top_p) and draws its token with
+              ``fold_in(base_key, index)`` through the shared
+              position-keyed sampler
+              (models/generate._sample_positional_row) — greedy
+              co-tenants take that sampler's argmax lane, so one
+              compiled program serves a mixed pool
 - ``insert``: write one finished prefill (a B=1 cache) into slot i
               (``dynamic_update_index_in_dim`` per leaf; the slot
               index is traced, so one program serves every slot)
@@ -57,11 +68,20 @@ class SlotKVManager:
         self.n_slots = int(n_slots)
         self._stacked = None          # pytree, leaves [S, ...]
         self._free = list(range(self.n_slots))
-        self._step_fns = {}           # window length -> jitted scan
+        self._step_fns = {}           # (window, sampled) -> jitted scan
         self._insert_fn = None
         # Host-side per-slot decode state (fed to the step program).
         self.tokens = np.zeros((self.n_slots,), np.int32)
         self.positions = np.zeros((self.n_slots,), np.int32)
+        # Per-slot sampling state (the sampled step variant's extra
+        # operands; inert — zeros — for greedy/idle slots): base PRNG
+        # key, index of the NEXT token to draw, and the shaping
+        # params (temperature 0 = greedy lane, top_k/top_p 0 = off).
+        self.keys = np.zeros((self.n_slots, 2), np.uint32)
+        self.next_index = np.zeros((self.n_slots,), np.int32)
+        self.temps = np.zeros((self.n_slots,), np.float32)
+        self.top_ks = np.zeros((self.n_slots,), np.int32)
+        self.top_ps = np.zeros((self.n_slots,), np.float32)
 
     # -- slot accounting ------------------------------------------------
 
@@ -85,9 +105,16 @@ class SlotKVManager:
         self._free.append(slot)
         self._free.sort()
         # Park the idle slot at position 0 so its dead stepping never
-        # drifts into out-of-range position-embedding lookups.
+        # drifts into out-of-range position-embedding lookups, and
+        # zero the sampling state so it steps through the cheap
+        # greedy lane of the sampled program.
         self.tokens[slot] = 0
         self.positions[slot] = 0
+        self.keys[slot] = 0
+        self.next_index[slot] = 0
+        self.temps[slot] = 0.0
+        self.top_ks[slot] = 0
+        self.top_ps[slot] = 0.0
 
     # -- device programs ------------------------------------------------
 
@@ -105,11 +132,20 @@ class SlotKVManager:
                 template_cache)
 
     def insert(self, slot: int, cache, first_token: int,
-               position: int) -> None:
+               position: int, *, base_key=None, next_index: int = 1,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0) -> None:
         """Admit a prefilled request into ``slot`` at a step boundary:
         write its B=1 cache into the pool and arm the slot's decode
         state (``first_token`` at ``position`` is the next step's
-        input, matching solo generate's sample-first contract)."""
+        input, matching solo generate's sample-first contract).
+
+        Sampled streams additionally arm the slot's sampling state:
+        ``base_key`` (the stream's fold_in(PRNGKey(seed), row) key)
+        and ``next_index`` (the token index the NEXT decode step
+        draws — 1, because token 0 was sampled from the prefill
+        logits at admission).  Greedy streams leave the defaults
+        (temperature 0 routes them through the argmax lane)."""
         import jax
 
         self._ensure_stacked(cache)
@@ -122,8 +158,16 @@ class SlotKVManager:
         self._stacked = self._insert_fn(self._stacked, cache, slot)
         self.tokens[slot] = first_token
         self.positions[slot] = position
+        if base_key is not None:
+            self.keys[slot] = np.asarray(base_key, np.uint32)
+        else:
+            self.keys[slot] = 0
+        self.next_index[slot] = next_index
+        self.temps[slot] = temperature
+        self.top_ks[slot] = top_k
+        self.top_ps[slot] = top_p
 
-    def _build_step(self, window: int):
+    def _build_step(self, window: int, sampled: bool):
         import jax
         import jax.numpy as jnp
 
@@ -131,7 +175,7 @@ class SlotKVManager:
 
         model, variables = self.model, self.variables
 
-        def one(cache, tok, pos):
+        def logits_for(cache, tok, pos):
             # One decoder step for one slot: tok [] at absolute
             # position pos [].  _params inside the closure keeps int8
             # weights int8 in HBM (generate._params contract).
@@ -139,46 +183,93 @@ class SlotKVManager:
                 {"params": G._params(variables), "cache": cache},
                 tok[None, None], decode=True, decode_position=pos,
                 mutable=["cache"])
-            logits = G.extract_logits(out)[:, -1][0]        # [V]
-            nxt = jnp.argmax(logits).astype(jnp.int32)      # greedy
-            return nxt, mut["cache"]
+            return G.extract_logits(out)[:, -1][0], mut["cache"]  # [V]
 
-        def step(stacked, toks, positions):
+        if not sampled:
+            # The pure-greedy body — byte-for-byte the pre-sampling
+            # program, so all-greedy pools never pay the sampler's
+            # sort/cumsum and greedy-only servers compile nothing new.
+            def one(cache, tok, pos):
+                logits, cache = logits_for(cache, tok, pos)
+                nxt = jnp.argmax(logits).astype(jnp.int32)  # greedy
+                return nxt, cache
+
+            def step(stacked, toks, positions):
+                def body(carry, _):
+                    cache, tok, pos = carry
+                    nxt, cache = jax.vmap(one)(cache, tok, pos)
+                    return (cache, nxt, pos + 1), nxt
+                (cache, _, _), outs = jax.lax.scan(
+                    body, (stacked, toks, positions), None,
+                    length=window)
+                return outs, cache                          # [W, S]
+
+            return jax.jit(step)
+
+        # Sampled body: every slot draws through the shared position-
+        # keyed sampler with ITS OWN (key, index, temperature, top_k,
+        # top_p); greedy co-tenants (temperature 0) take the argmax
+        # lane, producing the same tokens the greedy body would.
+        def one_sampled(cache, tok, pos, key, idx, temp, tk, tp):
+            logits, cache = logits_for(cache, tok, pos)
+            nxt = G._sample_positional_row(logits, key, idx, temp,
+                                           tk, tp)
+            return nxt, cache
+
+        def step_sampled(stacked, toks, positions, keys, idxs,
+                         temps, tks, tps):
             def body(carry, _):
-                cache, tok, pos = carry
-                nxt, cache = jax.vmap(one)(cache, tok, pos)
-                return (cache, nxt, pos + 1), nxt
-            (cache, _, _), outs = jax.lax.scan(
-                body, (stacked, toks, positions), None, length=window)
+                cache, tok, pos, idx = carry
+                nxt, cache = jax.vmap(one_sampled)(
+                    cache, tok, pos, keys, idx, temps, tks, tps)
+                return (cache, nxt, pos + 1, idx + 1), nxt
+            (cache, _, _, _), outs = jax.lax.scan(
+                body, (stacked, toks, positions, idxs), None,
+                length=window)
             return outs, cache                              # [W, S]
 
-        return jax.jit(step)
+        return jax.jit(step_sampled)
 
-    def step(self, window: int = 1) -> np.ndarray:
+    def step(self, window: int = 1, sampled: bool = False
+             ) -> np.ndarray:
         """``window`` fused decode steps across the whole pool;
-        returns the greedy tokens [window, S] (garbage for idle slots
-        — the caller masks by occupancy).  Greedy argmax and the
-        token feedback run inside one scanned program, so a window
-        costs ONE dispatch + ONE host round-trip regardless of its
-        length; the caller (engine._pick_window) sizes the window so
-        no admission or budget-eviction boundary lands inside it."""
+        returns the next tokens [window, S] (garbage for idle slots
+        — the caller masks by occupancy).  Token selection (greedy
+        argmax, or the position-keyed per-slot sampler when
+        ``sampled``) and the token feedback run inside one scanned
+        program, so a window costs ONE dispatch + ONE host round-trip
+        regardless of its length; the caller (engine._decode_step)
+        passes ``sampled`` iff any resident stream samples, and
+        engine._pick_window sizes the window so no admission or
+        budget-eviction boundary lands inside it."""
         import jax
         import jax.numpy as jnp
 
         if self._stacked is None:
             raise RuntimeError("step() before any insert()")
-        fn = self._step_fns.get(window)
+        fn = self._step_fns.get((window, sampled))
         if fn is None:
-            fn = self._step_fns[window] = self._build_step(window)
-        outs, self._stacked = fn(
-            self._stacked, jnp.asarray(self.tokens),
-            jnp.asarray(self.positions))
+            fn = self._step_fns[(window, sampled)] = \
+                self._build_step(window, sampled)
+        if sampled:
+            outs, self._stacked = fn(
+                self._stacked, jnp.asarray(self.tokens),
+                jnp.asarray(self.positions), jnp.asarray(self.keys),
+                jnp.asarray(self.next_index),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps))
+        else:
+            outs, self._stacked = fn(
+                self._stacked, jnp.asarray(self.tokens),
+                jnp.asarray(self.positions))
         outs = np.asarray(jax.device_get(outs))
         # Arm the next step: every slot feeds back its own last token
-        # at the next position; idle slots' state is overwritten by
-        # the insert that reactivates them.
+        # at the next position (and, for sampled slots, the next
+        # token index); idle slots' state is overwritten by the
+        # insert that reactivates them.
         self.tokens = outs[-1].copy()
         self.positions = self.positions + window
+        self.next_index = self.next_index + window
         # Re-park free slots at position 0 so their dead stepping
         # stays bounded by one window and can never drift past
         # max_position on a long-lived resident batch.
@@ -186,4 +277,5 @@ class SlotKVManager:
             idle = np.asarray(self._free, np.int32)
             self.tokens[idle] = 0
             self.positions[idle] = 0
+            self.next_index[idle] = 0
         return outs

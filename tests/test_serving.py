@@ -11,10 +11,11 @@ import urllib.request
 import numpy as np
 import pytest
 
-from polyaxon_tpu.models.generate import generate
+from polyaxon_tpu.models.generate import generate, generate_positional
 from polyaxon_tpu.models.registry import get_model
 from polyaxon_tpu.serving import (DecodeEngine, ModelServer,
-                                  SchedulerPolicy, make_server)
+                                  SamplingSpec, SchedulerPolicy,
+                                  make_server)
 
 
 @pytest.fixture(scope="module")
@@ -586,6 +587,32 @@ class TestLegacyCoalescing:
         return ModelServer(model, variables, max_batch=max_batch,
                            batching="coalesce")
 
+    def test_beam_and_speculative_stay_solo_under_coalesce(self):
+        """Beam and speculative greedy requests must never be
+        hijacked by the greedy coalescer: a coalesced argmax batch
+        would silently answer a beam request with greedy tokens."""
+        from polyaxon_tpu.models.generate import generate_beam
+
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        ms = ModelServer(model, variables, batching="coalesce",
+                         draft_model=model, draft_variables=variables)
+        try:
+            out = ms.generate({"prompt": [1, 2, 3], "num_beams": 2,
+                               "max_new_tokens": 4})
+            want = generate_beam(model, variables,
+                                 np.asarray([[1, 2, 3]], np.int32),
+                                 max_new_tokens=4, num_beams=2)
+            assert out["tokens"] == np.asarray(want).tolist()
+            ms.generate({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                         "speculative": True, "spec_k": 2})
+            # the speculative request compiled/ran the spec program
+            # (token equality with greedy is BY DESIGN, so assert the
+            # routing itself)
+            assert any(k[0] == "spec" for k in ms._fns)
+        finally:
+            ms.close()
+
     def test_seq2seq_default_falls_back_to_coalesce(self):
         """The slot engine is decoder-only; a seq2seq model under the
         default batching='continuous' must keep request batching via
@@ -709,6 +736,215 @@ class TestLegacyCoalescing:
         assert results["two"]["new_tokens"] == ref2["new_tokens"]
         assert results["one"]["new_tokens"] == ref1["new_tokens"]
         assert results["big"]["new_tokens"] == ref_big["new_tokens"]
+
+
+def _fp32_tiny():
+    """gpt2-tiny in f32: the sampled exactness tests compare tokens
+    ACROSS compiled programs (engine slot step vs the solo positional
+    reference, split vs one-shot prefill), where bf16's one-ulp
+    cross-program rounding can flip a borderline top-k/nucleus
+    threshold (docs/SERVING.md caveat); f32 margins dominate that
+    noise."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+class TestSampledEngine:
+    """Sampled requests as engine citizens (PR 2): per-slot
+    position-keyed PRNG streams + per-slot sampling params in the
+    slot step program.  The load-bearing contract is CO-TENANCY-
+    INVARIANT DETERMINISM: a request's i-th generated token is drawn
+    with ``fold_in(fold_in(PRNGKey(seed), row), i)`` — a function of
+    the request alone — so the engine must reproduce the solo
+    ``generate_positional`` reference under ANY admission schedule."""
+
+    PROMPT = [3, 1, 4, 1]
+    SPEC = dict(seed=7, temperature=0.9, top_k=16, top_p=0.95)
+
+    def _reference(self, model, variables, new=8, **over):
+        kw = {**self.SPEC, **over}
+        return np.asarray(generate_positional(
+            model, variables, np.asarray([self.PROMPT], np.int32),
+            max_new_tokens=new, **kw)).tolist()
+
+    def test_determinism_across_cotenancy_schedules(self):
+        """The property test the contract is named for: the same
+        sampled request + seed, run under three different co-tenancy/
+        admission schedules (alone; into a full mixed pool; admitted
+        mid-flight next to a running stream), returns byte-identical
+        tokens — all equal to the position-keyed solo reference."""
+        model, variables = _fp32_tiny()
+        want = self._reference(model, variables)
+        prompt = np.asarray([self.PROMPT], np.int32)
+
+        def run(schedule):
+            eng = DecodeEngine(
+                model, variables, autostart=False,
+                policy=SchedulerPolicy(n_slots=4, decode_window=4))
+            if schedule == "alone":
+                g = eng.submit(prompt, 8, None, None,
+                               sampling=SamplingSpec(**self.SPEC))
+            elif schedule == "full-pool":
+                # three co-tenants with their own streams (greedy and
+                # sampled) occupy the pool before the target arrives
+                for i in range(3):
+                    eng.submit(
+                        np.asarray([[9, 9, 2, 6]], np.int32), 6,
+                        None, None,
+                        sampling=SamplingSpec(seed=i, temperature=1.1,
+                                              top_k=8) if i else None)
+                g = eng.submit(prompt, 8, None, None,
+                               sampling=SamplingSpec(**self.SPEC))
+            else:  # mid-flight admission into a decoding batch
+                eng.submit(np.asarray([[2, 7, 1, 8]], np.int32), 10,
+                           None, None)
+                for _ in range(3):
+                    eng.tick()
+                g = eng.submit(prompt, 8, None, None,
+                               sampling=SamplingSpec(**self.SPEC))
+            eng.run_until_idle()
+            return g.result().tolist()
+
+        for schedule in ("alone", "full-pool", "mid-flight"):
+            assert run(schedule) == want, schedule
+
+    def test_greedy_cotenant_unaffected_by_sampled_neighbors(self):
+        """A greedy stream sharing the pool with sampled streams still
+        reproduces solo greedy ``generate`` exactly — the sampled step
+        program's argmax lane is the same argmax."""
+        model, variables = _fp32_tiny()
+        prompt = np.asarray([self.PROMPT], np.int32)
+        want = np.asarray(generate(
+            model, variables, prompt, max_new_tokens=8)).tolist()
+        eng = DecodeEngine(
+            model, variables, autostart=False,
+            policy=SchedulerPolicy(n_slots=3, decode_window=4))
+        g = eng.submit(prompt, 8, None, None)
+        eng.submit(np.asarray([[9, 9, 2, 6]], np.int32), 8, None,
+                   None, sampling=SamplingSpec(seed=1, temperature=1.0,
+                                               top_k=8))
+        eng.submit(np.asarray([[2, 7, 1, 8]], np.int32), 8, None,
+                   None, sampling=SamplingSpec(seed=2, temperature=0.8,
+                                               top_p=0.9))
+        eng.run_until_idle()
+        assert g.result().tolist() == want
+        assert eng.admitted_sampled_total == 2
+        assert eng.admitted_greedy_total == 1
+
+    def test_sampled_eos_freeze_matches_reference(self):
+        """A sampled stream hitting EOS mid-budget evicts its slot and
+        pads to budget exactly like the solo reference's eos-freeze."""
+        model, variables = _fp32_tiny()
+        prompt = np.asarray([self.PROMPT], np.int32)
+        free = self._reference(model, variables, new=8)
+        eos = free[0][4 + 2]            # third generated token
+        assert eos not in free[0][4:6]  # freeze fires at step 2
+        want = self._reference(model, variables, new=8, eos_id=eos)
+        eng = DecodeEngine(model, variables, autostart=False,
+                           policy=SchedulerPolicy(n_slots=2))
+        g = eng.submit(prompt, 8, eos, None,
+                       sampling=SamplingSpec(**self.SPEC))
+        eng.run_until_idle()
+        assert g.result().tolist() == want
+        assert eng.evicted_total == 1
+
+    def test_sampled_chunked_prefill_matches_reference(self):
+        """Chunked prefill is position-keyed cache mechanics — it must
+        not shift a sampled stream either."""
+        model, variables = _fp32_tiny()
+        long_prompt = np.asarray([list(range(1, 11))], np.int32)
+        want = np.asarray(generate_positional(
+            model, variables, long_prompt, max_new_tokens=5,
+            **self.SPEC)).tolist()
+        eng = DecodeEngine(model, variables, autostart=False,
+                           policy=SchedulerPolicy(n_slots=2))
+        g = eng.submit(long_prompt, 5, None, 3,
+                       sampling=SamplingSpec(**self.SPEC))
+        eng.run_until_idle()
+        assert g.result().tolist() == want
+
+    def test_multirow_sampled_request_matches_reference(self):
+        """Each row of a B>1 sampled request is its own stream with
+        base key fold_in(PRNGKey(seed), row) — together they equal the
+        batched positional reference."""
+        model, variables = _fp32_tiny()
+        rows = np.asarray([[3, 1, 4, 1], [2, 7, 1, 8]], np.int32)
+        want = np.asarray(generate_positional(
+            model, variables, rows, max_new_tokens=6,
+            **self.SPEC)).tolist()
+        eng = DecodeEngine(model, variables, autostart=False,
+                           policy=SchedulerPolicy(n_slots=4))
+        g = eng.submit(rows, 6, None, None,
+                       sampling=SamplingSpec(**self.SPEC))
+        eng.run_until_idle()
+        assert g.result().tolist() == want
+
+    def test_sampled_prefix_hit_rides_engine_and_matches_cold(self):
+        """A sampled single-row prefix-cache hit seeds an engine
+        stream (no solo device-lock hold) and must return the cold
+        response bit-for-bit: position-keyed token indices restart at
+        0 for new tokens, so the prefill split cannot shift the
+        draw."""
+        model, variables = _fp32_tiny()
+        ms = ModelServer(model, variables, max_batch=4)
+        try:
+            system = [7, 3, 9, 2, 5, 1]
+            req = {"prompt": system + [4, 8], "max_new_tokens": 5,
+                   "temperature": 0.8, "top_k": 32, "seed": 9}
+            cold = ms.generate(dict(req))
+            assert "prefix_hit_len" not in cold
+            ms.prefill_prompt({"prompt": system})
+            before = ms.engine.stats()
+            warm = ms.generate(dict(req))
+            after = ms.engine.stats()
+            assert warm["prefix_hit_len"] == len(system)
+            assert warm["new_tokens"] == cold["new_tokens"]
+            assert after["admitted_sampled_total"] == \
+                before["admitted_sampled_total"] + 1
+        finally:
+            ms.close()
+
+    def test_uniform_validation_messages_across_paths(self):
+        """Satellite contract: top_k out of [1, vocab] and top_p out
+        of (0, 1] are 400-mapped ValueErrors with ONE message on
+        every path — engine, coalesce, serialized, speculative."""
+        spec = get_model("gpt2-tiny")
+        model, variables = spec.init_params(batch_size=1)
+        bad = {
+            "top_k_zero": {"temperature": 0.9, "top_k": 0},
+            "top_k_over": {"temperature": 0.9, "top_k": 4096},
+            "top_p_zero": {"temperature": 0.9, "top_p": 0.0},
+            "top_p_over": {"temperature": 0.9, "top_p": 1.5},
+            "spec_top_k": {"speculative": True, "temperature": 0.9,
+                           "top_k": 0},
+        }
+        msgs = {}
+        for mode in ("continuous", "coalesce", "off"):
+            ms = ModelServer(model, variables, batching=mode,
+                             draft_model=model,
+                             draft_variables=variables)
+            try:
+                for name, extra in bad.items():
+                    with pytest.raises(ValueError) as ei:
+                        ms.generate({"prompt": [1, 2],
+                                     "max_new_tokens": 2, **extra})
+                    msgs.setdefault(name, set()).add(str(ei.value))
+            finally:
+                ms.close()
+        for name, seen in msgs.items():
+            assert len(seen) == 1, (name, seen)
+        assert "top_k must be in [1, 1024]" in msgs["top_k_zero"].pop()
+        assert "top_p must be in (0, 1]" in msgs["top_p_over"].pop()
 
 
 class TestRingBeam:

@@ -23,9 +23,15 @@ from polyaxon_tpu.serving import ModelServer, make_server
 def smoke_server():
     spec = get_model("gpt2-tiny")
     model, variables = spec.init_params(batch_size=1)
+    # decode_window=1: every decode step runs the same compiled
+    # program, so the sampled same-seed determinism assertion below
+    # is exact even on this bf16 model (different fused window
+    # lengths are different XLA programs, which may round one bf16
+    # ulp apart — the f32 unit tests in test_serving.py cover window
+    # fusion; this file is the scheduling canary).
     ms = ModelServer(model, variables, model_name="gpt2-tiny",
                      max_batch=8, n_slots=4, queue_depth=32,
-                     prefill_chunk=8)
+                     prefill_chunk=8, decode_window=1)
     srv = make_server("127.0.0.1", 0, ms)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -80,6 +86,55 @@ def test_concurrent_short_and_long_requests_complete(smoke_server):
     assert stats["queue_len"] == 0
 
 
+def test_sampled_requests_ride_the_engine(smoke_server):
+    """Sampled requests are engine citizens: a mixed greedy/sampled
+    burst completes through the slot pool (admitted_sampled_total
+    advances), sampled responses are deterministic by seed under
+    concurrency (the position-keyed RNG contract), and different
+    seeds actually sample differently."""
+    base, ms, model, variables = smoke_server
+    before = ms.engine.stats()
+    sampled = {"prompt": [5, 6, 7], "max_new_tokens": 6,
+               "temperature": 0.9, "top_k": 32, "top_p": 0.95,
+               "seed": 7}
+    greedy = {"prompt": list(range(1, 9)), "max_new_tokens": 6}
+    reqs = [dict(sampled), greedy, dict(sampled),
+            {**sampled, "seed": 8}, greedy]
+    results = [None] * len(reqs)
+    errors = []
+
+    def go(i):
+        try:
+            results[i] = _post(base, dict(reqs[i]))
+        except Exception as e:  # noqa: BLE001 - the assert reports it
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    # same seed, concurrent co-tenants -> identical tokens; a
+    # different seed -> a different draw
+    assert results[0]["tokens"] == results[2]["tokens"]
+    assert results[0]["tokens"] != results[3]["tokens"]
+    vocab = model.cfg.vocab_size
+    for r in results:
+        for row in r["new_tokens"]:
+            assert len(row) == 6
+            assert all(0 <= t < vocab for t in row)
+    stats = ms.engine.stats()
+    assert stats["admitted_sampled_total"] >= \
+        before["admitted_sampled_total"] + 3
+    assert stats["admitted_greedy_total"] >= \
+        before["admitted_greedy_total"] + 2
+    assert stats["slots_active"] == 0
+    assert stats["queue_len"] == 0
+
+
 def test_metrics_expose_phase_breakdown(smoke_server):
     base, ms, _, _ = smoke_server
     _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 2})
@@ -96,12 +151,28 @@ def test_metrics_expose_phase_breakdown(smoke_server):
                  "ptpu_serving_decode_seconds_sum",
                  "ptpu_serving_slots",
                  "ptpu_serving_slots_active",
+                 "ptpu_serving_slot_occupancy",
                  "ptpu_serving_queue_len",
                  "ptpu_serving_admitted_total",
+                 "ptpu_serving_admitted_greedy_total",
+                 "ptpu_serving_admitted_sampled_total",
+                 "ptpu_serving_completed_total",
+                 "ptpu_serving_completed_greedy_total",
+                 "ptpu_serving_completed_sampled_total",
                  "ptpu_serving_evicted_total",
                  "ptpu_serving_decode_steps_total",
                  "ptpu_serving_prefill_chunks_total",
                  "ptpu_serving_rejected_total"):
         assert name in metrics, name
+    # the mode split adds up and mirrors /info
+    assert metrics["ptpu_serving_admitted_total"] == \
+        metrics["ptpu_serving_admitted_greedy_total"] \
+        + metrics["ptpu_serving_admitted_sampled_total"]
+    info = json.loads(urllib.request.urlopen(
+        base + "/info", timeout=30).read())
+    for k in ("slot_occupancy", "admitted_greedy_total",
+              "admitted_sampled_total", "completed_greedy_total",
+              "completed_sampled_total"):
+        assert k in info, k
     assert metrics["ptpu_serving_queue_seconds_count"] >= 1
     assert metrics["ptpu_serving_decode_seconds_sum"] > 0
