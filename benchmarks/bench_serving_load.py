@@ -30,9 +30,24 @@ device lock for its whole decode, so a realistic mixed stream
 re-serializes; under the engine sampled streams occupy slots like
 greedy ones (position-keyed RNG keeps them schedule-invariant).  The
 sampled rows land beside the greedy ones (``load_sampled`` +
-``sampled_continuous_vs_coalesce``).  Rows land in
-benchmarks/results.jsonl as ``{"bench": "serving-load"}`` with a
-cpu-smoke regime tag off-TPU.
+``sampled_continuous_vs_coalesce``).
+
+A third SPEC-MIX leg makes EVERY client SPECULATIVE (the same
+short/long class mix, greedy-spec and sampled-spec alternating with
+per-client seeds) against a weight-perturbed copy of the target
+tuned to the realistic ~0.8 draft-acceptance band — the workload PR
+3 exists for: under ``coalesce``/``off`` each speculative request
+holds the device lock for its whole draft/verify decode, so >= 4
+concurrent speculative clients fully serialize; under the engine
+their per-round draft/verify work batches across the slot pool with
+per-slot variable advance (``load_spec`` +
+``spec_continuous_vs_coalesce``; the engine row records the measured
+acceptance rate).  Greedy/sampled requests never speculate, so
+mixing them into this leg would measure the pool-program tax on
+co-tenants, not engine-vs-solo speculative throughput — the greedy
+and sampled legs stay the pinned coverage for non-speculative
+traffic.  Rows land in benchmarks/results.jsonl as ``{"bench":
+"serving-load"}`` with a cpu-smoke regime tag off-TPU.
 
 Run: python benchmarks/bench_serving_load.py [--model gpt2-medium]
      [--short-clients 12] [--long-clients 4] [--requests 6]
@@ -78,7 +93,10 @@ SHAPES = {
     # is in), so the A/B compares batching policies, not dispatch
     # counts.  gpt2-tiny stays available for a fast functional smoke.
     "gpt2-mini": {"short": (32, 8), "long": (32, 96)},
-    "gpt2-tiny": {"short": (32, 8), "long": (32, 96)},
+    # tiny's long budget leaves spec_k slack under its max_position
+    # 128 (32 + 88 + 4 - 1 <= 128) so the spec-mix leg's speculative
+    # long clients are servable on the functional smoke too.
+    "gpt2-tiny": {"short": (32, 8), "long": (32, 88)},
 }
 DEFAULT_SHAPE = SHAPES["gpt2-medium"]
 
@@ -108,11 +126,16 @@ def pct_ms(xs, p):
 
 def run_mixed_load(base: str, *, n_short: int, n_long: int,
                    requests: int, shapes, vocab: int,
-                   sampled_mix: bool = False):
+                   sampled_mix: bool = False,
+                   spec_mix: bool = False):
     """N_short + N_long threads x R sequential requests each; returns
     per-class latencies + aggregate wall.  ``sampled_mix`` switches
     every other client to sampling (SAMPLED_PARAMS cycled, per-client
-    seed) — the 50/50 greedy/sampled traffic of the sampled leg."""
+    seed) — the 50/50 greedy/sampled traffic of the sampled leg.
+    ``spec_mix`` switches EVERY client to SPECULATIVE requests
+    (greedy-spec and sampled-spec alternating, per-client seeds) —
+    the all-speculative traffic of the spec leg, where the baselines
+    serialize each request's whole draft/verify decode."""
     import numpy as np
 
     rng = np.random.RandomState(0)
@@ -129,7 +152,12 @@ def run_mixed_load(base: str, *, n_short: int, n_long: int,
         cls = clients[i]
         _, new = shapes[cls]
         payload = {"prompt": prompts[i], "max_new_tokens": new}
-        if sampled_mix and i % 2 == 1:
+        if spec_mix:
+            payload.update({"speculative": True, "spec_k": 4})
+            if i % 2 == 1:
+                payload.update({"temperature": 0.9, "top_k": 64,
+                                "seed": i})
+        elif sampled_mix and i % 2 == 1:
             payload.update(SAMPLED_PARAMS[(i // 2)
                                           % len(SAMPLED_PARAMS)])
             payload["seed"] = i
@@ -166,6 +194,26 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
     spec = get_model(model_name)
     model, variables = spec.init_params(batch_size=1)
     vocab = model.cfg.vocab_size
+    # Draft for the SPEC-MIX leg: a weight-perturbed copy of the
+    # target.  Random-init models have near-uniform logits, so a
+    # *separately initialized* draft proposes garbage (acceptance ~0
+    # — speculation pays its overhead and commits one token a round,
+    # in any serving system); a deterministic 2e-3 per-element
+    # perturbation lands greedy draft/target agreement at the
+    # realistic ~0.8 mid-range (measured, recorded per run as
+    # spec_accept_rate), exercising BOTH the accept and the
+    # reject/rewind lanes.  Every mode gets the same draft, so the
+    # A/B compares batching policy only.
+    import jax.numpy as jnp
+
+    def _jiggle(x):
+        if x.dtype.kind != "f":
+            return x
+        wave = jnp.cos(jnp.arange(x.size, dtype=jnp.float32))
+        return x + 0.002 * wave.reshape(x.shape).astype(x.dtype)
+
+    draft_model = model
+    draft_variables = jax.tree.map(_jiggle, variables)
     # Scarce capacity BY DESIGN: ~4 clients per slot, so batching
     # policy (who occupies the physical batch, and for how long)
     # decides throughput — both policies get the same width.
@@ -173,11 +221,14 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
 
     rows = []
     rows_sampled = []
+    rows_spec = []
     for mode in ("continuous", "coalesce", "off"):
         ms = ModelServer(model, variables, model_name=model_name,
                          max_batch=n_slots,
                          batching=mode, n_slots=n_slots,
-                         queue_depth=4 * (n_short + n_long))
+                         queue_depth=4 * (n_short + n_long),
+                         draft_model=draft_model,
+                         draft_variables=draft_variables)
         srv = make_server("127.0.0.1", 0, ms)
         thread = threading.Thread(target=srv.serve_forever, daemon=True)
         thread.start()
@@ -204,6 +255,16 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                 _post(base, {"prompt": warm, "max_new_tokens": new,
                              "temperature": 0.9, "top_k": 64,
                              "top_p": 0.95, "seed": 1}, timeout=900)
+                # Speculative warm: greedy-spec and sampled-spec per
+                # shape (the engine's spec round programs per window,
+                # or the solo "spec"/"spec_pos" programs).
+                _post(base, {"prompt": warm, "max_new_tokens": new,
+                             "speculative": True, "spec_k": 4},
+                      timeout=900)
+                _post(base, {"prompt": warm, "max_new_tokens": new,
+                             "speculative": True, "spec_k": 4,
+                             "temperature": 0.9, "top_k": 64,
+                             "seed": 1}, timeout=900)
                 if mode == "coalesce":
                     # every bucket _batch_bucket can land on: powers
                     # of two AND the min(b, max_batch) cap — a
@@ -216,15 +277,32 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                                      "max_new_tokens": new},
                               timeout=900)
                         b *= 2
+            if mode == "continuous":
+                # Every power-of-two spec WINDOW program must compile
+                # outside the timed runs: a solo warm request's rem
+                # walk can skip a window size (high acceptance jumps
+                # rem past the [2k, 4k) band), but mixed-residency
+                # boundaries in the timed leg will hit it.  A fresh
+                # single-resident request's FIRST window is exactly
+                # pow2(min(cap, (new - 1) // spec_k)), so budgets
+                # 4k*w .. walk every size.
+                p_len, _ = shapes["short"]
+                warm = warm_rng.randint(0, vocab,
+                                        size=p_len).tolist()
+                for nb in (12, 20, 40):  # first windows 2, 4, 8
+                    _post(base, {"prompt": warm,
+                                 "max_new_tokens": nb,
+                                 "speculative": True, "spec_k": 4},
+                          timeout=900)
 
-            def timed_leg(sampled_mix):
+            def timed_leg(leg):
                 pre = json.loads(urllib.request.urlopen(
                     base + "/info", timeout=30).read())
                 lats, wall, errors = run_mixed_load(
                     base, n_short=n_short, n_long=n_long,
                     requests=requests, shapes=shapes, vocab=vocab,
-                    sampled_mix=sampled_mix)
-                leg = "sampled-mix" if sampled_mix else "greedy"
+                    sampled_mix=leg == "sampled-mix",
+                    spec_mix=leg == "spec-mix")
                 if errors:
                     print(f"# load mode={mode} leg={leg} errors: "
                           f"{errors[:3]}", file=sys.stderr)
@@ -250,10 +328,24 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                     row["decode_steps"] = \
                         info.get("decode_steps_total", 0) \
                         - pre.get("decode_steps_total", 0)
-                    if sampled_mix:
+                    if leg == "sampled-mix":
                         row["admitted_sampled"] = \
                             info.get("admitted_sampled_total", 0) \
                             - pre.get("admitted_sampled_total", 0)
+                    if leg == "spec-mix":
+                        row["admitted_spec"] = \
+                            info.get("admitted_spec_total", 0) \
+                            - pre.get("admitted_spec_total", 0)
+                        drafted = info.get("spec_drafted_total", 0) \
+                            - pre.get("spec_drafted_total", 0)
+                        accepted = \
+                            info.get("spec_accepted_total", 0) \
+                            - pre.get("spec_accepted_total", 0)
+                        row["spec_drafted"] = drafted
+                        row["spec_accepted"] = accepted
+                        if drafted:
+                            row["spec_accept_rate"] = round(
+                                accepted / drafted, 4)
                 if mode == "coalesce":
                     row["coalesced_batches"] = \
                         info["coalesced_batches"] \
@@ -269,12 +361,15 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                       file=sys.stderr)
                 return row
 
-            row = timed_leg(False)
+            row = timed_leg("greedy")
             if row is not None:
                 rows.append(row)
-            row = timed_leg(True)
+            row = timed_leg("sampled-mix")
             if row is not None:
                 rows_sampled.append(row)
+            row = timed_leg("spec-mix")
+            if row is not None:
+                rows_spec.append(row)
         finally:
             srv.shutdown()
             srv.server_close()  # release the listening socket too
@@ -289,17 +384,24 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         "requests_per_client": requests,
         "load": rows,
         "load_sampled": rows_sampled,
+        "load_spec": rows_spec,
         # Headline before/after: the engine vs the seed coalescing
         # path (and vs the serialized floor) on the same traffic —
         # once for the all-greedy stream, once for the 50/50
         # greedy/sampled mix (where the baselines decode every
-        # sampled request solo).
+        # sampled request solo), once for the ALL-speculative mix
+        # (where the baselines serialize every request's whole
+        # draft/verify decode).
         "continuous_vs_coalesce": _ab(rows, "continuous", "coalesce"),
         "continuous_vs_serialized": _ab(rows, "continuous", "off"),
         "sampled_continuous_vs_coalesce":
             _ab(rows_sampled, "continuous", "coalesce"),
         "sampled_continuous_vs_serialized":
             _ab(rows_sampled, "continuous", "off"),
+        "spec_continuous_vs_coalesce":
+            _ab(rows_spec, "continuous", "coalesce"),
+        "spec_continuous_vs_serialized":
+            _ab(rows_spec, "continuous", "off"),
         **prefix,
     }
 
@@ -408,11 +510,12 @@ def main() -> int:
     row = {"bench": "serving-load", "ts": time.time(),
            **({"regime": "cpu-smoke"} if backend != "tpu" else {}),
            **r}
-    # A mode that errored out is missing from load[]/load_sampled[]:
-    # mark the row partial so resume_sweep's leg attribution
-    # (non-partial rows only) retries the leg instead of stamping it
-    # done without the headline A/B measurements.
-    if len(r.get("load", [])) < 3 or len(r.get("load_sampled", [])) < 3:
+    # A mode that errored out is missing from load[]/load_sampled[]/
+    # load_spec[]: mark the row partial so resume_sweep's leg
+    # attribution (non-partial rows only) retries the leg instead of
+    # stamping it done without the headline A/B measurements.
+    if len(r.get("load", [])) < 3 or len(r.get("load_sampled", [])) < 3 \
+            or len(r.get("load_spec", [])) < 3:
         row["partial"] = True
     print(json.dumps(row))
     with open(RESULTS, "a") as f:

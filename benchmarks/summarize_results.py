@@ -89,6 +89,21 @@ def headline_value(r):
     return None, ""
 
 
+def spec_mix_value(r):
+    """serving-load rows: the SPEC-MIX leg's headline A/B — engine
+    aggregate tok/s over the solo speculative path (coalesce mode),
+    with the engine leg's measured draft-acceptance rate.  Empty for
+    every other bench."""
+    ab = r.get("spec_continuous_vs_coalesce") or {}
+    v = ab.get("tok_per_sec_speedup")
+    if not v:
+        return ""
+    eng = next((x for x in r.get("load_spec", [])
+                if x.get("mode") == "continuous"), {})
+    rate = eng.get("spec_accept_rate")
+    return f"{v}x" + (f" (acc {rate})" if rate is not None else "")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -98,8 +113,8 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| mfu | age |")
-    print("|---|---|---|---|---|---|---|---|---|")
+          "| spec-mix | mfu | age |")
+    print("|---|---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -115,6 +130,7 @@ def main() -> int:
               f"| {r.get('variant') or ''} | {r.get('batch')} "
               f"| {r.get('backend')}{'/' + ','.join(flags) if flags else ''} "
               f"| {v if v is not None else ''} | {unit} "
+              f"| {spec_mix_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0
 

@@ -266,12 +266,15 @@ def _build_serving_model(name: str, batch_size: int,
 @click.option("--checkpoint", default=None, type=click.Path(),
               help="Orbax checkpoint dir from `ptpu train` "
                    "(--checkpoint-every); default: random init.")
-@click.option("--draft-model", default=None,
-              help="Zoo model for SPECULATIVE decoding (same vocab). "
-                   "Greedy by default (output identical to the "
-                   "target's greedy decode); with --temperature it "
-                   "runs rejection speculative sampling — exact "
-                   "target-distribution samples for any draft.")
+@click.option("--draft-model", "--spec-draft", "draft_model",
+              default=None,
+              help="Zoo model for SPECULATIVE decoding (same vocab; "
+                   "--spec-draft is an alias). Greedy by default "
+                   "(output identical to the target's greedy "
+                   "decode); with --temperature it runs rejection "
+                   "speculative sampling — exact target-distribution "
+                   "samples for any draft, under the position-keyed "
+                   "--seed schedule the server's engine uses.")
 @click.option("--draft-checkpoint", default=None, type=click.Path())
 @click.option("--spec-k", default=4, type=int,
               help="Draft proposals per speculative round.")
@@ -333,10 +336,11 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                                       "vocab_size", None))
         G._check_top_p(top_p)
         if draft_model is not None:
+            # Shared validation (ONE message with the server and the
+            # library): spec_k >= 1, no speculative+beam.
+            G._check_spec_k(spec_k)
             if beams > 1:
-                raise click.ClickException(
-                    "speculative decoding cannot combine with --beams "
-                    "(greedy or sampled only)")
+                raise click.ClickException(G.SPEC_BEAM_MSG)
             if temperature == 0.0 and (top_k is not None
                                        or top_p is not None):
                 raise click.ClickException(
@@ -347,15 +351,17 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                 draft_model, b, draft_checkpoint, int8_kv,
                 int8_weights, kv_ring=kv_ring,
                 kv_ring_slack=ring_slack)
-            # temperature>0 runs rejection speculative sampling: exact
-            # target-distribution samples for any draft (generate.py).
+            # temperature>0 runs rejection speculative sampling under
+            # the POSITION-KEYED --seed schedule (exact target-
+            # distribution samples for any draft) — the same schedule
+            # the server's engine and solo paths run, so `ptpu
+            # generate --seed N` matches a served request with seed N.
             out = G.generate_speculative(
                 model, variables, draft, draft_vars, toks,
                 max_new_tokens=max_new_tokens, k=spec_k, eos_id=eos_id,
                 prefill_chunk=prefill_chunk, temperature=temperature,
                 top_k=top_k, top_p=top_p,
-                rng=jax.random.PRNGKey(seed)
-                if temperature != 0.0 else None)
+                seed=seed if temperature != 0.0 else None)
         elif beams > 1:
             if temperature != 0.0 or top_k is not None \
                     or top_p is not None:
@@ -447,15 +453,24 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Max decode steps fused per device dispatch when "
                    "no admission could happen sooner (the engine "
                    "drops to single steps under admission pressure).")
-@click.option("--draft-model", default=None,
+@click.option("--draft-model", "--spec-draft", "draft_model",
+              default=None,
               help="Zoo model enabling SPECULATIVE requests "
-                   "({\"speculative\": true}); same vocab as --model.")
+                   "({\"speculative\": true}); same vocab as --model "
+                   "(--spec-draft is an alias). With the default "
+                   "--batching continuous, speculative requests ride "
+                   "the engine's slot pool.")
 @click.option("--draft-checkpoint", default=None, type=click.Path())
+@click.option("--spec-k", default=4, type=int,
+              help="Default draft proposals per speculative round "
+                   "for requests that don't pass spec_k — and the "
+                   "engine's cap: requests asking for more decode "
+                   "solo.")
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
-          draft_model, draft_checkpoint, cpu):
+          draft_model, draft_checkpoint, spec_k, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
     prefill later /generate requests skip).
@@ -485,6 +500,14 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         # target build (checkpoint restore can take minutes)
         raise click.ClickException(
             "--draft-checkpoint requires --draft-model")
+    try:
+        # Shared validation with the server/library (_check_spec_k):
+        # one message for a bad --spec-k on every surface.
+        from polyaxon_tpu.models.generate import _check_spec_k
+
+        _check_spec_k(spec_k)
+    except ValueError as e:
+        raise click.ClickException(str(e))
     model, variables = _build_serving_model(
         model_name, 1, checkpoint, int8_kv, int8_weights,
         kv_ring=kv_ring, kv_ring_slack=kv_ring_slack)
@@ -503,6 +526,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                      decode_window=decode_window,
                      prefix_cache=prefix_cache,
                      draft_model=draft, draft_variables=draft_vars,
+                     spec_k=spec_k,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),
                            **({"int8_kv": True} if int8_kv else {}),

@@ -139,6 +139,19 @@ def _check_top_k(top_k, vocab=None) -> None:
         raise ValueError(f"top_k must be in [1, {hi}]; got {top_k}")
 
 
+def _check_spec_k(spec_k) -> None:
+    """A draft length < 1 can't propose anything — refuse it at every
+    entry point (server, CLI, library) with ONE shared message."""
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1; got {spec_k}")
+
+
+# Shared by the server and the CLI so speculative+beam is refused with
+# one message regardless of which surface fields the request.
+SPEC_BEAM_MSG = ("speculative decoding cannot combine with beam "
+                 "search (greedy or sampled only)")
+
+
 def _check_positional_sampling(top_k, top_p, temperature,
                                vocab=None) -> None:
     """Shared validation for the positional entry points — only for
@@ -288,6 +301,106 @@ def _sample_positional(logits, keys, index, temperature, top_k, top_p):
     broadcast to every row."""
     return jax.vmap(lambda l, k: _sample_positional_row(
         l, k, index, temperature, top_k, top_p))(logits, keys)
+
+
+# -- position-keyed speculative kernels -----------------------------------
+#
+# Speculative decoding draws THREE kinds of randomness per proposed
+# token: the draft's proposal, the accept/reject uniform, and the
+# residual resample.  Keying each by (base key, token index, lane)
+# makes every draw a pure function of the request alone — like the
+# plain sampled schedule above — so an engine slot and the solo
+# reference commit identical tokens under any co-tenancy, and a
+# partially-rejected round's re-derivation next round (same keys, same
+# prefix) reproduces the same tokens instead of forking the stream.
+# Exactness of rejection sampling is preserved: whether round N's
+# first rejection lands at index j is a function of draws at indices
+# <= j only, so the draws at later indices are still fresh uniforms
+# conditioned on the committed prefix even though their keys were
+# "used" for discarded proposals in an earlier round.
+
+_SPEC_LANE_DRAFT = 1
+_SPEC_LANE_ACCEPT = 2
+_SPEC_LANE_RESIDUAL = 3
+
+
+def _spec_round_key(base_key, index, lane):
+    """Key for one speculative draw: fold_in(fold_in(base, token
+    index), lane) — disjoint from the plain sampled schedule's
+    fold_in(base, index) committed-token keys."""
+    return jax.random.fold_in(jax.random.fold_in(base_key, index),
+                              lane)
+
+
+def _spec_draft_row(logits, base_key, index, temperature, top_k,
+                    top_p):
+    """Draft proposal for ONE row at new-token ``index``: returns
+    ``(token, q_row)`` where ``q_row`` is the draft's shaped density
+    (softmax of the temp/top-k/top-p-shaped logits — what the accept
+    test divides by).  ``temperature <= 0`` rows take the argmax lane
+    (greedy speculative needs no density; q_row is a dead value
+    then)."""
+    l, greedy = _shape_logits_positional(logits, temperature, top_k,
+                                         top_p)
+    key = _spec_round_key(base_key, index, _SPEC_LANE_DRAFT)
+    sampled = jax.random.categorical(key, l)
+    tok = jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                    sampled).astype(jnp.int32)
+    return tok, jax.nn.softmax(l.astype(jnp.float32), axis=-1)
+
+
+def _spec_verify_row(t_logits, d_toks, q_rows, base_key, index0,
+                     temperature, top_k, top_p, k_eff):
+    """Verify ONE row's K proposals against the target: ``t_logits``
+    [K, V] are the target's logits at the K draft positions,
+    ``d_toks`` [K] the proposals, ``q_rows`` [K, V] their draft
+    densities, ``index0`` the new-token index of the first proposal.
+    Returns ``(out_toks [K], c, m)``: the committed tokens are
+    ``out_toks[:c]`` with ``c`` in [1, k_eff] and ``m`` the accepted
+    draft count (``c - 1`` correction/bonus excluded, clipped to
+    ``k_eff``).
+
+    Greedy lane (``temperature <= 0``): longest draft/target-argmax
+    matching prefix plus the target's argmax correction — identical
+    commits to ``generate_speculative``'s greedy path.  Sampled lane:
+    rejection speculative sampling (accept ``x ~ q`` with prob
+    ``min(1, p(x)/q(x))``, first rejection resamples from
+    ``norm(max(p - q, 0))``) under the position-keyed key schedule,
+    with BOTH densities shaped by :func:`_shape_logits_positional` —
+    the same function the plain sampled paths run, so engine and solo
+    shape bit-identically.
+
+    ``k_eff`` may be a traced scalar <= K (the engine compiles one
+    program at the pool's max draft length; a slot with a smaller
+    ``spec_k`` caps its accepts/commits at its own k — proposals and
+    accept draws at indices < k_eff are identical to a K = k_eff
+    program's, so the committed stream is unchanged)."""
+    k = d_toks.shape[0]
+    idxs = index0 + jnp.arange(k)
+    shaped = jax.vmap(lambda l: _shape_logits_positional(
+        l, temperature, top_k, top_p)[0])(t_logits)        # [K, V]
+    p_rows = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1)
+    px = jnp.take_along_axis(p_rows, d_toks[:, None],
+                             axis=-1)[:, 0]                # [K]
+    qx = jnp.take_along_axis(q_rows, d_toks[:, None], axis=-1)[:, 0]
+    u = jax.vmap(lambda i: jax.random.uniform(
+        _spec_round_key(base_key, i, _SPEC_LANE_ACCEPT)))(idxs)
+    t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    greedy = jnp.asarray(temperature, jnp.float32) <= 0.0
+    accept = jnp.where(greedy, d_toks == t_arg,
+                       u * qx < px)      # u < p/q without the divide
+    k_eff = jnp.clip(jnp.asarray(k_eff, jnp.int32), 1, k)
+    accept = accept & (jnp.arange(k) < k_eff)
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    c = jnp.minimum(m + 1, k_eff)
+    resid = jnp.clip(p_rows - q_rows, 0.0, None)
+    res = jax.vmap(lambda i, r: jax.random.categorical(
+        _spec_round_key(base_key, i, _SPEC_LANE_RESIDUAL),
+        jnp.log(r + 1e-20)))(idxs, resid).astype(jnp.int32)
+    correction = jnp.where(greedy, t_arg, res)
+    out = jnp.where(jnp.arange(k) < m, d_toks, correction)
+    return out.astype(jnp.int32), c.astype(jnp.int32), \
+        m.astype(jnp.int32)
 
 
 def _decode_loop_positional(apply_step, cache, first_logits, *,
@@ -742,7 +855,9 @@ def generate_speculative(model, variables, draft_model, draft_variables,
                          temperature: float = 0.0,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None,
-                         rng: Optional[jax.Array] = None) -> jax.Array:
+                         rng: Optional[jax.Array] = None,
+                         seed: Optional[int] = None,
+                         keys: Optional[jax.Array] = None) -> jax.Array:
     """Speculative decoding: a small DRAFT model proposes ``k`` tokens
     per round; the target verifies all of them in ONE chunked forward
     (k+1 positions through the causal-append mask).
@@ -756,9 +871,19 @@ def generate_speculative(model, variables, draft_model, draft_variables,
     position resamples from the residual ``norm(max(p - q, 0))``.
     Each committed token is therefore distributed EXACTLY as a sample
     from the target's (temp/top-k/top-p-shaped) distribution, for any
-    draft — the draft only changes the schedule.  The shaping is
-    applied to BOTH densities via the same ``_modified_logits`` the
-    plain sampler uses.
+    draft — the draft only changes the schedule.
+
+    Sampled randomness comes from ONE of two schedules: ``rng``
+    (split-chain per round, shaping via the same ``_modified_logits``
+    the plain sampler uses), or ``seed``/``keys`` — the POSITION-KEYED
+    schedule the continuous-batching engine's speculative slots run
+    (every draft/accept/residual draw keyed by (seed, row, token
+    index, lane) through the shared :func:`_spec_draft_row` /
+    :func:`_spec_verify_row` kernels, shaping via
+    :func:`_shape_logits_positional`): tokens are a pure function of
+    the request, so this form is the solo REFERENCE engine
+    speculative slots are pinned against, and a served sampled
+    speculative request returns the same tokens solo or in a slot.
 
     Each round costs one draft scan (k small steps) plus one target
     forward of k+1 positions; at acceptance rate a the target runs
@@ -781,18 +906,25 @@ def generate_speculative(model, variables, draft_model, draft_variables,
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1; got "
                          f"{max_new_tokens}")
-    if k < 1:
-        raise ValueError(f"k must be >= 1; got {k}")
+    _check_spec_k(k)
     sampled = temperature != 0.0
-    if sampled and rng is None:
-        raise ValueError("temperature > 0 requires an rng key "
-                         "(use temperature=0 for greedy decoding)")
+    positional = sampled and (keys is not None or seed is not None)
+    if sampled and rng is None and not positional:
+        raise ValueError("temperature > 0 requires an rng key or a "
+                         "seed (use temperature=0 for greedy "
+                         "decoding)")
+    if positional and rng is not None:
+        raise ValueError(
+            "pass either rng (split-chain schedule) or seed/keys "
+            "(position-keyed schedule), not both")
     _check_temperature(temperature)
     _check_top_p(top_p)
     _check_top_k(top_k, getattr(getattr(model, "cfg", None),
                                 "vocab_size", None))
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
+    if positional and keys is None:
+        keys = sample_stream_keys(seed, b)
     for m, nm in ((model, "target"), (draft_model, "draft")):
         max_pos = getattr(getattr(m, "cfg", None), "max_position", None)
         # The final round (entered at count <= max_new_tokens - 1,
@@ -825,7 +957,14 @@ def generate_speculative(model, variables, draft_model, draft_variables,
                                  chunk=prefill_chunk)
     _, d_cache = _prefill(draft_model, draft_variables, prompt,
                           chunk=prefill_chunk)
-    if sampled:
+    if positional:
+        # Token index 0 draws exactly like the plain positional paths
+        # (and the engine's admission sampler): fold_in(base, 0).
+        rng = jax.random.PRNGKey(0)  # unused; keeps one loop carry
+        first = _sample_positional(
+            t_logits, keys, 0, temperature, top_k or 0,
+            top_p or 0.0).astype(jnp.int32)               # [B]
+    elif sampled:
         rng, key = jax.random.split(rng)
         first = _sample(t_logits, key, temperature, top_k,
                         top_p).astype(jnp.int32)          # [B]
@@ -920,12 +1059,65 @@ def generate_speculative(model, variables, draft_model, draft_variables,
         d_cache = _rollback_cache(d_cache, new_consumed)
         return t_cache, d_cache, x, buf, count + c, rng
 
+    # -- position-keyed rounds (the engine-shared schedule) -------------
+
+    tk_, tp_ = (top_k or 0), (top_p or 0.0)
+
+    def draft_step_positional(carry, _):
+        cache, tok, pos, idx = carry
+        out, mut = draft_model.apply(
+            {"params": _params(draft_variables), "cache": cache},
+            tok[:, None], decode=True, decode_position=pos,
+            mutable=["cache"])
+        logits = extract_logits(out)[:, -1]
+        nxt, q_row = jax.vmap(lambda l, bk: _spec_draft_row(
+            l, bk, idx, temperature, tk_, tp_))(logits, keys)
+        return (mut["cache"], nxt, pos + 1, idx + 1), (nxt, q_row)
+
+    def round_body_positional(state):
+        t_cache, d_cache, x, buf, count, rng = state
+        consumed = p_len + count - 1
+
+        (d_cache, _, _, _), (d_toks, q_rows) = jax.lax.scan(
+            draft_step_positional, (d_cache, x, consumed, count),
+            None, length=k)
+        d_toks = d_toks.T                                 # [B, k]
+        q_rows = jnp.moveaxis(q_rows, 0, 1)               # [B, k, V]
+
+        chunk = jnp.concatenate([x[:, None], d_toks], axis=1)
+        out, mut = model.apply(
+            {"params": _params(variables), "cache": t_cache},
+            chunk, decode=True, decode_position=consumed,
+            mutable=["cache"])
+        t_logits_all = extract_logits(out)                # [B, k+1, V]
+
+        out_toks, c_rows, _ = jax.vmap(
+            lambda tl, dt, qr, bk: _spec_verify_row(
+                tl[:k], dt, qr, bk, count, temperature, tk_, tp_,
+                k))(t_logits_all[:, :k + 1], d_toks, q_rows, keys)
+        # Lockstep cache advance by the batch-min acceptance (shared
+        # schedule mechanics, exactly like the chain path) — but the
+        # TOKENS stay per-row exact: a row that verified further
+        # re-derives the same tokens next round, because every draw
+        # is keyed by (row, token index) and the committed prefix is
+        # unchanged.  Per-slot engine execution therefore matches
+        # this lockstep reference bit-for-bit.
+        c = jnp.min(c_rows)                               # scalar >= 1
+        buf = jax.lax.dynamic_update_slice(buf, out_toks, (0, count))
+        x = jnp.take(out_toks, c - 1, axis=1)             # [B]
+        new_consumed = consumed + c
+        t_cache = _rollback_cache(mut["cache"], new_consumed)
+        d_cache = _rollback_cache(d_cache, new_consumed)
+        return t_cache, d_cache, x, buf, count + c, rng
+
     def cond(state):
         return state[4] < max_new_tokens
 
     state = (t_cache, d_cache, first, buf, jnp.array(1, jnp.int32),
              rng)
-    *_, buf, _, _ = jax.lax.while_loop(cond, round_body, state)
+    *_, buf, _, _ = jax.lax.while_loop(
+        cond, round_body_positional if positional else round_body,
+        state)
     new = buf[:, :max_new_tokens]
 
     if eos_id is not None:

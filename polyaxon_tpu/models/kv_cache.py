@@ -139,6 +139,21 @@ def append_kv_cache(mod, k, v, max_position: int, window=None,
     the variables because flax forbids re-declaring them in the same
     apply.)
 
+    Speculative ROLLBACK contract (shared with the ring cache, and
+    relied on by the serving engine's per-slot rewinds): resetting
+    ``cache_index`` to a smaller value leaves stale K/V entries past
+    it, but they are masked BY ABSOLUTE POSITION, never trusted —
+    entry slot ``j`` is admissible only to queries at positions
+    ``>= j``, appends always write ``[idx, idx + S)`` BEFORE the
+    chunk's queries read, and post-rollback appends are contiguous
+    from the rewound index, so every stale slot a query could admit
+    has already been overwritten by the fresh chunk that contains
+    that query.  Holds for any mix of chunk widths after the rewind
+    (a k+1-wide verify, a 1-wide decode step, a chunked prefill
+    extension) — pinned in
+    tests/test_spec_engine.py::TestRollbackMasking for the plain and
+    int8 disciplines.
+
     ``quantize``: store the cache as int8 with per-(token, head)
     bf16 scales over the feature axis.  At long context the KV read is
     the decode bandwidth bottleneck (kv_bytes/token in the decode

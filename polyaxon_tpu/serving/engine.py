@@ -27,9 +27,15 @@ and sampled slots draw through the POSITION-KEYED RNG contract
 row, token index) only, never of slot id, engine step count, or
 co-tenancy — so sampled output is bit-identical to the solo
 ``generate_positional`` reference under any admission schedule
-(pinned in tests/test_sampled_engine.py).  Beam/speculative requests
-keep the solo path (they tile or roll back the cache, which the slot
-pool doesn't speak).
+(pinned in tests/test_sampled_engine.py).  SPECULATIVE requests are
+engine citizens too when the engine owns a draft model: spec slots
+draft/verify/commit a variable accepted prefix per round through the
+spec step program (slots.py), every draft/accept/residual draw
+position-keyed per (token index, lane), so speculative output is
+bit-identical to ``generate_speculative``'s seed mode under any
+co-tenancy (pinned in tests/test_spec_engine.py).  Beam requests
+keep the solo path (the per-beam cache tiling/reorder is a layout
+the slot pool doesn't speak).
 
 Threading: ``submit`` may be called from any handler thread; all slot
 and queue mutation happens on the engine loop thread (or, in tests,
@@ -55,7 +61,11 @@ from .scheduler import (AdmissionQueue, QueueFullError, RequestGroup,
                         SamplingSpec, SchedulerPolicy, Stream)
 from .slots import SlotKVManager
 
-__all__ = ["DecodeEngine", "QueueFullError"]
+__all__ = ["DecodeEngine", "QueueFullError", "SPEC_ACCEPT_BUCKETS"]
+
+# Acceptance-rate histogram bucket upper bounds (le) for completed
+# speculative requests; the last implicit bucket is +Inf.
+SPEC_ACCEPT_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 
 
 class DecodeEngine:
@@ -63,16 +73,24 @@ class DecodeEngine:
                  policy: Optional[SchedulerPolicy] = None,
                  device_lock: Optional[threading.Lock] = None,
                  autostart: bool = True,
-                 prefill_fns=None):
+                 prefill_fns=None,
+                 draft_model=None, draft_variables=None):
         self.model = model
         self.variables = variables
+        # Draft model: enables SPECULATIVE streams (spec_k > 0) — the
+        # slot pool stacks a second cache for it and the spec step
+        # variant drafts/verifies/commits per round.
+        self.draft_model = draft_model
+        self.draft_variables = draft_variables
         self.policy = policy or SchedulerPolicy()
         self.device_lock = device_lock or threading.Lock()
         # autostart=False: no loop thread — the owner drives tick()
         # manually (deterministic tests, offline batch use).
         self.autostart = bool(autostart)
         self.slots = SlotKVManager(model, variables,
-                                   self.policy.n_slots)
+                                   self.policy.n_slots,
+                                   draft_model=draft_model,
+                                   draft_variables=draft_variables)
         self.queue = AdmissionQueue(self.policy)
         # streams resident in a slot: slot index -> Stream
         self._resident: Dict[int, Stream] = {}
@@ -83,6 +101,10 @@ class DecodeEngine:
         # traffic and /prefill never compile the same program twice.
         self._prefill_fns = prefill_fns
         self._pf_fns: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # Draft prefill programs (speculative streams prefill through
+        # BOTH models): engine-owned — the server's shared cache only
+        # speaks the target model.
+        self._pf_fns_draft: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._pf_cap = 16
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
@@ -98,12 +120,25 @@ class DecodeEngine:
         self.admitted_total = 0
         self.admitted_greedy_total = 0
         self.admitted_sampled_total = 0
+        self.admitted_spec_total = 0
         self.evicted_total = 0
         self.decode_steps_total = 0
         self.prefill_chunks_total = 0
         self.completed_total = 0
         self.completed_greedy_total = 0
         self.completed_sampled_total = 0
+        self.completed_spec_total = 0
+        # Speculative scheduling counters + the per-request
+        # acceptance-rate histogram (accepted drafts / drafted, bucket
+        # upper bounds in SPEC_ACCEPT_BUCKETS; one completed request =
+        # one observation).  ONE shared structure — /metrics and
+        # /info both render engine.stats(), so they can never drift.
+        self.spec_rounds_total = 0
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_accept_hist = [0] * (len(SPEC_ACCEPT_BUCKETS) + 1)
+        self.spec_accept_sum = 0.0
+        self.spec_accept_count = 0
 
     # -- submission (any thread) ----------------------------------------
 
@@ -125,7 +160,25 @@ class DecodeEngine:
         decodes in a slot like any other request, instead of holding
         the device lock for a whole solo decode.  ``on_prefilled``
         fires on the engine thread once the prompt is fully consumed
-        (the cache store-back hook)."""
+        (the cache store-back hook).
+
+        ``sampling.spec_k > 0`` submits a SPECULATIVE request: needs
+        the engine's draft model (its prompt prefills through BOTH
+        models), and composes with greedy or sampled accept lanes."""
+        if sampling is not None and sampling.spec_k > 0:
+            if self.draft_model is None:
+                raise ValueError(
+                    "speculative request on an engine without a "
+                    "draft model")
+            if prefix is not None:
+                # The stored prefix holds only the TARGET's prefill;
+                # a draft cache seeded from nothing would verify
+                # against garbage.  The server keeps speculative
+                # requests off the prefix path — enforce it here too.
+                raise ValueError(
+                    "speculative requests cannot seed from a prefix "
+                    "cache entry (the draft cache has no stored "
+                    "prefill)")
         if prefix is None:
             pieces = self.policy.chunk_plan(rows.shape[1],
                                             prefill_chunk)
@@ -310,6 +363,26 @@ class DecodeEngine:
                        ("pfill" if first else "extend", s_len),
                        self._pf_cap, build)
 
+    def _pf_fn_draft(self, s_len: int, first: bool):
+        """Draft-model twin of :meth:`_pf_fn` for speculative
+        streams' draft prefill."""
+        import jax
+
+        from ..models import generate as G
+
+        draft, dvars = self.draft_model, self.draft_variables
+
+        def build():
+            if first:
+                return jax.jit(
+                    lambda toks: G.prefill(draft, dvars, toks))
+            return jax.jit(lambda cache, toks, pos: G.prefill(
+                draft, dvars, toks, cache=cache, position=pos))
+
+        return lru_get(self._pf_fns_draft,
+                       ("pfill" if first else "extend", s_len),
+                       self._pf_cap, build)
+
     def _advance_prefill(self, stream: Stream) -> None:
         """Run ONE prefill piece for the head-of-queue stream; admit it
         into a slot when the prompt is fully consumed AND a slot is
@@ -328,6 +401,7 @@ class DecodeEngine:
         if stream.pieces:               # full-length prefix hits skip
             piece = stream.pieces[0]
             toks = stream.toks[:, stream.filled:stream.filled + piece]
+            spec = stream.sampling.spec_k > 0
             try:
                 with self.device_lock:
                     if stream.cache is None:
@@ -335,6 +409,18 @@ class DecodeEngine:
                     else:
                         logits, cache = self._pf_fn(piece, False)(
                             stream.cache, toks, stream.filled)
+                    if spec:
+                        # Speculative streams prefill the DRAFT model
+                        # too (same pieces — the chunked-prefill
+                        # exactness contract holds per model).
+                        if stream.d_cache is None:
+                            _, d_cache = self._pf_fn_draft(
+                                piece, True)(toks)
+                        else:
+                            _, d_cache = self._pf_fn_draft(
+                                piece, False)(stream.d_cache, toks,
+                                              stream.filled)
+                        stream.d_cache = d_cache
                     jax.block_until_ready(logits)
             except BaseException as e:
                 self._fail_group(group, e)
@@ -411,30 +497,44 @@ class DecodeEngine:
         stream.logits = None
         if stream.done():               # new == 1, or instant eos
             stream.cache = None
+            stream.d_cache = None
             self.slots.release(slot)
             self._complete(stream)
             self._count_admitted(spec)
             self.evicted_total += 1
             return
+        if spec.speculative and stream.base_key is None:
+            # Greedy speculative streams never drew token 0 from the
+            # PRNG, but the spec step program still wants the slot's
+            # base key operand (the sampled lanes are dead at
+            # temperature 0 — zeros would work — yet arming the real
+            # key keeps one invariant: every speculative slot's key
+            # is fold_in(PRNGKey(seed), row)).
+            stream.base_key = np.asarray(jax.random.fold_in(
+                jax.random.PRNGKey(spec.seed), stream.row))
         try:
             with self.device_lock:
                 self.slots.insert(
                     slot, stream.cache, first, stream.p_len,
                     base_key=stream.base_key, next_index=1,
                     temperature=spec.temperature, top_k=spec.top_k,
-                    top_p=spec.top_p)
+                    top_p=spec.top_p, draft_cache=stream.d_cache,
+                    spec_k=spec.spec_k)
         except BaseException as e:
             self.slots.release(slot)
             self._fail_group(stream.group, e)
             return
         stream.cache = None             # pool owns the KV now
+        stream.d_cache = None
         stream.slot = slot
         self._resident[slot] = stream
         self._count_admitted(spec)
 
     def _count_admitted(self, spec: SamplingSpec) -> None:
         self.admitted_total += 1
-        if spec.sampled:
+        if spec.speculative:
+            self.admitted_spec_total += 1
+        elif spec.sampled:
             self.admitted_sampled_total += 1
         else:
             self.admitted_greedy_total += 1
@@ -466,7 +566,14 @@ class DecodeEngine:
                 or any(s.eos_id is not None
                        for s in self._resident.values())):
             return 1
-        rem = min(s.new - len(s.out)
+        # Budget horizon in ROUNDS, advance-aware: a speculative slot
+        # may commit up to spec_k tokens per round, so fusing
+        # ``rem // spec_k`` rounds can never push any slot past its
+        # budget (no wasted rounds, and — because a spec round's
+        # verify chunk touches up to position + spec_k — no slot ever
+        # writes past the capacity the server validated).
+        rem = min((s.new - len(s.out)) //
+                  (s.sampling.spec_k if s.sampling.speculative else 1)
                   for s in self._resident.values())
         w, cap = 1, min(cap, max(1, rem))
         while w * 2 <= cap:
@@ -481,9 +588,18 @@ class DecodeEngine:
         and rows never interact, so the window's later tokens for that
         stream are discardable garbage — exactness is untouched)."""
         window = self._pick_window()
-        # One sampled resident switches the whole pool to the sampled
-        # step program (greedy co-tenants ride its argmax lane); an
-        # all-greedy pool keeps the cheaper greedy program.
+        # Program selection is a pool property: any speculative
+        # resident switches the pool to the SPEC program (greedy/
+        # sampled co-tenants ride its one-token plain lane, advancing
+        # by 1 per round while spec slots advance by accept-count);
+        # otherwise one sampled resident selects the sampled program
+        # (greedy co-tenants ride its argmax lane); an all-greedy
+        # pool keeps the cheapest argmax-only program.
+        spec_ks = [s.sampling.spec_k for s in self._resident.values()
+                   if s.sampling.speculative]
+        if spec_ks:
+            self._decode_step_spec(window, max(spec_ks))
+            return
         sampled = any(s.sampling.sampled
                       for s in self._resident.values())
         try:
@@ -506,14 +622,71 @@ class DecodeEngine:
                 self.evicted_total += 1
                 self._complete(stream)
 
+    def _decode_step_spec(self, window: int, K: int) -> None:
+        """Advance the pool by ``window`` fused SPECULATIVE rounds
+        (program width ``K`` = the largest resident spec_k).  Each
+        spec slot commits its own accepted prefix per round —
+        variable advance — while non-spec co-tenants commit exactly
+        one token per round; budgets are accounted in COMMITTED
+        tokens, and a stream stops consuming at its own eos/budget
+        (later tokens are discardable garbage, exactly like the
+        windowed plain step)."""
+        try:
+            with self.device_lock:
+                toks, commits, accepts = self.slots.step_spec(window,
+                                                              K)
+        except BaseException as e:
+            for slot, stream in list(self._resident.items()):
+                self._fail_group(stream.group, e)
+            return
+        self.decode_steps_total += window
+        self.spec_rounds_total += window
+        for slot, stream in list(self._resident.items()):
+            spec = stream.sampling.speculative
+            for w in range(window):
+                c = int(commits[w, slot])
+                if spec:
+                    stream.spec_rounds += 1
+                    stream.spec_drafted += stream.sampling.spec_k
+                    stream.spec_accepted += int(accepts[w, slot])
+                    self.spec_drafted_total += stream.sampling.spec_k
+                    self.spec_accepted_total += int(accepts[w, slot])
+                for j in range(c):
+                    stream.out.append(int(toks[w, slot, j]))
+                    if stream.done():
+                        break
+                if stream.done():
+                    break
+            if stream.done():
+                del self._resident[slot]
+                self.slots.release(slot)
+                stream.slot = None
+                self.evicted_total += 1
+                self._complete(stream)
+
     # -- completion -----------------------------------------------------
 
     def _complete(self, stream: Stream) -> None:
         group = stream.group
+        if stream.sampling.speculative and stream.spec_drafted:
+            # One acceptance-rate observation per completed stream:
+            # accepted draft tokens / drafted (the correction token a
+            # rejection commits is not "accepted" work).
+            rate = stream.spec_accepted / stream.spec_drafted
+            self.spec_accept_sum += rate
+            self.spec_accept_count += 1
+            for i, le in enumerate(SPEC_ACCEPT_BUCKETS):
+                if rate <= le:
+                    self.spec_accept_hist[i] += 1
+                    break
+            else:
+                self.spec_accept_hist[-1] += 1
         group.complete_row(stream)
         if group.event.is_set() and group.error is None:
             self.completed_total += 1
-            if group.sampling.sampled:
+            if group.sampling.speculative:
+                self.completed_spec_total += 1
+            elif group.sampling.sampled:
                 self.completed_sampled_total += 1
             else:
                 self.completed_greedy_total += 1
@@ -548,11 +721,24 @@ class DecodeEngine:
             "admitted_total": self.admitted_total,
             "admitted_greedy_total": self.admitted_greedy_total,
             "admitted_sampled_total": self.admitted_sampled_total,
+            "admitted_spec_total": self.admitted_spec_total,
             "evicted_total": self.evicted_total,
             "decode_steps_total": self.decode_steps_total,
             "prefill_chunks_total": self.prefill_chunks_total,
             "completed_total": self.completed_total,
             "completed_greedy_total": self.completed_greedy_total,
             "completed_sampled_total": self.completed_sampled_total,
+            "completed_spec_total": self.completed_spec_total,
             "rejected_total": self.queue.rejected,
+            # Speculative scheduling + the per-request acceptance-rate
+            # histogram (per-bucket counts, upper bounds in
+            # spec_accept_buckets; /metrics cumulates them) — ONE
+            # structure behind both observability endpoints.
+            "spec_rounds_total": self.spec_rounds_total,
+            "spec_drafted_total": self.spec_drafted_total,
+            "spec_accepted_total": self.spec_accepted_total,
+            "spec_accept_buckets": list(SPEC_ACCEPT_BUCKETS),
+            "spec_accept_hist": list(self.spec_accept_hist),
+            "spec_accept_sum": round(self.spec_accept_sum, 6),
+            "spec_accept_count": self.spec_accept_count,
         }

@@ -49,26 +49,41 @@ class SamplingSpec:
     row, token index) only, never of slot id, engine step count, or
     co-tenancy — which is what makes engine output independent of the
     admission schedule.
+
+    ``spec_k > 0`` marks the request SPECULATIVE: its slots draft
+    ``spec_k`` tokens per round from the engine's draft model and
+    commit a variable accepted prefix (budget accounting stays in
+    COMMITTED tokens — a stream is done when ``len(out)`` reaches its
+    budget, however many rounds that took).  Speculative randomness
+    is position-keyed too (per-(token index, lane) keys, see
+    models/generate._spec_verify_row), so co-tenancy never changes a
+    speculative response either.
     """
 
-    __slots__ = ("seed", "temperature", "top_k", "top_p")
+    __slots__ = ("seed", "temperature", "top_k", "top_p", "spec_k")
 
     def __init__(self, seed: int = 0, temperature: float = 0.0,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None):
+                 top_p: Optional[float] = None,
+                 spec_k: int = 0):
         self.seed = int(seed)
         self.temperature = float(temperature)
         self.top_k = int(top_k) if top_k else 0
         self.top_p = float(top_p) if top_p else 0.0
+        self.spec_k = int(spec_k) if spec_k else 0
 
     @property
     def sampled(self) -> bool:
         return self.temperature > 0.0
 
+    @property
+    def speculative(self) -> bool:
+        return self.spec_k > 0
+
     def __repr__(self) -> str:  # debuggability in engine dumps
         return (f"SamplingSpec(seed={self.seed}, "
                 f"temperature={self.temperature}, top_k={self.top_k}, "
-                f"top_p={self.top_p})")
+                f"top_p={self.top_p}, spec_k={self.spec_k})")
 
 
 GREEDY = SamplingSpec()
@@ -158,7 +173,8 @@ class Stream:
     __slots__ = ("group", "row", "toks", "new", "eos_id", "sampling",
                  "base_key", "pieces", "filled", "cache", "logits",
                  "out", "slot", "pf_done", "t_prefill_start",
-                 "t_admit")
+                 "t_admit", "d_cache", "spec_rounds", "spec_drafted",
+                 "spec_accepted")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -176,6 +192,7 @@ class Stream:
         self.pieces = pieces      # remaining prefill piece lengths
         self.filled = 0           # prompt tokens already prefilled
         self.cache = None         # partial B=1 cache during prefill
+        self.d_cache = None       # draft-model cache (spec streams)
         self.logits = None        # last-position logits once filled
         self.out: List[int] = []  # committed new tokens
         self.slot: Optional[int] = None
@@ -183,6 +200,12 @@ class Stream:
         #                           be queued, waiting for a slot)
         self.t_prefill_start: Optional[float] = None
         self.t_admit: Optional[float] = None
+        # Speculative accounting (rounds consumed before the stream
+        # finished; drafted/accepted feed the acceptance-rate
+        # histogram at completion).
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     @property
     def p_len(self) -> int:

@@ -50,13 +50,20 @@ alone), so co-tenancy never changes a sampled response.
 ``batching="coalesce"`` selects the legacy whole-request coalescer
 (legacy.py — the measured baseline; sampled requests decode solo
 there), ``batching="off"`` serializes every request (the A/B floor).
-Beam/speculative requests always take the solo path (a beam schedule
-or draft rollback would change their outputs if merged).
+SPECULATIVE decoder-only requests default to the engine too when the
+server owns a draft model: spec slots draft/verify/commit a variable
+accepted prefix per round under the same position-keyed RNG contract
+(engine output == ``generate_speculative``'s seed mode), so a single
+speculative client no longer holds the device lock for a whole
+decode.  Beam requests always take the solo path (the per-beam cache
+schedule would change their outputs if merged); requests that fall
+back to solo are counted per kind in /info's routing report.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -126,6 +133,7 @@ class ModelServer:
                  decode_window: int = 8,
                  prefix_cache: int = 4,
                  draft_model=None, draft_variables=None,
+                 spec_k: int = 4,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
         self.variables = variables
@@ -143,9 +151,18 @@ class ModelServer:
         # Optional speculative-decoding draft: requests opt in with
         # {"speculative": true}; greedy by default (output identical
         # to plain greedy decode), rejection-sampled with temperature
-        # (models/generate.generate_speculative).
+        # (models/generate.generate_speculative).  ``spec_k`` is both
+        # the default per-request draft length AND the engine's cap:
+        # the spec step program's verify chunk is cap+1 wide for
+        # EVERY resident, so the cap bounds the end-of-cache slack
+        # engine co-tenants must leave (requests that don't fit, or
+        # ask for a bigger k, decode solo — see _note_fallback).
         self.draft_model = draft_model
         self.draft_variables = draft_variables
+        from ..models.generate import _check_spec_k
+
+        _check_spec_k(spec_k)
+        self.spec_k_default = int(spec_k)
         self.model_name = model_name
         self.max_batch = int(max_batch)
         self.extra_info = info or {}
@@ -181,7 +198,11 @@ class ModelServer:
                 # compile cache so a prompt length prefilled via
                 # /prefill and via engine admission compiles once.
                 prefill_fns=lambda s, first: self._split_fns(
-                    1, s, "pfill" if first else "extend", None))
+                    1, s, "pfill" if first else "extend", None),
+                # Draft model makes speculative requests engine
+                # citizens (spec step program, slots.py).
+                draft_model=draft_model,
+                draft_variables=draft_variables)
         self._coalescer = RequestCoalescer(self) \
             if self.batching == "coalesce" else None
         self.coalesced_batches = 0
@@ -193,6 +214,10 @@ class ModelServer:
         # unlocked, consistent enough for monotonic counters.
         self._stats_lock = threading.Lock()
         self.errors = 0
+        # Requests that fell back to the solo path, keyed by request
+        # kind: {"reason": ..., "count": n}.  Surfaced in /info's
+        # routing report; the reason is logged ONCE per kind.
+        self.solo_fallbacks: Dict[str, Dict[str, Any]] = {}
         self._lat_sum = 0.0
         self._lat_count = 0
         self._tokens_out = 0
@@ -224,6 +249,23 @@ class ModelServer:
         """Stop the engine loop thread (idempotent)."""
         if self.engine is not None:
             self.engine.close()
+
+    def _note_fallback(self, kind: str, reason: str) -> None:
+        """A request class fell back to the solo decode path: count
+        it under its kind and log the reason ONCE per kind (a busy
+        server must not spam stderr per request).  /info surfaces the
+        table, so a silently-solo workload is diagnosable."""
+        with self._stats_lock:
+            fb = self.solo_fallbacks.get(kind)
+            first = fb is None
+            if first:
+                self.solo_fallbacks[kind] = {"reason": reason,
+                                             "count": 1}
+            else:
+                fb["count"] += 1
+        if first:
+            print(f"# serving: {kind} requests take the solo path — "
+                  f"{reason}", file=sys.stderr)
 
     def _note_breakdown(self, queue_s: float, prefill_s: float,
                         decode_s: float) -> None:
@@ -270,6 +312,19 @@ class ModelServer:
                     k=k, eos_id=eos, prefill_chunk=chunk,
                     temperature=temp, top_k=top_k, top_p=top_p,
                     rng=rng if temp != 0.0 else None))
+            if kind == "spec_pos":
+                # sampled speculative solo under the position-keyed
+                # schedule — the reference the engine's spec slots
+                # are pinned against, so solo and engine agree
+                # token-for-token per seed
+                k = beams  # slot reused for the draft length
+                return jax.jit(
+                    lambda toks, keys: G.generate_speculative(
+                        self.model, self.variables, self.draft_model,
+                        self.draft_variables, toks,
+                        max_new_tokens=new, k=k, eos_id=eos,
+                        prefill_chunk=chunk, temperature=temp,
+                        top_k=top_k, top_p=top_p, keys=keys))
             return jax.jit(lambda toks, rng: G.generate(
                 self.model, self.variables, toks, max_new_tokens=new,
                 temperature=temp, top_k=top_k, top_p=top_p,
@@ -485,7 +540,9 @@ class ModelServer:
         # raised here so doomed requests fail in this cheap layer —
         # never at jit-trace time inside the device lock, and never
         # differently depending on which batching mode fields them.
-        from ..models.generate import (_check_temperature,
+        from ..models.generate import (SPEC_BEAM_MSG,
+                                       _check_spec_k,
+                                       _check_temperature,
                                        _check_top_k, _check_top_p)
 
         _check_top_k(top_k, getattr(getattr(self.model, "cfg", None),
@@ -511,9 +568,7 @@ class ModelServer:
                     "server has no draft model (start with "
                     "--draft-model to enable speculative decoding)")
             if beams > 1:
-                raise ValueError(
-                    "speculative decoding cannot combine with beam "
-                    "search (greedy or sampled only)")
+                raise ValueError(SPEC_BEAM_MSG)
             if temp == 0.0 and (top_k is not None
                                 or top_p is not None):
                 # dropping the flags silently would let a client
@@ -522,11 +577,10 @@ class ModelServer:
                     "speculative top_k/top_p need temperature > 0 "
                     "(temperature=0 is greedy and would ignore them)")
             try:
-                spec_k = _int(req.get("spec_k", 4))
+                spec_k = _int(req.get("spec_k", self.spec_k_default))
             except (TypeError, ValueError):
                 raise ValueError("spec_k must be an int")
-            if spec_k < 1:
-                raise ValueError("spec_k must be >= 1")
+            _check_spec_k(spec_k)
         chunk = req.get("prefill_chunk")
         try:
             chunk = None if chunk is None else _int(chunk)
@@ -579,15 +633,54 @@ class ModelServer:
         prefix_hit = None
         if self._prefix_enabled and beams == 1 and not speculative:
             prefix_hit = self._prefix_lookup(toks)
-        # Engine eligibility: any non-beam, non-speculative request on
-        # a decoder-only model.  temperature==0 streams are greedy
-        # (top_k/top_p are inert then, exactly like solo _sample);
-        # temperature>0 streams sample per-slot under the position-
-        # keyed RNG contract, so co-tenancy never changes tokens.
-        engine_ok = (self.engine is not None and beams == 1
-                     and not speculative)
-        sampling = SamplingSpec(seed, temp, top_k, top_p) \
-            if temp != 0.0 else None
+        # Engine eligibility: any non-beam request on a decoder-only
+        # model — greedy, sampled, AND speculative (the engine owns
+        # the draft model whenever the server does).  temperature==0
+        # streams are greedy (top_k/top_p inert, exactly like solo
+        # _sample); temperature>0 streams sample per-slot under the
+        # position-keyed RNG contract; speculative streams draft/
+        # verify per round under the same contract — co-tenancy never
+        # changes tokens on any lane.
+        engine_ok = self.engine is not None and beams == 1
+        if speculative and self.engine is None:
+            # The satellite fix: engine-less modes used to drop
+            # speculative requests to solo SILENTLY.
+            self._note_fallback(
+                "speculative",
+                f"batching={self.batching!r} has no decode engine; "
+                f"speculative requests hold the device lock for a "
+                f"whole solo decode")
+        if engine_ok and self.draft_model is not None:
+            # A spec-capable pool verifies a cap+1-wide chunk per
+            # round for EVERY resident, so every engine request —
+            # co-tenants included — must leave cap-1 slack at the
+            # cache end; and a spec_k above the cap would widen the
+            # pool program past what co-tenants were admitted for.
+            cap = self.spec_k_default
+            cfg = getattr(self.model, "cfg", None)
+            max_pos = getattr(cfg, "max_position", None)
+            ring = getattr(cfg, "kv_cache_ring", False)
+            if speculative and spec_k > cap:
+                engine_ok = False
+                self._note_fallback(
+                    "speculative (spec_k over cap)",
+                    f"request spec_k {spec_k} exceeds the engine cap "
+                    f"{cap} (--spec-k); decoding solo")
+            elif not ring and max_pos is not None \
+                    and p_len + new + cap - 1 > max_pos:
+                engine_ok = False
+                self._note_fallback(
+                    "near-capacity",
+                    f"prompt + max_new_tokens within {cap - 1} "
+                    f"tokens of max_position ({max_pos}) cannot "
+                    f"co-tenant a speculative pool (verify chunks "
+                    f"are {cap + 1} wide); decoding solo")
+        sampling = None
+        if speculative:
+            sampling = SamplingSpec(seed, temp, top_k, top_p,
+                                    spec_k=spec_k)
+        elif temp != 0.0:
+            sampling = SamplingSpec(seed, temp, top_k, top_p)
         # The coalescer merges plain greedy requests ONLY — beam and
         # speculative greedy requests must keep their solo programs
         # (a coalesced argmax batch would silently answer a beam
@@ -643,9 +736,18 @@ class ModelServer:
 
             positional = (not speculative and beams == 1
                           and G.positional_eligible(self.model, temp))
+            # Sampled speculative solo runs the POSITION-KEYED seed
+            # mode (generate_speculative keys=...), the same schedule
+            # the engine's spec slots run — so a request returns the
+            # same tokens whichever batching mode fields it.  Greedy
+            # speculative has no randomness (its solo program already
+            # equals the engine's greedy-spec commits).
+            spec_pos = (speculative and temp != 0.0
+                        and not hasattr(self.model, "encode"))
             if speculative:
                 # last slot carries the draft length (see _fn)
-                key = ("spec", len(rows), p_len, new, temp, top_k,
+                key = ("spec_pos" if spec_pos else "spec",
+                       len(rows), p_len, new, temp, top_k,
                        top_p, eos, spec_k, chunk)
             elif beams > 1:
                 key = ("beam", len(rows), p_len, new, temp, top_k,
@@ -674,6 +776,10 @@ class ModelServer:
                         toks, keys, np.float32(temp),
                         np.int32(top_k or 0),
                         np.float32(top_p or 0.0))))
+                elif spec_pos:
+                    keys = np.asarray(
+                        G.sample_stream_keys(seed, len(rows)))
+                    out = np.asarray(jax.device_get(fn(toks, keys)))
                 else:
                     out = np.asarray(jax.device_get(
                         fn(toks, jrandom.PRNGKey(seed))))
@@ -715,10 +821,33 @@ class ModelServer:
                 if v is not None:
                     summary[f] = v
         engine = self.engine.stats() if self.engine is not None else {}
+        # Routing report: where each request class decodes on THIS
+        # server config, plus the dynamic solo-fallback table (kinds
+        # that dropped to solo at request time, with the logged
+        # reason and a count).
+        if self.engine is not None:
+            spec_route = ("engine" if self.draft_model is not None
+                          else "unavailable (no draft model)")
+            routing = {"greedy": "engine", "sampled": "engine",
+                       "speculative": spec_route, "beam": "solo"}
+        else:
+            routing = {
+                "greedy": "coalesce" if self.batching == "coalesce"
+                else "solo",
+                "sampled": "solo",
+                "speculative": "solo" if self.draft_model is not None
+                else "unavailable (no draft model)",
+                "beam": "solo"}
+        with self._stats_lock:
+            fallbacks = {k: dict(v)
+                         for k, v in self.solo_fallbacks.items()}
         return {"model": self.model_name, "config": summary,
                 "backend": jax.default_backend(),
                 "max_batch": self.max_batch,
                 "batching": self.batching,
+                "spec_k_default": self.spec_k_default,
+                "routing": routing,
+                "solo_fallbacks": fallbacks,
                 "compiled_shapes": len(self._fns),
                 "requests": self.requests,
                 "coalesced_batches": self.coalesced_batches,
@@ -729,11 +858,17 @@ class ModelServer:
                    ("slots", "slots_active", "slot_occupancy",
                     "queue_len", "queue_depth", "admitted_total",
                     "admitted_greedy_total", "admitted_sampled_total",
+                    "admitted_spec_total",
                     "evicted_total", "decode_steps_total",
                     "prefill_chunks_total", "completed_total",
                     "completed_greedy_total",
                     "completed_sampled_total",
-                    "rejected_total") if k in engine},
+                    "completed_spec_total",
+                    "rejected_total",
+                    "spec_rounds_total", "spec_drafted_total",
+                    "spec_accepted_total", "spec_accept_buckets",
+                    "spec_accept_hist", "spec_accept_sum",
+                    "spec_accept_count") if k in engine},
                 **self.extra_info}
 
     def metrics_text(self) -> str:
@@ -812,6 +947,9 @@ class ModelServer:
                 "# TYPE ptpu_serving_admitted_sampled_total counter",
                 f"ptpu_serving_admitted_sampled_total "
                 f"{es['admitted_sampled_total']}",
+                "# TYPE ptpu_serving_admitted_spec_total counter",
+                f"ptpu_serving_admitted_spec_total "
+                f"{es['admitted_spec_total']}",
                 "# TYPE ptpu_serving_completed_total counter",
                 f"ptpu_serving_completed_total "
                 f"{es['completed_total']}",
@@ -821,6 +959,9 @@ class ModelServer:
                 "# TYPE ptpu_serving_completed_sampled_total counter",
                 f"ptpu_serving_completed_sampled_total "
                 f"{es['completed_sampled_total']}",
+                "# TYPE ptpu_serving_completed_spec_total counter",
+                f"ptpu_serving_completed_spec_total "
+                f"{es['completed_spec_total']}",
                 "# TYPE ptpu_serving_evicted_total counter",
                 f"ptpu_serving_evicted_total {es['evicted_total']}",
                 "# TYPE ptpu_serving_decode_steps_total counter",
@@ -829,6 +970,36 @@ class ModelServer:
                 "# TYPE ptpu_serving_prefill_chunks_total counter",
                 f"ptpu_serving_prefill_chunks_total "
                 f"{es['prefill_chunks_total']}",
+                # Speculative scheduling counters + the per-request
+                # acceptance-rate histogram — rendered from the SAME
+                # engine.stats() dict /info reports, so the two
+                # endpoints can never drift.
+                "# TYPE ptpu_serving_spec_rounds_total counter",
+                f"ptpu_serving_spec_rounds_total "
+                f"{es['spec_rounds_total']}",
+                "# TYPE ptpu_serving_spec_drafted_total counter",
+                f"ptpu_serving_spec_drafted_total "
+                f"{es['spec_drafted_total']}",
+                "# TYPE ptpu_serving_spec_accepted_total counter",
+                f"ptpu_serving_spec_accepted_total "
+                f"{es['spec_accepted_total']}",
+                "# TYPE ptpu_serving_spec_accept_rate histogram",
+            ]
+            cum = 0
+            for le, n in zip(es["spec_accept_buckets"],
+                             es["spec_accept_hist"]):
+                cum += n
+                lines.append(
+                    f'ptpu_serving_spec_accept_rate_bucket'
+                    f'{{le="{le}"}} {cum}')
+            cum += es["spec_accept_hist"][-1]
+            lines += [
+                f'ptpu_serving_spec_accept_rate_bucket{{le="+Inf"}} '
+                f'{cum}',
+                f"ptpu_serving_spec_accept_rate_sum "
+                f"{es['spec_accept_sum']}",
+                f"ptpu_serving_spec_accept_rate_count "
+                f"{es['spec_accept_count']}",
             ]
         return "\n".join(lines) + "\n"
 

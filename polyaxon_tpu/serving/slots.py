@@ -12,7 +12,23 @@ scan-stacked layers stack uniformly, because the slot axis is ADDED
 rather than reusing the model's internal batch axis — the exact
 layout-keying headache beam search has to solve does not exist here).
 
-Three device programs, compiled once each per model:
+Speculative decoding rides the same pool: a second stacked cache (the
+DRAFT model's) sits alongside the target cache, and a SPECULATIVE
+step variant drafts K tokens per slot, verifies them with one
+K+1-wide target forward per slot, and commits a per-slot variable
+prefix (greedy exact-match lane, or the position-keyed
+rejection-sampling lane shared with
+``models/generate.generate_speculative``'s seed mode).  Rejection is
+a per-slot position REWIND — every slot owns its cache_index, and
+the plain/int8/ring caches mask validity by absolute position, so
+rewound entries are overwritten before any query can admit them (the
+accept/rewind contract, docs/SERVING.md).  Non-speculative co-tenants
+ride the same program advancing exactly one token per round: their
+token comes from the verify chunk's FIRST logits row through the
+shared positional sampler — the same value the plain step programs
+produce.
+
+Device programs, compiled once each per model:
 
 - ``step``:   [S]-stacked cache + toks [S] + positions [S]
               -> next tokens [W, S] + updated stacked cache,
@@ -62,13 +78,20 @@ class SlotKVManager:
     engine.py/scheduler.py.
     """
 
-    def __init__(self, model, variables, n_slots: int):
+    def __init__(self, model, variables, n_slots: int,
+                 draft_model=None, draft_variables=None):
         self.model = model
         self.variables = variables
+        # Draft model for SPECULATIVE slots (optional): its per-slot
+        # caches stack into a second pool stepped by the spec
+        # program's draft scan.
+        self.draft_model = draft_model
+        self.draft_variables = draft_variables
         self.n_slots = int(n_slots)
         self._stacked = None          # pytree, leaves [S, ...]
+        self._draft_stacked = None    # draft pytree, leaves [S, ...]
         self._free = list(range(self.n_slots))
-        self._step_fns = {}           # (window, sampled) -> jitted scan
+        self._step_fns = {}           # (window, variant) -> jitted scan
         self._insert_fn = None
         # Host-side per-slot decode state (fed to the step program).
         self.tokens = np.zeros((self.n_slots,), np.int32)
@@ -82,6 +105,10 @@ class SlotKVManager:
         self.temps = np.zeros((self.n_slots,), np.float32)
         self.top_ks = np.zeros((self.n_slots,), np.int32)
         self.top_ps = np.zeros((self.n_slots,), np.float32)
+        # Per-slot draft length: > 0 marks a SPECULATIVE slot (commits
+        # up to spec_k tokens per round); 0 routes the slot through
+        # the spec program's plain one-token lane.
+        self.spec_ks = np.zeros((self.n_slots,), np.int32)
 
     # -- slot accounting ------------------------------------------------
 
@@ -115,6 +142,7 @@ class SlotKVManager:
         self.temps[slot] = 0.0
         self.top_ks[slot] = 0
         self.top_ps[slot] = 0.0
+        self.spec_ks[slot] = 0
 
     # -- device programs ------------------------------------------------
 
@@ -131,10 +159,20 @@ class SlotKVManager:
                 lambda l: jnp.zeros((self.n_slots,) + l.shape, l.dtype),
                 template_cache)
 
+    def _ensure_draft_stacked(self, template_cache) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._draft_stacked is None:
+            self._draft_stacked = jax.tree.map(
+                lambda l: jnp.zeros((self.n_slots,) + l.shape, l.dtype),
+                template_cache)
+
     def insert(self, slot: int, cache, first_token: int,
                position: int, *, base_key=None, next_index: int = 1,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0) -> None:
+               top_p: float = 0.0, draft_cache=None,
+               spec_k: int = 0) -> None:
         """Admit a prefilled request into ``slot`` at a step boundary:
         write its B=1 cache into the pool and arm the slot's decode
         state (``first_token`` at ``position`` is the next step's
@@ -145,7 +183,12 @@ class SlotKVManager:
         and ``next_index`` (the token index the NEXT decode step
         draws — 1, because token 0 was sampled from the prefill
         logits at admission).  Greedy streams leave the defaults
-        (temperature 0 routes them through the argmax lane)."""
+        (temperature 0 routes them through the argmax lane).
+
+        Speculative streams pass ``draft_cache`` (the DRAFT model's
+        prefill of the same prompt) and ``spec_k`` > 0; the spec step
+        program drafts/verifies/commits up to ``spec_k`` tokens per
+        round for this slot."""
         import jax
 
         self._ensure_stacked(cache)
@@ -156,6 +199,13 @@ class SlotKVManager:
                         s, n.astype(s.dtype), idx, 0), stacked, one)
             self._insert_fn = jax.jit(_insert)
         self._stacked = self._insert_fn(self._stacked, cache, slot)
+        if draft_cache is not None:
+            # Same jitted insert program — jax.jit caches per pytree
+            # structure, so the draft tree gets its own compiled
+            # specialization without a second closure to maintain.
+            self._ensure_draft_stacked(draft_cache)
+            self._draft_stacked = self._insert_fn(
+                self._draft_stacked, draft_cache, slot)
         self.tokens[slot] = first_token
         self.positions[slot] = position
         if base_key is not None:
@@ -166,6 +216,7 @@ class SlotKVManager:
         self.temps[slot] = temperature
         self.top_ks[slot] = top_k
         self.top_ps[slot] = top_p
+        self.spec_ks[slot] = spec_k
 
     def _build_step(self, window: int, sampled: bool):
         import jax
@@ -279,3 +330,138 @@ class SlotKVManager:
             self.positions[idle] = 0
             self.next_index[idle] = 0
         return outs
+
+    # -- speculative step ------------------------------------------------
+
+    def _build_spec_step(self, window: int, K: int):
+        """One spec program per (window, K): ``window`` speculative
+        rounds fused into a scan, each round drafting ``K`` proposals
+        per slot from the stacked draft cache, verifying them with
+        one K+1-wide target forward per slot, and committing a
+        per-slot variable prefix via the shared per-row kernels
+        (models/generate._spec_draft_row / _spec_verify_row — the
+        exact math of ``generate_speculative``'s seed mode).  After
+        the commit both caches REWIND to the accepted position
+        (``_rollback_cache`` per slot); the rewound entries are
+        overwritten by the next round's chunk before any query can
+        admit them (absolute-position masking, models/kv_cache.py).
+
+        Slots with ``spec_k == 0`` (greedy/sampled co-tenants, idle
+        slots) commit exactly ONE token per round, drawn from the
+        verify chunk's first logits row through the shared positional
+        sampler — the same token the plain step programs produce —
+        and rewind to position + 1."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import generate as G
+
+        model, variables = self.model, self.variables
+        draft, draft_vars = self.draft_model, self.draft_variables
+        if draft is None:
+            raise RuntimeError(
+                "speculative step without a draft model (construct "
+                "SlotKVManager with draft_model/draft_variables)")
+
+        def one_round(t_cache, d_cache, tok, pos, idx, key, temp,
+                      tk, tp, sk):
+            # Draft K proposals (k small steps, its own cache).
+            def dstep(carry, _):
+                cache, t, p, i = carry
+                out, mut = draft.apply(
+                    {"params": G._params(draft_vars), "cache": cache},
+                    t[None, None], decode=True, decode_position=p,
+                    mutable=["cache"])
+                logits = G.extract_logits(out)[:, -1][0]
+                nxt, q = G._spec_draft_row(logits, key, i, temp, tk,
+                                           tp)
+                return (mut["cache"], nxt, p + 1, i + 1), (nxt, q)
+
+            (d_cache, _, _, _), (d_toks, q_rows) = jax.lax.scan(
+                dstep, (d_cache, tok, pos, idx), None, length=K)
+
+            # Target verifies [tok, d_1..d_K] in ONE forward.
+            chunk = jnp.concatenate([tok[None], d_toks])[None, :]
+            out, mut = model.apply(
+                {"params": G._params(variables), "cache": t_cache},
+                chunk, decode=True, decode_position=pos,
+                mutable=["cache"])
+            t_all = G.extract_logits(out)[0]              # [K+1, V]
+
+            out_toks, c, _m = G._spec_verify_row(
+                t_all[:K], d_toks, q_rows, key, idx, temp, tk, tp, sk)
+            # Plain lane (sk == 0): one token from the chunk's first
+            # logits — identical to the greedy/sampled step programs.
+            plain = G._sample_positional_row(t_all[0], key, idx, temp,
+                                             tk, tp)
+            is_spec = sk > 0
+            c = jnp.where(is_spec, c, 1)
+            m = jnp.where(is_spec, _m, 0)
+            out_toks = jnp.where(is_spec, out_toks,
+                                 jnp.zeros_like(out_toks).at[0]
+                                 .set(plain))
+            new_pos = pos + c
+            t_cache = G._rollback_cache(mut["cache"], new_pos)
+            d_cache = G._rollback_cache(d_cache, new_pos)
+            nxt = out_toks[c - 1]
+            return (t_cache, d_cache, nxt, new_pos, idx + c,
+                    out_toks, c, m)
+
+        def step(t_stacked, d_stacked, toks, positions, idxs, keys,
+                 temps, tks, tps, sks):
+            def body(carry, _):
+                t_c, d_c, tok, pos, idx = carry
+                (t_c, d_c, nxt, npos, nidx, outs, cs, ms) = jax.vmap(
+                    one_round)(t_c, d_c, tok, pos, idx, keys, temps,
+                               tks, tps, sks)
+                return (t_c, d_c, nxt, npos, nidx), (outs, cs, ms)
+
+            (t_c, d_c, _, _, _), (outs, cs, ms) = jax.lax.scan(
+                body, (t_stacked, d_stacked, toks, positions, idxs),
+                None, length=window)
+            return outs, cs, ms, t_c, d_c   # [W, S, K], [W, S] x2
+
+        return jax.jit(step)
+
+    def step_spec(self, window: int, K: int):
+        """``window`` fused SPECULATIVE rounds across the whole pool.
+        Returns ``(tokens [window, S, K], commits [window, S],
+        accepts [window, S])``: round w commits ``tokens[w, s,
+        :commits[w, s]]`` for slot s (1 for non-speculative slots,
+        garbage for idle ones — the caller masks by occupancy), and
+        ``accepts`` counts the accepted draft tokens (the engine's
+        acceptance-rate metric).  ``K`` is the program's draft width
+        — the pool max; slots with smaller ``spec_k`` commit at most
+        their own k (exactness per slot is unchanged, see
+        _spec_verify_row)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._stacked is None or self._draft_stacked is None:
+            raise RuntimeError("step_spec() before a speculative "
+                               "insert()")
+        fn = self._step_fns.get((window, "spec", K))
+        if fn is None:
+            fn = self._step_fns[(window, "spec", K)] = \
+                self._build_spec_step(window, K)
+        outs, cs, ms, self._stacked, self._draft_stacked = fn(
+            self._stacked, self._draft_stacked,
+            jnp.asarray(self.tokens), jnp.asarray(self.positions),
+            jnp.asarray(self.next_index), jnp.asarray(self.keys),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
+        outs = np.asarray(jax.device_get(outs))
+        cs = np.asarray(jax.device_get(cs))
+        ms = np.asarray(jax.device_get(ms))
+        # Arm the next round from the LAST round's per-slot commit.
+        rows = np.arange(self.n_slots)
+        adv = cs.sum(axis=0).astype(np.int32)
+        self.tokens = outs[-1, rows, cs[-1] - 1].astype(np.int32)
+        self.positions = self.positions + adv
+        self.next_index = self.next_index + adv
+        if self._free:
+            idle = np.asarray(self._free, np.int32)
+            self.tokens[idle] = 0
+            self.positions[idle] = 0
+            self.next_index[idle] = 0
+        return outs, cs, ms

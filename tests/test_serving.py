@@ -1285,3 +1285,160 @@ class TestRequestSpace:
                 greedy_outputs[key] = out["new_tokens"]
         # the run exercised prefix hits
         assert ms.prefix_hits > 0
+
+
+class TestSpeculativeEngineServing:
+    """Speculative requests as engine citizens (PR 3): routing,
+    cross-mode token agreement per seed, and the shared spec
+    observability surface.  Engine-vs-solo exactness under schedules
+    lives in tests/test_spec_engine.py; this class pins the SERVER
+    layer."""
+
+    def _servers(self, **kw):
+        model, variables = _fp32_tiny()
+        return model, variables, {
+            mode: ModelServer(model, variables, max_batch=4,
+                              batching=mode, draft_model=model,
+                              draft_variables=variables, **kw)
+            for mode in ("continuous", "coalesce", "off")}
+
+    def test_every_batching_mode_agrees_per_seed(self):
+        """Greedy AND sampled speculative requests return identical
+        tokens through the engine (continuous), the coalesce-mode
+        solo fallback, and the serialized floor — the solo sampled
+        path runs generate_speculative's seed mode, the same
+        schedule the engine's spec slots run."""
+        model, variables, servers = self._servers()
+        reqs = {
+            "greedy": {"prompt": [5, 6, 7, 8], "max_new_tokens": 6,
+                       "speculative": True, "spec_k": 3},
+            "sampled": {"prompt": [5, 6, 7, 8], "max_new_tokens": 6,
+                        "speculative": True, "spec_k": 3,
+                        "temperature": 0.9, "top_k": 16, "seed": 7},
+        }
+        try:
+            for name, req in reqs.items():
+                outs = {mode: ms.generate(dict(req))["new_tokens"]
+                        for mode, ms in servers.items()}
+                assert outs["continuous"] == outs["coalesce"], name
+                assert outs["continuous"] == outs["off"], name
+            # the engine actually served them (not a silent solo)
+            es = servers["continuous"].engine.stats()
+            assert es["admitted_spec_total"] == len(reqs)
+            assert es["completed_spec_total"] == len(reqs)
+        finally:
+            for ms in servers.values():
+                ms.close()
+
+    def test_coalesce_fallback_logged_and_reported(self):
+        """The satellite fix: engine-less modes route speculative
+        requests solo — no longer silently.  The fallback lands in
+        /info's routing report with a reason and a count."""
+        model, variables, servers = self._servers()
+        try:
+            ms = servers["coalesce"]
+            assert ms.info()["routing"]["speculative"] == "solo"
+            ms.generate({"prompt": [1, 2, 3], "max_new_tokens": 2,
+                         "speculative": True, "spec_k": 2})
+            ms.generate({"prompt": [1, 2, 3], "max_new_tokens": 2,
+                         "speculative": True, "spec_k": 2})
+            fb = ms.info()["solo_fallbacks"]["speculative"]
+            assert fb["count"] == 2
+            assert "solo" in fb["reason"]
+            # the engine-backed server reports engine routing and no
+            # speculative fallback
+            info = servers["continuous"].info()
+            assert info["routing"]["speculative"] == "engine"
+            assert "speculative" not in info["solo_fallbacks"]
+        finally:
+            for ms in servers.values():
+                ms.close()
+
+    def test_spec_k_over_cap_falls_back_solo_with_same_tokens(self):
+        """A request asking for a draft length above the server's
+        --spec-k cap decodes solo (the pool program is compiled at
+        the cap) — logged, counted, and token-identical to an
+        engine-less server."""
+        model, variables = _fp32_tiny()
+        eng = ModelServer(model, variables, max_batch=2,
+                          draft_model=model,
+                          draft_variables=variables, spec_k=2)
+        solo = ModelServer(model, variables, max_batch=2,
+                           batching="off", draft_model=model,
+                           draft_variables=variables, spec_k=2)
+        try:
+            req = {"prompt": [5, 6, 7, 8], "max_new_tokens": 6,
+                   "speculative": True, "spec_k": 4,
+                   "temperature": 0.9, "seed": 3}
+            a = eng.generate(dict(req))
+            b = solo.generate(dict(req))
+            assert a["new_tokens"] == b["new_tokens"]
+            assert eng.engine.stats()["admitted_spec_total"] == 0
+            fb = eng.info()["solo_fallbacks"]
+            assert any("spec_k" in k for k in fb)
+            # default spec_k comes from the server flag
+            assert eng.info()["spec_k_default"] == 2
+        finally:
+            eng.close()
+            solo.close()
+
+    def test_near_capacity_cotenant_falls_back_solo(self):
+        """On a spec-capable engine every resident's verify chunk is
+        cap+1 wide, so a greedy request within cap-1 tokens of
+        max_position decodes solo (correctly, with a logged reason)
+        instead of scribbling past the cache end."""
+        model, variables = _fp32_tiny()
+        max_pos = model.cfg.max_position
+        ms = ModelServer(model, variables, max_batch=1,
+                         draft_model=model,
+                         draft_variables=variables, spec_k=4)
+        try:
+            p_len = 8
+            new = max_pos - p_len          # exactly at capacity
+            req = {"prompt": list(range(1, p_len + 1)),
+                   "max_new_tokens": new}
+            out = ms.generate(dict(req))
+            want = generate(model, variables,
+                            np.asarray([req["prompt"]], np.int32),
+                            max_new_tokens=new)
+            assert out["tokens"] == np.asarray(want).tolist()
+            assert ms.engine.stats()["admitted_total"] == 0
+            assert "near-capacity" in ms.info()["solo_fallbacks"]
+        finally:
+            ms.close()
+
+    def test_spec_metrics_and_info_share_counters(self):
+        """/metrics' speculative counters and histogram render the
+        SAME engine.stats() dict /info reports — no drift."""
+        model, variables, servers = self._servers()
+        try:
+            ms = servers["continuous"]
+            ms.generate({"prompt": [5, 6, 7, 8], "max_new_tokens": 6,
+                         "speculative": True, "spec_k": 3,
+                         "temperature": 0.9, "seed": 1})
+            info = ms.info()
+            text = ms.metrics_text()
+            metrics = {}
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    name, _, value = line.rpartition(" ")
+                    metrics[name] = float(value)
+            assert metrics["ptpu_serving_admitted_spec_total"] == \
+                info["admitted_spec_total"] == 1
+            assert metrics["ptpu_serving_completed_spec_total"] == \
+                info["completed_spec_total"] == 1
+            assert metrics["ptpu_serving_spec_drafted_total"] == \
+                info["spec_drafted_total"] > 0
+            assert metrics["ptpu_serving_spec_accepted_total"] == \
+                info["spec_accepted_total"]
+            assert metrics["ptpu_serving_spec_accept_rate_count"] \
+                == info["spec_accept_count"] == 1
+            # histogram: cumulative buckets end at the observation
+            # count, and the per-bucket counts in /info sum to it
+            assert metrics[
+                'ptpu_serving_spec_accept_rate_bucket{le="+Inf"}'] \
+                == 1
+            assert sum(info["spec_accept_hist"]) == 1
+        finally:
+            for ms in servers.values():
+                ms.close()

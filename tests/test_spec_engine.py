@@ -1,0 +1,305 @@
+"""Speculative continuous batching — engine-vs-solo token equality
+and scheduling properties for speculative slots (serving/engine.py +
+the spec step program in serving/slots.py + the shared per-row
+draft/verify/accept kernels in models/generate.py).
+
+The defining contract, mirroring tests/test_sampled_engine.py: a
+speculative request's tokens are a pure function of the request —
+every draft/accept/residual draw is keyed by (seed, row, token index,
+lane) — so engine spec slots and the solo ``generate_speculative(...,
+seed=)`` reference agree bit-for-bit under ANY co-tenancy or
+admission schedule, and co-tenants' tokens never change when a spec
+slot joins the pool (greedy/sampled streams ride the spec program's
+one-token plain lane).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models.generate import (
+    _rollback_cache,
+    generate,
+    generate_continue,
+    generate_positional,
+    generate_speculative,
+    prefill,
+)
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import DecodeEngine, SchedulerPolicy
+from polyaxon_tpu.serving.scheduler import SamplingSpec
+
+
+def _small_model(vocab=32, **over):
+    """f32 vocab-32 model (the sampled-engine test shape): margins
+    dominate cross-program rounding, so token equality is exact."""
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=vocab, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32, **over)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _draft_vars(model, seed=99):
+    return model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 4), jnp.int32))
+
+
+def _engine(model, variables, dvars, **policy):
+    kw = dict(n_slots=4, decode_window=8)
+    kw.update(policy)
+    return DecodeEngine(model, variables, autostart=False,
+                        policy=SchedulerPolicy(**kw),
+                        draft_model=model, draft_variables=dvars)
+
+
+PROMPT = np.asarray([[3, 1, 4, 1]], np.int32)
+SPEC = dict(temperature=0.9, top_k=16)
+
+
+def test_greedy_spec_engine_matches_generate():
+    """Greedy speculative through the engine == plain greedy
+    generate (speculation changes the schedule, never the tokens) —
+    with a low-acceptance independent draft, so the correction lane
+    is exercised."""
+    model, variables = _small_model()
+    dvars = _draft_vars(model)
+    eng = _engine(model, variables, dvars)
+    g = eng.submit(PROMPT, 12, None, None,
+                   sampling=SamplingSpec(spec_k=3))
+    eng.run_until_idle()
+    want = np.asarray(generate(model, variables, PROMPT,
+                               max_new_tokens=12))
+    assert g.result().tolist() == want.tolist()
+    assert eng.admitted_spec_total == 1
+    assert eng.completed_spec_total == 1
+
+
+def test_sampled_spec_engine_matches_solo_under_three_schedules():
+    """Engine-vs-solo token equality per seed for sampled speculative
+    requests under three co-tenancy/admission schedules: alone in
+    the pool; admitted into a pool of running greedy/sampled
+    co-tenants; and slot-starved (queued behind residents, admitted
+    mid-flight into an evicted slot)."""
+    model, variables = _small_model()
+    dvars = _draft_vars(model)
+    want = np.asarray(generate_speculative(
+        model, variables, model, dvars, PROMPT, max_new_tokens=12,
+        k=3, seed=7, **SPEC)).tolist()
+
+    # 1) alone
+    eng = _engine(model, variables, dvars)
+    g = eng.submit(PROMPT, 12, None, None,
+                   sampling=SamplingSpec(seed=7, spec_k=3, **SPEC))
+    eng.run_until_idle()
+    assert g.result().tolist() == want
+
+    # 2) admitted mid-flight beside running co-tenants
+    eng = _engine(model, variables, dvars)
+    a = eng.submit(np.asarray([[2, 7, 1, 8]], np.int32), 16, None,
+                   None)
+    b = eng.submit(np.asarray([[5, 6, 7, 8]], np.int32), 16, None,
+                   None, sampling=SamplingSpec(seed=3,
+                                               temperature=1.0))
+    for _ in range(3):
+        eng.tick()
+    g = eng.submit(PROMPT, 12, None, None,
+                   sampling=SamplingSpec(seed=7, spec_k=3, **SPEC))
+    eng.run_until_idle()
+    assert g.result().tolist() == want
+    # ...and the co-tenants' tokens are what they'd be solo
+    assert a.result().tolist() == np.asarray(generate(
+        model, variables, np.asarray([[2, 7, 1, 8]], np.int32),
+        max_new_tokens=16)).tolist()
+    assert b.result().tolist() == np.asarray(generate_positional(
+        model, variables, np.asarray([[5, 6, 7, 8]], np.int32),
+        max_new_tokens=16, seed=3, temperature=1.0)).tolist()
+
+    # 3) slot-starved: queued, admitted into an evicted slot
+    eng = _engine(model, variables, dvars, n_slots=2)
+    others = [eng.submit(np.asarray([[i, i + 1, 2, 3]], np.int32),
+                         4 + i, None, None) for i in range(2)]
+    g = eng.submit(PROMPT, 12, None, None,
+                   sampling=SamplingSpec(seed=7, spec_k=3, **SPEC))
+    eng.run_until_idle()
+    assert g.result().tolist() == want
+    del others
+
+
+def test_mixed_spec_k_pool_matches_solo_per_request():
+    """Two speculative residents with DIFFERENT spec_k share one
+    pool program (compiled at the max k; the smaller-k slot caps its
+    own accepts) — each must still match its own solo reference."""
+    model, variables = _small_model()
+    dvars = _draft_vars(model)
+    p2 = np.asarray([[9, 8, 7, 6]], np.int32)
+    eng = _engine(model, variables, dvars)
+    g4 = eng.submit(PROMPT, 12, None, None,
+                    sampling=SamplingSpec(seed=7, spec_k=4, **SPEC))
+    g2 = eng.submit(p2, 12, None, None,
+                    sampling=SamplingSpec(seed=11, spec_k=2, **SPEC))
+    eng.run_until_idle()
+    w4 = np.asarray(generate_speculative(
+        model, variables, model, dvars, PROMPT, max_new_tokens=12,
+        k=4, seed=7, **SPEC)).tolist()
+    w2 = np.asarray(generate_speculative(
+        model, variables, model, dvars, p2, max_new_tokens=12,
+        k=2, seed=11, **SPEC)).tolist()
+    assert g4.result().tolist() == w4
+    assert g2.result().tolist() == w2
+
+
+def test_windowed_and_single_step_schedules_agree():
+    """The same speculative request through decode_window=1 and
+    decode_window=8 engines: identical tokens (fused rounds change
+    dispatch count, never the position-keyed stream)."""
+    model, variables = _small_model()
+    dvars = _draft_vars(model)
+    outs = []
+    for window in (1, 8):
+        eng = _engine(model, variables, dvars, decode_window=window)
+        g = eng.submit(PROMPT, 13, None, None,
+                       sampling=SamplingSpec(seed=5, spec_k=3,
+                                             temperature=1.0,
+                                             top_p=0.9))
+        eng.run_until_idle()
+        outs.append(g.result().tolist())
+    assert outs[0] == outs[1]
+
+
+def test_eos_mid_round_matches_solo():
+    """An eos firing inside a round's committed prefix freezes the
+    stream exactly like the solo reference (later commits are
+    discarded garbage)."""
+    model, variables = _small_model()
+    dvars = _draft_vars(model)
+    free = np.asarray(generate_speculative(
+        model, variables, model, dvars, PROMPT, max_new_tokens=12,
+        k=3, seed=7, **SPEC))[0, 4:].tolist()
+    eos = next(tok for i, tok in enumerate(free)
+               if i >= 2 and tok not in free[:i])
+    want = np.asarray(generate_speculative(
+        model, variables, model, dvars, PROMPT, max_new_tokens=12,
+        k=3, seed=7, eos_id=eos, **SPEC)).tolist()
+    eng = _engine(model, variables, dvars)
+    g = eng.submit(PROMPT, 12, eos, None,
+                   sampling=SamplingSpec(seed=7, spec_k=3, **SPEC))
+    eng.run_until_idle()
+    assert g.result().tolist() == want
+
+
+def test_spec_never_blocks_greedy_admission():
+    """Regression: a long-running speculative resident must not stop
+    greedy co-tenants from admitting and completing — the whole point
+    of making speculative an engine citizen (the solo path held the
+    device lock for its entire decode)."""
+    model, variables = _small_model()
+    dvars = _draft_vars(model)
+    eng = _engine(model, variables, dvars, n_slots=2)
+    spec = eng.submit(PROMPT, 40, None, None,
+                      sampling=SamplingSpec(seed=7, spec_k=3, **SPEC))
+    ticks = 0
+    while not eng._resident:            # spec stream resident
+        eng.tick()
+        ticks += 1
+        assert ticks < 10
+    shorts = [eng.submit(np.asarray([[i, 1, 2, 3]], np.int32), 3,
+                         None, None) for i in range(3)]
+    while not all(s.event.is_set() for s in shorts):
+        assert not spec.event.is_set(), \
+            "spec stream finished before short greedy co-tenants " \
+            "were even admitted — admission was blocked"
+        eng.tick()
+    eng.run_until_idle()
+    for i, s in enumerate(shorts):
+        want = np.asarray(generate(
+            model, variables, np.asarray([[i, 1, 2, 3]], np.int32),
+            max_new_tokens=3)).tolist()
+        assert s.result().tolist() == want
+    assert spec.event.is_set()
+
+
+def test_spec_submit_without_draft_rejected():
+    model, variables = _small_model()
+    eng = DecodeEngine(model, variables, autostart=False,
+                       policy=SchedulerPolicy(n_slots=2))
+    with pytest.raises(ValueError, match="draft"):
+        eng.submit(PROMPT, 4, None, None,
+                   sampling=SamplingSpec(spec_k=3))
+
+
+def test_acceptance_counters_flow():
+    """Self-draft: every proposal accepts, so the acceptance-rate
+    histogram's top bucket fills and accepted == drafted for the
+    rounds the stream consumed."""
+    model, variables = _small_model()
+    eng = DecodeEngine(model, variables, autostart=False,
+                       policy=SchedulerPolicy(n_slots=2,
+                                              decode_window=1),
+                       draft_model=model, draft_variables=variables)
+    g = eng.submit(PROMPT, 9, None, None,
+                   sampling=SamplingSpec(spec_k=4))
+    eng.run_until_idle()
+    want = np.asarray(generate(model, variables, PROMPT,
+                               max_new_tokens=9)).tolist()
+    assert g.result().tolist() == want
+    s = eng.stats()
+    assert s["spec_accept_count"] == 1
+    assert s["spec_accept_hist"][-2] + s["spec_accept_hist"][-1] == 1
+    assert s["spec_accepted_total"] > 0
+    assert s["spec_drafted_total"] >= s["spec_accepted_total"]
+
+
+class TestRollbackMasking:
+    """The accept/rewind KV contract (docs/SERVING.md): after
+    ``_rollback_cache``, entries past the rewound index are DEAD —
+    validity is keyed by absolute position and contiguous re-appends
+    overwrite every stale slot before any query can admit it — for
+    the PLAIN and INT8 stacked caches (the ring cache pins the same
+    contract in tests/test_ring_kv_cache.py via its position
+    table)."""
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_rollback_then_redecode_equals_pristine(self, int8):
+        model, variables = _small_model(kv_cache_int8=int8)
+        prompt = jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32)
+        logits, cache = prefill(model, variables, prompt)
+        # Poison: append a 3-token rejected draft, then rewind.
+        garbage = jnp.asarray([[31, 30, 29]], jnp.int32)
+        _, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            garbage, decode=True, decode_position=6,
+            mutable=["cache"])
+        rolled = _rollback_cache(mut["cache"], 6)
+        a = generate_continue(model, variables, rolled, logits, 6,
+                              max_new_tokens=6)
+        b = generate_continue(model, variables, cache, logits, 6,
+                              max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_rollback_then_chunk_extend_equals_pristine(self, int8):
+        """A chunk extension NARROWER than the stale region: queries
+        stay within the freshly-written prefix, so stale entries
+        beyond it are never admitted."""
+        model, variables = _small_model(kv_cache_int8=int8)
+        prompt = jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32)
+        _, cache = prefill(model, variables, prompt)
+        garbage = jnp.asarray([[31, 30, 29, 28]], jnp.int32)
+        _, mut = model.apply(
+            {"params": variables["params"], "cache": cache},
+            garbage, decode=True, decode_position=6,
+            mutable=["cache"])
+        rolled = _rollback_cache(mut["cache"], 6)
+        suffix = jnp.asarray([[2, 6]], jnp.int32)
+        la, _ = prefill(model, variables, suffix, cache=rolled,
+                        position=6)
+        lb, _ = prefill(model, variables, suffix, cache=cache,
+                        position=6)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

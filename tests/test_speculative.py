@@ -210,3 +210,95 @@ class TestSampledSpeculative:
                 model, variables, model, draft_vars,
                 jnp.asarray([[1, 2]], jnp.int32),
                 max_new_tokens=4, k=2, temperature=0.5)
+
+
+class TestPositionalSpeculative:
+    """The position-keyed (seed/keys) schedule — the solo reference
+    the continuous-batching engine's speculative slots are pinned
+    against (tests/test_spec_engine.py pins the engine side)."""
+
+    _tiny_pair = TestSampledSpeculative._tiny_pair
+
+    def test_seed_deterministic_and_jitted(self):
+        cfg, model, variables, draft_vars = self._tiny_pair()
+        prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        from polyaxon_tpu.models.generate import sample_stream_keys
+        fn = jax.jit(lambda p, ks: generate_speculative(
+            model, variables, model, draft_vars, p,
+            max_new_tokens=8, k=3, temperature=0.9, top_k=16,
+            keys=ks))
+        a = fn(prompt, sample_stream_keys(7, 1))
+        bb = fn(prompt, sample_stream_keys(7, 1))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+        c = fn(prompt, sample_stream_keys(8, 1))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_rows_are_independent(self):
+        """Lockstep batch rounds == per-row solo execution: every
+        draw is keyed by (seed, row, token index, lane), so a row
+        re-deriving tokens after a batch-min rollback reproduces
+        them — the property that lets engine slots advance
+        independently yet match this reference."""
+        cfg, model, variables, draft_vars = self._tiny_pair()
+        from polyaxon_tpu.models.generate import sample_stream_keys
+        prompt = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+        both = np.asarray(generate_speculative(
+            model, variables, model, draft_vars, prompt,
+            max_new_tokens=8, k=3, temperature=0.9, top_k=16,
+            seed=7))
+        keys = sample_stream_keys(7, 2)
+        for r in range(2):
+            solo = np.asarray(generate_speculative(
+                model, variables, model, draft_vars,
+                prompt[r:r + 1], max_new_tokens=8, k=3,
+                temperature=0.9, top_k=16, keys=keys[r:r + 1]))
+            np.testing.assert_array_equal(both[r], solo[0])
+
+    def test_top_k_1_equals_greedy_for_any_draft(self):
+        """Same collapse as the chain schedule: top_k=1 makes every
+        density a point mass, so output equals the greedy chain for
+        any seed and draft."""
+        cfg, model, variables, draft_vars = self._tiny_pair()
+        prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        want = generate_speculative(
+            model, variables, model, draft_vars, prompt,
+            max_new_tokens=10, k=3)   # greedy reference
+        got = generate_speculative(
+            model, variables, model, draft_vars, prompt,
+            max_new_tokens=10, k=3, temperature=0.7, top_k=1,
+            seed=3)
+        np.testing.assert_array_equal(np.asarray(want),
+                                      np.asarray(got))
+
+    def test_marginals_match_vanilla_sampling(self):
+        """The positional schedule is still an EXACT sampler of the
+        target's conditional chain: per-position marginals over many
+        iid rows (distinct per-row keys via one seed) match vanilla
+        generate() sampling — heavy rejection via the independent
+        draft.  Deterministic given the fixed seeds."""
+        cfg, model, variables, draft_vars = self._tiny_pair()
+        n, vocab, steps = 4096, cfg.vocab_size, 3
+        prompt = jnp.tile(jnp.asarray([[3, 1, 4, 1]], jnp.int32),
+                          (n, 1))
+        spec = np.asarray(generate_speculative(
+            model, variables, model, draft_vars, prompt,
+            max_new_tokens=steps, k=2, temperature=1.0,
+            seed=21))[:, 4:]
+        ref = np.asarray(generate(
+            model, variables, prompt, max_new_tokens=steps,
+            temperature=1.0, rng=jax.random.PRNGKey(12)))[:, 4:]
+        for t in range(steps):
+            hs = np.bincount(spec[:, t], minlength=vocab) / n
+            hr = np.bincount(ref[:, t], minlength=vocab) / n
+            tv = 0.5 * np.abs(hs - hr).sum()
+            # same margin rationale as the chain-schedule test above
+            assert tv < 0.12, (t, tv)
+
+    def test_rng_and_seed_together_rejected(self):
+        cfg, model, variables, draft_vars = self._tiny_pair()
+        with pytest.raises(ValueError, match="not both"):
+            generate_speculative(
+                model, variables, model, draft_vars,
+                jnp.asarray([[1, 2]], jnp.int32),
+                max_new_tokens=4, k=2, temperature=0.5,
+                rng=jax.random.PRNGKey(0), seed=1)
