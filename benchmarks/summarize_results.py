@@ -104,6 +104,15 @@ def spec_mix_value(r):
     return f"{v}x" + (f" (acc {rate})" if rate is not None else "")
 
 
+def telemetry_value(r):
+    """serving-load rows: the telemetry-overhead A/B column — the
+    tracing-on tax in % agg tok/s (contract: <= ~3%).  Empty for
+    every other bench."""
+    ov = r.get("telemetry_overhead") or {}
+    pct = ov.get("overhead_pct")
+    return "" if pct is None else f"{pct}%"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -113,8 +122,8 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | mfu | age |")
-    print("|---|---|---|---|---|---|---|---|---|---|")
+          "| spec-mix | telemetry | mfu | age |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -131,6 +140,7 @@ def main() -> int:
               f"| {r.get('backend')}{'/' + ','.join(flags) if flags else ''} "
               f"| {v if v is not None else ''} | {unit} "
               f"| {spec_mix_value(r)} "
+              f"| {telemetry_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0
 

@@ -1,0 +1,143 @@
+"""Summarize a serving trace dump: phase latency percentiles and the
+engine occupancy/throughput timeline.
+
+Input is either a saved ``GET /trace`` response (the
+``{"traceEvents": [...]}`` Chrome trace object) or a ``ptpu serve
+--trace-file`` JSONL dump — both parsed by
+``polyaxon_tpu.serving.telemetry.load_trace_events``.  Output is the
+phase breakdown a bench run attaches next to its throughput numbers:
+
+- per-phase wall p50/p95/max + count for the request lifecycle spans
+  (queue, prefill, decode, and the solo/coalesce spans);
+- the engine step timeline: step wall p50/p95, mean occupancy
+  (resident slots per dispatch, token-weighted utilization vs the
+  pool width), tokens per step, and an occupancy-over-time strip so a
+  load run's ramp/drain phases are visible without opening Perfetto.
+
+Run: python benchmarks/trace_report.py TRACE_FILE [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+from bench_serving_load import percentile as pctl  # noqa: E402
+from polyaxon_tpu.serving.telemetry import (ENGINE_PID,  # noqa: E402
+                                            REQUESTS_PID,
+                                            load_trace_events)
+
+
+def phase_stats(events):
+    """name -> {count, p50_ms, p95_ms, max_ms} over request-track
+    complete spans."""
+    byname = {}
+    for ev in events:
+        if ev.get("pid") != REQUESTS_PID or ev.get("ph") != "X":
+            continue
+        byname.setdefault(ev["name"], []).append(
+            ev.get("dur", 0) / 1e3)
+    return {
+        name: {
+            "count": len(ds),
+            "p50_ms": round(pctl(ds, 50), 3),
+            "p95_ms": round(pctl(ds, 95), 3),
+            "max_ms": round(max(ds), 3),
+        }
+        for name, ds in sorted(byname.items())}
+
+
+def engine_stats(events, strip_buckets: int = 20):
+    """Step-timeline summary + an occupancy-over-time strip (mean
+    resident slots per wall-clock bucket, rendered 0-9)."""
+    steps = [ev for ev in events
+             if ev.get("pid") == ENGINE_PID and ev.get("ph") == "X"]
+    if not steps:
+        return None
+    walls = [ev.get("dur", 0) / 1e3 for ev in steps]
+    args = [ev.get("args", {}) for ev in steps]
+    occ = [a.get("occupancy", 0) for a in args]
+    toks = [a.get("tokens", 0) for a in args]
+    batch = max((a.get("batch", 0) for a in args), default=0)
+    t_lo = min(ev["ts"] for ev in steps)
+    t_hi = max(ev["ts"] + ev.get("dur", 0) for ev in steps)
+    span_us = max(1.0, t_hi - t_lo)
+    buckets = [[] for _ in range(strip_buckets)]
+    for ev, o in zip(steps, occ):
+        i = min(strip_buckets - 1,
+                int((ev["ts"] - t_lo) / span_us * strip_buckets))
+        buckets[i].append(o)
+    strip = "".join(
+        "." if not b else str(min(9, round(
+            9 * (sum(b) / len(b)) / max(1, batch))))
+        for b in buckets)
+    out = {
+        "steps": len(steps),
+        "wall_span_s": round(span_us / 1e6, 3),
+        "step_p50_ms": round(pctl(walls, 50), 3),
+        "step_p95_ms": round(pctl(walls, 95), 3),
+        "mean_occupancy": round(sum(occ) / len(occ), 3),
+        "pool_width": batch,
+        "tokens_total": sum(toks),
+        "tokens_per_step": round(sum(toks) / len(steps), 3),
+        "occupancy_strip": strip,
+    }
+    kinds = {}
+    for a in args:
+        kinds[a.get("kind", "?")] = kinds.get(a.get("kind", "?"),
+                                              0) + 1
+    out["steps_by_kind"] = kinds
+    return out
+
+
+def summarize(path: str):
+    events = load_trace_events(path)
+    return {
+        "trace": path,
+        "events": len(events),
+        "phases": phase_stats(events),
+        "engine": engine_stats(events),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="GET /trace JSON or --trace-file "
+                                  "JSONL dump")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args()
+    s = summarize(args.trace)
+    if args.json:
+        print(json.dumps(s, indent=2))
+        return 0
+    print(f"# {s['trace']}: {s['events']} events")
+    print("\n## request phases (wall ms)")
+    print("| phase | count | p50 | p95 | max |")
+    print("|---|---|---|---|---|")
+    for name, st in s["phases"].items():
+        print(f"| {name} | {st['count']} | {st['p50_ms']} "
+              f"| {st['p95_ms']} | {st['max_ms']} |")
+    eng = s["engine"]
+    if eng is None:
+        print("\n(no engine step records in this trace)")
+        return 0
+    print(f"\n## engine: {eng['steps']} step dispatches over "
+          f"{eng['wall_span_s']}s ({eng['steps_by_kind']})")
+    print(f"step wall p50/p95: {eng['step_p50_ms']} / "
+          f"{eng['step_p95_ms']} ms; tokens/step: "
+          f"{eng['tokens_per_step']} ({eng['tokens_total']} total)")
+    print(f"mean occupancy {eng['mean_occupancy']} of "
+          f"{eng['pool_width']} slots; over time (0-9): "
+          f"[{eng['occupancy_strip']}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -466,14 +466,33 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                    "for requests that don't pass spec_k — and the "
                    "engine's cap: requests asking for more decode "
                    "solo.")
+@click.option("--trace-buffer", default=4096, type=int,
+              help="Telemetry ring capacity in trace events (request "
+                   "lifecycle spans + engine step records, exported "
+                   "by GET /trace as Chrome trace JSON). 0 disables "
+                   "span recording; /metrics histograms stay live.")
+@click.option("--trace-file", default=None, type=click.Path(),
+              help="Dump the telemetry ring to this JSONL file on "
+                   "shutdown (one trace event per line).")
+@click.option("--profile-dir", default=None, type=click.Path(),
+              help="Enable POST /profile/start|stop: jax.profiler "
+                   "device traces land in timestamped subdirs here "
+                   "(omit to keep the endpoints disabled).")
+@click.option("--access-log", is_flag=True, default=False,
+              help="One structured JSON line per request on stderr "
+                   "(status, kind, rows, tokens, latency) — includes "
+                   "failed requests, which are otherwise silent.")
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
-          draft_model, draft_checkpoint, spec_k, cpu):
+          draft_model, draft_checkpoint, spec_k, trace_buffer,
+          trace_file, profile_dir, access_log, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
-    prefill later /generate requests skip).
+    prefill later /generate requests skip; /trace exports the
+    telemetry ring as Chrome trace JSON, and /profile/start|stop
+    drives on-demand jax.profiler traces when --profile-dir is set).
 
     The reference's `V1Service` schedules an opaque serving container;
     here the framework ships the model server itself (stdlib HTTP, jit
@@ -500,6 +519,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         # target build (checkpoint restore can take minutes)
         raise click.ClickException(
             "--draft-checkpoint requires --draft-model")
+    if trace_buffer < 0:
+        # same fail-fast contract: no model build for a bad flag
+        raise click.ClickException("--trace-buffer must be >= 0")
     try:
         # Shared validation with the server/library (_check_spec_k):
         # one message for a bad --spec-k on every surface.
@@ -527,6 +549,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                      prefix_cache=prefix_cache,
                      draft_model=draft, draft_variables=draft_vars,
                      spec_k=spec_k,
+                     trace_buffer=trace_buffer,
+                     profile_dir=profile_dir,
+                     access_log=access_log,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),
                            **({"int8_kv": True} if int8_kv else {}),
@@ -544,6 +569,18 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         srv.serve_forever()
     except KeyboardInterrupt:
         srv.shutdown()
+    finally:
+        ms.close()
+        if trace_file:
+            # Shutdown span dump, through the tracking stack's async
+            # writer (telemetry.dump_spans_jsonl) — the offline twin
+            # of GET /trace for post-mortem trace_report.py analysis.
+            from polyaxon_tpu.serving.telemetry import \
+                dump_spans_jsonl
+
+            n = dump_spans_jsonl(ms.telemetry, trace_file)
+            click.echo(f"wrote {n} trace events to {trace_file}",
+                       err=True)
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,10 @@ path moved from request coalescing to continuous batching:
   and stream state.
 - ``legacy.py``    — the seed request-coalescing path, kept as the
   measured A/B baseline (``batching="coalesce"``).
+- ``telemetry.py`` — trace-span ring (+ ``GET /trace`` Chrome trace
+  export), shared latency/acceptance histograms, and the
+  single-flight ``jax.profiler`` wrapper behind ``POST
+  /profile/start|stop``.
 
 The public surface is unchanged: ``from polyaxon_tpu.serving import
 ModelServer, make_server``.
@@ -24,7 +28,10 @@ from .scheduler import (QueueFullError, SamplingSpec,
                         SchedulerPolicy)
 from .server import ModelServer, make_server
 from .slots import SlotKVManager
+from .telemetry import (Histogram, ProfileSession, Telemetry,
+                        render_histogram)
 
 __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "SchedulerPolicy", "SamplingSpec", "SlotKVManager",
-           "QueueFullError"]
+           "QueueFullError", "Telemetry", "Histogram",
+           "ProfileSession", "render_histogram"]

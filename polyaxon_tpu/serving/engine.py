@@ -60,6 +60,7 @@ from ._lru import lru_get
 from .scheduler import (AdmissionQueue, QueueFullError, RequestGroup,
                         SamplingSpec, SchedulerPolicy, Stream)
 from .slots import SlotKVManager
+from .telemetry import Histogram, Telemetry
 
 __all__ = ["DecodeEngine", "QueueFullError", "SPEC_ACCEPT_BUCKETS"]
 
@@ -74,9 +75,17 @@ class DecodeEngine:
                  device_lock: Optional[threading.Lock] = None,
                  autostart: bool = True,
                  prefill_fns=None,
-                 draft_model=None, draft_variables=None):
+                 draft_model=None, draft_variables=None,
+                 telemetry: Optional[Telemetry] = None):
         self.model = model
         self.variables = variables
+        # Telemetry ring shared with the owning server (ModelServer
+        # passes its own, so request spans and engine step records
+        # land in ONE /trace timeline); a standalone engine defaults
+        # to a disabled core — every record call is one attribute
+        # check, nothing else.
+        self.tel = telemetry if telemetry is not None \
+            else Telemetry(buffer=0)
         # Draft model: enables SPECULATIVE streams (spec_k > 0) — the
         # slot pool stacks a second cache for it and the spec step
         # variant drafts/verifies/commits per round.
@@ -131,21 +140,22 @@ class DecodeEngine:
         # Speculative scheduling counters + the per-request
         # acceptance-rate histogram (accepted drafts / drafted, bucket
         # upper bounds in SPEC_ACCEPT_BUCKETS; one completed request =
-        # one observation).  ONE shared structure — /metrics and
-        # /info both render engine.stats(), so they can never drift.
+        # one observation).  ONE shared telemetry.Histogram — /metrics
+        # and /info both render engine.stats(), so they can never
+        # drift, and the exposition goes through the same
+        # render_histogram helper as the latency histograms.
         self.spec_rounds_total = 0
         self.spec_drafted_total = 0
         self.spec_accepted_total = 0
-        self.spec_accept_hist = [0] * (len(SPEC_ACCEPT_BUCKETS) + 1)
-        self.spec_accept_sum = 0.0
-        self.spec_accept_count = 0
+        self.spec_accept = Histogram(SPEC_ACCEPT_BUCKETS)
 
     # -- submission (any thread) ----------------------------------------
 
     def submit(self, rows: np.ndarray, new: int,
                eos_id: Optional[int], prefill_chunk: Optional[int],
                *, sampling: Optional[SamplingSpec] = None,
-               prefix=None, on_prefilled=None) -> RequestGroup:
+               prefix=None, on_prefilled=None,
+               record_timings: bool = False) -> RequestGroup:
         """Enqueue a request (may raise QueueFullError) and make sure
         the loop is running.  Returns the group; callers block on
         ``group.event``.  ``sampling`` carries the per-request
@@ -198,6 +208,11 @@ class DecodeEngine:
             stream.logits = logits
             stream.cache = cache
         group.on_prefilled = on_prefilled
+        group.record_timings = bool(record_timings)
+        for stream in group.streams:
+            stream.sid = self.tel.new_tid()
+            if group.record_timings:
+                stream.events = []
         self.queue.submit(group)          # raises when full
         if self.autostart:
             self._ensure_thread()
@@ -338,6 +353,23 @@ class DecodeEngine:
                 return
         raise RuntimeError("engine did not go idle within max_ticks")
 
+    # -- telemetry ------------------------------------------------------
+
+    def _emit(self, stream: Stream, name: str, t0: float, t1: float,
+              **args) -> None:
+        """One lifecycle span for ``stream``: into the shared trace
+        ring, and (when the request asked for a ``timings`` block)
+        onto the stream's own event list."""
+        self.tel.span(stream.sid or 0, name, t0, t1, **args)
+        if stream.events is not None:
+            stream.events.append((name, t0, t1, args))
+
+    def _emit_instant(self, stream: Stream, name: str, t: float,
+                      **args) -> None:
+        self.tel.instant(stream.sid or 0, name, t, **args)
+        if stream.events is not None:
+            stream.events.append((name, t, t, args))
+
     # -- prefill + admission --------------------------------------------
 
     def _pf_fn(self, s_len: int, first: bool):
@@ -398,10 +430,16 @@ class DecodeEngine:
             stream.t_prefill_start = time.perf_counter()
             if group.t_first_prefill is None:
                 group.t_first_prefill = stream.t_prefill_start
+            # Queue span closes the moment the stream first gets
+            # engine attention (prefill, or straight admission for
+            # full-length prefix hits).
+            self._emit(stream, "queue", group.t_submit,
+                       stream.t_prefill_start, row=stream.row)
         if stream.pieces:               # full-length prefix hits skip
             piece = stream.pieces[0]
             toks = stream.toks[:, stream.filled:stream.filled + piece]
             spec = stream.sampling.spec_k > 0
+            t_piece = time.perf_counter()
             try:
                 with self.device_lock:
                     if stream.cache is None:
@@ -430,6 +468,9 @@ class DecodeEngine:
             stream.filled += piece
             stream.pieces.pop(0)
             self.prefill_chunks_total += 1
+            self._emit(stream, "prefill", t_piece,
+                       time.perf_counter(), row=stream.row,
+                       piece=piece, filled=stream.filled)
             if stream.pieces:
                 return                  # more prompt to consume
         if not stream.pf_done:
@@ -494,12 +535,20 @@ class DecodeEngine:
         stream.out.append(first)
         stream.t_admit = time.perf_counter()
         stream.group.t_last_admit = stream.t_admit
+        if stream.group.t_first_admit is None:
+            # First token of the whole request exists NOW (sampled
+            # from the prefill logits) — the TTFT anchor.
+            stream.group.t_first_admit = stream.t_admit
+        self._emit_instant(stream, "admit", stream.t_admit,
+                           row=stream.row, slot=slot)
         stream.logits = None
         if stream.done():               # new == 1, or instant eos
             stream.cache = None
             stream.d_cache = None
             self.slots.release(slot)
-            self._complete(stream)
+            stream.slot = slot          # zero-length decode span
+            self._complete(stream)      # still keys the slot id
+            stream.slot = None
             self._count_admitted(spec)
             self.evicted_total += 1
             return
@@ -602,6 +651,8 @@ class DecodeEngine:
             return
         sampled = any(s.sampling.sampled
                       for s in self._resident.values())
+        occupancy = len(self._resident)
+        t0 = time.perf_counter()
         try:
             with self.device_lock:
                 toks_w = self.slots.step(window, sampled)  # [W, S]
@@ -609,18 +660,27 @@ class DecodeEngine:
             for slot, stream in list(self._resident.items()):
                 self._fail_group(stream.group, e)
             return
+        t1 = time.perf_counter()
         self.decode_steps_total += window
+        emitted = 0
         for slot, stream in list(self._resident.items()):
             for w in range(window):
                 stream.out.append(int(toks_w[w, slot]))
+                emitted += 1
                 if stream.done():
                     break
             if stream.done():
                 del self._resident[slot]
                 self.slots.release(slot)
-                stream.slot = None
                 self.evicted_total += 1
-                self._complete(stream)
+                self._complete(stream)   # records the slot id
+                stream.slot = None
+        self.tel.step("step", t0, t1,
+                      kind="sampled" if sampled else "plain",
+                      window=window, occupancy=occupancy,
+                      batch=self.slots.n_slots, tokens=emitted,
+                      device_s=round(self.slots.last_step_device_s,
+                                     6))
 
     def _decode_step_spec(self, window: int, K: int) -> None:
         """Advance the pool by ``window`` fused SPECULATIVE rounds
@@ -631,6 +691,8 @@ class DecodeEngine:
         tokens, and a stream stops consuming at its own eos/budget
         (later tokens are discardable garbage, exactly like the
         windowed plain step)."""
+        occupancy = len(self._resident)
+        t0 = time.perf_counter()
         try:
             with self.device_lock:
                 toks, commits, accepts = self.slots.step_spec(window,
@@ -639,8 +701,10 @@ class DecodeEngine:
             for slot, stream in list(self._resident.items()):
                 self._fail_group(stream.group, e)
             return
+        t1 = time.perf_counter()
         self.decode_steps_total += window
         self.spec_rounds_total += window
+        emitted = accepted = 0
         for slot, stream in list(self._resident.items()):
             spec = stream.sampling.speculative
             for w in range(window):
@@ -651,8 +715,10 @@ class DecodeEngine:
                     stream.spec_accepted += int(accepts[w, slot])
                     self.spec_drafted_total += stream.sampling.spec_k
                     self.spec_accepted_total += int(accepts[w, slot])
+                    accepted += int(accepts[w, slot])
                 for j in range(c):
                     stream.out.append(int(toks[w, slot, j]))
+                    emitted += 1
                     if stream.done():
                         break
                 if stream.done():
@@ -660,27 +726,41 @@ class DecodeEngine:
             if stream.done():
                 del self._resident[slot]
                 self.slots.release(slot)
-                stream.slot = None
                 self.evicted_total += 1
-                self._complete(stream)
+                self._complete(stream)   # records the slot id
+                stream.slot = None
+        self.tel.step("step", t0, t1, kind="spec", window=window,
+                      k=K, occupancy=occupancy,
+                      batch=self.slots.n_slots, tokens=emitted,
+                      accepted=accepted,
+                      device_s=round(self.slots.last_step_device_s,
+                                     6))
 
     # -- completion -----------------------------------------------------
 
     def _complete(self, stream: Stream) -> None:
         group = stream.group
+        stream.t_done = time.perf_counter()
         if stream.sampling.speculative and stream.spec_drafted:
             # One acceptance-rate observation per completed stream:
             # accepted draft tokens / drafted (the correction token a
             # rejection commits is not "accepted" work).
-            rate = stream.spec_accepted / stream.spec_drafted
-            self.spec_accept_sum += rate
-            self.spec_accept_count += 1
-            for i, le in enumerate(SPEC_ACCEPT_BUCKETS):
-                if rate <= le:
-                    self.spec_accept_hist[i] += 1
-                    break
-            else:
-                self.spec_accept_hist[-1] += 1
+            self.spec_accept.observe(
+                stream.spec_accepted / stream.spec_drafted)
+        # Lifecycle tail: one decode span (admission -> done) plus the
+        # completion instant — per-window detail lives on the engine
+        # step track, keyed back by the slot id.
+        if stream.t_admit is not None:
+            args = {"row": stream.row, "slot": stream.slot,
+                    "tokens": len(stream.out)}
+            if stream.sampling.speculative:
+                args.update(spec_rounds=stream.spec_rounds,
+                            spec_drafted=stream.spec_drafted,
+                            spec_accepted=stream.spec_accepted)
+            self._emit(stream, "decode", stream.t_admit,
+                       stream.t_done, **args)
+        self._emit_instant(stream, "complete", stream.t_done,
+                           row=stream.row, tokens=len(stream.out))
         group.complete_row(stream)
         if group.event.is_set() and group.error is None:
             self.completed_total += 1
@@ -702,6 +782,12 @@ class DecodeEngine:
                 del self._resident[slot]
                 self.slots.release(slot)
                 self.evicted_total += 1
+        if not group.event.is_set():   # fail once, however many
+            t = time.perf_counter()    # streams drag the group down
+            for stream in group.streams:
+                self._emit_instant(stream, "fail", t,
+                                   row=stream.row,
+                                   error=type(err).__name__)
         group.fail(err)
 
     # -- introspection --------------------------------------------------
@@ -732,13 +818,20 @@ class DecodeEngine:
             "rejected_total": self.queue.rejected,
             # Speculative scheduling + the per-request acceptance-rate
             # histogram (per-bucket counts, upper bounds in
-            # spec_accept_buckets; /metrics cumulates them) — ONE
-            # structure behind both observability endpoints.
+            # spec_accept_buckets; /metrics cumulates them via
+            # telemetry.render_histogram) — ONE structure behind both
+            # observability endpoints.
             "spec_rounds_total": self.spec_rounds_total,
             "spec_drafted_total": self.spec_drafted_total,
             "spec_accepted_total": self.spec_accepted_total,
-            "spec_accept_buckets": list(SPEC_ACCEPT_BUCKETS),
-            "spec_accept_hist": list(self.spec_accept_hist),
-            "spec_accept_sum": round(self.spec_accept_sum, 6),
-            "spec_accept_count": self.spec_accept_count,
+            **self._spec_accept_stats(),
+        }
+
+    def _spec_accept_stats(self) -> Dict[str, Any]:
+        counts, total, n = self.spec_accept.snapshot()
+        return {
+            "spec_accept_buckets": list(self.spec_accept.buckets),
+            "spec_accept_hist": counts,
+            "spec_accept_sum": round(total, 6),
+            "spec_accept_count": n,
         }

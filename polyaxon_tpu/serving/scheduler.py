@@ -173,8 +173,8 @@ class Stream:
     __slots__ = ("group", "row", "toks", "new", "eos_id", "sampling",
                  "base_key", "pieces", "filled", "cache", "logits",
                  "out", "slot", "pf_done", "t_prefill_start",
-                 "t_admit", "d_cache", "spec_rounds", "spec_drafted",
-                 "spec_accepted")
+                 "t_admit", "t_done", "d_cache", "spec_rounds",
+                 "spec_drafted", "spec_accepted", "sid", "events")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -200,6 +200,12 @@ class Stream:
         #                           be queued, waiting for a slot)
         self.t_prefill_start: Optional[float] = None
         self.t_admit: Optional[float] = None
+        self.t_done: Optional[float] = None
+        # Telemetry: trace-track id (engine assigns one per stream at
+        # submit) and, when the request asked for a ``timings`` block,
+        # the (name, t0, t1, args) phase tuples the response renders.
+        self.sid: Optional[int] = None
+        self.events: Optional[List[tuple]] = None
         # Speculative accounting (rounds consumed before the stream
         # finished; drafted/accepted feed the acceptance-rate
         # histogram at completion).
@@ -247,8 +253,14 @@ class RequestGroup:
         self.on_prefilled = None
         self.results: List[Optional[np.ndarray]] = [None] * rows.shape[0]
         self._pending = rows.shape[0]
+        # record_timings: the request asked for a per-phase ``timings``
+        # block — streams collect their span tuples (Stream.events) as
+        # the engine emits them, so the response can render the same
+        # lifecycle /trace records without scanning the shared ring.
+        self.record_timings = False
         self.t_submit = time.perf_counter()
         self.t_first_prefill: Optional[float] = None
+        self.t_first_admit: Optional[float] = None
         self.t_last_admit: Optional[float] = None
         self.t_done: Optional[float] = None
         self.streams = [

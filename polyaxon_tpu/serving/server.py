@@ -12,6 +12,14 @@ Endpoints:
 - ``GET  /healthz``  -> ``{"status": "ok", ...}`` (readiness; also the
   operator's gang-health convention)
 - ``GET  /info``     -> model name, config summary, quantization flags
+- ``GET  /metrics``  -> Prometheus text: counters, phase summaries,
+  and the latency histograms (telemetry.py)
+- ``GET  /trace``    -> Chrome trace-event JSON of the telemetry ring
+  (request lifecycle spans + the engine step timeline) — load it in
+  Perfetto or chrome://tracing
+- ``POST /profile/start`` / ``POST /profile/stop`` -> guarded,
+  single-flight ``jax.profiler`` trace into the server's
+  ``profile_dir`` (400 when started without one)
 - ``POST /prefill``  -> register a prompt (prefix) in the PREFIX
   CACHE: its KV prefill is stored on device (LRU, ``prefix_cache``
   entries) and later /generate requests whose prompt starts with it
@@ -75,8 +83,19 @@ from ._lru import lru_get
 from .engine import DecodeEngine
 from .legacy import RequestCoalescer
 from .scheduler import QueueFullError, SamplingSpec, SchedulerPolicy
+from .telemetry import ProfileSession, Telemetry, render_histogram
 
 BATCHING_MODES = ("continuous", "coalesce", "off")
+
+
+def _span_dicts(events, t0: float):
+    """Render engine/solo span tuples as the response ``timings``
+    block entries: start/duration in ms relative to request arrival."""
+    return [{"name": name,
+             "start_ms": round(1e3 * (a - t0), 3),
+             "dur_ms": round(1e3 * (b - a), 3),
+             **({"args": args} if args else {})}
+            for name, a, b, args in events]
 
 
 def _int_param(v):
@@ -134,9 +153,28 @@ class ModelServer:
                  prefix_cache: int = 4,
                  draft_model=None, draft_variables=None,
                  spec_k: int = 4,
+                 trace_buffer: int = 4096,
+                 profile_dir: Optional[str] = None,
+                 access_log: bool = False,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
         self.variables = variables
+        # Telemetry core (telemetry.py): ONE ring + histogram set
+        # shared with the engine, so request spans and engine step
+        # records land in the same /trace timeline.  trace_buffer=0
+        # disables span recording (the bench A/B's "telemetry off"
+        # arm); the latency histograms stay live — they are the
+        # /metrics surface.
+        self.telemetry = Telemetry(buffer=trace_buffer)
+        # POST /profile/start|stop (single-flight jax.profiler wrap);
+        # None keeps the endpoints disabled — profiling writes device
+        # traces to disk, so it must be an explicit operator opt-in.
+        self.profiler = ProfileSession(profile_dir) \
+            if profile_dir else None
+        # Structured one-line-per-request access log (off by default:
+        # a busy server must not pay per-request stderr IO unasked).
+        self.access_log = bool(access_log)
+        self._access_log_file = sys.stderr
         # Batching policy: "continuous" (engine, default), "coalesce"
         # (legacy baseline), "off" (serialize — the A/B floor for
         # benchmarks/bench_serving_load.py).  The old boolean kwarg
@@ -202,7 +240,8 @@ class ModelServer:
                 # Draft model makes speculative requests engine
                 # citizens (spec step program, slots.py).
                 draft_model=draft_model,
-                draft_variables=draft_variables)
+                draft_variables=draft_variables,
+                telemetry=self.telemetry)
         self._coalescer = RequestCoalescer(self) \
             if self.batching == "coalesce" else None
         self.coalesced_batches = 0
@@ -246,9 +285,61 @@ class ModelServer:
         self.prefix_hits = 0
 
     def close(self) -> None:
-        """Stop the engine loop thread (idempotent)."""
+        """Stop the engine loop thread (idempotent) and end any
+        in-flight profiler trace."""
         if self.engine is not None:
             self.engine.close()
+        if self.profiler is not None:
+            self.profiler.close()
+
+    def log_access(self, method: str, path: str, status: int,
+                   req, resp, dt: float) -> None:
+        """One structured line per request (the satellite fix for the
+        silent ``log_message`` no-op: before this, failed requests
+        vanished entirely).  Defensive about ``req`` — it may be
+        unparsed garbage on 400s — and writes a single JSON object
+        per line so log pipelines need no multi-line stitching."""
+        if not self.access_log:
+            return
+        rec: Dict[str, Any] = {
+            "t": round(time.time(), 3), "method": method,
+            "path": path, "status": int(status),
+            "ms": round(1e3 * dt, 3)}
+        if isinstance(req, dict):
+            rec["kind"] = self._request_kind(req, path)
+            rows = req.get("prompt")
+            if isinstance(rows, list) and rows:
+                rec["rows"] = len(rows) \
+                    if isinstance(rows[0], list) else 1
+        if isinstance(resp, dict):
+            if status == 200 and "new_tokens" in resp:
+                rec["new_tokens"] = sum(
+                    len(r) for r in resp["new_tokens"]
+                    if isinstance(r, list))
+            err = resp.get("error")
+            if err:
+                rec["error"] = str(err)[:200]
+        try:
+            print(json.dumps(rec), file=self._access_log_file,
+                  flush=True)
+        except Exception:
+            pass  # logging must never fail a request
+
+    @staticmethod
+    def _request_kind(req: Dict[str, Any], path: str) -> str:
+        if path == "/prefill":
+            return "prefill"
+        if req.get("speculative") is True:
+            return "speculative"
+        beams = req.get("num_beams")
+        if isinstance(beams, int) and not isinstance(beams, bool) \
+                and beams > 1:
+            return "beam"
+        temp = req.get("temperature", 0)
+        if isinstance(temp, (int, float)) \
+                and not isinstance(temp, bool) and temp > 0:
+            return "sampled"
+        return "greedy"
 
     def _note_fallback(self, kind: str, reason: str) -> None:
         """A request class fell back to the solo decode path: count
@@ -562,6 +653,9 @@ class ModelServer:
             # bool("false") is True — a stringified flag must not
             # silently flip the decode mode.
             raise ValueError("'speculative' must be a JSON boolean")
+        want_timings = req.get("timings", False)
+        if not isinstance(want_timings, bool):
+            raise ValueError("'timings' must be a JSON boolean")
         if speculative:
             if self.draft_model is None:
                 raise ValueError(
@@ -687,6 +781,11 @@ class ModelServer:
         # request with greedy tokens).
         greedy = temp == 0.0 and beams == 1 and not speculative
         breakdown = None
+        # Telemetry anchors: ``group`` (engine paths) carries the
+        # stream span lists + the TTFT anchor; solo/coalesce paths
+        # collect their coarser spans in ``solo_events``.
+        group = None
+        solo_events = None
         if prefix_hit is not None and engine_ok \
                 and toks.shape[0] == 1:
             # Prefix hit on the engine path: seed a stream with the
@@ -698,7 +797,8 @@ class ModelServer:
             group = self.engine.submit(
                 toks, new, eos, chunk, sampling=sampling,
                 prefix=(pc, lg, cache),
-                on_prefilled=self._store_stream_prefix)
+                on_prefilled=self._store_stream_prefix,
+                record_timings=want_timings)
             group.event.wait()
             if group.error is not None:
                 raise group.error
@@ -711,6 +811,8 @@ class ModelServer:
             out = self._generate_prefix_cached(
                 toks, p_len, new, temp, top_k, top_p, eos, chunk,
                 seed, prefix_hit)
+            solo_events = self._emit_solo(t0, "prefix_solo",
+                                          len(rows))
         elif engine_ok:
             # CONTINUOUS BATCHING: per-row decode streams through the
             # slot pool.  Greedy streams ignore ``seed`` (greedy
@@ -720,7 +822,8 @@ class ModelServer:
             # token i with fold_in(fold_in(PRNGKey(seed), row), i).
             # May raise QueueFullError -> 429.
             group = self.engine.submit(toks, new, eos, chunk,
-                                       sampling=sampling)
+                                       sampling=sampling,
+                                       record_timings=want_timings)
             group.event.wait()
             if group.error is not None:
                 raise group.error
@@ -731,6 +834,11 @@ class ModelServer:
         elif greedy and self._coalescer is not None:
             out = self._coalescer.generate(toks, p_len, new, eos,
                                            chunk)
+            # The coalescer's queue wait is its device-lock wait,
+            # folded inside generate() — one opaque span, honest
+            # about the granularity this path offers.
+            solo_events = self._emit_solo(t0, "coalesce_decode",
+                                          len(rows))
         else:
             from ..models import generate as G
 
@@ -787,9 +895,52 @@ class ModelServer:
                 self.requests += 1
             breakdown = (queue_s, 0.0,
                          time.perf_counter() - t_lock - queue_s)
+            t_end = time.perf_counter()
+            solo_events = [
+                ("queue", t_lock, t_lock + queue_s,
+                 {"kind": key[0]}),
+                ("solo_decode", t_lock + queue_s, t_end,
+                 {"kind": key[0], "rows": len(rows)}),
+                ("complete", t_end, t_end, {})]
+            self._push_solo_events(solo_events)
         dt = time.perf_counter() - t0
         if breakdown is not None:
             self._note_breakdown(*breakdown)
+            # Latency histograms (telemetry.py): queue-wait, prefill
+            # and decode-per-token come from the phase breakdown;
+            # solo requests report prefill 0 (fused into the decode
+            # program — documented in docs/SERVING.md).  Per-token
+            # divides by tokens actually DECODED: engine streams
+            # evict at eos (len(out)), solo programs step the whole
+            # budget (eos-frozen rows keep stepping).
+            if group is not None:
+                tokens_done = sum(len(s.out) for s in group.streams)
+            else:
+                tokens_done = len(rows) * new
+            self.telemetry.observe("queue_wait", breakdown[0])
+            self.telemetry.observe("prefill", breakdown[1])
+            self.telemetry.observe(
+                "decode_per_token",
+                breakdown[2] / max(1, tokens_done))
+        # TTFT: the engine samples token 0 at admission; solo paths
+        # deliver all tokens at once, so their client-visible TTFT is
+        # the full latency.
+        ttft = dt
+        if group is not None and group.t_first_admit is not None:
+            ttft = group.t_first_admit - group.t_submit
+        self.telemetry.observe("ttft", ttft)
+        self.telemetry.observe("total", dt)
+        timings = None
+        if want_timings:
+            timings = {"ttft_ms": round(1e3 * ttft, 3)}
+            if group is not None:
+                timings["streams"] = [
+                    {"row": s.row,
+                     "spans": _span_dicts(s.events or [],
+                                          group.t_submit)}
+                    for s in group.streams]
+            elif solo_events is not None:
+                timings["spans"] = _span_dicts(solo_events, t0)
         with self._stats_lock:
             self._lat_sum += dt
             self._lat_count += 1
@@ -806,7 +957,29 @@ class ModelServer:
                if breakdown is not None else {}),
             **({"prefix_hit_len": prefix_hit[1]}
                if prefix_hit is not None else {}),
+            **({"timings": timings} if timings is not None else {}),
         }
+
+    # -- telemetry helpers ----------------------------------------------
+
+    def _push_solo_events(self, events) -> None:
+        """Emit a solo/coalesce request's span tuples onto the shared
+        trace ring (one fresh track per request)."""
+        tid = self.telemetry.new_tid()
+        for name, a, b, args in events:
+            if a == b:
+                self.telemetry.instant(tid, name, a, **args)
+            else:
+                self.telemetry.span(tid, name, a, b, **args)
+
+    def _emit_solo(self, t0: float, name: str, rows: int):
+        """One opaque span for paths whose internal phases are fused
+        (coalescer, prefix-cache split decode): arrival -> now."""
+        t_end = time.perf_counter()
+        events = [(name, t0, t_end, {"rows": rows}),
+                  ("complete", t_end, t_end, {})]
+        self._push_solo_events(events)
+        return events
 
     def info(self) -> Dict[str, Any]:
         import jax
@@ -924,6 +1097,11 @@ class ModelServer:
             "# TYPE ptpu_serving_prefix_entries gauge",
             f"ptpu_serving_prefix_entries {len(self._prefix)}",
         ]
+        # Latency histograms (queue-wait, prefill, decode-per-token,
+        # TTFT, total) — rendered by the same telemetry helper as the
+        # spec-acceptance histogram below, so every histogram on this
+        # endpoint shares one exposition path.
+        lines += self.telemetry.metrics_lines()
         if self.engine is not None:
             lines += [
                 "# TYPE ptpu_serving_slots gauge",
@@ -983,24 +1161,14 @@ class ModelServer:
                 "# TYPE ptpu_serving_spec_accepted_total counter",
                 f"ptpu_serving_spec_accepted_total "
                 f"{es['spec_accepted_total']}",
-                "# TYPE ptpu_serving_spec_accept_rate histogram",
             ]
-            cum = 0
-            for le, n in zip(es["spec_accept_buckets"],
-                             es["spec_accept_hist"]):
-                cum += n
-                lines.append(
-                    f'ptpu_serving_spec_accept_rate_bucket'
-                    f'{{le="{le}"}} {cum}')
-            cum += es["spec_accept_hist"][-1]
-            lines += [
-                f'ptpu_serving_spec_accept_rate_bucket{{le="+Inf"}} '
-                f'{cum}',
-                f"ptpu_serving_spec_accept_rate_sum "
-                f"{es['spec_accept_sum']}",
-                f"ptpu_serving_spec_accept_rate_count "
-                f"{es['spec_accept_count']}",
-            ]
+            # The acceptance-rate histogram renders through the SAME
+            # shared helper as the latency histograms, from the same
+            # engine.stats() dict /info reports.
+            lines += render_histogram(
+                "ptpu_serving_spec_accept_rate",
+                es["spec_accept_buckets"], es["spec_accept_hist"],
+                es["spec_accept_sum"], es["spec_accept_count"])
         return "\n".join(lines) + "\n"
 
 
@@ -1031,7 +1199,10 @@ def make_server(host: str, port: int, ms: ModelServer
             self._send_raw(code, json.dumps(obj).encode(),
                            "application/json", extra)
 
-        def log_message(self, fmt, *args):  # quiet by default
+        def log_message(self, fmt, *args):
+            # Quiet by default; the structured per-request access log
+            # (ms.log_access, --access-log) replaces this — the
+            # stdlib's format can't carry status/kind/tokens/latency.
             pass
 
         def do_GET(self):
@@ -1043,10 +1214,51 @@ def make_server(host: str, port: int, ms: ModelServer
             elif self.path == "/metrics":
                 self._send_raw(200, ms.metrics_text().encode(),
                                "text/plain; version=0.0.4")
+            elif self.path == "/trace":
+                # Chrome trace-event JSON: request spans + the engine
+                # step timeline, loadable directly in Perfetto /
+                # chrome://tracing (docs/SERVING.md).
+                self._send(200, ms.telemetry.chrome_trace())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
+        def _do_profile(self):
+            """POST /profile/start|stop: guarded single-flight
+            jax.profiler wrap.  400 when the server was started
+            without --profile-dir (profiling writes device traces to
+            disk — explicit opt-in); 409 on state conflicts (second
+            start, stop with nothing running)."""
+            t0 = time.perf_counter()
+            if ms.profiler is None:
+                code, resp = 400, {
+                    "error": "profiling disabled (start the server "
+                             "with --profile-dir)"}
+            else:
+                try:
+                    if self.path == "/profile/start":
+                        d = ms.profiler.start()
+                        code, resp = 200, {"profiling": True,
+                                           "dir": d}
+                    else:
+                        d = ms.profiler.stop()
+                        code, resp = 200, {"profiling": False,
+                                           "dir": d}
+                except RuntimeError as e:
+                    code, resp = 409, {"error": str(e)}
+                except Exception as e:
+                    code, resp = 500, {
+                        "error": f"{type(e).__name__}: {e}"}
+            try:
+                self._send(code, resp)
+            except OSError:
+                pass
+            ms.log_access("POST", self.path, code, None, resp,
+                          time.perf_counter() - t0)
+
         def do_POST(self):
+            if self.path in ("/profile/start", "/profile/stop"):
+                self._do_profile()
+                return
             if self.path not in ("/generate", "/prefill"):
                 self._send(404, {"error": f"no route {self.path}"})
                 return
@@ -1056,6 +1268,8 @@ def make_server(host: str, port: int, ms: ModelServer
             # successful response streams out must not count as a
             # serving error (nor trigger a doomed second send).
             extra = None
+            t0 = time.perf_counter()
+            req = None
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
@@ -1082,5 +1296,10 @@ def make_server(host: str, port: int, ms: ModelServer
                 self._send(code, resp, extra)
             except OSError:
                 pass  # client went away mid-write; nothing to do
+            # AFTER the send, so logging latency never delays the
+            # response; 4xx/5xx lines are the whole point (failed
+            # requests used to vanish into the log_message no-op).
+            ms.log_access("POST", self.path, code, req, resp,
+                          time.perf_counter() - t0)
 
     return _ServingHTTPServer((host, port), Handler)

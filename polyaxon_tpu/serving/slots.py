@@ -64,6 +64,7 @@ occupancy managed above it.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -109,6 +110,11 @@ class SlotKVManager:
         # up to spec_k tokens per round); 0 routes the slot through
         # the spec program's plain one-token lane.
         self.spec_ks = np.zeros((self.n_slots,), np.int32)
+        # Wall-clock of the LAST step/step_spec device section
+        # (dispatch + host sync, measured inside the device lock so
+        # lock wait is excluded) — the engine's step-timeline records
+        # report it next to the scheduling wall time.
+        self.last_step_device_s = 0.0
 
     # -- slot accounting ------------------------------------------------
 
@@ -302,6 +308,7 @@ class SlotKVManager:
         if fn is None:
             fn = self._step_fns[(window, sampled)] = \
                 self._build_step(window, sampled)
+        t0 = time.perf_counter()
         if sampled:
             outs, self._stacked = fn(
                 self._stacked, jnp.asarray(self.tokens),
@@ -314,6 +321,7 @@ class SlotKVManager:
                 self._stacked, jnp.asarray(self.tokens),
                 jnp.asarray(self.positions))
         outs = np.asarray(jax.device_get(outs))
+        self.last_step_device_s = time.perf_counter() - t0
         # Arm the next step: every slot feeds back its own last token
         # at the next position (and, for sampled slots, the next
         # token index); idle slots' state is overwritten by the
@@ -444,6 +452,7 @@ class SlotKVManager:
         if fn is None:
             fn = self._step_fns[(window, "spec", K)] = \
                 self._build_spec_step(window, K)
+        t0 = time.perf_counter()
         outs, cs, ms, self._stacked, self._draft_stacked = fn(
             self._stacked, self._draft_stacked,
             jnp.asarray(self.tokens), jnp.asarray(self.positions),
@@ -453,6 +462,7 @@ class SlotKVManager:
         outs = np.asarray(jax.device_get(outs))
         cs = np.asarray(jax.device_get(cs))
         ms = np.asarray(jax.device_get(ms))
+        self.last_step_device_s = time.perf_counter() - t0
         # Arm the next round from the LAST round's per-slot commit.
         rows = np.arange(self.n_slots)
         adv = cs.sum(axis=0).astype(np.int32)

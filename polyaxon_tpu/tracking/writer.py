@@ -15,6 +15,28 @@ from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 
+class JsonlFileClient:
+    """Minimal AsyncEventWriter client that appends events to ONE
+    local JSONL file (one JSON object per line) — the serving layer's
+    ``--trace-file`` span dump rides this through the same async
+    writer the training tracking path uses, instead of growing a
+    second file-writing stack."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def append_events(self, kind, name, events) -> None:
+        import json
+
+        with self._lock, open(self.path, "a") as f:
+            for event in events:
+                f.write(json.dumps(event) + "\n")
+
+    def touch_heartbeat(self) -> None:
+        pass  # a local file needs no liveness signal
+
+
 class AsyncEventWriter:
     def __init__(self, client, flush_interval: float = 2.0,
                  max_batch: int = 512,
