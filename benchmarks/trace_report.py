@@ -96,6 +96,35 @@ def engine_stats(events, strip_buckets: int = 20):
     return out
 
 
+def compile_stats(events):
+    """Recompile-sentinel instants (``compile_miss`` on the engine
+    track, analysis/recompile.py): total + per-cache-kind counts, and
+    when the miss happened relative to the trace span — a tail of
+    misses AFTER warmup is a recompile storm made visible post-hoc."""
+    misses = [ev for ev in events
+              if ev.get("ph") == "i"
+              and ev.get("name") == "compile_miss"]
+    if not misses:
+        return None
+    by_kind = {}
+    for ev in misses:
+        k = ev.get("args", {}).get("kind", "?")
+        by_kind[k] = by_kind.get(k, 0) + 1
+    t_lo = min(ev["ts"] for ev in events if "ts" in ev)
+    t_hi = max(ev["ts"] for ev in events if "ts" in ev)
+    span = max(1.0, t_hi - t_lo)
+    # misses in the last half of the trace = after any sane warmup
+    late = sum(1 for ev in misses
+               if (ev["ts"] - t_lo) / span > 0.5)
+    return {
+        "compile_cache_misses": len(misses),
+        "by_kind": dict(sorted(by_kind.items())),
+        "late_misses": late,
+        "last_miss_at_frac": round(
+            (max(ev["ts"] for ev in misses) - t_lo) / span, 3),
+    }
+
+
 def summarize(path: str):
     events = load_trace_events(path)
     return {
@@ -103,6 +132,7 @@ def summarize(path: str):
         "events": len(events),
         "phases": phase_stats(events),
         "engine": engine_stats(events),
+        "compiles": compile_stats(events),
     }
 
 
@@ -136,6 +166,15 @@ def main() -> int:
     print(f"mean occupancy {eng['mean_occupancy']} of "
           f"{eng['pool_width']} slots; over time (0-9): "
           f"[{eng['occupancy_strip']}]")
+    cc = s["compiles"]
+    if cc is not None:
+        print(f"\n## compile cache: {cc['compile_cache_misses']} "
+              f"misses ({cc['by_kind']})")
+        print(f"last miss at {cc['last_miss_at_frac']} of the trace "
+              f"span; {cc['late_misses']} in the last half"
+              + (" — possible recompile storm, check program keys"
+                 if cc['late_misses'] else
+                 " (quiet after warmup — healthy)"))
     return 0
 
 

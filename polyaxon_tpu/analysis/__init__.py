@@ -1,0 +1,34 @@
+"""Static analysis + runtime sanitizers that machine-check the
+serving stack's own invariants (``ptpu check``, docs/ANALYSIS.md).
+
+Three layers, one theme — the conventions PRs 1-4 wrote down in prose
+(position-keyed RNG, lock discipline, one compiled program per shape,
+no hidden host syncs, no swallowed errors) become checked artifacts:
+
+- :mod:`rules` / :mod:`checker` — the AST linter (`ptpu check`),
+  rule families RNG-DET, LOCK-HOLD, JIT-PURITY, HOST-SYNC,
+  EXC-SWALLOW, with ``# ptpu: ignore[RULE]`` suppressions.
+- :mod:`baseline` — the committed, justified list of accepted
+  findings; the tier-1 clean-check test holds the package to it.
+- :mod:`locksan` / :mod:`recompile` — runtime sanitizers for what
+  static analysis can't see: lock-order inversions / long holds, and
+  steady-state recompile storms.
+"""
+
+from .baseline import (DEFAULT_BASELINE, apply_baseline,
+                       load_baseline, save_baseline)
+from .checker import check_file, check_paths, check_source
+from .locksan import (LockHeldTooLongError, LockOrderError,
+                      LockSanitizer, SanitizedLock)
+from .recompile import RecompileSentinel
+from .rules import ALL_RULES, RULE_IDS, Finding
+
+__all__ = [
+    "ALL_RULES", "RULE_IDS", "Finding",
+    "check_source", "check_file", "check_paths",
+    "DEFAULT_BASELINE", "load_baseline", "save_baseline",
+    "apply_baseline",
+    "LockSanitizer", "SanitizedLock", "LockOrderError",
+    "LockHeldTooLongError",
+    "RecompileSentinel",
+]

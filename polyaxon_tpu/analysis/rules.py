@@ -1,0 +1,635 @@
+"""Repo-specific static-analysis rule families.
+
+Each rule machine-checks one of the serving stack's written-in-prose
+contracts (docs/ANALYSIS.md maps every rule to the contract it
+guards).  Rules are AST visitors over one module at a time; they are
+deliberately narrow — a rule that cries wolf gets suppressed into
+uselessness, so each one flags only the patterns that have actually
+bitten (or would bite) this codebase:
+
+- RNG-DET    position-keyed RNG discipline in the serving-critical
+             paths: no ``jax.random.split`` chains, no fresh
+             ``PRNGKey`` that isn't immediately folded — a split
+             chain makes token values depend on the draw SCHEDULE,
+             which co-tenancy changes (docs/SERVING.md RNG contract).
+- LOCK-HOLD  no unbounded blocking inside a ``with <...lock>`` body:
+             ``time.sleep``, untimed ``.wait()``/``.get()``/
+             ``.join()``, socket/HTTP I/O, or a method-form
+             ``.block_until_ready()`` under a serving lock turns one
+             slow caller into a server-wide stall.  The functional
+             ``jax.block_until_ready(x)`` spelling is the sanctioned
+             step-sync idiom and is allowed.
+- JIT-PURITY no trace-time-frozen impurity inside jitted functions:
+             ``time.*`` clocks, ``np.random.*`` / stdlib ``random.*``
+             draws, and ``global`` mutation all execute ONCE at trace
+             time and silently become constants; static_argnums /
+             static_argnames targets must be hashable.
+- HOST-SYNC  implicit device->host syncs in the engine step hot path
+             (``np.asarray``/``float``/``int`` directly on a jax
+             call, ``.tolist()``/``.item()``): every one is a hidden
+             ``block_until_ready`` that serializes the decode loop.
+             Explicit ``jax.device_get(...)`` is the sanctioned
+             spelling.
+- EXC-SWALLOW ``except Exception: pass`` (body is ONLY ``pass``)
+             drops errors on the floor; best-effort teardown must say
+             so in the baseline, everything else must at least log.
+
+Suppression: ``# ptpu: ignore[RULE-A,RULE-B]`` on the flagged line or
+the line directly above silences those rules for that line;
+``# ptpu: ignore[*]`` silences everything.  Suppressions are for
+findings whose justification is local to the code; findings whose
+justification is historical (legacy reference paths) belong in the
+committed baseline (analysis/baseline.py) with a per-entry
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "Rule", "ALL_RULES", "RULE_IDS", "dotted_name"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``key()`` deliberately excludes the line number: baselines match
+    on (rule, path, enclosing function, source text), so edits above
+    a baselined finding don't invalidate the whole file's entries.
+    """
+
+    rule: str
+    path: str       # posix-style path relative to the checked root
+    line: int       # 1-based, for humans and editors
+    func: str       # enclosing def chain, or "<module>"
+    code: str       # stripped source line
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.func, self.code)
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.code)
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.func}] {self.message}\n    {self.code}")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _src_line(lines: Sequence[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+class Rule:
+    """One rule family.  Subclasses set ``id`` and implement
+    ``applies_to`` (path scoping) and ``check``."""
+
+    id: str = ""
+    message: str = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, lines: Sequence[str],
+              relpath: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+class _ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the enclosing function-def chain."""
+
+    def __init__(self):
+        self._stack: List[str] = []
+
+    @property
+    def func(self) -> str:
+        return ".".join(self._stack) if self._stack else "<module>"
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _in_serving(relpath: str) -> bool:
+    return "/serving/" in "/" + relpath
+
+
+# -- RNG-DET ----------------------------------------------------------------
+
+
+class RngDetRule(Rule):
+    """Position-keyed RNG only in serving-critical paths.
+
+    Flags ``jax.random.split`` (any alias ending in ``.split`` whose
+    root module is a jax random namespace) and fresh ``PRNGKey(...)``
+    construction, UNLESS the key is immediately position-keyed: the
+    ``PRNGKey`` call sits inside a ``fold_in(...)`` argument, or is
+    assigned to a name that is passed to ``fold_in`` within the same
+    function.  Guards the contract that a stream's i-th token key is
+    ``fold_in(fold_in(PRNGKey(seed), row), i)`` — a function of the
+    request alone — so co-tenancy and admission order can never
+    change sampled tokens (docs/SERVING.md)."""
+
+    id = "RNG-DET"
+
+    _SPLIT = re.compile(r"(^|\.)(random|jrandom)\.split$|^jrandom\.split$")
+
+    def applies_to(self, relpath: str) -> bool:
+        return _in_serving(relpath) or \
+            relpath.endswith("models/generate.py")
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def visit_Call(self, node):
+                name = dotted_name(node.func)
+                if name is not None:
+                    if rule._SPLIT.search(name):
+                        findings.append(Finding(
+                            rule.id, relpath, node.lineno, self.func,
+                            _src_line(lines, node.lineno),
+                            "jax.random.split chains make token "
+                            "values depend on the draw schedule; use "
+                            "position-keyed fold_in "
+                            "(sample_stream_keys)"))
+                    elif name.endswith("PRNGKey") and \
+                            not self._folded(node):
+                        findings.append(Finding(
+                            rule.id, relpath, node.lineno, self.func,
+                            _src_line(lines, node.lineno),
+                            "fresh PRNGKey outside a fold_in: "
+                            "serving-path draws must be "
+                            "position-keyed (fold_in(PRNGKey(seed), "
+                            "row) ... fold_in(base, index))"))
+                self.generic_visit(node)
+
+            def _folded(self, node) -> bool:
+                # Only fold_in calls in the SAME enclosing function
+                # count (module-wide matching would let any unrelated
+                # fold_in elsewhere in the file launder a fresh key).
+                local = [c for c in self._fold_calls
+                         if self._fn_of.get(id(c))
+                         is self._fn_of.get(id(node))]
+                # (a) nested directly inside a fold_in(...) call
+                for anc_call in local:
+                    for arg in ast.walk(anc_call):
+                        if arg is node:
+                            return True
+                # (b) assigned to a name folded in the same function
+                tgt = self._assign_target(node)
+                if tgt is not None:
+                    for call in local:
+                        for arg in call.args:
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id == tgt:
+                                return True
+                return False
+
+            def _assign_target(self, node) -> Optional[str]:
+                parent = self._parents.get(node)
+                if isinstance(parent, ast.Assign) and \
+                        len(parent.targets) == 1 and \
+                        isinstance(parent.targets[0], ast.Name):
+                    return parent.targets[0].id
+                return None
+
+        v = V()
+        # Pre-pass: every fold_in call, a child->parent map, and each
+        # node's enclosing FunctionDef (lambdas don't open a scope —
+        # a fold_in inside a vmapped lambda still belongs to the def
+        # that wrote it), so the "immediately folded" exemption can
+        # look up and sideways WITHIN one function only.
+        v._fold_calls = [
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.Call)
+            and (dotted_name(n.func) or "").endswith("fold_in")]
+        v._parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                v._parents[child] = parent
+
+        def fn_of(n):
+            n = v._parents.get(n)
+            while n is not None and not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                n = v._parents.get(n)
+            return n
+
+        v._fn_of = {id(n): fn_of(n) for n in ast.walk(tree)}
+        v.visit(tree)
+        return findings
+
+
+# -- LOCK-HOLD --------------------------------------------------------------
+
+
+_LOCK_NAME = re.compile(r"(^|_)lock$")
+
+_SOCKET_IO = {"create_connection", "urlopen", "recv", "accept",
+              "connect", "sendall", "getresponse", "request"}
+
+
+class LockHoldRule(Rule):
+    """No unbounded blocking inside a ``with <...lock>`` body.
+
+    A serving lock (``device_lock``, ``_lock``, ``_stats_lock``,
+    ``_prefix_lock``, anything matching ``*_lock``) serializes every
+    handler thread behind its holder: an untimed wait under one turns
+    a single slow caller into a server-wide stall, and an inversion-
+    prone sleep is a deadlock seed.  Flags, inside such a body (not
+    descending into nested function defs, which run later):
+    ``time.sleep``; ``.wait()`` / ``.get()`` / ``.join()`` with no
+    timeout; socket/HTTP I/O calls; method-form
+    ``x.block_until_ready()``.  The functional
+    ``jax.block_until_ready(x)`` used to fence a device step is the
+    sanctioned sync idiom and is NOT flagged — the step sync is why
+    the lock is held at all."""
+
+    id = "LOCK-HOLD"
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def visit_With(self, node):
+                held = None
+                for item in node.items:
+                    name = dotted_name(item.context_expr)
+                    if name is None and \
+                            isinstance(item.context_expr, ast.Call):
+                        name = dotted_name(item.context_expr.func)
+                    last = (name or "").rsplit(".", 1)[-1]
+                    if _LOCK_NAME.search(last):
+                        held = last
+                        break
+                if held is not None:
+                    for stmt in node.body:
+                        self._scan(stmt, held)
+                self.generic_visit(node)
+
+            visit_AsyncWith = visit_With
+
+            def _scan(self, node, held: str) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    return          # runs later, not under the lock
+                if isinstance(node, ast.Call):
+                    self._check_call(node, held)
+                for child in ast.iter_child_nodes(node):
+                    self._scan(child, held)
+
+            @staticmethod
+            def _none_const(a) -> bool:
+                return isinstance(a, ast.Constant) and a.value is None
+
+            @staticmethod
+            def _true_const(a) -> bool:
+                return isinstance(a, ast.Constant) and a.value is True
+
+            def _untimed(self, node: ast.Call, tail: str) -> bool:
+                """True when this wait/join/get/wait_for call blocks
+                without a bound.  A positional arg is only a timeout
+                where the stdlib signature puts one — ``q.get(True)``
+                and ``t.join(None)`` are still unbounded."""
+                kw = {k.arg: k.value for k in node.keywords}
+                timeout = kw.get("timeout")
+                if timeout is not None and \
+                        not self._none_const(timeout):
+                    return False
+                if tail in ("wait", "join"):
+                    # signature: (timeout=None)
+                    return not node.args \
+                        or self._none_const(node.args[0])
+                if tail == "wait_for":
+                    # signature: (predicate, timeout=None)
+                    return len(node.args) < 2 \
+                        or self._none_const(node.args[1])
+                # get: signature (block=True, timeout=None) — only
+                # the blocking forms count (q.get(), q.get(True),
+                # block=True); d.get(key[, default]) never matches.
+                if len(node.args) >= 2 and \
+                        not self._none_const(node.args[1]):
+                    return False
+                blocking = (not node.args and "block" not in kw) \
+                    or (node.args and self._true_const(node.args[0])) \
+                    or self._true_const(kw.get("block"))
+                return bool(blocking)
+
+            def _check_call(self, node: ast.Call, held: str) -> None:
+                name = dotted_name(node.func) or ""
+                tail = name.rsplit(".", 1)[-1]
+                msg = None
+                if name == "time.sleep":
+                    msg = "time.sleep while holding"
+                elif tail in ("wait", "get", "join", "wait_for") and \
+                        isinstance(node.func, ast.Attribute) and \
+                        self._untimed(node, tail):
+                    msg = f"untimed .{tail}() while holding"
+                elif tail == "block_until_ready" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        dotted_name(node.func.value) not in ("jax",):
+                    msg = ("method-form .block_until_ready() while "
+                           "holding")
+                elif tail in _SOCKET_IO and (
+                        name.startswith(("socket.", "requests.",
+                                         "urllib.", "http."))
+                        or tail in ("urlopen", "create_connection")):
+                    msg = f"socket/HTTP I/O ({tail}) while holding"
+                if msg is not None:
+                    findings.append(Finding(
+                        rule.id, relpath, node.lineno, self.func,
+                        _src_line(lines, node.lineno),
+                        f"{msg} {held}: one slow caller stalls every "
+                        f"thread queued on the lock — bound it with a "
+                        f"timeout or move it outside the critical "
+                        f"section"))
+
+        V().visit(tree)
+        return findings
+
+
+# -- JIT-PURITY -------------------------------------------------------------
+
+
+_IMPURE_CALLS = re.compile(
+    r"^(time\.(time|perf_counter|monotonic)"
+    r"|np\.random\.\w+|numpy\.random\.\w+"
+    r"|random\.\w+)$")
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+class JitPurityRule(Rule):
+    """No trace-time impurity inside jitted functions.
+
+    A ``jax.jit``-wrapped function's Python body runs ONCE, at trace
+    time: ``time.time()`` / ``np.random.*`` / stdlib ``random.*``
+    results are baked into the compiled program as constants, and
+    ``global`` writes happen once per compile, not per call — all
+    silent wrong-answer bugs.  Also checks that
+    ``static_argnums``/``static_argnames`` targets are hashable by
+    construction (an unhashable static arg fails at call time, far
+    from the jit site): a targeted parameter whose default is a
+    list/dict/set literal is flagged."""
+
+    id = "JIT-PURITY"
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        # Lexically-scoped def resolution for ``jax.jit(fn_name)``:
+        # scope node (Module/FunctionDef/ClassDef) -> {name: def}.
+        # Without this, ``jax.jit(step)`` inside a builder method
+        # resolves to an unrelated same-named METHOD elsewhere in the
+        # module and flags code that never traces.
+        parents: Dict[ast.AST, ast.AST] = {}
+        for p in ast.walk(tree):
+            for c in ast.iter_child_nodes(p):
+                parents[c] = p
+        scopes: Dict[ast.AST, Dict[str, ast.FunctionDef]] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                s = parents.get(n)
+                while s is not None and not isinstance(
+                        s, (ast.Module, ast.FunctionDef,
+                            ast.AsyncFunctionDef, ast.ClassDef)):
+                    s = parents.get(s)
+                scopes.setdefault(s, {})[n.name] = n
+
+        def resolve(call: ast.AST, name: str):
+            """Innermost def named ``name`` visible from ``call``."""
+            s = parents.get(call)
+            while s is not None:
+                if isinstance(s, (ast.Module, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                    d = scopes.get(s, {}).get(name)
+                    if d is not None:
+                        return d
+                s = parents.get(s)
+            return None
+
+        jitted_bodies: List[Tuple[ast.AST, str]] = []
+        seen: Set[int] = set()
+
+        def add(node, label):
+            if id(node) not in seen:
+                seen.add(id(node))
+                jitted_bodies.append((node, label))
+
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    if _is_jax_jit(dec):
+                        add(n, n.name)
+                    elif isinstance(dec, ast.Call) and (
+                            _is_jax_jit(dec.func)
+                            or (dotted_name(dec.func) or "").endswith(
+                                "partial")
+                            and dec.args
+                            and _is_jax_jit(dec.args[0])):
+                        add(n, n.name)
+            elif isinstance(n, ast.Call) and _is_jax_jit(n.func):
+                fn = None
+                if n.args:
+                    target = n.args[0]
+                    if isinstance(target, ast.Lambda):
+                        add(target, "<lambda>")
+                    elif isinstance(target, ast.Name):
+                        fn = resolve(n, target.id)
+                        if fn is not None:
+                            add(fn, target.id)
+                self._check_static_args(n, fn, lines, relpath,
+                                        findings)
+
+        for body, label in jitted_bodies:
+            for node in ast.walk(body):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func) or ""
+                    if _IMPURE_CALLS.match(name) and \
+                            not name.startswith(("jax.random.",
+                                                 "jrandom.")):
+                        findings.append(Finding(
+                            self.id, relpath, node.lineno, label,
+                            _src_line(lines, node.lineno),
+                            f"{name}() inside a jitted function runs "
+                            f"once at TRACE time and is baked into "
+                            f"the program as a constant"))
+                elif isinstance(node, ast.Global):
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno, label,
+                        _src_line(lines, node.lineno),
+                        "global mutation inside a jitted function "
+                        "happens once per compile, not per call"))
+        return findings
+
+    def _check_static_args(self, call: ast.Call, fn, lines,
+                           relpath, findings) -> None:
+        if fn is None:
+            return
+        params = [a.arg for a in fn.args.args]
+        defaults = dict(zip(params[len(params)
+                                   - len(fn.args.defaults):],
+                            fn.args.defaults))
+        marked: List[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        marked.append(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int) and \
+                            el.value < len(params):
+                        marked.append(params[el.value])
+        for pname in marked:
+            default = defaults.get(pname)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                findings.append(Finding(
+                    self.id, relpath, call.lineno, fn.name,
+                    _src_line(lines, call.lineno),
+                    f"static arg {pname!r} defaults to an unhashable "
+                    f"{type(default).__name__.lower()} literal — "
+                    f"static_argnums/static_argnames targets must be "
+                    f"hashable by construction"))
+
+
+# -- HOST-SYNC --------------------------------------------------------------
+
+
+_JAX_ROOTS = ("jax", "jnp", "jrandom")
+
+_HOT_PATHS = ("serving/engine.py", "serving/slots.py")
+
+
+def _is_jax_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    root = name.split(".", 1)[0]
+    return root in _JAX_ROOTS and not name.endswith("device_get")
+
+
+class HostSyncRule(Rule):
+    """No implicit device->host syncs in the decode hot path.
+
+    ``np.asarray``/``np.array``/``float``/``int`` applied directly to
+    a jax-producing call, and ``.tolist()``/``.item()``, each hide a
+    ``block_until_ready`` — the decode loop stalls on device work the
+    author never sees.  The sanctioned spelling is explicit:
+    ``np.asarray(jax.device_get(x))``.  Scoped to the engine step /
+    decode modules (serving/engine.py, serving/slots.py) where one
+    stray sync costs every resident stream a step."""
+
+    id = "HOST-SYNC"
+
+    def applies_to(self, relpath: str) -> bool:
+        return any(relpath.endswith(p) for p in _HOT_PATHS)
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def visit_Call(self, node):
+                name = dotted_name(node.func) or ""
+                tail = name.rsplit(".", 1)[-1]
+                if name in ("np.asarray", "np.array", "float",
+                            "int") and node.args and \
+                        _is_jax_call(node.args[0]):
+                    findings.append(Finding(
+                        rule.id, relpath, node.lineno, self.func,
+                        _src_line(lines, node.lineno),
+                        f"{name}() directly on a jax call is an "
+                        f"implicit device->host sync in the decode "
+                        f"hot path; spell it jax.device_get(...) so "
+                        f"the sync is visible"))
+                elif tail in ("tolist", "item") and \
+                        isinstance(node.func, ast.Attribute) and \
+                        not node.args:
+                    findings.append(Finding(
+                        rule.id, relpath, node.lineno, self.func,
+                        _src_line(lines, node.lineno),
+                        f".{tail}() in the decode hot path is an "
+                        f"implicit device->host sync; device_get "
+                        f"once, index on the host"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return findings
+
+
+# -- EXC-SWALLOW ------------------------------------------------------------
+
+
+class ExcSwallowRule(Rule):
+    """``except Exception: pass`` (body is only ``pass``) silently
+    drops errors.  Best-effort teardown belongs in the committed
+    baseline with a justification; everything else must at least log
+    at debug level so a broken subsystem is diagnosable."""
+
+    id = "EXC-SWALLOW"
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def visit_ExceptHandler(self, node):
+                if self._broad(node.type) and all(
+                        isinstance(s, ast.Pass) for s in node.body):
+                    findings.append(Finding(
+                        rule.id, relpath, node.lineno, self.func,
+                        _src_line(lines, node.lineno),
+                        "except-and-pass drops the error without a "
+                        "trace; log it (debug level is enough) or "
+                        "baseline it as best-effort teardown"))
+                self.generic_visit(node)
+
+            @staticmethod
+            def _broad(t) -> bool:
+                if t is None:
+                    return True
+                names = [dotted_name(el) for el in t.elts] \
+                    if isinstance(t, ast.Tuple) else [dotted_name(t)]
+                return any(n in ("Exception", "BaseException")
+                           for n in names)
+
+        V().visit(tree)
+        return findings
+
+
+ALL_RULES: Tuple[Rule, ...] = (RngDetRule(), LockHoldRule(),
+                               JitPurityRule(), HostSyncRule(),
+                               ExcSwallowRule())
+RULE_IDS: Tuple[str, ...] = tuple(r.id for r in ALL_RULES)

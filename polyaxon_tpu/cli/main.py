@@ -482,12 +482,22 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="One structured JSON line per request on stderr "
                    "(status, kind, rows, tokens, latency) — includes "
                    "failed requests, which are otherwise silent.")
+@click.option("--sanitize", is_flag=True, default=False,
+              help="Wrap the serving locks in the lock-order "
+                   "sanitizer (analysis/locksan.py): raises on "
+                   "lock-order inversion, reports in /info. Debug "
+                   "aid — off by default (and off in benchmark "
+                   "runs; see bench_serving_load.py --sanitize).")
+@click.option("--sanitize-max-hold", default=None, type=float,
+              help="With --sanitize: flag device_lock holds longer "
+                   "than this many seconds (unset = no hold limit).")
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
-          trace_file, profile_dir, access_log, cpu):
+          trace_file, profile_dir, access_log, sanitize,
+          sanitize_max_hold, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
     prefill later /generate requests skip; /trace exports the
@@ -522,6 +532,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     if trace_buffer < 0:
         # same fail-fast contract: no model build for a bad flag
         raise click.ClickException("--trace-buffer must be >= 0")
+    if sanitize_max_hold is not None and not sanitize:
+        raise click.ClickException(
+            "--sanitize-max-hold requires --sanitize")
     try:
         # Shared validation with the server/library (_check_spec_k):
         # one message for a bad --spec-k on every surface.
@@ -552,6 +565,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                      trace_buffer=trace_buffer,
                      profile_dir=profile_dir,
                      access_log=access_log,
+                     sanitize=sanitize,
+                     sanitize_max_hold_s=sanitize_max_hold,
                      info={**({"int8_weights": True}
                               if int8_weights else {}),
                            **({"int8_kv": True} if int8_kv else {}),
@@ -931,22 +946,114 @@ def _restart(run_uuid: str, copy_artifacts: bool, resume: bool):
 
 
 @cli.command()
-@click.option("-f", "--file", "files", multiple=True, required=True,
-              type=click.Path())
+@click.argument("paths", nargs=-1, type=click.Path(exists=True))
+@click.option("-f", "--file", "files", multiple=True,
+              type=click.Path(),
+              help="Validate polyaxonfile(s) instead of running the "
+                   "static analyzer.")
 @click.option("-P", "--param", "params", multiple=True)
-def check(files, params):
-    """Validate a polyaxonfile."""
-    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
-    from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
+@click.option("--format", "fmt", type=click.Choice(["text", "json"]),
+              default="text", help="Finding output format.")
+@click.option("--baseline", "baseline_path", default=None,
+              type=click.Path(),
+              help="Baseline file of accepted findings (default: the "
+                   "committed polyaxon_tpu/analysis/baseline.json).")
+@click.option("--update-baseline", is_flag=True, default=False,
+              help="Rewrite the baseline from the current findings "
+                   "(stable sort; justifications preserved, new "
+                   "entries get a TODO placeholder to fill in).")
+def check(paths, files, params, fmt, baseline_path, update_baseline):
+    """Validate a polyaxonfile (-f), or run the JAX-aware static
+    analyzer over PATHS (default: polyaxon_tpu/).
 
-    try:
-        op = check_polyaxonfile(list(files), params=_parse_params(params))
-    except (PolyaxonfileError, ValueError) as e:
-        raise click.ClickException(str(e))
-    kind = (getattr(op.component.run, "kind", "?")
-            if op.has_component else "ref")
-    click.echo(f"Valid operation: name={op.name!r} kind={kind}"
-               + (f" matrix={op.matrix.kind}" if op.matrix else ""))
+    The analyzer machine-checks the serving stack's own invariants —
+    rule families RNG-DET, LOCK-HOLD, JIT-PURITY, HOST-SYNC,
+    EXC-SWALLOW (docs/ANALYSIS.md has the catalog).  Exit status is
+    non-zero when findings exist beyond the committed baseline;
+    suppress locally-justified findings with `# ptpu: ignore[RULE]`,
+    baseline historically-justified ones with --update-baseline plus
+    a written justification.
+    """
+    if files:
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+        from polyaxon_tpu.polyaxonfile.reader import PolyaxonfileError
+
+        try:
+            op = check_polyaxonfile(list(files),
+                                    params=_parse_params(params))
+        except (PolyaxonfileError, ValueError) as e:
+            raise click.ClickException(str(e))
+        kind = (getattr(op.component.run, "kind", "?")
+                if op.has_component else "ref")
+        click.echo(f"Valid operation: name={op.name!r} kind={kind}"
+                   + (f" matrix={op.matrix.kind}" if op.matrix else ""))
+        return
+
+    if params:
+        # -P only means something to polyaxonfile validation: a CI
+        # line that lost its -f must fail loudly, not silently run
+        # the analyzer and report lint status as file validity.
+        raise click.ClickException(
+            "-P/--param requires -f (polyaxonfile validation); "
+            "the static analyzer takes PATHS only")
+
+    import polyaxon_tpu as _pkg
+    from polyaxon_tpu.analysis import (DEFAULT_BASELINE,
+                                       apply_baseline, check_paths,
+                                       load_baseline, save_baseline)
+    from polyaxon_tpu.analysis.checker import iter_py_files
+
+    # Findings and baseline entries are keyed by paths relative to
+    # the REPO root (the directory holding the package), never the
+    # cwd — `ptpu check` must match the committed baseline from any
+    # working directory.
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(_pkg.__file__)))
+    target = list(paths) or [os.path.join(root, "polyaxon_tpu")]
+    for p in target:
+        if not os.path.exists(p):
+            raise click.ClickException(f"no such path: {p}")
+    baseline_path = baseline_path or DEFAULT_BASELINE
+    findings = check_paths(target, root=root)
+    if update_baseline:
+        previous = load_baseline(baseline_path)
+        # Only the CHECKED paths' debt is rewritten: entries for
+        # files outside this run's scope are preserved verbatim, so
+        # `ptpu check some/subdir --update-baseline` can never drop
+        # other files' entries (and their written justifications).
+        checked = {
+            os.path.relpath(os.path.abspath(f), root).replace(
+                os.sep, "/")
+            for f in iter_py_files(target)}
+        entries = save_baseline(
+            baseline_path, findings, previous=previous,
+            preserve=[e for e in previous
+                      if e["path"] not in checked])
+        click.echo(f"wrote {len(entries)} baseline entries to "
+                   f"{baseline_path}")
+        return
+    entries = load_baseline(baseline_path)
+    new, stale = apply_baseline(findings, entries)
+    if fmt == "json":
+        click.echo(json.dumps({
+            "checked_paths": target,
+            "findings": [f.to_dict() for f in new],
+            "baselined": len(findings) - len(new),
+            "new": len(new),
+            "stale_baseline_entries": stale,
+        }, indent=1))
+    else:
+        for f in new:   # already stably sorted (path, line, rule)
+            click.echo(f.render())
+        for e in stale:
+            click.echo(f"note: stale baseline entry (code fixed?): "
+                       f"{e['rule']} {e['path']} [{e['func']}] — "
+                       f"run --update-baseline to drop it", err=True)
+        click.echo(f"{len(new)} new finding"
+                   f"{'' if len(new) == 1 else 's'} "
+                   f"({len(findings) - len(new)} baselined)")
+    if new:
+        raise SystemExit(1)
 
 
 @cli.group()

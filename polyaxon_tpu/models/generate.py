@@ -52,6 +52,8 @@ def init_cache(model, batch_size: int):
     step creates every entry (see :func:`generate_seq2seq`)."""
     tokens = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
+        # Shape probe under eval_shape (nothing is ever drawn from
+        # this key), not a sampling draw.  # ptpu: ignore[RNG-DET]
         lambda: model.init(jax.random.PRNGKey(0), tokens, decode=True,
                            decode_position=0))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),

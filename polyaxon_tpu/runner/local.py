@@ -20,6 +20,7 @@ for each child run.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shlex
 import socket
@@ -37,6 +38,9 @@ from ..compiler.topology import ProcessTopology
 from ..flow import V1Operation
 from ..flow.run import RunKind
 from ..lifecycle import V1Statuses
+
+
+logger = logging.getLogger(__name__)
 
 
 class ExecutionError(RuntimeError):
@@ -120,7 +124,8 @@ class LocalExecutor:
                 try:
                     self._finalize(run_uuid, make_compiled(operation))
                 except Exception:  # noqa: BLE001 - hooks never mask
-                    pass
+                    logger.debug("sweep finalize hooks failed",
+                                 exc_info=True)
             return self.store.get_run(run_uuid)
 
         run_uuid = run_uuid or self.create_run(
@@ -149,7 +154,8 @@ class LocalExecutor:
             try:
                 self._finalize(run_uuid, make_compiled(operation))
             except Exception:  # noqa: BLE001 - best effort on a failure
-                pass
+                logger.debug("failed-run finalize hooks failed",
+                             exc_info=True)
             raise
 
         self.store.update_run(

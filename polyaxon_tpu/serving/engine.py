@@ -76,7 +76,8 @@ class DecodeEngine:
                  autostart: bool = True,
                  prefill_fns=None,
                  draft_model=None, draft_variables=None,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 sentinel=None):
         self.model = model
         self.variables = variables
         # Telemetry ring shared with the owning server (ModelServer
@@ -93,13 +94,24 @@ class DecodeEngine:
         self.draft_variables = draft_variables
         self.policy = policy or SchedulerPolicy()
         self.device_lock = device_lock or threading.Lock()
+        # Recompile sentinel (analysis/recompile.py): every program-
+        # cache miss across the engine's prefill/step/insert caches is
+        # counted (and trace-marked), so the zero-steady-state-
+        # recompile contract is testable.  ModelServer passes ITS
+        # sentinel so server and engine caches report as one.
+        if sentinel is None:
+            from ..analysis.recompile import RecompileSentinel
+
+            sentinel = RecompileSentinel(telemetry=self.tel)
+        self.sentinel = sentinel
         # autostart=False: no loop thread — the owner drives tick()
         # manually (deterministic tests, offline batch use).
         self.autostart = bool(autostart)
         self.slots = SlotKVManager(model, variables,
                                    self.policy.n_slots,
                                    draft_model=draft_model,
-                                   draft_variables=draft_variables)
+                                   draft_variables=draft_variables,
+                                   sentinel=sentinel)
         self.queue = AdmissionQueue(self.policy)
         # streams resident in a slot: slot index -> Stream
         self._resident: Dict[int, Stream] = {}
@@ -247,8 +259,18 @@ class DecodeEngine:
                 # to process or fail it.  Wait the old loop out, then
                 # start a fresh one that owns the queue.  (If the old
                 # drain DID see the group, it failed it with "decode
-                # engine closed" — an error, never a hang.)
-                t.join()
+                # engine closed" — an error, never a hang.)  Timed:
+                # this wait runs under _thread_lock, so an old loop
+                # wedged in a device call would otherwise stall every
+                # submitter forever (LOCK-HOLD) — and starting a
+                # second loop beside a live one would race the slot
+                # state, so a timeout is a hard error instead.
+                t.join(timeout=30)
+                if t.is_alive():
+                    raise RuntimeError(
+                        "decode engine loop thread did not exit "
+                        "within 30s of close(); refusing to start a "
+                        "second loop over the same slot pool")
             self._stop = False
             self._thread = threading.Thread(
                 target=self._loop, name="decode-engine",
@@ -393,7 +415,8 @@ class DecodeEngine:
 
         return lru_get(self._pf_fns,
                        ("pfill" if first else "extend", s_len),
-                       self._pf_cap, build)
+                       self._pf_cap, build,
+                       sentinel=self.sentinel, kind="engine_prefill")
 
     def _pf_fn_draft(self, s_len: int, first: bool):
         """Draft-model twin of :meth:`_pf_fn` for speculative
@@ -413,7 +436,8 @@ class DecodeEngine:
 
         return lru_get(self._pf_fns_draft,
                        ("pfill" if first else "extend", s_len),
-                       self._pf_cap, build)
+                       self._pf_cap, build,
+                       sentinel=self.sentinel, kind="draft_prefill")
 
     def _advance_prefill(self, stream: Stream) -> None:
         """Run ONE prefill piece for the head-of-queue stream; admit it
@@ -479,7 +503,12 @@ class DecodeEngine:
                 try:
                     group.on_prefilled(stream)
                 except Exception:
-                    pass  # cache store-back must not fail the request
+                    # Cache store-back must not fail the request, but
+                    # a broken prefix cache should be diagnosable.
+                    import logging
+
+                    logging.getLogger(__name__).debug(
+                        "on_prefilled hook failed", exc_info=True)
         if self.slots.free_slots == 0:
             return          # wait, fully prefilled, for an eviction
         self.queue.pop_head()
@@ -500,9 +529,13 @@ class DecodeEngine:
         from ..models import generate as G
 
         if stream.base_key is None:
-            stream.base_key = np.asarray(jax.random.fold_in(
-                jax.random.PRNGKey(spec.seed), stream.row))
+            # device_get, not bare np.asarray: the sync is 8 bytes
+            # and intentional — spell it so (HOST-SYNC).
+            stream.base_key = np.asarray(jax.device_get(
+                jax.random.fold_in(jax.random.PRNGKey(spec.seed),
+                                   stream.row)))
         if self._admit_sample_fn is None:
+            self.sentinel.miss("admit_sample")
             self._admit_sample_fn = jax.jit(
                 lambda l, k, t, tk, tp:
                 G._sample_positional_row(l, k, 0, t, tk, tp))
@@ -559,8 +592,9 @@ class DecodeEngine:
             # temperature 0 — zeros would work — yet arming the real
             # key keeps one invariant: every speculative slot's key
             # is fold_in(PRNGKey(seed), row)).
-            stream.base_key = np.asarray(jax.random.fold_in(
-                jax.random.PRNGKey(spec.seed), stream.row))
+            stream.base_key = np.asarray(jax.device_get(
+                jax.random.fold_in(jax.random.PRNGKey(spec.seed),
+                                   stream.row)))
         try:
             with self.device_lock:
                 self.slots.insert(
@@ -825,6 +859,12 @@ class DecodeEngine:
             "spec_drafted_total": self.spec_drafted_total,
             "spec_accepted_total": self.spec_accepted_total,
             **self._spec_accept_stats(),
+            # Recompile sentinel: compile_cache_misses must go quiet
+            # once traffic has warmed its shapes (the zero-steady-
+            # state contract, tests/test_analysis.py); a counter that
+            # keeps climbing under same-shaped load is a recompile
+            # storm.
+            **self.sentinel.snapshot(),
         }
 
     def _spec_accept_stats(self) -> Dict[str, Any]:

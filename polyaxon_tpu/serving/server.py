@@ -83,7 +83,8 @@ from ._lru import lru_get
 from .engine import DecodeEngine
 from .legacy import RequestCoalescer
 from .scheduler import QueueFullError, SamplingSpec, SchedulerPolicy
-from .telemetry import ProfileSession, Telemetry, render_histogram
+from .telemetry import (ProfileSession, Telemetry,
+                        render_compile_cache, render_histogram)
 
 BATCHING_MODES = ("continuous", "coalesce", "off")
 
@@ -156,6 +157,8 @@ class ModelServer:
                  trace_buffer: int = 4096,
                  profile_dir: Optional[str] = None,
                  access_log: bool = False,
+                 sanitize: bool = False,
+                 sanitize_max_hold_s: Optional[float] = None,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
         self.variables = variables
@@ -166,6 +169,29 @@ class ModelServer:
         # arm); the latency histograms stay live — they are the
         # /metrics surface.
         self.telemetry = Telemetry(buffer=trace_buffer)
+        # Recompile sentinel (analysis/recompile.py): ONE counter set
+        # shared by the server's fused/split program LRU, the
+        # engine's prefill programs, and the slot pool's step/insert
+        # programs — /metrics' compile_cache_misses_total and /info's
+        # compile_cache report both read it, and each miss drops a
+        # compile_miss instant on the trace's engine track.
+        from ..analysis.recompile import RecompileSentinel
+
+        self.recompile = RecompileSentinel(telemetry=self.telemetry)
+        # Lock-order sanitizer (analysis/locksan.py), opt-in via
+        # ``sanitize`` (the `ptpu serve --sanitize` flag and the
+        # engine/serving tests): wraps every serving lock in a
+        # recording proxy that raises on lock-order inversion and
+        # (when ``sanitize_max_hold_s`` is set) on device_lock holds
+        # past the limit.  Off by default — the bench keeps it off
+        # and documents why (benchmarks/bench_serving_load.py).
+        self.sanitizer = None
+        if sanitize:
+            from ..analysis.locksan import LockSanitizer
+
+            self.sanitizer = LockSanitizer(
+                max_hold_s={"device_lock": sanitize_max_hold_s}
+                if sanitize_max_hold_s is not None else None)
         # POST /profile/start|stop (single-flight jax.profiler wrap);
         # None keeps the endpoints disabled — profiling writes device
         # traces to disk, so it must be an explicit operator opt-in.
@@ -204,7 +230,8 @@ class ModelServer:
         self.model_name = model_name
         self.max_batch = int(max_batch)
         self.extra_info = info or {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock() if self.sanitizer is None \
+            else self.sanitizer.wrap("device_lock")
         # LRU-bounded: the key includes client-controlled sampling
         # values (temperature must stay trace-static — the greedy
         # branch is Python-level control flow), so unbounded caching
@@ -241,7 +268,8 @@ class ModelServer:
                 # citizens (spec step program, slots.py).
                 draft_model=draft_model,
                 draft_variables=draft_variables,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry,
+                sentinel=self.recompile)
         self._coalescer = RequestCoalescer(self) \
             if self.batching == "coalesce" else None
         self.coalesced_batches = 0
@@ -251,7 +279,9 @@ class ModelServer:
         # NEVER the device lock, so bumping a counter can't queue a
         # finished request behind in-flight device work; reads are
         # unlocked, consistent enough for monotonic counters.
-        self._stats_lock = threading.Lock()
+        self._stats_lock = threading.Lock() \
+            if self.sanitizer is None \
+            else self.sanitizer.wrap("_stats_lock")
         self.errors = 0
         # Requests that fell back to the solo path, keyed by request
         # kind: {"reason": ..., "count": n}.  Surfaced in /info's
@@ -281,7 +311,9 @@ class ModelServer:
         else:
             self._prefix_enabled = False  # seq2seq: encoder != prefix
         self._prefix: "OrderedDict[tuple, tuple]" = OrderedDict()
-        self._prefix_lock = threading.Lock()
+        self._prefix_lock = threading.Lock() \
+            if self.sanitizer is None \
+            else self.sanitizer.wrap("_prefix_lock")
         self.prefix_hits = 0
 
     def close(self) -> None:
@@ -421,7 +453,9 @@ class ModelServer:
                 temperature=temp, top_k=top_k, top_p=top_p,
                 eos_id=eos, rng=rng, prefill_chunk=chunk))
 
-        return lru_get(self._fns, key, self._fn_cap, build)
+        return lru_get(self._fns, key, self._fn_cap, build,
+                       sentinel=self.recompile,
+                       kind=f"server:{key[0]}")
 
     # -- prefix cache ----------------------------------------------------
 
@@ -467,7 +501,9 @@ class ModelServer:
                                top_p=top_p, rng=rng, eos_id=eos,
                                _validated=True))
 
-        return lru_get(self._fns, key, self._fn_cap, build)
+        return lru_get(self._fns, key, self._fn_cap, build,
+                       sentinel=self.recompile,
+                       kind=f"server:{kind}")
 
     def _prefix_lookup(self, toks: np.ndarray):
         """Longest stored entry whose prompt is a prefix of ``toks``
@@ -1014,6 +1050,10 @@ class ModelServer:
         with self._stats_lock:
             fallbacks = {k: dict(v)
                          for k, v in self.solo_fallbacks.items()}
+        # Recompile sentinel in the routing report: a healthy routing
+        # table with a climbing miss count under steady traffic means
+        # some request property is leaking into program keys.
+        compile_cache = self.recompile.snapshot()
         return {"model": self.model_name, "config": summary,
                 "backend": jax.default_backend(),
                 "max_batch": self.max_batch,
@@ -1021,6 +1061,11 @@ class ModelServer:
                 "spec_k_default": self.spec_k_default,
                 "routing": routing,
                 "solo_fallbacks": fallbacks,
+                "compile_cache_misses":
+                    compile_cache["compile_cache_misses"],
+                "compile_cache": compile_cache,
+                **({"sanitizer": self.sanitizer.stats()}
+                   if self.sanitizer is not None else {}),
                 "compiled_shapes": len(self._fns),
                 "requests": self.requests,
                 "coalesced_batches": self.coalesced_batches,
@@ -1097,6 +1142,11 @@ class ModelServer:
             "# TYPE ptpu_serving_prefix_entries gauge",
             f"ptpu_serving_prefix_entries {len(self._prefix)}",
         ]
+        # Recompile sentinel (analysis/recompile.py): ONE counter set
+        # across the server/engine/slot program caches, rendered by
+        # the shared telemetry helper (same module as the histogram
+        # exposition, so /metrics and /info can never drift).
+        lines += render_compile_cache(self.recompile.snapshot())
         # Latency histograms (queue-wait, prefill, decode-per-token,
         # TTFT, total) — rendered by the same telemetry helper as the
         # spec-acceptance histogram below, so every histogram on this

@@ -80,7 +80,8 @@ class SlotKVManager:
     """
 
     def __init__(self, model, variables, n_slots: int,
-                 draft_model=None, draft_variables=None):
+                 draft_model=None, draft_variables=None,
+                 sentinel=None):
         self.model = model
         self.variables = variables
         # Draft model for SPECULATIVE slots (optional): its per-slot
@@ -88,6 +89,12 @@ class SlotKVManager:
         # program's draft scan.
         self.draft_model = draft_model
         self.draft_variables = draft_variables
+        # Recompile sentinel (analysis/recompile.py): every step/
+        # insert program build is a counted compile-cache miss, so a
+        # steady-state recompile storm (an unbounded key leaking into
+        # the program set) is observable instead of being mystery
+        # tail latency.
+        self.sentinel = sentinel
         self.n_slots = int(n_slots)
         self._stacked = None          # pytree, leaves [S, ...]
         self._draft_stacked = None    # draft pytree, leaves [S, ...]
@@ -199,6 +206,9 @@ class SlotKVManager:
 
         self._ensure_stacked(cache)
         if self._insert_fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("slot_insert")
+
             def _insert(stacked, one, idx):
                 return jax.tree.map(
                     lambda s, n: jax.lax.dynamic_update_index_in_dim(
@@ -306,8 +316,12 @@ class SlotKVManager:
             raise RuntimeError("step() before any insert()")
         fn = self._step_fns.get((window, sampled))
         if fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("slot_step", (window, sampled))
             fn = self._step_fns[(window, sampled)] = \
                 self._build_step(window, sampled)
+        elif self.sentinel is not None:
+            self.sentinel.hit("slot_step", (window, sampled))
         t0 = time.perf_counter()
         if sampled:
             outs, self._stacked = fn(
@@ -450,8 +464,12 @@ class SlotKVManager:
                                "insert()")
         fn = self._step_fns.get((window, "spec", K))
         if fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("slot_step", (window, "spec", K))
             fn = self._step_fns[(window, "spec", K)] = \
                 self._build_spec_step(window, K)
+        elif self.sentinel is not None:
+            self.sentinel.hit("slot_step", (window, "spec", K))
         t0 = time.perf_counter()
         outs, cs, ms, self._stacked, self._draft_stacked = fn(
             self._stacked, self._draft_stacked,

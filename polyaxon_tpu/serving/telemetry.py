@@ -41,7 +41,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Histogram", "Telemetry", "ProfileSession",
-           "render_histogram", "dump_spans_jsonl",
+           "render_histogram", "render_compile_cache",
+           "dump_spans_jsonl",
            "LATENCY_BUCKETS", "PER_TOKEN_BUCKETS",
            "REQUESTS_PID", "ENGINE_PID"]
 
@@ -120,6 +121,26 @@ def render_histogram(name: str, buckets: Sequence[float],
     lines.append(f"{name}_sum {total_sum}")
     lines.append(f"{name}_count {count}")
     return lines
+
+
+def render_compile_cache(snapshot: Dict[str, Any]) -> List[str]:
+    """Prometheus exposition for the recompile sentinel's counters
+    (``analysis.recompile.RecompileSentinel.snapshot()``) — lives
+    here so every /metrics family (histograms above, compile-cache
+    counters) renders through ONE module and can never drift from
+    what /info reports.  Steady-state traffic is supposed to hold
+    ``misses`` flat; alert on the rate, not the level."""
+    return [
+        "# TYPE ptpu_serving_compile_cache_misses_total counter",
+        f"ptpu_serving_compile_cache_misses_total "
+        f"{snapshot['compile_cache_misses']}",
+        "# TYPE ptpu_serving_compile_cache_hits_total counter",
+        f"ptpu_serving_compile_cache_hits_total "
+        f"{snapshot['compile_cache_hits']}",
+        "# TYPE ptpu_serving_compile_cache_evictions_total counter",
+        f"ptpu_serving_compile_cache_evictions_total "
+        f"{snapshot['compile_cache_evictions']}",
+    ]
 
 
 # (telemetry key, prometheus metric name, bucket ladder) for the
@@ -265,10 +286,18 @@ class ProfileSession:
                 raise RuntimeError(
                     f"a profile is already running (writing to "
                     f"{self._active_dir}); POST /profile/stop first")
-            d = os.path.join(
+            # Uniquify past second-granularity strftime: two
+            # start/stop cycles inside one second (a scripted
+            # profiling loop) must not merge their xprof sessions
+            # into one directory.  Safe under self._lock.
+            base = os.path.join(
                 self.log_dir,
                 time.strftime("profile_%Y%m%d_%H%M%S"))
-            os.makedirs(d, exist_ok=True)
+            d, n = base, 0
+            while os.path.exists(d):
+                n += 1
+                d = f"{base}_{n}"
+            os.makedirs(d)
             jax.profiler.start_trace(d)
             self._active_dir = d
             return d
@@ -309,6 +338,11 @@ def dump_spans_jsonl(telemetry: Telemetry, path: str,
     from ..tracking.writer import AsyncEventWriter, JsonlFileClient
 
     events = telemetry.events()
+    # Truncate first: JsonlFileClient appends, and a restart reusing
+    # the same --trace-file would otherwise mix events from two
+    # Telemetry epochs into one dump — trace_report's timeline math
+    # (phase stats, late-miss fractions) is only valid per epoch.
+    open(path, "w").close()
     writer = AsyncEventWriter(JsonlFileClient(path))
     writer.start()
     for ev in events:

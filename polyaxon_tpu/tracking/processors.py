@@ -8,10 +8,13 @@ available); on CPU-only hosts the TPU block is simply absent.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 
 def host_metrics() -> Dict[str, float]:
@@ -33,7 +36,10 @@ def host_metrics() -> Dict[str, float]:
         load1, _, _ = os.getloadavg()
         out["load1"] = load1
     except Exception:
-        pass
+        # Sampling is best-effort (a container without /proc/net or
+        # loadavg just reports fewer fields) — but say so, or a host
+        # with NO metrics looks identical to one never sampled.
+        logger.debug("host metric sampling failed", exc_info=True)
     return out
 
 
@@ -91,7 +97,10 @@ class SystemMetricsMonitor:
             try:
                 self._log_fn(name, value, now)
             except Exception:
-                pass
+                # One bad event must not end the monitor thread; a
+                # persistently failing sink still leaves a trace.
+                logger.debug("system metric log failed: %s", name,
+                             exc_info=True)
         return metrics
 
     def stop(self) -> None:

@@ -285,7 +285,10 @@ class Run:
                                 "late_probe": True,
                             }))
                     except Exception:
-                        pass
+                        # The correction is opportunistic; the main
+                        # env record already shipped "unavailable".
+                        logger.debug("late jax-backend correction "
+                                     "failed", exc_info=True)
 
             t = threading.Thread(target=probe, daemon=True)
             t.start()

@@ -1,0 +1,59 @@
+"""Tier-1 clean-check: ``ptpu check`` over the whole package must
+report NOTHING beyond the committed baseline.
+
+This is the enforcement half of the analysis subsystem: the rule
+families in polyaxon_tpu/analysis/rules.py machine-check the serving
+stack's written contracts (position-keyed RNG, lock discipline,
+jit purity, explicit host syncs, no swallowed errors), and this test
+holds every future diff to them.  A new finding means: fix it,
+suppress it inline with a local justification
+(``# ptpu: ignore[RULE]``), or add a baseline entry with a written
+justification (``ptpu check --update-baseline``, then REPLACE the
+TODO placeholder) — never delete the test."""
+
+import os
+
+import polyaxon_tpu
+from polyaxon_tpu.analysis import (DEFAULT_BASELINE, apply_baseline,
+                                   check_paths, load_baseline)
+
+PKG = os.path.dirname(os.path.abspath(polyaxon_tpu.__file__))
+ROOT = os.path.dirname(PKG)
+
+
+def test_package_is_clean_against_baseline():
+    findings = check_paths([PKG], root=ROOT)
+    entries = load_baseline(DEFAULT_BASELINE)
+    new, stale = apply_baseline(findings, entries)
+    assert not new, (
+        "new static-analysis findings (fix, ptpu:ignore with a "
+        "local justification, or baseline with a written one):\n"
+        + "\n".join(f.render() for f in new))
+    assert not stale, (
+        "stale baseline entries (the flagged code was fixed — run "
+        "`ptpu check --update-baseline` to drop the paid-off debt):\n"
+        + "\n".join(f"{e['rule']} {e['path']} [{e['func']}]"
+                    for e in stale))
+
+
+def test_baseline_entries_are_justified():
+    """Every baselined finding carries a real justification — the
+    --update-baseline TODO placeholder must never be committed."""
+    entries = load_baseline(DEFAULT_BASELINE)
+    assert entries, "committed baseline unexpectedly empty"
+    todo = [e for e in entries
+            if "TODO" in e.get("justification", "TODO")]
+    assert not todo, (
+        "baseline entries with placeholder justifications:\n"
+        + "\n".join(f"{e['rule']} {e['path']} [{e['func']}]"
+                    for e in todo))
+
+
+def test_no_findings_escape_rule_scoping():
+    """The committed baseline only carries rules the catalog defines
+    (a typo'd rule id in the baseline would silently never match)."""
+    from polyaxon_tpu.analysis import RULE_IDS
+
+    entries = load_baseline(DEFAULT_BASELINE)
+    unknown = {e["rule"] for e in entries} - set(RULE_IDS)
+    assert not unknown, f"baseline references unknown rules: {unknown}"

@@ -29,9 +29,13 @@ def smoke_server():
     # lengths are different XLA programs, which may round one bf16
     # ulp apart — the f32 unit tests in test_serving.py cover window
     # fusion; this file is the scheduling canary).
+    # sanitize=True: the lock-order sanitizer (analysis/locksan.py)
+    # wraps device/_stats/_prefix locks for the whole smoke — an
+    # inversion introduced anywhere on the serving path raises inside
+    # these requests, and the teardown asserts a quiet run.
     ms = ModelServer(model, variables, model_name="gpt2-tiny",
                      max_batch=8, n_slots=4, queue_depth=32,
-                     prefill_chunk=8, decode_window=1)
+                     prefill_chunk=8, decode_window=1, sanitize=True)
     srv = make_server("127.0.0.1", 0, ms)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -40,6 +44,8 @@ def smoke_server():
     srv.shutdown()
     srv.server_close()
     ms.close()
+    assert ms.sanitizer is not None and not ms.sanitizer.violations, \
+        f"lock sanitizer violations: {ms.sanitizer.violations}"
 
 
 def _post(base, payload, timeout=120):
