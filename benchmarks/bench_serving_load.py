@@ -49,6 +49,18 @@ and sampled legs stay the pinned coverage for non-speculative
 traffic.  Rows land in benchmarks/results.jsonl as ``{"bench":
 "serving-load"}`` with a cpu-smoke regime tag off-TPU.
 
+A fifth OVERLOAD leg drives a 2x-capacity MIXED-PRIORITY burst with
+deadlines at one continuous server with the request-lifecycle knobs
+armed (interactive short clients + batch long clients, two clients
+per slot; ``--slo-ttft-ms`` preemption on, a batch queue deadline,
+per-request deadlines): it records per-class admission-anchored TTFT
+p50/p99 (from the response ``timings`` block), shed/expired counts
+by class (the structured 503/504s), the server's
+preempted/resumed/shed counters, and GOODPUT — tokens of completed
+requests per second, the number load shedding exists to protect.
+The headline check: interactive TTFT p99 held under the SLO target
+while batch traffic is shed or deferred (``overload.slo_held``).
+
 A fourth TELEMETRY-OVERHEAD leg A/Bs the serving telemetry layer
 itself: the same greedy mix runs against two fresh continuous-mode
 servers back to back — tracing ON (default ring + histograms) vs
@@ -70,6 +82,7 @@ import os
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -396,6 +409,9 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         model, variables, model_name, vocab, shapes,
         n_slots=n_slots, n_short=n_short, n_long=n_long,
         requests=requests, queue_depth=4 * (n_short + n_long))
+    overload = bench_overload(model, variables, model_name, vocab,
+                              shapes, n_slots=n_slots,
+                              requests=requests)
     prefix = bench_prefix_cache(model, variables, model_name, vocab)
     return {
         "model": model_name,
@@ -425,6 +441,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         "spec_continuous_vs_serialized":
             _ab(rows_spec, "continuous", "off"),
         **telemetry,
+        **overload,
         **prefix,
     }
 
@@ -504,6 +521,177 @@ def bench_telemetry_overhead(model, variables, model_name: str,
         "tok_per_sec_off": out["off"],
         "overhead_pct": overhead_pct,
     }}
+
+
+def bench_overload(model, variables, model_name: str, vocab: int,
+                   shapes, *, n_slots: int, requests: int):
+    """Overload leg: 2x-capacity mixed-priority burst with deadlines
+    against ONE continuous server with the lifecycle knobs armed —
+    measures whether priority scheduling + preemption hold the
+    interactive TTFT SLO while batch traffic absorbs the pain
+    (deferred, preempted, or shed), and what goodput survives."""
+    import numpy as np
+
+    from polyaxon_tpu.serving import ModelServer, make_server
+
+    slo_ttft_ms = 1000          # tight enough that a pool full of
+    #                             long batch decodes MUST preempt to
+    #                             hold it (a long decode runs ~2s on
+    #                             the cpu smoke), loose enough that
+    #                             the half-budget preempt trigger
+    #                             (fires at slo/2) plus a few decode
+    #                             boundaries sits clearly under it
+    ms = ModelServer(model, variables, model_name=model_name,
+                     max_batch=n_slots, batching="continuous",
+                     n_slots=n_slots,
+                     queue_depth=16 * n_slots,
+                     slo_ttft_s=slo_ttft_ms / 1e3,
+                     batch_queue_deadline_s=20.0)
+    srv = make_server("127.0.0.1", 0, ms)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    n_int = n_batch = n_slots       # 2x slot capacity in clients
+    rng = np.random.RandomState(4)
+    ttfts = {"interactive": [], "batch": []}
+    completed = {"interactive": 0, "batch": 0}
+    shed = {"interactive": 0, "batch": 0}
+    expired = {"interactive": 0, "batch": 0}
+    tokens_done = [0]
+    lock = threading.Lock()
+    errors = []
+
+    def client(i):
+        cls = "interactive" if i < n_int else "batch"
+        p_len, new = shapes["short" if cls == "interactive"
+                            else "long"]
+        prompt = rng.randint(0, vocab, size=p_len).tolist()
+        payload = {"prompt": prompt, "max_new_tokens": new,
+                   "priority": cls, "timings": True,
+                   # Deadlines sized so a healthy schedule meets
+                   # them and a pathological one sheds instead of
+                   # rotting: tight-ish for interactive, generous
+                   # for batch (which also has the queue deadline).
+                   "deadline_ms": 30000 if cls == "interactive"
+                   else 120000}
+        for r_i in range(requests):
+            if cls == "interactive" and r_i:
+                # Think time between interactive requests: real
+                # interactive traffic arrives in waves, and the gap
+                # is what lets batch decodes saturate the pool — the
+                # state preempt-or-defer exists for.  Back-to-back
+                # interactive requests would hog slots continuously
+                # and never let the preemption path engage.
+                time.sleep(1.0)
+            try:
+                r = _post(base, payload)
+                with lock:
+                    completed[cls] += 1
+                    tokens_done[0] += sum(
+                        len(row) for row in r["new_tokens"])
+                    t = r.get("timings", {}).get("ttft_ms")
+                    if t is not None:
+                        ttfts[cls].append(t / 1e3)
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+                with lock:
+                    if code == 503:
+                        shed[cls] += 1
+                    elif code == 504:
+                        expired[cls] += 1
+                    else:
+                        errors.append(f"HTTP {code} ({cls})")
+                        return
+            except Exception as e:  # noqa: BLE001 - record, don't die
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+
+    try:
+        # Compile warm outside the timed burst (both shapes).
+        for cls in ("short", "long"):
+            p_len, new = shapes[cls]
+            warm = rng.randint(0, vocab, size=p_len).tolist()
+            _post(base, {"prompt": warm, "max_new_tokens": new},
+                  timeout=900)
+        # Warm the PREEMPT/RESUME path too: each preemption's resume
+        # re-prefill splits into pow2 pieces, and a cold XLA compile
+        # of a piece program runs ON the engine thread — inside the
+        # boundary an interactive admission is waiting on.  Driving
+        # a few preemption cycles at varied commit points here
+        # compiles those shapes outside the timed burst; the row's
+        # compile_cache_misses_during then shows the steady state.
+        p_len_l, new_l = shapes["long"]
+        p_len_s, new_s = shapes["short"]
+        for stagger_s in (0.3, 0.8, 1.5):
+            warm_ts = []
+            for _ in range(n_slots):
+                wl = rng.randint(0, vocab, size=p_len_l).tolist()
+                t = threading.Thread(target=lambda p=wl: _post(
+                    base, {"prompt": p, "max_new_tokens": new_l,
+                           "priority": "batch"}, timeout=900))
+                t.start()
+                warm_ts.append(t)
+            time.sleep(stagger_s)
+            ws = rng.randint(0, vocab, size=p_len_s).tolist()
+            _post(base, {"prompt": ws, "max_new_tokens": new_s,
+                         "priority": "interactive"}, timeout=900)
+            for t in warm_ts:
+                t.join()
+        pre = json.loads(urllib.request.urlopen(
+            base + "/info", timeout=30).read())
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_int + n_batch)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            print(f"# overload leg errors: {errors[:3]}",
+                  file=sys.stderr)
+            return {}
+        info = json.loads(urllib.request.urlopen(
+            base + "/info", timeout=30).read())
+        p99_int = pct_ms(ttfts["interactive"], 99)
+        row = {
+            "slots": n_slots,
+            "interactive_clients": n_int,
+            "batch_clients": n_batch,
+            "slo_ttft_ms": slo_ttft_ms,
+            "interactive_ttft_p50_ms": pct_ms(ttfts["interactive"],
+                                              50),
+            "interactive_ttft_p99_ms": p99_int,
+            "batch_ttft_p50_ms": pct_ms(ttfts["batch"], 50),
+            "batch_ttft_p99_ms": pct_ms(ttfts["batch"], 99),
+            "completed": dict(completed),
+            "shed": dict(shed),
+            "expired": dict(expired),
+            "preempted": info.get("preempted_total", 0)
+            - pre.get("preempted_total", 0),
+            "resumed": info.get("resumed_total", 0)
+            - pre.get("resumed_total", 0),
+            "server_shed_total": info.get("shed_total", 0)
+            - pre.get("shed_total", 0),
+            "goodput_tok_per_sec": round(tokens_done[0] / wall, 1),
+            "compile_cache_misses_during": info.get(
+                "compile_cache_misses", 0)
+            - pre.get("compile_cache_misses", 0),
+            "slo_held": p99_int is not None
+            and p99_int <= slo_ttft_ms,
+        }
+        print(f"# overload: interactive TTFT p99="
+              f"{row['interactive_ttft_p99_ms']}ms "
+              f"(slo {slo_ttft_ms}ms, held={row['slo_held']}), "
+              f"preempted={row['preempted']} "
+              f"shed={row['shed']} expired={row['expired']} "
+              f"goodput={row['goodput_tok_per_sec']} tok/s",
+              file=sys.stderr)
+        return {"overload": row}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        ms.close()
 
 
 def bench_prefix_cache(model, variables, model_name: str, vocab: int):
@@ -612,7 +800,8 @@ def main() -> int:
     # stamping it done without the headline A/B measurements.
     if len(r.get("load", [])) < 3 or len(r.get("load_sampled", [])) < 3 \
             or len(r.get("load_spec", [])) < 3 \
-            or "telemetry_overhead" not in r:
+            or "telemetry_overhead" not in r \
+            or "overload" not in r:
         row["partial"] = True
     print(json.dumps(row))
     with open(RESULTS, "a") as f:

@@ -104,6 +104,21 @@ def spec_mix_value(r):
     return f"{v}x" + (f" (acc {rate})" if rate is not None else "")
 
 
+def overload_value(r):
+    """serving-load rows: the OVERLOAD leg's headline — interactive
+    TTFT p99 vs its SLO target (held or blown) and how much batch
+    traffic was shed to hold it.  Empty for every other bench."""
+    ov = r.get("overload") or {}
+    p99 = ov.get("interactive_ttft_p99_ms")
+    if p99 is None:
+        return ""
+    held = "held" if ov.get("slo_held") else "BLOWN"
+    shed = (ov.get("shed") or {}).get("batch", 0) \
+        + (ov.get("expired") or {}).get("batch", 0)
+    return (f"p99 {p99}ms/{ov.get('slo_ttft_ms')}ms {held}, "
+            f"batch shed {shed}")
+
+
 def telemetry_value(r):
     """serving-load rows: the telemetry-overhead A/B column — the
     tracing-on tax in % agg tok/s (contract: <= ~3%).  Empty for
@@ -122,8 +137,8 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | telemetry | mfu | age |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|")
+          "| spec-mix | telemetry | overload | mfu | age |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -141,6 +156,7 @@ def main() -> int:
               f"| {v if v is not None else ''} | {unit} "
               f"| {spec_mix_value(r)} "
               f"| {telemetry_value(r)} "
+              f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0
 

@@ -30,9 +30,16 @@ bitten (or would bite) this codebase:
              ``block_until_ready`` that serializes the decode loop.
              Explicit ``jax.device_get(...)`` is the sanctioned
              spelling.
-- EXC-SWALLOW ``except Exception: pass`` (body is ONLY ``pass``)
-             drops errors on the floor; best-effort teardown must say
-             so in the baseline, everything else must at least log.
+- JIT-DEADLINE no ``time.*`` calls AT ALL inside jitted programs:
+             lifecycle control (deadline/cancel/preempt decisions)
+             is host-side scheduling — a deadline comparison traced
+             into a step program evaluates once and never fires
+             again.  Broader than JIT-PURITY's clock list on
+             purpose; the two share one jitted-body collector.
+- EXC-SWALLOW ``except Exception: pass`` (body is ONLY ``pass`` /
+             ``continue``) drops errors on the floor; best-effort
+             teardown must say so in the baseline, everything else
+             must at least log.
 
 Suppression: ``# ptpu: ignore[RULE-A,RULE-B]`` on the flagged line or
 the line directly above silences those rules for that line;
@@ -335,12 +342,49 @@ class LockHoldRule(Rule):
                 # get: signature (block=True, timeout=None) — only
                 # the blocking forms count (q.get(), q.get(True),
                 # block=True); d.get(key[, default]) never matches.
+                # (acquire shares the (blocking, timeout) shape but
+                # has its own check: see _unbounded_acquire.)
                 if len(node.args) >= 2 and \
                         not self._none_const(node.args[1]):
                     return False
                 blocking = (not node.args and "block" not in kw) \
                     or (node.args and self._true_const(node.args[0])) \
                     or self._true_const(kw.get("block"))
+                return bool(blocking)
+
+            @staticmethod
+            def _neg_num_const(a) -> bool:
+                """A literal negative number (parses as USub over a
+                Constant): acquire's spelled-out block-forever."""
+                if isinstance(a, ast.UnaryOp) \
+                        and isinstance(a.op, ast.USub) \
+                        and isinstance(a.operand, ast.Constant):
+                    v = a.operand.value
+                    return isinstance(v, (int, float)) \
+                        and not isinstance(v, bool)
+                return False
+
+            def _unbounded_acquire(self, node: ast.Call) -> bool:
+                """Lock.acquire(blocking=True, timeout=-1): blocking
+                with no timeout.  ``acquire(False)`` (try-lock) and
+                an explicit non-literal-negative timeout are bounded
+                — but ``timeout=-1`` (or ``acquire(True, -1)``) is
+                the stdlib's SPELLED-OUT block-forever and stays
+                flagged; a variable timeout gets the benefit of the
+                doubt like the rest of the rule."""
+                kw = {k.arg: k.value for k in node.keywords}
+                if "timeout" in kw:
+                    t = kw["timeout"]
+                    return self._none_const(t) \
+                        or self._neg_num_const(t)
+                if len(node.args) >= 2:
+                    t = node.args[1]
+                    return self._none_const(t) \
+                        or self._neg_num_const(t)
+                blocking = (not node.args and "blocking" not in kw) \
+                    or (node.args
+                        and self._true_const(node.args[0])) \
+                    or self._true_const(kw.get("blocking"))
                 return bool(blocking)
 
             def _check_call(self, node: ast.Call, held: str) -> None:
@@ -353,6 +397,19 @@ class LockHoldRule(Rule):
                         isinstance(node.func, ast.Attribute) and \
                         self._untimed(node, tail):
                     msg = f"untimed .{tail}() while holding"
+                elif tail == "acquire" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        _LOCK_NAME.search(
+                            (dotted_name(node.func.value) or "")
+                            .rsplit(".", 1)[-1]) and \
+                        self._unbounded_acquire(node):
+                    # Nested blocking lock acquisition under a held
+                    # lock is the lock-order-inversion seed the
+                    # cancellation/eviction paths must never plant:
+                    # `with a_lock: b_lock.acquire()` deadlocks
+                    # against any thread doing the reverse.
+                    msg = "untimed nested lock .acquire() while " \
+                          "holding"
                 elif tail == "block_until_ready" and \
                         isinstance(node.func, ast.Attribute) and \
                         dotted_name(node.func.value) not in ("jax",):
@@ -389,6 +446,79 @@ def _is_jax_jit(node: ast.AST) -> bool:
     return dotted_name(node) in ("jax.jit", "jit")
 
 
+def _collect_jitted(tree: ast.Module):
+    """Every jit-wrapped body in a module: decorated defs,
+    ``jax.jit(lambda ...)``, and ``jax.jit(fn_name)`` with the name
+    resolved LEXICALLY (scope chain from the call site — without
+    this, ``jax.jit(step)`` inside a builder method resolves to an
+    unrelated same-named METHOD elsewhere in the module and flags
+    code that never traces).  Returns ``(jitted_bodies, jit_calls)``:
+    ``jitted_bodies`` is ``[(body node, label)]`` deduplicated,
+    ``jit_calls`` is ``[(jit Call node, resolved def or None)]`` for
+    call-site checks (static_argnums hashability).  Shared by
+    JIT-PURITY and JIT-DEADLINE so the two rules can never disagree
+    about what "inside a jitted program" means."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for p in ast.walk(tree):
+        for c in ast.iter_child_nodes(p):
+            parents[c] = p
+    scopes: Dict[ast.AST, Dict[str, ast.FunctionDef]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            s = parents.get(n)
+            while s is not None and not isinstance(
+                    s, (ast.Module, ast.FunctionDef,
+                        ast.AsyncFunctionDef, ast.ClassDef)):
+                s = parents.get(s)
+            scopes.setdefault(s, {})[n.name] = n
+
+    def resolve(call: ast.AST, name: str):
+        """Innermost def named ``name`` visible from ``call``."""
+        s = parents.get(call)
+        while s is not None:
+            if isinstance(s, (ast.Module, ast.FunctionDef,
+                              ast.AsyncFunctionDef, ast.ClassDef)):
+                d = scopes.get(s, {}).get(name)
+                if d is not None:
+                    return d
+            s = parents.get(s)
+        return None
+
+    jitted_bodies: List[Tuple[ast.AST, str]] = []
+    jit_calls: List[Tuple[ast.Call, Optional[ast.FunctionDef]]] = []
+    seen: Set[int] = set()
+
+    def add(node, label):
+        if id(node) not in seen:
+            seen.add(id(node))
+            jitted_bodies.append((node, label))
+
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if _is_jax_jit(dec):
+                    add(n, n.name)
+                elif isinstance(dec, ast.Call) and (
+                        _is_jax_jit(dec.func)
+                        or (dotted_name(dec.func) or "").endswith(
+                            "partial")
+                        and dec.args
+                        and _is_jax_jit(dec.args[0])):
+                    add(n, n.name)
+        elif isinstance(n, ast.Call) and _is_jax_jit(n.func):
+            fn = None
+            if n.args:
+                target = n.args[0]
+                if isinstance(target, ast.Lambda):
+                    add(target, "<lambda>")
+                elif isinstance(target, ast.Name):
+                    fn = resolve(n, target.id)
+                    if fn is not None:
+                        add(fn, target.id)
+            jit_calls.append((n, fn))
+    return jitted_bodies, jit_calls
+
+
 class JitPurityRule(Rule):
     """No trace-time impurity inside jitted functions.
 
@@ -406,69 +536,10 @@ class JitPurityRule(Rule):
 
     def check(self, tree, lines, relpath):
         findings: List[Finding] = []
-        # Lexically-scoped def resolution for ``jax.jit(fn_name)``:
-        # scope node (Module/FunctionDef/ClassDef) -> {name: def}.
-        # Without this, ``jax.jit(step)`` inside a builder method
-        # resolves to an unrelated same-named METHOD elsewhere in the
-        # module and flags code that never traces.
-        parents: Dict[ast.AST, ast.AST] = {}
-        for p in ast.walk(tree):
-            for c in ast.iter_child_nodes(p):
-                parents[c] = p
-        scopes: Dict[ast.AST, Dict[str, ast.FunctionDef]] = {}
-        for n in ast.walk(tree):
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                s = parents.get(n)
-                while s is not None and not isinstance(
-                        s, (ast.Module, ast.FunctionDef,
-                            ast.AsyncFunctionDef, ast.ClassDef)):
-                    s = parents.get(s)
-                scopes.setdefault(s, {})[n.name] = n
-
-        def resolve(call: ast.AST, name: str):
-            """Innermost def named ``name`` visible from ``call``."""
-            s = parents.get(call)
-            while s is not None:
-                if isinstance(s, (ast.Module, ast.FunctionDef,
-                                  ast.AsyncFunctionDef, ast.ClassDef)):
-                    d = scopes.get(s, {}).get(name)
-                    if d is not None:
-                        return d
-                s = parents.get(s)
-            return None
-
-        jitted_bodies: List[Tuple[ast.AST, str]] = []
-        seen: Set[int] = set()
-
-        def add(node, label):
-            if id(node) not in seen:
-                seen.add(id(node))
-                jitted_bodies.append((node, label))
-
-        for n in ast.walk(tree):
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in n.decorator_list:
-                    if _is_jax_jit(dec):
-                        add(n, n.name)
-                    elif isinstance(dec, ast.Call) and (
-                            _is_jax_jit(dec.func)
-                            or (dotted_name(dec.func) or "").endswith(
-                                "partial")
-                            and dec.args
-                            and _is_jax_jit(dec.args[0])):
-                        add(n, n.name)
-            elif isinstance(n, ast.Call) and _is_jax_jit(n.func):
-                fn = None
-                if n.args:
-                    target = n.args[0]
-                    if isinstance(target, ast.Lambda):
-                        add(target, "<lambda>")
-                    elif isinstance(target, ast.Name):
-                        fn = resolve(n, target.id)
-                        if fn is not None:
-                            add(fn, target.id)
-                self._check_static_args(n, fn, lines, relpath,
-                                        findings)
+        jitted_bodies, jit_calls = _collect_jitted(tree)
+        for call, fn in jit_calls:
+            self._check_static_args(call, fn, lines, relpath,
+                                    findings)
 
         for body, label in jitted_bodies:
             for node in ast.walk(body):
@@ -522,6 +593,48 @@ class JitPurityRule(Rule):
                     f"{type(default).__name__.lower()} literal — "
                     f"static_argnums/static_argnames targets must be "
                     f"hashable by construction"))
+
+
+# -- JIT-DEADLINE -----------------------------------------------------------
+
+
+class DeadlineInJitRule(Rule):
+    """Lifecycle control stays HOST-SIDE: no ``time.*`` deadline math
+    inside a jit-wrapped step program.
+
+    The request-lifecycle layer (serving/engine.py sweep) delivers
+    cancellation, deadline expiry, and preemption at step boundaries
+    by comparing host wall-clock against per-group deadlines.  Any
+    ``time.*`` call inside a jitted function — not just the clocks
+    JIT-PURITY flags, but ALL of the module (``time_ns``,
+    ``monotonic_ns``, ``sleep``, ``strftime`` ...) — executes once at
+    trace time and freezes into the compiled program: a deadline
+    comparison there would evaluate exactly once and never fire
+    again, silently turning "evict at the boundary" into "immortal".
+    This is the Podracer decoupled-dataflow discipline
+    (arXiv:2104.06272): scheduling decisions on the host, pure math
+    on the device."""
+
+    id = "JIT-DEADLINE"
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        jitted_bodies, _ = _collect_jitted(tree)
+        for body, label in jitted_bodies:
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name.startswith("time."):
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno, label,
+                        _src_line(lines, node.lineno),
+                        f"{name}() inside a jitted program: deadline/"
+                        f"lifecycle math is host-side scheduling — "
+                        f"it freezes at trace time in a compiled "
+                        f"step, so a deadline check here would "
+                        f"evaluate once and never fire again"))
+        return findings
 
 
 # -- HOST-SYNC --------------------------------------------------------------
@@ -593,10 +706,14 @@ class HostSyncRule(Rule):
 
 
 class ExcSwallowRule(Rule):
-    """``except Exception: pass`` (body is only ``pass``) silently
-    drops errors.  Best-effort teardown belongs in the committed
-    baseline with a justification; everything else must at least log
-    at debug level so a broken subsystem is diagnosable."""
+    """``except Exception: pass`` — or ``continue`` — (body is only
+    control flow) silently drops errors.  The ``continue`` form is
+    the loop-sweep variant the request-lifecycle paths invite: an
+    eviction/cancellation sweep that swallows per-item errors and
+    moves on leaks the very slots it exists to reclaim, invisibly.
+    Best-effort teardown belongs in the committed baseline with a
+    justification; everything else must at least log at debug level
+    so a broken subsystem is diagnosable."""
 
     id = "EXC-SWALLOW"
 
@@ -607,13 +724,17 @@ class ExcSwallowRule(Rule):
         class V(_ScopedVisitor):
             def visit_ExceptHandler(self, node):
                 if self._broad(node.type) and all(
-                        isinstance(s, ast.Pass) for s in node.body):
+                        isinstance(s, (ast.Pass, ast.Continue))
+                        for s in node.body):
+                    what = "continue" if any(
+                        isinstance(s, ast.Continue)
+                        for s in node.body) else "pass"
                     findings.append(Finding(
                         rule.id, relpath, node.lineno, self.func,
                         _src_line(lines, node.lineno),
-                        "except-and-pass drops the error without a "
-                        "trace; log it (debug level is enough) or "
-                        "baseline it as best-effort teardown"))
+                        f"except-and-{what} drops the error without "
+                        f"a trace; log it (debug level is enough) or "
+                        f"baseline it as best-effort teardown"))
                 self.generic_visit(node)
 
             @staticmethod
@@ -630,6 +751,6 @@ class ExcSwallowRule(Rule):
 
 
 ALL_RULES: Tuple[Rule, ...] = (RngDetRule(), LockHoldRule(),
-                               JitPurityRule(), HostSyncRule(),
-                               ExcSwallowRule())
+                               JitPurityRule(), DeadlineInJitRule(),
+                               HostSyncRule(), ExcSwallowRule())
 RULE_IDS: Tuple[str, ...] = tuple(r.id for r in ALL_RULES)

@@ -453,6 +453,39 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Max decode steps fused per device dispatch when "
                    "no admission could happen sooner (the engine "
                    "drops to single steps under admission pressure).")
+@click.option("--default-priority", default="interactive",
+              type=click.Choice(["interactive", "batch"]),
+              help="Priority class for requests that don't declare "
+                   "one ({\"priority\": ...}): interactive drains "
+                   "ahead of batch, and batch decodes are "
+                   "preemptible under --slo-ttft-ms.")
+@click.option("--batch-queue-depth", default=None, type=int,
+              help="Admission-queue bound (rows) for the BATCH "
+                   "class (default: --queue-depth; the interactive "
+                   "class always uses --queue-depth).")
+@click.option("--queue-deadline-ms", default=None, type=int,
+              help="Shed an INTERACTIVE request (503 + reason "
+                   "queue_deadline) that got zero engine attention "
+                   "for this long — it could not start before its "
+                   "deadline, so don't let it rot in the queue.")
+@click.option("--batch-queue-deadline-ms", default=None, type=int,
+              help="Same shed deadline for the BATCH class queue.")
+@click.option("--slo-ttft-ms", default=None, type=int,
+              help="Interactive TTFT SLO target: when the "
+                   "interactive class's admission-anchored TTFT p99 "
+                   "(or the waiting head's own age) degrades past "
+                   "this, the scheduler preempts the longest batch "
+                   "decode and requeues it with its "
+                   "generated-so-far prefix (token-identical "
+                   "resume). Unset = never preempt.")
+@click.option("--request-timeout", default=600.0, type=float,
+              help="Bounded front-end wait (seconds) for "
+                   "engine-path requests: one with no terminal "
+                   "state after this long is shed with 503 + reason "
+                   "request_timeout instead of holding its HTTP "
+                   "worker until engine drain. Solo/coalesce paths "
+                   "bound waits via deadline checks at their "
+                   "dispatch boundaries.")
 @click.option("--draft-model", "--spec-draft", "draft_model",
               default=None,
               help="Zoo model enabling SPECULATIVE requests "
@@ -495,6 +528,8 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
+          default_priority, batch_queue_depth, queue_deadline_ms,
+          batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
           trace_file, profile_dir, access_log, sanitize,
           sanitize_max_hold, cpu):
@@ -517,6 +552,15 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     a request's tokens depend on its (seed, token index) only, never
     on what else shares the pool — so responses are reproducible
     under any concurrency.  Beam/speculative requests decode solo.
+
+    Requests are cancellable, deadline-bearing, and prioritized
+    (docs/SERVING.md "Request lifecycle"): client disconnects and
+    {"deadline_ms": N} expiries evict their slots at the next step
+    boundary; {"priority": "interactive"|"batch"} picks the class
+    queue; --slo-ttft-ms arms batch preemption with token-identical
+    resume; per-class queue deadlines shed unstartable requests with
+    503; and POST /drain stops admission, finishes in-flight work,
+    and turns /healthz readiness off.
     """
     import jax
 
@@ -535,6 +579,14 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     if sanitize_max_hold is not None and not sanitize:
         raise click.ClickException(
             "--sanitize-max-hold requires --sanitize")
+    for name, v in (("--queue-deadline-ms", queue_deadline_ms),
+                    ("--batch-queue-deadline-ms",
+                     batch_queue_deadline_ms),
+                    ("--slo-ttft-ms", slo_ttft_ms)):
+        if v is not None and v < 1:
+            raise click.ClickException(f"{name} must be >= 1")
+    if request_timeout is not None and request_timeout <= 0:
+        raise click.ClickException("--request-timeout must be > 0")
     try:
         # Shared validation with the server/library (_check_spec_k):
         # one message for a bad --spec-k on every surface.
@@ -559,6 +611,16 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                      n_slots=n_slots, queue_depth=queue_depth,
                      prefill_chunk=prefill_chunk,
                      decode_window=decode_window,
+                     default_priority=default_priority,
+                     batch_queue_depth=batch_queue_depth,
+                     queue_deadline_s=queue_deadline_ms / 1e3
+                     if queue_deadline_ms is not None else None,
+                     batch_queue_deadline_s=batch_queue_deadline_ms
+                     / 1e3 if batch_queue_deadline_ms is not None
+                     else None,
+                     slo_ttft_s=slo_ttft_ms / 1e3
+                     if slo_ttft_ms is not None else None,
+                     request_timeout_s=request_timeout,
                      prefix_cache=prefix_cache,
                      draft_model=draft, draft_variables=draft_vars,
                      spec_k=spec_k,

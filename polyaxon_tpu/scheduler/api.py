@@ -197,8 +197,15 @@ class ControlPlane:
                             f"(threshold {int(threshold_s)}s)")
                 if ok:
                     swept.append(record["uuid"])
-            except Exception:  # a deleted/corrupt run must not end the
-                continue      # sweep (or the daemon calling it)
+            except Exception:
+                # A deleted/corrupt run must not end the sweep (or
+                # the daemon calling it) — but a sweep that skips
+                # silently would also hide a broken store forever.
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "zombie sweep skipped a run", exc_info=True)
+                continue
         return swept
 
     # -- streams --------------------------------------------------------

@@ -24,8 +24,9 @@ ModelServer, make_server``.
 """
 
 from .engine import DecodeEngine
-from .scheduler import (QueueFullError, SamplingSpec,
-                        SchedulerPolicy)
+from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
+                        RequestCancelled, SamplingSpec,
+                        SchedulerPolicy, ShedError)
 from .server import ModelServer, make_server
 from .slots import SlotKVManager
 from .telemetry import (Histogram, ProfileSession, Telemetry,
@@ -33,5 +34,6 @@ from .telemetry import (Histogram, ProfileSession, Telemetry,
 
 __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "SchedulerPolicy", "SamplingSpec", "SlotKVManager",
-           "QueueFullError", "Telemetry", "Histogram",
+           "QueueFullError", "RequestCancelled", "DeadlineExceeded",
+           "ShedError", "PRIORITIES", "Telemetry", "Histogram",
            "ProfileSession", "render_histogram"]

@@ -57,8 +57,10 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ._lru import lru_get
-from .scheduler import (AdmissionQueue, QueueFullError, RequestGroup,
-                        SamplingSpec, SchedulerPolicy, Stream)
+from .scheduler import (AdmissionQueue, DeadlineExceeded, PRIORITIES,
+                        QueueFullError, RequestCancelled,
+                        RequestGroup, SamplingSpec, SchedulerPolicy,
+                        ShedError, Stream, terminal_status)
 from .slots import SlotKVManager
 from .telemetry import Histogram, Telemetry
 
@@ -160,6 +162,43 @@ class DecodeEngine:
         self.spec_drafted_total = 0
         self.spec_accepted_total = 0
         self.spec_accept = Histogram(SPEC_ACCEPT_BUCKETS)
+        # Request-lifecycle counters (one bump per terminal REQUEST,
+        # not per stream) + the per-class admission split.  Mostly
+        # mutated by the sweep/preemption machinery on the engine
+        # thread; the SHED counters are also bumped from submitter
+        # threads (the draining gate), so those go under _shed_lock —
+        # /metrics reads everything unlocked like the rest.
+        self._shed_lock = threading.Lock()
+        self.cancelled_total = 0
+        self.expired_total = 0
+        self.shed_total = 0
+        self.shed_by_class = {p: 0 for p in PRIORITIES}
+        self.preempted_total = 0
+        self.resumed_total = 0
+        self.admitted_by_class = {p: 0 for p in PRIORITIES}
+        # Preemption control signal: a SLIDING WINDOW of the most
+        # recent interactive admission-anchored TTFTs (the same
+        # observations the exported ttft_interactive histogram gets).
+        # The controller reads p99 over THIS window, not the
+        # cumulative histogram — lifetime bucket counts never decay,
+        # so one bad period would otherwise latch aggressive batch
+        # preemption until process restart.
+        from collections import deque
+        self._ttft_recent: "deque[float]" = deque(maxlen=64)
+        # Sweep fast path: the boundary sweep scans residents + the
+        # whole queue, which is pure waste for deployments that never
+        # touch the lifecycle features.  ``_cancel_pending`` is set
+        # by cancel() and consumed by the next sweep;
+        # ``_deadline_armed`` goes (and stays) True once ANY
+        # deadline-bearing request has been submitted — sticky on
+        # purpose: a deployment using deadlines pays the sweep as the
+        # feature's cost, one that never does skips it entirely.
+        self._cancel_pending = False
+        self._deadline_armed = False
+        # Draining: stop ADMITTING new requests (submit sheds with
+        # 503), finish everything already accepted — the /drain
+        # endpoint's engine half.  One-way per engine lifetime.
+        self.draining = False
 
     # -- submission (any thread) ----------------------------------------
 
@@ -167,7 +206,9 @@ class DecodeEngine:
                eos_id: Optional[int], prefill_chunk: Optional[int],
                *, sampling: Optional[SamplingSpec] = None,
                prefix=None, on_prefilled=None,
-               record_timings: bool = False) -> RequestGroup:
+               record_timings: bool = False,
+               priority: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> RequestGroup:
         """Enqueue a request (may raise QueueFullError) and make sure
         the loop is running.  Returns the group; callers block on
         ``group.event``.  ``sampling`` carries the per-request
@@ -186,7 +227,35 @@ class DecodeEngine:
 
         ``sampling.spec_k > 0`` submits a SPECULATIVE request: needs
         the engine's draft model (its prompt prefills through BOTH
-        models), and composes with greedy or sampled accept lanes."""
+        models), and composes with greedy or sampled accept lanes.
+
+        ``priority`` (default: the policy's ``default_priority``)
+        picks the request's class queue — ``interactive`` drains
+        ahead of ``batch``, and batch residents are preemptible under
+        the TTFT SLO.  ``deadline_s`` (relative seconds) arms a
+        deadline: expiry evicts the request at the next step boundary
+        with :class:`DeadlineExceeded`.  A DRAINING engine sheds
+        every new submit with :class:`ShedError` (503)."""
+        if priority is None:
+            priority = self.policy.default_priority
+        if priority not in PRIORITIES:
+            # Validate before the draining gate uses it as a counter
+            # key (RequestGroup would catch it later anyway; a bad
+            # priority must be a ValueError, never a KeyError).
+            raise ValueError(f"priority must be one of {PRIORITIES};"
+                             f" got {priority!r}")
+        if self.draining:
+            # Counted here too: the server's drain gate catches HTTP
+            # traffic, but a library caller (or a request that raced
+            # /drain past the server check) still sheds — and must
+            # still show up in the shed metrics.  Under _shed_lock:
+            # submit runs on arbitrary threads, unlike the sweep.
+            with self._shed_lock:
+                self.shed_total += 1
+                self.shed_by_class[priority] += 1
+            raise ShedError(
+                "engine is draining: finishing in-flight requests, "
+                "admitting none", reason="draining")
         if sampling is not None and sampling.spec_k > 0:
             if self.draft_model is None:
                 raise ValueError(
@@ -204,7 +273,8 @@ class DecodeEngine:
         if prefix is None:
             pieces = self.policy.chunk_plan(rows.shape[1],
                                             prefill_chunk)
-            group = RequestGroup(rows, new, eos_id, pieces, sampling)
+            group = RequestGroup(rows, new, eos_id, pieces, sampling,
+                                 priority=priority)
         else:
             if rows.shape[0] != 1:
                 raise ValueError(
@@ -214,11 +284,15 @@ class DecodeEngine:
             suffix = rows.shape[1] - p_cached
             pieces = self.policy.chunk_plan(suffix, prefill_chunk) \
                 if suffix > 0 else []
-            group = RequestGroup(rows, new, eos_id, pieces, sampling)
+            group = RequestGroup(rows, new, eos_id, pieces, sampling,
+                                 priority=priority)
             stream = group.streams[0]
             stream.filled = p_cached
             stream.logits = logits
             stream.cache = cache
+        if deadline_s is not None:
+            group.deadline = group.t_submit + float(deadline_s)
+            self._deadline_armed = True
         group.on_prefilled = on_prefilled
         group.record_timings = bool(record_timings)
         for stream in group.streams:
@@ -244,6 +318,32 @@ class DecodeEngine:
         if group.error is not None:
             raise group.error
         return group.result()
+
+    def cancel(self, group: RequestGroup,
+               err: Optional[BaseException] = None) -> None:
+        """Request ``group``'s eviction (client disconnect, deadline,
+        front-end give-up).  Callable from any thread; the engine
+        DELIVERS it at its next step boundary — queued streams drop,
+        a mid-prefill stream abandons its partial cache, resident
+        streams free their slots — and the group fails with ``err``
+        (default :class:`RequestCancelled`)."""
+        group.request_cancel(err if err is not None
+                             else RequestCancelled(
+                                 "request cancelled"))
+        # Flag AFTER the cancel is stored: the sweep that sees the
+        # flag is guaranteed to see the cancel_error too.  Then wake
+        # an idle loop so delivery doesn't wait out the idle sleep;
+        # manual-tick owners just call tick().
+        self._cancel_pending = True
+        with self._wake:
+            self._wake.notify()
+
+    def drain(self) -> None:
+        """Stop admission (new submits shed with 503 ``draining``)
+        while every already-accepted request — queued, prefilling, or
+        resident — runs to completion.  The server half turns
+        readiness off so a router stops sending traffic here."""
+        self.draining = True
 
     # -- engine loop ----------------------------------------------------
 
@@ -344,11 +444,16 @@ class DecodeEngine:
     # -- one scheduling round -------------------------------------------
 
     def tick(self) -> bool:
-        """One step boundary: admit/prefill within the policy budget,
-        then one decode step over the resident batch.  Returns whether
-        any work was done.  Single-threaded by contract (loop thread,
-        or tests driving it manually)."""
-        worked = False
+        """One step boundary: deliver pending lifecycle events
+        (cancellations, expired deadlines, queue-deadline sheds),
+        preempt a batch resident if the interactive TTFT SLO demands
+        it, admit/prefill within the policy budget, then one decode
+        step over the resident batch.  Returns whether any work was
+        done.  Single-threaded by contract (loop thread, or tests
+        driving it manually)."""
+        worked = self._sweep_lifecycle()
+        if self._maybe_preempt():
+            worked = True
         budget = self.policy.prefill_budget(bool(self._resident),
                                             self.slots.free_slots)
         while budget > 0:
@@ -367,6 +472,167 @@ class DecodeEngine:
             self._decode_step()
             worked = True
         return worked
+
+    # -- lifecycle: cancel / deadline / shed / preempt -------------------
+
+    def _sweep_lifecycle(self) -> bool:
+        """Deliver, at this step boundary, every pending cancel and
+        expired deadline (resident AND queued streams — a cancelled
+        request frees its slot within ONE boundary, pinned in
+        tests/test_lifecycle.py), and shed queued requests that blew
+        their class queue deadline before getting any engine
+        attention.  Host-side wall-clock only: deadline math never
+        enters a compiled step program (JIT-DEADLINE).
+
+        Fast path: with no cancel pending, no deadline ever armed,
+        and no class queue deadline configured, there is nothing the
+        scan could find — skip the O(resident + queue) walk (and its
+        queue-lock snapshot) on this boundary entirely."""
+        if not (self._cancel_pending or self._deadline_armed
+                or self.policy.queue_deadline_s is not None
+                or self.policy.batch_queue_deadline_s is not None):
+            return False
+        self._cancel_pending = False
+        now = time.perf_counter()
+        handled = set()          # id(group) -> already terminated
+        worked = False
+        for stream in ([s for s in self._resident.values()]
+                       + self.queue.snapshot()):
+            group = stream.group
+            if id(group) in handled or group.error is not None:
+                continue
+            err = group.cancel_error
+            if err is None and group.deadline is not None \
+                    and now > group.deadline:
+                err = DeadlineExceeded(
+                    f"deadline exceeded after "
+                    f"{now - group.t_submit:.3f}s "
+                    f"({group.status_phase()})")
+                group.request_cancel(err)
+            if err is None and group.t_first_prefill is None \
+                    and stream.slot is None:
+                # Zero engine attention so far: the class queue
+                # deadline decides whether it may keep waiting.
+                qd = self.policy.class_queue_deadline(group.priority)
+                if qd is not None and now - group.t_submit > qd:
+                    err = ShedError(
+                        f"{group.priority} request queued "
+                        f"{now - group.t_submit:.3f}s without "
+                        f"starting (class queue deadline {qd}s); "
+                        f"shed unstarted", reason="queue_deadline",
+                        retry_after=self.policy.retry_after_s)
+                    group.request_cancel(err)
+            if err is not None:
+                handled.add(id(group))
+                self._cancel_group(group, err, now)
+                worked = True
+        return worked
+
+    def _cancel_group(self, group: RequestGroup, err: BaseException,
+                      now: float) -> None:
+        """Terminate ``group`` with lifecycle error ``err``: drop its
+        queued streams, evict its residents (slots free THIS
+        boundary), emit the terminal span, bump the right counter,
+        and wake the waiter."""
+        status = terminal_status(err)
+        self.queue.drop_group(group)
+        for slot, stream in list(self._resident.items()):
+            if stream.group is not group:
+                continue
+            del self._resident[slot]
+            self.slots.release(slot)
+            self.evicted_total += 1
+            # Close the decode span at the eviction boundary so the
+            # trace shows exactly how much work the cancel discarded.
+            self._emit(stream, "decode", stream.t_admit, now,
+                       row=stream.row, slot=slot,
+                       tokens=len(stream.out), terminal=status)
+            stream.slot = None
+        for stream in group.streams:
+            self._emit_instant(stream, status, now, row=stream.row,
+                               tokens=len(stream.out))
+        if isinstance(err, ShedError):
+            with self._shed_lock:   # submit's draining gate races us
+                self.shed_total += 1
+                self.shed_by_class[group.priority] += 1
+        elif isinstance(err, DeadlineExceeded):
+            self.expired_total += 1
+        else:
+            self.cancelled_total += 1
+        group.fail(err)
+
+    def _recent_ttft_p99(self) -> Optional[float]:
+        """p99 of the sliding interactive-TTFT window (None until
+        there are observations) — the degraded-class half of the
+        preemption trigger."""
+        if not self._ttft_recent:
+            return None
+        xs = sorted(self._ttft_recent)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+    def _maybe_preempt(self) -> bool:
+        """Preempt ONE batch resident when the interactive class
+        needs its slot: the head of the interactive queue is
+        admit-ready (fully prefilled) with no free slot, and the
+        interactive admission-anchored TTFT — the p99 of the PR 4
+        histogram, or this head's own wait — has degraded past the
+        ``slo_ttft_s`` target.  The victim (the batch resident with
+        the most remaining budget, i.e. the longest expected hold) is
+        evicted through the same path as cancellation and REQUEUED at
+        the front of the batch class with its generated-so-far
+        prefix: resumption is token-identical (Stream.prepare_resume)
+        so preemption costs re-prefill, never correctness."""
+        slo = self.policy.slo_ttft_s
+        if slo is None or self.slots.free_slots > 0:
+            return False
+        head = self.queue.head()
+        if head is None or head.group.priority != "interactive" \
+                or not head.pf_done:
+            return False
+        now = time.perf_counter()
+        waited = now - head.group.t_submit
+        if waited <= slo / 2:
+            # Head-wait trigger acts at HALF the budget: preempting
+            # only once the target is already blown would guarantee
+            # a TTFT past the SLO by the time the admission it buys
+            # lands — a controller has to act with margin.  Under
+            # half-budget, consult the class p99 over the RECENT
+            # window (self._ttft_recent — same observations the
+            # exported ttft_interactive histogram records, but
+            # sliding, so a transient bad period stops arming
+            # preemption once healthy TTFTs wash it out instead of
+            # latching until restart).
+            p99 = self._recent_ttft_p99()
+            if p99 is None or p99 <= slo:
+                return False
+        victim = None
+        for slot, stream in self._resident.items():
+            if stream.group.priority != "batch":
+                continue
+            rem = stream.new - len(stream.out)
+            if victim is None or rem > victim[2]:
+                victim = (slot, stream, rem)
+        if victim is None:
+            return False        # all residents interactive: defer only
+        slot, stream, _ = victim
+        del self._resident[slot]
+        self.slots.release(slot)
+        self.evicted_total += 1
+        self.preempted_total += 1
+        self._emit(stream, "decode", stream.t_admit, now,
+                   row=stream.row, slot=slot, tokens=len(stream.out),
+                   terminal="preempted")
+        self._emit_instant(stream, "preempted", now, row=stream.row,
+                           slot=slot, tokens=len(stream.out))
+        # pow2 pieces, not chunk_plan: the resume length is
+        # data-dependent (prompt + commits at the preemption point),
+        # so one-piece prefill would be a fresh compile per
+        # preemption — pow2 decomposition keeps the resume program
+        # set bounded and steady-state quiet.
+        stream.prepare_resume(SchedulerPolicy.pow2_pieces(
+            stream.p_len + len(stream.out) - 1))
+        self.queue.requeue_front(stream)
+        return True
 
     def run_until_idle(self, max_ticks: int = 100000) -> None:
         """Drain queue + slots synchronously (tests/offline use)."""
@@ -461,7 +727,12 @@ class DecodeEngine:
                        stream.t_prefill_start, row=stream.row)
         if stream.pieces:               # full-length prefix hits skip
             piece = stream.pieces[0]
-            toks = stream.toks[:, stream.filled:stream.filled + piece]
+            # pf_toks, not toks: a PREEMPTED stream re-prefills
+            # prompt ++ committed[:-1] (Stream.prepare_resume) so its
+            # resumption is token-identical; for everyone else the
+            # two are the same array.
+            toks = stream.pf_toks[:, stream.filled:stream.filled
+                                  + piece]
             spec = stream.sampling.spec_k > 0
             t_piece = time.perf_counter()
             try:
@@ -499,7 +770,10 @@ class DecodeEngine:
                 return                  # more prompt to consume
         if not stream.pf_done:
             stream.pf_done = True
-            if group.on_prefilled is not None:
+            # Never on a resumed stream: its pf_toks mix generated
+            # tokens into the prefill, which must not be stored back
+            # as a prompt prefix.
+            if group.on_prefilled is not None and not stream.resume:
                 try:
                     group.on_prefilled(stream)
                 except Exception:
@@ -511,7 +785,10 @@ class DecodeEngine:
                         "on_prefilled hook failed", exc_info=True)
         if self.slots.free_slots == 0:
             return          # wait, fully prefilled, for an eviction
-        self.queue.pop_head()
+        # Pop THIS stream, never "the head": a concurrent interactive
+        # submit can change the class-aware head between the tick's
+        # head() and this pop (scheduler.AdmissionQueue.pop_stream).
+        self.queue.pop_stream(stream)
         self._admit(stream)
 
     def _first_token(self, stream: Stream, logits: np.ndarray) -> int:
@@ -552,37 +829,52 @@ class DecodeEngine:
         (including the FIRST insert's lazy stacked-pool allocation —
         the engine's largest device buy) release the slot and fail
         the group: a waiter must never hang on an admission that
-        silently died."""
+        silently died.
+
+        A RESUMED (preempted) stream skips token sampling entirely —
+        all its committed tokens already exist — and re-enters its
+        slot feeding ``out[-1]`` at its original position with
+        ``next_index == len(out)``, so the next draw uses exactly the
+        position key the uninterrupted run would have."""
         import jax
 
         slot = self.slots.acquire()
         assert slot is not None, "admission without a free slot"
         spec = stream.sampling
-        try:
-            logits = np.asarray(jax.device_get(stream.logits))[0]
-            first = self._first_token(stream, logits)
-        except BaseException as e:
-            self.slots.release(slot)
-            self._fail_group(stream.group, e)
-            return
-        stream.out.append(first)
+        resumed = stream.resume
+        if not resumed:
+            try:
+                logits = np.asarray(jax.device_get(stream.logits))[0]
+                first = self._first_token(stream, logits)
+            except BaseException as e:
+                self.slots.release(slot)
+                self._fail_group(stream.group, e)
+                return
+            stream.out.append(first)
         stream.t_admit = time.perf_counter()
         stream.group.t_last_admit = stream.t_admit
         if stream.group.t_first_admit is None:
             # First token of the whole request exists NOW (sampled
-            # from the prefill logits) — the TTFT anchor.
+            # from the prefill logits) — the TTFT anchor, observed
+            # into the request's CLASS histogram (the preemption
+            # control signal, docs/SERVING.md).
             stream.group.t_first_admit = stream.t_admit
+            ttft = stream.t_admit - stream.group.t_submit
+            self.tel.observe("ttft_" + stream.group.priority, ttft)
+            if stream.group.priority == "interactive":
+                self._ttft_recent.append(ttft)
         self._emit_instant(stream, "admit", stream.t_admit,
-                           row=stream.row, slot=slot)
+                           row=stream.row, slot=slot,
+                           **({"resumed": True} if resumed else {}))
         stream.logits = None
-        if stream.done():               # new == 1, or instant eos
+        if not resumed and stream.done():   # new == 1, or instant eos
             stream.cache = None
             stream.d_cache = None
             self.slots.release(slot)
             stream.slot = slot          # zero-length decode span
             self._complete(stream)      # still keys the slot id
             stream.slot = None
-            self._count_admitted(spec)
+            self._count_admitted(spec, stream.group.priority)
             self.evicted_total += 1
             return
         if spec.speculative and stream.base_key is None:
@@ -597,9 +889,15 @@ class DecodeEngine:
                                    stream.row)))
         try:
             with self.device_lock:
+                # Uniform across fresh and resumed admissions: feed
+                # the LAST committed token at its absolute position
+                # (fresh: token 0 at p_len), and draw token
+                # ``len(out)`` next.
                 self.slots.insert(
-                    slot, stream.cache, first, stream.p_len,
-                    base_key=stream.base_key, next_index=1,
+                    slot, stream.cache, stream.out[-1],
+                    stream.p_len + len(stream.out) - 1,
+                    base_key=stream.base_key,
+                    next_index=len(stream.out),
                     temperature=spec.temperature, top_k=spec.top_k,
                     top_p=spec.top_p, draft_cache=stream.d_cache,
                     spec_k=spec.spec_k)
@@ -611,10 +909,16 @@ class DecodeEngine:
         stream.d_cache = None
         stream.slot = slot
         self._resident[slot] = stream
-        self._count_admitted(spec)
+        if resumed:
+            stream.resume = False
+            self.resumed_total += 1
+        else:
+            self._count_admitted(spec, stream.group.priority)
 
-    def _count_admitted(self, spec: SamplingSpec) -> None:
+    def _count_admitted(self, spec: SamplingSpec,
+                        priority: str) -> None:
         self.admitted_total += 1
+        self.admitted_by_class[priority] += 1
         if spec.speculative:
             self.admitted_spec_total += 1
         elif spec.sampled:
@@ -647,7 +951,19 @@ class DecodeEngine:
                 not head.pf_done
                 or self.slots.free_slots > 0
                 or any(s.eos_id is not None
-                       for s in self._resident.values())):
+                       for s in self._resident.values())
+                # An armed TTFT SLO makes every boundary a potential
+                # preemption point while an interactive request
+                # waits: fusing would delay it by the whole window.
+                or (self.policy.slo_ttft_s is not None
+                    and head.group.priority == "interactive")):
+            return 1
+        if any(s.group.deadline is not None
+               for s in self._resident.values()):
+            # Deadlines are delivered at boundaries only; fusing
+            # past one would hold a dead request's slot for the
+            # window tail.  Cancels can land at any moment, so only
+            # actually-armed deadlines (cheap to check) cost fusion.
             return 1
         # Budget horizon in ROUNDS, advance-aware: a speculative slot
         # may commit up to spec_k tokens per round, so fusing
@@ -850,6 +1166,26 @@ class DecodeEngine:
             "completed_sampled_total": self.completed_sampled_total,
             "completed_spec_total": self.completed_spec_total,
             "rejected_total": self.queue.rejected,
+            # Request lifecycle: terminal-status counters, the
+            # preempt/resume pair (equal in steady state — every
+            # preempted stream resumes unless its group dies first),
+            # per-class admission split + queue depths, and the
+            # drain latch.
+            "cancelled_total": self.cancelled_total,
+            "expired_total": self.expired_total,
+            "shed_total": self.shed_total,
+            "shed_interactive_total":
+                self.shed_by_class["interactive"],
+            "shed_batch_total": self.shed_by_class["batch"],
+            "preempted_total": self.preempted_total,
+            "resumed_total": self.resumed_total,
+            "admitted_interactive_total":
+                self.admitted_by_class["interactive"],
+            "admitted_batch_total": self.admitted_by_class["batch"],
+            "queue_len_interactive":
+                self.queue.class_len("interactive"),
+            "queue_len_batch": self.queue.class_len("batch"),
+            "draining": self.draining,
             # Speculative scheduling + the per-request acceptance-rate
             # histogram (per-bucket counts, upper bounds in
             # spec_accept_buckets; /metrics cumulates them via

@@ -16,9 +16,12 @@ same traffic to record the before/after.  Select with
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from .scheduler import DeadlineExceeded
 
 
 class _Pending:
@@ -123,8 +126,20 @@ class RequestCoalescer:
                     it.error = e
                     it.event.set()
 
+    def _pull_pending(self, ckey, item) -> None:
+        """Remove a still-queued item from its shape queue (deadline
+        shed, or the broken-invariant bailout) so no later leader
+        executes work nobody is waiting for."""
+        with self._pending_lock:
+            q = self._pending.get(ckey)
+            if q and item in q:
+                q.remove(item)
+                if not q:
+                    self._pending.pop(ckey, None)
+
     def generate(self, toks: np.ndarray, p_len: int, new: int, eos,
-                 chunk) -> np.ndarray:
+                 chunk, deadline: Optional[float] = None
+                 ) -> np.ndarray:
         """Queue a greedy request; lead merged batches until ours is
         done.  Leader election is just lock acquisition: whoever gets
         the device lock drains and executes; everyone else's request
@@ -132,6 +147,14 @@ class RequestCoalescer:
         released) or still queued for the next leader — so inside the
         lock, an unset event implies our item is drainable and every
         drain makes progress.
+
+        ``deadline`` (absolute perf_counter, or None) is honored at
+        the only boundary this path has: after the lock is acquired,
+        before dispatching a batch.  An expired still-pending item is
+        pulled and shed instead of joining a merged decode it no
+        longer wants; one already executed by an earlier leader
+        delivers its (late) result — finished device work is never
+        discarded.
         """
         ckey = (p_len, eos, chunk)  # new excluded: lengths merge
         item = _Pending(toks, new)
@@ -139,6 +162,14 @@ class RequestCoalescer:
             self._pending.setdefault(ckey, []).append(item)
         with self.ms._lock:
             while not item.event.is_set():
+                if deadline is not None \
+                        and time.perf_counter() > deadline:
+                    self._pull_pending(ckey, item)
+                    if not item.event.is_set():
+                        raise DeadlineExceeded(
+                            "deadline exceeded waiting for the "
+                            "coalesced dispatch")
+                    break
                 batch = self._drain(ckey)
                 if not batch:
                     # Invariant broken (e.g. max_batch shrunk below a
@@ -146,12 +177,7 @@ class RequestCoalescer:
                     # loudly instead of waiting forever — and pull the
                     # orphaned item so no later leader runs it after
                     # this request has already errored out.
-                    with self._pending_lock:
-                        q = self._pending.get(ckey)
-                        if q and item in q:
-                            q.remove(item)
-                            if not q:
-                                self._pending.pop(ckey, None)
+                    self._pull_pending(ckey, item)
                     if not item.event.is_set():
                         raise RuntimeError(
                             "coalescing invariant broken: queued "

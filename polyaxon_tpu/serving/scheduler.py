@@ -15,15 +15,36 @@ the passive pieces of that design:
   top_p) every stream carries into its slot; temperature 0 is greedy,
   and sampled streams draw under the position-keyed RNG contract
   (models/generate), so tokens never depend on the schedule.
-- :class:`AdmissionQueue` — the bounded FIFO between the HTTP
-  front-end and the engine.  Submission is all-or-nothing per request;
-  a full queue raises :class:`QueueFullError`, which the front-end
-  maps to 429 + Retry-After (explicit backpressure instead of an
-  unbounded thread pile-up).
-- :class:`SchedulerPolicy` — the knobs: slot count, queue depth, the
-  default prefill chunk, and how much prefill work may run per decode
-  boundary (1 chunk while decodes are active — prefill must never
-  starve the running batch — bursting only when the batch is idle).
+- :class:`AdmissionQueue` — the bounded, PER-PRIORITY-CLASS FIFO
+  between the HTTP front-end and the engine.  Submission is
+  all-or-nothing per request; a full class queue raises
+  :class:`QueueFullError`, which the front-end maps to 429 +
+  Retry-After (explicit backpressure instead of an unbounded thread
+  pile-up).  The engine pops class-aware: ``interactive`` ahead of
+  ``batch`` — the "defer" half of preempt-or-defer.
+- :class:`SchedulerPolicy` — the knobs: slot count, per-class queue
+  depths and queue deadlines, the default prefill chunk, how much
+  prefill work may run per decode boundary (1 chunk while decodes are
+  active — prefill must never starve the running batch — bursting
+  only when the batch is idle), and the interactive-TTFT SLO target
+  that arms batch preemption.
+
+REQUEST LIFECYCLE (the robustness layer): every request is a
+first-class cancellable, deadline-bearing, prioritized object.  A
+group carries an optional absolute ``deadline`` and a cancel request
+(:meth:`RequestGroup.request_cancel`, set from any thread); the
+engine DELIVERS both at step boundaries only — lifecycle control is
+host-side scheduling, never part of a compiled step program (the
+Podracer decoupled-dataflow split, arXiv:2104.06272; machine-checked
+by the JIT-DEADLINE rule in analysis/rules.py).  Terminal statuses:
+
+    queued -> prefill -> decoding -> complete
+                 |           |-----> cancelled   (client went away)
+                 |           |-----> expired     (deadline passed)
+                 |           `-----> preempted -> requeued (resumes
+                 |                   with its generated-so-far prefix)
+                 `---------> shed    (cannot start before its class
+                                      queue deadline, or draining)
 """
 
 from __future__ import annotations
@@ -31,7 +52,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -88,6 +109,11 @@ class SamplingSpec:
 
 GREEDY = SamplingSpec()
 
+# Priority classes, highest first: the admission queue pops
+# ``interactive`` ahead of ``batch``, and only ``batch`` residents are
+# preemptible when the interactive TTFT SLO degrades.
+PRIORITIES = ("interactive", "batch")
+
 
 class QueueFullError(RuntimeError):
     """Admission queue at capacity: the front-end returns 429 with
@@ -97,6 +123,50 @@ class QueueFullError(RuntimeError):
     def __init__(self, msg: str, retry_after: int = 1):
         super().__init__(msg)
         self.retry_after = int(retry_after)
+
+
+class RequestCancelled(RuntimeError):
+    """Terminal status ``cancelled``: the client went away (or an
+    in-process caller cancelled the group).  The engine evicts the
+    request's slots at the next step boundary; the front-end maps
+    this to 499 (client closed request — nobody is listening)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Terminal status ``expired``: the request's deadline passed
+    before it completed.  Delivered at a step boundary like a cancel
+    (partial work is discarded, the slot frees); the front-end maps
+    this to 504."""
+
+
+class ShedError(RuntimeError):
+    """Terminal status ``shed``: graceful overload — the request was
+    refused or dropped WITHOUT being started (its class queue
+    deadline passed before any engine attention, the server is
+    draining, or a bounded front-end wait gave up on a wedged
+    engine).  Maps to 503 with a structured machine-readable
+    ``reason`` so clients and load balancers can tell shed classes
+    apart."""
+
+    def __init__(self, msg: str, reason: str = "overload",
+                 retry_after: Optional[int] = None):
+        super().__init__(msg)
+        self.reason = str(reason)
+        self.retry_after = retry_after
+
+
+def terminal_status(err: Optional[BaseException]) -> str:
+    """Map a terminal error to the request's lifecycle status name
+    (the ``status`` field on RequestGroup, span names, counters)."""
+    if err is None:
+        return "complete"
+    if isinstance(err, ShedError):
+        return "shed"
+    if isinstance(err, DeadlineExceeded):
+        return "expired"
+    if isinstance(err, RequestCancelled):
+        return "cancelled"
+    return "failed"
 
 
 class SchedulerPolicy:
@@ -122,7 +192,12 @@ class SchedulerPolicy:
     def __init__(self, *, n_slots: int = 8, queue_depth: int = 64,
                  prefill_chunk: Optional[int] = None,
                  idle_prefill_burst: int = 4, decode_window: int = 8,
-                 retry_after_s: int = 1):
+                 retry_after_s: int = 1,
+                 default_priority: str = "interactive",
+                 batch_queue_depth: Optional[int] = None,
+                 queue_deadline_s: Optional[float] = None,
+                 batch_queue_deadline_s: Optional[float] = None,
+                 slo_ttft_s: Optional[float] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1; got {n_slots}")
         if queue_depth < 1:
@@ -134,12 +209,46 @@ class SchedulerPolicy:
         if decode_window < 1:
             raise ValueError(
                 f"decode_window must be >= 1; got {decode_window}")
+        if default_priority not in PRIORITIES:
+            raise ValueError(
+                f"default_priority must be one of {PRIORITIES}; "
+                f"got {default_priority!r}")
+        if batch_queue_depth is not None and batch_queue_depth < 1:
+            raise ValueError(f"batch_queue_depth must be >= 1; got "
+                             f"{batch_queue_depth}")
+        for name, v in (("queue_deadline_s", queue_deadline_s),
+                        ("batch_queue_deadline_s",
+                         batch_queue_deadline_s),
+                        ("slo_ttft_s", slo_ttft_s)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0; got {v}")
         self.n_slots = int(n_slots)
         self.queue_depth = int(queue_depth)
         self.prefill_chunk = prefill_chunk
         self.idle_prefill_burst = max(1, int(idle_prefill_burst))
         self.decode_window = int(decode_window)
         self.retry_after_s = int(retry_after_s)
+        # Lifecycle knobs: the default priority class for requests
+        # that don't declare one; per-class queue depth (batch
+        # defaults to the interactive depth) and queue DEADLINES (a
+        # queued request with zero engine attention past its class
+        # deadline is shed with 503 instead of rotting); and the
+        # interactive-TTFT SLO target arming batch preemption
+        # (engine._maybe_preempt — None disables preemption).
+        self.default_priority = default_priority
+        self.batch_queue_depth = int(batch_queue_depth) \
+            if batch_queue_depth is not None else self.queue_depth
+        self.queue_deadline_s = queue_deadline_s
+        self.batch_queue_deadline_s = batch_queue_deadline_s
+        self.slo_ttft_s = slo_ttft_s
+
+    def class_queue_depth(self, priority: str) -> int:
+        return self.batch_queue_depth if priority == "batch" \
+            else self.queue_depth
+
+    def class_queue_deadline(self, priority: str) -> Optional[float]:
+        return self.batch_queue_deadline_s if priority == "batch" \
+            else self.queue_deadline_s
 
     def prefill_budget(self, decodes_active: bool,
                        free_slots: int = 1) -> int:
@@ -152,6 +261,30 @@ class SchedulerPolicy:
         if not decodes_active:
             return max(self.idle_prefill_burst, free_slots)
         return max(1, free_slots)
+
+    @staticmethod
+    def pow2_pieces(n: int) -> List[int]:
+        """Split ``n`` prefill tokens into DESCENDING power-of-two
+        pieces (binary decomposition: 39 -> [32, 4, 2, 1]).  Used for
+        preemption-resume re-prefill, whose total length varies with
+        the (data-dependent) preemption point: naive one-piece
+        prefill would compile a fresh program per preempted request
+        forever, where pow2 pieces bound the shape set to
+        ~log2(max_position) programs that go warm after the first few
+        preemptions — the zero-steady-state-recompile contract held
+        on the resume path (pinned in tests/test_lifecycle.py).
+        Chunked prefill is position-keyed cache extension, so the
+        split changes compile keys, never tokens."""
+        pieces: List[int] = []
+        if n <= 0:
+            return pieces
+        b = 1 << (n.bit_length() - 1)
+        while n:
+            if n >= b:
+                pieces.append(b)
+                n -= b
+            b >>= 1
+        return pieces
 
     def chunk_plan(self, p_len: int, req_chunk: Optional[int]
                    ) -> List[int]:
@@ -174,7 +307,8 @@ class Stream:
                  "base_key", "pieces", "filled", "cache", "logits",
                  "out", "slot", "pf_done", "t_prefill_start",
                  "t_admit", "t_done", "d_cache", "spec_rounds",
-                 "spec_drafted", "spec_accepted", "sid", "events")
+                 "spec_drafted", "spec_accepted", "sid", "events",
+                 "pf_toks", "resume")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -183,6 +317,12 @@ class Stream:
         self.group = group
         self.row = row
         self.toks = toks          # [1, p_len] int32
+        # What prefill actually consumes: the prompt, or — after a
+        # preemption — prompt ++ committed-tokens[:-1] (prepare_resume
+        # below).  ``toks`` stays the prompt: results and prefix-cache
+        # keys never see resume state.
+        self.pf_toks = toks
+        self.resume = False       # re-prefilling after a preemption
         self.new = new
         self.eos_id = eos_id
         self.sampling = sampling or GREEDY
@@ -217,6 +357,36 @@ class Stream:
     def p_len(self) -> int:
         return self.toks.shape[1]
 
+    def prepare_resume(self, pieces: List[int]) -> None:
+        """Reset this PREEMPTED stream for re-prefill + re-admission
+        with its generated-so-far prefix, so no token is resampled.
+
+        The cache is rebuilt by prefilling ``prompt ++ out[:-1]`` (the
+        chunked-prefill exactness contract: prefill of the true
+        committed prefix equals having decoded it incrementally, per
+        model — the draft cache included for speculative streams);
+        re-admission then feeds ``out[-1]`` at its original position
+        with ``next_index == len(out)``, so token ``len(out)`` is
+        drawn with exactly the position key the uninterrupted run
+        would have used.  Token-identical resumption is what makes
+        preemption safe under the RNG determinism contract (pinned in
+        tests/test_lifecycle.py across plain/sampled/spec)."""
+        assert self.out, "preempted stream with no committed tokens"
+        self.resume = True
+        if len(self.out) > 1:
+            self.pf_toks = np.concatenate(
+                [self.toks,
+                 np.asarray([self.out[:-1]], np.int32)], axis=1)
+        else:
+            self.pf_toks = self.toks
+        self.pieces = pieces
+        self.filled = 0
+        self.pf_done = False
+        self.cache = None
+        self.d_cache = None
+        self.logits = None
+        self.slot = None
+
     def done(self) -> bool:
         if len(self.out) >= self.new:
             return True
@@ -240,12 +410,30 @@ class RequestGroup:
 
     def __init__(self, rows: np.ndarray, new: int,
                  eos_id: Optional[int], pieces_per_row: List[int],
-                 sampling: Optional[SamplingSpec] = None):
+                 sampling: Optional[SamplingSpec] = None, *,
+                 priority: str = "interactive"):
         self.rows = rows
         self.new = new
         self.sampling = sampling or GREEDY
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}; "
+                             f"got {priority!r}")
+        self.priority = priority
+        # Absolute perf_counter deadline (None = immortal), armed by
+        # engine.submit RELATIVE to t_submit (there is deliberately
+        # no constructor path: every deadline shares that one
+        # convention).  Checked at step boundaries by the engine
+        # sweep and by the front-end wait loop — never inside a
+        # compiled step program.
+        self.deadline: Optional[float] = None
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
+        # Lifecycle: a cancel/deadline/shed request lands here from
+        # ANY thread (request_cancel); the engine delivers it — evict
+        # slots, drop queue entries, fail the group — at its next
+        # step boundary.  ``status`` is the terminal state name.
+        self.cancel_error: Optional[BaseException] = None
+        self.status = "active"
         # Called (with the stream) on the engine thread the moment a
         # stream's prompt is fully prefilled, before slot admission —
         # the prefix cache's store-back hook (server._store_stream_
@@ -273,13 +461,35 @@ class RequestGroup:
         self._pending -= 1
         if self._pending == 0:
             self.t_done = time.perf_counter()
+            self.status = "complete"
             self.event.set()
 
     def fail(self, err: BaseException) -> None:
         if not self.event.is_set():
             self.error = err
             self.t_done = time.perf_counter()
+            self.status = terminal_status(err)
             self.event.set()
+
+    def request_cancel(self, err: BaseException) -> None:
+        """Ask for this group's eviction at the next step boundary
+        (idempotent; the first reason wins).  Safe from any thread —
+        a single reference store the engine thread reads.  Callers
+        outside the engine go through :meth:`DecodeEngine.cancel`,
+        which also arms the sweep's fast-path flag — a bare
+        request_cancel is only guaranteed delivery when something
+        else (a deadline, a queue deadline) keeps the sweep on."""
+        if self.cancel_error is None and not self.event.is_set():
+            self.cancel_error = err
+
+    def status_phase(self) -> str:
+        """Where this request is in its lifecycle right now — for
+        error messages and the cancelled/expired span args."""
+        if self.t_first_admit is not None:
+            return "decoding"
+        if self.t_first_prefill is not None:
+            return "prefilling"
+        return "queued"
 
     def result(self) -> np.ndarray:
         return np.stack(self.results, axis=0)
@@ -296,54 +506,104 @@ class RequestGroup:
 
 
 class AdmissionQueue:
-    """Bounded FIFO of streams awaiting prefill + a slot.
+    """Bounded PER-CLASS FIFO of streams awaiting prefill + a slot.
 
     ``submit`` is atomic per request (all B streams or none) so a
     multi-row request can never deadlock half-admitted against the
-    depth bound.  The engine pops from the head only (FIFO — no
-    reordering policy yet; the policy hook is SchedulerPolicy).
+    depth bound, and lands in its group's PRIORITY class queue with
+    that class's own depth bound.  ``head``/``pop_head`` are
+    class-aware — ``interactive`` drains before ``batch`` (the
+    "defer" half of preempt-or-defer; within one class, FIFO).
+    ``requeue_front`` puts a PREEMPTED stream back at the head of its
+    class, bypassing the depth bound (it was already admitted once —
+    requeueing must never shed it).
     """
 
     def __init__(self, policy: SchedulerPolicy):
         self.policy = policy
-        self._q: "deque[Stream]" = deque()
+        self._q: Dict[str, "deque[Stream]"] = {
+            p: deque() for p in PRIORITIES}
         self._lock = threading.Lock()
         self.rejected = 0
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._q)
+            return sum(len(q) for q in self._q.values())
+
+    def class_len(self, priority: str) -> int:
+        with self._lock:
+            return len(self._q[priority])
 
     def submit(self, group: RequestGroup) -> None:
         n = len(group.streams)
-        if n > self.policy.queue_depth:
-            # Usage error, not backpressure: a request wider than the
-            # whole queue can never be admitted even when idle, so a
-            # retryable 429 would have a well-behaved client retry
-            # forever.  ValueError maps to 400 at the HTTP layer.
+        cls = group.priority
+        depth = self.policy.class_queue_depth(cls)
+        if n > depth:
+            # Usage error, not backpressure: a request wider than its
+            # whole class queue can never be admitted even when idle,
+            # so a retryable 429 would have a well-behaved client
+            # retry forever.  ValueError maps to 400 at the HTTP
+            # layer.
             raise ValueError(
-                f"request has {n} rows but the admission queue holds "
-                f"{self.policy.queue_depth}; raise --queue-depth or "
-                f"split the batch")
+                f"request has {n} rows but the {cls} admission queue "
+                f"holds {depth}; raise --queue-depth or split the "
+                f"batch")
         with self._lock:
-            if len(self._q) + n > self.policy.queue_depth:
+            if len(self._q[cls]) + n > depth:
                 self.rejected += 1
                 raise QueueFullError(
-                    f"admission queue full ({len(self._q)}/"
-                    f"{self.policy.queue_depth} rows waiting); retry "
-                    f"after {self.policy.retry_after_s}s",
+                    f"{cls} admission queue full ({len(self._q[cls])}"
+                    f"/{depth} rows waiting); retry after "
+                    f"{self.policy.retry_after_s}s",
                     retry_after=self.policy.retry_after_s)
-            self._q.extend(group.streams)
+            self._q[cls].extend(group.streams)
 
     def head(self) -> Optional[Stream]:
         with self._lock:
-            return self._q[0] if self._q else None
+            for p in PRIORITIES:
+                if self._q[p]:
+                    return self._q[p][0]
+            return None
 
     def pop_head(self) -> Optional[Stream]:
         with self._lock:
-            return self._q.popleft() if self._q else None
+            for p in PRIORITIES:
+                if self._q[p]:
+                    return self._q[p].popleft()
+            return None
+
+    def pop_stream(self, stream: Stream) -> bool:
+        """Remove EXACTLY ``stream`` (admission pops the stream it
+        prefilled, not "whatever is head now").  With one FIFO the
+        two were interchangeable; with class-aware popping, an
+        interactive submit landing between the engine's ``head()``
+        and its pop would CHANGE the head — popping blind would drop
+        the newcomer on the floor and leave the admitted stream
+        queued for a second, state-corrupting admission."""
+        with self._lock:
+            q = self._q[stream.group.priority]
+            if q and q[0] is stream:
+                q.popleft()
+                return True
+            try:
+                q.remove(stream)
+                return True
+            except ValueError:
+                return False
+
+    def requeue_front(self, stream: Stream) -> None:
+        with self._lock:
+            self._q[stream.group.priority].appendleft(stream)
+
+    def snapshot(self) -> List[Stream]:
+        """Every queued stream, pop order — the lifecycle sweep's
+        read-only view (cancel/deadline/shed checks)."""
+        with self._lock:
+            return [s for p in PRIORITIES for s in self._q[p]]
 
     def drop_group(self, group: RequestGroup) -> None:
         """Remove a failed group's still-queued streams."""
         with self._lock:
-            self._q = deque(s for s in self._q if s.group is not group)
+            q = self._q[group.priority]
+            self._q[group.priority] = deque(
+                s for s in q if s.group is not group)

@@ -71,6 +71,8 @@ back to solo are counted per kind in /info's routing report.
 from __future__ import annotations
 
 import json
+import select
+import socket
 import sys
 import threading
 import time
@@ -82,7 +84,9 @@ import numpy as np
 from ._lru import lru_get
 from .engine import DecodeEngine
 from .legacy import RequestCoalescer
-from .scheduler import QueueFullError, SamplingSpec, SchedulerPolicy
+from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
+                        RequestCancelled, SamplingSpec,
+                        SchedulerPolicy, ShedError)
 from .telemetry import (ProfileSession, Telemetry,
                         render_compile_cache, render_histogram)
 
@@ -151,6 +155,12 @@ class ModelServer:
                  n_slots: int = 8, queue_depth: int = 64,
                  prefill_chunk: Optional[int] = None,
                  decode_window: int = 8,
+                 default_priority: str = "interactive",
+                 batch_queue_depth: Optional[int] = None,
+                 queue_deadline_s: Optional[float] = None,
+                 batch_queue_deadline_s: Optional[float] = None,
+                 slo_ttft_s: Optional[float] = None,
+                 request_timeout_s: Optional[float] = 600.0,
                  prefix_cache: int = 4,
                  draft_model=None, draft_variables=None,
                  spec_k: int = 4,
@@ -230,6 +240,23 @@ class ModelServer:
         self.model_name = model_name
         self.max_batch = int(max_batch)
         self.extra_info = info or {}
+        # Request lifecycle: the default priority class for requests
+        # that don't declare one (validated by SchedulerPolicy below
+        # even in engine-less modes), the bounded front-end wait cap
+        # (None = unbounded — NOT the default: a wedged engine must
+        # shed its waiters, never collect HTTP workers forever), and
+        # the drain latch (/drain flips it; /healthz reports 503).
+        if default_priority not in PRIORITIES:
+            raise ValueError(
+                f"default_priority must be one of {PRIORITIES}; "
+                f"got {default_priority!r}")
+        self.default_priority = default_priority
+        if request_timeout_s is not None and request_timeout_s <= 0:
+            raise ValueError(f"request_timeout_s must be > 0; got "
+                             f"{request_timeout_s}")
+        self.request_timeout_s = request_timeout_s
+        self.draining = False
+        self.drain_rejected = 0     # 503s shed at the drain gate
         self._lock = threading.Lock() if self.sanitizer is None \
             else self.sanitizer.wrap("device_lock")
         # LRU-bounded: the key includes client-controlled sampling
@@ -257,7 +284,12 @@ class ModelServer:
                 policy=SchedulerPolicy(
                     n_slots=n_slots, queue_depth=queue_depth,
                     prefill_chunk=prefill_chunk,
-                    decode_window=decode_window),
+                    decode_window=decode_window,
+                    default_priority=default_priority,
+                    batch_queue_depth=batch_queue_depth,
+                    queue_deadline_s=queue_deadline_s,
+                    batch_queue_deadline_s=batch_queue_deadline_s,
+                    slo_ttft_s=slo_ttft_s),
                 device_lock=self._lock,
                 # Engine streams are single-row; share the server's
                 # compile cache so a prompt length prefilled via
@@ -323,6 +355,86 @@ class ModelServer:
             self.engine.close()
         if self.profiler is not None:
             self.profiler.close()
+
+    # -- request lifecycle ----------------------------------------------
+
+    def drain(self) -> Dict[str, Any]:
+        """POST /drain: stop admitting (every path — engine, solo,
+        coalesce — sheds new requests with 503 ``draining``), let
+        in-flight work finish, and turn /healthz readiness off so a
+        router/load-balancer stops sending traffic here.  Idempotent;
+        returns the in-flight snapshot so an orchestrator can poll
+        until it hits zero before killing the process."""
+        self.draining = True
+        if self.engine is not None:
+            self.engine.drain()
+        return self.drain_status()
+
+    def drain_status(self) -> Dict[str, Any]:
+        es = self.engine.stats() if self.engine is not None else {}
+        return {"draining": self.draining,
+                "drain_rejected": self.drain_rejected,
+                "slots_active": es.get("slots_active", 0),
+                "queue_len": es.get("queue_len", 0)}
+
+    def _check_not_draining(self) -> None:
+        if self.draining:
+            # Counted HERE (the shed happens at validation, before
+            # the engine ever sees the request) so /metrics shows
+            # drain-time 503s instead of staying flat while the
+            # access log streams them.
+            with self._stats_lock:
+                self.drain_rejected += 1
+            raise ShedError(
+                "server is draining: finishing in-flight requests, "
+                "admitting none", reason="draining")
+
+    def _wait_group(self, group, cancel_check=None) -> None:
+        """Bounded wait for an engine group — the front-end half of
+        the lifecycle contract.  Replaces the old untimed
+        ``group.event.wait()``, which held an HTTP worker until
+        engine drain if the engine ever wedged.  Three give-up paths,
+        all delivered to the engine as a boundary cancel first:
+
+        - ``cancel_check`` (client-disconnect probe) fires ->
+          :class:`RequestCancelled` (499; nobody is listening);
+        - the request's own deadline passes -> the engine sweep
+          normally delivers :class:`DeadlineExceeded` itself, but a
+          front-end check backstops a wedged engine;
+        - ``request_timeout_s`` elapses with no terminal state ->
+          :class:`ShedError` (503 ``request_timeout``).
+
+        Raising without waiting for the engine's acknowledgement is
+        safe: the group is flagged, its slots free at the engine's
+        next boundary, and a late completion writes into state nobody
+        reads."""
+        cap = None
+        if self.request_timeout_s is not None:
+            cap = group.t_submit + self.request_timeout_s
+        while not group.event.wait(0.1):
+            now = time.perf_counter()
+            if cancel_check is not None and cancel_check():
+                err = RequestCancelled(
+                    "client disconnected; request cancelled")
+                self.engine.cancel(group, err)
+                raise err
+            if group.deadline is not None and now > group.deadline:
+                err = DeadlineExceeded(
+                    f"deadline exceeded after "
+                    f"{now - group.t_submit:.3f}s "
+                    f"({group.status_phase()})")
+                self.engine.cancel(group, err)
+                raise err
+            if cap is not None and now > cap:
+                err = ShedError(
+                    f"request exceeded the server request timeout "
+                    f"({self.request_timeout_s}s) without reaching a "
+                    f"terminal state; shedding the waiter",
+                    reason="request_timeout")
+                self.engine.cancel(group, err)
+                raise err
+        if group.error is not None:
+            raise group.error
 
     def log_access(self, method: str, path: str, status: int,
                    req, resp, dt: float) -> None:
@@ -543,6 +655,7 @@ class ModelServer:
         """POST /prefill: register a prompt (prefix) in the prefix
         cache — the system-prompt workflow.  Later /generate requests
         whose prompt starts with it skip its prefill entirely."""
+        self._check_not_draining()
         if not self._prefix_enabled:
             raise ValueError(
                 "prefix cache is disabled on this server "
@@ -587,7 +700,7 @@ class ModelServer:
 
     def _generate_prefix_cached(self, toks: np.ndarray, p_len: int,
                                 new: int, temp, top_k, top_p, eos,
-                                chunk, seed, hit):
+                                chunk, seed, hit, deadline=None):
         """Solo decode through the split prefill/continue programs on
         a prefix-cache HIT, paying prefill only for the suffix (which
         is stored back, so sessions grow).  Exact: the split is the
@@ -603,6 +716,15 @@ class ModelServer:
 
         b = toks.shape[0]
         with self._lock:
+            if deadline is not None \
+                    and time.perf_counter() > deadline:
+                # Same contract as the other solo branches: the
+                # split decode is fused dispatches that can't stop
+                # mid-flight, so the deadline is honored up to the
+                # device-lock acquisition.
+                raise DeadlineExceeded(
+                    "deadline exceeded waiting for the device "
+                    "(prefix-cache solo path)")
             _, pc, logits, cache = hit
             if pc < p_len:  # extend with the suffix, store back
                 suffix = toks[:, pc:]
@@ -630,9 +752,14 @@ class ModelServer:
 
     # -- request handling -----------------------------------------------
 
-    def generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
+    def generate(self, req: Dict[str, Any],
+                 cancel_check=None) -> Dict[str, Any]:
         import jax
 
+        # Draining sheds BEFORE validation work: the router already
+        # saw readiness drop; anything still arriving gets the
+        # structured 503 immediately.
+        self._check_not_draining()
         rows = _parse_prompt_rows(req, self.max_batch)
         lens = [len(r) for r in rows]
         _int = _int_param
@@ -692,6 +819,24 @@ class ModelServer:
         want_timings = req.get("timings", False)
         if not isinstance(want_timings, bool):
             raise ValueError("'timings' must be a JSON boolean")
+        # Lifecycle params: the priority class (server default when
+        # absent) and an optional relative deadline in ms — expiry
+        # evicts the request at the next step boundary (504).
+        priority = req.get("priority", self.default_priority)
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {list(PRIORITIES)}; got "
+                f"{priority!r}")
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is not None:
+            try:
+                deadline_ms = _int(deadline_ms)
+            except (TypeError, ValueError):
+                raise ValueError("deadline_ms must be an int")
+            if deadline_ms < 1:
+                raise ValueError("deadline_ms must be >= 1")
+        deadline_s = None if deadline_ms is None \
+            else deadline_ms / 1e3
         if speculative:
             if self.draft_model is None:
                 raise ValueError(
@@ -834,10 +979,9 @@ class ModelServer:
                 toks, new, eos, chunk, sampling=sampling,
                 prefix=(pc, lg, cache),
                 on_prefilled=self._store_stream_prefix,
-                record_timings=want_timings)
-            group.event.wait()
-            if group.error is not None:
-                raise group.error
+                record_timings=want_timings,
+                priority=priority, deadline_s=deadline_s)
+            self._wait_group(group, cancel_check)
             out = group.result()
             breakdown = group.breakdown()
             with self._stats_lock:
@@ -846,7 +990,9 @@ class ModelServer:
         elif prefix_hit is not None:
             out = self._generate_prefix_cached(
                 toks, p_len, new, temp, top_k, top_p, eos, chunk,
-                seed, prefix_hit)
+                seed, prefix_hit,
+                deadline=t0 + deadline_s
+                if deadline_s is not None else None)
             solo_events = self._emit_solo(t0, "prefix_solo",
                                           len(rows))
         elif engine_ok:
@@ -859,17 +1005,22 @@ class ModelServer:
             # May raise QueueFullError -> 429.
             group = self.engine.submit(toks, new, eos, chunk,
                                        sampling=sampling,
-                                       record_timings=want_timings)
-            group.event.wait()
-            if group.error is not None:
-                raise group.error
+                                       record_timings=want_timings,
+                                       priority=priority,
+                                       deadline_s=deadline_s)
+            self._wait_group(group, cancel_check)
             out = group.result()
             breakdown = group.breakdown()
             with self._stats_lock:
                 self.requests += 1
         elif greedy and self._coalescer is not None:
-            out = self._coalescer.generate(toks, p_len, new, eos,
-                                           chunk)
+            # Deadline is honored INSIDE the coalescer, at its one
+            # boundary (post-lock, pre-dispatch) — same contract as
+            # the solo branch's check under the device lock.
+            out = self._coalescer.generate(
+                toks, p_len, new, eos, chunk,
+                deadline=t0 + deadline_s
+                if deadline_s is not None else None)
             # The coalescer's queue wait is its device-lock wait,
             # folded inside generate() — one opaque span, honest
             # about the granularity this path offers.
@@ -912,6 +1063,15 @@ class ModelServer:
                 import jax.random as jrandom
 
                 queue_s = time.perf_counter() - t_lock
+                if deadline_s is not None \
+                        and time.perf_counter() - t0 > deadline_s:
+                    # Solo programs are one fused dispatch — the
+                    # deadline can only be honored BEFORE it (a
+                    # request that expired waiting on the device
+                    # lock sheds without burning device time).
+                    raise DeadlineExceeded(
+                        f"deadline exceeded after {queue_s:.3f}s "
+                        f"waiting for the device (solo path)")
                 fn = self._fn(key)
                 if positional:
                     keys = np.asarray(
@@ -1059,6 +1219,13 @@ class ModelServer:
                 "max_batch": self.max_batch,
                 "batching": self.batching,
                 "spec_k_default": self.spec_k_default,
+                "default_priority": self.default_priority,
+                # Engine-less modes still drain (solo/coalesce paths
+                # shed at validation); the engine passthrough below
+                # overwrites with its own latch, which drain() sets
+                # in the same call.
+                "draining": self.draining,
+                "drain_rejected_total": self.drain_rejected,
                 "routing": routing,
                 "solo_fallbacks": fallbacks,
                 "compile_cache_misses":
@@ -1083,6 +1250,13 @@ class ModelServer:
                     "completed_sampled_total",
                     "completed_spec_total",
                     "rejected_total",
+                    "cancelled_total", "expired_total", "shed_total",
+                    "shed_interactive_total", "shed_batch_total",
+                    "preempted_total", "resumed_total",
+                    "admitted_interactive_total",
+                    "admitted_batch_total",
+                    "queue_len_interactive", "queue_len_batch",
+                    "draining",
                     "spec_rounds_total", "spec_drafted_total",
                     "spec_accepted_total", "spec_accept_buckets",
                     "spec_accept_hist", "spec_accept_sum",
@@ -1141,6 +1315,12 @@ class ModelServer:
             f"ptpu_serving_prefix_hits_total {self.prefix_hits}",
             "# TYPE ptpu_serving_prefix_entries gauge",
             f"ptpu_serving_prefix_entries {len(self._prefix)}",
+            # 503s shed at the drain gate (before the engine sees the
+            # request) — every batching mode has this path, so it is
+            # a server counter, not an engine one.
+            "# TYPE ptpu_serving_drain_rejected_total counter",
+            f"ptpu_serving_drain_rejected_total "
+            f"{self.drain_rejected}",
         ]
         # Recompile sentinel (analysis/recompile.py): ONE counter set
         # across the server/engine/slot program caches, rendered by
@@ -1190,6 +1370,45 @@ class ModelServer:
                 "# TYPE ptpu_serving_completed_spec_total counter",
                 f"ptpu_serving_completed_spec_total "
                 f"{es['completed_spec_total']}",
+                # Request lifecycle: terminal-status counters, the
+                # preempt/resume pair, the per-class splits, and the
+                # drain latch — all from the same engine.stats()
+                # dict /info reports.
+                "# TYPE ptpu_serving_cancelled_total counter",
+                f"ptpu_serving_cancelled_total "
+                f"{es['cancelled_total']}",
+                "# TYPE ptpu_serving_deadline_expired_total counter",
+                f"ptpu_serving_deadline_expired_total "
+                f"{es['expired_total']}",
+                "# TYPE ptpu_serving_shed_total counter",
+                f"ptpu_serving_shed_total {es['shed_total']}",
+                "# TYPE ptpu_serving_shed_interactive_total counter",
+                f"ptpu_serving_shed_interactive_total "
+                f"{es['shed_interactive_total']}",
+                "# TYPE ptpu_serving_shed_batch_total counter",
+                f"ptpu_serving_shed_batch_total "
+                f"{es['shed_batch_total']}",
+                "# TYPE ptpu_serving_preempted_total counter",
+                f"ptpu_serving_preempted_total "
+                f"{es['preempted_total']}",
+                "# TYPE ptpu_serving_resumed_total counter",
+                f"ptpu_serving_resumed_total {es['resumed_total']}",
+                "# TYPE ptpu_serving_admitted_interactive_total "
+                "counter",
+                f"ptpu_serving_admitted_interactive_total "
+                f"{es['admitted_interactive_total']}",
+                "# TYPE ptpu_serving_admitted_batch_total counter",
+                f"ptpu_serving_admitted_batch_total "
+                f"{es['admitted_batch_total']}",
+                "# TYPE ptpu_serving_queue_len_interactive gauge",
+                f"ptpu_serving_queue_len_interactive "
+                f"{es['queue_len_interactive']}",
+                "# TYPE ptpu_serving_queue_len_batch gauge",
+                f"ptpu_serving_queue_len_batch "
+                f"{es['queue_len_batch']}",
+                "# TYPE ptpu_serving_draining gauge",
+                f"ptpu_serving_draining "
+                f"{1 if es['draining'] else 0}",
                 "# TYPE ptpu_serving_evicted_total counter",
                 f"ptpu_serving_evicted_total {es['evicted_total']}",
                 "# TYPE ptpu_serving_decode_steps_total counter",
@@ -1220,6 +1439,40 @@ class ModelServer:
                 es["spec_accept_buckets"], es["spec_accept_hist"],
                 es["spec_accept_sum"], es["spec_accept_count"])
         return "\n".join(lines) + "\n"
+
+
+def _disconnect_probe(conn):
+    """A zero-cost poll for "is the client still there?" used while a
+    handler thread waits on an engine group: after the request body,
+    a well-behaved client sends NOTHING until the response — so a
+    readable socket whose peek returns b"" means the peer closed.
+    (A pipelined second request also reads as readable; its non-empty
+    peek keeps the request alive, which is the conservative side.)
+
+    Known limitation: a client HALF-close (``shutdown(SHUT_WR)``
+    after the body, still reading) is indistinguishable from a full
+    close at this API — its request is cancelled too.  That matches
+    the common async-server convention (an empty read IS "client
+    disconnected"), and half-closing writers mid-request are rare
+    enough that reclaiming the slot wins; a client that wants the
+    response must keep its write side open."""
+    def check() -> bool:
+        try:
+            # poll(), not select(): select is FD_SETSIZE-bound, so
+            # at ~1024+ open fds (many waiting clients) it raises
+            # ValueError for high-numbered connections — which the
+            # except branch would misread as "client gone" and
+            # spuriously cancel live requests.  poll has no fd
+            # limit; ValueError now only means a genuinely closed
+            # socket (fileno() == -1).
+            p = select.poll()
+            p.register(conn.fileno(), select.POLLIN)
+            if not p.poll(0):
+                return False
+            return conn.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True     # probe failed: the socket is gone
+    return check
 
 
 class _ServingHTTPServer(ThreadingHTTPServer):
@@ -1257,8 +1510,16 @@ def make_server(host: str, port: int, ms: ModelServer
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"status": "ok",
-                                 "model": ms.model_name})
+                # Readiness doubles as the router's drain signal: a
+                # draining server answers 503 so load balancers stop
+                # routing here while in-flight work finishes.
+                if ms.draining:
+                    self._send(503, {"status": "draining",
+                                     "model": ms.model_name,
+                                     **ms.drain_status()})
+                else:
+                    self._send(200, {"status": "ok",
+                                     "model": ms.model_name})
             elif self.path == "/info":
                 self._send(200, ms.info())
             elif self.path == "/metrics":
@@ -1309,11 +1570,22 @@ def make_server(host: str, port: int, ms: ModelServer
             if self.path in ("/profile/start", "/profile/stop"):
                 self._do_profile()
                 return
+            if self.path == "/drain":
+                # Stop admission, finish in-flight, readiness off —
+                # idempotent, so an orchestrator can post it again
+                # while polling the in-flight snapshot toward zero.
+                t0 = time.perf_counter()
+                resp = ms.drain()
+                try:
+                    self._send(200, resp)
+                except OSError:
+                    pass
+                ms.log_access("POST", self.path, 200, None, resp,
+                              time.perf_counter() - t0)
+                return
             if self.path not in ("/generate", "/prefill"):
                 self._send(404, {"error": f"no route {self.path}"})
                 return
-            handler = ms.generate if self.path == "/generate" \
-                else ms.prefill_prompt
             # Generate FIRST, send after: a client hanging up while a
             # successful response streams out must not count as a
             # serving error (nor trigger a doomed second send).
@@ -1323,7 +1595,34 @@ def make_server(host: str, port: int, ms: ModelServer
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                code, resp = 200, handler(req)
+                if self.path == "/generate":
+                    # The disconnect probe lets a vanished client's
+                    # request cancel at the next step boundary
+                    # instead of decoding to budget exhaustion.
+                    code, resp = 200, ms.generate(
+                        req,
+                        cancel_check=_disconnect_probe(
+                            self.connection))
+                else:
+                    code, resp = 200, ms.prefill_prompt(req)
+            except ShedError as e:
+                # Graceful overload: 503 with a machine-readable
+                # reason (queue_deadline / draining /
+                # request_timeout) so clients and routers can tell
+                # shed classes apart from hard failures.
+                code = 503
+                resp = {"error": str(e), "reason": e.reason}
+                if e.retry_after:
+                    extra = {"Retry-After": str(e.retry_after)}
+            except DeadlineExceeded as e:
+                code, resp = 504, {"error": str(e),
+                                   "reason": "deadline"}
+            except RequestCancelled as e:
+                # 499 (client closed request): almost always
+                # unsendable — the client is gone — but the access
+                # log line is the point.
+                code, resp = 499, {"error": str(e),
+                                   "reason": "cancelled"}
             except QueueFullError as e:
                 # Explicit backpressure, not an error: the bounded
                 # admission queue is full — shed load with the

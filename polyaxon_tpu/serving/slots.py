@@ -139,7 +139,15 @@ class SlotKVManager:
     def release(self, slot: int) -> None:
         """Evict: the slot is reusable the SAME step — no device work,
         the stale KV is invisible (nothing reads it) until the next
-        insert overwrites it."""
+        insert overwrites it.  EVERY eviction flavor goes through
+        here — eos/budget completion, engine failure, CANCELLATION,
+        deadline expiry, and SLO preemption (engine._cancel_group /
+        _maybe_preempt) — because the safety argument is identical:
+        the dead slot parks at position 0 with zeroed sampling state,
+        its KV is unreachable until an insert overwrites it
+        wholesale, and a preempted request re-enters through insert()
+        with a freshly prefilled cache rather than trusting anything
+        left here."""
         if slot in self._free:
             raise ValueError(f"slot {slot} already free")
         self._free.append(slot)

@@ -153,6 +153,14 @@ HIST_SPECS = (
     ("decode_per_token", "ptpu_serving_decode_per_token_seconds",
      PER_TOKEN_BUCKETS),
     ("ttft", "ptpu_serving_ttft_seconds", LATENCY_BUCKETS),
+    # Per-PRIORITY-CLASS admission-anchored TTFT (observed by the
+    # engine at first admission): the interactive one is the
+    # preempt-or-defer control signal (SchedulerPolicy.slo_ttft_s),
+    # the batch one shows what deferral/preemption costs that class.
+    ("ttft_interactive", "ptpu_serving_ttft_interactive_seconds",
+     LATENCY_BUCKETS),
+    ("ttft_batch", "ptpu_serving_ttft_batch_seconds",
+     LATENCY_BUCKETS),
     ("total", "ptpu_serving_request_latency_seconds",
      LATENCY_BUCKETS),
 )

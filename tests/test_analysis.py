@@ -151,6 +151,25 @@ def test_lock_hold_allows_timed_waits_and_functional_sync():
     assert _rules(src) == []
 
 
+def test_lock_hold_flags_untimed_nested_lock_acquire():
+    """Blocking acquisition of a SECOND lock under a held one is the
+    inversion seed the cancellation/eviction paths must never plant;
+    try-lock and timed forms are bounded, and non-lock .acquire()
+    receivers (the slot pool) are not locks at all."""
+    src = """
+    def cancel(self):
+        with self.device_lock:
+            self._stats_lock.acquire()
+            bad = self._stats_lock.acquire(timeout=-1)   # spelled-
+            bad2 = self._stats_lock.acquire(True, -1)    # out forever
+            ok = self._prefix_lock.acquire(False)      # try-lock
+            ok2 = self._stats_lock.acquire(timeout=1)  # bounded
+            ok3 = self._stats_lock.acquire(timeout=t)  # benefit of
+            slot = self.slots.acquire()                # the doubt
+    """
+    assert _rules(src) == ["LOCK-HOLD"] * 3
+
+
 def test_lock_hold_ignores_nested_defs_and_non_locks():
     src = """
     import time
@@ -185,8 +204,12 @@ def test_jit_purity_flags_trace_time_impurity():
     fn = jax.jit(wrapped)
     lam = jax.jit(lambda x: x * time.perf_counter())
     """
+    # The time.* clock sites are double-covered: JIT-PURITY (baked
+    # trace-time constant) AND JIT-DEADLINE (lifecycle math must stay
+    # host-side) — the np.random site is purity-only.
     assert _rules(src, "polyaxon_tpu/anywhere.py") == \
-        ["JIT-PURITY"] * 3
+        ["JIT-DEADLINE", "JIT-PURITY", "JIT-PURITY",
+         "JIT-DEADLINE", "JIT-PURITY"]
 
 
 def test_jit_purity_static_args_must_be_hashable():
@@ -219,6 +242,50 @@ def test_jit_purity_negative():
     fn = jax.jit(f, static_argnums=(1,))   # int default: hashable
     """
     assert _rules(src, "polyaxon_tpu/anywhere.py") == []
+
+
+# -- JIT-DEADLINE -----------------------------------------------------------
+
+
+def test_jit_deadline_flags_any_time_call_in_jit():
+    """Lifecycle control is host-side: EVERY time.* call inside a
+    jitted program is flagged — including the _ns clocks and sleep,
+    which JIT-PURITY's narrow clock list does not cover."""
+    src = """
+    import time
+    import jax
+
+    def step(cache, tok, deadline):
+        if time.monotonic_ns() > deadline:
+            return tok
+        time.sleep(0.001)
+        return tok + 1
+
+    fn = jax.jit(step)
+    """
+    found = _rules(src, "polyaxon_tpu/anywhere.py")
+    assert found.count("JIT-DEADLINE") == 2
+    # monotonic_ns/sleep are deadline-only findings: JIT-PURITY's
+    # clock list doesn't know them, which is why the rule exists.
+    assert "JIT-PURITY" not in found
+
+
+def test_jit_deadline_host_side_sweep_is_clean():
+    """The engine's actual shape — deadline math on the host, around
+    (never inside) the jitted step — must not be flagged."""
+    src = """
+    import time
+    import jax
+
+    def tick(self):
+        now = time.perf_counter()
+        for group in self.groups:
+            if group.deadline is not None and now > group.deadline:
+                self.evict(group)
+        step = jax.jit(lambda c, t: c + t)
+        return step(self.cache, self.tok)
+    """
+    assert _rules(src, "polyaxon_tpu/serving/enginelike.py") == []
 
 
 # -- HOST-SYNC --------------------------------------------------------------
@@ -271,6 +338,21 @@ def test_exc_swallow_flags_pass_only_handlers():
     """
     assert _rules(src, "polyaxon_tpu/anything.py") == \
         ["EXC-SWALLOW"] * 2
+
+
+def test_exc_swallow_flags_continue_only_handlers():
+    """The loop-sweep variant the lifecycle paths invite: an
+    eviction/cancel sweep that swallows per-item errors with
+    ``continue`` leaks the slots it exists to reclaim."""
+    src = """
+    def sweep(self):
+        for slot, stream in items:
+            try:
+                evict(slot)
+            except Exception:
+                continue
+    """
+    assert _rules(src, "polyaxon_tpu/anything.py") == ["EXC-SWALLOW"]
 
 
 def test_exc_swallow_negative():

@@ -8,7 +8,10 @@ queue, slot leak, broken eviction) fails here in seconds, without
 waiting for the full serving suite."""
 
 import json
+import socket
 import threading
+import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -182,3 +185,88 @@ def test_metrics_expose_phase_breakdown(smoke_server):
         assert k in info, k
     assert metrics["ptpu_serving_queue_seconds_count"] >= 1
     assert metrics["ptpu_serving_decode_seconds_sum"] > 0
+
+
+# The two lifecycle smokes below run LAST (file order is collection
+# order under -p no:randomly): the drain latch is one-way, so no
+# admission-dependent test may follow it.
+
+
+def test_client_disconnect_cancels_and_frees_the_slot(smoke_server):
+    """A vanished client's request cancels at a step boundary and
+    frees its slot — under the lock sanitizer, whose quiet teardown
+    the fixture asserts (no inversion anywhere on the cancel path).
+    """
+    base, ms, _, _ = smoke_server
+    port = int(base.rsplit(":", 1)[1])
+    before = ms.engine.stats()
+    # Raw socket so the close is OUR choice: send a long-budget
+    # request, wait for the engine to own it, vanish.
+    body = json.dumps({"prompt": [3, 1, 4, 1],
+                       "max_new_tokens": 120}).encode()
+    s = socket.create_connection(("127.0.0.1", port))
+    s.sendall(b"POST /generate HTTP/1.1\r\nHost: s\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: " + str(len(body)).encode()
+              + b"\r\n\r\n" + body)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = ms.engine.stats()
+        if st["slots_active"] > 0 or st["queue_len"] > 0:
+            break
+        time.sleep(0.005)
+    s.close()
+    while time.time() < deadline:
+        st = ms.engine.stats()
+        if st["cancelled_total"] > before["cancelled_total"] \
+                and st["slots_active"] == 0:
+            break
+        time.sleep(0.05)
+    st = ms.engine.stats()
+    assert st["cancelled_total"] > before["cancelled_total"]
+    # quiet teardown: no leaked slots, nothing stuck in the queue
+    assert st["slots_active"] == 0
+    assert st["queue_len"] == 0
+
+
+def test_zz_drain_finishes_in_flight_and_flips_readiness(
+        smoke_server):
+    """/drain mid-flight: the in-flight request completes exactly,
+    new admission sheds with the structured 503, and readiness turns
+    off for the router tier.  Runs last — the latch is one-way."""
+    base, ms, model, variables = smoke_server
+    results = {}
+
+    def go():
+        results["r"] = _post(base, {"prompt": [5, 6, 7],
+                                    "max_new_tokens": 24})
+
+    t = threading.Thread(target=go)
+    t.start()
+    deadline = time.time() + 60
+    while time.time() < deadline and \
+            ms.engine.stats()["slots_active"] == 0:
+        time.sleep(0.005)
+    req = urllib.request.Request(base + "/drain", data=b"",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read())["draining"] is True
+    # readiness off -> the router stops sending traffic here
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(base + "/healthz", timeout=30)
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["status"] == "draining"
+    # new work sheds with the machine-readable reason
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, {"prompt": [1, 2], "max_new_tokens": 2})
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["reason"] == "draining"
+    # ...while the in-flight request finishes EXACTLY
+    t.join(timeout=120)
+    assert "r" in results
+    want = np.asarray(generate(
+        model, variables, np.asarray([[5, 6, 7]], np.int32),
+        max_new_tokens=24)).tolist()
+    assert results["r"]["tokens"] == want
+    st = ms.engine.stats()
+    assert st["slots_active"] == 0 and st["queue_len"] == 0
