@@ -119,6 +119,19 @@ def overload_value(r):
             f"batch shed {shed}")
 
 
+def paged_value(r):
+    """serving-load rows: the LONG-TAIL leg's headline — paged vs
+    fixed-lane aggregate tok/s at equal KV memory, with the
+    steady-state resident-occupancy ratio.  Empty for every other
+    bench."""
+    ab = (r.get("longtail") or {}).get("paged_vs_fixed") or {}
+    v = ab.get("tok_per_sec_speedup")
+    if not v:
+        return ""
+    occ = ab.get("occupancy_ratio")
+    return f"{v}x" + (f" (occ {occ}x)" if occ is not None else "")
+
+
 def telemetry_value(r):
     """serving-load rows: the telemetry-overhead A/B column — the
     tracing-on tax in % agg tok/s (contract: <= ~3%).  Empty for
@@ -137,8 +150,8 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | telemetry | overload | mfu | age |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "| spec-mix | paged | telemetry | overload | mfu | age |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -155,6 +168,7 @@ def main() -> int:
               f"| {r.get('backend')}{'/' + ','.join(flags) if flags else ''} "
               f"| {v if v is not None else ''} | {unit} "
               f"| {spec_mix_value(r)} "
+              f"| {paged_value(r)} "
               f"| {telemetry_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")

@@ -88,6 +88,29 @@ def engine_stats(events, strip_buckets: int = 20):
         "tokens_per_step": round(sum(toks) / len(steps), 3),
         "occupancy_strip": strip,
     }
+    # Paged-KV runs: a second strip for PAGE occupancy (pages in use
+    # / pool pages, 0-9 per wall-clock bucket) — the memory-side twin
+    # of the slot strip, so a run's page pressure (and headroom) is
+    # visible without opening Perfetto.
+    pages_total = max((a.get("pages_total", 0) for a in args),
+                      default=0)
+    if pages_total:
+        pbuckets = [[] for _ in range(strip_buckets)]
+        for ev, a in zip(steps, args):
+            if "pages_free" not in a:
+                continue
+            i = min(strip_buckets - 1,
+                    int((ev["ts"] - t_lo) / span_us * strip_buckets))
+            pbuckets[i].append(pages_total - a["pages_free"])
+        used = [pages_total - a["pages_free"] for a in args
+                if "pages_free" in a]
+        out["kv_pages_total"] = pages_total
+        out["mean_pages_used"] = round(sum(used) / max(1, len(used)),
+                                       3)
+        out["page_occupancy_strip"] = "".join(
+            "." if not b else str(min(9, round(
+                9 * (sum(b) / len(b)) / pages_total)))
+            for b in pbuckets)
     kinds = {}
     for a in args:
         kinds[a.get("kind", "?")] = kinds.get(a.get("kind", "?"),
@@ -166,6 +189,10 @@ def main() -> int:
     print(f"mean occupancy {eng['mean_occupancy']} of "
           f"{eng['pool_width']} slots; over time (0-9): "
           f"[{eng['occupancy_strip']}]")
+    if "page_occupancy_strip" in eng:
+        print(f"KV pages: mean {eng['mean_pages_used']} of "
+              f"{eng['kv_pages_total']} in use; over time (0-9): "
+              f"[{eng['page_occupancy_strip']}]")
     cc = s["compiles"]
     if cc is not None:
         print(f"\n## compile cache: {cc['compile_cache_misses']} "

@@ -453,6 +453,22 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Max decode steps fused per device dispatch when "
                    "no admission could happen sooner (the engine "
                    "drops to single steps under admission pressure).")
+@click.option("--kv-paged", is_flag=True, default=False,
+              help="Paged KV cache: slot KV lives in a pool of "
+                   "fixed-size pages with per-slot page tables and "
+                   "copy-on-write shared-prefix pages, so occupancy "
+                   "is bounded by token usage instead of slots x "
+                   "max_position lanes (continuous batching, "
+                   "plain/int8 caches only).")
+@click.option("--kv-page-tokens", default=64, type=int,
+              help="With --kv-paged: positions per KV page "
+                   "(>= 8; smaller pages pack tighter, bigger pages "
+                   "gather/scatter less).")
+@click.option("--kv-pages", default=None, type=int,
+              help="With --kv-paged: page-pool size in pages "
+                   "(default: the fixed-lane footprint, slots x "
+                   "ceil(max_position / page size) — same memory, "
+                   "paged layout).")
 @click.option("--default-priority", default="interactive",
               type=click.Choice(["interactive", "batch"]),
               help="Priority class for requests that don't declare "
@@ -528,6 +544,7 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
+          kv_paged, kv_page_tokens, kv_pages,
           default_priority, batch_queue_depth, queue_deadline_ms,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
@@ -587,6 +604,20 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
             raise click.ClickException(f"{name} must be >= 1")
     if request_timeout is not None and request_timeout <= 0:
         raise click.ClickException("--request-timeout must be > 0")
+    # Paged-KV flag validation: fail fast, before the model build.
+    if kv_page_tokens < 8:
+        raise click.ClickException("--kv-page-tokens must be >= 8")
+    if kv_pages is not None and kv_pages < 1:
+        raise click.ClickException("--kv-pages must be >= 1")
+    if kv_paged and kv_ring:
+        raise click.ClickException(
+            "--kv-paged needs a plain/int8 max_position cache; it "
+            "cannot combine with --kv-ring (the ring is already "
+            "O(window))")
+    if kv_paged and batching != "continuous":
+        raise click.ClickException(
+            "--kv-paged requires --batching continuous (paging is "
+            "the engine's slot storage)")
     try:
         # Shared validation with the server/library (_check_spec_k):
         # one message for a bad --spec-k on every surface.
@@ -611,6 +642,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                      n_slots=n_slots, queue_depth=queue_depth,
                      prefill_chunk=prefill_chunk,
                      decode_window=decode_window,
+                     kv_paged=kv_paged,
+                     kv_page_tokens=kv_page_tokens,
+                     kv_pages=kv_pages,
                      default_priority=default_priority,
                      batch_queue_depth=batch_queue_depth,
                      queue_deadline_s=queue_deadline_ms / 1e3
@@ -633,6 +667,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                               if int8_weights else {}),
                            **({"int8_kv": True} if int8_kv else {}),
                            **({"kv_ring": True} if kv_ring else {}),
+                           **({"kv_page_tokens": kv_page_tokens}
+                              if kv_paged else {}),
                            **({"draft_model": draft_model}
                               if draft_model else {})})
     try:

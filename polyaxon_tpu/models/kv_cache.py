@@ -163,6 +163,17 @@ def append_kv_cache(mod, k, v, max_position: int, window=None,
     keys quantize AFTER rotation, so the stored rounding is the only
     error (<= scale/2 per element).
 
+    CAPACITY contract: ``max_position`` is the CREATION width — an
+    apply that receives an existing cache keeps that cache's own key
+    width (``cached_key.shape[1]``) for the append and the validity
+    mask.  This is what makes the PAGED serving path work: the slot
+    engine materializes a per-request view of only the pages the
+    request owns (a position-contiguous cache narrower than
+    ``max_position`` — see :func:`gather_pages`), and the model
+    attends over exactly that width.  All positions stay ABSOLUTE, so
+    masking, RoPE, and the speculative rollback contract below are
+    unchanged at any width.
+
     Creates ``cached_key``/``cached_value``/``cache_index`` (plus
     ``cached_key_scale``/``cached_value_scale`` when quantized)
     variables in the "cache" collection on ``mod``; returns
@@ -183,17 +194,20 @@ def append_kv_cache(mod, k, v, max_position: int, window=None,
         kq, k_scale, vq, v_scale = k, None, v, None
     ck = mod.variable("cache", "cached_key", jnp.zeros,
                       (b, max_position, h, d), store_dtype)
+    # An existing (possibly paged-view) cache keeps ITS width; only a
+    # fresh creation uses max_position.
+    cap = ck.value.shape[1]
     cv = mod.variable("cache", "cached_value", jnp.zeros,
-                      (b, max_position, h, d), store_dtype)
+                      (b, cap, h, d), store_dtype)
     ck.value = jax.lax.dynamic_update_slice(ck.value, kq,
                                             (0, idx.value, 0, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, vq,
                                             (0, idx.value, 0, 0))
     if quantize:
         cks = mod.variable("cache", "cached_key_scale", jnp.zeros,
-                           (b, max_position, h, 1), jnp.bfloat16)
+                           (b, cap, h, 1), jnp.bfloat16)
         cvs = mod.variable("cache", "cached_value_scale", jnp.zeros,
-                           (b, max_position, h, 1), jnp.bfloat16)
+                           (b, cap, h, 1), jnp.bfloat16)
         cks.value = jax.lax.dynamic_update_slice(
             cks.value, k_scale, (0, idx.value, 0, 0))
         cvs.value = jax.lax.dynamic_update_slice(
@@ -205,8 +219,55 @@ def append_kv_cache(mod, k, v, max_position: int, window=None,
     else:
         k_full, v_full = ck.value, cv.value
     idx.value = idx.value + s
-    keys = jnp.arange(max_position)
-    valid = keys[None, :] <= pos_q[:, None]  # [S, max_position]
+    keys = jnp.arange(cap)
+    valid = keys[None, :] <= pos_q[:, None]  # [S, cap]
     if window is not None:
         valid &= keys[None, :] >= pos_q[:, None] - window
     return k_full, v_full, valid[None, None], pos_q
+
+
+# -- paged storage helpers --------------------------------------------------
+#
+# The serving engine's PAGED KV pool (serving/paged.py) stores every
+# position-indexed cache leaf as fixed-size PAGES of ``page_tokens``
+# positions each — pool leaf shape ``lead + (n_pages, page_tokens) +
+# rest`` where the original leaf was ``lead + (positions,) + rest`` —
+# and per-request page tables map logical position ranges to pool
+# pages.  The helpers below are the two data movements that makes
+# possible; both keep positions CONTIGUOUS inside the materialized
+# view (page i of a table covers absolute positions [i*pt, (i+1)*pt)),
+# so everything above — causal masking, RoPE, chunked prefill, the
+# speculative rollback contract — sees an ordinary (narrower) cache
+# and needs no paged-specific reasoning.
+
+
+def paged_pool_shape(leaf_shape, pos_axis: int, n_pages: int,
+                     page_tokens: int):
+    """Pool-leaf shape for a cache leaf: the position axis splits into
+    ``(n_pages, page_tokens)``."""
+    return (tuple(leaf_shape[:pos_axis]) + (n_pages, page_tokens)
+            + tuple(leaf_shape[pos_axis + 1:]))
+
+
+def gather_pages(pool_leaf, table, pos_axis: int):
+    """Materialize one request's position-contiguous view from the
+    pool: ``table`` [P] (int32 page ids) -> view with position width
+    ``P * page_tokens`` at ``pos_axis``.  A pure gather — the view is
+    a copy, so the model's functional cache update never aliases the
+    shared pool."""
+    v = jnp.take(pool_leaf, table, axis=pos_axis)
+    shape = v.shape
+    return v.reshape(shape[:pos_axis]
+                     + (shape[pos_axis] * shape[pos_axis + 1],)
+                     + shape[pos_axis + 2:])
+
+
+def scatter_pages(pool_leaf, pages, targets, pos_axis: int):
+    """Write ``pages`` (``lead + (n, page_tokens) + rest``) into the
+    pool at page ids ``targets`` [n].  Callers guarantee distinct
+    WRITABLE targets (copy-on-write: a shared page is never a scatter
+    target — redirect to a scratch/trash page instead); duplicate
+    targets are only ever garbage pages whose content is masked by
+    absolute position before any query can admit it."""
+    idx = (slice(None),) * pos_axis + (targets,)
+    return pool_leaf.at[idx].set(pages.astype(pool_leaf.dtype))

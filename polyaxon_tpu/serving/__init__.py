@@ -10,6 +10,13 @@ path moved from request coalescing to continuous batching:
   scheduling over a fixed slot pool.
 - ``slots.py``     — slot-indexed KV memory (stacked per-slot caches,
   the vmapped one-token step program).
+- ``paged.py``     — the PAGED slot KV manager (``kv_paged``): a
+  refcounted pool of fixed-size KV pages with per-slot page tables
+  and copy-on-write shared-prefix pages — same step bodies, storage
+  bounded by token usage instead of slots × max_position.
+- ``radix.py``     — compressed token-trie index behind the prefix
+  cache (O(prompt) longest-match lookup, LRU + scan-resistant cold
+  insertion, page-sharing ancestor lookup).
 - ``scheduler.py`` — admission queue, scheduler policy knobs, request
   and stream state.
 - ``legacy.py``    — the seed request-coalescing path, kept as the
@@ -24,6 +31,8 @@ ModelServer, make_server``.
 """
 
 from .engine import DecodeEngine
+from .paged import PagedSlotKVManager
+from .radix import RadixPrefixIndex
 from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
                         RequestCancelled, SamplingSpec,
                         SchedulerPolicy, ShedError)
@@ -34,6 +43,7 @@ from .telemetry import (Histogram, ProfileSession, Telemetry,
 
 __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "SchedulerPolicy", "SamplingSpec", "SlotKVManager",
+           "PagedSlotKVManager", "RadixPrefixIndex",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "ShedError", "PRIORITIES", "Telemetry", "Histogram",
            "ProfileSession", "render_histogram"]

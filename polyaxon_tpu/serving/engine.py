@@ -57,6 +57,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ._lru import lru_get
+from .paged import PageExhausted
 from .scheduler import (AdmissionQueue, DeadlineExceeded, PRIORITIES,
                         QueueFullError, RequestCancelled,
                         RequestGroup, SamplingSpec, SchedulerPolicy,
@@ -109,11 +110,45 @@ class DecodeEngine:
         # autostart=False: no loop thread — the owner drives tick()
         # manually (deterministic tests, offline batch use).
         self.autostart = bool(autostart)
-        self.slots = SlotKVManager(model, variables,
-                                   self.policy.n_slots,
-                                   draft_model=draft_model,
-                                   draft_variables=draft_variables,
-                                   sentinel=sentinel)
+        # KV storage: the fixed-lane stacked pool (slots.py), or —
+        # policy.kv_paged — the block-table page pool (paged.py):
+        # per-request page reservations instead of max_position
+        # lanes, so occupancy under mixed-length traffic is bounded
+        # by token usage, not by the widest request.
+        self.paged = bool(self.policy.kv_paged)
+        if self.paged:
+            from .paged import PagedSlotKVManager
+
+            max_pos = getattr(getattr(model, "cfg", None),
+                              "max_position", None)
+            if max_pos is None or getattr(
+                    getattr(model, "cfg", None), "kv_cache_ring",
+                    False):
+                raise ValueError(
+                    "kv_paged needs a decoder-only model with a "
+                    "plain/int8 max_position cache (ring caches keep "
+                    "the fixed-lane manager)")
+            self.slots = PagedSlotKVManager(
+                model, variables, self.policy.n_slots,
+                page_tokens=self.policy.kv_page_tokens,
+                n_pages=self.policy.kv_pages,
+                max_position=max_pos,
+                decode_window=self.policy.decode_window,
+                spec_k_cap=self.policy.spec_k_cap,
+                draft_model=draft_model,
+                draft_variables=draft_variables,
+                sentinel=sentinel)
+        else:
+            self.slots = SlotKVManager(model, variables,
+                                       self.policy.n_slots,
+                                       draft_model=draft_model,
+                                       draft_variables=draft_variables,
+                                       sentinel=sentinel)
+        # Optional page-pressure relief hook (paged mode): called
+        # with the page deficit when an admit-ready stream is blocked
+        # on free pages; the server wires it to prefix-cache LRU
+        # eviction so stored-but-idle prefixes yield to live traffic.
+        self.page_reclaim = None
         self.queue = AdmissionQueue(self.policy)
         # streams resident in a slot: slot index -> Stream
         self._resident: Dict[int, Stream] = {}
@@ -173,6 +208,10 @@ class DecodeEngine:
         self.expired_total = 0
         self.shed_total = 0
         self.shed_by_class = {p: 0 for p in PRIORITIES}
+        # Paged-KV shed split: requests whose page budget can never
+        # fit the pool (503 reason kv_pages) — a sizing signal, kept
+        # separate from queue-deadline/draining sheds.
+        self.shed_kv_pages_total = 0
         self.preempted_total = 0
         self.resumed_total = 0
         self.admitted_by_class = {p: 0 for p in PRIORITIES}
@@ -208,7 +247,8 @@ class DecodeEngine:
                prefix=None, on_prefilled=None,
                record_timings: bool = False,
                priority: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> RequestGroup:
+               deadline_s: Optional[float] = None,
+               shared_pages=None) -> RequestGroup:
         """Enqueue a request (may raise QueueFullError) and make sure
         the loop is running.  Returns the group; callers block on
         ``group.event``.  ``sampling`` carries the per-request
@@ -235,7 +275,17 @@ class DecodeEngine:
         the TTFT SLO.  ``deadline_s`` (relative seconds) arms a
         deadline: expiry evicts the request at the next step boundary
         with :class:`DeadlineExceeded`.  A DRAINING engine sheds
-        every new submit with :class:`ShedError` (503)."""
+        every new submit with :class:`ShedError` (503).
+
+        PAGED engines additionally shed (503 ``reason: kv_pages``) a
+        request whose KV budget can NEVER fit the page pool — waiting
+        would deadlock, not resolve — while one that merely doesn't
+        fit RIGHT NOW queues until evictions free pages.
+        ``shared_pages`` (single-row prefix hits only) are PINNED
+        page ids of the stored prefix's full pages: the engine owns
+        the pins from here on, maps them read-only into the stream's
+        table at admission, and releases them on any pre-admission
+        terminal path."""
         if priority is None:
             priority = self.policy.default_priority
         if priority not in PRIORITIES:
@@ -256,6 +306,32 @@ class DecodeEngine:
             raise ShedError(
                 "engine is draining: finishing in-flight requests, "
                 "admitting none", reason="draining")
+        if self.paged:
+            need = self._kv_tokens_needed(rows.shape[1], new)
+            if need > self.slots.capacity_tokens:
+                # Graceful overload, not deadlock: this request can
+                # NEVER fit the pool, so queue-waiting for evictions
+                # would hang it forever.  One that fits the pool but
+                # not the current free set simply waits admit-ready.
+                with self._shed_lock:
+                    self.shed_total += 1
+                    self.shed_by_class[priority] += 1
+                    self.shed_kv_pages_total += 1
+                raise ShedError(
+                    f"request KV budget ({need} tokens/row) exceeds "
+                    f"the page pool ({self.slots.capacity_tokens} "
+                    f"tokens = {self.slots.n_pages} x "
+                    f"{self.slots.page_tokens}-token pages); shrink "
+                    f"the prompt/budget or raise --kv-pages",
+                    reason="kv_pages")
+            if sampling is not None \
+                    and sampling.spec_k > self.policy.spec_k_cap:
+                # Paged co-tenants reserved slack for at most
+                # spec_k_cap-wide verify chunks; a wider resident
+                # would write past their reservations.
+                raise ValueError(
+                    f"spec_k {sampling.spec_k} exceeds the paged "
+                    f"engine's spec_k_cap {self.policy.spec_k_cap}")
         if sampling is not None and sampling.spec_k > 0:
             if self.draft_model is None:
                 raise ValueError(
@@ -290,6 +366,10 @@ class DecodeEngine:
             stream.filled = p_cached
             stream.logits = logits
             stream.cache = cache
+        if shared_pages:
+            # Single-row prefix hits only: the pins ride the stream
+            # until admission transfers them into the slot table.
+            group.streams[0].kv_shared = tuple(shared_pages)
         if deadline_s is not None:
             group.deadline = group.t_submit + float(deadline_s)
             self._deadline_armed = True
@@ -414,6 +494,7 @@ class DecodeEngine:
             stream = self.queue.pop_head()
             if stream is None:
                 break
+            self._release_stream_kv(stream)
             stream.group.fail(err)
 
     def _loop(self) -> None:
@@ -463,8 +544,8 @@ class DecodeEngine:
             if stream.group.error is not None:
                 self.queue.drop_group(stream.group)
                 continue
-            if stream.pf_done and self.slots.free_slots == 0:
-                break       # prefilled, waiting on an eviction
+            if stream.pf_done and not self._can_admit_stream(stream):
+                break       # prefilled, waiting on a slot / pages
             self._advance_prefill(stream)
             worked = True
             budget -= 1
@@ -472,6 +553,77 @@ class DecodeEngine:
             self._decode_step()
             worked = True
         return worked
+
+    # -- paged-KV accounting ---------------------------------------------
+
+    def _kv_tokens_needed(self, p_len: int, new: int) -> int:
+        """A stream's FULL KV reservation: prompt + budget, plus the
+        speculative write slack every paged co-tenant of a
+        spec-capable pool must leave (a spec round's verify chunk
+        writes up to spec_k_cap positions past the last committed
+        token, for every resident)."""
+        slack = self.policy.spec_k_cap \
+            if self.draft_model is not None else 0
+        return p_len + new + slack
+
+    def _admissible_now(self, stream: Stream) -> bool:
+        """Pure check (no reclaim side effects — _pick_window calls
+        this every boundary): a free slot AND, paged, enough free
+        pages for the stream's reservation net of its shared prefix
+        pages."""
+        if self.slots.free_slots == 0:
+            return False
+        if not self.paged:
+            return True
+        return self.slots.can_admit(
+            self._kv_tokens_needed(stream.p_len, stream.new),
+            len(stream.kv_shared or ()))
+
+    def _can_admit_stream(self, stream: Stream) -> bool:
+        """Admission gate: a free slot AND (paged) enough free pages
+        for the stream's full reservation net of its shared prefix
+        pages.  When pages are the blocker, ask the owner's reclaim
+        hook (prefix-cache LRU eviction) to free some before giving
+        up until the next boundary — stored-but-idle prefixes must
+        never starve live traffic."""
+        if self._admissible_now(stream):
+            return True
+        if self.slots.free_slots == 0 or not self.paged:
+            return False
+        need = self._kv_tokens_needed(stream.p_len, stream.new)
+        n_shared = len(stream.kv_shared or ())
+        if self.page_reclaim is not None:
+            # The hook's contract is "make this many pages FREE" (it
+            # evicts until the free count reaches the target), so it
+            # gets the stream's whole page need — passing only the
+            # deficit would stop short and leave admission blocked
+            # at every subsequent boundary.
+            try:
+                self.page_reclaim(
+                    self.slots.pages_needed(need) - n_shared)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "page_reclaim hook failed", exc_info=True)
+            return self.slots.can_admit(need, n_shared)
+        return False
+
+    def _release_stream_kv(self, stream: Stream) -> None:
+        """Release a stream's still-PINNED shared prefix pages (set
+        at submit, consumed at admission) — called on every terminal
+        path that can fire before the pins transfer into a slot
+        table."""
+        ids = stream.kv_shared
+        if ids:
+            stream.kv_shared = None
+            try:
+                self.slots.unpin(ids)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "shared-page release failed", exc_info=True)
 
     # -- lifecycle: cancel / deadline / shed / preempt -------------------
 
@@ -549,6 +701,7 @@ class DecodeEngine:
                        tokens=len(stream.out), terminal=status)
             stream.slot = None
         for stream in group.streams:
+            self._release_stream_kv(stream)
             self._emit_instant(stream, status, now, row=stream.row,
                                tokens=len(stream.out))
         if isinstance(err, ShedError):
@@ -783,8 +936,8 @@ class DecodeEngine:
 
                     logging.getLogger(__name__).debug(
                         "on_prefilled hook failed", exc_info=True)
-        if self.slots.free_slots == 0:
-            return          # wait, fully prefilled, for an eviction
+        if not self._can_admit_stream(stream):
+            return          # wait, fully prefilled, for slot/pages
         # Pop THIS stream, never "the head": a concurrent interactive
         # submit can change the class-aware head between the tick's
         # head() and this pop (scheduler.AdmissionQueue.pop_stream).
@@ -870,6 +1023,7 @@ class DecodeEngine:
         if not resumed and stream.done():   # new == 1, or instant eos
             stream.cache = None
             stream.d_cache = None
+            self._release_stream_kv(stream)  # never mapped a table
             self.slots.release(slot)
             stream.slot = slot          # zero-length decode span
             self._complete(stream)      # still keys the slot id
@@ -887,6 +1041,16 @@ class DecodeEngine:
             stream.base_key = np.asarray(jax.device_get(
                 jax.random.fold_in(jax.random.PRNGKey(spec.seed),
                                    stream.row)))
+        kw = {}
+        if self.paged:
+            # Ownership of the pinned shared pages passes to insert
+            # (it unpins on its own failure paths), so clear the
+            # stream's reference FIRST — a later terminal path must
+            # not double-release.
+            shared = stream.kv_shared or ()
+            stream.kv_shared = None
+            kw = dict(total_tokens=self._kv_tokens_needed(
+                stream.p_len, stream.new), shared_pages=shared)
         try:
             with self.device_lock:
                 # Uniform across fresh and resumed admissions: feed
@@ -900,7 +1064,20 @@ class DecodeEngine:
                     next_index=len(stream.out),
                     temperature=spec.temperature, top_k=spec.top_k,
                     top_p=spec.top_p, draft_cache=stream.d_cache,
-                    spec_k=spec.spec_k)
+                    spec_k=spec.spec_k, **kw)
+        except PageExhausted:
+            # A handler thread (prefix store) reserved pages between
+            # the admission gate and this insert: a TRANSIENT
+            # shortage, not a request failure — put the stream back
+            # at the front of its class through the preempt-resume
+            # machinery (insert already released its pins/pages), so
+            # it re-prefills and admits when pages free.  The
+            # fits-but-not-now contract: wait, never 500.
+            self.slots.release(slot)
+            stream.prepare_resume(SchedulerPolicy.pow2_pieces(
+                stream.p_len + len(stream.out) - 1))
+            self.queue.requeue_front(stream)
+            return
         except BaseException as e:
             self.slots.release(slot)
             self._fail_group(stream.group, e)
@@ -949,7 +1126,13 @@ class DecodeEngine:
         head = self.queue.head()
         if head is not None and (
                 not head.pf_done
-                or self.slots.free_slots > 0
+                # Admissible NEXT BOUNDARY — for paged pools a free
+                # slot alone is not admissibility: a head blocked on
+                # PAGES can't admit until a budget eviction frees
+                # some, so fusing toward that eviction loses nothing
+                # (an eos-capable resident still pins the window to 1
+                # below, since an eos frees pages mid-window).
+                or self._admissible_now(head)
                 or any(s.eos_id is not None
                        for s in self._resident.values())
                 # An armed TTFT SLO makes every boundary a potential
@@ -1030,7 +1213,10 @@ class DecodeEngine:
                       window=window, occupancy=occupancy,
                       batch=self.slots.n_slots, tokens=emitted,
                       device_s=round(self.slots.last_step_device_s,
-                                     6))
+                                     6),
+                      **({"pages_free": self.slots.free_page_count(),
+                          "pages_total": self.slots.n_pages}
+                         if self.paged else {}))
 
     def _decode_step_spec(self, window: int, K: int) -> None:
         """Advance the pool by ``window`` fused SPECULATIVE rounds
@@ -1084,7 +1270,10 @@ class DecodeEngine:
                       batch=self.slots.n_slots, tokens=emitted,
                       accepted=accepted,
                       device_s=round(self.slots.last_step_device_s,
-                                     6))
+                                     6),
+                      **({"pages_free": self.slots.free_page_count(),
+                          "pages_total": self.slots.n_pages}
+                         if self.paged else {}))
 
     # -- completion -----------------------------------------------------
 
@@ -1132,6 +1321,8 @@ class DecodeEngine:
                 del self._resident[slot]
                 self.slots.release(slot)
                 self.evicted_total += 1
+        for stream in group.streams:
+            self._release_stream_kv(stream)
         if not group.event.is_set():   # fail once, however many
             t = time.perf_counter()    # streams drag the group down
             for stream in group.streams:
@@ -1174,6 +1365,7 @@ class DecodeEngine:
             "cancelled_total": self.cancelled_total,
             "expired_total": self.expired_total,
             "shed_total": self.shed_total,
+            "shed_kv_pages_total": self.shed_kv_pages_total,
             "shed_interactive_total":
                 self.shed_by_class["interactive"],
             "shed_batch_total": self.shed_by_class["batch"],
@@ -1195,6 +1387,11 @@ class DecodeEngine:
             "spec_drafted_total": self.spec_drafted_total,
             "spec_accepted_total": self.spec_accepted_total,
             **self._spec_accept_stats(),
+            # Paged-KV page-pool gauges (absent in fixed-lane mode):
+            # free/resident/shared page counts — the occupancy story
+            # the paged refactor exists for, fed to /metrics + /info
+            # from this ONE dict.
+            **(self.slots.page_stats() if self.paged else {}),
             # Recompile sentinel: compile_cache_misses must go quiet
             # once traffic has warmed its shapes (the zero-steady-
             # state contract, tests/test_analysis.py); a counter that

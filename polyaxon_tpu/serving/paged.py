@@ -1,0 +1,806 @@
+"""Paged KV memory for the continuous-batching engine.
+
+The fixed-lane pool (slots.py) stacks one FULL-WIDTH cache lane per
+slot, so every resident request pays for ``max_position`` tokens of
+KV whatever its actual length: occupancy collapses under mixed
+short/long traffic and max concurrency is pinned by the widest
+request, not by token usage.  This module replaces that storage with
+BLOCK-TABLE PAGING — the VirtualFlow decoupling (arXiv:2009.09523) of
+logical slots from physical cache layout:
+
+- every position-indexed cache leaf is stored as a POOL of fixed-size
+  pages (``page_tokens`` positions each, leaf shape ``lead +
+  (n_pages, page_tokens) + rest``);
+- each slot owns a PAGE TABLE (padded int32 page-id list, a RUNTIME
+  argument of the step programs, so one compiled program per
+  (window, pages-per-slot-pad) shape serves every occupancy pattern
+  — the zero-steady-state-recompile contract holds per pad class,
+  never per request mix);
+- the step programs GATHER a slot's pages into a position-contiguous
+  view (``models/kv_cache.gather_pages``), run the SAME decode bodies
+  the fixed-lane manager runs (slots.build_step_body /
+  build_spec_step_body — one traced body, two storage layouts), and
+  SCATTER only the window's dirty pages back;
+- pages are REFERENCE-COUNTED and shared COPY-ON-WRITE: a stored
+  prefix's pages map read-only into every matching slot's table
+  (admission of a prefix hit costs only the divergent suffix), and a
+  page is never a scatter target while shared — dirty windows only
+  ever cover pages the slot privately owns, enforced by construction
+  (decode writes start at the prompt end, which is at or past the
+  shared-aligned boundary) rather than by a runtime branch.
+
+Safety argument, same shape as the fixed-lane one: a slot's
+materialized view is position-contiguous (page i covers absolute
+positions [i*pt, (i+1)*pt)), so the causal-append masking, chunked
+prefill, and the speculative rollback contract (stale entries masked
+by absolute position) hold verbatim on paged storage — rollback is
+still just a ``cache_index`` rewind inside the step body, with NO
+page bookkeeping, because each slot's pages are reserved up front for
+its full budget (see below).  Idle slots' dead stepping lands in a
+per-slot SCRATCH page, and writes redirected away from shared pages
+land in a single TRASH page; both hold garbage by definition and are
+masked by position before any query could admit them.
+
+RESERVATION DISCIPLINE: admission reserves a request's FULL page need
+(prompt + budget + speculative slack) minus its shared prefix pages.
+The ISSUE's lazier "prompt + first window" admission would pack a few
+more residents but requires a mid-decode page-exhaustion preemption
+path (and its livelock policy); full reservation keeps the engine
+deadlock-free by construction — a resident can always finish — while
+still delivering the occupancy win, because reservations are sized by
+THIS request's length, not by ``max_position``.  Page exhaustion
+therefore only exists at the edges: a request that can NEVER fit the
+pool sheds 503 ``reason: kv_pages`` at submit, and one that doesn't
+fit RIGHT NOW waits admit-ready in the queue until evictions free
+pages (the admission-resume path, tests/test_paged_engine.py).
+
+Locking: page refcounts and the free list are mutated ONLY under
+``_page_lock`` (machine-checked by the PAGE-REF rule in
+analysis/rules.py — handler threads pin/unpin prefix pages while the
+engine thread admits and releases).  Slot tables and the decode state
+arrays stay engine-thread-only, like the fixed-lane manager's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .slots import build_spec_step_body, build_step_body
+
+__all__ = ["PagedSlotKVManager", "PageExhausted"]
+
+
+class PageExhausted(RuntimeError):
+    """Page reservation failed.  Engine admission is gated on
+    ``can_admit`` so this is a defensive error, not a control path."""
+
+
+def _pow2ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class PagedSlotKVManager:
+    """Fixed pool of ``n_slots`` decode slots over a PAGED KV pool.
+
+    Same engine-facing surface as :class:`slots.SlotKVManager`
+    (acquire/release/insert/step/step_spec + the host decode-state
+    arrays), plus the page accounting the engine's admission gate and
+    the server's shared-prefix store ride on (``can_admit`` /
+    ``pin`` / ``unpin`` / ``scatter_cache`` / ``materialize``).
+    """
+
+    paged = True
+
+    def __init__(self, model, variables, n_slots: int, *,
+                 page_tokens: int = 64, n_pages: Optional[int] = None,
+                 max_position: int, decode_window: int = 8,
+                 spec_k_cap: int = 4,
+                 draft_model=None, draft_variables=None,
+                 sentinel=None):
+        if page_tokens < 8:
+            raise ValueError(
+                f"kv_page_tokens must be >= 8; got {page_tokens}")
+        if max_position < 1:
+            raise ValueError(
+                f"paged KV needs the model's max_position; got "
+                f"{max_position}")
+        self.model = model
+        self.variables = variables
+        self.draft_model = draft_model
+        self.draft_variables = draft_variables
+        self.sentinel = sentinel
+        self.n_slots = int(n_slots)
+        self.page_tokens = int(page_tokens)
+        self.max_position = int(max_position)
+        pt = self.page_tokens
+        self.max_pages_slot = -(-self.max_position // pt)
+        # Default pool = the fixed-lane footprint (n_slots full-width
+        # lanes), so `kv_paged=True` alone changes layout, not budget.
+        self.n_pages = int(n_pages) if n_pages is not None \
+            else self.n_slots * self.max_pages_slot
+        if self.n_pages < 1:
+            raise ValueError(f"kv_pages must be >= 1; got {n_pages}")
+        # Scratch page per slot (dead stepping of idle slots, and the
+        # pad target beyond a short slot's real pages) + one TRASH
+        # page (the redirected write target for content that must not
+        # land on a shared page).  All garbage by definition, masked
+        # by absolute position before any read could admit them.
+        self.scratch0 = self.n_pages
+        self.trash = self.n_pages + self.n_slots
+        self.total_pages = self.n_pages + self.n_slots + 1
+        # Dirty-window bound: the widest position span one step
+        # dispatch can write (a spec round writes K+1 wide per round).
+        self._span_cap = max(1, int(decode_window)) \
+            * max(1, int(spec_k_cap)) + 1
+        self._n_dirty_cap = (self._span_cap - 1 + pt - 1) // pt + 1
+        # Table width covers the largest possible reservation plus
+        # the dirty-window margin (so d0 + n_dirty always lands
+        # inside the table and no clamp is ever needed).
+        need_cap = (self.max_position + int(spec_k_cap)
+                    + pt - 1) // pt
+        self.table_width = _pow2ceil(need_cap + self._n_dirty_cap)
+
+        # -- page accounting (under _page_lock) ------------------------
+        self._page_lock = threading.Lock()
+        with self._page_lock:
+            self.refcounts = np.zeros((self.total_pages,), np.int64)
+            self.refcounts[self.n_pages:] = 1  # scratch/trash pinned
+            self._free_pages: List[int] = list(range(self.n_pages))
+
+        # -- slot state (engine thread only) ---------------------------
+        self._free = list(range(self.n_slots))
+        self.page_tables = np.empty((self.n_slots, self.table_width),
+                                    np.int32)
+        for s in range(self.n_slots):
+            self.page_tables[s, :] = self.scratch0 + s
+        self._slot_pages: List[Optional[Tuple[List[int], int]]] = \
+            [None] * self.n_slots           # (page ids, n shared)
+        self._slot_need = np.zeros((self.n_slots,), np.int32)
+
+        # -- device pools ---------------------------------------------
+        self._pool: Optional[List[Any]] = None       # per paged leaf
+        self._meta: Optional[List[Dict[str, Any]]] = None
+        self._treedef = None
+        self._draft_pool: Optional[List[Any]] = None
+        self._draft_meta: Optional[List[Dict[str, Any]]] = None
+        self._draft_treedef = None
+        self._step_fns: Dict[Tuple, Any] = {}
+        self._insert_fns: Dict[Tuple, Any] = {}
+        self._gather_fns: Dict[int, Any] = {}
+
+        # -- per-slot decode state (identical to SlotKVManager) --------
+        self.tokens = np.zeros((self.n_slots,), np.int32)
+        self.positions = np.zeros((self.n_slots,), np.int32)
+        self.keys = np.zeros((self.n_slots, 2), np.uint32)
+        self.next_index = np.zeros((self.n_slots,), np.int32)
+        self.temps = np.zeros((self.n_slots,), np.float32)
+        self.top_ks = np.zeros((self.n_slots,), np.int32)
+        self.top_ps = np.zeros((self.n_slots,), np.float32)
+        self.spec_ks = np.zeros((self.n_slots,), np.int32)
+        self.last_step_device_s = 0.0
+
+    # -- page accounting ------------------------------------------------
+
+    def pages_needed(self, tokens: int) -> int:
+        return max(1, -(-int(tokens) // self.page_tokens))
+
+    @property
+    def capacity_tokens(self) -> int:
+        return self.n_pages * self.page_tokens
+
+    def free_page_count(self) -> int:
+        with self._page_lock:
+            return len(self._free_pages)
+
+    def can_admit(self, tokens: int, shared_pages: int = 0) -> bool:
+        """Enough free pages for a ``tokens``-long reservation, of
+        which ``shared_pages`` leading pages are already mapped
+        (pinned prefix pages)?"""
+        need = self.pages_needed(tokens) - int(shared_pages)
+        with self._page_lock:
+            return len(self._free_pages) >= need
+
+    def pin(self, ids: Sequence[int]) -> None:
+        """Take one reference on each page (prefix-cache lookups pin
+        an entry's pages so eviction/reuse can't free them while a
+        request maps or materializes them)."""
+        with self._page_lock:
+            for i in ids:
+                if self.refcounts[i] < 1:
+                    raise ValueError(
+                        f"pin of a free page {i} (stale page id — "
+                        f"the entry holding it was already freed)")
+                self.refcounts[i] += 1
+
+    def unpin(self, ids: Sequence[int]) -> None:
+        """Drop one reference per page; pages hitting zero return to
+        the free list."""
+        with self._page_lock:
+            for i in ids:
+                if self.refcounts[i] < 1:
+                    raise ValueError(f"unpin of a free page {i}")
+                self.refcounts[i] -= 1
+                if self.refcounts[i] == 0:
+                    self._free_pages.append(i)
+
+    def try_reserve(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` free pages (refcount 0 -> 1), or None if fewer
+        are free."""
+        if n <= 0:
+            return []
+        with self._page_lock:
+            if len(self._free_pages) < n:
+                return None
+            ids = [self._free_pages.pop() for _ in range(n)]
+            for i in ids:
+                self.refcounts[i] = 1
+            return ids
+
+    def page_stats(self) -> Dict[str, int]:
+        with self._page_lock:
+            free = len(self._free_pages)
+            shared = int(np.sum(self.refcounts[:self.n_pages] > 1))
+        resident = int(sum(len(p[0]) for p in self._slot_pages
+                           if p is not None))
+        return {
+            "kv_pages": self.n_pages,
+            "kv_page_tokens": self.page_tokens,
+            "kv_pages_free": free,
+            "kv_pages_resident": resident,
+            "kv_pages_shared": shared,
+        }
+
+    # -- slot accounting ------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def acquire(self) -> Optional[int]:
+        return self._free.pop(0) if self._free else None
+
+    def release(self, slot: int) -> None:
+        """Evict: park the slot (same contract as the fixed-lane
+        release — see SlotKVManager.release) AND return its pages:
+        one reference dropped per mapped page, so privately-owned
+        pages free immediately while shared prefix pages live on
+        under the entries/slots still referencing them."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} already free")
+        self._free.append(slot)
+        self._free.sort()
+        held = self._slot_pages[slot]
+        if held is not None:
+            self._slot_pages[slot] = None
+            self.unpin(held[0])
+        self.page_tables[slot, :] = self.scratch0 + slot
+        self._slot_need[slot] = 0
+        self.tokens[slot] = 0
+        self.positions[slot] = 0
+        self.keys[slot] = 0
+        self.next_index[slot] = 0
+        self.temps[slot] = 0.0
+        self.top_ks[slot] = 0
+        self.top_ps[slot] = 0.0
+        self.spec_ks[slot] = 0
+
+    # -- leaf classification / pools ------------------------------------
+
+    def _classify(self, template):
+        """Flatten a template cache and classify each leaf: PAGED
+        (one axis == max_position — the position axis that splits
+        into pages) or INDEX (``cache_index`` leaves, rebuilt from
+        the slot position at gather time).  Anything else (e.g. a
+        ring cache's position table) is unsupported — the server
+        gates paged mode to plain/int8 caches."""
+        import jax
+
+        leaves_p, treedef = jax.tree_util.tree_flatten_with_path(
+            template)
+        metas = []
+        for path, leaf in leaves_p:
+            key = jax.tree_util.keystr(path)
+            if key.endswith("cache_index']"):
+                metas.append({"kind": "index", "shape": leaf.shape,
+                              "dtype": leaf.dtype})
+                continue
+            # The standard cache leaves (kv_cache.append_kv_cache)
+            # are [..., B, positions, heads, feat]: position is the
+            # THIRD-FROM-LAST axis, whatever leading layer-stack axes
+            # scan_stack added.  Prefer that known layout — a head
+            # count or head dim that coincidentally equals
+            # max_position must not confuse the classifier — and fall
+            # back to a unique max_position axis for unknown names.
+            named = any(key.endswith(f"{n}']") for n in (
+                "cached_key", "cached_value", "cached_key_scale",
+                "cached_value_scale"))
+            if named and leaf.ndim >= 3 \
+                    and leaf.shape[leaf.ndim - 3] == self.max_position:
+                metas.append({"kind": "paged",
+                              "pos_axis": leaf.ndim - 3,
+                              "shape": leaf.shape,
+                              "dtype": leaf.dtype})
+                continue
+            axes = [i for i, d in enumerate(leaf.shape)
+                    if d == self.max_position]
+            if len(axes) != 1:
+                raise ValueError(
+                    f"paged KV cannot page cache leaf {key} of shape "
+                    f"{leaf.shape}: expected the [..., B, positions, "
+                    f"heads, feat] layout or exactly one axis of "
+                    f"max_position ({self.max_position}); ring "
+                    f"caches and exotic layouts need the fixed-lane "
+                    f"manager")
+            metas.append({"kind": "paged", "pos_axis": axes[0],
+                          "shape": leaf.shape, "dtype": leaf.dtype})
+        return metas, treedef
+
+    def _alloc_pool(self, metas):
+        import jax.numpy as jnp
+
+        from ..models.kv_cache import paged_pool_shape
+
+        pool = []
+        for m in metas:
+            if m["kind"] != "paged":
+                pool.append(None)
+                continue
+            pool.append(jnp.zeros(paged_pool_shape(
+                m["shape"], m["pos_axis"], self.total_pages,
+                self.page_tokens), m["dtype"]))
+        return pool
+
+    def _ensure_pool(self, template_cache) -> None:
+        if self._pool is None:
+            self._meta, self._treedef = self._classify(template_cache)
+            self._pool = self._alloc_pool(self._meta)
+
+    def _ensure_draft_pool(self, template_cache) -> None:
+        if self._draft_pool is None:
+            self._draft_meta, self._draft_treedef = \
+                self._classify(template_cache)
+            self._draft_pool = self._alloc_pool(self._draft_meta)
+
+    def _pad_class(self, n_pages: int) -> int:
+        return min(self.table_width, _pow2ceil(max(1, n_pages)))
+
+    # -- gather / scatter program pieces --------------------------------
+
+    def _gather_tree(self, pool, metas, treedef, tables, positions):
+        """Stacked [S, ...] cache pytree from the pool: paged leaves
+        gather through the page tables into position-contiguous
+        views; index leaves rebuild from the slot positions."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves = []
+        for m, p in zip(metas, pool):
+            if m["kind"] == "index":
+                leaves.append(jax.vmap(
+                    lambda pos, m=m: jnp.full(m["shape"], pos,
+                                              m["dtype"]))(positions))
+                continue
+            a = m["pos_axis"]
+            v = jnp.take(p, tables, axis=a)
+            # lead + (S, P, pt) + rest -> (S,) + lead + (P*pt,) + rest
+            v = jnp.moveaxis(v, a, 0)
+            shape = v.shape
+            leaves.append(v.reshape(
+                (shape[0],) + shape[1:a + 1]
+                + (shape[a + 1] * shape[a + 2],) + shape[a + 3:]))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _scatter_dirty(self, pool, metas, stacked, tables, d0,
+                       n_dirty: int):
+        """Write each slot's dirty page window ([d0, d0 + n_dirty)
+        local pages — everything this dispatch could have written)
+        back to the pool.  Dirty pages are private by construction
+        (decode writes start at the prompt end, past any shared
+        page), so targets never collide except on scratch/trash
+        garbage."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.kv_cache import scatter_pages
+
+        leaves, _ = jax.tree_util.tree_flatten(stacked)
+        pt = self.page_tokens
+        idx = jax.vmap(lambda t, d: jax.lax.dynamic_slice(
+            t, (d,), (n_dirty,)))(tables, d0)       # [S, n_dirty]
+        flat_idx = idx.reshape(-1)
+        out = []
+        for m, p, leaf in zip(metas, pool, leaves):
+            if m["kind"] == "index":
+                out.append(None)
+                continue
+            a = m["pos_axis"]
+
+            def slice_one(v, d, a=a):
+                return jax.lax.dynamic_slice_in_dim(
+                    v, d * pt, n_dirty * pt, axis=a)
+
+            dirty = jax.vmap(slice_one)(leaf, d0)
+            s = dirty.shape          # (S,) + lead + (n_dirty*pt,) + rest
+            dirty = dirty.reshape(s[:a + 1] + (n_dirty, pt)
+                                  + s[a + 2:])
+            dirty = jnp.moveaxis(dirty, 0, a)
+            s = dirty.shape          # lead + (S, n_dirty, pt) + rest
+            dirty = dirty.reshape(s[:a] + (s[a] * s[a + 1],)
+                                  + s[a + 2:])
+            out.append(scatter_pages(p, dirty, flat_idx, a))
+        return out
+
+    def _scatter_cache_leaves(self, pool, metas, cache, targets,
+                              P: int):
+        """Scatter a contiguous B=1 cache's first ``P * page_tokens``
+        positions into pool pages ``targets`` [P] (shared entries are
+        pre-munged to the trash page by the host caller)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.kv_cache import scatter_pages
+
+        leaves, _ = jax.tree_util.tree_flatten(cache)
+        pt = self.page_tokens
+        width = P * pt
+        out = []
+        for m, p, leaf in zip(metas, pool, leaves):
+            if m["kind"] == "index":
+                out.append(None)
+                continue
+            a = m["pos_axis"]
+            have = leaf.shape[a]
+            if have < width:
+                pad = [(0, 0)] * leaf.ndim
+                pad[a] = (0, width - have)
+                leaf = jnp.pad(leaf, pad)
+            elif have > width:
+                leaf = jax.lax.slice_in_dim(leaf, 0, width, axis=a)
+            s = leaf.shape
+            pages = leaf.reshape(s[:a] + (P, pt) + s[a + 1:])
+            out.append(scatter_pages(p, pages, targets, a))
+        return out
+
+    # -- insert / prefix-store scatter ----------------------------------
+
+    def _insert_fn(self, P: int, draft: bool):
+        import jax
+
+        key = (P, draft)
+        fn = self._insert_fns.get(key)
+        if fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("page_insert", key)
+            metas = self._draft_meta if draft else self._meta
+
+            def ins(pool, cache, targets):
+                return self._scatter_cache_leaves(pool, metas, cache,
+                                                  targets, P)
+
+            fn = self._insert_fns[key] = jax.jit(ins)
+        elif self.sentinel is not None:
+            self.sentinel.hit("page_insert", key)
+        return fn
+
+    def _write_targets(self, ids: List[int], n_shared: int,
+                       P: int) -> np.ndarray:
+        """Scatter targets for a cache write over pages ``ids``:
+        already-populated SHARED pages redirect to the trash page
+        (their content is identical by the prefix contract — never
+        rewrite a page with refcount > 1), and pad entries past the
+        real pages also land in trash."""
+        tg = np.full((P,), self.trash, np.int32)
+        if len(ids) > n_shared:
+            tg[n_shared:len(ids)] = np.asarray(ids[n_shared:],
+                                               np.int32)
+        return tg
+
+    def scatter_cache(self, cache, ids: List[int],
+                      n_shared: int = 0, *, draft: bool = False
+                      ) -> None:
+        """Write a contiguous B=1 cache into pages ``ids`` (first
+        ``n_shared`` already hold the same content and are skipped
+        via trash redirect).  Device work — callers hold the device
+        lock."""
+        if draft:
+            self._ensure_draft_pool(cache)
+        else:
+            self._ensure_pool(cache)
+        P = self._pad_class(len(ids))
+        tg = self._write_targets(ids, n_shared, P)
+        import jax.numpy as jnp
+
+        if draft:
+            self._draft_pool = self._insert_fn(P, True)(
+                self._draft_pool, cache, jnp.asarray(tg))
+        else:
+            self._pool = self._insert_fn(P, False)(
+                self._pool, cache, jnp.asarray(tg))
+
+    def insert(self, slot: int, cache, first_token: int,
+               position: int, *, base_key=None, next_index: int = 1,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, draft_cache=None,
+               spec_k: int = 0, total_tokens: Optional[int] = None,
+               shared_pages: Sequence[int] = ()) -> None:
+        """Admit a prefilled request: reserve its page budget, build
+        its table, scatter the prefilled cache into its PRIVATE pages
+        (shared prefix pages are mapped, not rewritten), and arm the
+        slot's decode state (identical to the fixed-lane insert).
+
+        ``total_tokens`` is the request's full KV budget (prompt +
+        new tokens + speculative slack) — the reservation that makes
+        mid-decode page exhaustion impossible.  ``shared_pages`` are
+        pinned prefix-page ids whose references this call TAKES
+        OWNERSHIP of (released with the rest at slot release)."""
+        if total_tokens is None:
+            total_tokens = self.max_position
+        n_need = self.pages_needed(total_tokens)
+        shared = list(shared_pages)
+        if len(shared) > n_need:       # defensive: over-wide prefix
+            self.unpin(shared[n_need:])
+            shared = shared[:n_need]
+        priv = self.try_reserve(n_need - len(shared))
+        if priv is None:
+            self.unpin(shared)
+            raise PageExhausted(
+                f"admission needs {n_need - len(shared)} free pages "
+                f"(have {self.free_page_count()}): engine admission "
+                f"gate out of sync")
+        ids = shared + priv
+        try:
+            self.scatter_cache(cache, ids, n_shared=len(shared))
+            if draft_cache is not None:
+                # Mirrored page ids: the draft pool is allocated with
+                # the same page geometry, so one table serves both.
+                self.scatter_cache(draft_cache, ids,
+                                   n_shared=len(shared), draft=True)
+        except BaseException:
+            self.unpin(ids)
+            raise
+        self.page_tables[slot, :] = self.scratch0 + slot
+        self.page_tables[slot, :len(ids)] = np.asarray(ids, np.int32)
+        self._slot_pages[slot] = (ids, len(shared))
+        self._slot_need[slot] = n_need
+        self.tokens[slot] = first_token
+        self.positions[slot] = position
+        if base_key is not None:
+            self.keys[slot] = np.asarray(base_key, np.uint32)
+        else:
+            self.keys[slot] = 0
+        self.next_index[slot] = next_index
+        self.temps[slot] = temperature
+        self.top_ks[slot] = top_k
+        self.top_ps[slot] = top_p
+        self.spec_ks[slot] = spec_k
+
+    # -- prefix materialization -----------------------------------------
+
+    def materialize(self, ids: Sequence[int], n_tokens: int):
+        """Gather stored prefix pages into a CONTIGUOUS B=1 cache of
+        the model's full creation width (``max_position``) — exactly
+        the shape the prefill/extend programs expect, so a prefix hit
+        reuses every existing compiled program.  Device work — caller
+        holds the device lock and a pin on every page in ``ids``."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._pool is None:
+            raise RuntimeError("materialize() before any page write")
+        P = self._pad_class(len(ids))
+        fn = self._gather_fns.get(P)
+        if fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("page_gather", P)
+            metas, treedef = self._meta, self._treedef
+            pt, width = self.page_tokens, self.max_position
+
+            def gather_cc(pool, table, pos):
+                from ..models.kv_cache import gather_pages
+
+                leaves = []
+                for m, p in zip(metas, pool):
+                    if m["kind"] == "index":
+                        leaves.append(jnp.full(m["shape"], pos,
+                                               m["dtype"]))
+                        continue
+                    a = m["pos_axis"]
+                    v = gather_pages(p, table, a)
+                    have = v.shape[a]
+                    if have < width:
+                        padw = [(0, 0)] * v.ndim
+                        padw[a] = (0, width - have)
+                        v = jnp.pad(v, padw)
+                    elif have > width:
+                        v = jax.lax.slice_in_dim(v, 0, width, axis=a)
+                    leaves.append(v)
+                return jax.tree_util.tree_unflatten(treedef, leaves)
+
+            fn = self._gather_fns[P] = jax.jit(gather_cc)
+        elif self.sentinel is not None:
+            self.sentinel.hit("page_gather", P)
+        table = np.full((P,), self.trash, np.int32)
+        table[:len(ids)] = np.asarray(ids, np.int32)
+        return fn(self._pool, jnp.asarray(table),
+                  jnp.asarray(n_tokens, np.int32))
+
+    # -- decode steps ----------------------------------------------------
+
+    def _resident_pad(self) -> int:
+        """Pad class for this dispatch's page tables: pow2 of the
+        widest resident reservation, so the compiled program set
+        stays bounded and steady-state quiet — and so the gathered
+        view (the dispatch's attention width) tracks the RESIDENT
+        MIX, not the worst case.  The dirty-window slice clamps its
+        start instead of padding the class (see step's d0)."""
+        need = int(self._slot_need.max()) if self.n_slots else 1
+        return self._pad_class(max(need, self._n_dirty_cap))
+
+    def _n_dirty(self, span: int) -> int:
+        pt = self.page_tokens
+        return (span - 1 + pt - 1) // pt + 1
+
+    def _dirty_start(self, P: int, n_dirty: int) -> np.ndarray:
+        """Per-slot first dirty page for this dispatch, CLAMPED so
+        the static-width dirty slice always fits the table.  The
+        clamp can shift a window over earlier pages the slot already
+        holds — harmless: the gathered view carries their current
+        content untouched, so the write-back is byte-identical (for
+        the rare boundary case where the earlier page is a SHARED
+        prefix page, identical bytes under the serialized device lock
+        are benign — content equality is the invariant, and no reader
+        can observe a difference)."""
+        d0 = self.positions // self.page_tokens
+        return np.clip(d0, 0, max(0, P - n_dirty)).astype(np.int32)
+
+    def _build_step(self, window: int, sampled: bool, P: int):
+        import jax
+
+        body = build_step_body(self.model, self.variables, window,
+                               sampled)
+        metas, treedef = self._meta, self._treedef
+        n_dirty = self._n_dirty(window)
+
+        def step(pool, tables, d0, toks, positions, *extra):
+            stacked = self._gather_tree(pool, metas, treedef,
+                                        tables, positions)
+            outs, stacked = body(stacked, toks, positions, *extra)
+            pool = self._scatter_dirty(pool, metas, stacked, tables,
+                                       d0, n_dirty)
+            return outs, pool
+
+        return jax.jit(step)
+
+    def step(self, window: int = 1, sampled: bool = False
+             ) -> np.ndarray:
+        """``window`` fused decode steps across the whole pool — the
+        paged twin of SlotKVManager.step: gather views, run the SAME
+        decode body, scatter dirty pages.  One compiled program per
+        (window, sampled, pages-per-slot pad class)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._pool is None:
+            raise RuntimeError("step() before any insert()")
+        P = self._resident_pad()
+        key = (window, sampled, P)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("slot_step", key)
+            fn = self._step_fns[key] = self._build_step(
+                window, sampled, P)
+        elif self.sentinel is not None:
+            self.sentinel.hit("slot_step", key)
+        tables = jnp.asarray(self.page_tables[:, :P])
+        d0 = jnp.asarray(self._dirty_start(P, self._n_dirty(window)))
+        t0 = time.perf_counter()
+        if sampled:
+            outs, self._pool = fn(
+                self._pool, tables, d0, jnp.asarray(self.tokens),
+                jnp.asarray(self.positions), jnp.asarray(self.keys),
+                jnp.asarray(self.next_index),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps))
+        else:
+            outs, self._pool = fn(
+                self._pool, tables, d0, jnp.asarray(self.tokens),
+                jnp.asarray(self.positions))
+        outs = np.asarray(jax.device_get(outs))
+        self.last_step_device_s = time.perf_counter() - t0
+        self.tokens = outs[-1].copy()
+        self.positions = self.positions + window
+        self.next_index = self.next_index + window
+        if self._free:
+            idle = np.asarray(self._free, np.int32)
+            self.tokens[idle] = 0
+            self.positions[idle] = 0
+            self.next_index[idle] = 0
+        return outs
+
+    def _build_spec_step(self, window: int, K: int, P: int):
+        import jax
+
+        body = build_spec_step_body(
+            self.model, self.variables, self.draft_model,
+            self.draft_variables, window, K)
+        metas, treedef = self._meta, self._treedef
+        d_metas, d_treedef = self._draft_meta, self._draft_treedef
+        n_dirty = self._n_dirty(window * K + 1)
+
+        def step(t_pool, d_pool, tables, d0, toks, positions, idxs,
+                 keys, temps, tks, tps, sks):
+            t_stacked = self._gather_tree(t_pool, metas, treedef,
+                                          tables, positions)
+            d_stacked = self._gather_tree(d_pool, d_metas, d_treedef,
+                                          tables, positions)
+            outs, cs, ms, t_stacked, d_stacked = body(
+                t_stacked, d_stacked, toks, positions, idxs, keys,
+                temps, tks, tps, sks)
+            t_pool = self._scatter_dirty(t_pool, metas, t_stacked,
+                                         tables, d0, n_dirty)
+            d_pool = self._scatter_dirty(d_pool, d_metas, d_stacked,
+                                         tables, d0, n_dirty)
+            return outs, cs, ms, t_pool, d_pool
+
+        return jax.jit(step)
+
+    def step_spec(self, window: int, K: int):
+        """``window`` fused SPECULATIVE rounds — the paged twin of
+        SlotKVManager.step_spec.  The in-program rollback stays a
+        pure ``cache_index`` rewind on the gathered view: pages are
+        reserved to budget, so rejection never touches the page
+        accounting (no truncation, no refcount traffic — the
+        full-reservation dividend)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._pool is None or self._draft_pool is None:
+            raise RuntimeError("step_spec() before a speculative "
+                               "insert()")
+        P = self._resident_pad()
+        key = (window, "spec", K, P)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            if self.sentinel is not None:
+                self.sentinel.miss("slot_step", key)
+            fn = self._step_fns[key] = self._build_spec_step(
+                window, K, P)
+        elif self.sentinel is not None:
+            self.sentinel.hit("slot_step", key)
+        tables = jnp.asarray(self.page_tables[:, :P])
+        d0 = jnp.asarray(self._dirty_start(
+            P, self._n_dirty(window * K + 1)))
+        t0 = time.perf_counter()
+        outs, cs, ms, self._pool, self._draft_pool = fn(
+            self._pool, self._draft_pool, tables, d0,
+            jnp.asarray(self.tokens), jnp.asarray(self.positions),
+            jnp.asarray(self.next_index), jnp.asarray(self.keys),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
+        outs = np.asarray(jax.device_get(outs))
+        cs = np.asarray(jax.device_get(cs))
+        ms = np.asarray(jax.device_get(ms))
+        self.last_step_device_s = time.perf_counter() - t0
+        rows = np.arange(self.n_slots)
+        adv = cs.sum(axis=0).astype(np.int32)
+        self.tokens = outs[-1, rows, cs[-1] - 1].astype(np.int32)
+        self.positions = self.positions + adv
+        self.next_index = self.next_index + adv
+        if self._free:
+            idle = np.asarray(self._free, np.int32)
+            self.tokens[idle] = 0
+            self.positions[idle] = 0
+            self.next_index[idle] = 0
+        return outs, cs, ms

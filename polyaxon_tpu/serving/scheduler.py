@@ -197,7 +197,10 @@ class SchedulerPolicy:
                  batch_queue_depth: Optional[int] = None,
                  queue_deadline_s: Optional[float] = None,
                  batch_queue_deadline_s: Optional[float] = None,
-                 slo_ttft_s: Optional[float] = None):
+                 slo_ttft_s: Optional[float] = None,
+                 kv_paged: bool = False, kv_page_tokens: int = 64,
+                 kv_pages: Optional[int] = None,
+                 spec_k_cap: int = 4):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1; got {n_slots}")
         if queue_depth < 1:
@@ -241,6 +244,28 @@ class SchedulerPolicy:
         self.queue_deadline_s = queue_deadline_s
         self.batch_queue_deadline_s = batch_queue_deadline_s
         self.slo_ttft_s = slo_ttft_s
+        # Paged-KV knobs (serving/paged.py): ``kv_paged`` swaps the
+        # fixed-lane slot cache for the block-table page pool;
+        # ``kv_page_tokens`` is the page size in positions;
+        # ``kv_pages`` the pool size in pages (None = the fixed-lane
+        # footprint, n_slots x ceil(max_position / page_tokens) — the
+        # equal-memory default the bench A/Bs against).
+        # ``spec_k_cap`` bounds the pool's speculative draft width —
+        # a spec-capable pool's verify chunks write cap+1 wide for
+        # EVERY resident, so paged admission reserves that slack per
+        # slot (the server passes its --spec-k here).
+        if kv_page_tokens < 8:
+            raise ValueError(
+                f"kv_page_tokens must be >= 8; got {kv_page_tokens}")
+        if kv_pages is not None and kv_pages < 1:
+            raise ValueError(f"kv_pages must be >= 1; got {kv_pages}")
+        if spec_k_cap < 1:
+            raise ValueError(
+                f"spec_k_cap must be >= 1; got {spec_k_cap}")
+        self.kv_paged = bool(kv_paged)
+        self.kv_page_tokens = int(kv_page_tokens)
+        self.kv_pages = int(kv_pages) if kv_pages is not None else None
+        self.spec_k_cap = int(spec_k_cap)
 
     def class_queue_depth(self, priority: str) -> int:
         return self.batch_queue_depth if priority == "batch" \
@@ -308,7 +333,7 @@ class Stream:
                  "out", "slot", "pf_done", "t_prefill_start",
                  "t_admit", "t_done", "d_cache", "spec_rounds",
                  "spec_drafted", "spec_accepted", "sid", "events",
-                 "pf_toks", "resume")
+                 "pf_toks", "resume", "kv_shared")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -352,6 +377,13 @@ class Stream:
         self.spec_rounds = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # Paged-KV: PINNED shared prefix page ids this stream will
+        # map at admission (server prefix hits set it via
+        # engine.submit).  The engine owns the pins from submit on —
+        # insert transfers them into the slot's table, every
+        # pre-admission terminal path unpins them
+        # (engine._release_stream_kv).
+        self.kv_shared: Optional[tuple] = None
 
     @property
     def p_len(self) -> int:

@@ -70,6 +70,7 @@ back to solo are counted per kind in /info's routing report.
 
 from __future__ import annotations
 
+import collections
 import json
 import select
 import socket
@@ -84,6 +85,7 @@ import numpy as np
 from ._lru import lru_get
 from .engine import DecodeEngine
 from .legacy import RequestCoalescer
+from .radix import RadixPrefixIndex
 from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
                         RequestCancelled, SamplingSpec,
                         SchedulerPolicy, ShedError)
@@ -91,6 +93,33 @@ from .telemetry import (ProfileSession, Telemetry,
                         render_compile_cache, render_histogram)
 
 BATCHING_MODES = ("continuous", "coalesce", "off")
+
+
+class _PagedPrefix:
+    """Radix payload for a PAGE-BACKED prefix entry: the stored
+    prompt's KV lives in the engine's page pool (one reference per
+    page held by this entry — shared pages referenced, never copied),
+    not in a private contiguous cache.  ``logits`` are the last-
+    position prefill logits (what a full-length hit seeds decode
+    with)."""
+
+    __slots__ = ("pages", "n_tokens", "logits")
+
+    def __init__(self, pages, n_tokens: int, logits):
+        self.pages = tuple(int(p) for p in pages)
+        self.n_tokens = int(n_tokens)
+        self.logits = logits
+
+
+PrefixHit = collections.namedtuple(
+    "PrefixHit", ["p_cached", "logits", "cache", "pins"])
+"""One prefix-cache lookup result: ``p_cached`` tokens of stored
+prefill, the stored last-position ``logits``, a CONTIGUOUS ``cache``
+holding them (materialized from pool pages in paged mode), and
+``pins`` — still-pinned FULL-page ids the engine path maps read-only
+into the admitted slot's table (empty for legacy entries).  The
+caller owns the pins until ``engine.submit(shared_pages=pins)``
+returns; every other outcome must unpin them."""
 
 
 def _span_dicts(events, t0: float):
@@ -155,6 +184,9 @@ class ModelServer:
                  n_slots: int = 8, queue_depth: int = 64,
                  prefill_chunk: Optional[int] = None,
                  decode_window: int = 8,
+                 kv_paged: bool = False,
+                 kv_page_tokens: int = 64,
+                 kv_pages: Optional[int] = None,
                  default_priority: str = "interactive",
                  batch_queue_depth: Optional[int] = None,
                  queue_deadline_s: Optional[float] = None,
@@ -278,6 +310,14 @@ class ModelServer:
         self.engine: Optional[DecodeEngine] = None
         if self.batching == "continuous" and hasattr(model, "encode"):
             self.batching = "coalesce"
+        if kv_paged and self.batching != "continuous":
+            # Paged KV is the engine's storage discipline — there is
+            # nothing to page in the coalesce/off solo paths.
+            raise ValueError(
+                "kv_paged requires the continuous-batching engine "
+                f"(batching={self.batching!r}"
+                + (" — seq2seq models fall back to coalesce)"
+                   if hasattr(model, "encode") else ")"))
         if self.batching == "continuous":
             self.engine = DecodeEngine(
                 model, variables,
@@ -289,7 +329,11 @@ class ModelServer:
                     batch_queue_depth=batch_queue_depth,
                     queue_deadline_s=queue_deadline_s,
                     batch_queue_deadline_s=batch_queue_deadline_s,
-                    slo_ttft_s=slo_ttft_s),
+                    slo_ttft_s=slo_ttft_s,
+                    kv_paged=kv_paged,
+                    kv_page_tokens=kv_page_tokens,
+                    kv_pages=kv_pages,
+                    spec_k_cap=self.spec_k_default),
                 device_lock=self._lock,
                 # Engine streams are single-row; share the server's
                 # compile cache so a prompt length prefilled via
@@ -330,23 +374,43 @@ class ModelServer:
         self._prefill_s_sum = 0.0
         self._decode_s_sum = 0.0
         self._breakdown_count = 0
-        # PREFIX CACHE: post-prefill KV caches keyed by the exact
-        # prompt batch, LRU-bounded (entries cost O(max_position)
-        # device memory each — the system-prompt serving win).  A
-        # request whose prompt extends a stored entry pays prefill
-        # only for the suffix (models/generate.prefill's extension
-        # contract); greedy/sampled solo requests only — beam/spec
-        # tile or roll back the cache.  prefix_cache=0 disables.
+        # PREFIX CACHE: stored prompt prefills in a RADIX index
+        # (serving/radix.py — O(prompt) longest-match lookup however
+        # many entries, replacing the seed's O(entries) linear scan),
+        # LRU-bounded.  A request whose prompt extends a stored entry
+        # pays prefill only for the suffix (models/generate.prefill's
+        # extension contract).  Entry storage depends on the engine:
+        # LEGACY (fixed-lane / engine-less): each entry holds its own
+        # contiguous B=1 cache, O(max_position) device memory.
+        # PAGED (kv_paged): single-row entries hold POOL PAGES — a
+        # stored system prompt is prefilled once and its pages are
+        # shared (refcounted, copy-on-write) by every extension entry
+        # and every resident slot that hits it, so admission of a hit
+        # costs only the divergent suffix.  prefix_cache=0 disables.
         self.prefix_cache_size = int(prefix_cache)
         if not hasattr(model, "encode"):
             self._prefix_enabled = self.prefix_cache_size > 0
         else:
             self._prefix_enabled = False  # seq2seq: encoder != prefix
-        self._prefix: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefix = RadixPrefixIndex(max(1, self.prefix_cache_size))
         self._prefix_lock = threading.Lock() \
             if self.sanitizer is None \
             else self.sanitizer.wrap("_prefix_lock")
         self.prefix_hits = 0
+        # Prefix-reuse hit-token counter: prompt tokens served from a
+        # stored prefill instead of fresh prefill work — the measure
+        # the shared-prefix bench leg asserts on.
+        self.prefix_hit_tokens = 0
+        self._prefix_store_skips = 0   # paged stores dropped for
+        #                                pool pressure (logged once)
+        self.kv_paged = bool(self.engine is not None
+                             and self.engine.paged)
+        if self.kv_paged:
+            # Page-pressure relief: when an admit-ready stream is
+            # blocked on free pages, the engine asks us to evict
+            # stored-but-idle prefix entries (LRU; pages shared with
+            # residents survive via their refcounts).
+            self.engine.page_reclaim = self._reclaim_prefix_pages
 
     def close(self) -> None:
         """Stop the engine loop thread (idempotent) and end any
@@ -617,28 +681,153 @@ class ModelServer:
                        sentinel=self.recompile,
                        kind=f"server:{kind}")
 
-    def _prefix_lookup(self, toks: np.ndarray):
+    def _prefix_lookup(self, toks: np.ndarray
+                       ) -> Optional[PrefixHit]:
         """Longest stored entry whose prompt is a prefix of ``toks``
-        (same batch): returns (key, p_cached, logits, cache) or None."""
-        b, p_len = toks.shape
+        (same batch) via one radix walk.  Paged entries are PINNED
+        under the prefix lock (so eviction can't free their pages
+        mid-flight), materialized into a contiguous cache under the
+        device lock, and returned with their still-pinned FULL-page
+        ids — the engine path maps those read-only into the admitted
+        slot's table; every other outcome must unpin them
+        (:class:`PrefixHit`)."""
         with self._prefix_lock:
-            best = None
-            for key, (rows, logits, cache) in self._prefix.items():
-                pc = rows.shape[1]
-                if rows.shape[0] != b or pc > p_len:
-                    continue
-                if (best is None or pc > best[1]) and \
-                        np.array_equal(rows, toks[:, :pc]):
-                    best = (key, pc, logits, cache)
-            if best is not None:
-                self._prefix.move_to_end(best[0])
-        return best
+            hit = self._prefix.lookup(toks)
+            if hit is None:
+                return None
+            ent_toks, payload = hit
+            pc = ent_toks.shape[1]
+            if not isinstance(payload, _PagedPrefix):
+                logits, cache = payload
+                return PrefixHit(pc, logits, cache, ())
+            # Pin while still under the prefix lock: a concurrent
+            # eviction between lookup and pin could free the pages.
+            # (Lock order everywhere: _prefix_lock > _page_lock.)
+            self.engine.slots.pin(payload.pages)
+        try:
+            with self._lock:
+                cache = self.engine.slots.materialize(payload.pages,
+                                                      pc)
+        except BaseException:
+            # A failed materialization (compile error, device OOM)
+            # must not leak the pins — repeated failing hits would
+            # otherwise walk the free pool down to permanent
+            # kv_pages sheds.
+            self.engine.slots.unpin(payload.pages)
+            raise
+        # Keep pins only on the FULL pages (the shareable ones — the
+        # partial tail page's content rides the materialized cache
+        # and is rewritten privately by the admitted slot).
+        n_full = pc // self.engine.slots.page_tokens
+        pins = payload.pages[:n_full]
+        if payload.pages[n_full:]:
+            self.engine.slots.unpin(payload.pages[n_full:])
+        return PrefixHit(pc, payload.logits, cache, pins)
 
-    def _prefix_store(self, toks: np.ndarray, logits, cache) -> None:
-        key = (toks.shape[0], toks.shape[1], toks.tobytes())
+    def _unpin_prefix(self, pins) -> None:
+        if pins:
+            self.engine.slots.unpin(pins)
+
+    def _free_displaced(self, displaced) -> None:
+        """Release payloads the radix index displaced (overwrites and
+        LRU evictions): paged entries drop their page references —
+        pages shared by a child entry or a resident slot stay alive
+        under the remaining refcounts ("evict leaf pages first, never
+        a page with refcount > 1" falls out of the accounting)."""
+        for _toks, payload in displaced:
+            if isinstance(payload, _PagedPrefix):
+                self.engine.slots.unpin(payload.pages)
+
+    def _reclaim_prefix_pages(self, n_pages_needed: int) -> bool:
+        """Evict LRU prefix entries until ``n_pages_needed`` pages
+        are free (or the index is empty) — the engine's page-pressure
+        hook: stored-but-idle prefixes must never starve admission of
+        live traffic."""
+        mgr = self.engine.slots
+        while mgr.free_page_count() < n_pages_needed:
+            with self._prefix_lock:
+                ev = self._prefix.pop_lru()
+            if ev is None:
+                return False
+            self._free_displaced([ev])
+        return True
+
+    def _prefix_store(self, toks: np.ndarray, logits, cache, *,
+                      hot: bool = True) -> None:
+        """Store a prompt's prefill for reuse.  Callers must NOT hold
+        the device lock (the paged path scatters pages under it).
+
+        Legacy entries keep the contiguous ``cache``.  Paged entries
+        (single-row, paged engine) write the cache into POOL PAGES,
+        sharing every page-aligned prefix page with the deepest
+        stored ancestor (the radix parent) instead of re-storing it —
+        a session extension of an N-page system prompt costs only its
+        own suffix pages.
+
+        ``hot=False`` marks a SPECULATIVE store (the per-request
+        session store-back): it enters the index's COLD ring, so a
+        stream of one-shot suffixes cycles itself out instead of
+        flushing explicitly registered system prompts (scan
+        resistance — see RadixPrefixIndex.store).  A store that
+        could not survive insertion (capacity fully held by hot
+        entries) is skipped BEFORE any device/page work."""
+        toks = np.asarray(toks, np.int32)
+        p_len = toks.shape[1]
+        paged = self.kv_paged and toks.shape[0] == 1
+        mgr = self.engine.slots if self.engine is not None else None
+        shared = ()
         with self._prefix_lock:
-            lru_get(self._prefix, key, self.prefix_cache_size,
-                    lambda: (toks.copy(), logits, cache))
+            anc = self._prefix.longest_ancestor(toks)
+            if anc is not None and anc[0].shape[1] >= p_len:
+                return     # exact prompt already stored
+            if not self._prefix.accepts(hot):
+                return     # would be displaced in the same call
+            if paged and anc is not None \
+                    and isinstance(anc[1], _PagedPrefix):
+                n_share = min(anc[0].shape[1] // mgr.page_tokens,
+                              mgr.pages_needed(p_len))
+                shared = tuple(anc[1].pages[:n_share])
+                mgr.pin(shared)
+        if not paged:
+            with self._prefix_lock:
+                displaced = self._prefix.store(toks, (logits, cache),
+                                               hot=hot)
+            self._free_displaced(displaced)
+            return
+        n_pages = mgr.pages_needed(p_len)
+        fresh = None
+        for _ in range(8):      # bounded: a reserve/consume race
+            #                     must not spin this store forever
+            fresh = mgr.try_reserve(n_pages - len(shared))
+            if fresh is not None:
+                break
+            if not self._reclaim_prefix_pages(n_pages - len(shared)):
+                break
+        if fresh is None:
+            # Pool too tight to store (live traffic owns the pages):
+            # skip quietly — the prefix cache is an optimization,
+            # never back-pressure.
+            mgr.unpin(shared)
+            with self._stats_lock:
+                self._prefix_store_skips += 1
+                first = self._prefix_store_skips == 1
+            if first:
+                print("# serving: prefix store skipped — page pool "
+                      "under live-traffic pressure (counted in "
+                      "/info prefix_store_skips)", file=sys.stderr)
+            return
+        ids = list(shared) + fresh
+        try:
+            with self._lock:
+                mgr.scatter_cache(cache, ids,
+                                  n_shared=len(shared))
+        except BaseException:
+            mgr.unpin(ids)
+            raise
+        payload = _PagedPrefix(ids, p_len, logits)
+        with self._prefix_lock:
+            displaced = self._prefix.store(toks, payload, hot=hot)
+        self._free_displaced(displaced)
 
     def _store_stream_prefix(self, stream) -> None:
         """Engine ``on_prefilled`` hook for prefix-seeded streams:
@@ -649,7 +838,7 @@ class ModelServer:
         immutable, so the stored entry and the slot copy never
         alias mutably)."""
         self._prefix_store(np.asarray(stream.toks), stream.logits,
-                           stream.cache)
+                           stream.cache, hot=False)
 
     def prefill_prompt(self, req: Dict[str, Any]) -> Dict[str, Any]:
         """POST /prefill: register a prompt (prefix) in the prefix
@@ -689,7 +878,9 @@ class ModelServer:
             logits, cache = self._split_fns(
                 toks.shape[0], toks.shape[1], "pfill", chunk)(toks)
             jax.block_until_ready(logits)
-            self._prefix_store(toks, logits, cache)
+        # Outside the device lock: the paged store re-acquires it for
+        # its page scatter (locks never nest device -> prefix).
+        self._prefix_store(toks, logits, cache)
         with self._stats_lock:
             self.requests += 1
             self._lat_sum += time.perf_counter() - t0
@@ -715,39 +906,54 @@ class ModelServer:
         from ..models import generate as G
 
         b = toks.shape[0]
-        with self._lock:
-            if deadline is not None \
-                    and time.perf_counter() > deadline:
-                # Same contract as the other solo branches: the
-                # split decode is fused dispatches that can't stop
-                # mid-flight, so the deadline is honored up to the
-                # device-lock acquisition.
-                raise DeadlineExceeded(
-                    "deadline exceeded waiting for the device "
-                    "(prefix-cache solo path)")
-            _, pc, logits, cache = hit
-            if pc < p_len:  # extend with the suffix, store back
-                suffix = toks[:, pc:]
-                logits, cache = self._split_fns(
-                    b, suffix.shape[1], "extend", chunk)(
-                        cache, suffix, pc)
-                jax.block_until_ready(logits)
-                self._prefix_store(toks, logits, cache)
-            if G.positional_eligible(self.model, temp):
-                keys = np.asarray(G.sample_stream_keys(seed, b))
-                fn = self._split_fns(b, None, "cont_pos", chunk,
-                                     new=new, eos=eos)
-                out_new = np.asarray(jax.device_get(fn(
-                    cache, logits, p_len, keys, np.float32(temp),
-                    np.int32(top_k or 0), np.float32(top_p or 0.0))))
-            else:
-                out_new = np.asarray(jax.device_get(self._split_fns(
-                    b, None, "cont", chunk, new=new, temp=temp,
-                    top_k=top_k, top_p=top_p, eos=eos)(
-                        cache, logits, p_len, jrandom.PRNGKey(seed))))
+        store_back = None
+        try:
+            with self._lock:
+                if deadline is not None \
+                        and time.perf_counter() > deadline:
+                    # Same contract as the other solo branches: the
+                    # split decode is fused dispatches that can't stop
+                    # mid-flight, so the deadline is honored up to the
+                    # device-lock acquisition.
+                    raise DeadlineExceeded(
+                        "deadline exceeded waiting for the device "
+                        "(prefix-cache solo path)")
+                pc, logits, cache = hit.p_cached, hit.logits, hit.cache
+                if pc < p_len:  # extend with the suffix, store back
+                    suffix = toks[:, pc:]
+                    logits, cache = self._split_fns(
+                        b, suffix.shape[1], "extend", chunk)(
+                            cache, suffix, pc)
+                    jax.block_until_ready(logits)
+                    store_back = (logits, cache)
+                if G.positional_eligible(self.model, temp):
+                    keys = np.asarray(G.sample_stream_keys(seed, b))
+                    fn = self._split_fns(b, None, "cont_pos", chunk,
+                                         new=new, eos=eos)
+                    out_new = np.asarray(jax.device_get(fn(
+                        cache, logits, p_len, keys, np.float32(temp),
+                        np.int32(top_k or 0),
+                        np.float32(top_p or 0.0))))
+                else:
+                    out_new = np.asarray(jax.device_get(
+                        self._split_fns(
+                            b, None, "cont", chunk, new=new, temp=temp,
+                            top_k=top_k, top_p=top_p, eos=eos)(
+                            cache, logits, p_len,
+                            jrandom.PRNGKey(seed))))
+        finally:
+            # The solo path never maps shared pages into a slot — the
+            # materialized cache is an independent copy.
+            self._unpin_prefix(hit.pins)
+        if store_back is not None:
+            # Outside the device lock: the paged store re-acquires
+            # it.  Cold insertion: one speculative store-back per
+            # request must never flush a registered system prompt.
+            self._prefix_store(toks, *store_back, hot=False)
         with self._stats_lock:
             self.requests += 1
             self.prefix_hits += 1
+            self.prefix_hit_tokens += hit.p_cached
         return np.concatenate([toks, out_new], axis=1)
 
     # -- request handling -----------------------------------------------
@@ -974,19 +1180,31 @@ class ModelServer:
             # no prefill at all on a full-length hit) and DECODES IN A
             # SLOT like cold traffic — same decode program, and no
             # whole-decode device-lock hold stalling resident streams.
-            _, pc, lg, cache = prefix_hit
-            group = self.engine.submit(
-                toks, new, eos, chunk, sampling=sampling,
-                prefix=(pc, lg, cache),
-                on_prefilled=self._store_stream_prefix,
-                record_timings=want_timings,
-                priority=priority, deadline_s=deadline_s)
+            # Paged engines additionally map the stored prefix's FULL
+            # pages read-only into the admitted slot's table
+            # (``shared_pages`` — copy-on-write sharing, so N hits of
+            # one system prompt hold ONE copy of its KV); the engine
+            # owns those pins once submit returns.
+            pc, lg, cache = (prefix_hit.p_cached, prefix_hit.logits,
+                             prefix_hit.cache)
+            try:
+                group = self.engine.submit(
+                    toks, new, eos, chunk, sampling=sampling,
+                    prefix=(pc, lg, cache),
+                    on_prefilled=self._store_stream_prefix,
+                    record_timings=want_timings,
+                    priority=priority, deadline_s=deadline_s,
+                    shared_pages=prefix_hit.pins or None)
+            except BaseException:
+                self._unpin_prefix(prefix_hit.pins)
+                raise
             self._wait_group(group, cancel_check)
             out = group.result()
             breakdown = group.breakdown()
             with self._stats_lock:
                 self.requests += 1
                 self.prefix_hits += 1
+                self.prefix_hit_tokens += pc
         elif prefix_hit is not None:
             out = self._generate_prefix_cached(
                 toks, p_len, new, temp, top_k, top_p, eos, chunk,
@@ -1151,7 +1369,7 @@ class ModelServer:
                 "prefill_ms": round(1e3 * breakdown[1], 3),
                 "decode_ms": round(1e3 * breakdown[2], 3)}
                if breakdown is not None else {}),
-            **({"prefix_hit_len": prefix_hit[1]}
+            **({"prefix_hit_len": prefix_hit.p_cached}
                if prefix_hit is not None else {}),
             **({"timings": timings} if timings is not None else {}),
         }
@@ -1239,6 +1457,9 @@ class ModelServer:
                 "coalesced_requests": self.coalesced_requests,
                 "prefix_entries": len(self._prefix),
                 "prefix_hits": self.prefix_hits,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prefix_store_skips": self._prefix_store_skips,
+                "kv_paged": self.kv_paged,
                 **{k: engine[k] for k in
                    ("slots", "slots_active", "slot_occupancy",
                     "queue_len", "queue_depth", "admitted_total",
@@ -1257,6 +1478,9 @@ class ModelServer:
                     "admitted_batch_total",
                     "queue_len_interactive", "queue_len_batch",
                     "draining",
+                    "shed_kv_pages_total",
+                    "kv_pages", "kv_page_tokens", "kv_pages_free",
+                    "kv_pages_resident", "kv_pages_shared",
                     "spec_rounds_total", "spec_drafted_total",
                     "spec_accepted_total", "spec_accept_buckets",
                     "spec_accept_hist", "spec_accept_sum",
@@ -1315,6 +1539,12 @@ class ModelServer:
             f"ptpu_serving_prefix_hits_total {self.prefix_hits}",
             "# TYPE ptpu_serving_prefix_entries gauge",
             f"ptpu_serving_prefix_entries {len(self._prefix)}",
+            # Prefix-reuse in TOKENS: prompt tokens served from a
+            # stored prefill instead of fresh prefill work (the
+            # shared-prefix bench leg's assertion target).
+            "# TYPE ptpu_serving_prefix_hit_tokens_total counter",
+            f"ptpu_serving_prefix_hit_tokens_total "
+            f"{self.prefix_hit_tokens}",
             # 503s shed at the drain gate (before the engine sees the
             # request) — every batching mode has this path, so it is
             # a server counter, not an engine one.
@@ -1431,6 +1661,29 @@ class ModelServer:
                 f"ptpu_serving_spec_accepted_total "
                 f"{es['spec_accepted_total']}",
             ]
+            if "kv_pages" in es:
+                # Paged-KV page-pool gauges (kv_paged engines only):
+                # the occupancy surface the block-table refactor
+                # exists for, plus the can-never-fit shed split.
+                lines += [
+                    "# TYPE ptpu_serving_kv_pages gauge",
+                    f"ptpu_serving_kv_pages {es['kv_pages']}",
+                    "# TYPE ptpu_serving_kv_page_tokens gauge",
+                    f"ptpu_serving_kv_page_tokens "
+                    f"{es['kv_page_tokens']}",
+                    "# TYPE ptpu_serving_kv_pages_free gauge",
+                    f"ptpu_serving_kv_pages_free "
+                    f"{es['kv_pages_free']}",
+                    "# TYPE ptpu_serving_kv_pages_resident gauge",
+                    f"ptpu_serving_kv_pages_resident "
+                    f"{es['kv_pages_resident']}",
+                    "# TYPE ptpu_serving_kv_pages_shared gauge",
+                    f"ptpu_serving_kv_pages_shared "
+                    f"{es['kv_pages_shared']}",
+                    "# TYPE ptpu_serving_shed_kv_pages_total counter",
+                    f"ptpu_serving_shed_kv_pages_total "
+                    f"{es['shed_kv_pages_total']}",
+                ]
             # The acceptance-rate histogram renders through the SAME
             # shared helper as the latency histograms, from the same
             # engine.stats() dict /info reports.

@@ -70,6 +70,164 @@ from typing import Optional
 import numpy as np
 
 
+# -- step-program bodies (shared with the paged manager) --------------------
+#
+# The scan/vmap decode bodies treat the stacked cache pytree opaquely
+# — they only thread it through ``model.apply`` — so the SAME bodies
+# serve the fixed-lane manager below (stacked resident cache) and the
+# paged manager (serving/paged.py), which wraps them in a page-table
+# gather before and a dirty-page scatter after.  Exactness across the
+# two storage disciplines is free by construction: one traced body,
+# two cache layouts with identical materialized content.
+
+
+def build_step_body(model, variables, window: int, sampled: bool):
+    """Unjitted ``window``-fused decode body over a stacked cache.
+
+    Plain: ``step(stacked, toks, positions) -> (outs [W, S], stacked)``.
+    Sampled: ``step(stacked, toks, positions, keys, idxs, temps, tks,
+    tps)`` with the same returns."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import generate as G
+
+    def logits_for(cache, tok, pos):
+        # One decoder step for one slot: tok [] at absolute
+        # position pos [].  _params inside the closure keeps int8
+        # weights int8 in HBM (generate._params contract).
+        out, mut = model.apply(
+            {"params": G._params(variables), "cache": cache},
+            tok[None, None], decode=True, decode_position=pos,
+            mutable=["cache"])
+        return G.extract_logits(out)[:, -1][0], mut["cache"]  # [V]
+
+    if not sampled:
+        # The pure-greedy body — byte-for-byte the pre-sampling
+        # program, so all-greedy pools never pay the sampler's
+        # sort/cumsum and greedy-only servers compile nothing new.
+        def one(cache, tok, pos):
+            logits, cache = logits_for(cache, tok, pos)
+            nxt = jnp.argmax(logits).astype(jnp.int32)  # greedy
+            return nxt, cache
+
+        def step(stacked, toks, positions):
+            def body(carry, _):
+                cache, tok, pos = carry
+                nxt, cache = jax.vmap(one)(cache, tok, pos)
+                return (cache, nxt, pos + 1), nxt
+            (cache, _, _), outs = jax.lax.scan(
+                body, (stacked, toks, positions), None,
+                length=window)
+            return outs, cache                          # [W, S]
+
+        return step
+
+    # Sampled body: every slot draws through the shared position-
+    # keyed sampler with ITS OWN (key, index, temperature, top_k,
+    # top_p); greedy co-tenants (temperature 0) take the argmax
+    # lane, producing the same tokens the greedy body would.
+    def one_sampled(cache, tok, pos, key, idx, temp, tk, tp):
+        logits, cache = logits_for(cache, tok, pos)
+        nxt = G._sample_positional_row(logits, key, idx, temp,
+                                       tk, tp)
+        return nxt, cache
+
+    def step_sampled(stacked, toks, positions, keys, idxs,
+                     temps, tks, tps):
+        def body(carry, _):
+            cache, tok, pos, idx = carry
+            nxt, cache = jax.vmap(one_sampled)(
+                cache, tok, pos, keys, idx, temps, tks, tps)
+            return (cache, nxt, pos + 1, idx + 1), nxt
+        (cache, _, _, _), outs = jax.lax.scan(
+            body, (stacked, toks, positions, idxs), None,
+            length=window)
+        return outs, cache                              # [W, S]
+
+    return step_sampled
+
+
+def build_spec_step_body(model, variables, draft, draft_vars,
+                         window: int, K: int):
+    """Unjitted ``window``-round SPECULATIVE body over a stacked
+    target cache + stacked draft cache (the math documented on
+    :meth:`SlotKVManager._build_spec_step`):
+
+    ``step(t_stacked, d_stacked, toks, positions, idxs, keys, temps,
+    tks, tps, sks) -> (outs [W, S, K], commits [W, S], accepts
+    [W, S], t_stacked, d_stacked)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import generate as G
+
+    if draft is None:
+        raise RuntimeError(
+            "speculative step without a draft model (construct the "
+            "slot manager with draft_model/draft_variables)")
+
+    def one_round(t_cache, d_cache, tok, pos, idx, key, temp,
+                  tk, tp, sk):
+        # Draft K proposals (k small steps, its own cache).
+        def dstep(carry, _):
+            cache, t, p, i = carry
+            out, mut = draft.apply(
+                {"params": G._params(draft_vars), "cache": cache},
+                t[None, None], decode=True, decode_position=p,
+                mutable=["cache"])
+            logits = G.extract_logits(out)[:, -1][0]
+            nxt, q = G._spec_draft_row(logits, key, i, temp, tk,
+                                       tp)
+            return (mut["cache"], nxt, p + 1, i + 1), (nxt, q)
+
+        (d_cache, _, _, _), (d_toks, q_rows) = jax.lax.scan(
+            dstep, (d_cache, tok, pos, idx), None, length=K)
+
+        # Target verifies [tok, d_1..d_K] in ONE forward.
+        chunk = jnp.concatenate([tok[None], d_toks])[None, :]
+        out, mut = model.apply(
+            {"params": G._params(variables), "cache": t_cache},
+            chunk, decode=True, decode_position=pos,
+            mutable=["cache"])
+        t_all = G.extract_logits(out)[0]              # [K+1, V]
+
+        out_toks, c, _m = G._spec_verify_row(
+            t_all[:K], d_toks, q_rows, key, idx, temp, tk, tp, sk)
+        # Plain lane (sk == 0): one token from the chunk's first
+        # logits — identical to the greedy/sampled step programs.
+        plain = G._sample_positional_row(t_all[0], key, idx, temp,
+                                         tk, tp)
+        is_spec = sk > 0
+        c = jnp.where(is_spec, c, 1)
+        m = jnp.where(is_spec, _m, 0)
+        out_toks = jnp.where(is_spec, out_toks,
+                             jnp.zeros_like(out_toks).at[0]
+                             .set(plain))
+        new_pos = pos + c
+        t_cache = G._rollback_cache(mut["cache"], new_pos)
+        d_cache = G._rollback_cache(d_cache, new_pos)
+        nxt = out_toks[c - 1]
+        return (t_cache, d_cache, nxt, new_pos, idx + c,
+                out_toks, c, m)
+
+    def step(t_stacked, d_stacked, toks, positions, idxs, keys,
+             temps, tks, tps, sks):
+        def body(carry, _):
+            t_c, d_c, tok, pos, idx = carry
+            (t_c, d_c, nxt, npos, nidx, outs, cs, ms) = jax.vmap(
+                one_round)(t_c, d_c, tok, pos, idx, keys, temps,
+                           tks, tps, sks)
+            return (t_c, d_c, nxt, npos, nidx), (outs, cs, ms)
+
+        (t_c, d_c, _, _, _), (outs, cs, ms) = jax.lax.scan(
+            body, (t_stacked, d_stacked, toks, positions, idxs),
+            None, length=window)
+        return outs, cs, ms, t_c, d_c   # [W, S, K], [W, S] x2
+
+    return step
+
+
 class SlotKVManager:
     """Fixed pool of ``n_slots`` decode slots over one model.
 
@@ -78,6 +236,8 @@ class SlotKVManager:
     programs.  Device work only — request bookkeeping lives in
     engine.py/scheduler.py.
     """
+
+    paged = False
 
     def __init__(self, model, variables, n_slots: int,
                  draft_model=None, draft_variables=None,
@@ -244,66 +404,9 @@ class SlotKVManager:
 
     def _build_step(self, window: int, sampled: bool):
         import jax
-        import jax.numpy as jnp
 
-        from ..models import generate as G
-
-        model, variables = self.model, self.variables
-
-        def logits_for(cache, tok, pos):
-            # One decoder step for one slot: tok [] at absolute
-            # position pos [].  _params inside the closure keeps int8
-            # weights int8 in HBM (generate._params contract).
-            out, mut = model.apply(
-                {"params": G._params(variables), "cache": cache},
-                tok[None, None], decode=True, decode_position=pos,
-                mutable=["cache"])
-            return G.extract_logits(out)[:, -1][0], mut["cache"]  # [V]
-
-        if not sampled:
-            # The pure-greedy body — byte-for-byte the pre-sampling
-            # program, so all-greedy pools never pay the sampler's
-            # sort/cumsum and greedy-only servers compile nothing new.
-            def one(cache, tok, pos):
-                logits, cache = logits_for(cache, tok, pos)
-                nxt = jnp.argmax(logits).astype(jnp.int32)  # greedy
-                return nxt, cache
-
-            def step(stacked, toks, positions):
-                def body(carry, _):
-                    cache, tok, pos = carry
-                    nxt, cache = jax.vmap(one)(cache, tok, pos)
-                    return (cache, nxt, pos + 1), nxt
-                (cache, _, _), outs = jax.lax.scan(
-                    body, (stacked, toks, positions), None,
-                    length=window)
-                return outs, cache                          # [W, S]
-
-            return jax.jit(step)
-
-        # Sampled body: every slot draws through the shared position-
-        # keyed sampler with ITS OWN (key, index, temperature, top_k,
-        # top_p); greedy co-tenants (temperature 0) take the argmax
-        # lane, producing the same tokens the greedy body would.
-        def one_sampled(cache, tok, pos, key, idx, temp, tk, tp):
-            logits, cache = logits_for(cache, tok, pos)
-            nxt = G._sample_positional_row(logits, key, idx, temp,
-                                           tk, tp)
-            return nxt, cache
-
-        def step_sampled(stacked, toks, positions, keys, idxs,
-                         temps, tks, tps):
-            def body(carry, _):
-                cache, tok, pos, idx = carry
-                nxt, cache = jax.vmap(one_sampled)(
-                    cache, tok, pos, keys, idx, temps, tks, tps)
-                return (cache, nxt, pos + 1, idx + 1), nxt
-            (cache, _, _, _), outs = jax.lax.scan(
-                body, (stacked, toks, positions, idxs), None,
-                length=window)
-            return outs, cache                              # [W, S]
-
-        return jax.jit(step_sampled)
+        return jax.jit(build_step_body(self.model, self.variables,
+                                       window, sampled))
 
     def step(self, window: int = 1, sampled: bool = False
              ) -> np.ndarray:
@@ -382,76 +485,10 @@ class SlotKVManager:
         sampler — the same token the plain step programs produce —
         and rewind to position + 1."""
         import jax
-        import jax.numpy as jnp
 
-        from ..models import generate as G
-
-        model, variables = self.model, self.variables
-        draft, draft_vars = self.draft_model, self.draft_variables
-        if draft is None:
-            raise RuntimeError(
-                "speculative step without a draft model (construct "
-                "SlotKVManager with draft_model/draft_variables)")
-
-        def one_round(t_cache, d_cache, tok, pos, idx, key, temp,
-                      tk, tp, sk):
-            # Draft K proposals (k small steps, its own cache).
-            def dstep(carry, _):
-                cache, t, p, i = carry
-                out, mut = draft.apply(
-                    {"params": G._params(draft_vars), "cache": cache},
-                    t[None, None], decode=True, decode_position=p,
-                    mutable=["cache"])
-                logits = G.extract_logits(out)[:, -1][0]
-                nxt, q = G._spec_draft_row(logits, key, i, temp, tk,
-                                           tp)
-                return (mut["cache"], nxt, p + 1, i + 1), (nxt, q)
-
-            (d_cache, _, _, _), (d_toks, q_rows) = jax.lax.scan(
-                dstep, (d_cache, tok, pos, idx), None, length=K)
-
-            # Target verifies [tok, d_1..d_K] in ONE forward.
-            chunk = jnp.concatenate([tok[None], d_toks])[None, :]
-            out, mut = model.apply(
-                {"params": G._params(variables), "cache": t_cache},
-                chunk, decode=True, decode_position=pos,
-                mutable=["cache"])
-            t_all = G.extract_logits(out)[0]              # [K+1, V]
-
-            out_toks, c, _m = G._spec_verify_row(
-                t_all[:K], d_toks, q_rows, key, idx, temp, tk, tp, sk)
-            # Plain lane (sk == 0): one token from the chunk's first
-            # logits — identical to the greedy/sampled step programs.
-            plain = G._sample_positional_row(t_all[0], key, idx, temp,
-                                             tk, tp)
-            is_spec = sk > 0
-            c = jnp.where(is_spec, c, 1)
-            m = jnp.where(is_spec, _m, 0)
-            out_toks = jnp.where(is_spec, out_toks,
-                                 jnp.zeros_like(out_toks).at[0]
-                                 .set(plain))
-            new_pos = pos + c
-            t_cache = G._rollback_cache(mut["cache"], new_pos)
-            d_cache = G._rollback_cache(d_cache, new_pos)
-            nxt = out_toks[c - 1]
-            return (t_cache, d_cache, nxt, new_pos, idx + c,
-                    out_toks, c, m)
-
-        def step(t_stacked, d_stacked, toks, positions, idxs, keys,
-                 temps, tks, tps, sks):
-            def body(carry, _):
-                t_c, d_c, tok, pos, idx = carry
-                (t_c, d_c, nxt, npos, nidx, outs, cs, ms) = jax.vmap(
-                    one_round)(t_c, d_c, tok, pos, idx, keys, temps,
-                               tks, tps, sks)
-                return (t_c, d_c, nxt, npos, nidx), (outs, cs, ms)
-
-            (t_c, d_c, _, _, _), (outs, cs, ms) = jax.lax.scan(
-                body, (t_stacked, d_stacked, toks, positions, idxs),
-                None, length=window)
-            return outs, cs, ms, t_c, d_c   # [W, S, K], [W, S] x2
-
-        return jax.jit(step)
+        return jax.jit(build_spec_step_body(
+            self.model, self.variables, self.draft_model,
+            self.draft_variables, window, K))
 
     def step_spec(self, window: int, K: int):
         """``window`` fused SPECULATIVE rounds across the whole pool.

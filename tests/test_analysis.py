@@ -376,6 +376,83 @@ def test_exc_swallow_negative():
     assert _rules(src, "polyaxon_tpu/anything.py") == []
 
 
+# -- PAGE-REF ---------------------------------------------------------------
+
+
+POOL = "polyaxon_tpu/serving/paged.py"
+
+
+def test_page_ref_flags_unlocked_refcount_mutation():
+    src = """
+    class Pool:
+        def bad_bump(self, i):
+            self.refcounts[i] += 1
+        def bad_assign(self, i):
+            self.refcounts[i] = 0
+        def bad_free(self, i):
+            self._free_pages.append(i)
+    """
+    assert _rules(src, POOL) == ["PAGE-REF"] * 3
+
+
+def test_page_ref_locked_mutations_pass():
+    src = """
+    class Pool:
+        def ok(self, ids):
+            with self._page_lock:
+                for i in ids:
+                    self.refcounts[i] += 1
+                    if self.refcounts[i] == 0:
+                        self._free_pages.append(i)
+        def reads_ok(self, i):
+            return self.refcounts[i]      # reads aren't mutations
+        def tables_ok(self, s):
+            self.page_tables[s, :] = 0    # engine-thread state
+    """
+    assert _rules(src, POOL) == []
+
+
+def test_page_ref_with_block_outside_nested_def_does_not_protect():
+    src = """
+    class Pool:
+        def sneaky(self, i):
+            with self._page_lock:
+                def later():
+                    self.refcounts[i] += 1
+                return later
+    """
+    assert _rules(src, POOL) == ["PAGE-REF"]
+
+
+def test_page_ref_internals_private_outside_pool_module():
+    src = """
+    def peek(mgr, s):
+        return mgr.refcounts[3], mgr.page_tables[s], mgr._free_pages
+    """
+    assert _rules(src, "polyaxon_tpu/serving/engine.py") == \
+        ["PAGE-REF"] * 3
+
+
+def test_page_ref_raw_literal_page_ids_flagged_outside_pool():
+    src = """
+    def ok(mgr, ids):
+        mgr.pin(ids)
+        mgr.unpin(tuple(ids))
+    def bad(mgr):
+        mgr.unpin([3, 4])
+    """
+    assert _rules(src, "polyaxon_tpu/serving/server.py") == \
+        ["PAGE-REF"]
+
+
+def test_page_ref_scoped_to_serving():
+    src = """
+    def elsewhere(mgr):
+        return mgr.refcounts
+    """
+    assert _rules(src, "polyaxon_tpu/tracking/thing.py") == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 
