@@ -77,6 +77,16 @@ the paged arm must serve every hit from SHARED pages — the common
 prompt is prefilled exactly once, asserted via the
 ``prefix_hit_tokens`` counter (``longtail_shared``).
 
+A seventh MESHED leg runs the same mixed greedy/sampled load against
+a ``--mesh tp=1`` and a ``--mesh tp=4`` engine at EQUAL total KV
+budget (same slots, same model — tp shards the pool, never grows it)
+on forced host devices.  Criterion is CORRECTNESS AND RECOMPILE
+BEHAVIOR, not speedup: a host-platform CPU mesh is one CPU pretending
+to be N devices, so the leg pins token-identity between the arms,
+zero timed compile misses, and records the per-step device-second
+inflation as a collective-time-share estimate (``meshed``) — speedup
+claims belong to real multi-chip hardware.
+
 A fourth TELEMETRY-OVERHEAD leg A/Bs the serving telemetry layer
 itself: the same greedy mix runs against two fresh continuous-mode
 servers back to back — tracing ON (default ring + histograms) vs
@@ -430,6 +440,9 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                               requests=requests)
     longtail = bench_longtail(model, variables, model_name, vocab,
                               requests=requests)
+    meshed = bench_meshed(model, variables, model_name, vocab,
+                          shapes, n_slots=n_slots, n_short=n_short,
+                          n_long=n_long, requests=requests)
     prefix = bench_prefix_cache(model, variables, model_name, vocab)
     return {
         "model": model_name,
@@ -461,6 +474,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         **telemetry,
         **overload,
         **longtail,
+        **meshed,
         **prefix,
     }
 
@@ -985,6 +999,137 @@ def bench_longtail(model, variables, model_name: str, vocab: int, *,
     return {"longtail": {**out, "paged_vs_fixed": ab}}
 
 
+def bench_meshed(model, variables, model_name: str, vocab: int,
+                 shapes, *, n_slots: int, n_short: int, n_long: int,
+                 requests: int):
+    """MESHED leg: the same mixed greedy/sampled load against a tp=1
+    and a tp=4 engine AT EQUAL TOTAL KV BUDGET (same slot count and
+    model — tp shards the same pool over more devices, it never
+    grows it), on forced host devices.
+
+    CRITERION — correctness and recompile behavior, NOT speedup: a
+    host-platform CPU "mesh" is one physical CPU pretending to be N
+    devices, so collectives are memcpy through shared memory and the
+    per-device compute shrinkage buys nothing (the devices share the
+    same cores).  What this leg pins is (a) the tp=4 arm answers
+    TOKEN-IDENTICALLY to the tp=1 arm (the exact-layout contract
+    under real concurrent load), (b) ZERO compile-cache misses during
+    the timed arm (mesh shapes warm like any other program key), and
+    (c) the per-step device-second inflation tp=4/tp=1 — the
+    COLLECTIVE-TIME SHARE estimate, derived from the engine's
+    last_step_device_s counters: on a host mesh the extra device
+    wall per step is collectives + SPMD partition overhead, the
+    number a real-hardware deployment would watch shrink as ICI
+    replaces memcpy.  Speedup claims belong to real multi-chip runs.
+    """
+    import jax as _jax
+
+    from polyaxon_tpu.serving import ModelServer, make_server
+
+    if len(_jax.devices()) < 4:
+        print("# meshed leg skipped: needs >= 4 devices (set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "for the cpu-smoke arm)", file=sys.stderr)
+        return {"meshed_skipped": "needs >= 4 devices"}
+
+    import numpy as np
+
+    arms = {}
+    parity = {}
+    rng = np.random.RandomState(11)
+    p_len, new = shapes["short"]
+    parity_greedy = rng.randint(0, vocab, size=p_len).tolist()
+    parity_sampled = rng.randint(0, vocab, size=p_len).tolist()
+    for tp in (1, 4):
+        ms = ModelServer(model, variables, model_name=model_name,
+                         max_batch=n_slots, batching="continuous",
+                         n_slots=n_slots,
+                         queue_depth=4 * (n_short + n_long),
+                         mesh=f"tp={tp}")
+        srv = make_server("127.0.0.1", 0, ms)
+        thread = threading.Thread(target=srv.serve_forever,
+                                  daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            warm_rng = np.random.RandomState(1)
+            for cls in ("short", "long"):
+                wp, wn = shapes[cls]
+                warm = warm_rng.randint(0, vocab, size=wp).tolist()
+                _post(base, {"prompt": warm, "max_new_tokens": wn},
+                      timeout=900)
+                _post(base, {"prompt": warm, "max_new_tokens": wn,
+                             "temperature": 0.9, "top_k": 64,
+                             "top_p": 0.95, "seed": 1}, timeout=900)
+            pre = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            lats, wall, errors = run_mixed_load(
+                base, n_short=n_short, n_long=n_long,
+                requests=requests, shapes=shapes, vocab=vocab,
+                sampled_mix=True)
+            if errors:
+                print(f"# meshed tp={tp} errors: {errors[:3]}",
+                      file=sys.stderr)
+                return {}
+            info = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            total_toks = (len(lats["short"]) * shapes["short"][1]
+                          + len(lats["long"]) * shapes["long"][1])
+            steps = info.get("decode_steps_total", 0) \
+                - pre.get("decode_steps_total", 0)
+            dev_s = info.get("step_device_seconds_total", 0.0) \
+                - pre.get("step_device_seconds_total", 0.0)
+            arms[tp] = {
+                "tp": tp,
+                "agg_tok_per_sec": round(total_toks / wall, 1),
+                "short_p50_ms": pct_ms(lats["short"], 50),
+                "long_p50_ms": pct_ms(lats["long"], 50),
+                "decode_steps": steps,
+                "device_s_per_step":
+                    round(dev_s / max(1, steps), 6),
+                "compile_misses_timed":
+                    info.get("compile_cache_misses", 0)
+                    - pre.get("compile_cache_misses", 0),
+            }
+            # Token-parity probes (fixed seeds): both arms must
+            # answer bitwise-identically — the exact-layout contract
+            # observed at the HTTP surface.
+            parity[tp] = [
+                _post(base, {"prompt": parity_greedy,
+                             "max_new_tokens": new})["new_tokens"],
+                _post(base, {"prompt": parity_sampled,
+                             "max_new_tokens": new,
+                             "temperature": 0.9, "top_k": 64,
+                             "seed": 7})["new_tokens"],
+            ]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            ms.close()
+    d1 = arms[1]["device_s_per_step"]
+    d4 = arms[4]["device_s_per_step"]
+    out = {
+        "criterion": "correctness+recompiles (host-device mesh "
+                     "measures no speedup)",
+        "arms": [arms[1], arms[4]],
+        "tokens_equal": parity[1] == parity[4],
+        "compile_misses_timed": arms[1]["compile_misses_timed"]
+        + arms[4]["compile_misses_timed"],
+        "agg_ratio_tp4_vs_tp1": round(
+            arms[4]["agg_tok_per_sec"]
+            / max(1e-9, arms[1]["agg_tok_per_sec"]), 3),
+        # Collective-time share of the tp=4 step's device wall,
+        # derived from last_step_device_s (see docstring).
+        "collective_share_tp4": round(max(0.0, 1 - d1 / d4), 4)
+        if d4 > 0 else None,
+    }
+    print(f"# meshed: tp4/tp1 agg {out['agg_ratio_tp4_vs_tp1']}x, "
+          f"tokens_equal={out['tokens_equal']}, timed misses "
+          f"{out['compile_misses_timed']}, collective share "
+          f"{out['collective_share_tp4']}", file=sys.stderr)
+    return {"meshed": out}
+
+
 def bench_prefix_cache(model, variables, model_name: str, vocab: int):
     """Prefix-cache A/B: a LONG registered system prompt + a short
     user suffix.  The warm timed request repeats a prompt the cache
@@ -1093,7 +1238,8 @@ def main() -> int:
             or len(r.get("load_spec", [])) < 3 \
             or "telemetry_overhead" not in r \
             or "overload" not in r \
-            or "longtail" not in r:
+            or "longtail" not in r \
+            or ("meshed" not in r and "meshed_skipped" not in r):
         row["partial"] = True
     print(json.dumps(row))
     with open(RESULTS, "a") as f:

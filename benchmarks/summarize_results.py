@@ -132,6 +132,21 @@ def paged_value(r):
     return f"{v}x" + (f" (occ {occ}x)" if occ is not None else "")
 
 
+def meshed_value(r):
+    """serving-load rows: the MESHED leg's headline — token parity +
+    timed-recompile health of the tp=4 arm vs tp=1 (the host-device
+    criterion: correctness, not speedup) with the derived
+    collective-time share.  Empty for every other bench."""
+    m = r.get("meshed") or {}
+    if not m:
+        return ""
+    ok = m.get("tokens_equal") and not m.get("compile_misses_timed")
+    share = m.get("collective_share_tp4")
+    return (("ok" if ok else "FAIL")
+            + f" tp4/tp1 {m.get('agg_ratio_tp4_vs_tp1')}x"
+            + (f" coll {share}" if share is not None else ""))
+
+
 def telemetry_value(r):
     """serving-load rows: the telemetry-overhead A/B column — the
     tracing-on tax in % agg tok/s (contract: <= ~3%).  Empty for
@@ -150,8 +165,9 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | paged | telemetry | overload | mfu | age |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "| spec-mix | paged | mesh | telemetry | overload | mfu "
+          "| age |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -169,6 +185,7 @@ def main() -> int:
               f"| {v if v is not None else ''} | {unit} "
               f"| {spec_mix_value(r)} "
               f"| {paged_value(r)} "
+              f"| {meshed_value(r)} "
               f"| {telemetry_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")

@@ -77,8 +77,12 @@ def engine_stats(events, strip_buckets: int = 20):
         "." if not b else str(min(9, round(
             9 * (sum(b) / len(b)) / max(1, batch))))
         for b in buckets)
+    # Meshed runs tag every step record with the mesh axes — surface
+    # them in the header so a tp=4 trace reads as one at a glance.
+    mesh = next((a["mesh"] for a in args if "mesh" in a), None)
     out = {
         "steps": len(steps),
+        **({"mesh": mesh} if mesh else {}),
         "wall_span_s": round(span_us / 1e6, 3),
         "step_p50_ms": round(pctl(walls, 50), 3),
         "step_p95_ms": round(pctl(walls, 95), 3),
@@ -182,7 +186,8 @@ def main() -> int:
         print("\n(no engine step records in this trace)")
         return 0
     print(f"\n## engine: {eng['steps']} step dispatches over "
-          f"{eng['wall_span_s']}s ({eng['steps_by_kind']})")
+          f"{eng['wall_span_s']}s ({eng['steps_by_kind']})"
+          + (f" on mesh {eng['mesh']}" if eng.get("mesh") else ""))
     print(f"step wall p50/p95: {eng['step_p50_ms']} / "
           f"{eng['step_p95_ms']} ms; tokens/step: "
           f"{eng['tokens_per_step']} ({eng['tokens_total']} total)")

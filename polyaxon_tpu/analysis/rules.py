@@ -40,6 +40,14 @@ bitten (or would bite) this codebase:
              ``continue``) drops errors on the floor; best-effort
              teardown must say so in the baseline, everything else
              must at least log.
+- SHARD-LEAK  unsharded host-array placement in the serving layer:
+             a single-argument ``jax.device_put(x)`` (uncommitted —
+             lands on the default device, and fed to a mesh-compiled
+             step program it forces a transfer/gather on EVERY call),
+             or a ``jnp.zeros``-family allocation assigned straight
+             to KV-pool state (``_stacked``/``_pool``/...) outside
+             the mesh-aware ``_alloc*``/``_ensure*`` helpers that
+             commit pools to their NamedShardings at birth.
 
 Suppression: ``# ptpu: ignore[RULE-A,RULE-B]`` on the flagged line or
 the line directly above silences those rules for that line;
@@ -903,8 +911,93 @@ class PageRefRule(Rule):
         return findings
 
 
+# Serving KV-pool state attrs whose allocation must flow through the
+# mesh-aware allocator helpers (slots._alloc_stacked /
+# paged._alloc_pool commit pools to their NamedShardings at birth).
+_POOL_STATE_ATTRS = {"_stacked", "_draft_stacked", "_pool",
+                     "_draft_pool"}
+_ZEROS_FAMILY = {"zeros", "ones", "full", "empty", "zeros_like",
+                 "ones_like", "full_like"}
+_ALLOC_HELPERS = re.compile(r"(^|\.)(_alloc|_ensure)")
+
+
+class ShardLeakRule(Rule):
+    """Meshed-serving placement discipline (serving/meshed.py).
+
+    A meshed engine's step programs compile with explicit in/out
+    shardings over committed operands; a host-built array placed
+    UNCOMMITTED (``jax.device_put(x)`` with no sharding) lands on the
+    default device, and feeding it to a mesh-compiled program forces
+    a transfer/reshard on every call — invisible steady-state tax
+    that profiles as mystery step latency.  The sanctioned spellings
+    are ``device_put(x, sharding)`` / ``ServingMesh.put_replicated``
+    (committed), or keeping the array host-side and letting the
+    program's explicit ``in_shardings`` place it.  Pool-state
+    allocations (``self._stacked = jnp.zeros(...)``) must go through
+    the ``_alloc*``/``_ensure*`` helpers for the same reason: a pool
+    born unsharded silently demotes every subsequent step to
+    replicated layout."""
+
+    id = "SHARD-LEAK"
+
+    def applies_to(self, relpath: str) -> bool:
+        return _in_serving(relpath)
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def _flag(self, node, msg):
+                findings.append(Finding(
+                    rule.id, relpath, node.lineno, self.func,
+                    _src_line(lines, node.lineno), msg))
+
+            def visit_Call(self, node):
+                name = dotted_name(node.func) or ""
+                tail = name.rsplit(".", 1)[-1]
+                if tail == "device_put" and len(node.args) == 1 \
+                        and not node.keywords:
+                    self._flag(
+                        node,
+                        "single-argument device_put places the array "
+                        "UNCOMMITTED on the default device; fed to a "
+                        "mesh-compiled program that costs a transfer "
+                        "per call — pass a NamedSharding (or "
+                        "ServingMesh.put_replicated)")
+                self.generic_visit(node)
+
+            def visit_Assign(self, node):
+                if not _ALLOC_HELPERS.search(self.func):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                t.attr in _POOL_STATE_ATTRS and \
+                                self._allocates(node.value):
+                            self._flag(
+                                node,
+                                f"KV-pool state ({t.attr}) allocated "
+                                f"outside the _alloc*/_ensure* "
+                                f"helpers: pools must be committed "
+                                f"to their mesh shardings at birth "
+                                f"(an unsharded pool demotes every "
+                                f"step to replicated layout)")
+                self.generic_visit(node)
+
+            @staticmethod
+            def _allocates(value) -> bool:
+                for n in ast.walk(value):
+                    if isinstance(n, ast.Call):
+                        name = dotted_name(n.func) or ""
+                        if name.rsplit(".", 1)[-1] in _ZEROS_FAMILY:
+                            return True
+                return False
+
+        V().visit(tree)
+        return findings
+
+
 ALL_RULES: Tuple[Rule, ...] = (RngDetRule(), LockHoldRule(),
                                JitPurityRule(), DeadlineInJitRule(),
                                HostSyncRule(), ExcSwallowRule(),
-                               PageRefRule())
+                               PageRefRule(), ShardLeakRule())
 RULE_IDS: Tuple[str, ...] = tuple(r.id for r in ALL_RULES)

@@ -453,6 +453,15 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Max decode steps fused per device dispatch when "
                    "no admission could happen sooner (the engine "
                    "drops to single steps under admission pressure).")
+@click.option("--mesh", "mesh_arg", default=None,
+              help="Serve over a device mesh, e.g. 'tp=4' or "
+                   "'tp=2,ep=2': params go under NamedSharding and "
+                   "the slot KV cache shards its heads axis over tp "
+                   "(experts over ep; dp shards the slot axis on "
+                   "fixed-lane pools).  The exact serving layout — "
+                   "meshed responses are token-bitwise-identical to "
+                   "unmeshed ones per seed.  Requires --batching "
+                   "continuous and dp*tp*ep local devices.")
 @click.option("--kv-paged", is_flag=True, default=False,
               help="Paged KV cache: slot KV lives in a pool of "
                    "fixed-size pages with per-slot page tables and "
@@ -544,7 +553,7 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
-          kv_paged, kv_page_tokens, kv_pages,
+          mesh_arg, kv_paged, kv_page_tokens, kv_pages,
           default_priority, batch_queue_depth, queue_deadline_ms,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
@@ -618,6 +627,23 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         raise click.ClickException(
             "--kv-paged requires --batching continuous (paging is "
             "the engine's slot storage)")
+    mesh_spec = None
+    if mesh_arg is not None:
+        # Parse BEFORE the model build (fail-fast contract): a typo'd
+        # axis or a size the local device count can't honor must not
+        # cost a checkpoint restore.  Device-count validation happens
+        # in ServingMesh (after `--cpu` had its chance to switch the
+        # platform), but the spec grammar is checkable now.
+        if batching != "continuous":
+            raise click.ClickException(
+                "--mesh requires --batching continuous (the mesh "
+                "shards the engine's slot KV pools)")
+        from polyaxon_tpu.serving.meshed import MeshError, parse_mesh
+
+        try:
+            mesh_spec = parse_mesh(mesh_arg)
+        except MeshError as e:
+            raise click.ClickException(str(e))
     try:
         # Shared validation with the server/library (_check_spec_k):
         # one message for a bad --spec-k on every surface.
@@ -637,40 +663,50 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         draft, draft_vars = _build_serving_model(
             draft_model, 1, draft_checkpoint, int8_kv, int8_weights,
             kv_ring=kv_ring, kv_ring_slack=kv_ring_slack)
-    ms = ModelServer(model, variables, model_name=model_name,
-                     max_batch=max_batch, batching=batching,
-                     n_slots=n_slots, queue_depth=queue_depth,
-                     prefill_chunk=prefill_chunk,
-                     decode_window=decode_window,
-                     kv_paged=kv_paged,
-                     kv_page_tokens=kv_page_tokens,
-                     kv_pages=kv_pages,
-                     default_priority=default_priority,
-                     batch_queue_depth=batch_queue_depth,
-                     queue_deadline_s=queue_deadline_ms / 1e3
-                     if queue_deadline_ms is not None else None,
-                     batch_queue_deadline_s=batch_queue_deadline_ms
-                     / 1e3 if batch_queue_deadline_ms is not None
-                     else None,
-                     slo_ttft_s=slo_ttft_ms / 1e3
-                     if slo_ttft_ms is not None else None,
-                     request_timeout_s=request_timeout,
-                     prefix_cache=prefix_cache,
-                     draft_model=draft, draft_variables=draft_vars,
-                     spec_k=spec_k,
-                     trace_buffer=trace_buffer,
-                     profile_dir=profile_dir,
-                     access_log=access_log,
-                     sanitize=sanitize,
-                     sanitize_max_hold_s=sanitize_max_hold,
-                     info={**({"int8_weights": True}
-                              if int8_weights else {}),
-                           **({"int8_kv": True} if int8_kv else {}),
-                           **({"kv_ring": True} if kv_ring else {}),
-                           **({"kv_page_tokens": kv_page_tokens}
-                              if kv_paged else {}),
-                           **({"draft_model": draft_model}
-                              if draft_model else {})})
+    from polyaxon_tpu.serving.meshed import MeshError
+
+    try:
+        ms = ModelServer(model, variables, model_name=model_name,
+                         max_batch=max_batch, batching=batching,
+                         n_slots=n_slots, queue_depth=queue_depth,
+                         prefill_chunk=prefill_chunk,
+                         decode_window=decode_window,
+                         mesh=mesh_spec,
+                         kv_paged=kv_paged,
+                         kv_page_tokens=kv_page_tokens,
+                         kv_pages=kv_pages,
+                         default_priority=default_priority,
+                         batch_queue_depth=batch_queue_depth,
+                         queue_deadline_s=queue_deadline_ms / 1e3
+                         if queue_deadline_ms is not None else None,
+                         batch_queue_deadline_s=batch_queue_deadline_ms
+                         / 1e3 if batch_queue_deadline_ms is not None
+                         else None,
+                         slo_ttft_s=slo_ttft_ms / 1e3
+                         if slo_ttft_ms is not None else None,
+                         request_timeout_s=request_timeout,
+                         prefix_cache=prefix_cache,
+                         draft_model=draft, draft_variables=draft_vars,
+                         spec_k=spec_k,
+                         trace_buffer=trace_buffer,
+                         profile_dir=profile_dir,
+                         access_log=access_log,
+                         sanitize=sanitize,
+                         sanitize_max_hold_s=sanitize_max_hold,
+                         info={**({"int8_weights": True}
+                                  if int8_weights else {}),
+                               **({"int8_kv": True} if int8_kv else {}),
+                               **({"kv_ring": True} if kv_ring else {}),
+                               **({"kv_page_tokens": kv_page_tokens}
+                                  if kv_paged else {}),
+                               **({"draft_model": draft_model}
+                                  if draft_model else {})})
+    except MeshError as e:
+        # Mesh validation (device count, head/expert divisibility)
+        # fails AFTER the model build by necessity — it needs the
+        # model config — but still deserves the clean usage-error
+        # surface.
+        raise click.ClickException(str(e))
     try:
         srv = make_server(host, port, ms)
     except OSError as e:

@@ -190,7 +190,15 @@ class GPT2Model(nn.Module):
 
     def head(self, x):
         x = self.ln_f(x)
-        logits = self.wte.attend(x.astype(self.cfg.dtype))
+        # Pin the attend input's hidden dim REPLICATED: without this,
+        # the partitioner propagates an fsdp-on-hidden preference
+        # into the tied embedding's transpose, whose vocab dim is
+        # committed to (tp, fsdp) by the param rules — the two device
+        # orders can't be resharded in place and XLA falls back to
+        # involuntary full rematerialization of the weight
+        # (test_spmd_layout pins the warning away).
+        x = constrain(x.astype(self.cfg.dtype), BATCH, None, None)
+        logits = self.wte.attend(x)
         # LM head shards the vocab dim with the tied embedding.
         return constrain(logits.astype(jnp.float32), BATCH, None, "tp")
 

@@ -235,6 +235,11 @@ class LlamaModel(nn.Module):
 
     def head(self, x):
         x = self.final_norm(x).astype(self.cfg.dtype)
+        # Pin the head input's hidden dim REPLICATED: the partitioner
+        # otherwise propagates an fsdp-on-hidden preference into the
+        # vocab-committed head weight and falls back to involuntary
+        # full rematerialization (see gpt2.head / test_spmd_layout).
+        x = constrain(x, BATCH, None, None)
         if self.cfg.tie_embeddings:
             logits = self.embed.attend(x)
         else:

@@ -392,6 +392,11 @@ class T5Model(nn.Module):
     def head(self, x):
         cfg = self.cfg
         x = x.astype(cfg.dtype)
+        # Pin the head input's hidden dim REPLICATED: the partitioner
+        # otherwise propagates an fsdp-on-hidden preference into the
+        # vocab-committed head weight and falls back to involuntary
+        # full rematerialization (see gpt2.head / test_spmd_layout).
+        x = constrain(x, BATCH, None, None)
         if cfg.tie_embeddings:
             # T5 scales the tied head's input by d**-0.5 (the scale
             # the attention logits dropped).

@@ -1,0 +1,42 @@
+"""`jax.shard_map` compatibility for older jax (0.4.x).
+
+The parallel layer is written against the modern surface —
+``from jax import shard_map`` with ``check_vma=`` and (for
+partial-manual pipelining) ``axis_names=``.  Older jax ships the same
+machinery as ``jax.experimental.shard_map.shard_map`` with the
+previous spellings: ``check_rep=`` and the COMPLEMENT parameter
+``auto=`` (the axes left automatic) instead of ``axis_names`` (the
+axes made manual).  This wrapper translates; every call site imports
+it only when the top-level name is missing, so on modern jax the
+real function runs untouched.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma: bool = True, axis_names=None):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # check_rep is always OFF here: the old checker predates the VMA
+    # system the callers' check_vma=True relies on (pipeline.py
+    # promotes carries with pcast, which doesn't exist either) and
+    # rejects valid cond/ppermute bodies.  The modern path keeps the
+    # full check; this wrapper only exists where that path is
+    # unavailable.
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) \
+            - frozenset(axis_names)
+    mapped = _shard_map(f, **kwargs)
+    if kwargs.get("auto"):
+        # Old shard_map's eager impl refuses partial-auto outright
+        # (`if auto: raise NotImplementedError`); under jit it works.
+        # A nested jit inlines, so already-jitted callers lose
+        # nothing and eager callers (the multichip dryrun's pp leg)
+        # gain the supported path.
+        import jax
+
+        mapped = jax.jit(mapped)
+    return mapped

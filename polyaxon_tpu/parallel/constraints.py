@@ -26,8 +26,30 @@ AxisName = Union[None, str, Sequence[str]]
 _AMBIENT_MESH: contextvars.ContextVar = contextvars.ContextVar(
     "ptpu_ambient_mesh", default=None)
 
+# Serving-exact mesh (serving/meshed.py): a SECOND ambient channel
+# with different semantics.  Training publishes the mesh so constrain
+# SHARDS activations (the Megatron layout — fastest, but the row-
+# parallel matmuls psum partial products, which reorders float
+# accumulation).  The serving engine's contract is TOKEN-BITWISE
+# equality to unmeshed execution, so under an exact mesh every
+# constrain site that names a TENSOR axis ("tp"/"ep") instead forces
+# the activation REPLICATED — an all-gather, which is pure
+# concatenation — right before the row-parallel contraction that
+# would otherwise psum.  The SPMD decomposition then contains no
+# cross-device float reduction at all: column-parallel matmuls keep
+# every output element's accumulation order, attention shards over
+# heads (per-head math untouched), and gathers move bytes, never
+# reassociate sums.  docs/SERVING.md "Meshed serving".
+_EXACT_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "ptpu_serving_exact_mesh", default=None)
+
 # The canonical batch-dim axes (matches mesh.active_batch_axes).
 BATCH: Tuple[str, ...] = ("dp", "fsdp")
+
+# Axes whose constrain sites sit immediately before a contraction
+# over the constrained dim (o_proj/down_proj inputs, vocab logits):
+# the exact mode's force-replicate points.
+TENSOR_AXES: Tuple[str, ...] = ("tp", "ep")
 
 
 @contextlib.contextmanager
@@ -44,6 +66,27 @@ def current_mesh():
     return _AMBIENT_MESH.get()
 
 
+@contextlib.contextmanager
+def exact_mesh(mesh):
+    """Publish ``mesh`` as the serving-exact mesh for traces inside
+    the block (no-op when ``mesh`` is None).  Contextvar-scoped, so
+    each caller wraps its own jit CALLS (tracing happens on first call)
+    and concurrent meshed/unmeshed traces on other threads never see
+    it."""
+    if mesh is None:
+        yield None
+        return
+    token = _EXACT_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _EXACT_MESH.reset(token)
+
+
+def current_exact_mesh():
+    return _EXACT_MESH.get()
+
+
 def constrain(x, *axes: AxisName):
     """``with_sharding_constraint`` against the ambient mesh.
 
@@ -52,7 +95,25 @@ def constrain(x, *axes: AxisName):
     dims may be omitted and stay unconstrained).  Names absent from the
     ambient mesh, or present with size 1, are dropped — so
     ``constrain(x, BATCH, None, "tp")`` is safe on any mesh.
+
+    Under a serving-exact mesh (:func:`exact_mesh`) the semantics
+    flip: a site naming a TENSOR axis forces the activation
+    REPLICATED (the pre-contraction all-gather of the reduction-free
+    serving layout), every other site is a no-op — bitwise equality
+    to unmeshed execution, see the module-level note on _EXACT_MESH.
     """
+    emesh = _EXACT_MESH.get()
+    if emesh is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def _names(a):
+            return (a,) if isinstance(a, str) else tuple(a or ())
+
+        if any(n in TENSOR_AXES for a in axes for n in _names(a)):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(emesh, P()))
+        return x
     mesh = _AMBIENT_MESH.get()
     if mesh is None:
         return x
@@ -63,12 +124,29 @@ def constrain(x, *axes: AxisName):
     # Inside shard_map the mesh axes are Manual and per-axis constraints
     # are illegal (and meaningless — the caller already laid data out);
     # models run under both jit (constrain) and shard_map (no-op), e.g.
-    # blocks executing inside the pp pipeline.
-    abstract = jax.sharding.get_abstract_mesh()
-    if abstract is not None and any(
-            "Manual" in str(t)
-            for t in getattr(abstract, "axis_types", ())):
-        return x
+    # blocks executing inside the pp pipeline.  Older jax (0.4.x) has
+    # no get_abstract_mesh; there the probe is the bound named-axis
+    # env — inside shard_map the mesh axes are bound, and the
+    # resulting sharding error would surface at LOWERING, outside the
+    # ValueError catch below, so it must be caught at trace time.
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        abstract = jax.sharding.get_abstract_mesh()
+        if abstract is not None and any(
+                "Manual" in str(t)
+                for t in getattr(abstract, "axis_types", ())):
+            return x
+    else:
+        try:
+            from jax._src.core import get_axis_env
+
+            if any(a in mesh.shape
+                   for a in get_axis_env().axis_sizes):
+                return x
+        # Private-API drift on some other old jax: fall through to
+        # the ValueError catch below (best-effort probe, per-trace-
+        # call — logging here would spam every trace).
+        except Exception:  # ptpu: ignore[EXC-SWALLOW]
+            pass
 
     spec = []
     for a in axes:

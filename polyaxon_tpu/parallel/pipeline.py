@@ -44,6 +44,14 @@ def _manual_axes(mesh):
     return frozenset(mesh.axis_names) - auto
 
 
+def _vma_of(x):
+    """x's varying-manual-axes set; empty on older jax, which has no
+    VMA tracking (jax.typeof/pcast landed with the modern shard_map
+    surface) — there the promotion below is unnecessary by the same
+    token."""
+    return jax.typeof(x).vma if hasattr(jax, "typeof") else ()
+
+
 def _pvary_to(x, axes):
     """Promote x's varying-manual-axes set to include ``axes``.
 
@@ -51,8 +59,11 @@ def _pvary_to(x, axes):
     check_vma=True, which makes scan carries and cond branches strict
     about VMA agreement; inputs replicated over pp (spec doesn't
     mention it) must be explicitly promoted before they meet
-    pp-varying values in a carry.
+    pp-varying values in a carry.  No-op on older jax (no VMA
+    tracking to promote within).
     """
+    if not hasattr(jax, "typeof"):
+        return x
     have = jax.typeof(x).vma
     missing = tuple(a for a in axes if a not in have)
     return jax.lax.pcast(x, missing, to="varying") if missing else x
@@ -104,7 +115,7 @@ def _pipeline_shard(params, x_micro, *, axis_name: str, stage_fn,
         return (nxt, out_accum), None
 
     init = (_pvary_to(jnp.zeros(buf_shape, x_micro.dtype),
-                      jax.typeof(x_micro).vma), out_accum)
+                      _vma_of(x_micro)), out_accum)
     (_, out_accum), _ = jax.lax.scan(tick, init, jnp.arange(total))
     return out_accum
 
@@ -129,7 +140,10 @@ def pipeline_apply(
     caller reads them from the last stage (psum-broadcast below makes the
     value uniform across the pp axis so downstream code is simple).
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:   # older jax: translated spellings
+        from ._shard_map_compat import shard_map
 
     n_stages = mesh.shape.get(axis_name, 1)
     batch = x.shape[0]
@@ -275,7 +289,10 @@ def pipelined_lm_loss_1f1b(model, block, mesh, *, n_micro: int = 0,
     """
     import numpy as np
     import optax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:   # older jax: translated spellings
+        from ._shard_map_compat import shard_map
 
     cfg = model.cfg
     n_stages = mesh.shape.get(axis_name, 1)

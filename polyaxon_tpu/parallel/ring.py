@@ -276,7 +276,10 @@ def ring_attention(
     stops rotating after ceil(window/block) hops — communication is O(W),
     not O(S).
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:   # older jax: translated spellings
+        from ._shard_map_compat import shard_map
 
     if window is not None:
         if not causal:

@@ -21,6 +21,10 @@ path moved from request coalescing to continuous batching:
   and stream state.
 - ``legacy.py``    — the seed request-coalescing path, kept as the
   measured A/B baseline (``batching="coalesce"``).
+- ``meshed.py``    — the serving mesh (``--mesh tp=4``): params under
+  NamedSharding, KV pools sharded over the heads axis, the exact
+  (reduction-free) layout whose meshed output is token-bitwise
+  identical to unmeshed serving.
 - ``telemetry.py`` — trace-span ring (+ ``GET /trace`` Chrome trace
   export), shared latency/acceptance histograms, and the
   single-flight ``jax.profiler`` wrapper behind ``POST
@@ -31,6 +35,7 @@ ModelServer, make_server``.
 """
 
 from .engine import DecodeEngine
+from .meshed import MeshError, ServingMesh, parse_mesh
 from .paged import PagedSlotKVManager
 from .radix import RadixPrefixIndex
 from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
@@ -44,6 +49,7 @@ from .telemetry import (Histogram, ProfileSession, Telemetry,
 __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "SchedulerPolicy", "SamplingSpec", "SlotKVManager",
            "PagedSlotKVManager", "RadixPrefixIndex",
+           "ServingMesh", "parse_mesh", "MeshError",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "ShedError", "PRIORITIES", "Telemetry", "Histogram",
            "ProfileSession", "render_histogram"]

@@ -47,6 +47,7 @@ interleave at step granularity.
 
 from __future__ import annotations
 
+import contextlib
 import sys
 import threading
 import time
@@ -80,7 +81,31 @@ class DecodeEngine:
                  prefill_fns=None,
                  draft_model=None, draft_variables=None,
                  telemetry: Optional[Telemetry] = None,
-                 sentinel=None):
+                 sentinel=None, mesh=None):
+        # Serving mesh (serving/meshed.py): accepts a ServingMesh, a
+        # spec string ("tp=4"), a dict, or a MeshSpec.  When set, the
+        # slot KV pools shard over the mesh, params are PLACED onto
+        # it (library callers who didn't pre-place get the exact
+        # layout applied here; ModelServer places before
+        # constructing the engine and passes a ServingMesh whose
+        # placement this re-application matches, so double placement
+        # is a no-op), and every engine-owned trace runs under the
+        # serving-exact constraint mode — output stays token-bitwise
+        # identical to the unmeshed engine per seed.
+        if mesh is not None:
+            from .meshed import ServingMesh
+
+            if not isinstance(mesh, ServingMesh):
+                mesh = ServingMesh(mesh)
+            mesh.validate_model(model, "model",
+                                n_slots=(policy or SchedulerPolicy()
+                                         ).n_slots)
+            if draft_model is not None:
+                mesh.validate_model(draft_model, "draft model")
+            variables = mesh.place_params(variables)
+            if draft_variables is not None:
+                draft_variables = mesh.place_params(draft_variables)
+        self.mesh = mesh
         self.model = model
         self.variables = variables
         # Telemetry ring shared with the owning server (ModelServer
@@ -136,14 +161,14 @@ class DecodeEngine:
                 decode_window=self.policy.decode_window,
                 spec_k_cap=self.policy.spec_k_cap,
                 draft_model=draft_model,
-                draft_variables=draft_variables,
-                sentinel=sentinel)
+                draft_variables=self.draft_variables,
+                sentinel=sentinel, mesh=mesh)
         else:
-            self.slots = SlotKVManager(model, variables,
+            self.slots = SlotKVManager(model, self.variables,
                                        self.policy.n_slots,
                                        draft_model=draft_model,
-                                       draft_variables=draft_variables,
-                                       sentinel=sentinel)
+                                       draft_variables=self.draft_variables,
+                                       sentinel=sentinel, mesh=mesh)
         # Optional page-pressure relief hook (paged mode): called
         # with the page deficit when an admit-ready stream is blocked
         # on free pages; the server wires it to prefix-cache LRU
@@ -238,6 +263,19 @@ class DecodeEngine:
         # 503), finish everything already accepted — the /drain
         # endpoint's engine half.  One-way per engine lifetime.
         self.draining = False
+        # Meshed step accounting: cumulative device wall (dispatch +
+        # sync, from the manager's last_step_device_s) vs scheduling
+        # wall per decode dispatch — the observability the bench's
+        # tp=1-vs-tpN A/B derives its collective-time share from.
+        self.step_device_s_total = 0.0
+        self.step_wall_s_total = 0.0
+
+    def _exact(self):
+        """Serving-exact trace context for engine-owned device calls
+        (prefill pieces trace over column-sharded params); no-op
+        unmeshed."""
+        return self.mesh.exact() if self.mesh is not None \
+            else contextlib.nullcontext()
 
     # -- submission (any thread) ----------------------------------------
 
@@ -889,7 +927,7 @@ class DecodeEngine:
             spec = stream.sampling.spec_k > 0
             t_piece = time.perf_counter()
             try:
-                with self.device_lock:
+                with self.device_lock, self._exact():
                     if stream.cache is None:
                         logits, cache = self._pf_fn(piece, True)(toks)
                     else:
@@ -1208,12 +1246,16 @@ class DecodeEngine:
                 self.evicted_total += 1
                 self._complete(stream)   # records the slot id
                 stream.slot = None
+        self.step_device_s_total += self.slots.last_step_device_s
+        self.step_wall_s_total += t1 - t0
         self.tel.step("step", t0, t1,
                       kind="sampled" if sampled else "plain",
                       window=window, occupancy=occupancy,
                       batch=self.slots.n_slots, tokens=emitted,
                       device_s=round(self.slots.last_step_device_s,
                                      6),
+                      **({"mesh": self.mesh.axes_str()}
+                         if self.mesh is not None else {}),
                       **({"pages_free": self.slots.free_page_count(),
                           "pages_total": self.slots.n_pages}
                          if self.paged else {}))
@@ -1265,12 +1307,16 @@ class DecodeEngine:
                 self.evicted_total += 1
                 self._complete(stream)   # records the slot id
                 stream.slot = None
+        self.step_device_s_total += self.slots.last_step_device_s
+        self.step_wall_s_total += t1 - t0
         self.tel.step("step", t0, t1, kind="spec", window=window,
                       k=K, occupancy=occupancy,
                       batch=self.slots.n_slots, tokens=emitted,
                       accepted=accepted,
                       device_s=round(self.slots.last_step_device_s,
                                      6),
+                      **({"mesh": self.mesh.axes_str()}
+                         if self.mesh is not None else {}),
                       **({"pages_free": self.slots.free_page_count(),
                           "pages_total": self.slots.n_pages}
                          if self.paged else {}))
@@ -1392,12 +1438,36 @@ class DecodeEngine:
             # the paged refactor exists for, fed to /metrics + /info
             # from this ONE dict.
             **(self.slots.page_stats() if self.paged else {}),
+            # Mesh topology + step device/wall seconds (absent
+            # unmeshed): axis names/sizes and device count for
+            # /info, and the cumulative per-dispatch device share —
+            # on a mesh the device wall bundles compute AND
+            # collectives, so the tp=1-vs-tpN bench A/B is what
+            # isolates the collective-time share (bench_serving_load
+            # meshed leg).
+            **(self._mesh_stats() if self.mesh is not None else {}),
             # Recompile sentinel: compile_cache_misses must go quiet
             # once traffic has warmed its shapes (the zero-steady-
             # state contract, tests/test_analysis.py); a counter that
             # keeps climbing under same-shaped load is a recompile
             # storm.
             **self.sentinel.snapshot(),
+        }
+
+    def _mesh_stats(self) -> Dict[str, Any]:
+        wall = self.step_wall_s_total
+        return {
+            "mesh": self.mesh.describe(),
+            "mesh_devices": self.mesh.n_devices,
+            "step_device_seconds_total":
+                round(self.step_device_s_total, 6),
+            "step_wall_seconds_total": round(wall, 6),
+            # Per-step device share of the dispatch wall: the
+            # remainder is host scheduling; the device part bundles
+            # per-shard compute + collectives (see stats() note).
+            "step_device_share":
+                round(self.step_device_s_total / wall, 4)
+                if wall > 0 else None,
         }
 
     def _spec_accept_stats(self) -> Dict[str, Any]:

@@ -63,6 +63,7 @@ arrays stay engine-thread-only, like the fixed-lane manager's.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -103,7 +104,15 @@ class PagedSlotKVManager:
                  max_position: int, decode_window: int = 8,
                  spec_k_cap: int = 4,
                  draft_model=None, draft_variables=None,
-                 sentinel=None):
+                 sentinel=None, mesh=None):
+        if mesh is not None and mesh.dp > 1:
+            from ..parallel.mesh import MeshError
+
+            raise MeshError(
+                "paged KV does not support dp slot parallelism "
+                "(pages migrate between slots, so the page axis has "
+                "no stable dp decomposition); use tp/ep, or the "
+                "fixed-lane manager for dp")
         if page_tokens < 8:
             raise ValueError(
                 f"kv_page_tokens must be >= 8; got {page_tokens}")
@@ -116,6 +125,15 @@ class PagedSlotKVManager:
         self.draft_model = draft_model
         self.draft_variables = draft_variables
         self.sentinel = sentinel
+        # Serving mesh (serving/meshed.py): page pools shard their
+        # HEADS axis over tp; page tables/decode state stay host-side
+        # and commit replicated through the programs' explicit
+        # in_shardings.  Gather/scatter move pages within a head
+        # shard — no cross-device math, so paged == fixed-lane
+        # byte-identity holds per mesh shape.
+        self.mesh = mesh
+        self._pool_sh = None
+        self._draft_pool_sh = None
         self.n_slots = int(n_slots)
         self.page_tokens = int(page_tokens)
         self.max_position = int(max_position)
@@ -329,6 +347,12 @@ class PagedSlotKVManager:
                     and leaf.shape[leaf.ndim - 3] == self.max_position:
                 metas.append({"kind": "paged",
                               "pos_axis": leaf.ndim - 3,
+                              # Pool heads axis for mesh sharding:
+                              # the position axis splits into
+                              # (pages, page_tokens), pushing heads
+                              # from leaf ndim-2 to pool ndim-1... +1
+                              # overall = pos_axis + 2.
+                              "heads_axis": leaf.ndim - 3 + 2,
                               "shape": leaf.shape,
                               "dtype": leaf.dtype})
                 continue
@@ -346,31 +370,49 @@ class PagedSlotKVManager:
                           "shape": leaf.shape, "dtype": leaf.dtype})
         return metas, treedef
 
+    def _exact(self):
+        """Serving-exact trace context (no-op unmeshed)."""
+        return self.mesh.exact() if self.mesh is not None \
+            else contextlib.nullcontext()
+
     def _alloc_pool(self, metas):
+        """Zero-init pool leaves (None for index leaves); meshed
+        pools commit each paged leaf to its heads-over-tp
+        NamedSharding at birth.  Returns (pool, shardings)."""
+        import jax
         import jax.numpy as jnp
 
         from ..models.kv_cache import paged_pool_shape
 
-        pool = []
+        pool, shardings = [], []
         for m in metas:
             if m["kind"] != "paged":
                 pool.append(None)
+                shardings.append(None)
                 continue
-            pool.append(jnp.zeros(paged_pool_shape(
+            leaf = jnp.zeros(paged_pool_shape(
                 m["shape"], m["pos_axis"], self.total_pages,
-                self.page_tokens), m["dtype"]))
-        return pool
+                self.page_tokens), m["dtype"])
+            if self.mesh is not None:
+                sh = self.mesh.pool_leaf_sharding(m, leaf)
+                leaf = jax.device_put(leaf, sh)
+                shardings.append(sh)
+            else:
+                shardings.append(None)
+            pool.append(leaf)
+        return pool, shardings
 
     def _ensure_pool(self, template_cache) -> None:
         if self._pool is None:
             self._meta, self._treedef = self._classify(template_cache)
-            self._pool = self._alloc_pool(self._meta)
+            self._pool, self._pool_sh = self._alloc_pool(self._meta)
 
     def _ensure_draft_pool(self, template_cache) -> None:
         if self._draft_pool is None:
             self._draft_meta, self._draft_treedef = \
                 self._classify(template_cache)
-            self._draft_pool = self._alloc_pool(self._draft_meta)
+            self._draft_pool, self._draft_pool_sh = \
+                self._alloc_pool(self._draft_meta)
 
     def _pad_class(self, n_pages: int) -> int:
         return min(self.table_width, _pow2ceil(max(1, n_pages)))
@@ -488,7 +530,13 @@ class PagedSlotKVManager:
                 return self._scatter_cache_leaves(pool, metas, cache,
                                                   targets, P)
 
-            fn = self._insert_fns[key] = jax.jit(ins)
+            if self.mesh is not None:
+                sh = self._draft_pool_sh if draft else self._pool_sh
+                fn = jax.jit(ins, in_shardings=(sh, None, None),
+                             out_shardings=sh)
+            else:
+                fn = jax.jit(ins)
+            self._insert_fns[key] = fn
         elif self.sentinel is not None:
             self.sentinel.hit("page_insert", key)
         return fn
@@ -521,12 +569,13 @@ class PagedSlotKVManager:
         tg = self._write_targets(ids, n_shared, P)
         import jax.numpy as jnp
 
-        if draft:
-            self._draft_pool = self._insert_fn(P, True)(
-                self._draft_pool, cache, jnp.asarray(tg))
-        else:
-            self._pool = self._insert_fn(P, False)(
-                self._pool, cache, jnp.asarray(tg))
+        with self._exact():
+            if draft:
+                self._draft_pool = self._insert_fn(P, True)(
+                    self._draft_pool, cache, jnp.asarray(tg))
+            else:
+                self._pool = self._insert_fn(P, False)(
+                    self._pool, cache, jnp.asarray(tg))
 
     def insert(self, slot: int, cache, first_token: int,
                position: int, *, base_key=None, next_index: int = 1,
@@ -627,13 +676,23 @@ class PagedSlotKVManager:
                     leaves.append(v)
                 return jax.tree_util.tree_unflatten(treedef, leaves)
 
-            fn = self._gather_fns[P] = jax.jit(gather_cc)
+            if self.mesh is not None:
+                # Materialized prefix caches feed the ordinary
+                # prefill/extend programs — gather them back to a
+                # REPLICATED contiguous cache.
+                fn = jax.jit(gather_cc,
+                             in_shardings=(self._pool_sh, None, None),
+                             out_shardings=self.mesh.replicated)
+            else:
+                fn = jax.jit(gather_cc)
+            self._gather_fns[P] = fn
         elif self.sentinel is not None:
             self.sentinel.hit("page_gather", P)
         table = np.full((P,), self.trash, np.int32)
         table[:len(ids)] = np.asarray(ids, np.int32)
-        return fn(self._pool, jnp.asarray(table),
-                  jnp.asarray(n_tokens, np.int32))
+        with self._exact():
+            return fn(self._pool, jnp.asarray(table),
+                      jnp.asarray(n_tokens, np.int32))
 
     # -- decode steps ----------------------------------------------------
 
@@ -680,7 +739,14 @@ class PagedSlotKVManager:
                                        d0, n_dirty)
             return outs, pool
 
-        return jax.jit(step)
+        if self.mesh is None:
+            return jax.jit(step)
+        rep = self.mesh.replicated
+        n_extra = 5 if sampled else 0
+        in_sh = (self._pool_sh, rep, rep, rep, rep) \
+            + (rep,) * n_extra
+        return jax.jit(step, in_shardings=in_sh,
+                       out_shardings=(rep, self._pool_sh))
 
     def step(self, window: int = 1, sampled: bool = False
              ) -> np.ndarray:
@@ -706,17 +772,19 @@ class PagedSlotKVManager:
         tables = jnp.asarray(self.page_tables[:, :P])
         d0 = jnp.asarray(self._dirty_start(P, self._n_dirty(window)))
         t0 = time.perf_counter()
-        if sampled:
-            outs, self._pool = fn(
-                self._pool, tables, d0, jnp.asarray(self.tokens),
-                jnp.asarray(self.positions), jnp.asarray(self.keys),
-                jnp.asarray(self.next_index),
-                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-                jnp.asarray(self.top_ps))
-        else:
-            outs, self._pool = fn(
-                self._pool, tables, d0, jnp.asarray(self.tokens),
-                jnp.asarray(self.positions))
+        with self._exact():
+            if sampled:
+                outs, self._pool = fn(
+                    self._pool, tables, d0, jnp.asarray(self.tokens),
+                    jnp.asarray(self.positions),
+                    jnp.asarray(self.keys),
+                    jnp.asarray(self.next_index),
+                    jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                    jnp.asarray(self.top_ps))
+            else:
+                outs, self._pool = fn(
+                    self._pool, tables, d0, jnp.asarray(self.tokens),
+                    jnp.asarray(self.positions))
         outs = np.asarray(jax.device_get(outs))
         self.last_step_device_s = time.perf_counter() - t0
         self.tokens = outs[-1].copy()
@@ -754,7 +822,13 @@ class PagedSlotKVManager:
                                          tables, d0, n_dirty)
             return outs, cs, ms, t_pool, d_pool
 
-        return jax.jit(step)
+        if self.mesh is None:
+            return jax.jit(step)
+        rep = self.mesh.replicated
+        in_sh = (self._pool_sh, self._draft_pool_sh) + (rep,) * 10
+        return jax.jit(step, in_shardings=in_sh,
+                       out_shardings=(rep, rep, rep, self._pool_sh,
+                                      self._draft_pool_sh))
 
     def step_spec(self, window: int, K: int):
         """``window`` fused SPECULATIVE rounds — the paged twin of
@@ -783,12 +857,13 @@ class PagedSlotKVManager:
         d0 = jnp.asarray(self._dirty_start(
             P, self._n_dirty(window * K + 1)))
         t0 = time.perf_counter()
-        outs, cs, ms, self._pool, self._draft_pool = fn(
-            self._pool, self._draft_pool, tables, d0,
-            jnp.asarray(self.tokens), jnp.asarray(self.positions),
-            jnp.asarray(self.next_index), jnp.asarray(self.keys),
-            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
+        with self._exact():
+            outs, cs, ms, self._pool, self._draft_pool = fn(
+                self._pool, self._draft_pool, tables, d0,
+                jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                jnp.asarray(self.next_index), jnp.asarray(self.keys),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
         outs = np.asarray(jax.device_get(outs))
         cs = np.asarray(jax.device_get(cs))
         ms = np.asarray(jax.device_get(ms))

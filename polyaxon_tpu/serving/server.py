@@ -71,6 +71,7 @@ back to solo are counted per kind in /info's routing report.
 from __future__ import annotations
 
 import collections
+import contextlib
 import json
 import select
 import socket
@@ -196,6 +197,7 @@ class ModelServer:
                  prefix_cache: int = 4,
                  draft_model=None, draft_variables=None,
                  spec_k: int = 4,
+                 mesh=None,
                  trace_buffer: int = 4096,
                  profile_dir: Optional[str] = None,
                  access_log: bool = False,
@@ -318,6 +320,38 @@ class ModelServer:
                 f"(batching={self.batching!r}"
                 + (" — seq2seq models fall back to coalesce)"
                    if hasattr(model, "encode") else ")"))
+        # Serving mesh ("tp=4" / MeshSpec / ServingMesh): shard the
+        # slot KV pools over the mesh and place params under
+        # NamedSharding (serving/meshed.py — the exact layout, so
+        # meshed responses are token-bitwise-identical to unmeshed
+        # ones per seed).  Params are placed HERE, before the engine
+        # and before _split_fns capture self.variables, so every
+        # program — engine steps, prefill, solo fallbacks — runs over
+        # the same placed tree.
+        self.mesh = None
+        if mesh is not None:
+            from .meshed import MeshError, ServingMesh
+
+            if self.batching != "continuous":
+                # MeshError (a ValueError) so the CLI's clean
+                # usage-error surface catches it — the seq2seq
+                # fallback above can flip batching AFTER the CLI's
+                # own pre-check passed.
+                raise MeshError(
+                    "mesh requires the continuous-batching engine "
+                    f"(batching={self.batching!r}"
+                    + (" — seq2seq models fall back to coalesce)"
+                       if hasattr(model, "encode") else ")"))
+            self.mesh = mesh if isinstance(mesh, ServingMesh) \
+                else ServingMesh(mesh)
+            self.mesh.validate_model(model, "model", n_slots=n_slots)
+            if draft_model is not None:
+                self.mesh.validate_model(draft_model, "draft model")
+            self.variables = variables = \
+                self.mesh.place_params(variables)
+            if draft_variables is not None:
+                self.draft_variables = draft_variables = \
+                    self.mesh.place_params(draft_variables)
         if self.batching == "continuous":
             self.engine = DecodeEngine(
                 model, variables,
@@ -345,7 +379,8 @@ class ModelServer:
                 draft_model=draft_model,
                 draft_variables=draft_variables,
                 telemetry=self.telemetry,
-                sentinel=self.recompile)
+                sentinel=self.recompile,
+                mesh=self.mesh)
         self._coalescer = RequestCoalescer(self) \
             if self.batching == "coalesce" else None
         self.coalesced_batches = 0
@@ -419,6 +454,14 @@ class ModelServer:
             self.engine.close()
         if self.profiler is not None:
             self.profiler.close()
+
+    def _exact(self):
+        """Serving-exact trace context for the server's own device
+        sections (solo programs and prefill trace over the mesh's
+        column-sharded params; the exact constraint mode keeps their
+        output bitwise-identical to unmeshed).  No-op unmeshed."""
+        return self.mesh.exact() if self.mesh is not None \
+            else contextlib.nullcontext()
 
     # -- request lifecycle ----------------------------------------------
 
@@ -874,7 +917,7 @@ class ModelServer:
             raise ValueError("prefill_chunk must be >= 1")
         toks = np.asarray(rows, np.int32)
         t0 = time.perf_counter()
-        with self._lock:
+        with self._lock, self._exact():
             logits, cache = self._split_fns(
                 toks.shape[0], toks.shape[1], "pfill", chunk)(toks)
             jax.block_until_ready(logits)
@@ -908,7 +951,7 @@ class ModelServer:
         b = toks.shape[0]
         store_back = None
         try:
-            with self._lock:
+            with self._lock, self._exact():
                 if deadline is not None \
                         and time.perf_counter() > deadline:
                     # Same contract as the other solo branches: the
@@ -1277,7 +1320,8 @@ class ModelServer:
                 key = ("sample", len(rows), p_len, new, temp, top_k,
                        top_p, eos, beams, chunk)
             t_lock = time.perf_counter()
-            with self._lock:  # one chip: serialize device work
+            # one chip (or one mesh): serialize device work
+            with self._lock, self._exact():
                 import jax.random as jrandom
 
                 queue_s = time.perf_counter() - t_lock
@@ -1481,6 +1525,9 @@ class ModelServer:
                     "shed_kv_pages_total",
                     "kv_pages", "kv_page_tokens", "kv_pages_free",
                     "kv_pages_resident", "kv_pages_shared",
+                    "mesh", "mesh_devices",
+                    "step_device_seconds_total",
+                    "step_wall_seconds_total", "step_device_share",
                     "spec_rounds_total", "spec_drafted_total",
                     "spec_accepted_total", "spec_accept_buckets",
                     "spec_accept_hist", "spec_accept_sum",
@@ -1661,6 +1708,31 @@ class ModelServer:
                 f"ptpu_serving_spec_accepted_total "
                 f"{es['spec_accepted_total']}",
             ]
+            if "mesh" in es:
+                # Mesh topology + the per-step device-share counters
+                # (meshed engines only).  Axis sizes render as one
+                # labeled gauge per active axis; the step counters
+                # feed the bench's tp=1-vs-tpN collective-share
+                # derivation (see engine.stats()).
+                lines += [
+                    "# TYPE ptpu_serving_mesh_devices gauge",
+                    f"ptpu_serving_mesh_devices {es['mesh_devices']}",
+                    "# TYPE ptpu_serving_mesh_axis_size gauge",
+                ]
+                for axis, size in sorted(es["mesh"]["axes"].items()):
+                    lines.append(
+                        f'ptpu_serving_mesh_axis_size{{axis="{axis}"}}'
+                        f' {size}')
+                lines += [
+                    "# TYPE ptpu_serving_step_device_seconds_total "
+                    "counter",
+                    f"ptpu_serving_step_device_seconds_total "
+                    f"{es['step_device_seconds_total']}",
+                    "# TYPE ptpu_serving_step_wall_seconds_total "
+                    "counter",
+                    f"ptpu_serving_step_wall_seconds_total "
+                    f"{es['step_wall_seconds_total']}",
+                ]
             if "kv_pages" in es:
                 # Paged-KV page-pool gauges (kv_paged engines only):
                 # the occupancy surface the block-table refactor

@@ -64,6 +64,7 @@ occupancy managed above it.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Optional
 
@@ -241,7 +242,7 @@ class SlotKVManager:
 
     def __init__(self, model, variables, n_slots: int,
                  draft_model=None, draft_variables=None,
-                 sentinel=None):
+                 sentinel=None, mesh=None):
         self.model = model
         self.variables = variables
         # Draft model for SPECULATIVE slots (optional): its per-slot
@@ -249,6 +250,15 @@ class SlotKVManager:
         # program's draft scan.
         self.draft_model = draft_model
         self.draft_variables = draft_variables
+        # Serving mesh (serving/meshed.py): when set, the stacked
+        # pools live under NamedSharding (heads over tp, slot axis
+        # over dp) and every step/insert program compiles with
+        # EXPLICIT in/out shardings under the serving-exact
+        # constraint mode — meshed output is token-bitwise-identical
+        # to unmeshed (docs/SERVING.md "Meshed serving").
+        self.mesh = mesh
+        self._cache_sh = None         # stacked-pool shardings pytree
+        self._draft_cache_sh = None
         # Recompile sentinel (analysis/recompile.py): every step/
         # insert program build is a counted compile-cache miss, so a
         # steady-state recompile storm (an unbounded key leaking into
@@ -260,7 +270,7 @@ class SlotKVManager:
         self._draft_stacked = None    # draft pytree, leaves [S, ...]
         self._free = list(range(self.n_slots))
         self._step_fns = {}           # (window, variant) -> jitted scan
-        self._insert_fn = None
+        self._insert_fns = {}         # draft? -> jitted insert
         # Host-side per-slot decode state (fed to the step program).
         self.tokens = np.zeros((self.n_slots,), np.int32)
         self.positions = np.zeros((self.n_slots,), np.int32)
@@ -327,27 +337,39 @@ class SlotKVManager:
 
     # -- device programs ------------------------------------------------
 
+    def _exact(self):
+        """Serving-exact trace context (no-op unmeshed) — wraps every
+        call that can TRACE a program over sharded operands."""
+        return self.mesh.exact() if self.mesh is not None \
+            else contextlib.nullcontext()
+
+    def _alloc_stacked(self, template_cache):
+        """Zero-init the [S, ...] pool; meshed pools are committed to
+        their NamedShardings at birth (heads over tp, slots over dp)."""
+        import jax
+        import jax.numpy as jnp
+
+        stacked = jax.tree.map(
+            lambda l: jnp.zeros((self.n_slots,) + l.shape, l.dtype),
+            template_cache)
+        if self.mesh is not None:
+            sh = self.mesh.cache_shardings(stacked, slot_axis=True)
+            return self.mesh.place_cache(stacked, slot_axis=True), sh
+        return stacked, None
+
     def _ensure_stacked(self, template_cache) -> None:
         """Allocate the stacked pool lazily from the FIRST prefilled
         cache's tree (guarantees the template matches what prefill
         actually produces — int8 scale leaves, ring position tables,
         scan-stacked layers all included)."""
-        import jax
-        import jax.numpy as jnp
-
         if self._stacked is None:
-            self._stacked = jax.tree.map(
-                lambda l: jnp.zeros((self.n_slots,) + l.shape, l.dtype),
-                template_cache)
+            self._stacked, self._cache_sh = \
+                self._alloc_stacked(template_cache)
 
     def _ensure_draft_stacked(self, template_cache) -> None:
-        import jax
-        import jax.numpy as jnp
-
         if self._draft_stacked is None:
-            self._draft_stacked = jax.tree.map(
-                lambda l: jnp.zeros((self.n_slots,) + l.shape, l.dtype),
-                template_cache)
+            self._draft_stacked, self._draft_cache_sh = \
+                self._alloc_stacked(template_cache)
 
     def insert(self, slot: int, cache, first_token: int,
                position: int, *, base_key=None, next_index: int = 1,
@@ -370,26 +392,14 @@ class SlotKVManager:
         prefill of the same prompt) and ``spec_k`` > 0; the spec step
         program drafts/verifies/commits up to ``spec_k`` tokens per
         round for this slot."""
-        import jax
-
         self._ensure_stacked(cache)
-        if self._insert_fn is None:
-            if self.sentinel is not None:
-                self.sentinel.miss("slot_insert")
-
-            def _insert(stacked, one, idx):
-                return jax.tree.map(
-                    lambda s, n: jax.lax.dynamic_update_index_in_dim(
-                        s, n.astype(s.dtype), idx, 0), stacked, one)
-            self._insert_fn = jax.jit(_insert)
-        self._stacked = self._insert_fn(self._stacked, cache, slot)
-        if draft_cache is not None:
-            # Same jitted insert program — jax.jit caches per pytree
-            # structure, so the draft tree gets its own compiled
-            # specialization without a second closure to maintain.
-            self._ensure_draft_stacked(draft_cache)
-            self._draft_stacked = self._insert_fn(
-                self._draft_stacked, draft_cache, slot)
+        with self._exact():
+            self._stacked = self._get_insert_fn(False)(
+                self._stacked, cache, slot)
+            if draft_cache is not None:
+                self._ensure_draft_stacked(draft_cache)
+                self._draft_stacked = self._get_insert_fn(True)(
+                    self._draft_stacked, draft_cache, slot)
         self.tokens[slot] = first_token
         self.positions[slot] = position
         if base_key is not None:
@@ -402,11 +412,51 @@ class SlotKVManager:
         self.top_ps[slot] = top_p
         self.spec_ks[slot] = spec_k
 
+    def _get_insert_fn(self, draft: bool):
+        """Jitted slot insert for the target (or draft) pool.  One
+        program per pool: meshed pools pin EXPLICIT in/out shardings
+        so the write keeps the pool committed to its layout — an
+        XLA-chosen output sharding drifting to replicated would force
+        a reshard on every subsequent step."""
+        import jax
+
+        fn = self._insert_fns.get(draft)
+        if fn is not None:
+            return fn
+        if self.sentinel is not None:
+            self.sentinel.miss("slot_insert",
+                               "draft" if draft else "target")
+
+        def _insert(stacked, one, idx):
+            return jax.tree.map(
+                lambda s, n: jax.lax.dynamic_update_index_in_dim(
+                    s, n.astype(s.dtype), idx, 0), stacked, one)
+
+        if self.mesh is not None:
+            sh = self._draft_cache_sh if draft else self._cache_sh
+            fn = jax.jit(_insert, in_shardings=(sh, None, None),
+                         out_shardings=sh)
+        else:
+            fn = jax.jit(_insert)
+        self._insert_fns[draft] = fn
+        return fn
+
     def _build_step(self, window: int, sampled: bool):
         import jax
 
-        return jax.jit(build_step_body(self.model, self.variables,
-                                       window, sampled))
+        body = build_step_body(self.model, self.variables, window,
+                               sampled)
+        if self.mesh is None:
+            return jax.jit(body)
+        # Explicit in/out shardings: the cache stays pinned to its
+        # (heads-over-tp, slots-over-dp) layout across steps, host
+        # operands (tokens/positions/sampling state) commit
+        # replicated, and token outputs gather back replicated.
+        rep = self.mesh.replicated
+        n_extra = 5 if sampled else 0
+        in_sh = (self._cache_sh, rep, rep) + (rep,) * n_extra
+        return jax.jit(body, in_shardings=in_sh,
+                       out_shardings=(rep, self._cache_sh))
 
     def step(self, window: int = 1, sampled: bool = False
              ) -> np.ndarray:
@@ -434,17 +484,19 @@ class SlotKVManager:
         elif self.sentinel is not None:
             self.sentinel.hit("slot_step", (window, sampled))
         t0 = time.perf_counter()
-        if sampled:
-            outs, self._stacked = fn(
-                self._stacked, jnp.asarray(self.tokens),
-                jnp.asarray(self.positions), jnp.asarray(self.keys),
-                jnp.asarray(self.next_index),
-                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-                jnp.asarray(self.top_ps))
-        else:
-            outs, self._stacked = fn(
-                self._stacked, jnp.asarray(self.tokens),
-                jnp.asarray(self.positions))
+        with self._exact():
+            if sampled:
+                outs, self._stacked = fn(
+                    self._stacked, jnp.asarray(self.tokens),
+                    jnp.asarray(self.positions),
+                    jnp.asarray(self.keys),
+                    jnp.asarray(self.next_index),
+                    jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                    jnp.asarray(self.top_ps))
+            else:
+                outs, self._stacked = fn(
+                    self._stacked, jnp.asarray(self.tokens),
+                    jnp.asarray(self.positions))
         outs = np.asarray(jax.device_get(outs))
         self.last_step_device_s = time.perf_counter() - t0
         # Arm the next step: every slot feeds back its own last token
@@ -486,9 +538,16 @@ class SlotKVManager:
         and rewind to position + 1."""
         import jax
 
-        return jax.jit(build_spec_step_body(
+        body = build_spec_step_body(
             self.model, self.variables, self.draft_model,
-            self.draft_variables, window, K))
+            self.draft_variables, window, K)
+        if self.mesh is None:
+            return jax.jit(body)
+        rep = self.mesh.replicated
+        in_sh = (self._cache_sh, self._draft_cache_sh) + (rep,) * 8
+        return jax.jit(body, in_shardings=in_sh,
+                       out_shardings=(rep, rep, rep, self._cache_sh,
+                                      self._draft_cache_sh))
 
     def step_spec(self, window: int, K: int):
         """``window`` fused SPECULATIVE rounds across the whole pool.
@@ -516,12 +575,13 @@ class SlotKVManager:
         elif self.sentinel is not None:
             self.sentinel.hit("slot_step", (window, "spec", K))
         t0 = time.perf_counter()
-        outs, cs, ms, self._stacked, self._draft_stacked = fn(
-            self._stacked, self._draft_stacked,
-            jnp.asarray(self.tokens), jnp.asarray(self.positions),
-            jnp.asarray(self.next_index), jnp.asarray(self.keys),
-            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
+        with self._exact():
+            outs, cs, ms, self._stacked, self._draft_stacked = fn(
+                self._stacked, self._draft_stacked,
+                jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                jnp.asarray(self.next_index), jnp.asarray(self.keys),
+                jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+                jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
         outs = np.asarray(jax.device_get(outs))
         cs = np.asarray(jax.device_get(cs))
         ms = np.asarray(jax.device_get(ms))

@@ -453,6 +453,73 @@ def test_page_ref_scoped_to_serving():
     assert _rules(src, "polyaxon_tpu/tracking/thing.py") == []
 
 
+# -- SHARD-LEAK -------------------------------------------------------------
+
+
+def test_shard_leak_flags_uncommitted_device_put():
+    src = """
+    import jax
+
+    def admit(self, cache):
+        return jax.device_put(cache)
+    """
+    assert _rules(src) == ["SHARD-LEAK"]
+
+
+def test_shard_leak_allows_committed_placement():
+    src = """
+    import jax
+
+    def admit(self, cache, sharding):
+        a = jax.device_put(cache, sharding)
+        b = jax.device_put(cache, device=sharding)
+        c = self.mesh.put_replicated(cache)
+        return a, b, c
+    """
+    assert _rules(src) == []
+
+
+def test_shard_leak_flags_pool_alloc_outside_helpers():
+    """Pool state born outside the _alloc*/_ensure* helpers skips
+    the mesh placement — an unsharded pool silently demotes every
+    step program to replicated layout."""
+    src = """
+    import jax.numpy as jnp
+
+    def reset(self):
+        self._stacked = jnp.zeros((4, 8))
+
+    def _ensure_stacked(self, template):
+        self._stacked = jnp.zeros((4, 8))
+
+    def _alloc_pool(self, metas):
+        self._pool = jnp.zeros((4, 8))
+    """
+    assert _rules(src) == ["SHARD-LEAK"]
+
+
+def test_shard_leak_pool_assign_without_alloc_passes():
+    """Rebinding pool state to a step program's OUTPUT (or clearing
+    it) is the normal step loop, not an allocation."""
+    src = """
+    def step(self, fn, toks):
+        outs, self._stacked = fn(self._stacked, toks)
+        self._pool = None
+        return outs
+    """
+    assert _rules(src) == []
+
+
+def test_shard_leak_scoped_to_serving():
+    src = """
+    import jax
+
+    def elsewhere(x):
+        return jax.device_put(x)
+    """
+    assert _rules(src, "polyaxon_tpu/tracking/thing.py") == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 

@@ -21,22 +21,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _abstract_mesh_available() -> bool:
-    """Env prerequisite for the probe-compiling flop-reconciliation
-    tests: the sharding-constraint layer (parallel/constraints.py)
-    calls ``jax.sharding.get_abstract_mesh`` inside every traced
-    forward, which this environment's jax may not expose — a known
-    gap that fails these tests at the seed, not a bench regression."""
-    import jax
-
-    return hasattr(jax.sharding, "get_abstract_mesh")
-
-
-requires_abstract_mesh = pytest.mark.skipif(
-    not _abstract_mesh_available(),
-    reason="jax.sharding.get_abstract_mesh missing (known env "
-           "prerequisite for the probe-compile path; fails at the "
-           "seed)")
+# The probe-compiling flop-reconciliation tests used to skip when
+# ``jax.sharding.get_abstract_mesh`` was missing (older jax): the
+# sharding-constraint layer called it unconditionally inside every
+# traced forward.  The meshed-serving work made constraints.py guard
+# that probe (hasattr fallback), so the compile path works on every
+# supported jax and the skip is gone.
 
 
 def _load_bench(tmp_path=None):
@@ -364,7 +354,6 @@ class TestFlopReconciliation:
     unrolled L=1/L=2 probes and (on TPU) adds back the pallas-invisible
     attention term."""
 
-    @requires_abstract_mesh
     def test_linear_in_depth_reconstruction(self):
         import jax
 
@@ -386,7 +375,6 @@ class TestFlopReconciliation:
                                   "num_layers": 4}, None)
         assert abs(predicted - f4) / f4 < 0.05
 
-    @requires_abstract_mesh
     def test_bridge_exceeds_scanned_count(self):
         import jax
 

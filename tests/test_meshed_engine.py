@@ -1,0 +1,468 @@
+"""Meshed (tensor-parallel) serving engine (serving/meshed.py).
+
+The defining contract, in test form: a ``--mesh tp=N`` engine is
+TOKEN-BITWISE-IDENTICAL to the unmeshed engine — and to unmeshed solo
+generation — per seed, for plain, sampled, and speculative streams,
+under any co-tenancy or admission schedule, per mesh shape.  The
+exact serving layout makes this possible by construction (no float
+reduction crosses a device boundary: column-parallel matmuls keep
+accumulation order, attention shards per-head, the pre-contraction
+constrain sites all-gather instead of psum — see
+docs/SERVING.md "Meshed serving"), and these tests pin it on the
+conftest's 8 virtual host devices.
+
+Also pinned: paged-on-mesh page poison (freed-page reuse never leaks
+across shards), zero steady-state compile-cache misses per mesh
+shape, the server surface (warm==cold with a mesh, /info + /metrics
+topology), dp slot-parallelism, expert-parallel moe_gpt, and the
+clean startup errors for indivisible head/expert/slot counts.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models.generate import (
+    generate,
+    generate_positional,
+    generate_speculative,
+)
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import (DecodeEngine, MeshError,
+                                  SchedulerPolicy, ServingMesh,
+                                  parse_mesh)
+from polyaxon_tpu.serving.scheduler import SamplingSpec
+
+PROMPT = np.asarray([[3, 1, 4, 1]], np.int32)
+P2 = np.asarray([[2, 7, 1, 8]], np.int32)
+P3 = np.asarray([[5, 6, 7, 8]], np.int32)
+SAMP = SamplingSpec(seed=7, temperature=1.0, top_k=8)
+SPEC = SamplingSpec(seed=7, temperature=0.9, top_k=16, spec_k=3)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    # 4 heads so tp=1/2/4 all divide.
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=4, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def draft_vars(small_model):
+    model, _ = small_model
+    return model.init(jax.random.PRNGKey(99),
+                      jnp.zeros((1, 4), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def refs(small_model, draft_vars):
+    """UNMESHED solo references — the oracle every meshed engine run
+    must equal bitwise."""
+    model, variables = small_model
+    return {
+        "plain": np.asarray(generate(
+            model, variables, PROMPT, max_new_tokens=12)).tolist(),
+        "sampled": np.asarray(generate_positional(
+            model, variables, PROMPT, max_new_tokens=12, seed=7,
+            temperature=1.0, top_k=8)).tolist(),
+        "spec": np.asarray(generate_speculative(
+            model, variables, model, draft_vars, PROMPT,
+            max_new_tokens=12, k=3, seed=7, temperature=0.9,
+            top_k=16)).tolist(),
+    }
+
+
+def _engine(model, variables, dvars=None, *, mesh, paged=False,
+            **policy):
+    kw = dict(n_slots=4, decode_window=8)
+    if paged:
+        kw.update(kv_paged=True, kv_page_tokens=8)
+    kw.update(policy)
+    extra = {}
+    if dvars is not None:
+        extra = dict(draft_model=model, draft_variables=dvars)
+    return DecodeEngine(model, variables, autostart=False,
+                        policy=SchedulerPolicy(**kw), mesh=mesh,
+                        **extra)
+
+
+def _submit_all(eng):
+    return {
+        "plain": eng.submit(PROMPT, 12, None, None),
+        "sampled": eng.submit(PROMPT, 12, None, None, sampling=SAMP),
+        "spec": eng.submit(PROMPT, 12, None, None, sampling=SPEC),
+    }
+
+
+# -- determinism matrix: tp shape x mode x co-tenancy schedule ---------------
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+def test_matrix_meshed_equals_unmeshed_solo(tp, small_model,
+                                            draft_vars, refs):
+    """Per mesh shape, plain/sampled/spec streams equal the UNMESHED
+    solo references bitwise under three co-tenancy schedules: alone,
+    admitted beside running co-tenants, and slot-starved."""
+    model, variables = small_model
+    mesh = f"tp={tp}"
+
+    # 1) alone
+    eng = _engine(model, variables, draft_vars, mesh=mesh)
+    groups = _submit_all(eng)
+    eng.run_until_idle()
+    for kind, g in groups.items():
+        assert g.result().tolist() == refs[kind], (tp, "alone", kind)
+
+    # 2) co-tenants mid-flight when the pinned streams are admitted
+    eng = _engine(model, variables, draft_vars, mesh=mesh, n_slots=6)
+    a = eng.submit(P2, 16, None, None)
+    b = eng.submit(P3, 16, None, None,
+                   sampling=SamplingSpec(seed=3, temperature=1.0))
+    for _ in range(3):
+        eng.tick()
+    groups = _submit_all(eng)
+    eng.run_until_idle()
+    for kind, g in groups.items():
+        assert g.result().tolist() == refs[kind], (tp, "cotenant",
+                                                   kind)
+    assert a.result().tolist() == np.asarray(generate(
+        model, variables, P2, max_new_tokens=16)).tolist()
+    assert b.result().tolist() == np.asarray(generate_positional(
+        model, variables, P3, max_new_tokens=16, seed=3,
+        temperature=1.0)).tolist()
+
+    # 3) slot-starved: queued behind residents, admitted into
+    #    recycled (evicted) slots
+    eng = _engine(model, variables, draft_vars, mesh=mesh, n_slots=2)
+    others = [eng.submit(np.asarray([[i, i + 1, 2, 3]], np.int32),
+                         4 + i, None, None) for i in range(2)]
+    groups = _submit_all(eng)
+    eng.run_until_idle()
+    for kind, g in groups.items():
+        assert g.result().tolist() == refs[kind], (tp, "starved",
+                                                   kind)
+    del others
+
+
+def test_meshed_engine_equals_unmeshed_engine(small_model):
+    """Engine-vs-engine: one mixed co-tenancy run, byte-identical
+    responses meshed and unmeshed — the mesh changes placement,
+    never tokens."""
+    model, variables = small_model
+    results = []
+    for mesh in (None, "tp=2"):
+        eng = _engine(model, variables, mesh=mesh)
+        groups = [
+            eng.submit(PROMPT, 12, None, None),
+            eng.submit(P3, 10, None, None,
+                       sampling=SamplingSpec(seed=3,
+                                             temperature=1.0)),
+            eng.submit(np.asarray([[9, 8, 7, 6]], np.int32), 6,
+                       None, None),
+        ]
+        eng.run_until_idle()
+        results.append([g.result().tolist() for g in groups])
+    assert results[0] == results[1]
+
+
+def test_kv_pool_actually_sharded(small_model):
+    """The stacked KV pool's cache leaves really are distributed
+    over tp (not silently replicated), and stay so after stepping."""
+    model, variables = small_model
+    eng = _engine(model, variables, mesh="tp=4")
+    g = eng.submit(PROMPT, 8, None, None)
+    eng.run_until_idle()
+    assert g.error is None
+    leaves = [l for l in jax.tree.leaves(eng.slots._stacked)
+              if getattr(l, "ndim", 0) >= 3]
+    assert leaves
+    assert all(not l.sharding.is_fully_replicated for l in leaves)
+    # column-parallel params are sharded too
+    qkv = [v for path, v in jax.tree_util.tree_leaves_with_path(
+        eng.variables["params"])
+        if "qkv" in str(path) and "kernel" in str(path)]
+    assert qkv and not qkv[0].sharding.is_fully_replicated
+
+
+def test_dp_slot_parallel(small_model, refs):
+    """dp shards the SLOT axis of the fixed-lane pool: per-slot math
+    is untouched, tokens stay bitwise."""
+    model, variables = small_model
+    eng = _engine(model, variables, mesh="dp=2,tp=2")
+    g = eng.submit(PROMPT, 12, None, None)
+    s = eng.submit(PROMPT, 12, None, None, sampling=SAMP)
+    eng.run_until_idle()
+    assert g.result().tolist() == refs["plain"]
+    assert s.result().tolist() == refs["sampled"]
+
+
+# -- paged on mesh -----------------------------------------------------------
+
+
+def test_paged_on_mesh_equals_fixed_and_solo(small_model, refs):
+    model, variables = small_model
+    eng = _engine(model, variables, mesh="tp=2", paged=True)
+    g = eng.submit(PROMPT, 12, None, None)
+    s = eng.submit(PROMPT, 12, None, None, sampling=SAMP)
+    eng.run_until_idle()
+    assert g.result().tolist() == refs["plain"]
+    assert s.result().tolist() == refs["sampled"]
+    assert eng.slots.free_page_count() == eng.slots.n_pages
+    sharded = [l for l in eng.slots._pool if l is not None]
+    assert sharded
+    assert all(not l.sharding.is_fully_replicated for l in sharded)
+
+
+def test_paged_on_mesh_freed_page_poison(small_model):
+    """Page poison on a mesh: decoding in RECYCLED pages (freed by a
+    finished co-tenant) matches the fresh-pool run bitwise — stale
+    bytes in any shard of a freed page are dead."""
+    model, variables = small_model
+    p2 = np.asarray([[9, 8, 7, 6]], np.int32)
+    eng = _engine(model, variables, mesh="tp=2", paged=True,
+                  kv_pages=6)
+    g = eng.submit(p2, 12, None, None,
+                   sampling=SamplingSpec(seed=11, temperature=1.0))
+    eng.run_until_idle()
+    want = g.result().tolist()
+    eng = _engine(model, variables, mesh="tp=2", paged=True,
+                  kv_pages=6)
+    a = eng.submit(PROMPT, 30, None, None)   # touches most pages
+    eng.run_until_idle()
+    assert eng.slots.free_page_count() == 6
+    g = eng.submit(p2, 12, None, None,
+                   sampling=SamplingSpec(seed=11, temperature=1.0))
+    eng.run_until_idle()
+    assert g.result().tolist() == want
+    del a
+
+
+# -- recompiles --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_zero_steady_state_recompiles_per_mesh_shape(tp, small_model):
+    """Warm-twice-then-flat per mesh shape: same-shaped traffic on a
+    warmed meshed engine adds ZERO compile-cache misses — mesh
+    placement enters no program key beyond the shape class."""
+    model, variables = small_model
+
+    def round_(eng):
+        gs = [
+            eng.submit(PROMPT, 10, None, None),
+            eng.submit(P3, 7, None, None,
+                       sampling=SamplingSpec(seed=3, temperature=0.8,
+                                             top_k=8)),
+        ]
+        eng.run_until_idle()
+        return gs
+
+    eng = _engine(model, variables, mesh=f"tp={tp}")
+    round_(eng)
+    round_(eng)
+    warm = eng.sentinel.misses
+    assert warm > 0
+    for _ in range(3):
+        round_(eng)
+    assert eng.sentinel.misses == warm, eng.sentinel.snapshot()
+
+
+# -- server surface ----------------------------------------------------------
+
+
+class TestMeshedServer:
+    def _server(self, small_model, **kw):
+        from polyaxon_tpu.serving import ModelServer
+
+        model, variables = small_model
+        args = dict(model_name="t", max_batch=2, n_slots=4,
+                    prefix_cache=4, mesh="tp=2")
+        args.update(kw)
+        return ModelServer(model, variables, **args)
+
+    def test_warm_equals_cold_and_topology_exported(self,
+                                                    small_model):
+        ms = self._server(small_model)
+        try:
+            sys_p = list(range(1, 21))
+            body = {"prompt": sys_p + [25, 26], "max_new_tokens": 8,
+                    "temperature": 0.9, "top_k": 8, "seed": 5}
+            cold = ms.generate(dict(body))
+            ms.prefill_prompt({"prompt": sys_p})
+            warm = ms.generate(dict(body))
+            assert warm["new_tokens"] == cold["new_tokens"]
+            assert warm["prefix_hit_len"] == len(sys_p)
+            info = ms.info()
+            assert info["mesh"]["axes"] == {"tp": 2}
+            assert info["mesh_devices"] == 2
+            assert info["step_device_seconds_total"] > 0
+            text = ms.metrics_text()
+            assert "ptpu_serving_mesh_devices 2" in text
+            assert 'ptpu_serving_mesh_axis_size{axis="tp"} 2' in text
+            assert "ptpu_serving_step_device_seconds_total" in text
+            from polyaxon_tpu.serving.telemetry import \
+                parse_prometheus_text
+            parse_prometheus_text(text)
+        finally:
+            ms.close()
+
+    def test_meshed_server_matches_unmeshed_server(self, small_model):
+        want = None
+        body = {"prompt": [3, 1, 4, 1, 5, 9], "max_new_tokens": 10,
+                "temperature": 0.9, "top_k": 8, "seed": 5}
+        for mesh in (None, "tp=2"):
+            ms = self._server(small_model, mesh=mesh, prefix_cache=0)
+            try:
+                got = ms.generate(dict(body))["new_tokens"]
+            finally:
+                ms.close()
+            if want is None:
+                want = got
+            else:
+                assert got == want
+
+    def test_paged_server_on_mesh_shares_pages(self, small_model):
+        ms = self._server(small_model, kv_paged=True,
+                          kv_page_tokens=8)
+        try:
+            sys_p = list(range(1, 21))
+            ms.prefill_prompt({"prompt": sys_p})
+            r = ms.generate({"prompt": sys_p + [25, 26],
+                             "max_new_tokens": 8})
+            assert r["prefix_hit_len"] == len(sys_p)
+            info = ms.info()
+            assert info["kv_paged"] is True
+            assert info["mesh"]["axes"] == {"tp": 2}
+        finally:
+            ms.close()
+
+    def test_trace_report_shows_mesh(self, small_model, tmp_path):
+        import json as _json
+
+        ms = self._server(small_model, prefix_cache=0)
+        try:
+            ms.generate({"prompt": [1, 2, 3, 4],
+                         "max_new_tokens": 6})
+            trace = tmp_path / "trace.json"
+            trace.write_text(_json.dumps(ms.telemetry.chrome_trace()))
+        finally:
+            ms.close()
+        import sys as _sys
+        _sys.path.insert(0, "benchmarks")
+        try:
+            import trace_report
+        finally:
+            _sys.path.pop(0)
+        eng = trace_report.engine_stats(
+            trace_report.load_trace_events(str(trace)))
+        assert eng["mesh"] == "tp=2"
+
+
+# -- clean errors ------------------------------------------------------------
+
+
+class TestCleanErrors:
+    def test_indivisible_heads(self):
+        cfg = dataclasses.replace(
+            GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+            num_layers=1, num_heads=2, max_position=64,
+            dtype=jnp.float32)
+        model = GPT2Model(cfg=cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))
+        with pytest.raises(MeshError, match="num_heads=2.*tp=4"):
+            DecodeEngine(model, variables, autostart=False,
+                         policy=SchedulerPolicy(n_slots=4),
+                         mesh="tp=4")
+
+    def test_indivisible_kv_heads_named_in_error(self):
+        from polyaxon_tpu.models.llama import LlamaConfig, LlamaModel
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(),
+                                  dtype=jnp.float32)  # kv heads 2
+        model = LlamaModel(cfg=cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))
+        with pytest.raises(MeshError, match="num_kv_heads=2.*tp=4"):
+            DecodeEngine(model, variables, autostart=False,
+                         policy=SchedulerPolicy(n_slots=4),
+                         mesh="tp=4")
+
+    def test_indivisible_slots_for_dp(self, small_model):
+        model, variables = small_model
+        with pytest.raises(MeshError, match="n_slots"):
+            DecodeEngine(model, variables, autostart=False,
+                         policy=SchedulerPolicy(n_slots=3),
+                         mesh="dp=2")
+
+    def test_paged_rejects_dp(self, small_model):
+        model, variables = small_model
+        with pytest.raises(ValueError, match="dp slot parallelism"):
+            DecodeEngine(model, variables, autostart=False,
+                         policy=SchedulerPolicy(
+                             n_slots=4, kv_paged=True,
+                             kv_page_tokens=8),
+                         mesh="dp=2")
+
+    def test_parse_rejects_training_axes_and_typos(self):
+        with pytest.raises(MeshError, match="training"):
+            parse_mesh("fsdp=2")
+        with pytest.raises(MeshError, match="AXIS=SIZE"):
+            parse_mesh("tp4")
+        with pytest.raises(MeshError):
+            parse_mesh("warp=2")
+        spec = parse_mesh("tp=2,ep=2")
+        assert (spec.tp, spec.ep, spec.dp) == (2, 2, 1)
+
+    def test_too_few_devices(self):
+        with pytest.raises(MeshError, match="devices"):
+            ServingMesh("tp=16")
+
+    def test_server_mesh_requires_continuous(self, small_model):
+        from polyaxon_tpu.serving import ModelServer
+
+        model, variables = small_model
+        with pytest.raises(ValueError, match="mesh requires"):
+            ModelServer(model, variables, batching="coalesce",
+                        mesh="tp=2")
+
+
+# -- expert parallelism ------------------------------------------------------
+
+
+def test_moe_gpt_experts_over_ep(small_model):
+    """moe_gpt routes experts over the ep axis: expert params are
+    distributed, decode gathers the routed expert cross-device, and
+    tokens stay bitwise vs unmeshed."""
+    from polyaxon_tpu.models.moe_gpt import MoEGPTConfig, MoEGPTModel
+
+    cfg = dataclasses.replace(
+        MoEGPTConfig.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=4, num_experts=4, max_position=64,
+        dtype=jnp.float32)
+    model = MoEGPTModel(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    want_g = np.asarray(generate(model, variables, PROMPT,
+                                 max_new_tokens=10)).tolist()
+    want_s = np.asarray(generate_positional(
+        model, variables, PROMPT, max_new_tokens=10, seed=7,
+        temperature=1.0, top_k=8)).tolist()
+    eng = _engine(model, variables, mesh="tp=2,ep=2")
+    g = eng.submit(PROMPT, 10, None, None)
+    s = eng.submit(PROMPT, 10, None, None, sampling=SAMP)
+    eng.run_until_idle()
+    assert g.result().tolist() == want_g
+    assert s.result().tolist() == want_s
+    experts = [v for path, v in jax.tree_util.tree_leaves_with_path(
+        eng.variables["params"]) if "experts_w1" in str(path)]
+    assert experts and not experts[0].sharding.is_fully_replicated

@@ -66,7 +66,8 @@ def test_ring_cache_is_o_window():
     _, ring_cfg = _cfgs(window=8, max_position=2048)
     model = LlamaModel(cfg=ring_cfg)
     cache = G.init_cache(model, 2)
-    key_shapes = [v.shape for p, v in jax.tree.leaves_with_path(cache)
+    key_shapes = [v.shape
+                  for p, v in jax.tree_util.tree_leaves_with_path(cache)
                   if "cached_key'" in str(p)]
     assert key_shapes and all(s[2] == 8 + 1 for s in key_shapes), \
         key_shapes  # [layers, B, window+1, H, D]
