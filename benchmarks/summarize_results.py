@@ -136,15 +136,20 @@ def meshed_value(r):
     """serving-load rows: the MESHED leg's headline — token parity +
     timed-recompile health of the tp=4 arm vs tp=1 (the host-device
     criterion: correctness, not speedup) with the derived
-    collective-time share.  Empty for every other bench."""
+    collective-time share, plus the flight recorder's trace-TRUE
+    share when a profiled window landed during the timed arm
+    (``collP``; the host-mesh estimate's device-truth check).  Empty
+    for every other bench."""
     m = r.get("meshed") or {}
     if not m:
         return ""
     ok = m.get("tokens_equal") and not m.get("compile_misses_timed")
     share = m.get("collective_share_tp4")
+    prof = m.get("collective_share_profiled_tp4")
     return (("ok" if ok else "FAIL")
             + f" tp4/tp1 {m.get('agg_ratio_tp4_vs_tp1')}x"
-            + (f" coll {share}" if share is not None else ""))
+            + (f" coll {share}" if share is not None else "")
+            + (f" collP {prof}" if prof is not None else ""))
 
 
 def telemetry_value(r):
@@ -156,6 +161,19 @@ def telemetry_value(r):
     return "" if pct is None else f"{pct}%"
 
 
+def recorder_value(r):
+    """serving-load rows: the flight-recorder overhead A/B column —
+    the periodic-profiler-window tax in % agg tok/s (same <= ~3%
+    contract as telemetry), with the window count.  Empty for every
+    other bench."""
+    ov = r.get("recorder_overhead") or {}
+    pct = ov.get("overhead_pct")
+    if pct is None:
+        return ""
+    w = ov.get("windows")
+    return f"{pct}%" + (f" ({w}w)" if w is not None else "")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -165,9 +183,10 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | paged | mesh | telemetry | overload | mfu "
-          "| age |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "| spec-mix | paged | mesh | telemetry | recorder "
+          "| overload | mfu | age |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+          "---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -187,6 +206,7 @@ def main() -> int:
               f"| {paged_value(r)} "
               f"| {meshed_value(r)} "
               f"| {telemetry_value(r)} "
+              f"| {recorder_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0

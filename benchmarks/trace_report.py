@@ -12,9 +12,18 @@ phase breakdown a bench run attaches next to its throughput numbers:
 - the engine step timeline: step wall p50/p95, mean occupancy
   (resident slots per dispatch, token-weighted utilization vs the
   pool width), tokens per step, and an occupancy-over-time strip so a
-  load run's ramp/drain phases are visible without opening Perfetto.
+  load run's ramp/drain phases are visible without opening Perfetto;
+- with ``--profile-report FILE`` (a saved ``GET /profile/report``
+  body — the flight recorder's parsed jax.profiler attribution,
+  serving/profiling.py): an ATTRIBUTION section — per profiled
+  window, the compute/collective/transfer/host-gap seconds and
+  shares plus serving MFU — and a HOST-GAP strip (one digit per
+  window, 0-9) beside the occupancy strip, so "is the engine device-
+  or host-bound, and when" reads off the same report as "how full
+  was the pool".
 
 Run: python benchmarks/trace_report.py TRACE_FILE [--json]
+     [--profile-report REPORT_JSON]
 """
 
 from __future__ import annotations
@@ -152,14 +161,58 @@ def compile_stats(events):
     }
 
 
-def summarize(path: str):
+def attribution_stats(report):
+    """Per-window attribution table + the host-gap strip from a
+    saved ``GET /profile/report`` body.  ``windows`` is the
+    recorder's bounded history, oldest first — one strip digit per
+    window (0-9 = host-gap share), so the strip reads like the
+    occupancy strip's device-truth twin."""
+    wins = [w for w in (report.get("windows") or [])
+            if w.get("wall_s")]
+    if not wins:
+        return None
+    rows = []
+    for w in wins:
+        rows.append({
+            "window": w.get("window"),
+            "steps": w.get("steps"),
+            "tokens": w.get("tokens"),
+            "wall_ms": round(1e3 * w["wall_s"], 3),
+            "compute_s": w["category_s"]["compute"],
+            "collective_s": w["category_s"]["collective"],
+            "transfer_s": w["category_s"]["transfer"],
+            "host_gap_s": w["host_gap_s"],
+            "collective_share": w["collective_share"],
+            "host_gap_share": w["host_gap_share"],
+            "device_busy_share": w["device_busy_share"],
+            "mfu": w.get("mfu"),
+        })
+    latest = rows[-1]
+    return {
+        "windows": rows,
+        "latest": latest,
+        "host_fallback": bool(wins[-1].get("host_fallback")),
+        "peak_flops_source": wins[-1].get("peak_flops_source"),
+        "host_gap_strip": "".join(
+            str(min(9, round(9 * r["host_gap_share"])))
+            for r in rows),
+    }
+
+
+def summarize(path: str, profile_report=None):
     events = load_trace_events(path)
+    attribution = None
+    if profile_report is not None:
+        with open(profile_report) as f:
+            attribution = attribution_stats(json.load(f))
     return {
         "trace": path,
         "events": len(events),
         "phases": phase_stats(events),
         "engine": engine_stats(events),
         "compiles": compile_stats(events),
+        **({"attribution": attribution}
+           if attribution is not None else {}),
     }
 
 
@@ -167,10 +220,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("trace", help="GET /trace JSON or --trace-file "
                                   "JSONL dump")
+    ap.add_argument("--profile-report", default=None,
+                    help="saved GET /profile/report JSON (flight "
+                         "recorder attribution) to render beside "
+                         "the trace summary")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
-    s = summarize(args.trace)
+    s = summarize(args.trace, profile_report=args.profile_report)
     if args.json:
         print(json.dumps(s, indent=2))
         return 0
@@ -198,6 +255,32 @@ def main() -> int:
         print(f"KV pages: mean {eng['mean_pages_used']} of "
               f"{eng['kv_pages_total']} in use; over time (0-9): "
               f"[{eng['page_occupancy_strip']}]")
+    att = s.get("attribution")
+    if att is not None:
+        note = []
+        if att.get("host_fallback"):
+            note.append("host-platform trace: XLA runtime threads "
+                        "stand in for device tracks")
+        if att.get("peak_flops_source") == "nominal":
+            note.append("MFU vs a NOMINAL 1 TF/s peak (unknown "
+                        "hardware) — a trend, not a hardware claim")
+        print("\n## attribution (flight-recorder windows, "
+              "device-truth)"
+              + (f" — {'; '.join(note)}" if note else ""))
+        print("| window | steps | tokens | wall ms | compute s "
+              "| collective s | transfer s | host-gap s "
+              "| coll share | gap share | busy share | mfu |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|---|")
+        for r in att["windows"]:
+            print(f"| {r['window']} | {r['steps']} | {r['tokens']} "
+                  f"| {r['wall_ms']} | {r['compute_s']} "
+                  f"| {r['collective_s']} | {r['transfer_s']} "
+                  f"| {r['host_gap_s']} | {r['collective_share']} "
+                  f"| {r['host_gap_share']} "
+                  f"| {r['device_busy_share']} "
+                  f"| {r['mfu'] if r['mfu'] is not None else ''} |")
+        print(f"host-gap per profiled window (0-9): "
+              f"[{att['host_gap_strip']}]")
     cc = s["compiles"]
     if cc is not None:
         print(f"\n## compile cache: {cc['compile_cache_misses']} "

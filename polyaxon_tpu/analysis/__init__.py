@@ -13,6 +13,11 @@ no hidden host syncs, no swallowed errors) become checked artifacts:
 - :mod:`locksan` / :mod:`recompile` — runtime sanitizers for what
   static analysis can't see: lock-order inversions / long holds, and
   steady-state recompile storms.
+- :mod:`xprof` — the ``jax.profiler`` Chrome-trace parser behind the
+  serving flight recorder (serving/profiling.py): classifies device
+  events into compute/collective/transfer, partitions a window's
+  wall into category + host-gap shares.  Pure stdlib, importable for
+  offline dump analysis.
 """
 
 from .baseline import (DEFAULT_BASELINE, apply_baseline,

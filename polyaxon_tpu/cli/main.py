@@ -536,6 +536,17 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Enable POST /profile/start|stop: jax.profiler "
                    "device traces land in timestamped subdirs here "
                    "(omit to keep the endpoints disabled).")
+@click.option("--profile-every", default=0, type=int,
+              help="FLIGHT RECORDER (needs --profile-dir): every N "
+                   "decode dispatches, wrap --profile-steps step "
+                   "boundaries in a jax.profiler window, auto-analyze "
+                   "the dump, and publish trace-true attribution — "
+                   "collective/host-gap/device-busy shares + serving "
+                   "MFU — as /metrics gauges and GET /profile/report. "
+                   "0 (default) disables.")
+@click.option("--profile-steps", default=8, type=int,
+              help="With --profile-every: decode dispatches per "
+                   "recorder window.")
 @click.option("--access-log", is_flag=True, default=False,
               help="One structured JSON line per request on stderr "
                    "(status, kind, rows, tokens, latency) — includes "
@@ -557,8 +568,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           default_priority, batch_queue_depth, queue_deadline_ms,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
-          trace_file, profile_dir, access_log, sanitize,
-          sanitize_max_hold, cpu):
+          trace_file, profile_dir, profile_every, profile_steps,
+          access_log, sanitize, sanitize_max_hold, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
     prefill later /generate requests skip; /trace exports the
@@ -602,6 +613,18 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     if trace_buffer < 0:
         # same fail-fast contract: no model build for a bad flag
         raise click.ClickException("--trace-buffer must be >= 0")
+    if profile_every < 0:
+        raise click.ClickException("--profile-every must be >= 0")
+    if profile_steps < 1:
+        raise click.ClickException("--profile-steps must be >= 1")
+    if profile_every and not profile_dir:
+        raise click.ClickException(
+            "--profile-every needs --profile-dir (the flight "
+            "recorder writes jax.profiler windows there)")
+    if profile_every and batching != "continuous":
+        raise click.ClickException(
+            "--profile-every requires --batching continuous (the "
+            "flight recorder windows decode-step boundaries)")
     if sanitize_max_hold is not None and not sanitize:
         raise click.ClickException(
             "--sanitize-max-hold requires --sanitize")
@@ -690,6 +713,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          spec_k=spec_k,
                          trace_buffer=trace_buffer,
                          profile_dir=profile_dir,
+                         profile_every=profile_every,
+                         profile_steps=profile_steps,
                          access_log=access_log,
                          sanitize=sanitize,
                          sanitize_max_hold_s=sanitize_max_hold,

@@ -25,6 +25,11 @@ path moved from request coalescing to continuous batching:
   NamedSharding, KV pools sharded over the heads axis, the exact
   (reduction-free) layout whose meshed output is token-bitwise
   identical to unmeshed serving.
+- ``profiling.py`` — the FLIGHT RECORDER (``--profile-every``):
+  periodic single-flight ``jax.profiler`` windows over decode-step
+  boundaries, auto-analyzed (analysis/xprof.py) into collective /
+  transfer / host-gap / device-busy shares + a serving-MFU estimate,
+  published as /metrics gauges and ``GET /profile/report``.
 - ``telemetry.py`` — trace-span ring (+ ``GET /trace`` Chrome trace
   export), shared latency/acceptance histograms, and the
   single-flight ``jax.profiler`` wrapper behind ``POST

@@ -265,10 +265,19 @@ class DecodeEngine:
         self.draining = False
         # Meshed step accounting: cumulative device wall (dispatch +
         # sync, from the manager's last_step_device_s) vs scheduling
-        # wall per decode dispatch — the observability the bench's
-        # tp=1-vs-tpN A/B derives its collective-time share from.
+        # wall per decode dispatch — a host-clock ESTIMATE of device
+        # time; the flight recorder below is the device-truth
+        # counterpart.
         self.step_device_s_total = 0.0
         self.step_wall_s_total = 0.0
+        # Flight recorder (serving/profiling.py): set by the owning
+        # server when --profile-every is armed.  None (the default)
+        # keeps the decode loop's cost at one attribute check per
+        # dispatch; armed, the recorder periodically wraps
+        # profile_steps dispatch boundaries in a jax.profiler window
+        # and publishes trace-true attribution (collective/host-gap/
+        # busy shares, serving MFU) to /metrics + /profile/report.
+        self.recorder = None
 
     def _exact(self):
         """Serving-exact trace context for engine-owned device calls
@@ -825,6 +834,16 @@ class DecodeEngine:
         self.queue.requeue_front(stream)
         return True
 
+    def mean_resident_position(self) -> float:
+        """Mean absolute decode position over resident slots (0.0
+        when the pool is empty) — the flight recorder's context-
+        length input to the per-token attention-flop term.  Engine
+        thread only (it reads the slot arrays the tick mutates)."""
+        if not self._resident:
+            return 0.0
+        return float(np.mean([self.slots.positions[s]
+                              for s in self._resident]))
+
     def run_until_idle(self, max_ticks: int = 100000) -> None:
         """Drain queue + slots synchronously (tests/offline use)."""
         for _ in range(max_ticks):
@@ -1223,6 +1242,8 @@ class DecodeEngine:
         sampled = any(s.sampling.sampled
                       for s in self._resident.values())
         occupancy = len(self._resident)
+        if self.recorder is not None:
+            self.recorder.on_step_start()
         t0 = time.perf_counter()
         try:
             with self.device_lock:
@@ -1248,6 +1269,8 @@ class DecodeEngine:
                 stream.slot = None
         self.step_device_s_total += self.slots.last_step_device_s
         self.step_wall_s_total += t1 - t0
+        if self.recorder is not None:
+            self.recorder.on_step_end(emitted)
         self.tel.step("step", t0, t1,
                       kind="sampled" if sampled else "plain",
                       window=window, occupancy=occupancy,
@@ -1270,6 +1293,8 @@ class DecodeEngine:
         (later tokens are discardable garbage, exactly like the
         windowed plain step)."""
         occupancy = len(self._resident)
+        if self.recorder is not None:
+            self.recorder.on_step_start()
         t0 = time.perf_counter()
         try:
             with self.device_lock:
@@ -1309,6 +1334,8 @@ class DecodeEngine:
                 stream.slot = None
         self.step_device_s_total += self.slots.last_step_device_s
         self.step_wall_s_total += t1 - t0
+        if self.recorder is not None:
+            self.recorder.on_step_end(emitted)
         self.tel.step("step", t0, t1, kind="spec", window=window,
                       k=K, occupancy=occupancy,
                       batch=self.slots.n_slots, tokens=emitted,

@@ -70,7 +70,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .slots import build_spec_step_body, build_step_body
+from .slots import (build_spec_step_body, build_step_body,
+                    step_annotation)
 
 __all__ = ["PagedSlotKVManager", "PageExhausted"]
 
@@ -772,7 +773,7 @@ class PagedSlotKVManager:
         tables = jnp.asarray(self.page_tables[:, :P])
         d0 = jnp.asarray(self._dirty_start(P, self._n_dirty(window)))
         t0 = time.perf_counter()
-        with self._exact():
+        with self._exact(), step_annotation():
             if sampled:
                 outs, self._pool = fn(
                     self._pool, tables, d0, jnp.asarray(self.tokens),
@@ -785,7 +786,9 @@ class PagedSlotKVManager:
                 outs, self._pool = fn(
                     self._pool, tables, d0, jnp.asarray(self.tokens),
                     jnp.asarray(self.positions))
-        outs = np.asarray(jax.device_get(outs))
+            # Sync inside the marker so it spans the device
+            # execution, not just the async enqueue (see slots.py).
+            outs = np.asarray(jax.device_get(outs))
         self.last_step_device_s = time.perf_counter() - t0
         self.tokens = outs[-1].copy()
         self.positions = self.positions + window
@@ -857,16 +860,17 @@ class PagedSlotKVManager:
         d0 = jnp.asarray(self._dirty_start(
             P, self._n_dirty(window * K + 1)))
         t0 = time.perf_counter()
-        with self._exact():
+        with self._exact(), step_annotation():
             outs, cs, ms, self._pool, self._draft_pool = fn(
                 self._pool, self._draft_pool, tables, d0,
                 jnp.asarray(self.tokens), jnp.asarray(self.positions),
                 jnp.asarray(self.next_index), jnp.asarray(self.keys),
                 jnp.asarray(self.temps), jnp.asarray(self.top_ks),
                 jnp.asarray(self.top_ps), jnp.asarray(self.spec_ks))
-        outs = np.asarray(jax.device_get(outs))
-        cs = np.asarray(jax.device_get(cs))
-        ms = np.asarray(jax.device_get(ms))
+            # Sync inside the marker — see the plain step.
+            outs = np.asarray(jax.device_get(outs))
+            cs = np.asarray(jax.device_get(cs))
+            ms = np.asarray(jax.device_get(ms))
         self.last_step_device_s = time.perf_counter() - t0
         rows = np.arange(self.n_slots)
         adv = cs.sum(axis=0).astype(np.int32)

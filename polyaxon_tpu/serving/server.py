@@ -200,6 +200,8 @@ class ModelServer:
                  mesh=None,
                  trace_buffer: int = 4096,
                  profile_dir: Optional[str] = None,
+                 profile_every: int = 0,
+                 profile_steps: int = 8,
                  access_log: bool = False,
                  sanitize: bool = False,
                  sanitize_max_hold_s: Optional[float] = None,
@@ -446,12 +448,57 @@ class ModelServer:
             # stored-but-idle prefix entries (LRU; pages shared with
             # residents survive via their refcounts).
             self.engine.page_reclaim = self._reclaim_prefix_pages
+        # FLIGHT RECORDER (serving/profiling.py), off by default:
+        # --profile-every N --profile-steps K periodically wraps K
+        # decode-step boundaries in a jax.profiler window, analyzes
+        # the dump off-thread (analysis/xprof.py), and publishes
+        # trace-TRUE attribution — collective/transfer/host-gap/
+        # device-busy shares + serving MFU — as /metrics gauges, the
+        # /info "profiling" block, and GET /profile/report.  One
+        # published record behind all three surfaces, so they can
+        # never drift; shares the manual endpoints' ProfileSession,
+        # so recorder windows and POST /profile/start are single-
+        # flight against each other.
+        self.recorder = None
+        if profile_every:
+            if profile_every < 0:
+                raise ValueError(
+                    f"profile_every must be >= 0; got "
+                    f"{profile_every}")
+            if self.profiler is None:
+                raise ValueError(
+                    "profile_every needs profile_dir (the flight "
+                    "recorder writes jax.profiler windows there)")
+            if self.engine is None:
+                raise ValueError(
+                    "profile_every requires the continuous-batching "
+                    f"engine (batching={self.batching!r}) — the "
+                    "recorder windows decode-step boundaries")
+            from .profiling import (FlightRecorder,
+                                    decode_flops_per_token,
+                                    detect_peak_flops)
+
+            cfg = getattr(model, "cfg", None)
+            peak = detect_peak_flops()
+            self.recorder = FlightRecorder(
+                self.profiler, every=profile_every,
+                steps=profile_steps, telemetry=self.telemetry,
+                flops_fn=(lambda pos: decode_flops_per_token(
+                    cfg, pos)) if cfg is not None else None,
+                peak_flops=peak["peak_flops"],
+                peak_flops_source=peak["peak_flops_source"],
+                n_devices=self.mesh.n_devices
+                if self.mesh is not None else 1,
+                position_probe=self.engine.mean_resident_position)
+            self.engine.recorder = self.recorder
 
     def close(self) -> None:
         """Stop the engine loop thread (idempotent) and end any
-        in-flight profiler trace."""
+        in-flight profiler trace (recorder window or manual)."""
         if self.engine is not None:
             self.engine.close()
+        if self.recorder is not None:
+            self.recorder.close()
         if self.profiler is not None:
             self.profiler.close()
 
@@ -1495,6 +1542,11 @@ class ModelServer:
                 "compile_cache": compile_cache,
                 **({"sanitizer": self.sanitizer.stats()}
                    if self.sanitizer is not None else {}),
+                # Flight-recorder attribution (serving/profiling.py):
+                # summarized from the SAME published record /metrics
+                # and GET /profile/report render.
+                **({"profiling": self.recorder.info_block()}
+                   if self.recorder is not None else {}),
                 "compiled_shapes": len(self._fns),
                 "requests": self.requests,
                 "coalesced_batches": self.coalesced_batches,
@@ -1604,6 +1656,12 @@ class ModelServer:
         # the shared telemetry helper (same module as the histogram
         # exposition, so /metrics and /info can never drift).
         lines += render_compile_cache(self.recompile.snapshot())
+        if self.recorder is not None:
+            # Flight-recorder attribution gauges (collective/host-gap/
+            # device-busy shares + serving MFU): rendered from the
+            # SAME record GET /profile/report returns — one
+            # reduction, no drift (serving/profiling.py).
+            lines += self.recorder.metrics_lines()
         # Latency histograms (queue-wait, prefill, decode-per-token,
         # TTFT, total) — rendered by the same telemetry helper as the
         # spec-acceptance histogram below, so every histogram on this
@@ -1855,6 +1913,26 @@ def make_server(host: str, port: int, ms: ModelServer
                 # step timeline, loadable directly in Perfetto /
                 # chrome://tracing (docs/SERVING.md).
                 self._send(200, ms.telemetry.chrome_trace())
+            elif self.path == "/profile/report":
+                # The flight recorder's parsed attribution for the
+                # most recent profiled window(s) — the same numbers
+                # the /metrics gauges export (one reduction).
+                if ms.recorder is None:
+                    self._send(400, {
+                        "error": "flight recorder disabled (start "
+                                 "the server with --profile-every N "
+                                 "and --profile-dir)"})
+                else:
+                    rep = ms.recorder.report()
+                    if rep["latest"] is None:
+                        self._send(404, {
+                            "error": "no profiled window analyzed "
+                                     "yet",
+                            **{k: rep[k] for k in
+                               ("windows_total", "windows_skipped",
+                                "windows_deferred", "last_error")}})
+                    else:
+                        self._send(200, rep)
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 

@@ -273,27 +273,56 @@ class ProfileSession:
     device trace into a timestamped subdirectory of ``log_dir`` and
     refuses while one is running (profiling is process-global state —
     two concurrent POSTs must not race start_trace); ``stop`` ends it
-    and reports where the dump landed."""
+    and reports where the dump landed.
+
+    ``owner`` tags who holds the in-flight trace — ``"manual"`` for
+    the ``POST /profile/start|stop`` endpoints, ``"recorder"`` for
+    the flight recorder's periodic windows (serving/profiling.py) —
+    so the two consumers share ONE session without racing: a start
+    while the other side owns it raises (the HTTP surface maps that
+    to 409; the recorder defers its window), and ``stop`` refuses an
+    owner mismatch rather than silently ending someone else's
+    trace."""
 
     def __init__(self, log_dir: str):
         self.log_dir = log_dir
         self._lock = threading.Lock()
         self._active_dir: Optional[str] = None
+        self._owner: Optional[str] = None
+        self._session = None     # low-level (python-tracer-off) mode
 
     @property
     def active(self) -> bool:
         return self._active_dir is not None
 
-    def start(self) -> str:
+    @property
+    def owner(self) -> Optional[str]:
+        return self._owner
+
+    def start(self, owner: str = "manual",
+              python_tracer: bool = True) -> str:
+        """``python_tracer=False`` drops to jaxlib's ProfilerSession
+        with ``python_tracer_level=0``: device/runtime TraceMes and
+        the ``ptpu_step`` markers still land in the dump, but the
+        Python host tracer — which instruments EVERY Python call on
+        EVERY thread for the duration — stays off.  That is the
+        difference between a recorder window costing milliseconds
+        and costing >50% of a busy server's throughput (measured;
+        the bench's ``recorder_overhead`` leg holds it), so the
+        flight recorder always passes False; the manual endpoints
+        keep the full trace for interactive debugging."""
         import os
 
         import jax
 
         with self._lock:
             if self._active_dir is not None:
+                who = "the flight recorder" \
+                    if self._owner == "recorder" else self._owner
                 raise RuntimeError(
-                    f"a profile is already running (writing to "
-                    f"{self._active_dir}); POST /profile/stop first")
+                    f"a profile is already running (owned by {who}, "
+                    f"writing to {self._active_dir}); POST "
+                    f"/profile/stop first")
             # Uniquify past second-granularity strftime: two
             # start/stop cycles inside one second (a scripted
             # profiling loop) must not merge their xprof sessions
@@ -306,11 +335,33 @@ class ProfileSession:
                 n += 1
                 d = f"{base}_{n}"
             os.makedirs(d)
-            jax.profiler.start_trace(d)
+            self._session = None
+            if not python_tracer:
+                try:
+                    from jax._src.lib import xla_client
+
+                    opts = xla_client.profiler.ProfileOptions()
+                    opts.python_tracer_level = 0
+                    # No HLO protos in recorder dumps: with them on,
+                    # every window serializes the HLO of EVERY
+                    # compiled module in the process (~100MB on a
+                    # warmed server — measured), on the engine
+                    # thread.  Attribution needs events, not HLO.
+                    opts.enable_hlo_proto = False
+                    self._session = \
+                        xla_client.profiler.ProfilerSession(opts)
+                except (ImportError, AttributeError):
+                    # jaxlib without the options surface: fall back
+                    # to the full trace (correct, just costlier —
+                    # the recorder-overhead bench leg measures it).
+                    pass
+            if self._session is None:
+                jax.profiler.start_trace(d)
             self._active_dir = d
+            self._owner = owner
             return d
 
-    def stop(self) -> str:
+    def stop(self, owner: str = "manual") -> str:
         import jax
 
         with self._lock:
@@ -318,21 +369,33 @@ class ProfileSession:
                 raise RuntimeError(
                     "no profile is running; POST /profile/start "
                     "first")
+            if self._owner != owner:
+                who = "the flight recorder" \
+                    if self._owner == "recorder" else self._owner
+                raise RuntimeError(
+                    f"the running profile is owned by {who}; it will "
+                    f"end at its own window boundary")
             # Clear the active marker only AFTER stop_trace succeeds:
             # jax's profiler is process-global state, so dropping the
             # marker on a failed stop would wedge the endpoints (stop
             # -> 409 "nothing running", start -> jax "already
             # started") with no operator recovery but a restart.
             d = self._active_dir
-            jax.profiler.stop_trace()
+            if self._session is not None:
+                self._session.stop_and_export(d)
+                self._session = None
+            else:
+                jax.profiler.stop_trace()
             self._active_dir = None
+            self._owner = None
             return d
 
     def close(self) -> None:
-        """Best-effort end-of-life stop (server shutdown mid-trace)."""
+        """Best-effort end-of-life stop (server shutdown mid-trace),
+        whoever owns the in-flight trace."""
         try:
             if self.active:
-                self.stop()
+                self.stop(owner=self._owner or "manual")
         except Exception:
             pass
 

@@ -520,6 +520,101 @@ def test_shard_leak_scoped_to_serving():
     assert _rules(src, "polyaxon_tpu/tracking/thing.py") == []
 
 
+# -- TIME-TRUTH -------------------------------------------------------------
+
+
+def test_time_truth_flags_unsynced_delta_over_jax():
+    """A perf_counter delta spanning an async jax dispatch with no
+    sync: the delta times the enqueue, not the device."""
+    src = """
+    import time
+    import jax
+
+    def bench(fn, x):
+        t0 = time.perf_counter()
+        y = jax.jit(fn)(x)
+        return time.perf_counter() - t0
+    """
+    assert _rules(src) == ["TIME-TRUTH"]
+    # benchmarks/ is in scope too — committed rows are evidence
+    assert _rules(src, "benchmarks/bench_thing.py") == ["TIME-TRUTH"]
+
+
+def test_time_truth_allows_synced_delta_and_plain_timing():
+    """block_until_ready (or device_get) between clock read and
+    delta makes it honest; timing non-jax work (HTTP, threads) never
+    matches; and time.time anchors are covered like perf_counter."""
+    src = """
+    import time
+    import jax
+    import numpy as np
+
+    def bench(fn, x):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.jit(fn)(x))
+        dt = time.perf_counter() - t0
+        t1 = time.time()
+        out = np.asarray(jax.device_get(fn(x)))
+        dt2 = time.time() - t1
+        return dt, dt2
+
+    def http(post, payload):
+        t0 = time.perf_counter()
+        post(payload)
+        return time.perf_counter() - t0
+    """
+    assert _rules(src) == []
+
+
+def test_time_truth_reanchors_on_reassignment():
+    """A loop that re-reads the clock re-anchors: only the span from
+    the NEAREST prior assignment counts, so a synced early section
+    doesn't launder a later unsynced one."""
+    src = """
+    import time
+    import jax
+
+    def loop(fn, x):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        ok = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fn2 = jax.jit(fn)(x)
+        bad = time.perf_counter() - t0
+        return ok, bad
+    """
+    assert _rules(src) == ["TIME-TRUTH"]
+
+
+def test_time_truth_scoped_and_ignores_nested_defs():
+    """Out of scope outside serving//benchmarks/; a jax call inside
+    a nested def between anchor and delta doesn't count (it runs on
+    its own schedule), and profiler markers are not dispatch."""
+    src = """
+    import time
+    import jax
+
+    def outer(x):
+        t0 = time.perf_counter()
+        def later():
+            return jax.jit(lambda v: v)(x)
+        with jax.profiler.TraceAnnotation("mark"):
+            pass
+        return time.perf_counter() - t0, later
+    """
+    assert _rules(src) == []
+    pos = """
+    import time
+    import jax
+
+    def bench(fn, x):
+        t0 = time.perf_counter()
+        y = jax.jit(fn)(x)
+        return time.perf_counter() - t0
+    """
+    assert _rules(pos, "polyaxon_tpu/models/generate.py") == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 
