@@ -174,6 +174,16 @@ def recorder_value(r):
     return f"{pct}%" + (f" ({w}w)" if w is not None else "")
 
 
+def debug_value(r):
+    """serving-load rows: the debuggability-overhead A/B column —
+    the history-ring + stall-watchdog tax in % agg tok/s with the
+    layer fully armed (same <= ~3% contract as telemetry and the
+    recorder).  Empty for every other bench."""
+    ov = r.get("debug_overhead") or {}
+    pct = ov.get("overhead_pct")
+    return "" if pct is None else f"{pct}%"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -183,10 +193,10 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | paged | mesh | telemetry | recorder "
+          "| spec-mix | paged | mesh | telemetry | recorder | debug "
           "| overload | mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|")
+          "---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -207,6 +217,7 @@ def main() -> int:
               f"| {meshed_value(r)} "
               f"| {telemetry_value(r)} "
               f"| {recorder_value(r)} "
+              f"| {debug_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0

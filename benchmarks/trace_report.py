@@ -199,6 +199,69 @@ def attribution_stats(report):
     }
 
 
+def request_timeline(events, rid: str):
+    """ONE request's causal story, reassembled from the trace by its
+    request ID (the ``rid`` arg every engine span/instant carries):
+    phases (queue/prefill/decode), preemptions (with the preemptor's
+    request ID and the control-law reason), admission blocks and what
+    unblocked them, page requeues, and the terminal cause — ordered
+    by start time, offsets relative to the request's first event.
+
+    Returns None when the trace has no events for ``rid`` (wrong ID,
+    or the span rolled off the bounded trace ring)."""
+    mine = []
+    for ev in events:
+        if ev.get("args", {}).get("rid") != rid:
+            continue
+        if ev.get("ph") == "X":
+            mine.append((ev["ts"], ev.get("dur", 0),
+                         ev["name"], ev.get("args", {})))
+        elif ev.get("ph") == "i":
+            mine.append((ev["ts"], None, ev["name"],
+                         ev.get("args", {})))
+    if not mine:
+        return None
+    mine.sort(key=lambda e: e[0])
+    t0 = mine[0][0]
+    entries = []
+    terminal = None
+    preempts = []
+    for ts, dur, name, args in mine:
+        a = {k: v for k, v in args.items() if k != "rid"}
+        e = {"at_ms": round((ts - t0) / 1e3, 3), "event": name}
+        if dur is not None:
+            e["dur_ms"] = round(dur / 1e3, 3)
+        if a:
+            e["args"] = a
+        entries.append(e)
+        if name == "preempted":
+            preempts.append({"at_ms": e["at_ms"],
+                             "by": a.get("by"),
+                             "reason": a.get("reason"),
+                             "tokens_lost_held": a.get("tokens")})
+        if name in ("complete", "cancelled", "expired", "shed",
+                    "failed"):
+            # Lifecycle instants are the request's actual fate and
+            # always win: a span-level ``terminal`` arg only says why
+            # that SEGMENT ended ("preempted" segments resume), so it
+            # is a fallback for when the instant rolled off the ring.
+            terminal = name
+        elif terminal is None and "terminal" in a:
+            terminal = a["terminal"]
+    return {
+        "request_id": rid,
+        "events": entries,
+        "n_events": len(entries),
+        "span_ms": round((mine[-1][0] - t0) / 1e3, 3),
+        "preemptions": preempts,
+        "blocked": [e for e in entries
+                    if e["event"] in ("admit_blocked",
+                                      "admit_unblocked",
+                                      "page_requeued")],
+        **({"terminal": terminal} if terminal else {}),
+    }
+
+
 def summarize(path: str, profile_report=None):
     events = load_trace_events(path)
     attribution = None
@@ -224,9 +287,40 @@ def main() -> int:
                     help="saved GET /profile/report JSON (flight "
                          "recorder attribution) to render beside "
                          "the trace summary")
+    ap.add_argument("--request", default=None, metavar="ID",
+                    help="render ONE request's causal timeline "
+                         "(phases, preemptions with preemptor IDs, "
+                         "page waits) by its X-Request-Id instead "
+                         "of the aggregate summary")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
+    if args.request is not None:
+        tl = request_timeline(load_trace_events(args.trace),
+                              args.request)
+        if tl is None:
+            print(f"no events for request {args.request!r} in "
+                  f"{args.trace} (wrong ID, or rolled off the "
+                  f"bounded trace ring)", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(tl, indent=2))
+            return 0
+        print(f"# request {tl['request_id']}: {tl['n_events']} "
+              f"events over {tl['span_ms']} ms"
+              + (f" -> {tl['terminal']}" if "terminal" in tl
+                 else ""))
+        print("\n| at ms | event | dur ms | detail |")
+        print("|---|---|---|---|")
+        for e in tl["events"]:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in e.get("args", {}).items())
+            print(f"| {e['at_ms']} | {e['event']} | "
+                  f"{e.get('dur_ms', '')} | {detail} |")
+        for p in tl["preemptions"]:
+            print(f"\npreempted at {p['at_ms']} ms by request "
+                  f"{p['by']} ({p['reason']})")
+        return 0
     s = summarize(args.trace, profile_report=args.profile_report)
     if args.json:
         print(json.dumps(s, indent=2))

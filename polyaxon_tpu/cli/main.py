@@ -560,6 +560,23 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--sanitize-max-hold", default=None, type=float,
               help="With --sanitize: flag device_lock holds longer "
                    "than this many seconds (unset = no hold limit).")
+@click.option("--request-history", default=256, type=int,
+              help="Terminal request-record retention ring behind "
+                   "GET /requests/<id>: per-request causal timelines "
+                   "(queue wait, admission slot, preemptions with "
+                   "preemptor IDs, page waits, terminal cause), "
+                   "newest N retained. 0 disables recording.")
+@click.option("--stall-timeout", default=None, type=float,
+              help="Arm the STALL WATCHDOG: when work exists but no "
+                   "decode-step boundary completes for this many "
+                   "seconds (or a queued request ages past 4x its "
+                   "class queue deadline), write a one-shot "
+                   "diagnostic bundle (--stall-dir) — state "
+                   "snapshot, trace tail, thread stacks — and bump "
+                   "ptpu_serving_stalls_total. Unset = off.")
+@click.option("--stall-dir", default=".", type=click.Path(),
+              help="With --stall-timeout: directory stall bundles "
+                   "(stall_<n>_<pid>.json) are written to.")
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
@@ -569,7 +586,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
           trace_file, profile_dir, profile_every, profile_steps,
-          access_log, sanitize, sanitize_max_hold, cpu):
+          access_log, sanitize, sanitize_max_hold, request_history,
+          stall_timeout, stall_dir, cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
     prefill later /generate requests skip; /trace exports the
@@ -628,6 +646,14 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     if sanitize_max_hold is not None and not sanitize:
         raise click.ClickException(
             "--sanitize-max-hold requires --sanitize")
+    if request_history < 0:
+        raise click.ClickException("--request-history must be >= 0")
+    if stall_timeout is not None and stall_timeout <= 0:
+        raise click.ClickException("--stall-timeout must be > 0")
+    if stall_timeout is not None and batching != "continuous":
+        raise click.ClickException(
+            "--stall-timeout requires --batching continuous (the "
+            "watchdog monitors decode-step boundaries)")
     for name, v in (("--queue-deadline-ms", queue_deadline_ms),
                     ("--batch-queue-deadline-ms",
                      batch_queue_deadline_ms),
@@ -718,6 +744,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          access_log=access_log,
                          sanitize=sanitize,
                          sanitize_max_hold_s=sanitize_max_hold,
+                         request_history=request_history,
+                         stall_timeout_s=stall_timeout,
+                         stall_dir=stall_dir,
                          info={**({"int8_weights": True}
                                   if int8_weights else {}),
                                **({"int8_kv": True} if int8_kv else {}),

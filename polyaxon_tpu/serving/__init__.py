@@ -34,11 +34,18 @@ path moved from request coalescing to continuous batching:
   export), shared latency/acceptance histograms, and the
   single-flight ``jax.profiler`` wrapper behind ``POST
   /profile/start|stop``.
+- ``debug.py``     — request-scoped debuggability: request IDs
+  (``X-Request-Id`` honored/generated/echoed), the terminal-record
+  retention ring behind ``GET /requests/<id>``, the published
+  ``GET /debug/state`` snapshot board, and the stall watchdog
+  (``--stall-timeout``) that dumps a diagnostic bundle when the
+  engine wedges.
 
 The public surface is unchanged: ``from polyaxon_tpu.serving import
 ModelServer, make_server``.
 """
 
+from .debug import RequestHistory, StallWatchdog, new_request_id
 from .engine import DecodeEngine
 from .meshed import MeshError, ServingMesh, parse_mesh
 from .paged import PagedSlotKVManager
@@ -57,4 +64,5 @@ __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "ServingMesh", "parse_mesh", "MeshError",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
            "ShedError", "PRIORITIES", "Telemetry", "Histogram",
-           "ProfileSession", "render_histogram"]
+           "ProfileSession", "render_histogram",
+           "RequestHistory", "StallWatchdog", "new_request_id"]

@@ -58,6 +58,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ._lru import lru_get
+from .debug import SnapshotBoard, events_to_dicts, new_request_id
 from .paged import PageExhausted
 from .scheduler import (AdmissionQueue, DeadlineExceeded, PRIORITIES,
                         QueueFullError, RequestCancelled,
@@ -278,6 +279,28 @@ class DecodeEngine:
         # and publishes trace-true attribution (collective/host-gap/
         # busy shares, serving MFU) to /metrics + /profile/report.
         self.recorder = None
+        # Request-scoped debuggability (serving/debug.py).
+        # ``history``: the terminal-record retention ring behind
+        # GET /requests — None (library default) records nothing; the
+        # server wires its RequestHistory here before traffic.
+        # ``debug_board``: the published step-boundary snapshot
+        # behind GET /debug/state; ``last_boundary_t`` is the stall
+        # watchdog's progress signal (stamped at the end of every
+        # tick).  ``_last_page_free`` attributes a blocked
+        # admission's eventual unblock to the eviction that freed
+        # capacity — (request id, why) of the most recent release.
+        self.history = None
+        self.debug_board = SnapshotBoard()
+        self.last_boundary_t = time.perf_counter()
+        self._last_page_free: Optional[Tuple] = None
+        # Publishing is throttled to one build per interval: a busy
+        # pool crosses hundreds of step boundaries a second, and
+        # /debug/state only needs a recent-consistent snapshot, not
+        # an every-boundary one — the snapshot build (slot + queue
+        # dicts) must not become a per-step tax nobody asked for.
+        self.board_interval_s = 0.1
+        self._board_t = 0.0
+        self.debug_board.publish(self.build_debug_snapshot())
 
     def _exact(self):
         """Serving-exact trace context for engine-owned device calls
@@ -295,7 +318,9 @@ class DecodeEngine:
                record_timings: bool = False,
                priority: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               shared_pages=None) -> RequestGroup:
+               shared_pages=None,
+               rid: Optional[str] = None,
+               prefix_info=None) -> RequestGroup:
         """Enqueue a request (may raise QueueFullError) and make sure
         the loop is running.  Returns the group; callers block on
         ``group.event``.  ``sampling`` carries the per-request
@@ -332,7 +357,13 @@ class DecodeEngine:
         page ids of the stored prefix's full pages: the engine owns
         the pins from here on, maps them read-only into the stream's
         table at admission, and releases them on any pre-admission
-        terminal path."""
+        terminal path.
+
+        ``rid`` is the request's correlation ID (the server passes
+        the inbound/generated ``X-Request-Id``); None generates one,
+        so EVERY group carries an ID into its trace spans and its
+        request-history record.  ``prefix_info`` rides the history
+        record as prefix-cache hit provenance."""
         if priority is None:
             priority = self.policy.default_priority
         if priority not in PRIORITIES:
@@ -420,13 +451,49 @@ class DecodeEngine:
         if deadline_s is not None:
             group.deadline = group.t_submit + float(deadline_s)
             self._deadline_armed = True
+        group.rid = rid if rid is not None else new_request_id()
+        group.prefix_info = prefix_info
         group.on_prefilled = on_prefilled
         group.record_timings = bool(record_timings)
+        # Streams collect their span tuples when the caller asked for
+        # a ``timings`` block OR the history ring is armed — the same
+        # events back both surfaces, so a record's timeline and a
+        # live timings response can never disagree.
+        keep_events = group.record_timings or (
+            self.history is not None and self.history.enabled)
         for stream in group.streams:
             stream.sid = self.tel.new_tid()
-            if group.record_timings:
+            if keep_events:
                 stream.events = []
-        self.queue.submit(group)          # raises when full
+        # Idle -> busy transition: re-stamp the watchdog's progress
+        # signal, or a server that sat idle past --stall-timeout
+        # would read as stalled the moment work arrives (the loop
+        # only stamps at tick, and the first tick may be a
+        # seconds-long compile).  Only on the transition — submits
+        # into an already-busy (possibly wedged) engine must NOT
+        # keep resetting staleness.
+        if not self._resident and len(self.queue) == 0:
+            self.last_boundary_t = time.perf_counter()
+        # Queue-entry instant: the FIRST trace event a request owns,
+        # so even one that never reaches admission (wedged engine,
+        # stall bundle) is findable in the ring by its rid.  Emitted
+        # BEFORE queue.submit — once the group is in the queue the
+        # engine thread can process it immediately, and a later
+        # "queued" would land out of order in stream.events.
+        for stream in group.streams:
+            self._emit_instant(stream, "queued", group.t_submit,
+                               row=stream.row, priority=priority)
+        try:
+            self.queue.submit(group)      # raises when full
+        except QueueFullError:
+            # Close the causal story for the trace ring: submitted,
+            # never queued (429 at the front-end).
+            for stream in group.streams:
+                self._emit_instant(stream, "shed",
+                                   time.perf_counter(),
+                                   row=stream.row,
+                                   reason="queue_full")
+            raise
         if self.autostart:
             self._ensure_thread()
             with self._wake:
@@ -532,6 +599,7 @@ class DecodeEngine:
         errors to their own group."""
         for slot, stream in list(self._resident.items()):
             stream.group.fail(err)
+            self._record_history(stream.group)
             try:
                 self.slots.release(slot)
             except ValueError:
@@ -543,6 +611,7 @@ class DecodeEngine:
                 break
             self._release_stream_kv(stream)
             stream.group.fail(err)
+            self._record_history(stream.group)
 
     def _loop(self) -> None:
         while not self._stop:
@@ -592,13 +661,31 @@ class DecodeEngine:
                 self.queue.drop_group(stream.group)
                 continue
             if stream.pf_done and not self._can_admit_stream(stream):
-                break       # prefilled, waiting on a slot / pages
+                # Prefilled, waiting on a slot / pages: stamp the
+                # wait start into its causal timeline (once).
+                self._note_blocked(stream)
+                break
             self._advance_prefill(stream)
             worked = True
             budget -= 1
         if self._resident:
             self._decode_step()
             worked = True
+        # Step-boundary bookkeeping for the debuggability layer: the
+        # watchdog's progress signal and the published /debug/state
+        # snapshot (throttled to board_interval_s) — host-side only,
+        # never under the device lock.  The progress stamp is
+        # PROGRESS-gated: a no-op tick (queue nonempty but nothing
+        # admittable, no residents) must let staleness grow, or a
+        # livelocked-but-spinning loop could never be declared
+        # stalled — "the loop thread is alive" is not "the engine is
+        # making progress".
+        now = time.perf_counter()
+        if worked:
+            self.last_boundary_t = now
+        if now - self._board_t >= self.board_interval_s:
+            self._board_t = now
+            self.debug_board.publish(self.build_debug_snapshot())
         return worked
 
     # -- paged-KV accounting ---------------------------------------------
@@ -653,7 +740,12 @@ class DecodeEngine:
 
                 logging.getLogger(__name__).debug(
                     "page_reclaim hook failed", exc_info=True)
-            return self.slots.can_admit(need, n_shared)
+            ok = self.slots.can_admit(need, n_shared)
+            if ok:
+                # The unblock came from evicting stored-but-idle
+                # prefix entries, not a co-tenant's eviction.
+                self._last_page_free = (None, "prefix_reclaim")
+            return ok
         return False
 
     def _release_stream_kv(self, stream: Stream) -> None:
@@ -671,6 +763,34 @@ class DecodeEngine:
 
                 logging.getLogger(__name__).debug(
                     "shared-page release failed", exc_info=True)
+
+    # -- debuggability: block/unblock attribution ------------------------
+
+    def _note_blocked(self, stream: Stream) -> None:
+        """First boundary a fully-prefilled head could not admit:
+        open its wait in the causal timeline, saying WHAT it waits on
+        (a slot, or — paged with a free slot — pages).  One instant
+        per blocked episode; the matching ``admit_unblocked`` closes
+        it with the wait length and what freed the capacity."""
+        if stream.blocked_t is not None:
+            return
+        now = time.perf_counter()
+        stream.blocked_t = now
+        args: Dict[str, Any] = {"on": "slot"}
+        if self.paged and self.slots.free_slots > 0:
+            args["on"] = "kv_pages"
+            args["pages_free"] = self.slots.free_page_count()
+            args["pages_needed"] = self.slots.pages_needed(
+                self._kv_tokens_needed(stream.p_len, stream.new)) \
+                - len(stream.kv_shared or ())
+        self._emit_instant(stream, "admit_blocked", now,
+                           row=stream.row, **args)
+
+    def _note_freed(self, stream: Stream, why: str) -> None:
+        """Remember who last freed slot/page capacity — the
+        attribution a blocked stream's ``admit_unblocked`` instant
+        carries ("which eviction unblocked me")."""
+        self._last_page_free = (stream.group.rid, why)
 
     # -- lifecycle: cancel / deadline / shed / preempt -------------------
 
@@ -741,6 +861,7 @@ class DecodeEngine:
             del self._resident[slot]
             self.slots.release(slot)
             self.evicted_total += 1
+            self._note_freed(stream, status)
             # Close the decode span at the eviction boundary so the
             # trace shows exactly how much work the cancel discarded.
             self._emit(stream, "decode", stream.t_admit, now,
@@ -760,6 +881,7 @@ class DecodeEngine:
         else:
             self.cancelled_total += 1
         group.fail(err)
+        self._record_history(group)
 
     def _recent_ttft_p99(self) -> Optional[float]:
         """p99 of the sliding interactive-TTFT window (None until
@@ -791,6 +913,9 @@ class DecodeEngine:
             return False
         now = time.perf_counter()
         waited = now - head.group.t_submit
+        # The control-law reason rides the victim's ``preempted``
+        # instant (and so its history record): which trigger fired.
+        reason = "head_wait_over_half_slo"
         if waited <= slo / 2:
             # Head-wait trigger acts at HALF the budget: preempting
             # only once the target is already blown would guarantee
@@ -805,6 +930,7 @@ class DecodeEngine:
             p99 = self._recent_ttft_p99()
             if p99 is None or p99 <= slo:
                 return False
+            reason = "ttft_p99_degraded"
         victim = None
         for slot, stream in self._resident.items():
             if stream.group.priority != "batch":
@@ -819,11 +945,18 @@ class DecodeEngine:
         self.slots.release(slot)
         self.evicted_total += 1
         self.preempted_total += 1
+        stream.preempts += 1
+        self._note_freed(stream, "preempted")
         self._emit(stream, "decode", stream.t_admit, now,
                    row=stream.row, slot=slot, tokens=len(stream.out),
                    terminal="preempted")
+        # The causal evidence a co-tenancy incident needs: WHO forced
+        # this eviction (the preemptor's request ID) and WHY the
+        # control law fired.
         self._emit_instant(stream, "preempted", now, row=stream.row,
-                           slot=slot, tokens=len(stream.out))
+                           slot=slot, tokens=len(stream.out),
+                           by=head.group.rid, reason=reason,
+                           head_waited_ms=round(1e3 * waited, 3))
         # pow2 pieces, not chunk_plan: the resume length is
         # data-dependent (prompt + commits at the preemption point),
         # so one-piece prefill would be a fresh compile per
@@ -856,14 +989,20 @@ class DecodeEngine:
     def _emit(self, stream: Stream, name: str, t0: float, t1: float,
               **args) -> None:
         """One lifecycle span for ``stream``: into the shared trace
-        ring, and (when the request asked for a ``timings`` block)
-        onto the stream's own event list."""
+        ring, and (when a ``timings`` block or the history ring wants
+        it) onto the stream's own event list.  Every span carries the
+        request ID — the correlation key ``trace_report.py
+        --request`` and the /requests records filter on."""
+        if stream.group.rid is not None:
+            args.setdefault("rid", stream.group.rid)
         self.tel.span(stream.sid or 0, name, t0, t1, **args)
         if stream.events is not None:
             stream.events.append((name, t0, t1, args))
 
     def _emit_instant(self, stream: Stream, name: str, t: float,
                       **args) -> None:
+        if stream.group.rid is not None:
+            args.setdefault("rid", stream.group.rid)
         self.tel.instant(stream.sid or 0, name, t, **args)
         if stream.events is not None:
             stream.events.append((name, t, t, args))
@@ -1050,6 +1189,7 @@ class DecodeEngine:
 
         slot = self.slots.acquire()
         assert slot is not None, "admission without a free slot"
+        stream.last_slot = slot
         spec = stream.sampling
         resumed = stream.resume
         if not resumed:
@@ -1076,6 +1216,18 @@ class DecodeEngine:
         self._emit_instant(stream, "admit", stream.t_admit,
                            row=stream.row, slot=slot,
                            **({"resumed": True} if resumed else {}))
+        if stream.blocked_t is not None:
+            # Close the admission wait opened by _note_blocked, with
+            # the attribution: whose eviction freed the capacity.
+            unb = self._last_page_free
+            self._emit_instant(
+                stream, "admit_unblocked", stream.t_admit,
+                row=stream.row, slot=slot,
+                wait_ms=round(
+                    1e3 * (stream.t_admit - stream.blocked_t), 3),
+                **({"unblocked_by": unb[0], "freed_via": unb[1]}
+                   if unb is not None else {}))
+            stream.blocked_t = None
         stream.logits = None
         if not resumed and stream.done():   # new == 1, or instant eos
             stream.cache = None
@@ -1131,6 +1283,9 @@ class DecodeEngine:
             # it re-prefills and admits when pages free.  The
             # fits-but-not-now contract: wait, never 500.
             self.slots.release(slot)
+            self._emit_instant(stream, "page_requeued",
+                               time.perf_counter(), row=stream.row,
+                               tokens=len(stream.out))
             stream.prepare_resume(SchedulerPolicy.pow2_pieces(
                 stream.p_len + len(stream.out) - 1))
             self.queue.requeue_front(stream)
@@ -1145,6 +1300,7 @@ class DecodeEngine:
         self._resident[slot] = stream
         if resumed:
             stream.resume = False
+            stream.resumes += 1
             self.resumed_total += 1
         else:
             self._count_admitted(spec, stream.group.priority)
@@ -1265,6 +1421,7 @@ class DecodeEngine:
                 del self._resident[slot]
                 self.slots.release(slot)
                 self.evicted_total += 1
+                self._note_freed(stream, "complete")
                 self._complete(stream)   # records the slot id
                 stream.slot = None
         self.step_device_s_total += self.slots.last_step_device_s
@@ -1330,6 +1487,7 @@ class DecodeEngine:
                 del self._resident[slot]
                 self.slots.release(slot)
                 self.evicted_total += 1
+                self._note_freed(stream, "complete")
                 self._complete(stream)   # records the slot id
                 stream.slot = None
         self.step_device_s_total += self.slots.last_step_device_s
@@ -1365,6 +1523,12 @@ class DecodeEngine:
         if stream.t_admit is not None:
             args = {"row": stream.row, "slot": stream.slot,
                     "tokens": len(stream.out)}
+            if stream.preempts or stream.resumes:
+                # A resumed request must be distinguishable from a
+                # straight-through one in the trace (the satellite
+                # fix — the access log gets the same fields).
+                args.update(preempts=stream.preempts,
+                            resumes=stream.resumes)
             if stream.sampling.speculative:
                 args.update(spec_rounds=stream.spec_rounds,
                             spec_drafted=stream.spec_drafted,
@@ -1382,6 +1546,7 @@ class DecodeEngine:
                 self.completed_sampled_total += 1
             else:
                 self.completed_greedy_total += 1
+            self._record_history(group)
 
     def _fail_group(self, group: RequestGroup,
                     err: BaseException) -> None:
@@ -1403,8 +1568,132 @@ class DecodeEngine:
                                    row=stream.row,
                                    error=type(err).__name__)
         group.fail(err)
+        self._record_history(group)
 
     # -- introspection --------------------------------------------------
+
+    @staticmethod
+    def _kind_of(sampling: SamplingSpec) -> str:
+        if sampling.speculative:
+            return "speculative"
+        return "sampled" if sampling.sampled else "greedy"
+
+    def _record_history(self, group: RequestGroup) -> None:
+        """One terminal record per request into the retention ring —
+        the full causal story ``GET /requests/<id>`` serves.  Called
+        on every terminal path (complete / cancel / expire / shed /
+        fail); re-recording the same request ID replaces the older
+        record, so double calls on shutdown races are harmless."""
+        h = self.history
+        if h is None or not h.enabled or group.rid is None:
+            return
+        t_done = group.t_done if group.t_done is not None \
+            else time.perf_counter()
+        queue_s, prefill_s, decode_s = group.breakdown()
+        rec: Dict[str, Any] = {
+            "request_id": group.rid,
+            "t": round(time.time(), 3),
+            "status": group.status,
+            "kind": self._kind_of(group.sampling),
+            "priority": group.priority,
+            "rows": len(group.streams),
+            "prompt_tokens": int(group.rows.shape[1]),
+            "max_new_tokens": int(group.new),
+            "wall_s": round(max(0.0, t_done - group.t_submit), 6),
+            "queue_wait_s": round(queue_s, 6),
+            "prefill_s": round(prefill_s, 6),
+            "decode_s": round(decode_s, 6),
+            "preempts": sum(s.preempts for s in group.streams),
+            "resumes": sum(s.resumes for s in group.streams),
+        }
+        if group.t_first_admit is not None:
+            rec["ttft_s"] = round(
+                group.t_first_admit - group.t_submit, 6)
+        if group.error is not None:
+            rec["error"] = (f"{type(group.error).__name__}: "
+                            f"{group.error}")[:300]
+        if group.prefix_info:
+            rec["prefix"] = dict(group.prefix_info)
+        if group.sampling.speculative:
+            rec["spec"] = {
+                "rounds": sum(s.spec_rounds for s in group.streams),
+                "drafted": sum(s.spec_drafted
+                               for s in group.streams),
+                "accepted": sum(s.spec_accepted
+                                for s in group.streams)}
+        rec["streams"] = [
+            {"row": s.row,
+             "tokens_out": len(s.out),
+             **({"slot": s.last_slot}
+                if s.last_slot is not None else {}),
+             **({"preempts": s.preempts, "resumes": s.resumes}
+                if (s.preempts or s.resumes) else {}),
+             "timeline": events_to_dicts(s.events or [],
+                                         group.t_submit)}
+            for s in group.streams]
+        h.record(rec)
+
+    def build_debug_snapshot(self, forced: bool = False
+                             ) -> Dict[str, Any]:
+        """The ``/debug/state`` snapshot: slot table, per-class
+        queues with entry ages, page pool, lifecycle flags — plain
+        host-side dicts, NEVER the device lock (the SNAPSHOT-LOCK
+        contract, docs/DESIGN.md).  Normally built on the engine
+        thread at a step boundary (tick), so it is internally
+        consistent; ``forced=True`` marks a build from another thread
+        (the stall watchdog, whose whole premise is that the engine
+        thread is stuck) — best-effort, possibly mid-mutation."""
+        now = time.perf_counter()
+        slots = []
+        for slot, s in sorted(list(self._resident.items())):
+            slots.append({
+                "slot": slot,
+                "request_id": s.group.rid,
+                "row": s.row,
+                "kind": self._kind_of(s.sampling),
+                "priority": s.group.priority,
+                "position": s.p_len + len(s.out) - 1,
+                "tokens_out": len(s.out),
+                "remaining": s.new - len(s.out),
+                "preempts": s.preempts,
+                "resumes": s.resumes,
+                "age_s": round(now - s.group.t_submit, 3),
+                **({"deadline_in_s": round(
+                    s.group.deadline - now, 3)}
+                   if s.group.deadline is not None else {}),
+            })
+        queues: Dict[str, list] = {p: [] for p in PRIORITIES}
+        for s in self.queue.snapshot():
+            queues[s.group.priority].append({
+                "request_id": s.group.rid,
+                "row": s.row,
+                "age_s": round(now - s.group.t_submit, 3),
+                "prefilled": s.filled,
+                "prompt_tokens": s.p_len,
+                "pf_done": s.pf_done,
+                **({"blocked_s": round(now - s.blocked_t, 3)}
+                   if s.blocked_t is not None else {}),
+            })
+        snap: Dict[str, Any] = {
+            "t": now,
+            "forced": bool(forced),
+            "draining": self.draining,
+            "n_slots": self.slots.n_slots,
+            "free_slots": self.slots.free_slots,
+            "slots": slots,
+            "queues": queues,
+            "queue_len": sum(len(q) for q in queues.values()),
+            "last_step_age_s": round(
+                max(0.0, now - self.last_boundary_t), 3),
+            "decode_steps_total": self.decode_steps_total,
+        }
+        if self.paged:
+            snap["pages"] = {**self.slots.page_stats(),
+                             "slot_table_pages":
+                                 self.slots.slot_page_counts()}
+        if self.mesh is not None:
+            snap["mesh"] = self.mesh.axes_str()
+        return snap
 
     def stats(self) -> Dict[str, Any]:
         # Per-request queue/prefill/decode timing lives in ModelServer

@@ -276,6 +276,17 @@ class PagedSlotKVManager:
             "kv_pages_shared": shared,
         }
 
+    def slot_page_counts(self) -> Dict[int, int]:
+        """Mapped pool pages per RESIDENT slot (``/debug/state``'s
+        per-slot table-size column) — the accounting API's answer so
+        introspection never reads pool internals directly
+        (PAGE-REF)."""
+        out: Dict[int, int] = {}
+        for slot, held in enumerate(self._slot_pages):
+            if held is not None:
+                out[slot] = len(held[0])
+        return out
+
     # -- slot accounting ------------------------------------------------
 
     @property

@@ -333,7 +333,8 @@ class Stream:
                  "out", "slot", "pf_done", "t_prefill_start",
                  "t_admit", "t_done", "d_cache", "spec_rounds",
                  "spec_drafted", "spec_accepted", "sid", "events",
-                 "pf_toks", "resume", "kv_shared")
+                 "pf_toks", "resume", "kv_shared", "last_slot",
+                 "preempts", "resumes", "blocked_t")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -384,6 +385,18 @@ class Stream:
         # pre-admission terminal path unpins them
         # (engine._release_stream_kv).
         self.kv_shared: Optional[tuple] = None
+        # Debuggability (serving/debug.py): the last slot this stream
+        # occupied (``slot`` clears at eviction; the access log and
+        # the history record want the id after the fact), preempt/
+        # resume counts (a resumed request must be distinguishable
+        # from a straight-through one in the log), and the moment the
+        # stream became BLOCKED at the admission gate — on a slot, or
+        # (paged) on free pages (None when not blocked; the wait span
+        # in its causal timeline, closed with what unblocked it).
+        self.last_slot: Optional[int] = None
+        self.preempts = 0
+        self.resumes = 0
+        self.blocked_t: Optional[float] = None
 
     @property
     def p_len(self) -> int:
@@ -444,6 +457,11 @@ class RequestGroup:
                  eos_id: Optional[int], pieces_per_row: List[int],
                  sampling: Optional[SamplingSpec] = None, *,
                  priority: str = "interactive"):
+        # Request ID — the correlation key across the response header,
+        # access log, trace spans, and the request-history record.
+        # Set by engine.submit (inbound X-Request-Id, or generated)
+        # so every group has one however it was constructed.
+        self.rid: Optional[str] = None
         self.rows = rows
         self.new = new
         self.sampling = sampling or GREEDY
@@ -471,6 +489,11 @@ class RequestGroup:
         # the prefix cache's store-back hook (server._store_stream_
         # prefix), so sessions grow warm without a solo detour.
         self.on_prefilled = None
+        # Prefix-cache hit provenance (server prefix hits): a small
+        # dict — cached token count, shared-page count — carried into
+        # the request-history record so a hit's cheap TTFT is
+        # attributable after the fact.
+        self.prefix_info: Optional[Dict] = None
         self.results: List[Optional[np.ndarray]] = [None] * rows.shape[0]
         self._pending = rows.shape[0]
         # record_timings: the request asked for a per-phase ``timings``

@@ -80,10 +80,13 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from ._lru import lru_get
+from .debug import (RequestHistory, StallWatchdog, events_to_dicts,
+                    new_request_id, sanitize_request_id)
 from .engine import DecodeEngine
 from .legacy import RequestCoalescer
 from .radix import RadixPrefixIndex
@@ -123,14 +126,10 @@ caller owns the pins until ``engine.submit(shared_pages=pins)``
 returns; every other outcome must unpin them."""
 
 
-def _span_dicts(events, t0: float):
-    """Render engine/solo span tuples as the response ``timings``
-    block entries: start/duration in ms relative to request arrival."""
-    return [{"name": name,
-             "start_ms": round(1e3 * (a - t0), 3),
-             "dur_ms": round(1e3 * (b - a), 3),
-             **({"args": args} if args else {})}
-            for name, a, b, args in events]
+# The response ``timings`` block and the history record's timeline
+# render through the SAME function (docs/DESIGN.md: one source, the
+# two surfaces cannot disagree).
+_span_dicts = events_to_dicts
 
 
 def _int_param(v):
@@ -205,6 +204,10 @@ class ModelServer:
                  access_log: bool = False,
                  sanitize: bool = False,
                  sanitize_max_hold_s: Optional[float] = None,
+                 request_history: int = 256,
+                 stall_timeout_s: Optional[float] = None,
+                 stall_dir: str = ".",
+                 stall_queue_factor: float = 4.0,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
         self.variables = variables
@@ -491,10 +494,44 @@ class ModelServer:
                 if self.mesh is not None else 1,
                 position_probe=self.engine.mean_resident_position)
             self.engine.recorder = self.recorder
+        # REQUEST-SCOPED DEBUGGABILITY (serving/debug.py).  The
+        # history ring answers "what happened to THIS request"
+        # (GET /requests/<id>); the engine writes the full causal
+        # record on every terminal path, and the front-end writes a
+        # minimal one for requests the engine never saw (validation
+        # 400s, solo paths, drain 503s) — engine records supersede.
+        # request_history=0 disables the whole layer (one attribute
+        # check on the engine's terminal paths, same off-switch
+        # contract as the trace ring).
+        self.history = RequestHistory(request_history)
+        if self.engine is not None:
+            self.engine.history = self.history
+        # STALL WATCHDOG (opt-in via --stall-timeout): declares a
+        # stall when work exists but no step boundary completes, and
+        # writes a one-shot diagnostic bundle (forced state snapshot
+        # + trace tail + thread stacks) before anyone restarts the
+        # evidence away.  Engine-only: solo paths have no step
+        # boundary to watch — their stall surface is the bounded
+        # front-end wait (request_timeout_s).
+        self.watchdog = None
+        if stall_timeout_s is not None:
+            if self.engine is None:
+                raise ValueError(
+                    "stall_timeout_s requires the continuous-"
+                    f"batching engine (batching={self.batching!r}) — "
+                    "the watchdog monitors decode-step boundaries")
+            self.watchdog = StallWatchdog(
+                self.engine, self.telemetry,
+                timeout_s=stall_timeout_s, out_dir=stall_dir,
+                queue_factor=stall_queue_factor,
+                extra_state=self._watchdog_extra_state)
+            self.watchdog.start()
 
     def close(self) -> None:
         """Stop the engine loop thread (idempotent) and end any
         in-flight profiler trace (recorder window or manual)."""
+        if self.watchdog is not None:
+            self.watchdog.close()
         if self.engine is not None:
             self.engine.close()
         if self.recorder is not None:
@@ -530,6 +567,83 @@ class ModelServer:
                 "drain_rejected": self.drain_rejected,
                 "slots_active": es.get("slots_active", 0),
                 "queue_len": es.get("queue_len", 0)}
+
+    # -- request-scoped debuggability -----------------------------------
+
+    def debug_state(self) -> Dict[str, Any]:
+        """``GET /debug/state``: a CONSISTENT snapshot of engine
+        internals plus the server-level lifecycle surface.  The
+        engine half is the snapshot it published at its most recent
+        step boundary (SnapshotBoard — built on the engine thread,
+        outside the device lock, so it is internally consistent and
+        this handler can never block behind a wedged device call:
+        the SNAPSHOT-LOCK contract, docs/DESIGN.md)."""
+        now = time.perf_counter()
+        out: Dict[str, Any] = {
+            "model": self.model_name,
+            "batching": self.batching,
+            "draining": self.draining,
+            "history": self.history.stats(),
+        }
+        if self.engine is not None:
+            snap = self.engine.debug_board.latest()
+            if snap is not None:
+                snap["age_s"] = round(max(0.0, now - snap["t"]), 3)
+                del snap["t"]   # perf_counter origin: meaningless
+                #                 outside the process; age_s is the
+                #                 consumable form
+            out["engine"] = snap
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.status()
+        if self.sanitizer is not None:
+            # The lock-sanitizer's acquisition graph (edges +
+            # violations) when armed — the bundle's deadlock
+            # evidence, live.
+            out["sanitizer"] = self.sanitizer.stats()
+        return out
+
+    def _watchdog_extra_state(self) -> Dict[str, Any]:
+        """Server-level state folded into the stall bundle's
+        snapshot (the watchdog has no back-reference to us)."""
+        return {
+            "draining": self.draining,
+            "requests": self.requests,
+            "errors": self.errors,
+            "history": self.history.stats(),
+            **({"sanitizer": self.sanitizer.stats()}
+               if self.sanitizer is not None else {}),
+        }
+
+    def record_front(self, rid: Optional[str], path: str,
+                     status: int, req, resp) -> None:
+        """Minimal front-end history record for a request the ENGINE
+        never recorded — validation 400s, drain/queue sheds, solo and
+        coalesce paths.  Engine-path records are written by the
+        engine itself with the full causal timeline; this only fills
+        the gap (RequestHistory.record_front never overwrites)."""
+        if rid is None or not self.history.enabled:
+            return
+        # Mirror the handler's error->HTTP mapping back into the
+        # engine's terminal-status vocabulary, so GET /requests?
+        # status=shed finds queue-full/drain sheds the engine never
+        # saw and a record never disagrees with its trace instants.
+        front_status = {200: "complete", 429: "shed", 503: "shed",
+                        504: "expired", 499: "cancelled"}.get(
+                            int(status), "failed")
+        rec: Dict[str, Any] = {
+            "request_id": rid, "t": round(time.time(), 3),
+            "path": path, "http_status": int(status),
+            "status": front_status}
+        if isinstance(req, dict):
+            rec["kind"] = self._request_kind(req, path)
+        if isinstance(resp, dict):
+            if resp.get("error"):
+                rec["error"] = str(resp["error"])[:300]
+            if resp.get("reason"):
+                rec["reason"] = resp["reason"]
+            if "wall_s" in resp:
+                rec["wall_s"] = resp["wall_s"]
+        self.history.record_front(rec)
 
     def _check_not_draining(self) -> None:
         if self.draining:
@@ -591,7 +705,8 @@ class ModelServer:
             raise group.error
 
     def log_access(self, method: str, path: str, status: int,
-                   req, resp, dt: float) -> None:
+                   req, resp, dt: float,
+                   rid: Optional[str] = None) -> None:
         """One structured line per request (the satellite fix for the
         silent ``log_message`` no-op: before this, failed requests
         vanished entirely).  Defensive about ``req`` — it may be
@@ -603,6 +718,17 @@ class ModelServer:
             "t": round(time.time(), 3), "method": method,
             "path": path, "status": int(status),
             "ms": round(1e3 * dt, 3)}
+        if rid is not None:
+            # The correlation key: grep the access log, the trace
+            # ring, and GET /requests/<id> by the same string.
+            rec["request_id"] = rid
+        if isinstance(resp, dict):
+            # Engine-path provenance (slot id, preempt/resume
+            # counts): a resumed request reads differently from a
+            # straight-through one in the log.
+            for k in ("slot", "preempts", "resumes"):
+                if k in resp:
+                    rec[k] = resp[k]
         if isinstance(req, dict):
             rec["kind"] = self._request_kind(req, path)
             rows = req.get("prompt")
@@ -1049,9 +1175,16 @@ class ModelServer:
     # -- request handling -----------------------------------------------
 
     def generate(self, req: Dict[str, Any],
-                 cancel_check=None) -> Dict[str, Any]:
+                 cancel_check=None,
+                 rid: Optional[str] = None) -> Dict[str, Any]:
         import jax
 
+        # Correlation ID: the HTTP handler passes the inbound (or
+        # generated) X-Request-Id; library callers get one here so
+        # every request carries an ID into its trace spans and its
+        # history record whichever surface submitted it.
+        if rid is None:
+            rid = new_request_id()
         # Draining sheds BEFORE validation work: the router already
         # saw readiness drop; anything still arriving gets the
         # structured 503 immediately.
@@ -1284,7 +1417,15 @@ class ModelServer:
                     on_prefilled=self._store_stream_prefix,
                     record_timings=want_timings,
                     priority=priority, deadline_s=deadline_s,
-                    shared_pages=prefix_hit.pins or None)
+                    shared_pages=prefix_hit.pins or None,
+                    rid=rid,
+                    # Hit provenance for the history record: how
+                    # many prompt tokens the stored prefill covered
+                    # and how many pool pages the slot mapped
+                    # read-only instead of refilling.
+                    prefix_info={"cached_tokens": pc,
+                                 "shared_pages":
+                                     len(prefix_hit.pins or ())})
             except BaseException:
                 self._unpin_prefix(prefix_hit.pins)
                 raise
@@ -1302,7 +1443,7 @@ class ModelServer:
                 deadline=t0 + deadline_s
                 if deadline_s is not None else None)
             solo_events = self._emit_solo(t0, "prefix_solo",
-                                          len(rows))
+                                          len(rows), rid=rid)
         elif engine_ok:
             # CONTINUOUS BATCHING: per-row decode streams through the
             # slot pool.  Greedy streams ignore ``seed`` (greedy
@@ -1315,7 +1456,8 @@ class ModelServer:
                                        sampling=sampling,
                                        record_timings=want_timings,
                                        priority=priority,
-                                       deadline_s=deadline_s)
+                                       deadline_s=deadline_s,
+                                       rid=rid)
             self._wait_group(group, cancel_check)
             out = group.result()
             breakdown = group.breakdown()
@@ -1333,7 +1475,7 @@ class ModelServer:
             # folded inside generate() — one opaque span, honest
             # about the granularity this path offers.
             solo_events = self._emit_solo(t0, "coalesce_decode",
-                                          len(rows))
+                                          len(rows), rid=rid)
         else:
             from ..models import generate as G
 
@@ -1407,7 +1549,7 @@ class ModelServer:
                 ("solo_decode", t_lock + queue_s, t_end,
                  {"kind": key[0], "rows": len(rows)}),
                 ("complete", t_end, t_end, {})]
-            self._push_solo_events(solo_events)
+            self._push_solo_events(solo_events, rid=rid)
         dt = time.perf_counter() - t0
         if breakdown is not None:
             self._note_breakdown(*breakdown)
@@ -1450,12 +1592,31 @@ class ModelServer:
             self._lat_sum += dt
             self._lat_count += 1
             self._tokens_out += len(rows) * new
+        # Engine-path provenance for the response AND the access log
+        # (log_access copies these fields): which slot(s) served the
+        # request, and whether it was preempted/resumed along the way
+        # — a resumed request must be distinguishable from a
+        # straight-through one in the log.
+        eng_fields: Dict[str, Any] = {}
+        if group is not None:
+            slots_used = [s.last_slot for s in group.streams
+                          if s.last_slot is not None]
+            if slots_used:
+                eng_fields["slot"] = slots_used[0] \
+                    if len(slots_used) == 1 else slots_used
+            pre = sum(s.preempts for s in group.streams)
+            res = sum(s.resumes for s in group.streams)
+            if pre or res:
+                eng_fields["preempts"] = pre
+                eng_fields["resumes"] = res
         return {
             "model": self.model_name,
+            "request_id": rid,
             "new_tokens": out[:, p_len:].tolist(),
             "tokens": out.tolist(),
             "wall_s": round(dt, 4),
             "tok_per_sec": round(len(rows) * new / dt, 1),
+            **eng_fields,
             **({"queue_ms": round(1e3 * breakdown[0], 3),
                 "prefill_ms": round(1e3 * breakdown[1], 3),
                 "decode_ms": round(1e3 * breakdown[2], 3)}
@@ -1467,23 +1628,30 @@ class ModelServer:
 
     # -- telemetry helpers ----------------------------------------------
 
-    def _push_solo_events(self, events) -> None:
+    def _push_solo_events(self, events,
+                          rid: Optional[str] = None) -> None:
         """Emit a solo/coalesce request's span tuples onto the shared
-        trace ring (one fresh track per request)."""
+        trace ring (one fresh track per request).  ``rid`` is stamped
+        into every span's args — solo paths must be as findable by
+        request ID as engine paths (the correlation contract in
+        docs/SERVING.md)."""
         tid = self.telemetry.new_tid()
         for name, a, b, args in events:
+            if rid is not None:
+                args.setdefault("rid", rid)
             if a == b:
                 self.telemetry.instant(tid, name, a, **args)
             else:
                 self.telemetry.span(tid, name, a, b, **args)
 
-    def _emit_solo(self, t0: float, name: str, rows: int):
+    def _emit_solo(self, t0: float, name: str, rows: int,
+                   rid: Optional[str] = None):
         """One opaque span for paths whose internal phases are fused
         (coalescer, prefix-cache split decode): arrival -> now."""
         t_end = time.perf_counter()
         events = [(name, t0, t_end, {"rows": rows}),
                   ("complete", t_end, t_end, {})]
-        self._push_solo_events(events)
+        self._push_solo_events(events, rid=rid)
         return events
 
     def info(self) -> Dict[str, Any]:
@@ -1542,6 +1710,13 @@ class ModelServer:
                 "compile_cache": compile_cache,
                 **({"sanitizer": self.sanitizer.stats()}
                    if self.sanitizer is not None else {}),
+                # Request-scoped debuggability: the history ring's
+                # occupancy (GET /requests) and the stall watchdog's
+                # arming/knobs + fire count when enabled.
+                "debug": {
+                    **self.history.stats(),
+                    **({"watchdog": self.watchdog.status()}
+                       if self.watchdog is not None else {})},
                 # Flight-recorder attribution (serving/profiling.py):
                 # summarized from the SAME published record /metrics
                 # and GET /profile/report render.
@@ -1597,6 +1772,8 @@ class ModelServer:
         # land there, so /metrics and /info can never disagree.
         es = self.engine.stats() if self.engine is not None else {}
         rejected = es.get("rejected_total", 0)
+        stalls = self.watchdog.stalls_total \
+            if self.watchdog is not None else 0
         with self._stats_lock:
             lat_sum, lat_count = self._lat_sum, self._lat_count
             toks, errs = self._tokens_out, self.errors
@@ -1650,6 +1827,20 @@ class ModelServer:
             "# TYPE ptpu_serving_drain_rejected_total counter",
             f"ptpu_serving_drain_rejected_total "
             f"{self.drain_rejected}",
+            # Request-history ring occupancy (GET /requests): how
+            # many terminal records are retained vs the capacity
+            # knob, and how many have rolled off the ring.
+            "# TYPE ptpu_serving_request_records gauge",
+            f"ptpu_serving_request_records {len(self.history)}",
+            "# TYPE ptpu_serving_request_records_evicted_total "
+            "counter",
+            f"ptpu_serving_request_records_evicted_total "
+            f"{self.history.evicted_total}",
+            # Stall-watchdog fires (0 and absent-watchdog both read
+            # 0, so dashboards can alert on any increase without
+            # caring whether the knob is armed).
+            "# TYPE ptpu_serving_stalls_total counter",
+            f"ptpu_serving_stalls_total {stalls}",
         ]
         # Recompile sentinel (analysis/recompile.py): ONE counter set
         # across the server/engine/slot program caches, rendered by
@@ -1870,11 +2061,27 @@ class _ServingHTTPServer(ThreadingHTTPServer):
 def make_server(host: str, port: int, ms: ModelServer
                 ) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
+        def _req_id(self) -> str:
+            """This request's correlation ID: the inbound
+            ``X-Request-Id`` when usable, else generated.  Called at
+            the top of every do_* (handler instances serve multiple
+            keep-alive requests, so the field must refresh per
+            request); ``_send_raw`` echoes it on EVERY response —
+            success, 4xx, and 5xx alike."""
+            rid = sanitize_request_id(
+                self.headers.get("X-Request-Id"))
+            self._rid = rid or new_request_id()
+            return self._rid
+
         def _send_raw(self, code: int, body: bytes, ctype: str,
                       extra=None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            rid = getattr(self, "_rid", None)
+            if rid is None:
+                rid = self._rid = new_request_id()
+            self.send_header("X-Request-Id", rid)
             for k, v in (extra or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1892,6 +2099,12 @@ def make_server(host: str, port: int, ms: ModelServer
             pass
 
         def do_GET(self):
+            self._req_id()
+            path = urlparse(self.path).path
+            if path == "/requests" or path.startswith("/requests/") \
+                    or path == "/debug/state":
+                self._do_debug_get(path)
+                return
             if self.path == "/healthz":
                 # Readiness doubles as the router's drain signal: a
                 # draining server answers 503 so load balancers stop
@@ -1936,6 +2149,52 @@ def make_server(host: str, port: int, ms: ModelServer
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
+        def _do_debug_get(self, path: str):
+            """The request-scoped debuggability surface:
+
+            - ``GET /debug/state`` — the engine's latest published
+              step-boundary snapshot + server lifecycle state.
+              Served from the SnapshotBoard, never the device lock
+              (SNAPSHOT-LOCK, docs/DESIGN.md), so it answers even
+              while the engine is wedged inside a device call.
+            - ``GET /requests?status=...&limit=N`` — newest-first
+              summaries from the terminal-record retention ring.
+            - ``GET /requests/<id>`` — one request's full causal
+              record (timeline, preemptions + preemptor IDs, page
+              waits, prefix provenance, terminal cause)."""
+            if path == "/debug/state":
+                self._send(200, ms.debug_state())
+                return
+            if not ms.history.enabled:
+                self._send(400, {
+                    "error": "request history disabled (start the "
+                             "server with --request-history N)"})
+                return
+            if path in ("/requests", "/requests/"):
+                q = parse_qs(urlparse(self.path).query)
+                status = (q.get("status") or [None])[0]
+                try:
+                    limit = int((q.get("limit") or ["100"])[0])
+                except ValueError:
+                    self._send(400,
+                               {"error": "limit must be an int"})
+                    return
+                self._send(200, {
+                    "requests": ms.history.list(status=status,
+                                                limit=limit),
+                    **ms.history.stats()})
+                return
+            want = path[len("/requests/"):]
+            rec = ms.history.get(want)
+            if rec is None:
+                self._send(404, {
+                    "error": f"no record for request {want!r} "
+                             f"(never seen, or rolled off the "
+                             f"{ms.history.capacity}-record "
+                             f"retention ring)"})
+            else:
+                self._send(200, rec)
+
         def _do_profile(self):
             """POST /profile/start|stop: guarded single-flight
             jax.profiler wrap.  400 when the server was started
@@ -1967,9 +2226,11 @@ def make_server(host: str, port: int, ms: ModelServer
             except OSError:
                 pass
             ms.log_access("POST", self.path, code, None, resp,
-                          time.perf_counter() - t0)
+                          time.perf_counter() - t0,
+                          rid=getattr(self, "_rid", None))
 
         def do_POST(self):
+            rid = self._req_id()
             if self.path in ("/profile/start", "/profile/stop"):
                 self._do_profile()
                 return
@@ -1984,7 +2245,7 @@ def make_server(host: str, port: int, ms: ModelServer
                 except OSError:
                     pass
                 ms.log_access("POST", self.path, 200, None, resp,
-                              time.perf_counter() - t0)
+                              time.perf_counter() - t0, rid=rid)
                 return
             if self.path not in ("/generate", "/prefill"):
                 self._send(404, {"error": f"no route {self.path}"})
@@ -2005,7 +2266,8 @@ def make_server(host: str, port: int, ms: ModelServer
                     code, resp = 200, ms.generate(
                         req,
                         cancel_check=_disconnect_probe(
-                            self.connection))
+                            self.connection),
+                        rid=rid)
                 else:
                     code, resp = 200, ms.prefill_prompt(req)
             except ShedError as e:
@@ -2044,6 +2306,11 @@ def make_server(host: str, port: int, ms: ModelServer
                 with ms._stats_lock:
                     ms.errors += 1
                 code, resp = 500, {"error": f"{type(e).__name__}: {e}"}
+            # Error bodies carry the ID too (the header already
+            # does): a client that only kept the JSON can still
+            # quote the correlation key in a bug report.
+            if isinstance(resp, dict):
+                resp.setdefault("request_id", rid)
             try:
                 self._send(code, resp, extra)
             except OSError:
@@ -2052,6 +2319,10 @@ def make_server(host: str, port: int, ms: ModelServer
             # response; 4xx/5xx lines are the whole point (failed
             # requests used to vanish into the log_message no-op).
             ms.log_access("POST", self.path, code, req, resp,
-                          time.perf_counter() - t0)
+                          time.perf_counter() - t0, rid=rid)
+            # Front-end history record for requests the engine never
+            # recorded (validation 400s, sheds, solo paths) — the
+            # engine's full causal record wins when both exist.
+            ms.record_front(rid, self.path, code, req, resp)
 
     return _ServingHTTPServer((host, port), Handler)

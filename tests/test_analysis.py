@@ -615,6 +615,71 @@ def test_time_truth_scoped_and_ignores_nested_defs():
     assert _rules(pos, "polyaxon_tpu/models/generate.py") == []
 
 
+# -- SNAPSHOT-LOCK ----------------------------------------------------------
+
+
+def test_snapshot_lock_flags_device_work_under_state_lock():
+    """The /debug/state consistency contract: nothing under a
+    snapshot ``*state_lock`` may acquire the device lock (directly,
+    via .acquire(), via a device-dispatching entry point, or via any
+    jax call) — a wedged device call must never wedge the
+    introspection surface that exists to diagnose it."""
+    src = """
+    import jax
+
+    def serve_state(self):
+        with self._state_lock:
+            with self._lock:
+                pass
+            self.engine._lock.acquire()
+            self.ms.generate(req)
+            self.engine.submit(toks, 4, None, None)
+            jax.device_get(x)
+    """
+    # The blocking .acquire() ALSO trips LOCK-HOLD's nested-acquire
+    # check (correct: it is both an inversion seed and a snapshot-
+    # contract breach); findings sort by (line, rule).
+    assert _rules(src) == ["SNAPSHOT-LOCK", "LOCK-HOLD",
+                           "SNAPSHOT-LOCK", "SNAPSHOT-LOCK",
+                           "SNAPSHOT-LOCK", "SNAPSHOT-LOCK"]
+
+
+def test_snapshot_lock_negatives():
+    """Host-dict snapshot work under the state lock passes; device
+    work under OTHER locks is LOCK-HOLD's territory, not this
+    rule's; nested defs run later, off the lock."""
+    src = """
+    def publish(self, snap):
+        with self._state_lock:
+            self._snapshot = snap
+
+    def latest(self):
+        with self._state_lock:
+            snap = self._snapshot
+            return dict(snap) if snap is not None else None
+
+    def handler(self):
+        with self._state_lock:
+            def later():
+                return self.ms.generate(req)
+            return later
+
+    def elsewhere(self):
+        with self._stats_lock:
+            self.requests += 1
+    """
+    assert _rules(src) == []
+
+
+def test_snapshot_lock_scoped_to_serving():
+    src = """
+    def f(self):
+        with self._state_lock:
+            self.ms.generate(req)
+    """
+    assert _rules(src, "polyaxon_tpu/train.py") == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 

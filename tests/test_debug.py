@@ -1,0 +1,825 @@
+"""Request-scoped debuggability (serving/debug.py + the server/
+engine integration): the layer that answers "what happened to THIS
+request" and "why is the engine making no progress right now".
+
+The contracts pinned here:
+
+- **ID propagation**: an inbound ``X-Request-Id`` is honored (when
+  well-formed) and echoed on EVERY response — success, 4xx, 5xx,
+  unknown-route 404s — as both the response header and the JSON
+  ``request_id`` field; absent/malformed headers downgrade to a
+  generated ID, never an error.  The same ID lands in the access
+  log, every trace span the request emits, and the history record.
+- **Causal-timeline exactness**: under a co-tenancy schedule with
+  real SLO preemptions, ``GET /requests/<id>``'s record reproduces
+  the exact preemption/resume chain — each ``preempted`` entry
+  carrying the PREEMPTOR's request ID and the control-law reason —
+  and the record's timeline is pinned event-for-event against the
+  engine's trace-ring spans (one source, two surfaces).
+- **Snapshot consistency**: ``GET /debug/state`` serves the
+  engine's step-boundary-published snapshot — internally consistent
+  (derived fields agree with the tables they summarize) and served
+  without ever touching the device lock, so it answers under load
+  and while the engine is wedged.
+- **Stall watchdog**: a wedged engine (work present, no step
+  boundaries) produces a loadable diagnostic bundle — forced
+  snapshot, trace tail, thread stacks — within one
+  ``--stall-timeout``, one-shot per episode, re-arming on recovery.
+- **Retention bounding**: the history ring holds exactly its
+  capacity, evicts oldest-first (counted), and capacity 0 disables
+  recording outright.
+- **Zero steady-state recompiles** with the layer fully armed: the
+  debuggability layer is host-side bookkeeping and must never
+  perturb the compiled-program story.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models.registry import get_model
+from polyaxon_tpu.serving import (DecodeEngine, ModelServer,
+                                  RequestHistory, SchedulerPolicy,
+                                  StallWatchdog, Telemetry,
+                                  make_server)
+from polyaxon_tpu.serving.debug import (dump_thread_stacks,
+                                        new_request_id,
+                                        sanitize_request_id)
+
+PROMPT = np.asarray([[3, 1, 4, 1]], np.int32)
+OTHER = np.asarray([[2, 7, 1, 8]], np.int32)
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    spec = get_model("gpt2-tiny")
+    return spec.init_params(batch_size=1)
+
+
+@pytest.fixture(scope="module")
+def debug_server(tiny):
+    model, variables = tiny
+    ms = ModelServer(model, variables, model_name="gpt2-tiny",
+                     max_batch=8, n_slots=4, queue_depth=32,
+                     request_history=64, access_log=True)
+    import io
+
+    ms._access_log_file = io.StringIO()
+    srv = make_server("127.0.0.1", 0, ms)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", ms
+    srv.shutdown()
+    srv.server_close()
+    ms.close()
+
+
+def _post(base, payload, expect=200, headers=None):
+    """POST /generate; returns (status, response headers, body)."""
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == expect
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        assert e.code == expect, body
+        return e.code, dict(e.headers), json.loads(body)
+
+
+def _get(base, path, expect=200):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            assert r.status == expect
+            return dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        assert e.code == expect, body
+        return dict(e.headers), json.loads(body)
+
+
+def _engine(model, variables, *, telemetry=None, history=None,
+            **policy):
+    kw = dict(n_slots=2, decode_window=1)
+    kw.update(policy)
+    eng = DecodeEngine(model, variables, autostart=False,
+                       policy=SchedulerPolicy(**kw),
+                       telemetry=telemetry)
+    if history is not None:
+        eng.history = history
+    return eng
+
+
+def _small_model(vocab=32):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=vocab, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+# ---------------------------------------------------------------------------
+# request IDs
+# ---------------------------------------------------------------------------
+
+
+class TestRequestIds:
+    def test_sanitize(self):
+        assert sanitize_request_id("req-1.a:B_x") == "req-1.a:B_x"
+        assert sanitize_request_id("  padded  ") == "padded"
+        assert sanitize_request_id(None) is None
+        assert sanitize_request_id("") is None
+        assert sanitize_request_id("has spaces") is None
+        assert sanitize_request_id("x" * 129) is None
+        assert sanitize_request_id("new\nline") is None
+        rid = new_request_id()
+        assert sanitize_request_id(rid) == rid and len(rid) == 16
+
+    def test_inbound_id_honored_header_and_body(self, debug_server):
+        base, _ = debug_server
+        _, hdrs, body = _post(
+            base, {"prompt": [1, 2, 3], "max_new_tokens": 2},
+            headers={"X-Request-Id": "client-req.1"})
+        assert hdrs["X-Request-Id"] == "client-req.1"
+        assert body["request_id"] == "client-req.1"
+
+    def test_generated_when_absent_and_unique(self, debug_server):
+        base, _ = debug_server
+        ids = set()
+        for _ in range(2):
+            _, hdrs, body = _post(
+                base, {"prompt": [1, 2, 3], "max_new_tokens": 1})
+            assert hdrs["X-Request-Id"] == body["request_id"]
+            assert len(body["request_id"]) == 16
+            ids.add(body["request_id"])
+        assert len(ids) == 2
+
+    def test_malformed_inbound_downgrades_to_generated(
+            self, debug_server):
+        base, _ = debug_server
+        _, hdrs, body = _post(
+            base, {"prompt": [1, 2, 3], "max_new_tokens": 1},
+            headers={"X-Request-Id": "bad id !!"})
+        assert hdrs["X-Request-Id"] != "bad id !!"
+        assert body["request_id"] == hdrs["X-Request-Id"]
+
+    def test_errors_echo_the_id(self, debug_server):
+        """The acceptance bar: EVERY response carries the ID —
+        validation 400s and unknown-route 404s included — in the
+        header AND the JSON body."""
+        base, _ = debug_server
+        _, hdrs, body = _post(
+            base, {"prompt": [1, 2, 3], "max_new_tokens": 0},
+            expect=400, headers={"X-Request-Id": "err-corr-1"})
+        assert hdrs["X-Request-Id"] == "err-corr-1"
+        assert body["request_id"] == "err-corr-1"
+        hdrs, body = _get(base, "/no/such/route", expect=404)
+        assert len(hdrs["X-Request-Id"]) == 16
+
+    def test_trace_spans_and_timings_carry_rid(self, debug_server):
+        base, ms = debug_server
+        _, _, body = _post(
+            base, {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                   "timings": True},
+            headers={"X-Request-Id": "traced-req"})
+        assert body["request_id"] == "traced-req"
+        mine = [e for e in ms.telemetry.events()
+                if e.get("args", {}).get("rid") == "traced-req"]
+        names = {e["name"] for e in mine}
+        assert {"queue", "admit", "decode", "complete"} <= names
+
+    def test_access_log_carries_id_and_engine_provenance(
+            self, debug_server):
+        base, ms = debug_server
+        mark = ms._access_log_file.tell()
+        _, _, body = _post(
+            base, {"prompt": [1, 2, 3], "max_new_tokens": 2},
+            headers={"X-Request-Id": "logged-req"})
+        assert "slot" in body      # engine-path provenance in resp
+        for _ in range(100):       # line lands after the response
+            if "logged-req" in ms._access_log_file.getvalue()[mark:]:
+                break
+            time.sleep(0.02)
+        lines = [json.loads(ln) for ln in
+                 ms._access_log_file.getvalue()[mark:].splitlines()]
+        rec = next(ln for ln in lines
+                   if ln.get("request_id") == "logged-req")
+        assert rec["status"] == 200
+        assert rec["slot"] == body["slot"]
+
+
+# ---------------------------------------------------------------------------
+# retention ring
+# ---------------------------------------------------------------------------
+
+
+class TestRequestHistory:
+    def test_bounded_oldest_first_eviction(self):
+        h = RequestHistory(capacity=4)
+        for i in range(10):
+            h.record({"request_id": f"r{i}", "status": "complete"})
+        assert len(h) == 4
+        assert h.recorded_total == 10
+        assert h.evicted_total == 6
+        assert h.get("r0") is None          # rolled off
+        assert h.get("r9")["request_id"] == "r9"
+        # list is newest-first
+        assert [r["request_id"] for r in h.list()] == \
+            ["r9", "r8", "r7", "r6"]
+
+    def test_rerecord_replaces_and_front_end_never_clobbers(self):
+        h = RequestHistory(capacity=8)
+        h.record_front({"request_id": "a", "status": "failed",
+                        "http_status": 400})
+        # the engine's full record supersedes the front-end minimal
+        h.record({"request_id": "a", "status": "complete",
+                  "preempts": 1})
+        assert h.get("a")["status"] == "complete"
+        assert len(h) == 1
+        # ...but a later front-end record never clobbers the engine's
+        h.record_front({"request_id": "a", "status": "failed"})
+        assert h.get("a")["status"] == "complete"
+
+    def test_capacity_zero_disables_negative_raises(self):
+        h = RequestHistory(capacity=0)
+        assert not h.enabled
+        h.record({"request_id": "x", "status": "complete"})
+        assert len(h) == 0 and h.recorded_total == 0
+        with pytest.raises(ValueError, match="request_history"):
+            RequestHistory(capacity=-1)
+
+    def test_list_status_filter_and_limit(self):
+        h = RequestHistory(capacity=16)
+        for i in range(6):
+            h.record({"request_id": f"c{i}", "status": "complete"})
+        for i in range(3):
+            h.record({"request_id": f"f{i}", "status": "failed",
+                      "error": "Boom: no"})
+        assert len(h.list(status="failed")) == 3
+        assert len(h.list(status="complete", limit=2)) == 2
+        assert h.list(status="shed") == []
+        assert h.list(limit=0) == [] and h.list(limit=-5) == []
+        st = h.stats()
+        assert st["request_history"] == 16
+        assert st["request_records"] == 9
+
+
+# ---------------------------------------------------------------------------
+# causal timelines (co-tenancy exactness)
+# ---------------------------------------------------------------------------
+
+
+class TestCausalTimeline:
+    def test_preemption_chain_exact_under_three_schedule_cotenancy(
+            self):
+        """THE exactness pin: a batch victim preempted twice by two
+        different interactive requests carries BOTH preemptions in
+        its history record — each with the correct preemptor's
+        request ID and the control-law reason — and the record's
+        timeline agrees event-for-event with the engine's trace
+        ring (same source, two surfaces)."""
+        model, variables = _small_model()
+        tel = Telemetry(buffer=2048)
+        hist = RequestHistory(capacity=32)
+        eng = _engine(model, variables, telemetry=tel, history=hist,
+                      n_slots=1, slo_ttft_s=0.0001)
+        victim = eng.submit(PROMPT, 24, None, None,
+                            priority="batch", rid="victim-req")
+        while len(victim.streams[0].out) < 3:
+            eng.tick()
+        inter1 = eng.submit(OTHER, 3, None, None,
+                            priority="interactive", rid="inter-1")
+        while not inter1.event.is_set():
+            eng.tick()
+        # let the victim resume and commit a few more tokens, then
+        # hit it with the second preemptor
+        resumed_at = len(victim.streams[0].out)
+        while len(victim.streams[0].out) < resumed_at + 2:
+            eng.tick()
+        inter2 = eng.submit(OTHER, 3, None, None,
+                            priority="interactive", rid="inter-2")
+        eng.run_until_idle()
+        assert eng.preempted_total == 2
+        assert victim.event.is_set() and victim.error is None
+
+        rec = hist.get("victim-req")
+        assert rec is not None
+        assert rec["status"] == "complete"
+        assert rec["preempts"] == 2 and rec["resumes"] == 2
+        tl = rec["streams"][0]["timeline"]
+        pre = [e for e in tl if e["name"] == "preempted"]
+        assert [p["args"]["by"] for p in pre] == \
+            ["inter-1", "inter-2"]
+        assert all(p["args"]["reason"] == "head_wait_over_half_slo"
+                   for p in pre)
+        # resumed admissions are marked; straight-through ones not
+        admits = [e for e in tl if e["name"] == "admit"]
+        assert len(admits) == 3
+        assert [bool(a["args"].get("resumed")) for a in admits] == \
+            [False, True, True]
+        # pinned against the trace ring: same preemption chain
+        trace_pre = [e for e in tel.events()
+                     if e["name"] == "preempted"
+                     and e["args"].get("rid") == "victim-req"]
+        assert [e["args"]["by"] for e in trace_pre] == \
+            ["inter-1", "inter-2"]
+        # the preemptors' own records exist and were never preempted
+        for rid in ("inter-1", "inter-2"):
+            r = hist.get(rid)
+            assert r["status"] == "complete" and r["preempts"] == 0
+
+    def test_blocked_admission_attributes_the_unblocking_eviction(
+            self):
+        """A prefilled head that cannot admit opens an
+        ``admit_blocked`` wait in its timeline; when the resident's
+        completion frees the slot, ``admit_unblocked`` closes it
+        naming WHO freed the capacity and via what."""
+        model, variables = _small_model()
+        hist = RequestHistory(capacity=8)
+        eng = _engine(model, variables, history=hist, n_slots=1)
+        first = eng.submit(PROMPT, 8, None, None, rid="holder")
+        eng.tick()                       # holder admits
+        waiter = eng.submit(OTHER, 2, None, None, rid="waiter")
+        eng.run_until_idle()
+        assert first.error is None and waiter.error is None
+        tl = hist.get("waiter")["streams"][0]["timeline"]
+        blocked = [e for e in tl if e["name"] == "admit_blocked"]
+        unblocked = [e for e in tl
+                     if e["name"] == "admit_unblocked"]
+        assert len(blocked) == 1 and blocked[0]["args"]["on"] == \
+            "slot"
+        assert len(unblocked) == 1
+        assert unblocked[0]["args"]["unblocked_by"] == "holder"
+        assert unblocked[0]["args"]["freed_via"] == "complete"
+        assert unblocked[0]["args"]["wait_ms"] >= 0
+
+    def test_terminal_error_paths_are_recorded(self):
+        model, variables = _small_model()
+        hist = RequestHistory(capacity=8)
+        eng = _engine(model, variables, history=hist, n_slots=1)
+        g = eng.submit(PROMPT, 30, None, None, rid="doomed")
+        for _ in range(3):
+            eng.tick()
+        eng.cancel(g)
+        eng.tick()
+        rec = hist.get("doomed")
+        assert rec["status"] == "cancelled"
+        assert "RequestCancelled" in rec["error"]
+        eng.run_until_idle()
+
+    def test_http_requests_endpoints(self, debug_server):
+        base, ms = debug_server
+        _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 2},
+              headers={"X-Request-Id": "fetch-me"})
+        hdrs, rec = _get(base, "/requests/fetch-me")
+        assert rec["request_id"] == "fetch-me"
+        assert rec["status"] == "complete"
+        assert rec["kind"] == "greedy" and rec["rows"] == 1
+        assert rec["prompt_tokens"] == 3
+        assert rec["max_new_tokens"] == 2
+        assert rec["wall_s"] >= rec["decode_s"] >= 0
+        assert "ttft_s" in rec
+        tl = rec["streams"][0]["timeline"]
+        assert [e["name"] for e in tl][-1] == "complete"
+        # the listing surfaces it, newest-first, filterable
+        _, listing = _get(base, "/requests?status=complete")
+        assert any(r["request_id"] == "fetch-me"
+                   for r in listing["requests"])
+        assert all(r["status"] == "complete"
+                   for r in listing["requests"])
+        _, limited = _get(base, "/requests?limit=1")
+        assert len(limited["requests"]) == 1
+        # a failed request gets a (front-end) record too
+        _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 0},
+              expect=400, headers={"X-Request-Id": "failed-req"})
+        _, frec = _get(base, "/requests/failed-req")
+        assert frec["status"] == "failed"
+        assert frec["http_status"] == 400
+        # unknown ID: structured 404, ID still echoed
+        hdrs, miss = _get(base, "/requests/nope", expect=404)
+        assert "retention ring" in miss["error"]
+        assert len(hdrs["X-Request-Id"]) == 16
+        _get(base, "/requests?limit=zzz", expect=400)
+        # /requests<garbage> is the no-route 404, not a record miss
+        _, nr = _get(base, "/requestsfoo", expect=404)
+        assert "no record" not in nr.get("error", "")
+        # a queue-full/drain shed records as status=shed, matching
+        # its trace instants (never the generic "failed")
+        ms.draining = True
+        try:
+            _post(base, {"prompt": [1, 2, 3], "max_new_tokens": 2},
+                  expect=503, headers={"X-Request-Id": "shed-drain"})
+        finally:
+            ms.draining = False
+            ms.engine.draining = False
+        _, srec = _get(base, "/requests/shed-drain")
+        assert srec["status"] == "shed" and srec["http_status"] == 503
+        _, sl = _get(base, "/requests?status=shed")
+        assert any(r["request_id"] == "shed-drain"
+                   for r in sl["requests"])
+
+    def test_requests_endpoint_400_when_disabled(self, tiny):
+        model, variables = tiny
+        ms = ModelServer(model, variables, max_batch=4,
+                         request_history=0)
+        srv = make_server("127.0.0.1", 0, ms)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            _, body = _get(base, "/requests", expect=400)
+            assert "--request-history" in body["error"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            ms.close()
+
+
+# ---------------------------------------------------------------------------
+# trace_report --request (offline twin of GET /requests/<id>)
+# ---------------------------------------------------------------------------
+
+
+def _trace_report_mod():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "benchmarks", "trace_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    return tr
+
+
+def test_trace_report_renders_one_requests_timeline(tmp_path):
+    """``trace_report.py TRACE --request ID`` reassembles one
+    request's causal story from a saved trace dump using the rid
+    span fields — preemptor ID and reason included."""
+    model, variables = _small_model()
+    tel = Telemetry(buffer=2048)
+    eng = _engine(model, variables, telemetry=tel, n_slots=1,
+                  slo_ttft_s=0.0001)
+    victim = eng.submit(PROMPT, 14, None, None, priority="batch",
+                        rid="tr-victim")
+    while len(victim.streams[0].out) < 3:
+        eng.tick()
+    eng.submit(OTHER, 3, None, None, priority="interactive",
+               rid="tr-inter")
+    eng.run_until_idle()
+    assert eng.preempted_total == 1
+    path = str(tmp_path / "trace.json")
+    with open(path, "w") as f:
+        json.dump(tel.chrome_trace(), f)
+
+    tr = _trace_report_mod()
+    tl = tr.request_timeline(tr.load_trace_events(path), "tr-victim")
+    assert tl is not None
+    assert tl["request_id"] == "tr-victim"
+    assert tl["preemptions"] and \
+        tl["preemptions"][0]["by"] == "tr-inter"
+    assert tl["preemptions"][0]["reason"] == \
+        "head_wait_over_half_slo"
+    assert tl["terminal"] == "complete"
+    names = [e["event"] for e in tl["events"]]
+    assert "queue" in names and "preempted" in names
+    # offsets are relative to the request's first event, ordered
+    ats = [e["at_ms"] for e in tl["events"]]
+    assert ats[0] == 0 and ats == sorted(ats)
+    # no cross-request contamination: the preemptor's timeline is
+    # its own
+    tl2 = tr.request_timeline(tr.load_trace_events(path),
+                              "tr-inter")
+    assert all("preempted" != e["event"] for e in tl2["events"])
+    assert tr.request_timeline(tr.load_trace_events(path),
+                               "no-such") is None
+
+
+# ---------------------------------------------------------------------------
+# /debug/state
+# ---------------------------------------------------------------------------
+
+
+class TestDebugState:
+    def test_snapshot_consistency_under_load(self, debug_server):
+        """Hammer /generate while polling /debug/state: every
+        snapshot parses, its derived fields agree with the tables
+        they summarize, and the final quiescent snapshot shows an
+        empty engine."""
+        base, ms = debug_server
+        # Publish every boundary: with warm jit caches the whole run
+        # can fit inside the default 100ms board throttle, and this
+        # test is about snapshot CONSISTENCY, not publish cadence.
+        ms.engine.board_interval_s = 0.0
+        errors = []
+
+        def client(i):
+            try:
+                _post(base, {"prompt": [1 + i, 2, 3],
+                             "max_new_tokens": 6})
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        seen_busy = False
+        # Poll for as long as the clients are in flight (not a fixed
+        # count: the first request's compile can eat seconds before
+        # any boundary publishes a busy board), generously bounded.
+        poll_deadline = time.time() + 120
+        while time.time() < poll_deadline:
+            _, state = _get(base, "/debug/state")
+            eng = state["engine"]
+            assert eng is not None and not eng["forced"]
+            assert eng["age_s"] >= 0
+            assert eng["queue_len"] == sum(
+                len(q) for q in eng["queues"].values())
+            assert len(eng["slots"]) <= eng["n_slots"]
+            assert eng["free_slots"] == \
+                eng["n_slots"] - len(eng["slots"])
+            for s in eng["slots"]:
+                assert s["request_id"]
+                assert s["remaining"] >= 0 and s["age_s"] >= 0
+                seen_busy = True
+            if all(not t.is_alive() for t in threads):
+                break
+            time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert seen_busy, "no snapshot ever observed a resident"
+        # quiescent: the published snapshot drains too (the board
+        # refreshes at the final boundaries)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            _, state = _get(base, "/debug/state")
+            if not state["engine"]["slots"] \
+                    and state["engine"]["queue_len"] == 0:
+                break
+            time.sleep(0.05)
+        assert state["engine"]["slots"] == []
+        assert state["history"]["request_records"] > 0
+        assert not state["draining"]
+
+    def test_engine_level_snapshot_fields(self):
+        model, variables = _small_model()
+        hist = RequestHistory(capacity=8)
+        eng = _engine(model, variables, history=hist, n_slots=2)
+        g = eng.submit(PROMPT, 6, None, None, rid="snap-resident")
+        queued = eng.submit(OTHER, 2, None, None, rid="snap-queued",
+                            deadline_s=30.0)
+        eng.tick()
+        snap = eng.build_debug_snapshot()
+        assert not snap["forced"]
+        by_id = {s["request_id"]: s for s in snap["slots"]}
+        assert "snap-resident" in by_id
+        res = by_id["snap-resident"]
+        assert res["kind"] == "greedy"
+        assert res["priority"] == "interactive"
+        assert res["remaining"] == 6 - res["tokens_out"]
+        assert res["preempts"] == 0 and res["resumes"] == 0
+        eng.run_until_idle()
+        assert g.error is None and queued.error is None
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestStallWatchdog:
+    def test_fires_on_wedged_engine_with_loadable_bundle(
+            self, tmp_path):
+        """Work present + no step boundaries -> ONE bundle: stall
+        metadata, forced snapshot, trace tail, thread stacks — all
+        loadable from the JSON on disk."""
+        model, variables = _small_model()
+        tel = Telemetry(buffer=256)
+        eng = _engine(model, variables, telemetry=tel, n_slots=1)
+        eng.submit(PROMPT, 4, None, None, rid="stuck-req")
+        wd = StallWatchdog(eng, tel, timeout_s=0.05,
+                           out_dir=str(tmp_path))
+        time.sleep(0.06)                 # let the boundary go stale
+        path = wd.check()
+        assert path is not None and wd.stalls_total == 1
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["stall"]["reason"] == "no_step_boundary"
+        assert bundle["stall"]["stale_s"] > 0.05
+        assert bundle["state"]["forced"] is True
+        assert bundle["state"]["queue_len"] == 1
+        assert bundle["state"]["queues"]["interactive"][0][
+            "request_id"] == "stuck-req"
+        # the submitted request's queue activity is in the tail
+        assert any(e.get("args", {}).get("rid") == "stuck-req"
+                   for e in bundle["trace_tail"])
+        assert any("MainThread" in k for k in bundle["threads"])
+        # one-shot per episode
+        assert wd.check() is None and wd.stalls_total == 1
+        # progress re-arms; an idle engine never fires
+        eng.run_until_idle()
+        assert wd.check() is None
+        # a fresh wedge is a fresh episode -> a second bundle
+        eng.submit(OTHER, 4, None, None)
+        time.sleep(0.06)
+        assert wd.check() is not None and wd.stalls_total == 2
+        eng.run_until_idle()
+        # the stall instants landed in the trace ring
+        assert sum(1 for e in tel.events()
+                   if e["name"] == "stall") == 2
+
+    def test_thread_fires_within_one_timeout(self, tmp_path):
+        """The acceptance bar: the watchdog THREAD produces the
+        bundle within one --stall-timeout of the wedge being
+        observable."""
+        model, variables = _small_model()
+        eng = _engine(model, variables, n_slots=1)
+        eng.submit(PROMPT, 4, None, None)
+        # Wedge AFTER submit: submit re-stamps the boundary on the
+        # idle->busy transition (so a long-idle server is not
+        # declared stalled the moment work arrives) — age it past
+        # the timeout to simulate an engine stuck mid-step.
+        eng.last_boundary_t -= 1.2
+        wd = StallWatchdog(eng, None, timeout_s=1.0,
+                           out_dir=str(tmp_path))
+        t0 = time.perf_counter()
+        wd.start()
+        try:
+            while wd.stalls_total == 0 \
+                    and time.perf_counter() - t0 < 5.0:
+                time.sleep(0.02)
+            elapsed = time.perf_counter() - t0
+            assert wd.stalls_total == 1
+            assert elapsed <= 1.0, \
+                f"bundle took {elapsed:.2f}s (> one timeout)"
+            assert wd.last_stall["bundle"] is not None
+        finally:
+            wd.close()
+            eng.run_until_idle()
+
+    def test_idle_start_does_not_fire_on_first_request(
+            self, tmp_path):
+        """A server idle past --stall-timeout must not read as
+        stalled the instant work arrives: submit re-stamps the
+        boundary on the idle->busy transition, and only the FIRST
+        submit — later submits into a wedged queue must not keep
+        resetting staleness."""
+        model, variables = _small_model()
+        eng = _engine(model, variables, n_slots=1)
+        eng.last_boundary_t -= 100.0     # long-idle server
+        wd = StallWatchdog(eng, None, timeout_s=1.0,
+                           out_dir=str(tmp_path))
+        eng.submit(PROMPT, 4, None, None)
+        assert wd.check() is None        # healthy, just woke up
+        # a SECOND submit while the queue is nonempty does not
+        # re-stamp: a wedged engine under traffic still goes stale
+        eng.last_boundary_t -= 2.0
+        eng.submit(OTHER, 4, None, None)
+        assert wd.check() is not None and wd.stalls_total == 1
+        eng.run_until_idle()
+
+    def test_queue_age_fires_once_per_request(self, tmp_path):
+        """queue_age episodes key on the offending request ID, not
+        boundary progress — a healthy-stepping engine advances the
+        boundary every tick, which must not re-fire the same ancient
+        request every poll."""
+        model, variables = _small_model()
+        eng = _engine(model, variables, n_slots=1,
+                      queue_deadline_s=0.05)
+        g = eng.submit(PROMPT, 4, None, None, rid="ancient-2")
+        g.t_submit -= 10.0
+        wd = StallWatchdog(eng, None, timeout_s=1e9,
+                           out_dir=str(tmp_path), queue_factor=2.0)
+        assert wd.check() is not None and wd.stalls_total == 1
+        # boundary advances (ticking engine) — same request must
+        # not produce a second bundle
+        eng.last_boundary_t = time.perf_counter()
+        assert wd.check() is None and wd.stalls_total == 1
+        eng.run_until_idle()
+
+    def test_queue_age_trigger_names_the_ancient_request(
+            self, tmp_path):
+        """The second stall signature: a queued request aged far
+        past its class deadline means the shed sweep itself stopped
+        running."""
+        model, variables = _small_model()
+        eng = _engine(model, variables, n_slots=1,
+                      queue_deadline_s=0.05)
+        g = eng.submit(PROMPT, 4, None, None, rid="ancient")
+        g.t_submit -= 10.0               # artificially ancient
+        wd = StallWatchdog(eng, None, timeout_s=1e9,
+                           out_dir=str(tmp_path), queue_factor=2.0)
+        path = wd.check()
+        assert path is not None
+        assert wd.last_stall["reason"] == "queue_age"
+        assert wd.last_stall["request_id"] == "ancient"
+        eng.run_until_idle()
+
+    def test_write_failure_downgrades_to_counter(self, tmp_path):
+        """A read-only disk must not kill the watchdog: the stall is
+        still counted and kept in memory, bundle path None."""
+        blocker = tmp_path / "file"
+        blocker.write_text("not a dir")
+        model, variables = _small_model()
+        eng = _engine(model, variables, n_slots=1)
+        eng.submit(PROMPT, 4, None, None)
+        wd = StallWatchdog(eng, None, timeout_s=0.01,
+                           out_dir=str(blocker / "sub"))
+        time.sleep(0.02)
+        assert wd.check() is None        # no path...
+        assert wd.stalls_total == 1      # ...but counted
+        assert wd.last_stall["bundle"] is None
+        eng.run_until_idle()
+
+    def test_validation(self):
+        model, variables = _small_model()
+        eng = _engine(model, variables)
+        with pytest.raises(ValueError, match="stall_timeout"):
+            StallWatchdog(eng, None, timeout_s=0.0, out_dir=".")
+        # server-level: the watchdog needs step boundaries to watch
+        with pytest.raises(ValueError, match="continuous"):
+            ModelServer(model, variables, batching="off",
+                        stall_timeout_s=1.0)
+
+    def test_server_wires_and_reaps_the_watchdog(self, tiny,
+                                                 tmp_path):
+        model, variables = tiny
+        ms = ModelServer(model, variables, max_batch=4,
+                         stall_timeout_s=30.0,
+                         stall_dir=str(tmp_path))
+        try:
+            assert ms.watchdog is not None and ms.watchdog.is_alive()
+            assert ms.engine.history is ms.history
+            # surfaced on /info's debug block and the metrics text
+            info = ms.info()
+            assert info["debug"]["watchdog"]["timeout_s"] == 30.0
+            assert "ptpu_serving_stalls_total 0" in ms.metrics_text()
+        finally:
+            ms.close()
+        ms.watchdog.join(timeout=5)
+        assert not ms.watchdog.is_alive()
+
+    def test_dump_thread_stacks_sees_this_thread(self):
+        stacks = dump_thread_stacks()
+        mine = next(v for k, v in stacks.items()
+                    if "MainThread" in k)
+        assert any("dump_thread_stacks_sees_this_thread" in ln
+                   for ln in mine)
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state recompiles with the layer armed
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_state_recompiles_with_layer_armed():
+    """The debuggability layer is host-side bookkeeping: with the
+    history ring recording every request and snapshots publishing,
+    repeated same-shape traffic adds ZERO compile-cache misses after
+    warmup."""
+    model, variables = _small_model()
+    tel = Telemetry(buffer=1024)
+    hist = RequestHistory(capacity=64)
+    eng = _engine(model, variables, telemetry=tel, history=hist,
+                  n_slots=2)
+    eng.board_interval_s = 0.0           # publish EVERY boundary
+
+    def run_one(rid):
+        g = eng.submit(PROMPT, 6, None, None, rid=rid)
+        eng.run_until_idle()
+        assert g.error is None
+
+    run_one("warm-0")                    # warmup compiles
+    warm = eng.sentinel.snapshot()["compile_cache_misses"]
+    for i in range(4):
+        run_one(f"steady-{i}")
+    assert eng.sentinel.snapshot()["compile_cache_misses"] == warm, \
+        "debug layer perturbed the compiled-program story"
+    assert len(hist) == 5                # every request recorded

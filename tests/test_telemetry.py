@@ -293,7 +293,8 @@ def test_timings_block(tel_server):
     assert t["ttft_ms"] >= 0
     spans = t["streams"][0]["spans"]
     names = [s["name"] for s in spans]
-    assert names[0] == "queue"
+    # queue-entry instant first, then the queue-wait span
+    assert names[:2] == ["queued", "queue"]
     assert names[-1] == "complete"
     assert "admit" in names and "decode" in names
     assert names.index("admit") < names.index("decode")
@@ -380,7 +381,8 @@ def test_engine_spans_complete_and_ordered(tiny):
     assert len(by_tid) == 3
     for tid, evs in by_tid.items():
         names = [e["name"] for e in evs]
-        assert names[0] == "queue"
+        # queue-entry instant first, then the queue-wait span
+        assert names[:2] == ["queued", "queue"]
         assert names[-2:] == ["decode", "complete"]
         assert "admit" in names
         prefills = [i for i, n in enumerate(names) if n == "prefill"]
