@@ -152,13 +152,22 @@ def meshed_value(r):
             + (f" collP {prof}" if prof is not None else ""))
 
 
+def _overhead_pct(ov):
+    """Render one overhead-leg percentage; a ``!`` suffix marks a
+    NOISY-BOX measurement (the harness's same-arm round-to-round
+    spread exceeded the ~3% band the leg attests, so the number is
+    an honest label, not evidence)."""
+    pct = ov.get("overhead_pct")
+    if pct is None:
+        return ""
+    return f"{pct}%" + ("!" if ov.get("noisy_box") else "")
+
+
 def telemetry_value(r):
     """serving-load rows: the telemetry-overhead A/B column — the
     tracing-on tax in % agg tok/s (contract: <= ~3%).  Empty for
     every other bench."""
-    ov = r.get("telemetry_overhead") or {}
-    pct = ov.get("overhead_pct")
-    return "" if pct is None else f"{pct}%"
+    return _overhead_pct(r.get("telemetry_overhead") or {})
 
 
 def recorder_value(r):
@@ -167,11 +176,11 @@ def recorder_value(r):
     contract as telemetry), with the window count.  Empty for every
     other bench."""
     ov = r.get("recorder_overhead") or {}
-    pct = ov.get("overhead_pct")
-    if pct is None:
+    pct = _overhead_pct(ov)
+    if not pct:
         return ""
     w = ov.get("windows")
-    return f"{pct}%" + (f" ({w}w)" if w is not None else "")
+    return pct + (f" ({w}w)" if w is not None else "")
 
 
 def debug_value(r):
@@ -179,9 +188,30 @@ def debug_value(r):
     the history-ring + stall-watchdog tax in % agg tok/s with the
     layer fully armed (same <= ~3% contract as telemetry and the
     recorder).  Empty for every other bench."""
-    ov = r.get("debug_overhead") or {}
-    pct = ov.get("overhead_pct")
-    return "" if pct is None else f"{pct}%"
+    return _overhead_pct(r.get("debug_overhead") or {})
+
+
+def chaos_value(r):
+    """serving-load rows: the chaos-soak column — terminal-status
+    accounting under the seeded fault storm (ok / poisoned
+    convictions / hung callers), engine restarts, and the armed-
+    fault-probe overhead tax.  ``LEAK``/``WEDGED`` flags mean the
+    crash-only contract was violated (the bench run itself fails on
+    them; a committed flag marks a preserved-evidence row).  Empty
+    for every other bench."""
+    ch = r.get("chaos") or {}
+    if not ch:
+        return ""
+    out = (f"{ch.get('ok', 0)}ok {ch.get('poisoned', 0)}px "
+           f"{ch.get('hung', 0)}hung r{ch.get('engine_restarts', 0)}")
+    if ch.get("leaked_slots") or ch.get("leaked_pages"):
+        out += " LEAK"
+    if ch.get("breaker_wedged"):
+        out += " WEDGED"
+    probe = _overhead_pct(r.get("faults_overhead") or {})
+    if probe:
+        out += f" probe {probe}"
+    return out
 
 
 def main() -> int:
@@ -194,9 +224,9 @@ def main() -> int:
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
           "| spec-mix | paged | mesh | telemetry | recorder | debug "
-          "| overload | mfu | age |")
+          "| chaos | overload | mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|")
+          "---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -218,6 +248,7 @@ def main() -> int:
               f"| {telemetry_value(r)} "
               f"| {recorder_value(r)} "
               f"| {debug_value(r)} "
+              f"| {chaos_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0

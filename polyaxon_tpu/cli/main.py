@@ -577,6 +577,25 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--stall-dir", default=".", type=click.Path(),
               help="With --stall-timeout: directory stall bundles "
                    "(stall_<n>_<pid>.json) are written to.")
+@click.option("--fault-plan", "fault_plan_path", default=None,
+              type=click.Path(exists=True),
+              help="CHAOS TESTING: arm the deterministic seeded "
+                   "fault-injection harness from a JSON plan "
+                   "(serving/faults.py — sites: step/page_alloc/"
+                   "slow_step/engine_death/prefix_store/"
+                   "socket_reset/telemetry).  Injected faults "
+                   "exercise the containment ladder: bounded step "
+                   "retries, quarantine bisection (the poisoned "
+                   "request alone fails 500 poisoned_request), "
+                   "supervised engine restart with requeue-and-"
+                   "resume, and the crash-storm circuit breaker. "
+                   "Unset (default): zero probes armed.")
+@click.option("--no-supervise", is_flag=True, default=False,
+              help="Disable the engine crash supervisor (an engine "
+                   "crash then fails every in-flight request "
+                   "instead of restarting with token-identical "
+                   "requeue-and-resume — the pre-crash-only "
+                   "behavior; debugging aid).")
 @click.option("--cpu", is_flag=True, default=False)
 def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
@@ -587,7 +606,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
           trace_file, profile_dir, profile_every, profile_steps,
           access_log, sanitize, sanitize_max_hold, request_history,
-          stall_timeout, stall_dir, cpu):
+          stall_timeout, stall_dir, fault_plan_path, no_supervise,
+          cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
     prefill later /generate requests skip; /trace exports the
@@ -654,6 +674,18 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         raise click.ClickException(
             "--stall-timeout requires --batching continuous (the "
             "watchdog monitors decode-step boundaries)")
+    fault_plan = None
+    if fault_plan_path is not None:
+        # Parse + validate the plan BEFORE the model build (the
+        # fail-fast contract): a typo'd fault site must not cost a
+        # checkpoint restore.
+        from polyaxon_tpu.serving import FaultPlan
+
+        try:
+            fault_plan = FaultPlan.load(fault_plan_path)
+        except (ValueError, OSError) as e:
+            raise click.ClickException(
+                f"--fault-plan {fault_plan_path}: {e}")
     for name, v in (("--queue-deadline-ms", queue_deadline_ms),
                     ("--batch-queue-deadline-ms",
                      batch_queue_deadline_ms),
@@ -747,6 +779,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          request_history=request_history,
                          stall_timeout_s=stall_timeout,
                          stall_dir=stall_dir,
+                         fault_plan=fault_plan,
+                         supervise=not no_supervise,
                          info={**({"int8_weights": True}
                                   if int8_weights else {}),
                                **({"int8_kv": True} if int8_kv else {}),

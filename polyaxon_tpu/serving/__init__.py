@@ -40,6 +40,17 @@ path moved from request coalescing to continuous batching:
   ``GET /debug/state`` snapshot board, and the stall watchdog
   (``--stall-timeout``) that dumps a diagnostic bundle when the
   engine wedges.
+- ``faults.py``    — deterministic seeded fault injection
+  (``--fault-plan``): site-keyed probes across the step dispatch,
+  page allocation, the prefix store, the engine loop, and the HTTP
+  handler — the chaos harness that proves recovery without changing
+  a surviving token.
+- ``recovery.py``  — crash-only recovery: the shared bounded
+  ``RetryPolicy``, the crash-storm ``CircuitBreaker`` (healthz 503
+  ``engine_down`` instead of hangs), and the ``EngineSupervisor``
+  that restarts a dead engine loop, rebuilds the pools without
+  recompiling, and requeues every stream for token-identical
+  resume.
 
 The public surface is unchanged: ``from polyaxon_tpu.serving import
 ModelServer, make_server``.
@@ -47,10 +58,13 @@ ModelServer, make_server``.
 
 from .debug import RequestHistory, StallWatchdog, new_request_id
 from .engine import DecodeEngine
+from .faults import FaultPlan
 from .meshed import MeshError, ServingMesh, parse_mesh
 from .paged import PagedSlotKVManager
 from .radix import RadixPrefixIndex
-from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
+from .recovery import CircuitBreaker, EngineSupervisor, RetryPolicy
+from .scheduler import (DeadlineExceeded, PRIORITIES,
+                        PoisonedRequest, QueueFullError,
                         RequestCancelled, SamplingSpec,
                         SchedulerPolicy, ShedError)
 from .server import ModelServer, make_server
@@ -63,6 +77,9 @@ __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "PagedSlotKVManager", "RadixPrefixIndex",
            "ServingMesh", "parse_mesh", "MeshError",
            "QueueFullError", "RequestCancelled", "DeadlineExceeded",
-           "ShedError", "PRIORITIES", "Telemetry", "Histogram",
+           "ShedError", "PoisonedRequest", "PRIORITIES",
+           "FaultPlan", "RetryPolicy", "CircuitBreaker",
+           "EngineSupervisor",
+           "Telemetry", "Histogram",
            "ProfileSession", "render_histogram",
            "RequestHistory", "StallWatchdog", "new_request_id"]

@@ -59,13 +59,16 @@ import numpy as np
 
 from ._lru import lru_get
 from .debug import SnapshotBoard, events_to_dicts, new_request_id
+from .faults import is_poisoned, is_transient
 from .paged import PageExhausted
+from .recovery import RetryPolicy
 from .scheduler import (AdmissionQueue, DeadlineExceeded, PRIORITIES,
-                        QueueFullError, RequestCancelled,
-                        RequestGroup, SamplingSpec, SchedulerPolicy,
-                        ShedError, Stream, terminal_status)
+                        PoisonedRequest, QueueFullError,
+                        RequestCancelled, RequestGroup, SamplingSpec,
+                        SchedulerPolicy, ShedError, Stream,
+                        terminal_status)
 from .slots import SlotKVManager
-from .telemetry import Histogram, Telemetry
+from .telemetry import ENGINE_PID, Histogram, Telemetry
 
 __all__ = ["DecodeEngine", "QueueFullError", "SPEC_ACCEPT_BUCKETS"]
 
@@ -82,7 +85,8 @@ class DecodeEngine:
                  prefill_fns=None,
                  draft_model=None, draft_variables=None,
                  telemetry: Optional[Telemetry] = None,
-                 sentinel=None, mesh=None):
+                 sentinel=None, mesh=None, faults=None,
+                 retry_policy: Optional[RetryPolicy] = None):
         # Serving mesh (serving/meshed.py): accepts a ServingMesh, a
         # spec string ("tp=4"), a dict, or a MeshSpec.  When set, the
         # slot KV pools shard over the mesh, params are PLACED onto
@@ -300,6 +304,39 @@ class DecodeEngine:
         # dicts) must not become a per-step tax nobody asked for.
         self.board_interval_s = 0.1
         self._board_t = 0.0
+        # FAULT TOLERANCE (serving/faults.py + serving/recovery.py).
+        # ``faults``: the armed FaultPlan, or None (the default) —
+        # every probe site is one attribute check when disarmed.
+        # ``retry_policy``: the bounded jittered-backoff schedule
+        # step-level TRANSIENT failures retry under (shared shape
+        # with the supervisor's restart backoff).  ``supervisor``:
+        # set by recovery.EngineSupervisor — when attached, a crash
+        # escaping the scheduling layer restarts the loop and
+        # requeues everything for token-identical resume instead of
+        # failing every caller; ``down`` latches True while the
+        # crash-storm circuit breaker holds the engine offline (new
+        # submits shed 503 ``engine_down``; /healthz reports it).
+        # ``_suspects``: groups implicated by a poisoned step
+        # dispatch, pending exoneration or conviction (the
+        # quarantine-bisection state, _quarantine_step).
+        self.faults = faults
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy()
+        self.supervisor = None
+        self.down = False
+        self._suspects: set = set()
+        # Convictions since the last SUCCESSFUL dispatch: a fault
+        # that keeps failing across quarantine convictions tracks
+        # the ENGINE, not a request — after 2 such convictions the
+        # next episode escalates to supervised recovery instead of
+        # serially convicting innocents (reset only by a dispatch
+        # that works, so a post-restart recurrence escalates
+        # immediately).
+        self._convictions_without_success = 0
+        self.step_retries_total = 0
+        self.requests_requeued_total = 0
+        self.poisoned_total = 0
+        self.telemetry_errors_total = 0
         self.debug_board.publish(self.build_debug_snapshot())
 
     def _exact(self):
@@ -384,6 +421,19 @@ class DecodeEngine:
             raise ShedError(
                 "engine is draining: finishing in-flight requests, "
                 "admitting none", reason="draining")
+        if self.down:
+            # Crash-storm circuit breaker open (recovery.py): shed
+            # fast with the machine-readable reason instead of
+            # queueing work a dead engine will never drain — the
+            # supervisor's cooldown probe flips this back off.
+            with self._shed_lock:
+                self.shed_total += 1
+                self.shed_by_class[priority] += 1
+            raise ShedError(
+                "decode engine is down (crash-restart circuit "
+                "breaker open); retry after the cooldown",
+                reason="engine_down",
+                retry_after=self.policy.retry_after_s)
         if self.paged:
             need = self._kv_tokens_needed(rows.shape[1], new)
             if need > self.slots.capacity_tokens:
@@ -447,11 +497,22 @@ class DecodeEngine:
         if shared_pages:
             # Single-row prefix hits only: the pins ride the stream
             # until admission transfers them into the slot table.
+            # The pool epoch they were pinned under rides along —
+            # if crash recovery rebuilds the pool before admission,
+            # _validate_shared_epoch drops the stale ids by
+            # reference instead of feeding them to the fresh
+            # accounting.
             group.streams[0].kv_shared = tuple(shared_pages)
+            group.streams[0].kv_epoch = getattr(
+                shared_pages, "epoch", None)
         if deadline_s is not None:
             group.deadline = group.t_submit + float(deadline_s)
             self._deadline_armed = True
         group.rid = rid if rid is not None else new_request_id()
+        if self.faults is not None:
+            # Resolve request_index-keyed poisoned fault specs to
+            # this request's concrete ID (faults.FaultPlan).
+            self.faults.on_submit(group.rid)
         group.prefix_info = prefix_info
         group.on_prefilled = on_prefilled
         group.record_timings = bool(record_timings)
@@ -616,19 +677,36 @@ class DecodeEngine:
     def _loop(self) -> None:
         while not self._stop:
             try:
+                if self.faults is not None:
+                    # Injected whole-engine death: raised HERE, past
+                    # tick's containment, so it exercises exactly the
+                    # supervised-restart path a real scheduling-layer
+                    # crash takes.
+                    self.faults.check("engine_death")
                 worked = self.tick()
             except BaseException as e:
                 # Device errors inside prefill/admit/decode already
                 # failed their own group; anything landing here is a
-                # scheduling-layer crash with no owner.  Surface it
-                # and fail everything in flight — retrying the same
-                # tick at 20 Hz would spin forever while the stuck
-                # groups' clients hang.
+                # whole-engine crash with no owner.  SUPERVISED
+                # engines (recovery.EngineSupervisor) recover: the
+                # supervisor requeues every stream for
+                # token-identical resume, rebuilds the pools, and
+                # starts a replacement loop thread — this thread
+                # just exits.  Unsupervised (library) engines keep
+                # the legacy crash-never-hang behavior: surface the
+                # error and fail everything in flight, since
+                # retrying the same tick at 20 Hz would spin forever
+                # while the stuck groups' clients hang.
+                if self.supervisor is not None \
+                        and self.supervisor.handle_crash(e):
+                    return
                 traceback.print_exc(file=sys.stderr)
                 self._fail_all(
                     RuntimeError(f"decode engine error: "
                                  f"{type(e).__name__}: {e}"))
                 worked = False
+            if worked and self.supervisor is not None:
+                self.supervisor.note_progress()
             if not worked:
                 with self._wake:
                     if self._stop:
@@ -637,6 +715,21 @@ class DecodeEngine:
         # Shutdown drain on the loop thread itself, where touching
         # _resident and the slot free-list can never race a tick.
         self._fail_all(RuntimeError("decode engine closed"))
+
+    def _restart_loop(self) -> bool:
+        """Start a REPLACEMENT loop thread after supervised crash
+        recovery (called by the supervisor ON the dying loop thread,
+        which exits right after).  Returns False when the engine was
+        closed mid-recovery — the caller fails the queue instead of
+        restarting."""
+        with self._thread_lock:
+            if self._stop:
+                return False
+            self._thread = threading.Thread(
+                target=self._loop, name="decode-engine",
+                daemon=True)
+            self._thread.start()
+            return True
 
     # -- one scheduling round -------------------------------------------
 
@@ -700,11 +793,26 @@ class DecodeEngine:
             if self.draft_model is not None else 0
         return p_len + new + slack
 
+    def _validate_shared_epoch(self, stream: Stream) -> None:
+        """Drop shared prefix pins taken under a page-pool generation
+        that crash recovery has since rebuilt: the ids mean nothing
+        in the fresh accounting (never unpin them into it), and the
+        stream's own materialized prefill makes admission without
+        the sharing token-identical — the share is an optimization.
+        Runs on the engine thread (the only thread recovery
+        alternates with), so the check-then-use is race-free."""
+        if stream.kv_shared and stream.kv_epoch is not None \
+                and stream.kv_epoch != getattr(self.slots, "epoch",
+                                               None):
+            stream.kv_shared = None
+            stream.kv_epoch = None
+
     def _admissible_now(self, stream: Stream) -> bool:
         """Pure check (no reclaim side effects — _pick_window calls
         this every boundary): a free slot AND, paged, enough free
         pages for the stream's reservation net of its shared prefix
         pages."""
+        self._validate_shared_epoch(stream)
         if self.slots.free_slots == 0:
             return False
         if not self.paged:
@@ -757,7 +865,9 @@ class DecodeEngine:
         if ids:
             stream.kv_shared = None
             try:
-                self.slots.unpin(ids)
+                # Epoch-guarded: pins from a pool generation that
+                # crash recovery rebuilt are dropped by reference.
+                self.slots.unpin(ids, epoch=stream.kv_epoch)
             except Exception:
                 import logging
 
@@ -941,31 +1051,53 @@ class DecodeEngine:
         if victim is None:
             return False        # all residents interactive: defer only
         slot, stream, _ = victim
-        del self._resident[slot]
-        self.slots.release(slot)
-        self.evicted_total += 1
         self.preempted_total += 1
         stream.preempts += 1
-        self._note_freed(stream, "preempted")
-        self._emit(stream, "decode", stream.t_admit, now,
-                   row=stream.row, slot=slot, tokens=len(stream.out),
-                   terminal="preempted")
         # The causal evidence a co-tenancy incident needs: WHO forced
         # this eviction (the preemptor's request ID) and WHY the
         # control law fired.
-        self._emit_instant(stream, "preempted", now, row=stream.row,
+        self._evict_requeue(slot, stream, "preempted", now,
+                            by=head.group.rid, reason=reason,
+                            head_waited_ms=round(1e3 * waited, 3))
+        return True
+
+    def _evict_requeue(self, slot: int, stream: Stream, why: str,
+                       now: float, *, release: bool = True,
+                       **instant_args) -> None:
+        """Evict a RESIDENT stream and requeue it at the front of its
+        class for token-identical resume — the one path every
+        requeue flavor (SLO preemption, quarantine bisection, crash
+        recovery) shares, because the safety argument is one
+        argument: resume re-prefills ``prompt ++ out[:-1]`` in pow2
+        pieces (bounded program set, steady-state quiet) and re-enters
+        feeding ``out[-1]`` with ``next_index == len(out)``, so no
+        token is ever resampled (Stream.prepare_resume).
+
+        ``release=False`` skips the slot release for crash recovery,
+        whose wholesale pool rebuild (slots.reset) makes per-slot
+        release both redundant and — paged — unsafe (the page
+        accounting it would touch is about to be reset)."""
+        del self._resident[slot]
+        if release:
+            self.slots.release(slot)
+        self.evicted_total += 1
+        self._note_freed(stream, why)
+        self._emit(stream, "decode", stream.t_admit, now,
+                   row=stream.row, slot=slot, tokens=len(stream.out),
+                   terminal=why)
+        self._emit_instant(stream, why, now, row=stream.row,
                            slot=slot, tokens=len(stream.out),
-                           by=head.group.rid, reason=reason,
-                           head_waited_ms=round(1e3 * waited, 3))
+                           **instant_args)
+        stream.slot = None
         # pow2 pieces, not chunk_plan: the resume length is
-        # data-dependent (prompt + commits at the preemption point),
+        # data-dependent (prompt + commits at the eviction point),
         # so one-piece prefill would be a fresh compile per
-        # preemption — pow2 decomposition keeps the resume program
+        # eviction — pow2 decomposition keeps the resume program
         # set bounded and steady-state quiet.
         stream.prepare_resume(SchedulerPolicy.pow2_pieces(
             stream.p_len + len(stream.out) - 1))
         self.queue.requeue_front(stream)
-        return True
+        self.requests_requeued_total += 1
 
     def mean_resident_position(self) -> float:
         """Mean absolute decode position over resident slots (0.0
@@ -984,6 +1116,71 @@ class DecodeEngine:
                 return
         raise RuntimeError("engine did not go idle within max_ticks")
 
+    # -- crash recovery (recovery.EngineSupervisor) ----------------------
+
+    def recover_from_crash(self) -> int:
+        """The engine half of supervised crash recovery — "requeue
+        everything and replay" (VirtualFlow's decoupling of request
+        state from the device holding it, arXiv:2009.09523).  Called
+        by the supervisor with NO loop thread running, so touching
+        loop-thread state is race-free by construction.  Returns the
+        number of resident streams requeued.
+
+        - Every RESIDENT stream is requeued through the preempt-
+          resume path: its committed tokens are host-side state, so
+          resumption is token-identical per seed however the engine
+          died (pinned in tests/test_faults.py).
+        - Every PARTIAL PREFILL (and stored-prefix seed) is reset to
+          re-prefill from its tokens — the partial cache referenced
+          a device state the crash made untrustworthy; chunked
+          prefill is position-keyed, so a from-scratch refill equals
+          the interrupted one.  pow2 pieces keep the replay program
+          set bounded (zero steady-state recompiles after recovery,
+          pinned).
+        - The slot/page pools rebuild IN PLACE (``slots.reset``):
+          fresh storage, SAME compiled step/insert programs.
+        - Stale shared-page pins are dropped by reference (never
+          unpinned INTO the fresh pool — its accounting starts
+          all-free); the owner's recovery hook flushes the prefix
+          store whose payloads those pins protected."""
+        now = time.perf_counter()
+        # Quarantine suspicion dies with the loop that formed it:
+        # the fault context behind a pre-crash episode is gone, and
+        # a stale suspect re-admitted alone must not be convictable
+        # without fresh bisection evidence.  (The conviction-streak
+        # counter deliberately SURVIVES recovery — a fault that
+        # recurs after restart escalates immediately instead of
+        # convicting more innocents; any successful dispatch resets
+        # it.)
+        self._suspects.clear()
+        n = 0
+        for slot, stream in sorted(list(self._resident.items())):
+            self._evict_requeue(slot, stream, "crash_requeued", now,
+                                release=False)
+            n += 1
+        for stream in self.queue.snapshot():
+            stream.kv_shared = None
+            if stream.filled or stream.cache is not None \
+                    or stream.pf_done:
+                stream.pieces = SchedulerPolicy.pow2_pieces(
+                    stream.pf_toks.shape[1])
+                stream.filled = 0
+                stream.cache = None
+                stream.d_cache = None
+                stream.logits = None
+                stream.pf_done = False
+                stream.blocked_t = None
+        with self.device_lock:
+            # Under the device lock: handler threads scatter/gather
+            # prefix pages under this same lock, and their
+            # in-device-lock epoch checks are only airtight if the
+            # rebuild (which bumps the epoch) cannot interleave.
+            # Pure host work — the hold is microseconds.
+            self.slots.reset()
+        self._last_page_free = None
+        self.last_boundary_t = time.perf_counter()
+        return n
+
     # -- telemetry ------------------------------------------------------
 
     def _emit(self, stream: Stream, name: str, t0: float, t1: float,
@@ -992,10 +1189,21 @@ class DecodeEngine:
         ring, and (when a ``timings`` block or the history ring wants
         it) onto the stream's own event list.  Every span carries the
         request ID — the correlation key ``trace_report.py
-        --request`` and the /requests records filter on."""
+        --request`` and the /requests records filter on.
+
+        CONTAINED: a telemetry failure (injected via the
+        ``telemetry`` fault site, or a real bug in the ring) is
+        counted and dropped, never propagated — observability must
+        stay strictly isolated from the request path (the
+        degradation ladder, docs/SERVING.md)."""
         if stream.group.rid is not None:
             args.setdefault("rid", stream.group.rid)
-        self.tel.span(stream.sid or 0, name, t0, t1, **args)
+        try:
+            if self.faults is not None:
+                self.faults.check("telemetry")
+            self.tel.span(stream.sid or 0, name, t0, t1, **args)
+        except Exception:
+            self.telemetry_errors_total += 1
         if stream.events is not None:
             stream.events.append((name, t0, t1, args))
 
@@ -1003,7 +1211,12 @@ class DecodeEngine:
                       **args) -> None:
         if stream.group.rid is not None:
             args.setdefault("rid", stream.group.rid)
-        self.tel.instant(stream.sid or 0, name, t, **args)
+        try:
+            if self.faults is not None:
+                self.faults.check("telemetry")
+            self.tel.instant(stream.sid or 0, name, t, **args)
+        except Exception:
+            self.telemetry_errors_total += 1
         if stream.events is not None:
             stream.events.append((name, t, t, args))
 
@@ -1261,6 +1474,12 @@ class DecodeEngine:
             kw = dict(total_tokens=self._kv_tokens_needed(
                 stream.p_len, stream.new), shared_pages=shared)
         try:
+            if self.faults is not None:
+                # Injected page-pool allocation failure: raises a
+                # PageExhausted subclass, so it rides the SAME
+                # transient-shortage requeue below that a real
+                # admission-gate race takes.
+                self.faults.check("page_alloc")
             with self.device_lock:
                 # Uniform across fresh and resumed admissions: feed
                 # the LAST committed token at its absolute position
@@ -1274,7 +1493,7 @@ class DecodeEngine:
                     temperature=spec.temperature, top_k=spec.top_k,
                     top_p=spec.top_p, draft_cache=stream.d_cache,
                     spec_k=spec.spec_k, **kw)
-        except PageExhausted:
+        except PageExhausted as pe:
             # A handler thread (prefix store) reserved pages between
             # the admission gate and this insert: a TRANSIENT
             # shortage, not a request failure — put the stream back
@@ -1283,12 +1502,30 @@ class DecodeEngine:
             # it re-prefills and admits when pages free.  The
             # fits-but-not-now contract: wait, never 500.
             self.slots.release(slot)
+            if kw.get("shared_pages") and getattr(pe, "injected",
+                                                  False):
+                # An INJECTED exhaustion fires at the probe, BEFORE
+                # insert (the pin owner on real failures) ever ran —
+                # the transferred pins must be released here or the
+                # chaos harness leaks the very pages whose
+                # accounting it exists to attest.
+                try:
+                    self.slots.unpin(kw["shared_pages"],
+                                     epoch=stream.kv_epoch)
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).debug(
+                        "injected-fault pin release failed",
+                        exc_info=True)
+                stream.kv_epoch = None
             self._emit_instant(stream, "page_requeued",
                                time.perf_counter(), row=stream.row,
                                tokens=len(stream.out))
             stream.prepare_resume(SchedulerPolicy.pow2_pieces(
                 stream.p_len + len(stream.out) - 1))
             self.queue.requeue_front(stream)
+            self.requests_requeued_total += 1
             return
         except BaseException as e:
             self.slots.release(slot)
@@ -1375,6 +1612,180 @@ class DecodeEngine:
             w *= 2
         return w
 
+    # -- step-boundary fault containment ---------------------------------
+
+    def _dispatch_step(self, dispatch):
+        """Contained step dispatch — the crash-only containment
+        ladder (docs/SERVING.md "Fault tolerance").  Returns the
+        dispatch result, or None when containment resolved the
+        failure by mutating the resident set (quarantine evictions /
+        convictions) — the caller skips this boundary's commit and
+        the next tick re-plans.
+
+        Classification of a failing dispatch:
+
+        - TRANSIENT (faults.is_transient — injected TransientFault,
+          or any error carrying ``ptpu_transient``): retried in
+          place under the shared bounded jittered-backoff
+          :class:`~polyaxon_tpu.serving.recovery.RetryPolicy`.  A
+          retry re-runs the identical dispatch — no tokens were
+          committed, and a partially-written cache is rewritten with
+          identical values (every step is a pure function of the
+          committed prefix) — so retries never change output.
+        - POISONED (faults.is_poisoned), or transient with retries
+          exhausted, or any other error with residents to protect:
+          :meth:`_quarantine_step` — bisect the resident suspects
+          until the culprit fails ALONE, requeue everyone else for
+          token-identical resume.
+
+        A containment round that cannot converge (a fault tracking
+        no single request — e.g. the device itself died) escalates
+        by raising: the loop's catch-all hands it to the supervisor
+        (restart + requeue) or, unsupervised, fails everything
+        visibly.  Either way: bounded, never a hang."""
+        attempt = 0
+        rounds = 0
+        while True:
+            rounds += 1
+            if rounds > 4 * self.slots.n_slots + 8:
+                raise RuntimeError(
+                    "step-fault containment did not converge "
+                    "(failures outlasted per-request quarantine); "
+                    "escalating to engine recovery")
+            try:
+                if self.faults is not None:
+                    # slow_step sleeps OUTSIDE the device lock so an
+                    # injected stall wedges the engine loop (what the
+                    # stall watchdog watches), not every solo caller.
+                    self.faults.check("slow_step")
+                    self.faults.check("step", rids=[
+                        s.group.rid
+                        for s in self._resident.values()])
+                out = dispatch()
+            except BaseException as e:
+                if not self._resident:
+                    raise       # nothing to contain: scheduling bug
+                if is_transient(e) and not is_poisoned(e) \
+                        and attempt < self.retry_policy.max_attempts:
+                    delay = self.retry_policy.delay_s(attempt)
+                    attempt += 1
+                    self.step_retries_total += 1
+                    try:
+                        self.tel.instant(
+                            0, "step_retry", time.perf_counter(),
+                            pid=ENGINE_PID, error=type(e).__name__,
+                            attempt=attempt,
+                            backoff_ms=round(1e3 * delay, 3))
+                    except Exception:
+                        # Same isolation contract as _emit: a broken
+                        # ring must never turn a retryable step
+                        # fault into an engine crash.
+                        self.telemetry_errors_total += 1
+                    time.sleep(delay)
+                    continue
+                self._quarantine_step(e)
+                if not self._resident:
+                    return None
+                continue
+            self._convictions_without_success = 0
+            if self._suspects:
+                # A successful dispatch exonerates every RESIDENT
+                # suspect: the deterministic fault did not fire, so
+                # the culprit is not among them.
+                for s in self._resident.values():
+                    self._suspects.discard(s.group)
+            return out
+
+    def _quarantine_step(self, err: BaseException) -> None:
+        """One quarantine-bisection round for a poisoned step
+        failure: isolate WHICH resident request keeps failing the
+        shared dispatch, fail only it, resume everyone else
+        token-identically.
+
+        The invariant the machinery rides: a poisoned failure fires
+        exactly when its culprit is resident.  So —
+
+        - no resident suspects yet: the failing dispatch implicates
+          every resident (fresh episode — mark them all);
+        - ONE suspect, and it is the SOLE resident: it just failed
+          ALONE — CONVICTED.  It fails with the typed
+          :class:`~.scheduler.PoisonedRequest` (500 +
+          ``reason: poisoned_request``), and every other suspect is
+          exonerated;
+        - one suspect among UNMARKED residents (a suspect carried
+          over from an earlier episode, sharing the dispatch with
+          requests admitted since): the failure implicates everyone
+          present — a lone stale suspect must NOT be convicted on
+          another request's fault, so every resident is (re)marked
+          and bisection continues on fresh evidence;
+        - several resident suspects: BISECT — evict half to the
+          requeue path (token-identical resume) and let the caller
+          re-dispatch with the rest resident.
+
+        A culprit that escapes a bisection round (its half was
+        evicted, so the re-dispatch succeeded) stays marked across
+        episodes; once bisection leaves it the sole RESIDENT of a
+        failing dispatch, it is convicted.  Convergence is bounded
+        by the resident count per round (_dispatch_step's round
+        guard — and the conviction-streak escalation — handle the
+        pathological fault that tracks no request at all)."""
+        now = time.perf_counter()
+        # Suspects whose group already reached a terminal state
+        # (cancelled, expired, completed pre-conviction) leave the
+        # pool lazily — the set must stay bounded by live requests.
+        for g in [g for g in self._suspects if g.event.is_set()]:
+            self._suspects.discard(g)
+        suspects = [(slot, s)
+                    for slot, s in sorted(self._resident.items())
+                    if s.group in self._suspects]
+        if not suspects or (len(suspects) == 1
+                            and len(self._resident) > 1):
+            for s in self._resident.values():
+                self._suspects.add(s.group)
+            suspects = sorted(self._resident.items())
+        if len(suspects) == 1:
+            if self._convictions_without_success >= 2:
+                # Two convictions with not one working dispatch
+                # between them: the failure is not request-tied —
+                # convicting a third resident would just 500 another
+                # innocent.  Escalate: the raise propagates to the
+                # loop's catch-all, where the supervisor restarts
+                # the engine (and, if the fault persists, the crash
+                # storm trips the breaker into fail-fast shedding).
+                raise RuntimeError(
+                    "step failures persist across quarantine "
+                    "convictions (no successful dispatch between "
+                    "episodes) — the fault tracks the engine, not "
+                    "a request; escalating to engine recovery"
+                ) from err
+            slot, stream = suspects[0]
+            self._convict(slot, stream, err, now)
+            # Culprit found: every other suspect (requeued during
+            # bisection) is exonerated.
+            self._suspects.clear()
+            return
+        for slot, stream in suspects[: len(suspects) // 2]:
+            self._evict_requeue(slot, stream, "quarantined", now,
+                                error=type(err).__name__)
+
+    def _convict(self, slot: int, stream: Stream,
+                 err: BaseException, now: float) -> None:
+        """Fail the isolated culprit — and ONLY it — with the typed
+        PoisonedRequest; its co-tenants keep decoding."""
+        group = stream.group
+        self.poisoned_total += 1
+        self._convictions_without_success += 1
+        self._note_freed(stream, "poisoned")
+        self._emit(stream, "decode", stream.t_admit, now,
+                   row=stream.row, slot=slot, tokens=len(stream.out),
+                   terminal="poisoned")
+        self._emit_instant(stream, "poisoned", now, row=stream.row,
+                           slot=slot, error=type(err).__name__)
+        self._fail_group(group, PoisonedRequest(
+            f"request {group.rid} poisoned the shared decode step "
+            f"and was quarantined (co-tenants resumed unaffected): "
+            f"{type(err).__name__}: {err}"))
+
     def _decode_step(self) -> None:
         """Advance every resident stream by one fused window of decode
         steps; evict finished streams so their slots are admissible
@@ -1401,12 +1812,18 @@ class DecodeEngine:
         if self.recorder is not None:
             self.recorder.on_step_start()
         t0 = time.perf_counter()
-        try:
+
+        def dispatch():
             with self.device_lock:
-                toks_w = self.slots.step(window, sampled)  # [W, S]
-        except BaseException as e:
-            for slot, stream in list(self._resident.items()):
-                self._fail_group(stream.group, e)
+                return self.slots.step(window, sampled)  # [W, S]
+
+        toks_w = self._dispatch_step(dispatch)
+        if toks_w is None:
+            # Containment resolved the boundary by mutating the
+            # resident set (quarantine evictions / a conviction)
+            # instead of producing tokens — the next tick re-plans.
+            if self.recorder is not None:
+                self.recorder.on_step_end(0)
             return
         t1 = time.perf_counter()
         self.decode_steps_total += window
@@ -1453,14 +1870,20 @@ class DecodeEngine:
         if self.recorder is not None:
             self.recorder.on_step_start()
         t0 = time.perf_counter()
-        try:
+
+        def dispatch():
             with self.device_lock:
-                toks, commits, accepts = self.slots.step_spec(window,
-                                                              K)
-        except BaseException as e:
-            for slot, stream in list(self._resident.items()):
-                self._fail_group(stream.group, e)
+                return self.slots.step_spec(window, K)
+
+        out = self._dispatch_step(dispatch)
+        if out is None:
+            # Containment mutated the resident set instead of
+            # producing tokens — the next tick re-plans (see the
+            # plain step).
+            if self.recorder is not None:
+                self.recorder.on_step_end(0)
             return
+        toks, commits, accepts = out
         t1 = time.perf_counter()
         self.decode_steps_total += window
         self.spec_rounds_total += window
@@ -1693,6 +2116,20 @@ class DecodeEngine:
                                  self.slots.slot_page_counts()}
         if self.mesh is not None:
             snap["mesh"] = self.mesh.axes_str()
+        # Fault-tolerance state: the supervisor block (restart
+        # count, breaker state, last crash/recovery evidence) and
+        # the armed fault plan's injection counters ride every
+        # snapshot — so a recovery storm is diagnosable from ONE
+        # artifact (/debug/state, or the stall watchdog's bundle,
+        # which embeds a forced build of this same snapshot).
+        snap["engine_down"] = self.down
+        if self.supervisor is not None:
+            snap["supervisor"] = self.supervisor.status()
+        if self.faults is not None:
+            snap["faults"] = self.faults.stats()
+        if self._suspects:
+            snap["quarantine_suspects"] = sorted(
+                g.rid for g in self._suspects if g.rid)
         return snap
 
     def stats(self) -> Dict[str, Any]:
@@ -1700,6 +2137,8 @@ class DecodeEngine:
         # (_note_breakdown, fed from group.breakdown()) — one source
         # of truth for /metrics; the engine exposes scheduling
         # counters only.
+        fstats = self.faults.stats() if self.faults is not None \
+            else None       # one lock-guarded build per scrape
         return {
             "slots": self.slots.n_slots,
             "slots_active": self.slots.active_slots,
@@ -1740,6 +2179,32 @@ class DecodeEngine:
                 self.queue.class_len("interactive"),
             "queue_len_batch": self.queue.class_len("batch"),
             "draining": self.draining,
+            # Fault tolerance (serving/faults.py + recovery.py):
+            # step-retry / requeue-and-resume / quarantine-conviction
+            # counters, the supervisor's crash/restart totals and
+            # breaker state, and the armed fault plan's per-site
+            # injection counters — ONE dict behind /metrics AND
+            # /info (the no-drift pin, tests/test_faults.py).
+            "engine_down": self.down,
+            "step_retries_total": self.step_retries_total,
+            "requests_requeued_total": self.requests_requeued_total,
+            "poisoned_total": self.poisoned_total,
+            "telemetry_errors_total": self.telemetry_errors_total,
+            "engine_crashes_total":
+                self.supervisor.crashes_total
+                if self.supervisor is not None else 0,
+            "engine_restarts_total":
+                self.supervisor.restarts_total
+                if self.supervisor is not None else 0,
+            "breaker_state":
+                self.supervisor.breaker.state
+                if self.supervisor is not None else "unsupervised",
+            "faults_injected_total":
+                fstats["faults_injected_total"]
+                if fstats is not None else 0,
+            "faults_injected":
+                fstats["faults_injected"]
+                if fstats is not None else {},
             # Speculative scheduling + the per-request acceptance-rate
             # histogram (per-bucket counts, upper bounds in
             # spec_accept_buckets; /metrics cumulates them via

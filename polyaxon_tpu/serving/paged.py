@@ -70,8 +70,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .slots import (build_spec_step_body, build_step_body,
-                    step_annotation)
+from .slots import (alloc_decode_state, build_spec_step_body,
+                    build_step_body, step_annotation)
 
 __all__ = ["PagedSlotKVManager", "PageExhausted"]
 
@@ -172,6 +172,13 @@ class PagedSlotKVManager:
             self.refcounts = np.zeros((self.total_pages,), np.int64)
             self.refcounts[self.n_pages:] = 1  # scratch/trash pinned
             self._free_pages: List[int] = list(range(self.n_pages))
+            # Pool GENERATION: bumped by the crash-recovery reset().
+            # Page ids are only meaningful within one epoch — pin()
+            # returns the epoch the pins were taken under, and
+            # epoch-tagged unpins/shares from a dead generation are
+            # dropped by reference instead of corrupting the fresh
+            # accounting.
+            self.epoch = 0
 
         # -- slot state (engine thread only) ---------------------------
         self._free = list(range(self.n_slots))
@@ -194,15 +201,9 @@ class PagedSlotKVManager:
         self._insert_fns: Dict[Tuple, Any] = {}
         self._gather_fns: Dict[int, Any] = {}
 
-        # -- per-slot decode state (identical to SlotKVManager) --------
-        self.tokens = np.zeros((self.n_slots,), np.int32)
-        self.positions = np.zeros((self.n_slots,), np.int32)
-        self.keys = np.zeros((self.n_slots, 2), np.uint32)
-        self.next_index = np.zeros((self.n_slots,), np.int32)
-        self.temps = np.zeros((self.n_slots,), np.float32)
-        self.top_ks = np.zeros((self.n_slots,), np.int32)
-        self.top_ps = np.zeros((self.n_slots,), np.float32)
-        self.spec_ks = np.zeros((self.n_slots,), np.int32)
+        # -- per-slot decode state (identical to SlotKVManager;
+        # shared helper, also called by crash-recovery reset()) -----
+        alloc_decode_state(self)
         self.last_step_device_s = 0.0
 
     # -- page accounting ------------------------------------------------
@@ -226,10 +227,14 @@ class PagedSlotKVManager:
         with self._page_lock:
             return len(self._free_pages) >= need
 
-    def pin(self, ids: Sequence[int]) -> None:
+    def pin(self, ids: Sequence[int]) -> int:
         """Take one reference on each page (prefix-cache lookups pin
         an entry's pages so eviction/reuse can't free them while a
-        request maps or materializes them)."""
+        request maps or materializes them).  Returns the pool EPOCH
+        the pins were taken under — callers that hold pins across
+        their own lock scope (the prefix-hit handler path) carry it
+        so a crash-recovery pool rebuild in between invalidates the
+        pins instead of corrupting the fresh refcounts."""
         with self._page_lock:
             for i in ids:
                 if self.refcounts[i] < 1:
@@ -237,11 +242,18 @@ class PagedSlotKVManager:
                         f"pin of a free page {i} (stale page id — "
                         f"the entry holding it was already freed)")
                 self.refcounts[i] += 1
+            return self.epoch
 
-    def unpin(self, ids: Sequence[int]) -> None:
+    def unpin(self, ids: Sequence[int],
+              epoch: Optional[int] = None) -> None:
         """Drop one reference per page; pages hitting zero return to
-        the free list."""
+        the free list.  ``epoch`` (when the caller carried one from
+        ``pin``) guards the crash-recovery race: pins from a dead
+        pool generation are dropped BY REFERENCE — the ids mean
+        nothing in the rebuilt accounting."""
         with self._page_lock:
+            if epoch is not None and epoch != self.epoch:
+                return
             for i in ids:
                 if self.refcounts[i] < 1:
                     raise ValueError(f"unpin of a free page {i}")
@@ -252,15 +264,24 @@ class PagedSlotKVManager:
     def try_reserve(self, n: int) -> Optional[List[int]]:
         """Pop ``n`` free pages (refcount 0 -> 1), or None if fewer
         are free."""
-        if n <= 0:
-            return []
+        return self.reserve_with_epoch(n)[0]
+
+    def reserve_with_epoch(self, n: int
+                           ) -> Tuple[Optional[List[int]], int]:
+        """``try_reserve`` plus the pool epoch the reservation was
+        made under, read atomically in one lock hold — for callers
+        (the prefix store) that carry the ids across their own lock
+        scopes and must recognize a crash-recovery pool rebuild in
+        between."""
         with self._page_lock:
+            if n <= 0:
+                return [], self.epoch
             if len(self._free_pages) < n:
-                return None
+                return None, self.epoch
             ids = [self._free_pages.pop() for _ in range(n)]
             for i in ids:
                 self.refcounts[i] = 1
-            return ids
+            return ids, self.epoch
 
     def page_stats(self) -> Dict[str, int]:
         with self._page_lock:
@@ -299,6 +320,31 @@ class PagedSlotKVManager:
 
     def acquire(self) -> Optional[int]:
         return self._free.pop(0) if self._free else None
+
+    def reset(self) -> None:
+        """Crash-recovery pool rebuild (recovery.EngineSupervisor):
+        every page reference — resident tables, prefix-store pins,
+        shared refcounts — is dropped WHOLESALE and the page pool
+        returns to all-free, while the compiled step/insert/gather
+        programs are KEPT (a supervised restart must add zero
+        steady-state recompiles).  Callers own the invalidation
+        story: stale page ids must never be unpinned into the fresh
+        accounting (the engine clears stream pins by reference; the
+        server's recovery hook flushes the prefix store whose
+        payloads these pages backed)."""
+        with self._page_lock:
+            self.refcounts[:] = 0
+            self.refcounts[self.n_pages:] = 1  # scratch/trash pinned
+            self._free_pages = list(range(self.n_pages))
+            self.epoch += 1     # prior-generation page ids are dead
+        self._free = list(range(self.n_slots))
+        for s in range(self.n_slots):
+            self.page_tables[s, :] = self.scratch0 + s
+        self._slot_pages = [None] * self.n_slots
+        self._slot_need[:] = 0
+        self._pool = None
+        self._draft_pool = None
+        alloc_decode_state(self)
 
     def release(self, slot: int) -> None:
         """Evict: park the slot (same contract as the fixed-lane

@@ -155,6 +155,19 @@ class ShedError(RuntimeError):
         self.retry_after = retry_after
 
 
+class PoisonedRequest(RuntimeError):
+    """Terminal status ``poisoned``: the fault-containment layer
+    isolated THIS request as the one whose computation keeps failing
+    the shared decode step (quarantine bisection,
+    engine._quarantine_step) and failed it alone — its co-tenants
+    were requeued and resumed token-identically.  Maps to 500 with
+    the machine-readable ``reason: poisoned_request`` so clients can
+    tell "my request breaks the model" apart from "the server is
+    broken" (which sheds 503 ``engine_down`` instead)."""
+
+    reason = "poisoned_request"
+
+
 def terminal_status(err: Optional[BaseException]) -> str:
     """Map a terminal error to the request's lifecycle status name
     (the ``status`` field on RequestGroup, span names, counters)."""
@@ -166,6 +179,8 @@ def terminal_status(err: Optional[BaseException]) -> str:
         return "expired"
     if isinstance(err, RequestCancelled):
         return "cancelled"
+    if isinstance(err, PoisonedRequest):
+        return "poisoned"
     return "failed"
 
 
@@ -333,8 +348,8 @@ class Stream:
                  "out", "slot", "pf_done", "t_prefill_start",
                  "t_admit", "t_done", "d_cache", "spec_rounds",
                  "spec_drafted", "spec_accepted", "sid", "events",
-                 "pf_toks", "resume", "kv_shared", "last_slot",
-                 "preempts", "resumes", "blocked_t")
+                 "pf_toks", "resume", "kv_shared", "kv_epoch",
+                 "last_slot", "preempts", "resumes", "blocked_t")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -385,6 +400,10 @@ class Stream:
         # pre-admission terminal path unpins them
         # (engine._release_stream_kv).
         self.kv_shared: Optional[tuple] = None
+        # Pool epoch the shared pins were taken under (paged prefix
+        # hits; engine._validate_shared_epoch drops pins from a pool
+        # generation that crash recovery has since rebuilt).
+        self.kv_epoch: Optional[int] = None
         # Debuggability (serving/debug.py): the last slot this stream
         # occupied (``slot`` clears at eviction; the access log and
         # the history record want the id after the fact), preempt/

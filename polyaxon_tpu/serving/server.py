@@ -88,9 +88,12 @@ from ._lru import lru_get
 from .debug import (RequestHistory, StallWatchdog, events_to_dicts,
                     new_request_id, sanitize_request_id)
 from .engine import DecodeEngine
+from .faults import FaultPlan, SocketReset
 from .legacy import RequestCoalescer
 from .radix import RadixPrefixIndex
-from .scheduler import (DeadlineExceeded, PRIORITIES, QueueFullError,
+from .recovery import EngineSupervisor
+from .scheduler import (DeadlineExceeded, PRIORITIES,
+                        PoisonedRequest, QueueFullError,
                         RequestCancelled, SamplingSpec,
                         SchedulerPolicy, ShedError)
 from .telemetry import (ProfileSession, Telemetry,
@@ -124,6 +127,23 @@ holding them (materialized from pool pages in paged mode), and
 into the admitted slot's table (empty for legacy entries).  The
 caller owns the pins until ``engine.submit(shared_pages=pins)``
 returns; every other outcome must unpin them."""
+
+
+class PagePins(tuple):
+    """Pinned page ids + the pool EPOCH they were pinned under
+    (``PagedSlotKVManager.pin`` returns it).  Pins cross thread and
+    lock scopes between the lookup and the engine's admission; a
+    crash-recovery pool rebuild in between bumps the epoch, which is
+    how every consumer (submit, admission, unpin) recognizes the ids
+    as dead and drops them BY REFERENCE instead of corrupting the
+    fresh refcount accounting."""
+
+    epoch: Optional[int] = None
+
+    def __new__(cls, ids, epoch):
+        self = super().__new__(cls, ids)
+        self.epoch = epoch
+        return self
 
 
 # The response ``timings`` block and the history record's timeline
@@ -208,9 +228,19 @@ class ModelServer:
                  stall_timeout_s: Optional[float] = None,
                  stall_dir: str = ".",
                  stall_queue_factor: float = 4.0,
+                 fault_plan=None,
+                 supervise: bool = True,
                  info: Optional[Dict[str, Any]] = None):
         self.model = model
         self.variables = variables
+        # FAULT INJECTION (serving/faults.py), disarmed by default:
+        # ``fault_plan`` (a FaultPlan, a plan dict, or a JSON path —
+        # `ptpu serve --fault-plan f.json`) arms the deterministic
+        # seeded chaos harness across the engine's step/admission
+        # sites, the prefix store, and the HTTP handler.  Disarmed,
+        # every probe site is one attribute check.
+        self.faults = FaultPlan.load(fault_plan) \
+            if fault_plan is not None else None
         # Telemetry core (telemetry.py): ONE ring + histogram set
         # shared with the engine, so request spans and engine step
         # records land in the same /trace timeline.  trace_buffer=0
@@ -385,7 +415,8 @@ class ModelServer:
                 draft_variables=draft_variables,
                 telemetry=self.telemetry,
                 sentinel=self.recompile,
-                mesh=self.mesh)
+                mesh=self.mesh,
+                faults=self.faults)
         self._coalescer = RequestCoalescer(self) \
             if self.batching == "coalesce" else None
         self.coalesced_batches = 0
@@ -432,6 +463,11 @@ class ModelServer:
             self._prefix_enabled = self.prefix_cache_size > 0
         else:
             self._prefix_enabled = False  # seq2seq: encoder != prefix
+        # The CONFIGURED state, captured once: the degradation
+        # ladder may flip _prefix_enabled off at runtime, and engine
+        # recovery restores it to exactly this — never beyond what
+        # construction decided.
+        self._prefix_configured = self._prefix_enabled
         self._prefix = RadixPrefixIndex(max(1, self.prefix_cache_size))
         self._prefix_lock = threading.Lock() \
             if self.sanitizer is None \
@@ -443,6 +479,12 @@ class ModelServer:
         self.prefix_hit_tokens = 0
         self._prefix_store_skips = 0   # paged stores dropped for
         #                                pool pressure (logged once)
+        # Degradation ladder (docs/SERVING.md "Fault tolerance"): a
+        # prefix-store ERROR (real, or the ``prefix_store`` fault
+        # site) disables the store with a counter instead of failing
+        # the request — the cache is an optimization, and a broken
+        # optimization must cost hit-rate, never availability.
+        self._prefix_store_errors = 0
         self.kv_paged = bool(self.engine is not None
                              and self.engine.paged)
         if self.kv_paged:
@@ -526,6 +568,20 @@ class ModelServer:
                 queue_factor=stall_queue_factor,
                 extra_state=self._watchdog_extra_state)
             self.watchdog.start()
+        # ENGINE SUPERVISOR (serving/recovery.py), ON by default for
+        # engine-backed servers: an exception escaping the engine's
+        # scheduling layer no longer fails every in-flight request —
+        # the supervisor requeues everything for token-identical
+        # resume, rebuilds the pools (zero recompiles), and restarts
+        # the loop with bounded backoff; a crash STORM trips the
+        # circuit breaker instead (healthz 503 ``engine_down``, new
+        # submits shed — fail fast, never hang).  ``supervise=False``
+        # keeps the legacy fail-everything crash behavior.
+        self.supervisor = None
+        if self.engine is not None and supervise:
+            self.supervisor = EngineSupervisor(self.engine)
+            self.supervisor.add_recovery_hook(
+                self._on_engine_recovery)
 
     def close(self) -> None:
         """Stop the engine loop thread (idempotent) and end any
@@ -610,6 +666,11 @@ class ModelServer:
             "requests": self.requests,
             "errors": self.errors,
             "history": self.history.stats(),
+            # Degradation-ladder state: a stall bundle from a
+            # recovery storm should show whether the prefix store
+            # disabled itself along the way.
+            "prefix_enabled": self._prefix_enabled,
+            "prefix_store_errors": self._prefix_store_errors,
             **({"sanitizer": self.sanitizer.stats()}
                if self.sanitizer is not None else {}),
         }
@@ -897,6 +958,78 @@ class ModelServer:
                        sentinel=self.recompile,
                        kind=f"server:{kind}")
 
+    # -- fault tolerance: prefix-store degradation + engine recovery ----
+
+    def _note_prefix_error(self, where: str) -> None:
+        """One prefix-store failure: count it and DISABLE the store
+        (lookups miss, stores skip) — requests keep flowing without
+        prefix reuse instead of 500ing on a broken cache.  The
+        counter + the disabled flag surface in /info and /metrics so
+        the degradation is an alert, not a mystery slowdown."""
+        with self._stats_lock:
+            self._prefix_store_errors += 1
+            first = self._prefix_enabled
+            self._prefix_enabled = False
+        if first:
+            print(f"# serving: prefix store DISABLED after an error "
+                  f"in {where} — requests continue without prefix "
+                  f"reuse (degradation ladder; counted in /info "
+                  f"prefix_store_errors)", file=sys.stderr)
+
+    def _prefix_lookup_safe(self, toks: np.ndarray
+                            ) -> Optional[PrefixHit]:
+        """Contained prefix lookup: an error (injected via the
+        ``prefix_store`` fault site, or real — a corrupt trie, a
+        failed page materialization) degrades to a MISS and disables
+        the store; the request pays full prefill and succeeds."""
+        if not self._prefix_enabled:
+            return None
+        try:
+            if self.faults is not None:
+                self.faults.check("prefix_store")
+            return self._prefix_lookup(toks)
+        except Exception:
+            self._note_prefix_error("lookup")
+            return None
+
+    def _prefix_store_safe(self, toks, logits, cache, *,
+                           hot: bool = True) -> None:
+        """Contained prefix store: same degradation contract as the
+        lookup — a failing store must never fail the request whose
+        prefill it was opportunistically caching."""
+        if not self._prefix_enabled:
+            return
+        try:
+            if self.faults is not None:
+                self.faults.check("prefix_store")
+            self._prefix_store(toks, logits, cache, hot=hot)
+        except Exception:
+            self._note_prefix_error("store")
+
+    def _on_engine_recovery(self) -> None:
+        """EngineSupervisor recovery hook, run after the slot/page
+        pool rebuild and before the loop restart.  PAGED prefix
+        entries hold page ids into the pool that was just reset —
+        their payloads are gone, so the whole index is flushed BY
+        REFERENCE (no unpins: the fresh pool's accounting starts
+        all-free, and unpinning stale ids into it would corrupt the
+        new refcounts).  Legacy contiguous entries survive crashes
+        (they own independent caches), so engine-less storage is
+        kept."""
+        if not self.kv_paged:
+            return
+        with self._prefix_lock:
+            self._prefix = RadixPrefixIndex(
+                max(1, self.prefix_cache_size))
+        # A store error during the crash window (e.g. a pin racing
+        # the pool reset) may have tripped the degradation ladder;
+        # the flush just removed whatever was broken, so a
+        # config-enabled store comes back up.  (Counted errors stay
+        # counted — the episode remains visible in /info.)
+        if self._prefix_configured:
+            with self._stats_lock:
+                self._prefix_enabled = True
+
     def _prefix_lookup(self, toks: np.ndarray
                        ) -> Optional[PrefixHit]:
         """Longest stored entry whose prompt is a prefix of ``toks``
@@ -919,30 +1052,54 @@ class ModelServer:
             # Pin while still under the prefix lock: a concurrent
             # eviction between lookup and pin could free the pages.
             # (Lock order everywhere: _prefix_lock > _page_lock.)
-            self.engine.slots.pin(payload.pages)
+            # The returned pool epoch rides the pins to the engine:
+            # a crash-recovery rebuild between here and admission
+            # invalidates them instead of corrupting fresh counts.
+            pin_epoch = self.engine.slots.pin(payload.pages)
         try:
             with self._lock:
+                if self.engine.slots.epoch != pin_epoch:
+                    # Pool rebuilt since the pin (recovery holds
+                    # this same lock for the rebuild, so the check
+                    # cannot race it): the ids are dead — a miss.
+                    return None
                 cache = self.engine.slots.materialize(payload.pages,
                                                       pc)
         except BaseException:
+            if self.engine.slots.epoch != pin_epoch:
+                # Crash recovery rebuilt the pool mid-materialize:
+                # the failure is the rebuild's, not the store's — a
+                # MISS, not an error (counting it would disable the
+                # store the recovery hook just flushed clean).  The
+                # pins died with the old generation (by reference).
+                return None
             # A failed materialization (compile error, device OOM)
             # must not leak the pins — repeated failing hits would
             # otherwise walk the free pool down to permanent
             # kv_pages sheds.
-            self.engine.slots.unpin(payload.pages)
+            self.engine.slots.unpin(payload.pages, epoch=pin_epoch)
             raise
+        if self.engine.slots.epoch != pin_epoch:
+            # Pool rebuilt while we gathered: the gather itself read
+            # pre-rebuild content (live device buffers), but the
+            # page ids now name OTHER requests' KV in the fresh
+            # accounting — drop the hit (miss; pins die by
+            # reference) rather than share poisoned pages.
+            return None
         # Keep pins only on the FULL pages (the shareable ones — the
         # partial tail page's content rides the materialized cache
         # and is rewritten privately by the admitted slot).
         n_full = pc // self.engine.slots.page_tokens
-        pins = payload.pages[:n_full]
+        pins = PagePins(payload.pages[:n_full], pin_epoch)
         if payload.pages[n_full:]:
-            self.engine.slots.unpin(payload.pages[n_full:])
+            self.engine.slots.unpin(payload.pages[n_full:],
+                                    epoch=pin_epoch)
         return PrefixHit(pc, payload.logits, cache, pins)
 
     def _unpin_prefix(self, pins) -> None:
         if pins:
-            self.engine.slots.unpin(pins)
+            self.engine.slots.unpin(
+                pins, epoch=getattr(pins, "epoch", None))
 
     def _free_displaced(self, displaced) -> None:
         """Release payloads the radix index displaced (overwrites and
@@ -992,6 +1149,7 @@ class ModelServer:
         paged = self.kv_paged and toks.shape[0] == 1
         mgr = self.engine.slots if self.engine is not None else None
         shared = ()
+        pin_epoch = None
         with self._prefix_lock:
             anc = self._prefix.longest_ancestor(toks)
             if anc is not None and anc[0].shape[1] >= p_len:
@@ -1003,7 +1161,9 @@ class ModelServer:
                 n_share = min(anc[0].shape[1] // mgr.page_tokens,
                               mgr.pages_needed(p_len))
                 shared = tuple(anc[1].pages[:n_share])
-                mgr.pin(shared)
+                pin_epoch = mgr.pin(shared)
+        if paged and pin_epoch is None:
+            pin_epoch = mgr.epoch    # no ancestor pins: current gen
         if not paged:
             with self._prefix_lock:
                 displaced = self._prefix.store(toks, (logits, cache),
@@ -1011,19 +1171,26 @@ class ModelServer:
             self._free_displaced(displaced)
             return
         n_pages = mgr.pages_needed(p_len)
-        fresh = None
+        fresh, reserve_epoch = None, pin_epoch
         for _ in range(8):      # bounded: a reserve/consume race
             #                     must not spin this store forever
-            fresh = mgr.try_reserve(n_pages - len(shared))
+            fresh, reserve_epoch = mgr.reserve_with_epoch(
+                n_pages - len(shared))
             if fresh is not None:
                 break
             if not self._reclaim_prefix_pages(n_pages - len(shared)):
                 break
-        if fresh is None:
-            # Pool too tight to store (live traffic owns the pages):
-            # skip quietly — the prefix cache is an optimization,
-            # never back-pressure.
-            mgr.unpin(shared)
+        if fresh is None or reserve_epoch != pin_epoch:
+            # Pool too tight to store (live traffic owns the pages)
+            # — or rebuilt by crash recovery since the ancestor pins
+            # were taken (mixed-generation ids must never enter the
+            # index): skip quietly; the prefix cache is an
+            # optimization, never back-pressure.  Epoch-guarded
+            # unpins release only ids still current; dead-generation
+            # ids drop by reference.
+            mgr.unpin(shared, epoch=pin_epoch)
+            if fresh:
+                mgr.unpin(fresh, epoch=reserve_epoch)
             with self._stats_lock:
                 self._prefix_store_skips += 1
                 first = self._prefix_store_skips == 1
@@ -1035,13 +1202,29 @@ class ModelServer:
         ids = list(shared) + fresh
         try:
             with self._lock:
+                # Epoch re-check INSIDE the device lock: crash
+                # recovery rebuilds the pool UNDER this lock, so a
+                # dead-generation scatter (which would overwrite
+                # pages the fresh pool already handed to residents)
+                # cannot interleave — it either sees the bump here
+                # and drops by reference, or completes before the
+                # rebuild (whose recovery flush then wipes the
+                # entry).
+                if mgr.epoch != pin_epoch:
+                    return
                 mgr.scatter_cache(cache, ids,
                                   n_shared=len(shared))
         except BaseException:
-            mgr.unpin(ids)
+            mgr.unpin(shared, epoch=pin_epoch)
+            mgr.unpin(fresh, epoch=reserve_epoch)
             raise
         payload = _PagedPrefix(ids, p_len, logits)
         with self._prefix_lock:
+            if mgr.epoch != pin_epoch:
+                # Rebuilt after the scatter: the ids are dead and
+                # the recovery flush owns the index — drop the
+                # entry by reference.
+                return
             displaced = self._prefix.store(toks, payload, hot=hot)
         self._free_displaced(displaced)
 
@@ -1053,8 +1236,9 @@ class ModelServer:
         stream's cache is handed to the slot pool (arrays are
         immutable, so the stored entry and the slot copy never
         alias mutably)."""
-        self._prefix_store(np.asarray(stream.toks), stream.logits,
-                           stream.cache, hot=False)
+        self._prefix_store_safe(np.asarray(stream.toks),
+                                stream.logits, stream.cache,
+                                hot=False)
 
     def prefill_prompt(self, req: Dict[str, Any]) -> Dict[str, Any]:
         """POST /prefill: register a prompt (prefix) in the prefix
@@ -1064,7 +1248,10 @@ class ModelServer:
         if not self._prefix_enabled:
             raise ValueError(
                 "prefix cache is disabled on this server "
-                "(start with --prefix-cache N)")
+                "(start with --prefix-cache N)"
+                + (" — it disabled itself after a store error; see "
+                   "/info prefix_store_errors"
+                   if self._prefix_store_errors else ""))
         import jax
 
         rows = _parse_prompt_rows(req, self.max_batch)
@@ -1096,7 +1283,7 @@ class ModelServer:
             jax.block_until_ready(logits)
         # Outside the device lock: the paged store re-acquires it for
         # its page scatter (locks never nest device -> prefix).
-        self._prefix_store(toks, logits, cache)
+        self._prefix_store_safe(toks, logits, cache)
         with self._stats_lock:
             self.requests += 1
             self._lat_sum += time.perf_counter() - t0
@@ -1165,7 +1352,7 @@ class ModelServer:
             # Outside the device lock: the paged store re-acquires
             # it.  Cold insertion: one speculative store-back per
             # request must never flush a registered system prompt.
-            self._prefix_store(toks, *store_back, hot=False)
+            self._prefix_store_safe(toks, *store_back, hot=False)
         with self._stats_lock:
             self.requests += 1
             self.prefix_hits += 1
@@ -1336,7 +1523,7 @@ class ModelServer:
         # back the cache, so they stay cold.
         prefix_hit = None
         if self._prefix_enabled and beams == 1 and not speculative:
-            prefix_hit = self._prefix_lookup(toks)
+            prefix_hit = self._prefix_lookup_safe(toks)
         # Engine eligibility: any non-beam request on a decoder-only
         # model — greedy, sampled, AND speculative (the engine owns
         # the draft model whenever the server does).  temperature==0
@@ -1730,6 +1917,18 @@ class ModelServer:
                 "prefix_hits": self.prefix_hits,
                 "prefix_hit_tokens": self.prefix_hit_tokens,
                 "prefix_store_skips": self._prefix_store_skips,
+                # Degradation ladder: error count + the live enabled
+                # flag (False after a store error OR prefix_cache=0).
+                "prefix_store_errors": self._prefix_store_errors,
+                "prefix_enabled": self._prefix_enabled,
+                # Fault tolerance: the supervisor's full status block
+                # and the armed fault plan's counters (the engine
+                # passthrough below carries the flat counter keys —
+                # same engine.stats() dict /metrics renders).
+                **({"supervisor": self.supervisor.status()}
+                   if self.supervisor is not None else {}),
+                **({"fault_plan": self.faults.stats()}
+                   if self.faults is not None else {}),
                 "kv_paged": self.kv_paged,
                 **{k: engine[k] for k in
                    ("slots", "slots_active", "slot_occupancy",
@@ -1749,6 +1948,12 @@ class ModelServer:
                     "admitted_batch_total",
                     "queue_len_interactive", "queue_len_batch",
                     "draining",
+                    "engine_down", "step_retries_total",
+                    "requests_requeued_total", "poisoned_total",
+                    "telemetry_errors_total",
+                    "engine_crashes_total", "engine_restarts_total",
+                    "breaker_state", "faults_injected_total",
+                    "faults_injected",
                     "shed_kv_pages_total",
                     "kv_pages", "kv_page_tokens", "kv_pages_free",
                     "kv_pages_resident", "kv_pages_shared",
@@ -1919,6 +2124,45 @@ class ModelServer:
                 f"{es['preempted_total']}",
                 "# TYPE ptpu_serving_resumed_total counter",
                 f"ptpu_serving_resumed_total {es['resumed_total']}",
+                # Fault tolerance (serving/faults.py + recovery.py):
+                # step retries, requeue-and-resume events, quarantine
+                # convictions, supervised crash/restart totals, the
+                # breaker gauge, and the per-site injected-fault
+                # split — all from the same engine.stats() dict
+                # /info reports (no-drift pin, tests/test_faults.py).
+                "# TYPE ptpu_serving_step_retries_total counter",
+                f"ptpu_serving_step_retries_total "
+                f"{es['step_retries_total']}",
+                "# TYPE ptpu_serving_requests_requeued_total counter",
+                f"ptpu_serving_requests_requeued_total "
+                f"{es['requests_requeued_total']}",
+                "# TYPE ptpu_serving_poisoned_total counter",
+                f"ptpu_serving_poisoned_total "
+                f"{es['poisoned_total']}",
+                "# TYPE ptpu_serving_telemetry_errors_total counter",
+                f"ptpu_serving_telemetry_errors_total "
+                f"{es['telemetry_errors_total']}",
+                "# TYPE ptpu_serving_engine_crashes_total counter",
+                f"ptpu_serving_engine_crashes_total "
+                f"{es['engine_crashes_total']}",
+                "# TYPE ptpu_serving_engine_restarts_total counter",
+                f"ptpu_serving_engine_restarts_total "
+                f"{es['engine_restarts_total']}",
+                "# TYPE ptpu_serving_engine_down gauge",
+                f"ptpu_serving_engine_down "
+                f"{1 if es['engine_down'] else 0}",
+                "# TYPE ptpu_serving_breaker_open gauge",
+                f"ptpu_serving_breaker_open "
+                f"{1 if es['breaker_state'] == 'open' else 0}",
+                "# TYPE ptpu_serving_faults_injected_total counter",
+                *[f'ptpu_serving_faults_injected_total'
+                  f'{{site="{site}"}} {n}'
+                  for site, n in sorted(
+                      es["faults_injected"].items())],
+                "# TYPE ptpu_serving_prefix_store_errors_total "
+                "counter",
+                f"ptpu_serving_prefix_store_errors_total "
+                f"{self._prefix_store_errors}",
                 "# TYPE ptpu_serving_admitted_interactive_total "
                 "counter",
                 f"ptpu_serving_admitted_interactive_total "
@@ -2108,11 +2352,20 @@ def make_server(host: str, port: int, ms: ModelServer
             if self.path == "/healthz":
                 # Readiness doubles as the router's drain signal: a
                 # draining server answers 503 so load balancers stop
-                # routing here while in-flight work finishes.
+                # routing here while in-flight work finishes — and a
+                # breaker-open engine answers 503 ``engine_down`` so
+                # the router sheds AROUND a crash-storming replica
+                # instead of feeding it work it will hang.
                 if ms.draining:
                     self._send(503, {"status": "draining",
                                      "model": ms.model_name,
                                      **ms.drain_status()})
+                elif ms.engine is not None and ms.engine.down:
+                    self._send(503, {
+                        "status": "engine_down",
+                        "model": ms.model_name,
+                        **({"supervisor": ms.supervisor.status()}
+                           if ms.supervisor is not None else {})})
                 else:
                     self._send(200, {"status": "ok",
                                      "model": ms.model_name})
@@ -2298,6 +2551,16 @@ def make_server(host: str, port: int, ms: ModelServer
                 resp = {"error": str(e),
                         "retry_after": e.retry_after}
                 extra = {"Retry-After": str(e.retry_after)}
+            except PoisonedRequest as e:
+                # Quarantine conviction: THIS request's computation
+                # kept failing the shared decode step, so it alone
+                # fails — typed, with the machine-readable reason,
+                # while its co-tenants resumed token-identically
+                # (engine._quarantine_step).
+                with ms._stats_lock:
+                    ms.errors += 1
+                code, resp = 500, {"error": str(e),
+                                   "reason": e.reason}
             except ValueError as e:
                 with ms._stats_lock:
                     ms.errors += 1
@@ -2312,7 +2575,21 @@ def make_server(host: str, port: int, ms: ModelServer
             if isinstance(resp, dict):
                 resp.setdefault("request_id", rid)
             try:
+                if ms.faults is not None:
+                    # Injected handler-socket death at the worst
+                    # moment — the response write.  The connection
+                    # drops with no response; server-side state is
+                    # already terminal, which is exactly what the
+                    # chaos harness verifies (no leaked slot, no
+                    # wedged worker, counters still advance).
+                    ms.faults.check("socket_reset")
                 self._send(code, resp, extra)
+            except SocketReset:
+                self.close_connection = True
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
             except OSError:
                 pass  # client went away mid-write; nothing to do
             # AFTER the send, so logging latency never delays the

@@ -102,6 +102,28 @@ def step_annotation():
 # two cache layouts with identical materialized content.
 
 
+def alloc_decode_state(mgr) -> None:
+    """(Re)allocate the host-side per-slot decode state the step
+    programs consume: feedback token + absolute position per slot,
+    the sampled variant's extra operands (base PRNG key, next-token
+    index, shaping params — inert zeros for greedy/idle slots), and
+    the per-slot draft length (> 0 marks a SPECULATIVE slot).
+
+    ONE helper shared by SlotKVManager and PagedSlotKVManager, and
+    by BOTH construction and crash-recovery ``reset()`` — so a field
+    added here can never silently survive a supervised restart
+    carrying stale pre-crash state."""
+    n = mgr.n_slots
+    mgr.tokens = np.zeros((n,), np.int32)
+    mgr.positions = np.zeros((n,), np.int32)
+    mgr.keys = np.zeros((n, 2), np.uint32)
+    mgr.next_index = np.zeros((n,), np.int32)
+    mgr.temps = np.zeros((n,), np.float32)
+    mgr.top_ks = np.zeros((n,), np.int32)
+    mgr.top_ps = np.zeros((n,), np.float32)
+    mgr.spec_ks = np.zeros((n,), np.int32)
+
+
 def build_step_body(model, variables, window: int, sampled: bool):
     """Unjitted ``window``-fused decode body over a stacked cache.
 
@@ -291,22 +313,11 @@ class SlotKVManager:
         self._free = list(range(self.n_slots))
         self._step_fns = {}           # (window, variant) -> jitted scan
         self._insert_fns = {}         # draft? -> jitted insert
-        # Host-side per-slot decode state (fed to the step program).
-        self.tokens = np.zeros((self.n_slots,), np.int32)
-        self.positions = np.zeros((self.n_slots,), np.int32)
-        # Per-slot sampling state (the sampled step variant's extra
-        # operands; inert — zeros — for greedy/idle slots): base PRNG
-        # key, index of the NEXT token to draw, and the shaping
-        # params (temperature 0 = greedy lane, top_k/top_p 0 = off).
-        self.keys = np.zeros((self.n_slots, 2), np.uint32)
-        self.next_index = np.zeros((self.n_slots,), np.int32)
-        self.temps = np.zeros((self.n_slots,), np.float32)
-        self.top_ks = np.zeros((self.n_slots,), np.int32)
-        self.top_ps = np.zeros((self.n_slots,), np.float32)
-        # Per-slot draft length: > 0 marks a SPECULATIVE slot (commits
-        # up to spec_k tokens per round); 0 routes the slot through
-        # the spec program's plain one-token lane.
-        self.spec_ks = np.zeros((self.n_slots,), np.int32)
+        # Host-side per-slot decode state (fed to the step program)
+        # — allocated by the shared helper both construction AND
+        # crash-recovery reset() call, so a new field can never
+        # silently survive a supervised restart with stale state.
+        alloc_decode_state(self)
         # Wall-clock of the LAST step/step_spec device section
         # (dispatch + host sync, measured inside the device lock so
         # lock wait is excluded) — the engine's step-timeline records
@@ -325,6 +336,18 @@ class SlotKVManager:
 
     def acquire(self) -> Optional[int]:
         return self._free.pop(0) if self._free else None
+
+    def reset(self) -> None:
+        """Crash-recovery pool rebuild (recovery.EngineSupervisor):
+        drop ALL resident KV and per-slot decode state while KEEPING
+        the compiled step/insert programs — the stacked pools are
+        released and lazily re-zeroed by the next insert's
+        ``_ensure_stacked``, so a supervised restart adds ZERO
+        steady-state recompiles (pinned in tests/test_faults.py)."""
+        self._stacked = None
+        self._draft_stacked = None
+        self._free = list(range(self.n_slots))
+        alloc_decode_state(self)
 
     def release(self, slot: int) -> None:
         """Evict: the slot is reusable the SAME step — no device work,

@@ -680,6 +680,113 @@ def test_snapshot_lock_scoped_to_serving():
     assert _rules(src, "polyaxon_tpu/train.py") == []
 
 
+# -- RETRY-BACKOFF ----------------------------------------------------------
+
+
+def test_retry_backoff_flags_unbounded_retry_loops():
+    """The crash-only retry contract: a ``while True`` loop that
+    swallows a jax or socket failure and loops again without bound
+    turns a permanent failure (dead device, gone peer) into an
+    invisible infinite spin — both the jax and the socket flavors
+    flag."""
+    src = """
+    import jax
+    import urllib.request
+
+    def spin_on_device(self, x):
+        while True:
+            try:
+                return jax.device_get(x)
+            except Exception:
+                self.errors += 1      # counted, still unbounded
+                continue
+
+    def spin_on_peer(self, url):
+        while True:
+            try:
+                return urllib.request.urlopen(url)
+            except OSError:
+                pass
+    """
+    assert _rules(src) == ["RETRY-BACKOFF", "RETRY-BACKOFF"]
+
+
+def test_retry_backoff_bounded_spelling_passes():
+    """The sanctioned spellings pass: the shared RetryPolicy
+    (attempt bound + delay_s backoff), a handler that can escalate
+    (raise after a bounded check), and service loops with external
+    termination (not constant-true)."""
+    src = """
+    import jax
+
+    def with_policy(self, x):
+        attempt = 0
+        while True:
+            try:
+                return jax.device_get(x)
+            except Exception:
+                if attempt >= self.retry_policy.max_attempts:
+                    raise
+                time.sleep(self.retry_policy.delay_s(attempt))
+                attempt += 1
+
+    def with_escape(self, x):
+        while True:
+            try:
+                return jax.device_get(x)
+            except Exception as e:
+                if not is_transient(e):
+                    raise
+                continue
+
+    def service_loop(self, x):
+        while not self._stop:
+            try:
+                jax.device_get(x)
+            except Exception:
+                self.errors += 1
+                continue
+    """
+    assert _rules(src) == []
+
+
+def test_retry_backoff_narrow_and_scoped():
+    """No finding without a risky call in the try (host-only retry
+    loops are someone else's problem), for narrow handlers (a typed
+    exception is a deliberate protocol), or outside serving/."""
+    src = """
+    import jax
+
+    def host_only(self):
+        while True:
+            try:
+                return self.queue.pop_head()
+            except Exception:
+                self.errors += 1
+                continue
+
+    def typed_handler(self, x):
+        while True:
+            try:
+                return jax.device_get(x)
+            except KeyError:
+                continue
+    """
+    assert _rules(src) == []
+    unbounded = """
+    import jax
+
+    def f(self, x):
+        while True:
+            try:
+                return jax.device_get(x)
+            except Exception:
+                self.errors += 1
+                continue
+    """
+    assert _rules(unbounded, "polyaxon_tpu/train.py") == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 

@@ -1,0 +1,981 @@
+"""Crash-only serving's proof obligations (serving/faults.py +
+serving/recovery.py).
+
+The hard property chaos testing exists to pin is DETERMINISM UNDER
+CHAOS: with a seeded fault plan active, every SURVIVING request's
+tokens are bitwise identical to the fault-free run — which, by the
+position-keyed RNG contract, is itself bitwise identical to the solo
+reference (``generate`` / ``generate_positional``).  So the matrix
+below compares every surviving request against the solo reference
+directly: one ground truth for fault-free, engine-crash,
+poisoned-request, and page-exhaustion runs alike, across
+plain/sampled/spec requests and three co-tenancy schedules.
+
+Alongside the matrix: the fault plan's own gate/determinism
+semantics, the shared RetryPolicy and CircuitBreaker, quarantine
+bisection (the poisoned request ALONE fails, typed), supervised
+restart with zero steady-state recompiles after recovery, the
+breaker's fail-fast-never-hang contract (healthz 503 engine_down,
+submits shed, and a healthy engine always re-closes it), the
+prefix-store degradation ladder, handler socket resets, the
+/metrics - /info - /debug/state counter no-drift pin, and the tier-1
+crash-recovery smoke with the lock sanitizer armed.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.generate import generate, generate_positional
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import (CircuitBreaker, DecodeEngine,
+                                  EngineSupervisor, FaultPlan,
+                                  ModelServer, PoisonedRequest,
+                                  RetryPolicy, make_server)
+from polyaxon_tpu.serving.debug import StallWatchdog
+from polyaxon_tpu.serving.faults import (EngineDeath, FaultInjected,
+                                         InjectedPageExhausted,
+                                         PoisonedComputation,
+                                         SocketReset, TransientFault,
+                                         is_poisoned, is_transient)
+from polyaxon_tpu.serving.scheduler import (SamplingSpec,
+                                            SchedulerPolicy,
+                                            ShedError)
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def draft_vars(small_model):
+    model, _ = small_model
+    return model.init(jax.random.PRNGKey(99),
+                      jnp.zeros((1, 4), jnp.int32))
+
+
+# The shared request set: the quarantine victim, the mode-varying
+# probe, and two co-tenants (greedy + sampled) — every run submits
+# all four, the schedule only changes WHEN.
+VICTIM = np.asarray([[9, 9, 2, 6]], np.int32)
+PROBE = np.asarray([[3, 1, 4, 1]], np.int32)
+CT1 = np.asarray([[2, 7, 1, 8]], np.int32)
+CT2 = np.asarray([[5, 4, 4, 2]], np.int32)
+SAMP = dict(seed=7, temperature=0.9, top_k=16, top_p=0.95)
+
+MODES = ("plain", "sampled", "spec")
+SCHEDULES = ("burst", "staggered", "starved")
+PLANS = {
+    # Whole-engine death mid-run: the supervised-restart path.
+    "engine_death": {"seed": 3, "faults": [
+        {"site": "engine_death", "after": 3, "times": 1}]},
+    # One request's computation poisons the shared step until
+    # quarantine bisection convicts it (unbounded times: it fires
+    # whenever the victim is resident, which IS the isolatable
+    # property).
+    "poisoned": {"seed": 5, "faults": [
+        {"site": "step", "kind": "poisoned", "rid": "victim"}]},
+    # Page-pool exhaustion at admission: the requeue-and-resume path.
+    "page_alloc": {"seed": 7, "faults": [
+        {"site": "page_alloc", "times": 2}]},
+}
+
+
+def _request_set(mode):
+    probe_sampling = {
+        "plain": None,
+        "sampled": SamplingSpec(**SAMP),
+        # Greedy accept lane: speculative output equals target-model
+        # greedy exactly, whatever the draft proposes.
+        "spec": SamplingSpec(spec_k=2),
+    }[mode]
+    return [
+        ("victim", VICTIM, 8, None),
+        ("probe", PROBE, 8, probe_sampling),
+        ("ct-greedy", CT1, 6, None),
+        ("ct-sampled", CT2, 6,
+         SamplingSpec(seed=3, temperature=1.1, top_k=8)),
+    ]
+
+
+@pytest.fixture(scope="module")
+def refs(small_model):
+    """Solo references per (mode, rid): the ONE ground truth every
+    run — fault-free or chaotic, fixed-lane or paged — must match."""
+    model, variables = small_model
+    out = {}
+    for mode in MODES:
+        for rid, prompt, new, samp in _request_set(mode):
+            if samp is None or samp.temperature == 0:
+                want = generate(model, variables, prompt,
+                                max_new_tokens=new)
+            else:
+                want = generate_positional(
+                    model, variables, prompt, max_new_tokens=new,
+                    seed=samp.seed, temperature=samp.temperature,
+                    top_k=samp.top_k, top_p=samp.top_p)
+            out[(mode, rid)] = np.asarray(want).tolist()
+    return out
+
+
+def _mk_engine(model, variables, dvars=None, *, faults=None,
+               paged=False, supervise=True, breaker=None,
+               backoff=None, **policy):
+    kw = dict(n_slots=4, decode_window=2, queue_depth=16)
+    if paged:
+        kw.update(kv_paged=True, kv_page_tokens=8)
+    kw.update(policy)
+    extra = {}
+    if dvars is not None:
+        extra = dict(draft_model=model, draft_variables=dvars)
+    eng = DecodeEngine(
+        model, variables, policy=SchedulerPolicy(**kw),
+        faults=FaultPlan.load(faults) if faults is not None else None,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                 max_delay_s=0.01),
+        **extra)
+    if supervise:
+        EngineSupervisor(
+            eng,
+            backoff=backoff if backoff is not None else RetryPolicy(
+                max_attempts=0, base_delay_s=0.001, max_delay_s=0.02),
+            breaker=breaker)
+    return eng
+
+
+def _run_schedule(eng, mode, schedule):
+    """Submit the request set under one co-tenancy schedule on the
+    LIVE engine and wait for every terminal event (the zero-hung-
+    callers contract is the wait timeout).
+
+    - ``burst``: all four at once into an idle pool.
+    - ``staggered``: the victim decodes a couple of tokens before
+      its co-tenants arrive (mid-flight admission).
+    - ``starved``: the burst plus two filler co-tenants — more
+      requests than the 4-slot pool, so the tail queues and admits
+      into evicted slots."""
+    reqs = _request_set(mode)
+    groups = {}
+    fillers = []
+
+    def submit(i):
+        rid, prompt, new, samp = reqs[i]
+        groups[rid] = eng.submit(prompt, new, None, None,
+                                 sampling=samp, rid=rid)
+
+    submit(0)
+    if schedule == "staggered":
+        s0 = groups["victim"].streams[0]
+        deadline = time.monotonic() + 60
+        while len(s0.out) < 2 and not groups["victim"].event.is_set():
+            assert time.monotonic() < deadline, "victim stalled"
+            time.sleep(0.002)
+    for i in (1, 2, 3):
+        submit(i)
+    if schedule == "starved":
+        for j in range(2):
+            fillers.append(eng.submit(
+                np.asarray([[1 + j, 2, 3, 4]], np.int32), 6,
+                None, None, rid=f"filler-{j}"))
+    for rid, g in groups.items():
+        assert g.event.wait(timeout=120), \
+            f"hung caller: {rid} under {schedule}"
+    for j, g in enumerate(fillers):
+        assert g.event.wait(timeout=120), f"hung filler-{j}"
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# THE determinism-under-chaos matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_pool(small_model, draft_vars):
+    """Shared LIVE engines for the matrix — one per (paged, spec)
+    config, reused across all 27 cells so the compiled-program
+    warmup is paid once, not per cell.  Reuse is exactly what the
+    machinery claims to support: each cell arms a FRESH FaultPlan on
+    the warm engine (``eng.faults`` is the one probe hook), runs its
+    schedule, and disarms; crash recovery rebuilds pools in place,
+    so a cell that killed the engine hands the next cell a healthy
+    one — and the breaker clears on every worked tick, so crash
+    cells never accumulate toward a trip across cells."""
+    model, variables = small_model
+    engines = {}
+
+    def get(*, paged, spec):
+        key = (paged, spec)
+        if key not in engines:
+            engines[key] = _mk_engine(
+                model, variables, draft_vars if spec else None,
+                paged=paged,
+                **(dict(kv_pages=12) if paged else {}))
+        return engines[key]
+
+    yield get
+    for eng in engines.values():
+        eng.close()
+
+
+@pytest.mark.parametrize("plan_name", list(PLANS))
+@pytest.mark.parametrize("schedule", SCHEDULES)
+@pytest.mark.parametrize("mode", MODES)
+def test_determinism_under_chaos_matrix(engine_pool, refs, mode,
+                                        schedule, plan_name):
+    """Under a seeded engine-crash / poisoned-request / page-
+    exhaustion plan, every surviving request's tokens are bitwise
+    identical to the solo reference (= the fault-free run), the
+    poisoned victim ALONE fails with the typed PoisonedRequest, and
+    no caller hangs — across plain/sampled/spec probes and three
+    co-tenancy schedules."""
+    eng = engine_pool(paged=(plan_name == "page_alloc"),
+                      spec=(mode == "spec"))
+    before = eng.stats()
+    plan = FaultPlan(PLANS[plan_name])
+    eng.faults = plan
+    try:
+        groups = _run_schedule(eng, mode, schedule)
+        st = eng.stats()
+    finally:
+        eng.faults = None
+    assert plan.injected_total >= 1, "plan never fired"
+    for rid, g in groups.items():
+        if plan_name == "poisoned" and rid == "victim":
+            assert isinstance(g.error, PoisonedRequest), g.error
+            assert g.status == "poisoned"
+            continue
+        assert g.error is None, (rid, g.error)
+        assert g.result().tolist() == refs[(mode, rid)], \
+            (rid, mode, schedule, plan_name)
+    if plan_name == "engine_death":
+        assert st["engine_crashes_total"] \
+            - before["engine_crashes_total"] == 1
+        assert st["engine_restarts_total"] \
+            - before["engine_restarts_total"] == 1
+    if plan_name == "poisoned":
+        assert st["poisoned_total"] - before["poisoned_total"] == 1
+        assert sum(1 for g in groups.values()
+                   if g.error is not None) == 1
+    if plan_name == "page_alloc":
+        # the injected exhaustion rode the requeue-and-resume path
+        assert st["requests_requeued_total"] \
+            > before["requests_requeued_total"]
+    # no leaked slots/pages once idle
+    assert st["slots_active"] == 0 and st["queue_len"] == 0
+
+
+def test_faultfree_equals_reference_baseline(engine_pool, refs):
+    """The comparison the matrix leans on, pinned explicitly once:
+    the DISARMED engine reproduces the solo references under the
+    burst schedule for every mode — on the same shared engines the
+    chaos cells run against."""
+    for mode in MODES:
+        eng = engine_pool(paged=False, spec=(mode == "spec"))
+        groups = _run_schedule(eng, mode, "burst")
+        for rid, g in groups.items():
+            assert g.error is None
+            assert g.result().tolist() == refs[(mode, rid)], \
+                (mode, rid)
+
+
+def test_zero_steady_state_recompiles_after_recovery(small_model,
+                                                     refs):
+    """A supervised restart rebuilds the pools IN PLACE: after the
+    crash-recovery cycle (and its replay warmup), repeated same-shape
+    traffic adds ZERO compile-cache misses — recovery must never
+    start a recompile storm."""
+    model, variables = small_model
+    eng = _mk_engine(model, variables, faults={
+        "seed": 1, "faults": [
+            {"site": "engine_death", "after": 2, "times": 1}]})
+    try:
+        groups = _run_schedule(eng, "plain", "burst")
+        for rid, g in groups.items():
+            assert g.error is None
+            assert g.result().tolist() == refs[("plain", rid)]
+        assert eng.stats()["engine_restarts_total"] == 1
+        warm = eng.sentinel.snapshot()["compile_cache_misses"]
+        groups = _run_schedule(eng, "plain", "burst")
+        for rid, g in groups.items():
+            assert g.error is None
+        assert eng.sentinel.snapshot()["compile_cache_misses"] \
+            == warm, "recovery perturbed the compiled-program story"
+    finally:
+        eng.close()
+
+
+def test_transient_step_faults_retry_in_place(small_model, refs):
+    """TRANSIENT step failures are absorbed by the bounded retry —
+    no quarantine, no restart, tokens identical."""
+    model, variables = small_model
+    eng = _mk_engine(model, variables, faults={
+        "seed": 2, "faults": [
+            {"site": "step", "kind": "transient", "times": 2}]})
+    try:
+        groups = _run_schedule(eng, "plain", "burst")
+        st = eng.stats()
+    finally:
+        eng.close()
+    for rid, g in groups.items():
+        assert g.error is None
+        assert g.result().tolist() == refs[("plain", rid)]
+    assert st["step_retries_total"] == 2
+    assert st["poisoned_total"] == 0
+    assert st["engine_crashes_total"] == 0
+
+
+def test_quarantine_requeues_innocents(small_model, refs):
+    """Bisection evicts innocent co-tenants to the requeue path (and
+    they resume token-identically) while convicting ONLY the
+    victim."""
+    model, variables = small_model
+    eng = _mk_engine(model, variables, faults={
+        "seed": 4, "faults": [
+            {"site": "step", "kind": "poisoned", "rid": "victim"}]})
+    try:
+        groups = _run_schedule(eng, "plain", "burst")
+        st = eng.stats()
+    finally:
+        eng.close()
+    assert isinstance(groups["victim"].error, PoisonedRequest)
+    for rid in ("probe", "ct-greedy", "ct-sampled"):
+        assert groups[rid].error is None
+        assert groups[rid].result().tolist() == refs[("plain", rid)]
+    assert st["poisoned_total"] == 1
+    # at least one innocent was evicted-and-resumed during bisection
+    assert st["requests_requeued_total"] >= 1
+    # conviction cleared the suspect pool
+    assert eng._suspects == set()
+
+
+def test_engine_level_fault_escalates_not_serial_convictions(
+        small_model):
+    """A fault that fails EVERY dispatch tracks the ENGINE, not a
+    request — quarantine must not drain the queue one wrongful
+    `poisoned_request` at a time.  After at most two convictions
+    with no working dispatch between them, the next episode
+    escalates to supervised recovery; the persisting fault then
+    storms the breaker into fail-fast shedding.  Every caller
+    reaches a typed terminal status — bounded, never a hang."""
+    model, variables = small_model
+    eng = _mk_engine(
+        model, variables,
+        faults={"seed": 0, "faults": [
+            {"site": "step", "kind": "transient"}]},  # unbounded
+        breaker=CircuitBreaker(threshold=2, window_s=60.0,
+                               cooldown_s=0.2))
+    eng.retry_policy = RetryPolicy(max_attempts=1,
+                                   base_delay_s=0.001,
+                                   max_delay_s=0.002)
+    try:
+        groups = [eng.submit(np.asarray([[3 + i, 5, 7]], np.int32),
+                             6, None, None, rid=f"r{i}")
+                  for i in range(4)]
+        for i, g in enumerate(groups):
+            assert g.event.wait(timeout=120), f"hung caller r{i}"
+        st = eng.stats()
+    finally:
+        eng.close()
+    assert all(g.error is not None for g in groups)
+    # conviction streak capped at 2, then the ladder escalated
+    assert st["poisoned_total"] <= 2
+    assert st["engine_crashes_total"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: fail fast, never hang, never wedge a healthy engine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_sheds_and_recovers(small_model, refs):
+    """A crash storm trips the breaker: in-flight work sheds with the
+    machine-readable ``engine_down`` (never a hang), new submits shed
+    at the gate — and after the cooldown the probe restart re-closes
+    the breaker on a healthy engine, which then serves normally."""
+    model, variables = small_model
+    eng = _mk_engine(
+        model, variables,
+        faults={"seed": 0, "faults": [
+            {"site": "engine_death", "times": 2}]},
+        breaker=CircuitBreaker(threshold=2, window_s=60.0,
+                               cooldown_s=0.8))
+    try:
+        g = eng.submit(PROBE, 8, None, None, rid="storm-victim")
+        assert g.event.wait(timeout=60), "hung during crash storm"
+        # crash #1 recovered+requeued; crash #2 tripped the breaker
+        assert isinstance(g.error, ShedError), g.error
+        assert g.error.reason == "engine_down"
+        assert eng.supervisor.breaker.state == CircuitBreaker.OPEN
+        # during the cooldown: fail-fast shedding at the gate
+        assert eng.down
+        with pytest.raises(ShedError) as ei:
+            eng.submit(PROBE, 4, None, None)
+        assert ei.value.reason == "engine_down"
+        # the probe restart must revive the engine (fault times
+        # exhausted = it is healthy now)
+        deadline = time.monotonic() + 30
+        while eng.down and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not eng.down, "breaker wedged a healthy engine"
+        g2 = eng.submit(PROBE, 8, None, None, rid="post-storm")
+        assert g2.event.wait(timeout=60)
+        assert g2.error is None
+        assert g2.result().tolist() == refs[("plain", "probe")]
+        # the worked tick closed the breaker
+        assert eng.supervisor.breaker.state == CircuitBreaker.CLOSED
+        st = eng.stats()
+        assert st["engine_crashes_total"] == 2
+        assert st["breaker_state"] == "closed"
+    finally:
+        eng.close()
+
+
+def test_circuit_breaker_unit():
+    br = CircuitBreaker(threshold=3, window_s=10.0, cooldown_s=1.0)
+    assert br.record_crash(now=0.0) == CircuitBreaker.CLOSED
+    assert br.record_crash(now=1.0) == CircuitBreaker.CLOSED
+    assert br.record_crash(now=2.0) == CircuitBreaker.OPEN
+    assert br.trips_total == 1
+    br.half_open()
+    assert br.state == CircuitBreaker.HALF_OPEN
+    # a crash during the probe goes straight back open
+    assert br.record_crash(now=3.0) == CircuitBreaker.OPEN
+    assert br.trips_total == 2
+    br.half_open()
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    # success cleared the window: three MORE crashes needed to trip
+    assert br.record_crash(now=4.0) == CircuitBreaker.CLOSED
+    # crashes outside the window fall off
+    br2 = CircuitBreaker(threshold=2, window_s=5.0)
+    br2.record_crash(now=0.0)
+    assert br2.record_crash(now=100.0) == CircuitBreaker.CLOSED
+    # a STALE half-open probe (idle past the window — note_progress
+    # never ran because no tick worked) must not re-trip on one
+    # isolated crash much later
+    br3 = CircuitBreaker(threshold=2, window_s=0.05, cooldown_s=0.0)
+    br3.record_crash()
+    br3.record_crash()
+    assert br3.state == CircuitBreaker.OPEN
+    br3.half_open()
+    time.sleep(0.08)
+    assert br3.record_crash() == CircuitBreaker.CLOSED
+    with pytest.raises(ValueError):
+        CircuitBreaker(threshold=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(window_s=0)
+
+
+def test_retry_policy_unit():
+    p1 = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                     max_delay_s=1.0, jitter=0.5, seed=42)
+    p2 = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                     max_delay_s=1.0, jitter=0.5, seed=42)
+    d1 = [p1.delay_s(i) for i in range(8)]
+    d2 = [p2.delay_s(i) for i in range(8)]
+    assert d1 == d2, "seeded delay streams must be reproducible"
+    assert all(d >= 0.01 for d in d1)
+    assert all(d <= 1.0 * 1.5 for d in d1)       # cap * (1+jitter)
+    # exponential growth below the cap
+    p3 = RetryPolicy(base_delay_s=0.01, max_delay_s=100.0, jitter=0.0)
+    assert p3.delay_s(3) == pytest.approx(0.08)
+    for bad in (dict(max_attempts=-1), dict(jitter=-0.1),
+                dict(base_delay_s=0.5, max_delay_s=0.1)):
+        with pytest.raises(ValueError):
+            RetryPolicy(**bad)
+
+
+# ---------------------------------------------------------------------------
+# the fault plan itself: validation + gate determinism
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_validation(self):
+        for bad in (
+                {"faults": []},
+                {"faults": "nope"},
+                {"seed": 0},
+                {"faults": [{"site": "nope"}]},
+                {"faults": [{"site": "step", "kind": "weird"}]},
+                {"faults": [{"site": "page_alloc",
+                             "kind": "transient"}]},
+                {"faults": [{"site": "step", "kind": "poisoned"}]},
+                {"faults": [{"site": "step", "banana": 1}]},
+                {"faults": [{"site": "step", "p": 1.5}]},
+                {"faults": [{"site": "step", "after": -1}]},
+                {"faults": [{"site": "step", "every": 0}]},
+                {"faults": [{"site": "step", "times": 0}]},
+                {"faults": [{"site": "slow_step", "delay_s": 0}]},
+                {"extra": 1, "faults": [{"site": "step"}]},
+        ):
+            with pytest.raises(ValueError):
+                FaultPlan(bad)
+
+    def test_load_from_dict_path_and_passthrough(self, tmp_path):
+        plan = {"seed": 9, "faults": [{"site": "step", "times": 1}]}
+        fp = FaultPlan.load(plan)
+        assert FaultPlan.load(fp) is fp
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(plan))
+        from_file = FaultPlan.load(str(p))
+        assert from_file.seed == 9 and len(from_file.specs) == 1
+
+    def test_gates_after_every_times(self):
+        fp = FaultPlan({"faults": [
+            {"site": "step", "after": 2, "every": 2, "times": 2}]})
+        fired = []
+        for i in range(10):
+            try:
+                fp.check("step")
+                fired.append(False)
+            except TransientFault:
+                fired.append(True)
+        # skip 2, then every 2nd eligible probe, max 2 fires
+        assert fired == [False, False, True, False, True,
+                         False, False, False, False, False]
+        assert fp.injected == {"step": 2}
+        assert fp.stats()["faults_injected_total"] == 2
+
+    def test_probability_draws_are_seed_deterministic(self):
+        def fire_pattern(seed):
+            fp = FaultPlan({"seed": seed, "faults": [
+                {"site": "step", "p": 0.5}]})
+            out = []
+            for _ in range(32):
+                try:
+                    fp.check("step")
+                    out.append(0)
+                except TransientFault:
+                    out.append(1)
+            return out
+
+        a, b, c = fire_pattern(11), fire_pattern(11), fire_pattern(12)
+        assert a == b, "same seed must fire identically"
+        assert a != c, "different seeds should differ (32 draws)"
+        assert 0 < sum(a) < 32
+
+    def test_poisoned_request_index_resolution(self):
+        fp = FaultPlan({"faults": [
+            {"site": "step", "kind": "poisoned",
+             "request_index": 1}]})
+        fp.on_submit("req-a")
+        fp.on_submit("req-b")
+        spec = fp.specs[0]
+        assert spec.target_rid == "req-b"
+        # gated on the target being RESIDENT
+        fp.check("step", rids=["req-a"])     # no fire
+        with pytest.raises(PoisonedComputation) as ei:
+            fp.check("step", rids=["req-a", "req-b"])
+        assert ei.value.rid == "req-b"
+        assert is_poisoned(ei.value)
+
+    def test_slow_step_sleeps_instead_of_raising(self):
+        fp = FaultPlan({"faults": [
+            {"site": "slow_step", "delay_s": 0.05, "times": 1}]})
+        t0 = time.perf_counter()
+        fp.check("slow_step")                # sleeps, no raise
+        assert time.perf_counter() - t0 >= 0.045
+        fp.check("slow_step")                # exhausted: no sleep
+        assert fp.injected == {"slow_step": 1}
+
+    def test_exception_taxonomy(self):
+        assert is_transient(TransientFault("x"))
+        assert not is_transient(RuntimeError("x"))
+        assert is_poisoned(PoisonedComputation("x", rid="r"))
+        assert not is_poisoned(TransientFault("x"))
+        # injected page exhaustion rides the PageExhausted path
+        from polyaxon_tpu.serving.paged import PageExhausted
+        assert issubclass(InjectedPageExhausted, PageExhausted)
+        assert issubclass(InjectedPageExhausted, FaultInjected)
+        for cls in (TransientFault, EngineDeath, SocketReset):
+            assert issubclass(cls, FaultInjected)
+
+
+def test_stale_prefix_pins_die_with_the_pool(small_model):
+    """Prefix pins cross thread scopes between lookup and admission;
+    a crash-recovery pool rebuild in between makes their ids
+    meaningless.  The pool epoch (returned by ``pin``, bumped by
+    ``reset``) is the guard: stale epoch-tagged unpins are no-ops,
+    and the engine's admission gate drops stale shares by reference
+    — fresh accounting is never corrupted."""
+    from polyaxon_tpu.serving.server import PagePins
+
+    model, variables = small_model
+    eng = DecodeEngine(
+        model, variables, autostart=False,
+        policy=SchedulerPolicy(n_slots=2, decode_window=1,
+                               kv_paged=True, kv_page_tokens=8,
+                               kv_pages=12))
+    mgr = eng.slots
+    ids = mgr.try_reserve(2)
+    epoch = mgr.pin(ids)
+    mgr.reset()                          # crash recovery's rebuild
+    assert mgr.epoch == epoch + 1
+    # stale unpin: by-reference no-op; the fresh all-free pool keeps
+    # its accounting (a raw unpin here would have raised or
+    # corrupted refcounts)
+    mgr.unpin(ids, epoch=epoch)
+    assert mgr.free_page_count() == mgr.n_pages
+    # the admission gate drops a stale share the same way
+    g = eng.submit(PROBE, 4, None, None,
+                   shared_pages=PagePins(tuple(ids), epoch))
+    stream = g.streams[0]
+    assert stream.kv_shared == tuple(ids)
+    assert stream.kv_epoch == epoch
+    eng._validate_shared_epoch(stream)
+    assert stream.kv_shared is None and stream.kv_epoch is None
+    # current-epoch pins still release normally
+    ids2 = mgr.try_reserve(1)
+    e2 = mgr.pin(ids2)
+    mgr.unpin(ids2, epoch=e2)            # pin refcount 2 -> 1
+    mgr.unpin(ids2)                      # reserve refcount 1 -> 0
+    assert mgr.free_page_count() == mgr.n_pages
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: prefix store + telemetry isolation
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_store_error_degrades_not_fails(small_model):
+    """A prefix-store failure disables the store with a counter; the
+    request pays full prefill and SUCCEEDS — a broken optimization
+    costs hit-rate, never availability."""
+    model, variables = small_model
+    ms = ModelServer(model, variables, model_name="tiny",
+                     max_batch=4, n_slots=2, prefix_cache=4,
+                     fault_plan={"seed": 0, "faults": [
+                         {"site": "prefix_store", "times": 1}]})
+    try:
+        want = np.asarray(generate(
+            model, variables, PROBE, max_new_tokens=4)).tolist()
+        r = ms.generate({"prompt": PROBE[0].tolist(),
+                         "max_new_tokens": 4})
+        assert r["tokens"] == want
+        assert ms._prefix_enabled is False
+        info = ms.info()
+        assert info["prefix_store_errors"] == 1
+        assert info["prefix_enabled"] is False
+        assert "ptpu_serving_prefix_store_errors_total 1" \
+            in ms.metrics_text()
+        # still serving, store stays off (no more injected faults
+        # needed — disabled is disabled)
+        r2 = ms.generate({"prompt": PROBE[0].tolist(),
+                          "max_new_tokens": 4})
+        assert r2["tokens"] == want
+    finally:
+        ms.close()
+
+
+def test_telemetry_faults_stay_isolated(small_model, refs):
+    """An injected telemetry failure is counted and dropped — the
+    request path never notices (observability strictly isolated)."""
+    model, variables = small_model
+    eng = _mk_engine(model, variables, faults={
+        "seed": 0, "faults": [{"site": "telemetry", "times": 3}]})
+    try:
+        groups = _run_schedule(eng, "plain", "burst")
+        st = eng.stats()
+    finally:
+        eng.close()
+    for rid, g in groups.items():
+        assert g.error is None
+        assert g.result().tolist() == refs[("plain", rid)]
+    assert st["telemetry_errors_total"] == 3
+    assert st["faults_injected"].get("telemetry") == 3
+
+
+# ---------------------------------------------------------------------------
+# server surfaces: socket reset, healthz, counters no-drift, bundles
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_server(small_model):
+    """Factory: spin up an HTTP server around a ModelServer built
+    with the given kwargs; everything torn down at test end."""
+    built = []
+
+    def build(**kw):
+        model, variables = small_model
+        ms = ModelServer(model, variables, model_name="tiny",
+                         max_batch=4, n_slots=2, queue_depth=16,
+                         **kw)
+        srv = make_server("127.0.0.1", 0, ms)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        built.append((srv, ms))
+        return f"http://127.0.0.1:{srv.server_address[1]}", ms
+
+    yield build
+    for srv, ms in built:
+        srv.shutdown()
+        srv.server_close()
+        ms.close()
+
+
+def _post(base, payload, expect=200, timeout=120):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            assert r.status == expect
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        assert e.code == expect, body
+        return json.loads(body)
+
+
+def _get(base, path, expect=200):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            assert r.status == expect
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        assert e.code == expect, body
+        return json.loads(body)
+
+
+def test_socket_reset_drops_connection_not_server(http_server,
+                                                  small_model):
+    """An injected handler-socket death drops ONE connection; the
+    server keeps serving, no slot leaks, the counter advances."""
+    model, variables = small_model
+    base, ms = http_server(fault_plan={"seed": 0, "faults": [
+        {"site": "socket_reset", "times": 1}]})
+    payload = {"prompt": PROBE[0].tolist(), "max_new_tokens": 4}
+    with pytest.raises(Exception):       # connection died mid-write
+        _post(base, payload)
+    # next request sails through, identical tokens
+    want = np.asarray(generate(
+        model, variables, PROBE, max_new_tokens=4)).tolist()
+    assert _post(base, payload)["tokens"] == want
+    st = ms.engine.stats()
+    assert st["faults_injected"].get("socket_reset") == 1
+    assert st["slots_active"] == 0
+
+
+def test_poisoned_request_maps_to_typed_500(http_server, small_model):
+    """The quarantine conviction reaches the client as a 500 with
+    the machine-readable ``reason: poisoned_request`` — while a
+    co-tenant completes normally."""
+    model, variables = small_model
+    base, ms = http_server(fault_plan={"seed": 0, "faults": [
+        {"site": "step", "kind": "poisoned", "request_index": 0}]})
+    results = {}
+
+    def go(name, payload, expect):
+        results[name] = _post(base, payload, expect=expect)
+
+    t1 = threading.Thread(target=go, args=(
+        "victim", {"prompt": VICTIM[0].tolist(),
+                   "max_new_tokens": 8}, 500))
+    t1.start()
+    time.sleep(0.05)                      # victim submits first
+    t2 = threading.Thread(target=go, args=(
+        "neighbor", {"prompt": CT1[0].tolist(),
+                     "max_new_tokens": 6}, 200))
+    t2.start()
+    t1.join(timeout=120)
+    t2.join(timeout=120)
+    assert results["victim"]["reason"] == "poisoned_request"
+    want = np.asarray(generate(
+        model, variables, CT1, max_new_tokens=6)).tolist()
+    assert results["neighbor"]["tokens"] == want
+
+
+def test_healthz_503_engine_down_then_recovers(http_server):
+    """Breaker open => /healthz answers 503 ``engine_down`` (the
+    router sheds around the replica); recovery flips it back 200."""
+    base, ms = http_server(
+        supervise=False,
+        fault_plan={"seed": 0, "faults": [
+            {"site": "engine_death", "times": 1}]})
+    # wire the storm-sensitive supervisor the way the server does,
+    # with a test-sized breaker (one crash trips it)
+    sup = EngineSupervisor(
+        ms.engine,
+        backoff=RetryPolicy(max_attempts=0, base_delay_s=0.001,
+                            max_delay_s=0.01),
+        breaker=CircuitBreaker(threshold=1, window_s=60.0,
+                               cooldown_s=1.0))
+    sup.add_recovery_hook(ms._on_engine_recovery)
+    ms.supervisor = sup
+    _post(base, {"prompt": PROBE[0].tolist(), "max_new_tokens": 2},
+          expect=503)
+    body = _get(base, "/healthz", expect=503)
+    assert body["status"] == "engine_down"
+    assert body["supervisor"]["breaker"]["state"] == "open"
+    deadline = time.monotonic() + 30
+    while ms.engine.down and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert _get(base, "/healthz")["status"] == "ok"
+    # healthy again end to end
+    r = _post(base, {"prompt": PROBE[0].tolist(),
+                     "max_new_tokens": 4})
+    assert len(r["tokens"][0]) == PROBE.shape[1] + 4
+
+
+def test_recovery_counters_no_drift_across_surfaces(small_model):
+    """The no-drift pin (the PR 4 template): every recovery counter
+    renders from ONE engine.stats() dict into /metrics and /info —
+    the surfaces can never disagree."""
+    model, variables = small_model
+    ms = ModelServer(model, variables, model_name="tiny",
+                     max_batch=4, n_slots=2,
+                     fault_plan={"seed": 0, "faults": [
+                         {"site": "step", "kind": "transient",
+                          "times": 1},
+                         {"site": "engine_death", "after": 2,
+                          "times": 1}]})
+    try:
+        for _ in range(2):
+            ms.generate({"prompt": PROBE[0].tolist(),
+                         "max_new_tokens": 4})
+        es = ms.engine.stats()
+        assert es["step_retries_total"] == 1
+        assert es["engine_restarts_total"] == 1
+        info = ms.info()
+        text = ms.metrics_text()
+        for key, metric in (
+                ("step_retries_total",
+                 "ptpu_serving_step_retries_total"),
+                ("requests_requeued_total",
+                 "ptpu_serving_requests_requeued_total"),
+                ("poisoned_total", "ptpu_serving_poisoned_total"),
+                ("telemetry_errors_total",
+                 "ptpu_serving_telemetry_errors_total"),
+                ("engine_crashes_total",
+                 "ptpu_serving_engine_crashes_total"),
+                ("engine_restarts_total",
+                 "ptpu_serving_engine_restarts_total"),
+                ("faults_injected_total",
+                 "ptpu_serving_faults_injected_total")):
+            assert info[key] == es[key], key
+            if metric != "ptpu_serving_faults_injected_total":
+                assert f"{metric} {es[key]}" in text, metric
+        for site, n in es["faults_injected"].items():
+            assert (f'ptpu_serving_faults_injected_total'
+                    f'{{site="{site}"}} {n}') in text
+        assert "ptpu_serving_engine_down 0" in text
+        assert "ptpu_serving_breaker_open 0" in text
+        assert info["breaker_state"] == es["breaker_state"]
+        assert info["supervisor"]["restarts_total"] \
+            == es["engine_restarts_total"]
+        assert info["fault_plan"]["faults_injected_total"] \
+            == es["faults_injected_total"]
+    finally:
+        ms.close()
+
+
+def test_debug_state_and_stall_bundle_carry_supervisor_state(
+        small_model, tmp_path):
+    """A recovery storm is diagnosable from ONE artifact: the
+    /debug/state snapshot (and the stall bundle, which embeds a
+    forced build of the same snapshot) carries restart count,
+    breaker state, last fault site, and last recovery duration."""
+    model, variables = small_model
+    eng = _mk_engine(model, variables, faults={
+        "seed": 0, "faults": [
+            {"site": "engine_death", "after": 1, "times": 1}]})
+    try:
+        g = eng.submit(PROBE, 4, None, None, rid="r1")
+        assert g.event.wait(timeout=60) and g.error is None
+        snap = eng.build_debug_snapshot(forced=True)
+        assert snap["engine_down"] is False
+        sup = snap["supervisor"]
+        assert sup["restarts_total"] == 1
+        assert sup["crashes_total"] == 1
+        assert sup["breaker"]["state"] == "closed"
+        assert sup["last_recovery_s"] >= 0
+        assert "EngineDeath" in sup["last_crash"]
+        assert snap["faults"]["last_fault_site"] == "engine_death"
+        # the stall bundle embeds the same snapshot
+        wd = StallWatchdog(eng, eng.tel, timeout_s=60.0,
+                           out_dir=str(tmp_path))
+        bundle = wd.build_bundle({"reason": "test"})
+        bsup = bundle["state"]["supervisor"]
+        assert bsup["restarts_total"] == 1
+        assert bundle["state"]["faults"]["last_fault_site"] \
+            == "engine_death"
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 crash-recovery smoke: one injected crash, sanitizer armed
+# ---------------------------------------------------------------------------
+
+
+def test_crash_recovery_smoke_sanitized(small_model):
+    """The acceptance smoke: a sanitized server survives one
+    injected engine crash mid-burst — every caller reaches a
+    terminal status with reference tokens, the engine restarts
+    exactly once, and teardown is lock-sanitizer quiet."""
+    model, variables = small_model
+    ms = ModelServer(model, variables, model_name="tiny",
+                     max_batch=8, n_slots=4, queue_depth=32,
+                     sanitize=True,
+                     fault_plan={"seed": 6, "faults": [
+                         {"site": "engine_death", "after": 4,
+                          "times": 1}]})
+    try:
+        reqs = [(PROBE, 8), (CT1, 6), (CT2, 6), (VICTIM, 8)]
+        results = [None] * len(reqs)
+        errors = []
+
+        def go(i):
+            prompt, new = reqs[i]
+            try:
+                results[i] = ms.generate(
+                    {"prompt": prompt[0].tolist(),
+                     "max_new_tokens": new})
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for (prompt, new), res in zip(reqs, results):
+            want = np.asarray(generate(
+                model, variables, prompt,
+                max_new_tokens=new)).tolist()
+            assert res["tokens"] == want
+        st = ms.engine.stats()
+        assert st["engine_restarts_total"] == 1
+        assert st["slots_active"] == 0 and st["queue_len"] == 0
+    finally:
+        ms.close()
+    assert ms.sanitizer is not None and not ms.sanitizer.violations, \
+        f"lock sanitizer violations: {ms.sanitizer.violations}"
