@@ -457,6 +457,9 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
                               requests=requests)
     longtail = bench_longtail(model, variables, model_name, vocab,
                               requests=requests)
+    lazy = bench_lazy_longtail(model, variables, model_name, vocab,
+                               requests=requests)
+    spill = bench_prefix_spill(model, variables, model_name, vocab)
     meshed = bench_meshed(model, variables, model_name, vocab,
                           shapes, n_slots=n_slots, n_short=n_short,
                           n_long=n_long, requests=requests)
@@ -495,6 +498,8 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         **chaos,
         **overload,
         **longtail,
+        **lazy,
+        **spill,
         **meshed,
         **prefix,
     }
@@ -1347,6 +1352,358 @@ def bench_longtail(model, variables, model_name: str, vocab: int, *,
     return {"longtail": {**out, "paged_vs_fixed": ab}}
 
 
+def bench_lazy_longtail(model, variables, model_name: str,
+                        vocab: int, *, requests: int):
+    """LAZY-GROWTH leg (PR 12 tentpole a): lazy vs full page
+    reservation at EQUAL device KV budget on a SHORT-OUTPUT mix.
+
+    Real traffic declares big budgets and stops early; full
+    reservation pays the whole budget in pages at admission, so
+    reserved-but-dead pages pin concurrency.  The mix here makes
+    that explicit: every request declares ``budget`` new tokens but
+    carries an ``eos_id`` learned from an untimed PROBE of its own
+    greedy continuation (the token at its target output length), so
+    it deterministically stops at ~1/3 to ~1/6 of budget — identical
+    tokens on both arms, so the A/B compares the RESERVATION POLICY
+    only.  Criterion: lazy >= 1.2x mean residents AND >= 1.2x
+    aggregate tok/s (decoded tokens, not budget-padded), with ZERO
+    timed compile-cache misses on both arms."""
+    import dataclasses
+
+    import numpy as np
+
+    from polyaxon_tpu.serving import ModelServer, make_server
+
+    # Serving-headroom rebuild, same rationale as bench_longtail.
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and getattr(cfg, "max_position", 0) < 1024 \
+            and not getattr(cfg, "kv_cache_ring", False) \
+            and dataclasses.is_dataclass(cfg):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = dataclasses.replace(cfg, max_position=1024)
+        model = type(model)(cfg=cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+    page_tokens = 64
+    n_slots = 12
+    budget = 192                      # declared (reserved) budget
+    pages = 18                        # full reservation: prompt +
+    #                                   budget = 4 pages/request ->
+    #                                   ~4 concurrent; lazy: usage-
+    #                                   bounded -> slot-cap 12
+    n_clients = 12
+    per_client = max(3, requests // 2)
+    rng = np.random.RandomState(23)
+    sched = []                        # (prompt tokens, target len)
+    for _ in range(n_clients):
+        pairs = []
+        for _ in range(per_client):
+            p = int(rng.choice([32, 64]))
+            tgt = int(rng.choice([16, 32, 64]))
+            pairs.append((rng.randint(0, vocab, size=p).tolist(),
+                          tgt))
+        sched.append(pairs)
+
+    def run_clients(base, eos_map, timed):
+        done = [0, 0]
+        lock = threading.Lock()
+        errors = []
+
+        def client(i):
+            for j, (toks, tgt) in enumerate(sched[i]):
+                if timed:
+                    body = {"prompt": toks, "max_new_tokens": budget,
+                            "eos_id": eos_map[(i, j)]}
+                else:
+                    body = {"prompt": toks, "max_new_tokens": tgt}
+                try:
+                    r = _post(base, body, timeout=900)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{type(e).__name__}: {e}")
+                    return
+                if timed:
+                    # decoded tokens = up to and incl. the first eos
+                    # (the response pads to budget with eos)
+                    row = r["new_tokens"][0]
+                    eos = eos_map[(i, j)]
+                    n = row.index(eos) + 1 if eos in row else len(row)
+                else:
+                    row = r["new_tokens"][0]
+                    eos_map[(i, j)] = row[-1]
+                    n = len(row)
+                with lock:
+                    done[0] += 1
+                    done[1] += n
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return done[0], done[1], time.perf_counter() - t0, errors
+
+    out = {}
+    for arm in ("full", "lazy"):
+        ms = ModelServer(model, variables, model_name=model_name,
+                         max_batch=4, batching="continuous",
+                         n_slots=n_slots,
+                         queue_depth=8 * n_clients, prefix_cache=0,
+                         kv_paged=True, kv_page_tokens=page_tokens,
+                         kv_pages=pages, kv_lazy=(arm == "lazy"))
+        srv = make_server("127.0.0.1", 0, ms)
+        threading.Thread(target=srv.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        stop_poll = threading.Event()
+        occ = []
+
+        def poll(ms=ms, stop=stop_poll, occ=occ):
+            while not stop.wait(0.1):
+                es = ms.engine.stats()
+                occ.append((es["slots_active"],
+                            es.get("kv_pages_resident", 0)))
+
+        try:
+            eos_map = {}
+            # PROBE pass (untimed): learns each request's eos AND
+            # warms the prompt/window programs.
+            _, _, _, errors = run_clients(base, eos_map, False)
+            if errors:
+                print(f"# lazy-longtail probe arm={arm} errors: "
+                      f"{errors[:3]}", file=sys.stderr)
+                return {}
+            # Warm the preempt-resume program set: pow2 pfill +
+            # extend pieces (an exhaustion preempt's re-prefill is a
+            # pow2 decomposition whose piece lengths must all be
+            # warm before the timed run).
+            L = 1
+            while 2 * L <= 256:
+                warm = np.random.RandomState(L).randint(
+                    0, vocab, size=2 * L).tolist()
+                _post(base, {"prompt": warm, "max_new_tokens": 1,
+                             "prefill_chunk": L}, timeout=900)
+                L *= 2
+            # TWO untimed passes of the TIMED schedule: warms the
+            # lazy pad classes, growth path, and exhaustion-preempt
+            # interleavings — two, because admission interleavings
+            # differ run to run and one pass can skip a (window,
+            # pad-class) combo the timed leg then hits (same
+            # rationale as the longtail leg).
+            run_clients(base, eos_map, True)
+            run_clients(base, eos_map, True)
+            pre = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            poller = threading.Thread(target=poll, daemon=True)
+            poller.start()
+            n_done, toks, wall, errors = run_clients(base, eos_map,
+                                                     True)
+            stop_poll.set()
+            poller.join()
+            if errors:
+                print(f"# lazy-longtail arm={arm} errors: "
+                      f"{errors[:3]}", file=sys.stderr)
+                return {}
+            info = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            out[arm] = {
+                "requests": n_done,
+                "agg_tok_per_sec": round(toks / wall, 1),
+                "decoded_tokens": toks,
+                "declared_budget": budget,
+                "mean_resident_requests": round(
+                    sum(o[0] for o in occ) / max(1, len(occ)), 2),
+                "mean_pages_resident": round(
+                    sum(o[1] for o in occ) / max(1, len(occ)), 1),
+                "kv_pages": pages,
+                "kv_budget_tokens": pages * page_tokens,
+                "compile_cache_misses_during": info.get(
+                    "compile_cache_misses", 0)
+                - pre.get("compile_cache_misses", 0),
+                "lazy_growths": info.get(
+                    "kv_pages_lazy_growths_total", 0),
+                "exhaustion_preempts": info.get(
+                    "kv_preempt_exhaustion_total", 0),
+            }
+        finally:
+            stop_poll.set()
+            srv.shutdown()
+            srv.server_close()
+            ms.close()
+    if len(out) < 2:
+        return {}
+    ab = {
+        "tok_per_sec_speedup": round(
+            out["lazy"]["agg_tok_per_sec"]
+            / max(0.01, out["full"]["agg_tok_per_sec"]), 3),
+        "occupancy_ratio": round(
+            out["lazy"]["mean_resident_requests"]
+            / max(0.01, out["full"]["mean_resident_requests"]), 3),
+    }
+    print(f"# lazy-longtail: lazy {out['lazy']['agg_tok_per_sec']} "
+          f"vs full {out['full']['agg_tok_per_sec']} tok/s "
+          f"({ab['tok_per_sec_speedup']}x) at equal page budget; "
+          f"mean residents "
+          f"{out['lazy']['mean_resident_requests']} vs "
+          f"{out['full']['mean_resident_requests']} "
+          f"({ab['occupancy_ratio']}x); "
+          f"{out['lazy']['exhaustion_preempts']} exhaustion "
+          f"preempts, {out['lazy']['lazy_growths']} growths",
+          file=sys.stderr)
+    return {"lazy_longtail": {**out, "lazy_vs_full": ab}}
+
+
+def bench_prefix_spill(model, variables, model_name: str,
+                       vocab: int):
+    """SPILL leg (PR 12 tentpole b): hit-rate x TTFT on a prefix
+    population sized ~4x the device page pool, host-RAM spill tier
+    vs the PR 7 drop-on-evict baseline.
+
+    Each arm registers N prefixes (N x pages-per-prefix >= 4x pool),
+    then round-robins hit traffic over all of them.  The drop arm
+    retains only the prefixes whose pages still fit the device pool
+    (the rest re-prefill from scratch); the spill arm serves the
+    whole population — device tier or re-materialized from host RAM
+    — so its hit-rate multiplies by the host/HBM ratio while the
+    spilled-hit TTFT stays bounded (device_put of the payload vs a
+    full prefill forward)."""
+    import dataclasses
+
+    import numpy as np
+
+    from polyaxon_tpu.serving import ModelServer, make_server
+
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and getattr(cfg, "max_position", 0) < 1024 \
+            and not getattr(cfg, "kv_cache_ring", False) \
+            and dataclasses.is_dataclass(cfg):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = dataclasses.replace(cfg, max_position=1024)
+        model = type(model)(cfg=cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+    page_tokens = 64
+    pages = 24                        # device pool: 1536 tokens
+    prefix_tokens = 256               # 4 pages per prefix
+    n_prefixes = 24                   # population = 96 pages = 4x
+    rounds = 2
+    rng = np.random.RandomState(31)
+    population = [rng.randint(0, vocab,
+                              size=prefix_tokens).tolist()
+                  for _ in range(n_prefixes)]
+    out = {}
+    for arm, spill in (("drop", 0), ("spill", 256 << 20)):
+        ms = ModelServer(model, variables, model_name=model_name,
+                         max_batch=4, batching="continuous",
+                         n_slots=4, queue_depth=64,
+                         prefix_cache=2 * n_prefixes,
+                         kv_paged=True, kv_page_tokens=page_tokens,
+                         kv_pages=pages,
+                         kv_host_spill_bytes=spill)
+        srv = make_server("127.0.0.1", 0, ms)
+        threading.Thread(target=srv.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            # Register the population: page pressure during the
+            # later registrations evicts the earlier entries from
+            # the device tier (spilling or dropping per arm).
+            for p in population:
+                req = urllib.request.Request(
+                    base + "/prefill",
+                    data=json.dumps({"prompt": p}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=900) as r:
+                    r.read()
+            # Warm the hit path's programs (extend + decode) on one
+            # prefix, untimed.
+            _post(base, {"prompt": population[0] + [7, 8],
+                         "max_new_tokens": 16, "timings": True},
+                  timeout=900)
+            pre = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            hit_ttfts, miss_ttfts = [], []
+            n_req = 0
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                for i, p in enumerate(population):
+                    r = _post(base, {"prompt": p + [11 + i % 7,
+                                                    3 + i % 5],
+                                     "max_new_tokens": 16,
+                                     "timings": True}, timeout=900)
+                    n_req += 1
+                    ttft = r.get("timings", {}).get("ttft_ms")
+                    if r.get("prefix_hit_len", 0) >= prefix_tokens:
+                        hit_ttfts.append(ttft)
+                    else:
+                        miss_ttfts.append(ttft)
+            wall = time.perf_counter() - t0
+            info = json.loads(urllib.request.urlopen(
+                base + "/info", timeout=30).read())
+            hits = info.get("prefix_hits", 0) \
+                - pre.get("prefix_hits", 0)
+            row = {
+                "requests": n_req,
+                "population_prefixes": n_prefixes,
+                "population_pages": n_prefixes
+                * (prefix_tokens // page_tokens),
+                "kv_pages": pages,
+                "hit_rate": round(len(hit_ttfts) / n_req, 3),
+                "prefix_hits": hits,
+                "wall_s": round(wall, 3),
+                # ttft_ms values are ALREADY milliseconds
+                "hit_ttft_p50_ms": round(percentile(hit_ttfts, 50), 3)
+                if hit_ttfts else None,
+                "hit_ttft_p95_ms": round(percentile(hit_ttfts, 95), 3)
+                if hit_ttfts else None,
+                "miss_ttft_p50_ms": round(percentile(miss_ttfts, 50),
+                                          3)
+                if miss_ttfts else None,
+                "rematerialize_hits": info.get(
+                    "kv_rematerialize_hits_total", 0),
+                "rematerialize_mb": round(info.get(
+                    "kv_rematerialize_bytes_total", 0) / 2**20, 2),
+                "kv_host_entries": info.get("kv_host_entries", 0),
+                "kv_host_mb": round(info.get(
+                    "kv_host_spill_bytes", 0) / 2**20, 2),
+            }
+            out[arm] = row
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            ms.close()
+    if len(out) < 2:
+        return {}
+    ab = {
+        "hit_rate_gain": round(
+            out["spill"]["hit_rate"]
+            / max(0.001, out["drop"]["hit_rate"]), 2),
+        # Spilled-hit TTFT bound: a re-materialized hit must beat a
+        # full re-prefill (the drop arm's miss), or the tier buys
+        # nothing.
+        "spill_hit_ttft_vs_drop_miss": round(
+            (out["spill"]["hit_ttft_p50_ms"] or 0)
+            / max(0.001, out["drop"]["miss_ttft_p50_ms"] or 0.001),
+            3) if out["drop"]["miss_ttft_p50_ms"] else None,
+    }
+    print(f"# prefix-spill: hit-rate {out['spill']['hit_rate']} "
+          f"(spill) vs {out['drop']['hit_rate']} (drop) = "
+          f"{ab['hit_rate_gain']}x on a "
+          f"{out['spill']['population_pages']}-page population over "
+          f"a {pages}-page pool; spilled-hit TTFT p50 "
+          f"{out['spill']['hit_ttft_p50_ms']}ms vs drop-miss p50 "
+          f"{out['drop']['miss_ttft_p50_ms']}ms "
+          f"({out['spill']['rematerialize_hits']} re-"
+          f"materializations, {out['spill']['kv_host_mb']} MB host)",
+          file=sys.stderr)
+    return {"prefix_spill": {**out, "spill_vs_drop": ab}}
+
+
 def bench_recorder_overhead(model, variables, model_name: str,
                             vocab: int, shapes, *, n_slots: int,
                             n_short: int, n_long: int,
@@ -1703,6 +2060,8 @@ def main() -> int:
             or "chaos" not in r \
             or "overload" not in r \
             or "longtail" not in r \
+            or "lazy_longtail" not in r \
+            or "prefix_spill" not in r \
             or ("meshed" not in r and "meshed_skipped" not in r):
         row["partial"] = True
     print(json.dumps(row))

@@ -132,6 +132,36 @@ def paged_value(r):
     return f"{v}x" + (f" (occ {occ}x)" if occ is not None else "")
 
 
+def lazy_value(r):
+    """serving-load rows: the LAZY-GROWTH leg's headline — lazy vs
+    full page reservation aggregate tok/s at equal page budget on
+    the short-output mix, with the mean-resident ratio and the
+    exhaustion-preempt count.  Empty for every other bench."""
+    leg = r.get("lazy_longtail") or {}
+    ab = leg.get("lazy_vs_full") or {}
+    v = ab.get("tok_per_sec_speedup")
+    if not v:
+        return ""
+    occ = ab.get("occupancy_ratio")
+    px = (leg.get("lazy") or {}).get("exhaustion_preempts")
+    return (f"{v}x" + (f" (occ {occ}x" if occ is not None else "(")
+            + (f", {px}px)" if px is not None else ")"))
+
+
+def spill_value(r):
+    """serving-load rows: the PREFIX-SPILL leg's headline — host-
+    tier hit-rate vs the drop-on-evict baseline on a population ~4x
+    the device pool, with the spilled-hit TTFT p50.  Empty for every
+    other bench."""
+    leg = r.get("prefix_spill") or {}
+    sp = leg.get("spill") or {}
+    dr = leg.get("drop") or {}
+    if not sp:
+        return ""
+    return (f"hit {sp.get('hit_rate')} vs {dr.get('hit_rate')}; "
+            f"ttft {sp.get('hit_ttft_p50_ms')}ms")
+
+
 def meshed_value(r):
     """serving-load rows: the MESHED leg's headline — token parity +
     timed-recompile health of the tp=4 arm vs tp=1 (the host-device
@@ -223,10 +253,10 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | paged | mesh | telemetry | recorder | debug "
-          "| chaos | overload | mfu | age |")
+          "| spec-mix | paged | lazy | spill | mesh | telemetry "
+          "| recorder | debug | chaos | overload | mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|---|")
+          "---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -244,6 +274,8 @@ def main() -> int:
               f"| {v if v is not None else ''} | {unit} "
               f"| {spec_mix_value(r)} "
               f"| {paged_value(r)} "
+              f"| {lazy_value(r)} "
+              f"| {spill_value(r)} "
               f"| {meshed_value(r)} "
               f"| {telemetry_value(r)} "
               f"| {recorder_value(r)} "

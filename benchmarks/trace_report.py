@@ -124,6 +124,33 @@ def engine_stats(events, strip_buckets: int = 20):
             "." if not b else str(min(9, round(
                 9 * (sum(b) / len(b)) / pages_total)))
             for b in pbuckets)
+        # Lazy-KV tier events (PR 12): growth (``kv_grow``) and
+        # exhaustion-preempt (``kv_preempt``) instants on the engine
+        # track, rendered as a marker strip ALIGNED UNDER the page
+        # strip — '.' nothing, 'g' growth(s), 'P' preempt(s), 'B'
+        # both in that bucket — so "when did the pool fill, and what
+        # did it cost" reads off one block.
+        marks = [ev for ev in events
+                 if ev.get("pid") == ENGINE_PID
+                 and ev.get("ph") == "i"
+                 and ev.get("name") in ("kv_grow", "kv_preempt")]
+        if marks:
+            mb = [set() for _ in range(strip_buckets)]
+            for ev in marks:
+                i = min(strip_buckets - 1,
+                        max(0, int((ev["ts"] - t_lo) / span_us
+                                   * strip_buckets)))
+                mb[i].add(ev["name"])
+            sym = {frozenset(): ".",
+                   frozenset({"kv_grow"}): "g",
+                   frozenset({"kv_preempt"}): "P",
+                   frozenset({"kv_grow", "kv_preempt"}): "B"}
+            out["kv_growth_preempt_strip"] = "".join(
+                sym[frozenset(s)] for s in mb)
+            out["kv_lazy_growths"] = sum(
+                1 for ev in marks if ev["name"] == "kv_grow")
+            out["kv_exhaustion_preempts"] = sum(
+                1 for ev in marks if ev["name"] == "kv_preempt")
     kinds = {}
     for a in args:
         kinds[a.get("kind", "?")] = kinds.get(a.get("kind", "?"),
@@ -349,6 +376,13 @@ def main() -> int:
         print(f"KV pages: mean {eng['mean_pages_used']} of "
               f"{eng['kv_pages_total']} in use; over time (0-9): "
               f"[{eng['page_occupancy_strip']}]")
+        if "kv_growth_preempt_strip" in eng:
+            # Aligned under the page strip: g = lazy growth(s), P =
+            # exhaustion preempt(s), B = both in that bucket.
+            print(f"lazy tier: {eng['kv_lazy_growths']} growths, "
+                  f"{eng['kv_exhaustion_preempts']} exhaustion "
+                  f"preempts (g/P/B):          "
+                  f"[{eng['kv_growth_preempt_strip']}]")
     att = s.get("attribution")
     if att is not None:
         note = []

@@ -478,6 +478,23 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                    "(default: the fixed-lane footprint, slots x "
                    "ceil(max_position / page size) — same memory, "
                    "paged layout).")
+@click.option("--kv-lazy", is_flag=True, default=False,
+              help="With --kv-paged: LAZY page reservation — "
+                   "admission reserves prompt + one decode window "
+                   "instead of the full budget, slots grow their "
+                   "page tables at step boundaries, and pool "
+                   "exhaustion preempts the resident with the most "
+                   "remaining budget (token-identical resume).  "
+                   "Packs more residents when outputs run short of "
+                   "budget.")
+@click.option("--kv-host-spill-bytes", default=0, type=int,
+              help="With --kv-paged: host-RAM byte budget for the "
+                   "prefix store's SPILL tier — entries evicted from "
+                   "device pages under pressure spill their payloads "
+                   "to host buffers instead of dropping; a hit "
+                   "re-materializes via device_put (and promotes "
+                   "back to pages when the pool has room).  0 "
+                   "(default) keeps the drop-on-evict behavior.")
 @click.option("--default-priority", default="interactive",
               type=click.Choice(["interactive", "batch"]),
               help="Priority class for requests that don't declare "
@@ -601,6 +618,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_ring, kv_ring_slack, prefix_cache, max_batch, batching,
           n_slots, queue_depth, prefill_chunk, decode_window,
           mesh_arg, kv_paged, kv_page_tokens, kv_pages,
+          kv_lazy, kv_host_spill_bytes,
           default_priority, batch_queue_depth, queue_deadline_ms,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
@@ -708,6 +726,17 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         raise click.ClickException(
             "--kv-paged requires --batching continuous (paging is "
             "the engine's slot storage)")
+    if kv_lazy and not kv_paged:
+        raise click.ClickException(
+            "--kv-lazy requires --kv-paged (lazy growth is a page-"
+            "reservation policy)")
+    if kv_host_spill_bytes < 0:
+        raise click.ClickException(
+            "--kv-host-spill-bytes must be >= 0")
+    if kv_host_spill_bytes and not kv_paged:
+        raise click.ClickException(
+            "--kv-host-spill-bytes requires --kv-paged (the host "
+            "tier spills page-pool payloads)")
     mesh_spec = None
     if mesh_arg is not None:
         # Parse BEFORE the model build (fail-fast contract): a typo'd
@@ -756,6 +785,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          kv_paged=kv_paged,
                          kv_page_tokens=kv_page_tokens,
                          kv_pages=kv_pages,
+                         kv_lazy=kv_lazy,
+                         kv_host_spill_bytes=kv_host_spill_bytes,
                          default_priority=default_priority,
                          batch_queue_depth=batch_queue_depth,
                          queue_deadline_s=queue_deadline_ms / 1e3
@@ -787,6 +818,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                                **({"kv_ring": True} if kv_ring else {}),
                                **({"kv_page_tokens": kv_page_tokens}
                                   if kv_paged else {}),
+                               **({"kv_lazy_mode": True}
+                                  if kv_lazy else {}),
                                **({"draft_model": draft_model}
                                   if draft_model else {})})
     except MeshError as e:

@@ -427,8 +427,20 @@ def prefetch_to_device(batches: Iterator[Dict[str, np.ndarray]],
     The host->device copy of batch t+1 overlaps the device compute of
     batch t; ``depth`` bounds staged HBM.  With sharding=None batches
     pass through un-transferred (jit will place them).
+
+    CPU backend: the worker passes batches through UN-TRANSFERRED
+    whatever ``sharding`` says.  There is no HBM to stage into — a
+    host->"device" copy on CPU is the same RAM, so the "overlap" buys
+    nothing — while a second thread's ``device_put`` racing
+    main-thread compilation/execution is exactly the kind of
+    concurrent client use some jaxlib CPU builds handle poorly.  jit
+    places the host arrays exactly as it would have placed the
+    staged ones, so tokens/metrics are unchanged.
     """
     import jax
+
+    if sharding is not None and jax.default_backend() == "cpu":
+        sharding = None
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()

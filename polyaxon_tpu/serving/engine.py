@@ -165,6 +165,7 @@ class DecodeEngine:
                 max_position=max_pos,
                 decode_window=self.policy.decode_window,
                 spec_k_cap=self.policy.spec_k_cap,
+                lazy=self.policy.kv_lazy,
                 draft_model=draft_model,
                 draft_variables=self.draft_variables,
                 sentinel=sentinel, mesh=mesh)
@@ -242,6 +243,14 @@ class DecodeEngine:
         # fit the pool (503 reason kv_pages) — a sizing signal, kept
         # separate from queue-deadline/draining sheds.
         self.shed_kv_pages_total = 0
+        # LAZY-KV exhaustion preemptions (engine._ensure_lazy_growth):
+        # a resident evicted mid-decode because a co-tenant's page
+        # growth found the pool empty — the concurrency-vs-memory
+        # trade the --kv-lazy mode makes explicit.  ``_exhaust_bars``
+        # holds the evictees whose re-admission is barred until the
+        # blocked growth completes (the livelock guard).
+        self.kv_preempt_exhaustion_total = 0
+        self._exhaust_bars: list = []
         self.preempted_total = 0
         self.resumed_total = 0
         self.admitted_by_class = {p: 0 for p in PRIORITIES}
@@ -747,7 +756,7 @@ class DecodeEngine:
         budget = self.policy.prefill_budget(bool(self._resident),
                                             self.slots.free_slots)
         while budget > 0:
-            stream = self.queue.head()
+            stream = self._queue_head()
             if stream is None:
                 break
             if stream.group.error is not None:
@@ -807,18 +816,66 @@ class DecodeEngine:
             stream.kv_shared = None
             stream.kv_epoch = None
 
+    def _kv_admit_tokens(self, stream: Stream) -> int:
+        """The token span admission must have pages for: the full
+        budget (default reservation discipline), or — lazy — the
+        stream's current committed length plus one dispatch span
+        (serving/paged.py admit_tokens; the rest grows at step
+        boundaries)."""
+        need = self._kv_tokens_needed(stream.p_len, stream.new)
+        if getattr(self.slots, "lazy", False):
+            return self.slots.admit_tokens(
+                stream.p_len + max(1, len(stream.out)), need)
+        return need
+
+    def _stream_barred(self, stream: Stream) -> bool:
+        """Lazy-KV livelock guard: an exhaustion evictee is NOT
+        admissible while the stream it was evicted for still waits
+        for the freed capacity.  ``Stream.evicted_for`` is set by
+        _ensure_lazy_growth and cleared the moment a growth pass
+        completes (the beneficiary got its pages), so the bar
+        normally lasts exactly one boundary — long enough that the
+        T+1 admission (which runs BEFORE the T+1 growth) cannot hand
+        the freed pages back to the very stream whose eviction freed
+        them.  Also cleared when the beneficiary goes terminal, and
+        when the pool has NO residents (no growth can be pending
+        without a resident, so a lingering bar would deadlock an
+        idle engine).  Engine thread only."""
+        b = stream.evicted_for
+        if b is None:
+            return False
+        if b.group.event.is_set() or not self._resident:
+            stream.evicted_for = None
+            return False
+        return True
+
+    def _queue_head(self) -> Optional[Stream]:
+        """Admission head: the class-aware queue head, SKIPPING
+        streams under an active exhaustion bar — a barred evictee
+        (possibly of a higher class) must never head-of-line-block
+        the stream it was evicted for."""
+        head = self.queue.head()
+        if head is None or not self._stream_barred(head):
+            return head
+        for s in self.queue.snapshot():
+            if not self._stream_barred(s):
+                return s
+        return None
+
     def _admissible_now(self, stream: Stream) -> bool:
         """Pure check (no reclaim side effects — _pick_window calls
         this every boundary): a free slot AND, paged, enough free
         pages for the stream's reservation net of its shared prefix
-        pages."""
+        pages (and, lazy, no active exhaustion bar)."""
         self._validate_shared_epoch(stream)
+        if self._stream_barred(stream):
+            return False
         if self.slots.free_slots == 0:
             return False
         if not self.paged:
             return True
         return self.slots.can_admit(
-            self._kv_tokens_needed(stream.p_len, stream.new),
+            self._kv_admit_tokens(stream),
             len(stream.kv_shared or ()))
 
     def _can_admit_stream(self, stream: Stream) -> bool:
@@ -830,9 +887,10 @@ class DecodeEngine:
         never starve live traffic."""
         if self._admissible_now(stream):
             return True
-        if self.slots.free_slots == 0 or not self.paged:
+        if self._stream_barred(stream) or self.slots.free_slots == 0 \
+                or not self.paged:
             return False
-        need = self._kv_tokens_needed(stream.p_len, stream.new)
+        need = self._kv_admit_tokens(stream)
         n_shared = len(stream.kv_shared or ())
         if self.page_reclaim is not None:
             # The hook's contract is "make this many pages FREE" (it
@@ -891,7 +949,7 @@ class DecodeEngine:
             args["on"] = "kv_pages"
             args["pages_free"] = self.slots.free_page_count()
             args["pages_needed"] = self.slots.pages_needed(
-                self._kv_tokens_needed(stream.p_len, stream.new)) \
+                self._kv_admit_tokens(stream)) \
                 - len(stream.kv_shared or ())
         self._emit_instant(stream, "admit_blocked", now,
                            row=stream.row, **args)
@@ -1017,7 +1075,8 @@ class DecodeEngine:
         slo = self.policy.slo_ttft_s
         if slo is None or self.slots.free_slots > 0:
             return False
-        head = self.queue.head()
+        head = self._queue_head()   # bar-aware: never preempt FOR a
+        #                             barred exhaustion evictee
         if head is None or head.group.priority != "interactive" \
                 or not head.pf_done:
             return False
@@ -1063,15 +1122,22 @@ class DecodeEngine:
 
     def _evict_requeue(self, slot: int, stream: Stream, why: str,
                        now: float, *, release: bool = True,
+                       front: bool = True,
                        **instant_args) -> None:
-        """Evict a RESIDENT stream and requeue it at the front of its
-        class for token-identical resume — the one path every
-        requeue flavor (SLO preemption, quarantine bisection, crash
-        recovery) shares, because the safety argument is one
-        argument: resume re-prefills ``prompt ++ out[:-1]`` in pow2
-        pieces (bounded program set, steady-state quiet) and re-enters
-        feeding ``out[-1]`` with ``next_index == len(out)``, so no
-        token is ever resampled (Stream.prepare_resume).
+        """Evict a RESIDENT stream and requeue it for token-identical
+        resume — the one path every requeue flavor (SLO preemption,
+        quarantine bisection, crash recovery, lazy-KV exhaustion)
+        shares, because the safety argument is one argument: resume
+        re-prefills ``prompt ++ out[:-1]`` in pow2 pieces (bounded
+        program set, steady-state quiet) and re-enters feeding
+        ``out[-1]`` with ``next_index == len(out)``, so no token is
+        ever resampled (Stream.prepare_resume).
+
+        ``front=True`` (every flavor but exhaustion) requeues at the
+        head of the stream's class; exhaustion evictions requeue at
+        the BACK (``front=False``) — the freed pages belong to the
+        growth-blocked beneficiary and everyone already queued, not
+        to the evictee (AdmissionQueue.requeue_back).
 
         ``release=False`` skips the slot release for crash recovery,
         whose wholesale pool rebuild (slots.reset) makes per-slot
@@ -1096,7 +1162,10 @@ class DecodeEngine:
         # set bounded and steady-state quiet.
         stream.prepare_resume(SchedulerPolicy.pow2_pieces(
             stream.p_len + len(stream.out) - 1))
-        self.queue.requeue_front(stream)
+        if front:
+            self.queue.requeue_front(stream)
+        else:
+            self.queue.requeue_back(stream)
         self.requests_requeued_total += 1
 
     def mean_resident_position(self) -> float:
@@ -1153,6 +1222,9 @@ class DecodeEngine:
         # convicting more innocents; any successful dispatch resets
         # it.)
         self._suspects.clear()
+        # Exhaustion bars die with the pool generation: the rebuilt
+        # all-free pool has no pending growth to protect.
+        self._exhaust_bars.clear()
         n = 0
         for slot, stream in sorted(list(self._resident.items())):
             self._evict_requeue(slot, stream, "crash_requeued", now,
@@ -1160,6 +1232,7 @@ class DecodeEngine:
             n += 1
         for stream in self.queue.snapshot():
             stream.kv_shared = None
+            stream.evicted_for = None
             if stream.filled or stream.cache is not None \
                     or stream.pf_done:
                 stream.pieces = SchedulerPolicy.pow2_pieces(
@@ -1403,6 +1476,8 @@ class DecodeEngine:
         slot = self.slots.acquire()
         assert slot is not None, "admission without a free slot"
         stream.last_slot = slot
+        stream.evicted_for = None    # an admitted stream carries no
+        #                              exhaustion bar
         spec = stream.sampling
         resumed = stream.resume
         if not resumed:
@@ -1786,6 +1861,114 @@ class DecodeEngine:
             f"and was quarantined (co-tenants resumed unaffected): "
             f"{type(err).__name__}: {err}"))
 
+    def _engine_instant(self, name: str, t: float, **args) -> None:
+        """One instant on the ENGINE trace track (growth/preempt
+        markers for the trace_report page strip) — same isolation
+        contract as _emit: a broken ring is counted, never raised."""
+        try:
+            self.tel.instant(0, name, t, pid=ENGINE_PID, **args)
+        except Exception:
+            self.telemetry_errors_total += 1
+
+    def _ensure_lazy_growth(self, span: int) -> bool:
+        """LAZY-KV step-boundary growth: before a dispatch that will
+        write ``span`` positions per resident slot, make sure every
+        resident's page table covers its writes
+        (PagedSlotKVManager.grow_slot, capped at each slot's full
+        budget).  On POOL EXHAUSTION, preempt the resident with the
+        most remaining budget — the longest expected page hold —
+        through the shared ``_evict_requeue`` path (token-identical
+        resume) and retry, until every survivor can grow.  Returns
+        False when the boundary was consumed by evictions (resident
+        set mutated or emptied; the next tick re-plans).
+
+        LIVELOCK-FREE by two rules: (1) exhaustion evictees requeue
+        at the BACK of their class (never ahead of anything already
+        waiting, the blocked beneficiary included), and (2) each
+        evictee carries ``evicted_for`` — the growth-blocked stream
+        its eviction served — and the admission gate skips it until
+        the next EVICTION-FREE growth pass completes, so the freed
+        pages cannot be stolen back at the very next boundary's
+        admission (which runs before that boundary's growth) by the
+        stream whose eviction freed them.  Each failed round evicts exactly one
+        resident, so the loop is bounded by the resident count — and
+        the submit-time can-never-fit shed guarantees a sole
+        resident's growth always fits, so a growth-blocked stream
+        eventually wins."""
+        evicted_any = False
+        while True:
+            blocked = None
+            for slot, stream in sorted(self._resident.items()):
+                budget = self._kv_tokens_needed(stream.p_len,
+                                                stream.new)
+                need = min(budget,
+                           int(self.slots.positions[slot]) + span)
+                grown = self.slots.grow_slot(slot, need)
+                if grown is None and self.page_reclaim is not None:
+                    # STORED-BUT-IDLE prefix pages yield before any
+                    # LIVE resident does: ask the owner's reclaim
+                    # hook (prefix-store spill/eviction) to free the
+                    # blocked growth's deficit, exactly as the
+                    # admission gate does — preempting a resident
+                    # while reclaimable cache pages sit idle would
+                    # invert the tier order (and a SOLE resident
+                    # could self-evict into a re-prefill spin).
+                    try:
+                        self.page_reclaim(
+                            self.slots.grow_need(slot, need))
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).debug(
+                            "page_reclaim hook failed during lazy "
+                            "growth", exc_info=True)
+                    grown = self.slots.grow_slot(slot, need)
+                if grown is None:
+                    blocked = (slot, stream)
+                    break
+                if grown:
+                    self._engine_instant(
+                        "kv_grow", time.perf_counter(), slot=slot,
+                        pages=grown, rid=stream.group.rid)
+            if blocked is None:
+                # Bars clear only on a pass that succeeded WITHOUT
+                # evictions: the pass that evicted must leave its
+                # bars standing across the next boundary's ADMISSION
+                # (which runs before the next growth), or the freed
+                # pages could be handed right back to the evictee.
+                if not evicted_any and self._exhaust_bars:
+                    for v in self._exhaust_bars:
+                        v.evicted_for = None
+                    self._exhaust_bars.clear()
+                return not evicted_any
+            now = time.perf_counter()
+            _bslot, bstream = blocked
+            victim = None
+            for slot, stream in self._resident.items():
+                rem = stream.new - len(stream.out)
+                if victim is None or rem > victim[2]:
+                    victim = (slot, stream, rem)
+            slot, stream, _rem = victim
+            self.kv_preempt_exhaustion_total += 1
+            self.preempted_total += 1
+            stream.preempts += 1
+            self._engine_instant("kv_preempt", now, slot=slot,
+                                 rid=stream.group.rid,
+                                 blocked_rid=bstream.group.rid)
+            self._evict_requeue(slot, stream, "preempted", now,
+                                front=False,
+                                reason="kv_pages_exhausted",
+                                blocked_rid=bstream.group.rid)
+            if stream is not bstream:
+                # The victim must not re-admit ahead of the stream
+                # it was evicted for (a self-eviction has no
+                # beneficiary to bar against).
+                stream.evicted_for = bstream
+                self._exhaust_bars.append(stream)
+            evicted_any = True
+            if not self._resident:
+                return False
+
     def _decode_step(self) -> None:
         """Advance every resident stream by one fused window of decode
         steps; evict finished streams so their slots are admissible
@@ -1805,6 +1988,12 @@ class DecodeEngine:
                    if s.sampling.speculative]
         if spec_ks:
             self._decode_step_spec(window, max(spec_ks))
+            return
+        if self.paged and self.slots.lazy \
+                and not self._ensure_lazy_growth(window):
+            # Exhaustion preemptions consumed this boundary (the
+            # resident set mutated); the next tick re-plans with the
+            # survivors' grown tables.
             return
         sampled = any(s.sampling.sampled
                       for s in self._resident.values())
@@ -1866,6 +2055,12 @@ class DecodeEngine:
         tokens, and a stream stops consuming at its own eos/budget
         (later tokens are discardable garbage, exactly like the
         windowed plain step)."""
+        if self.paged and self.slots.lazy \
+                and not self._ensure_lazy_growth(window * K + 1):
+            # A spec round's verify chunk writes up to window*K+1
+            # positions past the last committed token — grow (or
+            # preempt) for the whole span before dispatch.
+            return
         occupancy = len(self._resident)
         if self.recorder is not None:
             self.recorder.on_step_start()
@@ -2172,6 +2367,12 @@ class DecodeEngine:
             "shed_batch_total": self.shed_by_class["batch"],
             "preempted_total": self.preempted_total,
             "resumed_total": self.resumed_total,
+            # Lazy-KV exhaustion preemptions (0 unless --kv-lazy):
+            # residents evicted mid-decode because a co-tenant's page
+            # growth found the pool empty (engine._ensure_lazy_growth)
+            # — a subset of preempted_total.
+            "kv_preempt_exhaustion_total":
+                self.kv_preempt_exhaustion_total,
             "admitted_interactive_total":
                 self.admitted_by_class["interactive"],
             "admitted_batch_total": self.admitted_by_class["batch"],

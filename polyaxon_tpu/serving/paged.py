@@ -41,18 +41,33 @@ per-slot SCRATCH page, and writes redirected away from shared pages
 land in a single TRASH page; both hold garbage by definition and are
 masked by position before any query could admit them.
 
-RESERVATION DISCIPLINE: admission reserves a request's FULL page need
-(prompt + budget + speculative slack) minus its shared prefix pages.
-The ISSUE's lazier "prompt + first window" admission would pack a few
-more residents but requires a mid-decode page-exhaustion preemption
-path (and its livelock policy); full reservation keeps the engine
-deadlock-free by construction — a resident can always finish — while
-still delivering the occupancy win, because reservations are sized by
-THIS request's length, not by ``max_position``.  Page exhaustion
-therefore only exists at the edges: a request that can NEVER fit the
-pool sheds 503 ``reason: kv_pages`` at submit, and one that doesn't
-fit RIGHT NOW waits admit-ready in the queue until evictions free
-pages (the admission-resume path, tests/test_paged_engine.py).
+RESERVATION DISCIPLINE (two modes):
+
+- FULL (default): admission reserves a request's FULL page need
+  (prompt + budget + speculative slack) minus its shared prefix
+  pages.  Deadlock-free by construction — a resident can always
+  finish — and spec rollback stays pure, because no mid-decode page
+  event exists.  Page exhaustion only exists at the edges: a request
+  that can NEVER fit the pool sheds 503 ``reason: kv_pages`` at
+  submit, and one that doesn't fit RIGHT NOW waits admit-ready in
+  the queue until evictions free pages (the admission-resume path,
+  tests/test_paged_engine.py).
+- LAZY (``lazy=True``, the engine's ``--kv-lazy``): admission
+  reserves only ``prompt + one dispatch span`` (the first decode
+  window plus spec slack) and slots GROW their page tables at step
+  boundaries (:meth:`grow_slot`, through ``reserve_with_epoch`` like
+  every other page grab).  On real traffic outputs run short of
+  budget, so full reservation leaves reserved-but-dead pages pinning
+  concurrency below what the pool could hold; lazy reservation packs
+  residents by what they have actually WRITTEN.  The price is a new
+  failure mode — mid-decode pool exhaustion — which the engine owns:
+  it preempts the resident with the most remaining budget through
+  the PR 6/11 ``_evict_requeue`` path (token-identical resume) until
+  the blocked growth fits, with a livelock-free re-admission policy
+  (engine._ensure_lazy_growth).  The can-NEVER-fit shed at submit is
+  unchanged (it is a capacity statement, not a reservation one), so
+  a sole resident can always grow to its full budget — lazy mode is
+  still deadlock-free.
 
 Locking: page refcounts and the free list are mutated ONLY under
 ``_page_lock`` (machine-checked by the PAGE-REF rule in
@@ -103,7 +118,7 @@ class PagedSlotKVManager:
     def __init__(self, model, variables, n_slots: int, *,
                  page_tokens: int = 64, n_pages: Optional[int] = None,
                  max_position: int, decode_window: int = 8,
-                 spec_k_cap: int = 4,
+                 spec_k_cap: int = 4, lazy: bool = False,
                  draft_model=None, draft_variables=None,
                  sentinel=None, mesh=None):
         if mesh is not None and mesh.dp > 1:
@@ -158,6 +173,15 @@ class PagedSlotKVManager:
         # dispatch can write (a spec round writes K+1 wide per round).
         self._span_cap = max(1, int(decode_window)) \
             * max(1, int(spec_k_cap)) + 1
+        # Lazy admission/growth span: the widest span THIS pool's
+        # dispatches can actually write — spec rounds only exist
+        # when a draft model does, so a plain pool's "first decode
+        # window" is decode_window tokens, not the spec worst case
+        # (which would front-load most of a short budget and erase
+        # the lazy win).
+        self._grow_span = max(1, int(decode_window)) \
+            * (max(1, int(spec_k_cap))
+               if draft_model is not None else 1) + 1
         self._n_dirty_cap = (self._span_cap - 1 + pt - 1) // pt + 1
         # Table width covers the largest possible reservation plus
         # the dirty-window margin (so d0 + n_dirty always lands
@@ -189,6 +213,16 @@ class PagedSlotKVManager:
         self._slot_pages: List[Optional[Tuple[List[int], int]]] = \
             [None] * self.n_slots           # (page ids, n shared)
         self._slot_need = np.zeros((self.n_slots,), np.int32)
+        # LAZY reservation mode (module docstring): admission
+        # reserves one dispatch span past the prompt; the engine
+        # grows tables at step boundaries (grow_slot) up to each
+        # slot's full budget (_slot_budget, in pages).  The growth
+        # counters are monotonic totals (survive reset(), like every
+        # other counter behind /metrics).
+        self.lazy = bool(lazy)
+        self._slot_budget = np.zeros((self.n_slots,), np.int32)
+        self.lazy_growths_total = 0
+        self.lazy_pages_grown_total = 0
 
         # -- device pools ---------------------------------------------
         self._pool: Optional[List[Any]] = None       # per paged leaf
@@ -210,6 +244,17 @@ class PagedSlotKVManager:
 
     def pages_needed(self, tokens: int) -> int:
         return max(1, -(-int(tokens) // self.page_tokens))
+
+    def admit_tokens(self, cur_tokens: int, total_tokens: int) -> int:
+        """Tokens a new admission must have pages for UP FRONT: the
+        full reservation (default — deadlock-free by construction),
+        or — lazy — just the request's current length plus one
+        dispatch span (the first decode window incl. spec slack),
+        the rest growing at step boundaries (grow_slot)."""
+        if not self.lazy:
+            return int(total_tokens)
+        return min(int(total_tokens),
+                   int(cur_tokens) + self._grow_span)
 
     @property
     def capacity_tokens(self) -> int:
@@ -295,6 +340,9 @@ class PagedSlotKVManager:
             "kv_pages_free": free,
             "kv_pages_resident": resident,
             "kv_pages_shared": shared,
+            "kv_lazy": self.lazy,
+            "kv_pages_lazy_growths_total": self.lazy_growths_total,
+            "kv_pages_lazy_grown_total": self.lazy_pages_grown_total,
         }
 
     def slot_page_counts(self) -> Dict[int, int]:
@@ -342,6 +390,7 @@ class PagedSlotKVManager:
             self.page_tables[s, :] = self.scratch0 + s
         self._slot_pages = [None] * self.n_slots
         self._slot_need[:] = 0
+        self._slot_budget[:] = 0
         self._pool = None
         self._draft_pool = None
         alloc_decode_state(self)
@@ -362,6 +411,7 @@ class PagedSlotKVManager:
             self.unpin(held[0])
         self.page_tables[slot, :] = self.scratch0 + slot
         self._slot_need[slot] = 0
+        self._slot_budget[slot] = 0
         self.tokens[slot] = 0
         self.positions[slot] = 0
         self.keys[slot] = 0
@@ -647,13 +697,18 @@ class PagedSlotKVManager:
         slot's decode state (identical to the fixed-lane insert).
 
         ``total_tokens`` is the request's full KV budget (prompt +
-        new tokens + speculative slack) — the reservation that makes
-        mid-decode page exhaustion impossible.  ``shared_pages`` are
+        new tokens + speculative slack).  FULL mode reserves all of
+        it — the reservation that makes mid-decode page exhaustion
+        impossible; LAZY mode reserves ``admit_tokens`` (current
+        length + one dispatch span) and records the full budget as
+        the growth cap (``_slot_budget``).  ``shared_pages`` are
         pinned prefix-page ids whose references this call TAKES
         OWNERSHIP of (released with the rest at slot release)."""
         if total_tokens is None:
             total_tokens = self.max_position
-        n_need = self.pages_needed(total_tokens)
+        n_total = self.pages_needed(total_tokens)
+        n_need = self.pages_needed(self.admit_tokens(
+            position + 1, total_tokens)) if self.lazy else n_total
         shared = list(shared_pages)
         if len(shared) > n_need:       # defensive: over-wide prefix
             self.unpin(shared[n_need:])
@@ -680,6 +735,7 @@ class PagedSlotKVManager:
         self.page_tables[slot, :len(ids)] = np.asarray(ids, np.int32)
         self._slot_pages[slot] = (ids, len(shared))
         self._slot_need[slot] = n_need
+        self._slot_budget[slot] = n_total
         self.tokens[slot] = first_token
         self.positions[slot] = position
         if base_key is not None:
@@ -691,6 +747,56 @@ class PagedSlotKVManager:
         self.top_ks[slot] = top_k
         self.top_ps[slot] = top_p
         self.spec_ks[slot] = spec_k
+
+    # -- lazy growth (engine thread, step boundaries) --------------------
+
+    def grow_need(self, slot: int, tokens: int) -> int:
+        """Pages a ``grow_slot(slot, tokens)`` would still have to
+        reserve (0 = the table already covers it) — what the
+        engine's exhaustion path feeds the page-reclaim hook before
+        preempting anyone."""
+        held = self._slot_pages[slot]
+        if held is None:
+            raise ValueError(f"grow_need of a free slot {slot}")
+        want = min(self.pages_needed(tokens),
+                   int(self._slot_budget[slot]))
+        return max(0, want - len(held[0]))
+
+    def grow_slot(self, slot: int, tokens: int) -> Optional[int]:
+        """LAZY growth at a step boundary: extend ``slot``'s table so
+        it holds pages for ``tokens`` positions, capped at the slot's
+        full budget.  Returns the number of pages grown (0 = already
+        wide enough), or None on POOL EXHAUSTION — the engine's
+        preempt-on-exhaustion path owns what happens next.  Engine
+        thread only (it mutates the slot table); the reservation
+        itself goes through ``reserve_with_epoch`` — one
+        ``_page_lock`` hold — like every other page grab, so handler
+        threads (prefix pins/stores) interleave safely.
+
+        Freshly grown pages hold garbage until the decode step writes
+        them — masked by absolute position before any query could
+        admit them, the same argument every reserved-but-unwritten
+        page already rides."""
+        held = self._slot_pages[slot]
+        if held is None:
+            raise ValueError(f"grow of a free slot {slot}")
+        ids, _n_shared = held
+        want = min(self.pages_needed(tokens),
+                   int(self._slot_budget[slot]))
+        delta = want - len(ids)
+        if delta <= 0:
+            return 0
+        fresh, _epoch = self.reserve_with_epoch(delta)
+        if fresh is None:
+            return None
+        start = len(ids)
+        ids.extend(fresh)
+        self.page_tables[slot, start:start + delta] = \
+            np.asarray(fresh, np.int32)
+        self._slot_need[slot] = len(ids)
+        self.lazy_growths_total += 1
+        self.lazy_pages_grown_total += delta
+        return delta
 
     # -- prefix materialization -----------------------------------------
 
@@ -751,6 +857,78 @@ class PagedSlotKVManager:
         with self._exact():
             return fn(self._pool, jnp.asarray(table),
                       jnp.asarray(n_tokens, np.int32))
+
+    # -- host-RAM tier (prefix-store spill / re-materialize) -------------
+    #
+    # The SANCTIONED device<->host transfer helpers for page-pool
+    # payloads (the TIER-XFER rule, analysis/rules.py): a prefix
+    # entry evicted from the device pool under page pressure spills
+    # its payload to host buffers here instead of dropping it, and a
+    # later hit re-materializes via ``device_put`` + the existing
+    # contiguous-cache plumbing.  Both are device work — callers
+    # hold the device lock — and both are OFF the decode step path
+    # (spills ride page-pressure reclaim, re-materialization rides a
+    # prefix hit's admission, never a step dispatch).
+
+    def spill_pages(self, ids: Sequence[int], n_tokens: int
+                    ) -> List[Optional[np.ndarray]]:
+        """Gather stored prefix pages into HOST buffers: one np array
+        per paged cache leaf (None for index leaves), trimmed to the
+        entry's page-aligned span so host bytes track content, not
+        ``max_position`` headroom.  Caller holds the device lock and
+        a pin on every page in ``ids``."""
+        import jax
+
+        cache = self.materialize(ids, n_tokens)
+        leaves, _ = jax.tree_util.tree_flatten(cache)
+        width = len(ids) * self.page_tokens
+        host: List[Optional[np.ndarray]] = []
+        for m, leaf in zip(self._meta, leaves):
+            if m["kind"] == "index":
+                host.append(None)
+                continue
+            a = m["pos_axis"]
+            v = jax.lax.slice_in_dim(
+                leaf, 0, min(width, leaf.shape[a]), axis=a)
+            host.append(np.asarray(jax.device_get(v)))
+        return host
+
+    def rematerialize(self, host_leaves: Sequence[Optional[np.ndarray]],
+                      n_tokens: int):
+        """Host-tier hit: ``device_put`` the spilled leaves back into
+        a CONTIGUOUS B=1 cache of the model's full creation width —
+        byte-identical to what :meth:`materialize` returns for the
+        same content, so every downstream consumer (extend programs,
+        slot insert, page promotion via ``scatter_cache``) is reused
+        unchanged.  Caller holds the device lock."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._meta is None:
+            raise RuntimeError("rematerialize() before any page "
+                               "write shaped the pool")
+        width = self.max_position
+        leaves = []
+        for m, h in zip(self._meta, host_leaves):
+            if m["kind"] == "index":
+                leaves.append(jnp.full(m["shape"],
+                                       np.int32(n_tokens), m["dtype"]))
+                continue
+            a = m["pos_axis"]
+            have = h.shape[a]
+            if have < width:
+                pad = [(0, 0)] * h.ndim
+                pad[a] = (0, width - have)
+                h = np.pad(h, pad)
+            elif have > width:
+                h = np.take(h, range(width), axis=a)
+            h = h.astype(m["dtype"], copy=False)
+            # COMMITTED placement both ways (SHARD-LEAK): replicated
+            # over the serving mesh, or pinned to the default device.
+            sh = self.mesh.replicated if self.mesh is not None \
+                else jax.devices()[0]
+            leaves.append(jax.device_put(h, sh))
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
     # -- decode steps ----------------------------------------------------
 

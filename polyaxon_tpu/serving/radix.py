@@ -19,6 +19,17 @@ multi-row prompts radix exactly like single-row ones), giving:
   never touches descendants — payload-level sharing (page refcounts)
   is the owner's concern, reported back via the evicted payloads.
 
+TWO-TIER STORE SUPPORT: the index itself is tier-agnostic (a payload
+is opaque), but the host-RAM spill tier (ModelServer, PR 12) needs
+two more primitives so an entry can be DEMOTED in place — its device
+pages spilled to pinned host buffers — instead of dropped:
+
+- :meth:`set_payload` swaps one entry's payload without touching its
+  recency position (with an identity guard, so a concurrent
+  overwrite is never clobbered by a stale demotion);
+- :meth:`remove` pops one EXACT entry (the byte-budget eviction of
+  the host tier, and the recovery flush's survivor rebuild).
+
 Thread-safety is the CALLER's: ModelServer wraps every call in its
 ``_prefix_lock`` exactly as it wrapped the flat dict.
 """
@@ -224,6 +235,53 @@ class RadixPrefixIndex:
             parent.children.pop(node.edge[:, 0].tobytes(), None)
             node = parent
         return entry
+
+    def _exact_node(self, toks: np.ndarray) -> Optional[_Node]:
+        """The node holding EXACTLY ``toks``'s entry, or None."""
+        toks = np.ascontiguousarray(np.asarray(toks, np.int32))
+        node, depth, _ = self._match_walk(toks)
+        if node is None or depth != toks.shape[1] \
+                or node.entry is None \
+                or node.entry[0].shape != toks.shape \
+                or not np.array_equal(node.entry[0], toks):
+            return None
+        return node
+
+    def set_payload(self, toks: np.ndarray, payload, *,
+                    expect=None) -> bool:
+        """Swap the payload of the EXACT entry for ``toks`` in place
+        (recency position untouched) — the tier-demotion/promotion
+        primitive.  With ``expect`` set, the swap only happens while
+        the current payload IS ``expect`` (identity), so a demotion
+        computed outside the caller's lock can never clobber an
+        entry that was overwritten meanwhile.  Returns whether the
+        swap happened."""
+        node = self._exact_node(toks)
+        if node is None:
+            return False
+        if expect is not None and node.entry[1] is not expect:
+            return False
+        node.entry = (node.entry[0], payload)
+        return True
+
+    def remove(self, toks: np.ndarray) -> Optional[Any]:
+        """Pop the EXACT entry for ``toks`` (structural pruning like
+        pop_lru; descendants untouched); returns its payload, or
+        None when not stored."""
+        node = self._exact_node(toks)
+        if node is None:
+            return None
+        key = self._key(node.entry[0])
+        self._hot.pop(key, None)
+        self._cold.pop(key, None)
+        payload = node.entry[1]
+        node.entry = None
+        while node.parent is not None and node.entry is None \
+                and not node.children:
+            parent = node.parent
+            parent.children.pop(node.edge[:, 0].tobytes(), None)
+            node = parent
+        return payload
 
     def entries(self) -> List[Tuple[np.ndarray, Any]]:
         """Every stored entry, eviction order (coldest first)."""

@@ -214,7 +214,9 @@ class EngineSupervisor:
         self._lock = threading.Lock()
         # Owner hooks run after the pool rebuild, before the restart
         # (the server flushes its paged prefix store here — stored
-        # page payloads died with the old pool).
+        # page payloads died with the old pool; HOST-TIER spilled
+        # entries reference no device state and survive the flush,
+        # docs/DESIGN.md epoch contract extension).
         self._recovery_hooks: List[Callable[[], None]] = []
         engine.supervisor = self
 

@@ -215,6 +215,7 @@ class SchedulerPolicy:
                  slo_ttft_s: Optional[float] = None,
                  kv_paged: bool = False, kv_page_tokens: int = 64,
                  kv_pages: Optional[int] = None,
+                 kv_lazy: bool = False,
                  spec_k_cap: int = 4):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1; got {n_slots}")
@@ -277,9 +278,20 @@ class SchedulerPolicy:
         if spec_k_cap < 1:
             raise ValueError(
                 f"spec_k_cap must be >= 1; got {spec_k_cap}")
+        # ``kv_lazy`` (the --kv-lazy knob): LAZY page reservation —
+        # admission reserves prompt + one dispatch span instead of
+        # the full budget, tables grow at step boundaries, and pool
+        # exhaustion preempts the resident with the most remaining
+        # budget (token-identical resume; serving/paged.py
+        # "RESERVATION DISCIPLINE").
+        if kv_lazy and not kv_paged:
+            raise ValueError(
+                "kv_lazy requires kv_paged (lazy growth is a page-"
+                "reservation policy; fixed lanes have no pages)")
         self.kv_paged = bool(kv_paged)
         self.kv_page_tokens = int(kv_page_tokens)
         self.kv_pages = int(kv_pages) if kv_pages is not None else None
+        self.kv_lazy = bool(kv_lazy)
         self.spec_k_cap = int(spec_k_cap)
 
     def class_queue_depth(self, priority: str) -> int:
@@ -349,7 +361,8 @@ class Stream:
                  "t_admit", "t_done", "d_cache", "spec_rounds",
                  "spec_drafted", "spec_accepted", "sid", "events",
                  "pf_toks", "resume", "kv_shared", "kv_epoch",
-                 "last_slot", "preempts", "resumes", "blocked_t")
+                 "last_slot", "preempts", "resumes", "blocked_t",
+                 "evicted_for")
 
     def __init__(self, group: "RequestGroup", row: int,
                  toks: np.ndarray, new: int, eos_id: Optional[int],
@@ -416,6 +429,13 @@ class Stream:
         self.preempts = 0
         self.resumes = 0
         self.blocked_t: Optional[float] = None
+        # Lazy-KV livelock guard (engine._ensure_lazy_growth): the
+        # stream this one was exhaustion-evicted FOR.  While set, the
+        # admission gate skips this stream — the freed pages must
+        # reach the growth-blocked beneficiary before its own evictee
+        # can take them back — and the engine clears it the moment a
+        # growth pass completes (or the beneficiary goes terminal).
+        self.evicted_for: Optional["Stream"] = None
 
     @property
     def p_len(self) -> int:
@@ -668,6 +688,18 @@ class AdmissionQueue:
     def requeue_front(self, stream: Stream) -> None:
         with self._lock:
             self._q[stream.group.priority].appendleft(stream)
+
+    def requeue_back(self, stream: Stream) -> None:
+        """Requeue an EXHAUSTION-evicted stream at the BACK of its
+        class (bypassing the depth bound, like requeue_front — it
+        was already admitted once, requeueing must never shed it).
+        Back, not front: the eviction freed pages for someone else
+        — everyone already waiting in the class, the growth-blocked
+        beneficiary included, goes first (the structural half of the
+        lazy-KV livelock guard; ``Stream.evicted_for`` is the
+        cross-class half)."""
+        with self._lock:
+            self._q[stream.group.priority].append(stream)
 
     def snapshot(self) -> List[Stream]:
         """Every queued stream, pop order — the lifecycle sweep's

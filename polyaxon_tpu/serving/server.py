@@ -118,6 +118,29 @@ class _PagedPrefix:
         self.logits = logits
 
 
+class _SpilledPrefix:
+    """Radix payload for a HOST-TIER prefix entry (the spill tier,
+    ``--kv-host-spill-bytes``): the stored prompt's KV lives in host
+    RAM — one np array per paged cache leaf, gathered by the
+    sanctioned ``PagedSlotKVManager.spill_pages`` helper when page
+    pressure evicted the entry from the device pool — instead of
+    being dropped.  A hit re-materializes via ``device_put``
+    (``manager.rematerialize``) and opportunistically PROMOTES back
+    to device pages.  Host buffers reference no device state, so
+    spilled entries SURVIVE a crash-recovery pool rebuild (the epoch
+    contract extension, docs/DESIGN.md)."""
+
+    __slots__ = ("leaves", "n_tokens", "logits", "nbytes")
+
+    def __init__(self, leaves, n_tokens: int, logits):
+        self.leaves = list(leaves)
+        self.n_tokens = int(n_tokens)
+        self.logits = logits            # host np copy
+        self.nbytes = int(sum(a.nbytes for a in leaves
+                              if a is not None)) \
+            + (int(logits.nbytes) if hasattr(logits, "nbytes") else 0)
+
+
 PrefixHit = collections.namedtuple(
     "PrefixHit", ["p_cached", "logits", "cache", "pins"])
 """One prefix-cache lookup result: ``p_cached`` tokens of stored
@@ -207,6 +230,8 @@ class ModelServer:
                  kv_paged: bool = False,
                  kv_page_tokens: int = 64,
                  kv_pages: Optional[int] = None,
+                 kv_lazy: bool = False,
+                 kv_host_spill_bytes: int = 0,
                  default_priority: str = "interactive",
                  batch_queue_depth: Optional[int] = None,
                  queue_deadline_s: Optional[float] = None,
@@ -355,6 +380,19 @@ class ModelServer:
                 f"(batching={self.batching!r}"
                 + (" — seq2seq models fall back to coalesce)"
                    if hasattr(model, "encode") else ")"))
+        if kv_lazy and not kv_paged:
+            raise ValueError(
+                "kv_lazy requires kv_paged (lazy growth is a page-"
+                "reservation policy; fixed lanes have no pages)")
+        if kv_host_spill_bytes < 0:
+            raise ValueError(
+                f"kv_host_spill_bytes must be >= 0; got "
+                f"{kv_host_spill_bytes}")
+        if kv_host_spill_bytes and not kv_paged:
+            raise ValueError(
+                "kv_host_spill_bytes requires kv_paged (the host "
+                "tier spills page-pool payloads; legacy prefix "
+                "entries already own independent caches)")
         # Serving mesh ("tp=4" / MeshSpec / ServingMesh): shard the
         # slot KV pools over the mesh and place params under
         # NamedSharding (serving/meshed.py — the exact layout, so
@@ -402,6 +440,7 @@ class ModelServer:
                     kv_paged=kv_paged,
                     kv_page_tokens=kv_page_tokens,
                     kv_pages=kv_pages,
+                    kv_lazy=kv_lazy,
                     spec_k_cap=self.spec_k_default),
                 device_lock=self._lock,
                 # Engine streams are single-row; share the server's
@@ -487,11 +526,30 @@ class ModelServer:
         self._prefix_store_errors = 0
         self.kv_paged = bool(self.engine is not None
                              and self.engine.paged)
+        self.kv_lazy = bool(self.kv_paged and kv_lazy)
+        # HOST-RAM SPILL TIER for the prefix store (PR 12, tentpole
+        # b): a byte budget > 0 makes page-pressure eviction DEMOTE a
+        # paged entry — payload gathered to host buffers by the
+        # sanctioned spill helper — instead of dropping it, so the
+        # shareable-prefix working set is bounded by host RAM, not
+        # device pages.  A host-tier hit re-materializes via
+        # device_put (+ opportunistic promotion back to pages).  All
+        # counters under _stats_lock; _spill_stats() is the ONE dict
+        # /metrics and /info render (no drift).
+        self.kv_host_spill_bytes = int(kv_host_spill_bytes)
+        self._host_bytes = 0
+        self._host_entries = 0
+        self._host_spills_total = 0
+        self._host_dropped_total = 0    # budget evictions + oversize
+        self._remat_hits_total = 0
+        self._remat_bytes_total = 0
+        self._promotions_total = 0
         if self.kv_paged:
             # Page-pressure relief: when an admit-ready stream is
             # blocked on free pages, the engine asks us to evict
             # stored-but-idle prefix entries (LRU; pages shared with
-            # residents survive via their refcounts).
+            # residents survive via their refcounts) — spilling their
+            # payloads to the host tier first when it is enabled.
             self.engine.page_reclaim = self._reclaim_prefix_pages
         # FLIGHT RECORDER (serving/profiling.py), off by default:
         # --profile-every N --profile-steps K periodically wraps K
@@ -1018,9 +1076,21 @@ class ModelServer:
         kept."""
         if not self.kv_paged:
             return
+        displaced = []
         with self._prefix_lock:
+            # HOST-TIER entries SURVIVE the flush: their payloads are
+            # host buffers referencing no device state — exactly the
+            # epoch contract (stale device ids die with the pool
+            # generation; host bytes don't).  Re-stored in eviction
+            # order, coldest first, so recency survives too.
+            keep = [(t, p) for t, p in self._prefix.entries()
+                    if isinstance(p, _SpilledPrefix)]
             self._prefix = RadixPrefixIndex(
                 max(1, self.prefix_cache_size))
+            for t, p in keep:
+                displaced += self._prefix.store(t, p)
+        if displaced:
+            self._free_displaced(displaced)
         # A store error during the crash window (e.g. a pin racing
         # the pool reset) may have tripped the degradation ladder;
         # the flush just removed whatever was broken, so a
@@ -1046,16 +1116,27 @@ class ModelServer:
                 return None
             ent_toks, payload = hit
             pc = ent_toks.shape[1]
-            if not isinstance(payload, _PagedPrefix):
+            if isinstance(payload, _SpilledPrefix):
+                # Host-tier hit: the payload (immutable host arrays)
+                # is safe to carry out of the lock; re-materialize —
+                # and opportunistically promote — outside it.
+                spilled = payload
+            elif not isinstance(payload, _PagedPrefix):
                 logits, cache = payload
                 return PrefixHit(pc, logits, cache, ())
-            # Pin while still under the prefix lock: a concurrent
-            # eviction between lookup and pin could free the pages.
-            # (Lock order everywhere: _prefix_lock > _page_lock.)
-            # The returned pool epoch rides the pins to the engine:
-            # a crash-recovery rebuild between here and admission
-            # invalidates them instead of corrupting fresh counts.
-            pin_epoch = self.engine.slots.pin(payload.pages)
+            else:
+                spilled = None
+            if spilled is None:
+                # Pin while still under the prefix lock: a concurrent
+                # eviction between lookup and pin could free the
+                # pages.  (Lock order: _prefix_lock > _page_lock.)
+                # The returned pool epoch rides the pins to the
+                # engine: a crash-recovery rebuild between here and
+                # admission invalidates them instead of corrupting
+                # fresh counts.
+                pin_epoch = self.engine.slots.pin(payload.pages)
+        if spilled is not None:
+            return self._rematerialize_hit(ent_toks, spilled, pc)
         try:
             with self._lock:
                 if self.engine.slots.epoch != pin_epoch:
@@ -1096,6 +1177,68 @@ class ModelServer:
                                     epoch=pin_epoch)
         return PrefixHit(pc, payload.logits, cache, pins)
 
+    def _rematerialize_hit(self, ent_toks, payload: "_SpilledPrefix",
+                           pc: int) -> PrefixHit:
+        """HOST-TIER hit: ``device_put`` the spilled leaves back into
+        a contiguous cache (manager.rematerialize — the sanctioned
+        host->device helper) and opportunistically PROMOTE the entry
+        back to device pages so subsequent hits — and co-resident
+        slots — share them copy-on-write again.  Promotion is best-
+        effort: a tight pool (the very pressure that spilled the
+        entry) just serves the hit from the contiguous cache with no
+        shared pages.  Runs on a handler thread with no locks held;
+        errors propagate to _prefix_lookup_safe's degradation
+        ladder."""
+        mgr = self.engine.slots
+        with self._lock:
+            cache = mgr.rematerialize(payload.leaves, pc)
+        with self._stats_lock:
+            self._remat_hits_total += 1
+            self._remat_bytes_total += payload.nbytes
+        pins = ()
+        ids, ep = mgr.reserve_with_epoch(mgr.pages_needed(pc))
+        if ids:
+            promoted = False
+            try:
+                with self._lock:
+                    # Epoch re-check INSIDE the device lock, like the
+                    # paged store's scatter: recovery rebuilds the
+                    # pool under this lock, so a dead-generation
+                    # scatter cannot interleave.
+                    if mgr.epoch == ep:
+                        mgr.scatter_cache(cache, ids)
+                        promoted = True
+            except Exception:
+                promoted = False    # promotion is an optimization
+            if promoted:
+                new_payload = _PagedPrefix(ids, pc, payload.logits)
+                with self._prefix_lock:
+                    if self._prefix.set_payload(ent_toks, new_payload,
+                                                expect=payload):
+                        # This hit maps the promoted FULL pages
+                        # read-only, exactly like a device-tier hit;
+                        # pin under the prefix lock so an eviction
+                        # cannot race the mapping.
+                        n_full = pc // mgr.page_tokens
+                        pin_epoch = mgr.pin(ids[:n_full]) \
+                            if n_full else ep
+                        pins = PagePins(ids[:n_full], pin_epoch)
+                        promoted_entry = True
+                    else:
+                        promoted_entry = False
+                if promoted_entry:
+                    with self._stats_lock:
+                        self._host_bytes -= payload.nbytes
+                        self._host_entries -= 1
+                        self._promotions_total += 1
+                else:
+                    # Entry changed under us: abandon the promotion
+                    # (dead-generation ids drop by reference).
+                    mgr.unpin(ids, epoch=ep)
+            else:
+                mgr.unpin(ids, epoch=ep)
+        return PrefixHit(pc, payload.logits, cache, pins)
+
     def _unpin_prefix(self, pins) -> None:
         if pins:
             self.engine.slots.unpin(
@@ -1106,23 +1249,131 @@ class ModelServer:
         LRU evictions): paged entries drop their page references —
         pages shared by a child entry or a resident slot stay alive
         under the remaining refcounts ("evict leaf pages first, never
-        a page with refcount > 1" falls out of the accounting)."""
+        a page with refcount > 1" falls out of the accounting) —
+        and host-tier entries leave the spill byte accounting."""
         for _toks, payload in displaced:
             if isinstance(payload, _PagedPrefix):
                 self.engine.slots.unpin(payload.pages)
+            elif isinstance(payload, _SpilledPrefix):
+                with self._stats_lock:
+                    self._host_bytes -= payload.nbytes
+                    self._host_entries -= 1
+                    self._host_dropped_total += 1
+
+    def _spill_entry(self, toks, payload) -> bool:
+        """Demote one device-tier entry to the HOST tier: pin its
+        pages, gather the payload to host buffers (the sanctioned
+        ``spill_pages`` helper, under the device lock), swap the
+        entry's payload in place, and release the entry's page
+        references — the pages free (to the extent nothing else
+        shares them) while the CONTENT survives in host RAM.
+        Returns False when the entry must be dropped instead (spill
+        failed, over budget, or the entry changed under us)."""
+        mgr = self.engine.slots
+        with self._prefix_lock:
+            # Pin under the prefix lock (same discipline as the
+            # lookup): eviction elsewhere cannot free the pages
+            # while we gather.  Entry may already be gone/changed —
+            # the identity-guarded no-op swap is the O(prompt)
+            # presence check (same primitive the drop path uses).
+            if not self._prefix.set_payload(toks, payload,
+                                            expect=payload):
+                return True     # someone else dealt with it
+            pin_epoch = mgr.pin(payload.pages)
+        try:
+            with self._lock:
+                if mgr.epoch != pin_epoch:
+                    # Pool rebuilt (crash recovery): pins and pages
+                    # are dead by reference; the recovery flush owns
+                    # the index.
+                    return True
+                host = mgr.spill_pages(payload.pages,
+                                       payload.n_tokens)
+                import jax
+
+                logits_host = np.asarray(
+                    jax.device_get(payload.logits))
+        except Exception:
+            mgr.unpin(payload.pages, epoch=pin_epoch)
+            return False
+        spilled = _SpilledPrefix(host, payload.n_tokens, logits_host)
+        if spilled.nbytes > self.kv_host_spill_bytes:
+            mgr.unpin(payload.pages, epoch=pin_epoch)
+            with self._stats_lock:
+                self._host_dropped_total += 1
+            return False
+        with self._prefix_lock:
+            swapped = self._prefix.set_payload(toks, spilled,
+                                               expect=payload)
+        mgr.unpin(payload.pages, epoch=pin_epoch)   # the gather pin
+        if not swapped:
+            return True         # entry changed meanwhile: host copy
+        #                         discarded, nothing to drop
+        # The ENTRY's own page references are released now that its
+        # payload lives on the host.
+        mgr.unpin(payload.pages, epoch=pin_epoch)
+        with self._stats_lock:
+            self._host_bytes += spilled.nbytes
+            self._host_entries += 1
+            self._host_spills_total += 1
+        self._enforce_spill_budget()
+        return True
+
+    def _enforce_spill_budget(self) -> None:
+        """Drop the COLDEST host-tier entries until the spill bytes
+        fit the budget (host-tier LRU — the radix recency order
+        already is one)."""
+        while True:
+            with self._stats_lock:
+                if self._host_bytes <= self.kv_host_spill_bytes:
+                    return
+            with self._prefix_lock:
+                victim = None
+                for t, p in self._prefix.entries():   # coldest first
+                    if isinstance(p, _SpilledPrefix):
+                        victim = (t, p)
+                        break
+                if victim is None:
+                    return      # accounting drift guard
+                self._prefix.remove(victim[0])
+            self._free_displaced([victim])
 
     def _reclaim_prefix_pages(self, n_pages_needed: int) -> bool:
-        """Evict LRU prefix entries until ``n_pages_needed`` pages
-        are free (or the index is empty) — the engine's page-pressure
+        """Free device pages until ``n_pages_needed`` are free (or no
+        page-holding entry remains) — the engine's page-pressure
         hook: stored-but-idle prefixes must never starve admission of
-        live traffic."""
+        live traffic.  With the host tier enabled
+        (``kv_host_spill_bytes > 0``) evicted entries SPILL their
+        payloads to host RAM instead of dropping (tentpole b: the
+        shareable-prefix working set multiplies by the host/HBM
+        ratio); without it, this is the PR 7 drop-on-evict
+        behavior."""
         mgr = self.engine.slots
         while mgr.free_page_count() < n_pages_needed:
             with self._prefix_lock:
-                ev = self._prefix.pop_lru()
-            if ev is None:
+                victim = None
+                for t, p in self._prefix.entries():   # coldest first
+                    if isinstance(p, _PagedPrefix):
+                        victim = (t, p)
+                        break
+            if victim is None:
                 return False
-            self._free_displaced([ev])
+            toks, payload = victim
+            if self.kv_host_spill_bytes > 0 \
+                    and self._spill_entry(toks, payload):
+                continue
+            # Drop path (spill disabled, failed, or over budget):
+            # remove the entry and release its page references —
+            # guarded by payload identity, a concurrent overwrite's
+            # fresh payload must not be dropped on the old one's
+            # verdict.
+            with self._prefix_lock:
+                if self._prefix.set_payload(toks, payload,
+                                            expect=payload):
+                    self._prefix.remove(toks)
+                else:
+                    continue    # entry changed: re-evaluate
+            self._free_displaced([(toks, payload)])
         return True
 
     def _prefix_store(self, toks: np.ndarray, logits, cache, *,
@@ -1841,6 +2092,24 @@ class ModelServer:
         self._push_solo_events(events, rid=rid)
         return events
 
+    def _spill_stats(self) -> Dict[str, Any]:
+        """The host-spill tier's counters — ONE dict rendered by
+        BOTH /metrics and /info (the no-drift pin, like every prior
+        PR's counter families)."""
+        with self._stats_lock:
+            return {
+                "kv_host_spill_bytes": self._host_bytes,
+                "kv_host_spill_bytes_budget":
+                    self.kv_host_spill_bytes,
+                "kv_host_entries": self._host_entries,
+                "kv_host_spills_total": self._host_spills_total,
+                "kv_host_dropped_total": self._host_dropped_total,
+                "kv_rematerialize_hits_total": self._remat_hits_total,
+                "kv_rematerialize_bytes_total":
+                    self._remat_bytes_total,
+                "kv_promotions_total": self._promotions_total,
+            }
+
     def info(self) -> Dict[str, Any]:
         import jax
 
@@ -1930,6 +2199,11 @@ class ModelServer:
                 **({"fault_plan": self.faults.stats()}
                    if self.faults is not None else {}),
                 "kv_paged": self.kv_paged,
+                "kv_lazy": self.kv_lazy,
+                # Host-spill tier (tentpole b): bytes/entries/hit
+                # counters from the same _spill_stats() dict /metrics
+                # renders.
+                **(self._spill_stats() if self.kv_paged else {}),
                 **{k: engine[k] for k in
                    ("slots", "slots_active", "slot_occupancy",
                     "queue_len", "queue_depth", "admitted_total",
@@ -1957,6 +2231,9 @@ class ModelServer:
                     "shed_kv_pages_total",
                     "kv_pages", "kv_page_tokens", "kv_pages_free",
                     "kv_pages_resident", "kv_pages_shared",
+                    "kv_pages_lazy_growths_total",
+                    "kv_pages_lazy_grown_total",
+                    "kv_preempt_exhaustion_total",
                     "mesh", "mesh_devices",
                     "step_device_seconds_total",
                     "step_wall_seconds_total", "step_device_share",
@@ -2248,6 +2525,49 @@ class ModelServer:
                     "# TYPE ptpu_serving_shed_kv_pages_total counter",
                     f"ptpu_serving_shed_kv_pages_total "
                     f"{es['shed_kv_pages_total']}",
+                    # Tiered KV memory (PR 12): lazy growth/preempt
+                    # counters from the same engine.stats() dict, and
+                    # the host-spill tier's gauges from ONE
+                    # _spill_stats() dict shared with /info.
+                    "# TYPE ptpu_serving_kv_pages_lazy_growths_total "
+                    "counter",
+                    f"ptpu_serving_kv_pages_lazy_growths_total "
+                    f"{es['kv_pages_lazy_growths_total']}",
+                    "# TYPE ptpu_serving_kv_pages_lazy_grown_total "
+                    "counter",
+                    f"ptpu_serving_kv_pages_lazy_grown_total "
+                    f"{es['kv_pages_lazy_grown_total']}",
+                    "# TYPE ptpu_serving_kv_preempt_exhaustion_total "
+                    "counter",
+                    f"ptpu_serving_kv_preempt_exhaustion_total "
+                    f"{es['kv_preempt_exhaustion_total']}",
+                ]
+                sp = self._spill_stats()
+                lines += [
+                    "# TYPE ptpu_serving_kv_host_spill_bytes gauge",
+                    f"ptpu_serving_kv_host_spill_bytes "
+                    f"{sp['kv_host_spill_bytes']}",
+                    "# TYPE ptpu_serving_kv_host_entries gauge",
+                    f"ptpu_serving_kv_host_entries "
+                    f"{sp['kv_host_entries']}",
+                    "# TYPE ptpu_serving_kv_host_spills_total counter",
+                    f"ptpu_serving_kv_host_spills_total "
+                    f"{sp['kv_host_spills_total']}",
+                    "# TYPE ptpu_serving_kv_rematerialize_hits_total "
+                    "counter",
+                    f"ptpu_serving_kv_rematerialize_hits_total "
+                    f"{sp['kv_rematerialize_hits_total']}",
+                    "# TYPE ptpu_serving_kv_rematerialize_bytes_total "
+                    "counter",
+                    f"ptpu_serving_kv_rematerialize_bytes_total "
+                    f"{sp['kv_rematerialize_bytes_total']}",
+                    "# TYPE ptpu_serving_kv_host_dropped_total "
+                    "counter",
+                    f"ptpu_serving_kv_host_dropped_total "
+                    f"{sp['kv_host_dropped_total']}",
+                    "# TYPE ptpu_serving_kv_promotions_total counter",
+                    f"ptpu_serving_kv_promotions_total "
+                    f"{sp['kv_promotions_total']}",
                 ]
             # The acceptance-rate histogram renders through the SAME
             # shared helper as the latency histograms, from the same

@@ -680,6 +680,64 @@ def test_snapshot_lock_scoped_to_serving():
     assert _rules(src, "polyaxon_tpu/train.py") == []
 
 
+# -- TIER-XFER --------------------------------------------------------------
+
+
+def test_tier_xfer_flags_pool_transfers_outside_helpers():
+    """Page-pool payloads cross device<->host only through the
+    sanctioned tiered-memory helpers: a stray device_get of the pool
+    (or device_put of page payloads) on any other path is a
+    page-sized PCIe transfer — on the step path, a silent TTFT
+    cliff."""
+    src = """
+    import jax
+
+    def step(self, window):
+        snapshot = jax.device_get(self._pool)      # pool payload!
+        return snapshot
+
+    def debug_dump(self, payload):
+        # committed placement, still the WRONG path for page payloads
+        return jax.device_put(payload.pages, self.sharding)
+    """
+    assert _rules(src) == ["TIER-XFER", "TIER-XFER"]
+
+
+def test_tier_xfer_sanctioned_helpers_and_scalars_pass():
+    """The sanctioned helpers move pool payloads freely; scalar
+    syncs (step outputs, logits, PRNG keys) never match — the rule
+    keys on pool/page-named operands, not on transfers per se."""
+    src = """
+    import jax
+
+    def spill_pages(self, ids, n_tokens):
+        return jax.device_get(self._pool)          # the spill tier
+
+    def rematerialize(self, host_leaves, n_tokens):
+        return [jax.device_put(h, self.sharding)
+                for h in host_leaves]
+
+    def _alloc_pool(self, metas):
+        return jax.device_put(self._pool, self.sharding)
+
+    def step(self, window):
+        outs = jax.device_get(self.outs)           # scalar sync: ok
+        logits = jax.device_get(self.logits)
+        return outs, logits
+    """
+    assert _rules(src) == []
+
+
+def test_tier_xfer_scoped_to_serving():
+    src = """
+    import jax
+
+    def offline_dump(pool):
+        return jax.device_get(pool)
+    """
+    assert _rules(src, "polyaxon_tpu/train.py") == []
+
+
 # -- RETRY-BACKOFF ----------------------------------------------------------
 
 

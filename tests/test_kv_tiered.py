@@ -1,0 +1,533 @@
+"""Tiered KV memory (PR 12): lazy page growth with
+preempt-on-exhaustion, and the host-RAM spill tier for the radix
+prefix store (serving/paged.py lazy mode, engine._ensure_lazy_growth,
+server._spill_entry / _rematerialize_hit).
+
+The defining contracts, in test form:
+
+- DETERMINISM UNDER MEMORY PRESSURE: with lazy reservation and a
+  pool small enough that growth forces preempt-on-exhaustion cycles,
+  every request's tokens are bitwise equal to the solo reference —
+  across plain/sampled/spec kinds and three co-tenancy schedules.
+  Eviction + token-identical resume changes latency, never tokens.
+- PAGE POISON: freshly grown pages, pages recycled through an
+  exhaustion preempt, and pages a spilled entry re-materializes into
+  all carry ONLY content the masking admits — outputs equal the
+  fresh-pool run.
+- LIVELOCK GUARD: a starved admit-ready head admits within a bounded
+  number of evictions (exhaustion evictees requeue at the BACK and
+  are barred from re-admission ahead of the stream they were evicted
+  for).
+- SPILL TIER: page-pressure eviction demotes entries to host RAM
+  instead of dropping; a hit re-materializes (device_put) with
+  tokens equal to the cold run, promotes back to pages when the pool
+  allows, respects the byte budget, and SURVIVES a crash-recovery
+  pool rebuild (host buffers reference no device state; stale device
+  ids die with the pool epoch).
+- RECOMPILES: zero steady-state compile-cache misses once the lazy
+  pad classes are warm.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models.generate import (
+    generate,
+    generate_positional,
+    generate_speculative,
+)
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import DecodeEngine, ModelServer, SchedulerPolicy
+from polyaxon_tpu.serving.scheduler import SamplingSpec
+
+PROMPT = np.asarray([[3, 1, 4, 1]], np.int32)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def draft_vars(small_model):
+    model, _ = small_model
+    return model.init(jax.random.PRNGKey(99),
+                      jnp.zeros((1, 4), jnp.int32))
+
+
+def _engine(model, variables, dvars=None, **policy):
+    kw = dict(n_slots=4, decode_window=8, kv_paged=True,
+              kv_page_tokens=8, kv_lazy=True)
+    kw.update(policy)
+    extra = {}
+    if dvars is not None:
+        extra = dict(draft_model=model, draft_variables=dvars)
+    return DecodeEngine(model, variables, autostart=False,
+                        policy=SchedulerPolicy(**kw), **extra)
+
+
+# -- lazy growth: reservation ramps, tokens never change ---------------------
+
+
+def test_greedy_lazy_matches_generate_and_grows(small_model):
+    model, variables = small_model
+    eng = _engine(model, variables, decode_window=4)
+    g = eng.submit(PROMPT, 40, None, None)
+    eng.run_until_idle()
+    want = np.asarray(generate(model, variables, PROMPT,
+                               max_new_tokens=40))
+    assert g.result().tolist() == want.tolist()
+    # lazy admission reserved less than the budget, then grew
+    assert eng.slots.lazy_growths_total > 0
+    assert eng.slots.lazy_pages_grown_total > 0
+    assert eng.stats()["kv_pages_lazy_growths_total"] \
+        == eng.slots.lazy_growths_total
+    # every page returned once idle
+    assert eng.slots.free_page_count() == eng.slots.n_pages
+
+
+def test_lazy_packs_more_residents_than_full_reservation(small_model):
+    """The point of the mode: at EQUAL pool size, lazy admission
+    holds more concurrent residents than full reservation while
+    outputs are still short of budget."""
+    model, variables = small_model
+    peaks = {}
+    for lazy in (False, True):
+        eng = _engine(model, variables, kv_pages=10, kv_lazy=lazy,
+                      decode_window=1)
+        for i in range(4):
+            eng.submit(np.asarray([[i + 1, i + 2, i + 3, i + 4]],
+                                  np.int32), 40, None, None)
+        peak = 0
+        for _ in range(6):       # a few early boundaries
+            eng.tick()
+            peak = max(peak, eng.slots.active_slots)
+        eng.run_until_idle()
+        peaks[lazy] = peak
+    # full reservation: 40+4 tokens = 6 pages/request -> 1 resident;
+    # lazy: prompt + window -> 1 page each -> all 4 admit.
+    assert peaks[True] > peaks[False]
+
+
+def test_determinism_matrix_under_exhaustion(small_model, draft_vars):
+    """plain/sampled/spec x burst/staggered/starved co-tenancy on a
+    pool small enough that lazy growth forces preempt-on-exhaustion:
+    every request equals its solo reference bitwise."""
+    model, variables = small_model
+    p2 = np.asarray([[9, 8, 7, 6]], np.int32)
+    p3 = np.asarray([[5, 6, 7, 8]], np.int32)
+    kinds = {
+        "plain": (None, lambda: np.asarray(generate(
+            model, variables, PROMPT, max_new_tokens=30))),
+        "sampled": (SamplingSpec(seed=7, temperature=1.0, top_k=8),
+                    lambda: np.asarray(generate_positional(
+                        model, variables, PROMPT, max_new_tokens=30,
+                        seed=7, temperature=1.0, top_k=8))),
+        "spec": (SamplingSpec(seed=7, temperature=0.9, top_k=16,
+                              spec_k=3),
+                 lambda: np.asarray(generate_speculative(
+                     model, variables, model, draft_vars, PROMPT,
+                     max_new_tokens=30, k=3, seed=7,
+                     temperature=0.9, top_k=16))),
+    }
+    co_want = {
+        "a": np.asarray(generate(model, variables, p2,
+                                 max_new_tokens=28)).tolist(),
+        "b": np.asarray(generate(model, variables, p3,
+                                 max_new_tokens=24)).tolist(),
+    }
+    preempts_seen = 0
+    for kind, (spec, ref) in kinds.items():
+        dv = draft_vars if kind == "spec" else None
+        # One warm engine per kind; pool of 10 pages = 80 tokens vs
+        # ~3 x (4 + ~30) token demand -> growth must preempt.
+        eng = _engine(model, variables, dv, kv_pages=10,
+                      decode_window=2)
+        want = ref().tolist()
+        for schedule in ("burst", "staggered", "starved"):
+            if schedule == "burst":
+                a = eng.submit(p2, 28, None, None)
+                g = eng.submit(PROMPT, 30, None, None, sampling=spec)
+                b = eng.submit(p3, 24, None, None)
+            elif schedule == "staggered":
+                a = eng.submit(p2, 28, None, None)
+                for _ in range(3):
+                    eng.tick()
+                g = eng.submit(PROMPT, 30, None, None, sampling=spec)
+                for _ in range(2):
+                    eng.tick()
+                b = eng.submit(p3, 24, None, None)
+            else:               # starved: queue behind busy residents
+                a = eng.submit(p2, 28, None, None)
+                b = eng.submit(p3, 24, None, None)
+                g = eng.submit(PROMPT, 30, None, None, sampling=spec)
+            eng.run_until_idle()
+            assert g.result().tolist() == want, (kind, schedule)
+            assert a.result().tolist() == co_want["a"], (kind,
+                                                         schedule)
+            assert b.result().tolist() == co_want["b"], (kind,
+                                                         schedule)
+            assert eng.slots.free_page_count() == eng.slots.n_pages, \
+                (kind, schedule)
+        preempts_seen += eng.kv_preempt_exhaustion_total
+    # the matrix must actually exercise the exhaustion path
+    assert preempts_seen >= 1
+
+
+def test_lazy_equals_full_reservation_byte_identity(small_model):
+    model, variables = small_model
+    results = []
+    for lazy in (False, True):
+        eng = _engine(model, variables, kv_lazy=lazy)
+        groups = [
+            eng.submit(PROMPT, 12, None, None),
+            eng.submit(np.asarray([[5, 6, 7, 8]], np.int32), 10,
+                       None, None,
+                       sampling=SamplingSpec(seed=3,
+                                             temperature=1.0)),
+            eng.submit(np.asarray([[9, 8, 7, 6]], np.int32), 6,
+                       None, None),
+        ]
+        eng.run_until_idle()
+        results.append([g.result().tolist() for g in groups])
+    assert results[0] == results[1]
+
+
+def test_page_poison_on_grown_and_recycled_pages(small_model):
+    """Pages recycled through an exhaustion preempt and re-grown by
+    the resumed stream carry only masked content: the pressured run
+    equals the fresh-pool reference token-for-token."""
+    model, variables = small_model
+    p2 = np.asarray([[9, 8, 7, 6]], np.int32)
+    want = np.asarray(generate_positional(
+        model, variables, p2, max_new_tokens=30, seed=11,
+        temperature=1.0)).tolist()
+    eng = _engine(model, variables, kv_pages=8, decode_window=1)
+    a = eng.submit(PROMPT, 30, None, None)
+    g = eng.submit(p2, 30, None, None,
+                   sampling=SamplingSpec(seed=11, temperature=1.0))
+    eng.run_until_idle()
+    assert eng.kv_preempt_exhaustion_total >= 1
+    assert g.result().tolist() == want
+    assert a.result().tolist() == np.asarray(generate(
+        model, variables, PROMPT, max_new_tokens=30)).tolist()
+    assert eng.slots.free_page_count() == eng.slots.n_pages
+
+
+def test_livelock_guard_starved_head_admits_bounded(small_model):
+    """A fully-prefilled head blocked on pages admits within a
+    bounded number of boundaries while lazy residents grow and
+    exhaustion preempts cycle: evictees requeue at the BACK (never
+    ahead of the head) and carry the re-admission bar, so the head
+    is never starved by the streams whose evictions freed pages."""
+    model, variables = small_model
+    eng = _engine(model, variables, kv_pages=8, decode_window=1,
+                  n_slots=2)
+    a = eng.submit(PROMPT, 30, None, None)
+    b = eng.submit(np.asarray([[9, 8, 7, 6]], np.int32), 30, None,
+                   None)
+    for _ in range(3):
+        eng.tick()
+    head = eng.submit(np.asarray([[1, 2, 3, 4]], np.int32), 8, None,
+                      None)
+    # the head must admit within a bounded number of boundaries —
+    # residents' growth cannot starve it indefinitely
+    for i in range(200):
+        eng.tick()
+        if head.t_first_admit is not None:
+            break
+    assert head.t_first_admit is not None, \
+        "admit-ready head starved by lazy growth"
+    eng.run_until_idle()
+    assert head.result().tolist() == np.asarray(generate(
+        model, variables, np.asarray([[1, 2, 3, 4]], np.int32),
+        max_new_tokens=8)).tolist()
+    assert a.error is None and b.error is None
+
+
+def test_exhaustion_evictee_is_barred_until_growth_lands(small_model):
+    """The bar itself: after an exhaustion preempt, the evictee is
+    not admissible at the very next boundary's admission (the freed
+    pages must reach the blocked growth first)."""
+    model, variables = small_model
+    eng = _engine(model, variables, kv_pages=8, decode_window=1,
+                  n_slots=2)
+    # Asymmetric budgets: when the SHORTER resident's growth blocks,
+    # the victim (most remaining budget = the longer one) is a
+    # different stream, so the eviction carries a bar.  (A preempt
+    # whose victim IS the blocked stream is a self-eviction with no
+    # beneficiary to bar against.)
+    a = eng.submit(PROMPT, 30, None, None)
+    b = eng.submit(np.asarray([[9, 8, 7, 6]], np.int32), 44, None,
+                   None)
+    barred = []
+    for _ in range(500):
+        eng.tick()
+        barred = [s for s in eng.queue.snapshot()
+                  if s.evicted_for is not None]
+        if barred or (a.event.is_set() and b.event.is_set()):
+            break
+    assert barred, "no exhaustion evictee ever carried a bar"
+    assert all(eng._stream_barred(s) for s in barred)
+    eng.run_until_idle()
+    assert a.error is None and b.error is None
+    # bars cleared once growth completed / streams went terminal
+    assert not any(s.evicted_for for g in (a, b)
+                   for s in g.streams)
+
+
+def test_lazy_zero_steady_state_recompiles(small_model):
+    """Warm-twice-then-flat: once the lazy pad classes are warm,
+    same-shaped traffic (including growth + exhaustion preempts)
+    adds ZERO compile-cache misses."""
+    model, variables = small_model
+    eng = _engine(model, variables, kv_pages=10, decode_window=2)
+
+    def round_():
+        gs = [eng.submit(np.asarray([[i + 1, i + 2, i + 3, i + 4]],
+                                    np.int32), 28, None, None)
+              for i in range(3)]
+        eng.run_until_idle()
+        return gs
+
+    round_()
+    round_()
+    warm = eng.sentinel.snapshot()["compile_cache_misses"]
+    round_()
+    assert eng.sentinel.snapshot()["compile_cache_misses"] == warm
+
+
+# -- host-RAM spill tier -----------------------------------------------------
+
+
+def _server(small_model, **kw):
+    model, variables = small_model
+    args = dict(batching="continuous", n_slots=2, kv_paged=True,
+                kv_page_tokens=8, kv_pages=8, prefix_cache=8,
+                kv_host_spill_bytes=1 << 20)
+    args.update(kw)
+    return ModelServer(model, variables, **args)
+
+
+PREFIXES = [list(range(1, 17)), list(range(2, 18)),
+            list(range(3, 19))]  # 16 tokens = 2 pages each
+
+
+def test_spill_and_rematerialize_hits_token_identical(small_model):
+    model, variables = small_model
+    ms0 = ModelServer(model, variables, batching="continuous",
+                      n_slots=2, prefix_cache=0)
+    refs = [ms0.generate({"prompt": p + [20, 21],
+                          "max_new_tokens": 6})["new_tokens"]
+            for p in PREFIXES]
+    ms0.close()
+
+    # sanitize=True: the spill/re-materialize paths interleave
+    # _prefix_lock, the page lock, and the device lock from handler
+    # AND engine threads — the lock-order sanitizer must stay quiet.
+    ms = _server(small_model, sanitize=True)
+    try:
+        for p in PREFIXES:
+            ms.prefill_prompt({"prompt": p})
+        # page pressure: evict everything from the device tier
+        assert ms._reclaim_prefix_pages(ms.engine.slots.n_pages)
+        st = ms._spill_stats()
+        assert st["kv_host_entries"] == len(PREFIXES)
+        assert st["kv_host_spill_bytes"] > 0
+        assert ms.engine.slots.free_page_count() \
+            == ms.engine.slots.n_pages
+        # spilled-entry hits: re-materialized, token-identical, and
+        # opportunistically promoted back to device pages
+        for i, p in enumerate(PREFIXES):
+            r = ms.generate({"prompt": p + [20, 21],
+                             "max_new_tokens": 6})
+            assert r["new_tokens"] == refs[i]
+            assert r.get("prefix_hit_len") == len(p)
+        st = ms._spill_stats()
+        assert st["kv_rematerialize_hits_total"] == len(PREFIXES)
+        assert st["kv_rematerialize_bytes_total"] > 0
+        assert st["kv_promotions_total"] >= 1
+    finally:
+        ms.close()
+
+
+def test_spill_disabled_keeps_drop_on_evict(small_model):
+    ms = _server(small_model, kv_host_spill_bytes=0)
+    try:
+        for p in PREFIXES:
+            ms.prefill_prompt({"prompt": p})
+        ms._reclaim_prefix_pages(ms.engine.slots.n_pages)
+        st = ms._spill_stats()
+        assert st["kv_host_entries"] == 0
+        assert st["kv_host_spills_total"] == 0
+        assert len(ms._prefix) == 0      # dropped, PR 7 behavior
+    finally:
+        ms.close()
+
+
+def test_spill_budget_evicts_coldest_host_entries(small_model):
+    """The host tier is BYTE-BOUNDED: spilling past the budget drops
+    the coldest spilled entries (host-tier LRU)."""
+    ms = _server(small_model)
+    try:
+        for p in PREFIXES:
+            ms.prefill_prompt({"prompt": p})
+        ms._reclaim_prefix_pages(ms.engine.slots.n_pages)
+        per_entry = ms._spill_stats()["kv_host_spill_bytes"] \
+            // len(PREFIXES)
+        # shrink the budget to ~2 entries and re-enforce
+        ms.kv_host_spill_bytes = int(per_entry * 2.5)
+        ms._enforce_spill_budget()
+        st = ms._spill_stats()
+        assert st["kv_host_entries"] == 2
+        assert st["kv_host_spill_bytes"] <= ms.kv_host_spill_bytes
+        assert st["kv_host_dropped_total"] >= 1
+    finally:
+        ms.close()
+
+
+def test_host_tier_survives_crash_recovery(small_model):
+    """The epoch contract extension (docs/DESIGN.md): spilled host
+    buffers reference no device state, so they SURVIVE the crash-
+    recovery pool rebuild — while device-tier entries (stale page
+    ids) are flushed by reference."""
+    model, variables = small_model
+    ms = _server(small_model)
+    try:
+        ref = None
+        for p in PREFIXES:
+            ms.prefill_prompt({"prompt": p})
+        # spill two of the three; the third stays device-tier
+        mgr = ms.engine.slots
+        held = mgr.n_pages - mgr.free_page_count()
+        assert ms._reclaim_prefix_pages(
+            mgr.free_page_count() + 4)    # frees ~2 entries' pages
+        st = ms._spill_stats()
+        n_host = st["kv_host_entries"]
+        assert 1 <= n_host < len(PREFIXES)
+        # cold reference for a spilled prefix
+        ms0 = ModelServer(model, variables, batching="continuous",
+                          n_slots=2, prefix_cache=0)
+        ref = ms0.generate({"prompt": PREFIXES[0] + [20, 21],
+                            "max_new_tokens": 6})["new_tokens"]
+        ms0.close()
+        # crash recovery: pool rebuild + the server's recovery hook
+        ms.engine.recover_from_crash()
+        ms._on_engine_recovery()
+        st2 = ms._spill_stats()
+        assert st2["kv_host_entries"] == n_host
+        # only host-tier entries survive; device ids died with epoch
+        kinds = {type(p).__name__
+                 for _t, p in ms._prefix.entries()}
+        assert kinds == {"_SpilledPrefix"}
+        assert len(ms._prefix) == n_host
+        # and a surviving host entry still serves token-identical
+        # hits on the rebuilt pool
+        r = ms.generate({"prompt": PREFIXES[0] + [20, 21],
+                         "max_new_tokens": 6})
+        assert r["new_tokens"] == ref
+        assert r.get("prefix_hit_len") == len(PREFIXES[0])
+        del held
+    finally:
+        ms.close()
+
+
+def test_spill_counters_no_drift_across_surfaces(small_model):
+    """/info and /metrics render the SAME _spill_stats() dict and
+    the same engine.stats() lazy counters — pinned."""
+    ms = _server(small_model, kv_lazy=True)
+    try:
+        for p in PREFIXES:
+            ms.prefill_prompt({"prompt": p})
+        ms._reclaim_prefix_pages(ms.engine.slots.n_pages)
+        ms.generate({"prompt": PREFIXES[0] + [20, 21],
+                     "max_new_tokens": 6})
+        info = ms.info()
+        sp = ms._spill_stats()
+        for k in ("kv_host_spill_bytes", "kv_host_entries",
+                  "kv_host_spills_total",
+                  "kv_rematerialize_hits_total",
+                  "kv_rematerialize_bytes_total"):
+            assert info[k] == sp[k], k
+        assert info["kv_lazy"] is True
+        es = ms.engine.stats()
+        assert info["kv_pages_lazy_growths_total"] \
+            == es["kv_pages_lazy_growths_total"]
+        assert info["kv_preempt_exhaustion_total"] \
+            == es["kv_preempt_exhaustion_total"]
+        text = ms.metrics_text()
+        for line in (
+                f"ptpu_serving_kv_host_entries "
+                f"{sp['kv_host_entries']}",
+                f"ptpu_serving_kv_rematerialize_hits_total "
+                f"{sp['kv_rematerialize_hits_total']}",
+                f"ptpu_serving_kv_host_dropped_total "
+                f"{sp['kv_host_dropped_total']}",
+                f"ptpu_serving_kv_promotions_total "
+                f"{sp['kv_promotions_total']}",
+                f"ptpu_serving_kv_pages_lazy_growths_total "
+                f"{es['kv_pages_lazy_growths_total']}",
+                f"ptpu_serving_kv_preempt_exhaustion_total "
+                f"{es['kv_preempt_exhaustion_total']}"):
+            assert line in text, line
+    finally:
+        ms.close()
+
+
+def test_growth_reclaims_idle_prefix_pages_before_preempting(
+        small_model):
+    """Tier order under growth exhaustion: STORED-BUT-IDLE prefix
+    pages yield (spill/evict via the reclaim hook) before any LIVE
+    resident is preempted — reclaimable cache pages must never cost
+    a resident its slot."""
+    ms = _server(small_model, kv_lazy=True, kv_pages=12,
+                 n_slots=2)
+    try:
+        # Prefix entries hold most of the pool (3 x 2 pages = 6 of
+        # 12; two residents' lazy growth will need them back).
+        for p in PREFIXES:
+            ms.prefill_prompt({"prompt": p})
+        assert ms.engine.slots.free_page_count() <= 6
+        import threading
+
+        rs = []
+        ts = [threading.Thread(target=lambda i=i: rs.append(
+            ms.generate({"prompt": [i + 1, i + 2, i + 3, i + 4],
+                         "max_new_tokens": 40})))
+            for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(rs) == 2
+        # growth happened, the idle prefix pages were spilled to the
+        # host tier, and NO live resident was exhaustion-preempted
+        es = ms.engine.stats()
+        assert es["kv_pages_lazy_growths_total"] > 0
+        assert es["kv_preempt_exhaustion_total"] == 0
+        assert ms._spill_stats()["kv_host_entries"] >= 1
+    finally:
+        ms.close()
+
+
+def test_kv_lazy_requires_paged(small_model):
+    model, variables = small_model
+    with pytest.raises(ValueError, match="kv_lazy requires"):
+        ModelServer(model, variables, batching="continuous",
+                    kv_lazy=True)
+    with pytest.raises(ValueError, match="kv_host_spill_bytes"):
+        ModelServer(model, variables, batching="continuous",
+                    kv_host_spill_bytes=1 << 20)
+    with pytest.raises(ValueError, match="kv_lazy requires"):
+        SchedulerPolicy(kv_lazy=True)
