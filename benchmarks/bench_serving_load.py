@@ -452,6 +452,9 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         model, variables, model_name, vocab, shapes,
         n_slots=n_slots, n_short=n_short, n_long=n_long,
         requests=requests, queue_depth=4 * (n_short + n_long))
+    fleet = bench_fleet_chaos(
+        model, variables, model_name, vocab, shapes,
+        n_slots=n_slots, requests=requests)
     overload = bench_overload(model, variables, model_name, vocab,
                               shapes, n_slots=n_slots,
                               requests=requests)
@@ -496,6 +499,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         **debug,
         **faults,
         **chaos,
+        **fleet,
         **overload,
         **longtail,
         **lazy,
@@ -907,6 +911,181 @@ def bench_chaos_soak(model, variables, model_name: str, vocab: int,
           f"leaked_slots={row['leaked_slots']} "
           f"leaked_pages={row['leaked_pages']}", file=sys.stderr)
     return {"chaos": row}
+
+
+def bench_fleet_chaos(model, variables, model_name: str, vocab: int,
+                      shapes, *, n_slots: int, requests: int):
+    """FLEET chaos-soak (serving/router.py): 3 in-process replicas
+    behind the router under mixed greedy/sampled load while a SEEDED
+    fleet plan kills one replica mid-burst and slow-walks another.
+    The committed evidence is the router-tier robustness contract:
+    ZERO hung requests, ZERO token mismatches vs the fault-free run
+    for surviving requests, retry volume under the budget (spent <=
+    burst + ratio x live traffic — the token bucket is never
+    overdrawn), hedges cancel their losers (cancelled <= fired, no
+    double-completion), and zero steady-state recompiles on the
+    SURVIVING replicas (the storm must not perturb their compiled
+    program set)."""
+    import numpy as np
+
+    from polyaxon_tpu.serving import (LocalReplica, ModelServer,
+                                      ReplicaRouter,
+                                      make_router_server)
+
+    def factory():
+        return ModelServer(model, variables, model_name=model_name,
+                           max_batch=n_slots, batching="continuous",
+                           n_slots=n_slots, queue_depth=64)
+
+    reps = [LocalReplica(factory, f"r{i}") for i in range(3)]
+    # The slow-walk (0.6s/request) sits ABOVE the hedge watermark
+    # (0.3s — requests on the slow replica hedge to a healthy one,
+    # first winner cancels the loser) but BELOW the probe timeout
+    # (1.5s — the replica stays IN rotation, which is exactly the
+    # tail pathology hedging exists for; a slower-than-probe replica
+    # just drops out like a dead one).
+    router = ReplicaRouter(
+        reps, probe_interval_s=0.1, probe_timeout_s=1.5,
+        cooldown_s=0.3, retry_ratio=0.25, retry_burst=8.0,
+        max_attempts=3, request_timeout_s=120.0,
+        hedge="0.3", hedge_min_s=0.25,
+        fleet_faults={"seed": 97, "faults": [
+            # kill r1 a few requests into the burst; slow-walk r2
+            {"site": "replica_kill", "replica": 1, "after": 6,
+             "times": 1},
+            {"site": "replica_slow", "replica": 2, "delay_s": 0.6,
+             "after": 2, "times": 1},
+        ]})
+    srv = make_router_server("127.0.0.1", 0, router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    rng = np.random.RandomState(23)
+    clients = ("short",) * 8 + ("long",) * 4
+    payloads = []
+    for i, cls in enumerate(clients):
+        p_len, new = shapes[cls]
+        payload = {"prompt": rng.randint(0, vocab,
+                                         size=p_len).tolist(),
+                   "max_new_tokens": new}
+        if i % 2 == 1:
+            payload.update(SAMPLED_PARAMS[(i // 2)
+                                          % len(SAMPLED_PARAMS)])
+            payload["seed"] = i
+        payloads.append(payload)
+
+    # Fault-free references + fleet-wide warmup: every payload runs
+    # on EVERY replica directly — r0's answer is the fault-free
+    # single-replica reference, the replicas must agree bitwise
+    # before any chaos, and every compiled program the burst needs
+    # exists everywhere (so the zero-recompile pin below measures
+    # the storm, not first compiles).
+    refs = []
+    for payload in payloads:
+        per_rep = [
+            _post(rep.url, payload, timeout=900)["tokens"]
+            for rep in reps]
+        assert per_rep[0] == per_rep[1] == per_rep[2], \
+            "replicas disagree before chaos — fleet determinism " \
+            "broken at rest"
+        refs.append(per_rep[0])
+    miss_before = {
+        rep.id: rep.ms.recompile.snapshot()["compile_cache_misses"]
+        for rep in reps}
+
+    counts = {"ok": 0, "mismatch": 0, "failed": 0, "hung": 0}
+    count_lock = threading.Lock()
+
+    def bump(k):
+        with count_lock:
+            counts[k] += 1
+
+    def client(i):
+        for _ in range(requests):
+            try:
+                r = _post(base, payloads[i], timeout=120)
+                if r["tokens"] == refs[i]:
+                    bump("ok")
+                else:
+                    bump("mismatch")
+            except (TimeoutError, socket.timeout):
+                bump("hung")        # the one outcome the router
+                #                     tier exists to prevent
+            except urllib.error.URLError as e:
+                if isinstance(getattr(e, "reason", None),
+                              (TimeoutError, socket.timeout)):
+                    bump("hung")
+                else:
+                    bump("failed")  # fast typed shed: allowed,
+                    #                 counted
+            except Exception:
+                bump("failed")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(clients))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = round(time.perf_counter() - t0, 1)
+    with count_lock:
+        counts["hung"] += sum(1 for t in threads if t.is_alive())
+    st = router.stats()
+    # Survivors of the storm: every replica the plan did not kill.
+    survivor_miss_delta = {
+        rep.id: rep.ms.recompile.snapshot()["compile_cache_misses"]
+        - miss_before[rep.id]
+        for rep in reps if rep.id != "r1"}
+    # Re-admit the killed replica: restart + probe back to ready.
+    reps[1].restart()
+    deadline = time.monotonic() + 60
+    while not reps[1].up() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    row = {
+        "replicas": len(reps),
+        "requests_submitted": len(clients) * requests,
+        **counts,
+        "wall_s": wall,
+        "failovers": st["failovers_total"],
+        "hedges_fired": st["hedges_fired_total"],
+        "hedges_won": st["hedges_won_total"],
+        "hedges_cancelled": st["hedges_cancelled_total"],
+        "retry_budget_spent": st["retry_budget_spent_total"],
+        "retry_budget_denied": st["retry_budget_denied_total"],
+        "retry_budget_cap": round(
+            router.budget.burst
+            + router.budget.ratio * st["requests_total"], 1),
+        "retry_under_budget": bool(
+            st["retry_budget_spent_total"]
+            <= router.budget.burst
+            + router.budget.ratio * st["requests_total"]),
+        "hedges_cancel_losers": bool(
+            st["hedges_cancelled_total"] <= st["hedges_fired_total"]
+            and st["hedges_won_total"] <= st["hedges_fired_total"]),
+        "fleet_faults_applied": st["fleet_faults_applied"],
+        "survivor_recompiles": survivor_miss_delta,
+        "killed_replica_readmitted": bool(reps[1].up()),
+    }
+    router.close()
+    srv.shutdown()
+    srv.server_close()
+    for rep in reps:
+        rep.close()
+    print(f"# fleet chaos: {row['requests_submitted']} requests "
+          f"over 3 replicas (1 killed, 1 slow-walked) -> "
+          f"ok={counts['ok']} mismatch={counts['mismatch']} "
+          f"failed={counts['failed']} hung={counts['hung']}; "
+          f"failovers={row['failovers']} "
+          f"hedges={row['hedges_fired']}/"
+          f"{row['hedges_won']}won/"
+          f"{row['hedges_cancelled']}cancelled "
+          f"budget={row['retry_budget_spent']}/"
+          f"{row['retry_budget_cap']} "
+          f"survivor_recompiles={survivor_miss_delta} "
+          f"readmitted={row['killed_replica_readmitted']}",
+          file=sys.stderr)
+    return {"fleet": row}
 
 
 def bench_overload(model, variables, model_name: str, vocab: int,
@@ -2058,6 +2237,7 @@ def main() -> int:
             or "debug_overhead" not in r \
             or "faults_overhead" not in r \
             or "chaos" not in r \
+            or "fleet" not in r \
             or "overload" not in r \
             or "longtail" not in r \
             or "lazy_longtail" not in r \
@@ -2124,6 +2304,31 @@ def main() -> int:
             f"chaos soak violated the crash-only contract: "
             f"{violations} (full evidence in the chaos field of "
             f"the row just written)")
+    # The FLEET chaos soak's router-tier contract, same post-persist
+    # discipline: zero hung, zero survivor token mismatches, retries
+    # under budget, hedges cancel their losers, zero recompiles on
+    # surviving replicas, killed replica re-admitted.
+    fl = r.get("fleet")
+    if fl is None:
+        raise SystemExit(
+            "fleet chaos leg missing from this run (see stderr "
+            "above); row marked partial")
+    fleet_violations = {k: fl[k] for k in ("hung", "mismatch")
+                        if fl.get(k)}
+    if not fl.get("retry_under_budget"):
+        fleet_violations["retry_under_budget"] = False
+    if not fl.get("hedges_cancel_losers"):
+        fleet_violations["hedges_cancel_losers"] = False
+    if any(fl.get("survivor_recompiles", {}).values()):
+        fleet_violations["survivor_recompiles"] = \
+            fl["survivor_recompiles"]
+    if not fl.get("killed_replica_readmitted"):
+        fleet_violations["killed_replica_readmitted"] = False
+    if fleet_violations:
+        raise SystemExit(
+            f"fleet chaos soak violated the router-tier contract: "
+            f"{fleet_violations} (full evidence in the fleet field "
+            f"of the row just written)")
     return 0
 
 

@@ -244,6 +244,30 @@ def chaos_value(r):
     return out
 
 
+def fleet_value(r):
+    """serving-load rows: the FLEET chaos-soak column — terminal
+    accounting for the 3-replica router storm (ok / survivor token
+    mismatches / hung), failovers + hedges fired/won, and whether
+    retry volume stayed under budget.  ``MISMATCH``/``OVERBUDGET``/
+    ``RECOMPILED`` flags mean the router-tier contract was violated
+    (the bench run itself fails on them; a committed flag marks a
+    preserved-evidence row).  Empty for every other bench."""
+    fl = r.get("fleet") or {}
+    if not fl:
+        return ""
+    out = (f"{fl.get('ok', 0)}ok {fl.get('hung', 0)}hung "
+           f"fo{fl.get('failovers', 0)} "
+           f"h{fl.get('hedges_fired', 0)}/"
+           f"{fl.get('hedges_won', 0)}w")
+    if fl.get("mismatch"):
+        out += f" MISMATCH{fl['mismatch']}"
+    if not fl.get("retry_under_budget", True):
+        out += " OVERBUDGET"
+    if any((fl.get("survivor_recompiles") or {}).values()):
+        out += " RECOMPILED"
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -254,9 +278,10 @@ def main() -> int:
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
           "| spec-mix | paged | lazy | spill | mesh | telemetry "
-          "| recorder | debug | chaos | overload | mfu | age |")
+          "| recorder | debug | chaos | fleet | overload | mfu "
+          "| age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|---|---|---|")
+          "---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -281,6 +306,7 @@ def main() -> int:
               f"| {recorder_value(r)} "
               f"| {debug_value(r)} "
               f"| {chaos_value(r)} "
+              f"| {fleet_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0

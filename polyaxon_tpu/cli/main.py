@@ -853,6 +853,106 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                        err=True)
 
 
+@cli.command()
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8100, type=int)
+@click.option("--replica", "replicas", multiple=True, required=True,
+              help="Replica endpoint (host:port or http://host:port);"
+                   " repeat per replica.")
+@click.option("--probe-interval", default=0.5, type=float,
+              help="Seconds between /healthz probe rounds.")
+@click.option("--probe-timeout", default=2.0, type=float,
+              help="Per-probe socket timeout (a timeout-less probe "
+                   "is how a hung replica wedges the router).")
+@click.option("--down-after", default=2, type=int,
+              help="Consecutive transport failures that trip a "
+                   "replica out of rotation.")
+@click.option("--cooldown", default=1.0, type=float,
+              help="Seconds out of rotation before the half-open "
+                   "re-admission probe.")
+@click.option("--retry-ratio", default=0.1, type=float,
+              help="Retry-budget refill per live request (retries + "
+                   "hedges can never exceed this fraction of "
+                   "traffic plus --retry-burst).")
+@click.option("--retry-burst", default=8.0, type=float,
+              help="Retry-budget bucket capacity (the cold-start "
+                   "failover headroom).")
+@click.option("--max-attempts", default=3, type=int,
+              help="Replica attempts per request (first + "
+                   "failovers).")
+@click.option("--request-timeout", default=120.0, type=float,
+              help="Per-attempt read timeout / default request "
+                   "deadline, seconds.")
+@click.option("--hedge", default="off",
+              help="'off', 'p99' (duplicate a request sitting past "
+                   "the sliding p99 watermark), or a fixed "
+                   "threshold in seconds.")
+@click.option("--hedge-min", default=0.2, type=float,
+              help="Hedge watermark floor, seconds.")
+@click.option("--affinity/--no-affinity", default=True,
+              help="Radix-prefix affinity: route a request to the "
+                   "replica whose store holds its registered "
+                   "prefix (never beats health).")
+@click.option("--min-ready", default=1, type=int,
+              help="Rolling restart never drops the ready-replica "
+                   "count below this.")
+@click.option("--fleet-fault-plan", default=None, type=click.Path(),
+              help="Seeded fleet chaos plan (JSON; replica_kill/"
+                   "replica_hang/replica_slow sites) — local "
+                   "replicas only.")
+def route(host, port, replicas, probe_interval, probe_timeout,
+          down_after, cooldown, retry_ratio, retry_burst,
+          max_attempts, request_timeout, hedge, hedge_min, affinity,
+          min_ready, fleet_fault_plan):
+    """Run the replica ROUTER tier in front of N `ptpu serve`
+    replicas (docs/SERVING.md "Fleet").
+
+    The router probes each replica's /healthz (503 draining/
+    engine_down takes it out of rotation; recovery re-admits it
+    after a half-open success probe), balances by least-outstanding
+    with radix-prefix affinity, fails replica deaths over inside a
+    bounded retry budget with jittered backoff, optionally hedges
+    requests past the p99 watermark (first winner cancels the
+    loser), and rolls restarts via POST /fleet/restart without
+    dropping below --min-ready ready replicas.
+    """
+    from polyaxon_tpu.serving import (ReplicaRouter,
+                                      make_router_server)
+
+    try:
+        router = ReplicaRouter(
+            list(replicas),
+            probe_interval_s=probe_interval,
+            probe_timeout_s=probe_timeout,
+            down_after=down_after,
+            cooldown_s=cooldown,
+            retry_ratio=retry_ratio,
+            retry_burst=retry_burst,
+            max_attempts=max_attempts,
+            request_timeout_s=request_timeout,
+            hedge=hedge,
+            hedge_min_s=hedge_min,
+            affinity=affinity,
+            min_ready=min_ready,
+            fleet_faults=fleet_fault_plan)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    try:
+        srv = make_router_server(host, port, router)
+    except OSError as e:
+        router.close()
+        raise click.ClickException(
+            f"cannot bind {host}:{port}: {e}")
+    click.echo(f"routing {len(replicas)} replica(s) on "
+               f"http://{host}:{srv.server_address[1]}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.shutdown()
+    finally:
+        router.close()
+
+
 # ---------------------------------------------------------------------------
 # ops
 # ---------------------------------------------------------------------------

@@ -45,6 +45,12 @@ path moved from request coalescing to continuous batching:
   page allocation, the prefix store, the engine loop, and the HTTP
   handler — the chaos harness that proves recovery without changing
   a surviving token.
+- ``router.py``    — the replica ROUTER tier (``ptpu route``): N
+  replica endpoints behind one front — health-probed rotation with
+  per-replica circuit breakers, least-outstanding + radix-prefix-
+  affinity routing, failover with a bounded retry budget and
+  cross-replica resume, hedged requests past the p99 watermark, and
+  drain-aware rolling restarts (``POST /fleet/restart``).
 - ``recovery.py``  — crash-only recovery: the shared bounded
   ``RetryPolicy``, the crash-storm ``CircuitBreaker`` (healthz 503
   ``engine_down`` instead of hangs), and the ``EngineSupervisor``
@@ -63,6 +69,8 @@ from .meshed import MeshError, ServingMesh, parse_mesh
 from .paged import PagedSlotKVManager
 from .radix import RadixPrefixIndex
 from .recovery import CircuitBreaker, EngineSupervisor, RetryPolicy
+from .router import (LocalReplica, Replica, ReplicaRouter,
+                     RetryBudget, make_router_server)
 from .scheduler import (DeadlineExceeded, PRIORITIES,
                         PoisonedRequest, QueueFullError,
                         RequestCancelled, SamplingSpec,
@@ -80,6 +88,8 @@ __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "ShedError", "PoisonedRequest", "PRIORITIES",
            "FaultPlan", "RetryPolicy", "CircuitBreaker",
            "EngineSupervisor",
+           "ReplicaRouter", "Replica", "LocalReplica",
+           "RetryBudget", "make_router_server",
            "Telemetry", "Histogram",
            "ProfileSession", "render_histogram",
            "RequestHistory", "StallWatchdog", "new_request_id"]

@@ -366,7 +366,8 @@ class DecodeEngine:
                deadline_s: Optional[float] = None,
                shared_pages=None,
                rid: Optional[str] = None,
-               prefix_info=None) -> RequestGroup:
+               prefix_info=None,
+               resume_tokens: int = 0) -> RequestGroup:
         """Enqueue a request (may raise QueueFullError) and make sure
         the loop is running.  Returns the group; callers block on
         ``group.event``.  ``sampling`` carries the per-request
@@ -409,7 +410,20 @@ class DecodeEngine:
         the inbound/generated ``X-Request-Id``); None generates one,
         so EVERY group carries an ID into its trace spans and its
         request-history record.  ``prefix_info`` rides the history
-        record as prefix-cache hit provenance."""
+        record as prefix-cache hit provenance.
+
+        ``resume_tokens=N`` (single-row) declares the trailing N
+        prompt tokens a PRIOR attempt's committed output — the
+        cross-replica resume contract (docs/DESIGN.md): a router
+        failing a request over replays ``prompt ++
+        tokens_received_so_far`` and the stream re-enters through
+        the SAME preempt-resume machinery PR 6 pinned (re-prefill of
+        the committed prefix, re-admission feeding ``out[-1]`` with
+        ``next_index == len(out)``), so sampled draws continue at
+        position key N exactly as the uninterrupted run — on ANY
+        replica — would have drawn them.  ``new`` stays the
+        request's ORIGINAL total budget; the group's result is the
+        original prompt plus all ``new`` tokens."""
         if priority is None:
             priority = self.policy.default_priority
         if priority not in PRIORITIES:
@@ -444,7 +458,10 @@ class DecodeEngine:
                 reason="engine_down",
                 retry_after=self.policy.retry_after_s)
         if self.paged:
-            need = self._kv_tokens_needed(rows.shape[1], new)
+            # A resume replay carries prior output inside the prompt;
+            # the slot only ever holds original-prompt + budget.
+            need = self._kv_tokens_needed(
+                rows.shape[1] - int(resume_tokens or 0), new)
             if need > self.slots.capacity_tokens:
                 # Graceful overload, not deadlock: this request can
                 # NEVER fit the pool, so queue-waiting for evictions
@@ -483,7 +500,47 @@ class DecodeEngine:
                     "speculative requests cannot seed from a prefix "
                     "cache entry (the draft cache has no stored "
                     "prefill)")
-        if prefix is None:
+        if resume_tokens:
+            # CROSS-REPLICA RESUME: the trailing N prompt tokens are
+            # committed output from a prior attempt (router failover
+            # replay).  Split them back out and re-enter through the
+            # preempt-resume machinery — prepare_resume re-prefills
+            # ``prompt ++ out[:-1]`` in pow2 pieces, and admission
+            # feeds ``out[-1]`` at its original absolute position
+            # with ``next_index == len(out)``, so token N draws with
+            # exactly the position key an uninterrupted run uses.
+            rt = int(resume_tokens)
+            if prefix is not None:
+                raise ValueError(
+                    "resume_tokens cannot combine with a prefix-"
+                    "cache seed (the replayed prefix IS the state)")
+            if rows.shape[0] != 1:
+                raise ValueError(
+                    f"resume_tokens takes a single-row request (got "
+                    f"batch {rows.shape[0]}; multi-row failover "
+                    f"replays the whole request instead)")
+            if rt >= rows.shape[1]:
+                raise ValueError(
+                    f"resume_tokens ({rt}) must leave at least one "
+                    f"original prompt token (prompt length "
+                    f"{rows.shape[1]})")
+            if rt >= new:
+                raise ValueError(
+                    f"resume_tokens ({rt}) >= max_new_tokens "
+                    f"({new}): nothing left to generate")
+            out_prev = [int(t) for t in rows[0, rows.shape[1] - rt:]]
+            if eos_id is not None and eos_id in out_prev:
+                raise ValueError(
+                    "resume_tokens output already contains eos_id; "
+                    "the request is complete — nothing to resume")
+            orig = np.ascontiguousarray(rows[:, :rows.shape[1] - rt])
+            group = RequestGroup(orig, new, eos_id, [], sampling,
+                                 priority=priority)
+            stream = group.streams[0]
+            stream.out = out_prev
+            stream.prepare_resume(SchedulerPolicy.pow2_pieces(
+                orig.shape[1] + rt - 1))
+        elif prefix is None:
             pieces = self.policy.chunk_plan(rows.shape[1],
                                             prefill_chunk)
             group = RequestGroup(rows, new, eos_id, pieces, sampling,
@@ -1528,13 +1585,18 @@ class DecodeEngine:
             self._count_admitted(spec, stream.group.priority)
             self.evicted_total += 1
             return
-        if spec.speculative and stream.base_key is None:
+        if (spec.speculative or (resumed and spec.sampled)) \
+                and stream.base_key is None:
             # Greedy speculative streams never drew token 0 from the
             # PRNG, but the spec step program still wants the slot's
             # base key operand (the sampled lanes are dead at
             # temperature 0 — zeros would work — yet arming the real
             # key keeps one invariant: every speculative slot's key
-            # is fold_in(PRNGKey(seed), row)).
+            # is fold_in(PRNGKey(seed), row)).  A CROSS-REPLICA
+            # resumed sampled stream (submit resume_tokens=) skipped
+            # _first_token on THIS engine entirely — its token 0 was
+            # drawn by the prior attempt — so the key is armed here:
+            # same fold_in, pure function of the request.
             stream.base_key = np.asarray(jax.device_get(
                 jax.random.fold_in(jax.random.PRNGKey(spec.seed),
                                    stream.row)))

@@ -45,7 +45,22 @@ site               where it fires / what it simulates
 ``telemetry``      the engine's span/instant emission (raises
                    :class:`FaultInjected` — must stay ISOLATED:
                    counted, never request-fatal)
+``replica_kill``   FLEET site (polled by the router tier,
+                   serving/router.py): hard-kill replica ``replica``
+                   — listener closed, in-flight connections reset —
+                   the failover-and-resume path
+``replica_hang``   FLEET site: replica ``replica`` stops answering
+                   (connections accepted, never served) — the probe-
+                   timeout / hedged-request path
+``replica_slow``   FLEET site: replica ``replica`` slow-walks every
+                   request by ``delay_s`` — the tail-amplification
+                   pathology (arXiv:2011.03641) hedging absorbs
 =================  ========================================================
+
+Fleet sites are POLLED (:meth:`FaultPlan.poll`), not raised: the
+router consumes the fired spec and applies the fault to the target
+replica, so a seeded fleet chaos plan stays a pure function of the
+plan + the routed-request probe order.
 
 Plan schema (JSON)::
 
@@ -87,11 +102,16 @@ from .paged import PageExhausted
 
 __all__ = ["FaultPlan", "FaultSpec", "FaultInjected", "TransientFault",
            "PoisonedComputation", "EngineDeath", "SocketReset",
-           "InjectedPageExhausted", "SITES", "is_transient",
-           "is_poisoned"]
+           "InjectedPageExhausted", "SITES", "FLEET_SITES",
+           "is_transient", "is_poisoned"]
 
 SITES = ("step", "page_alloc", "slow_step", "engine_death",
-         "prefix_store", "socket_reset", "telemetry")
+         "prefix_store", "socket_reset", "telemetry",
+         "replica_kill", "replica_hang", "replica_slow")
+
+# Sites consumed by POLLING (the router tier applies the fault to a
+# replica) instead of by raising at the probe.
+FLEET_SITES = ("replica_kill", "replica_hang", "replica_slow")
 
 
 class FaultInjected(RuntimeError):
@@ -160,8 +180,8 @@ class FaultSpec:
     must fail at plan load, not silently never fire)."""
 
     __slots__ = ("site", "kind", "p", "after", "every", "times",
-                 "request_index", "rid", "delay_s", "probes", "fired",
-                 "target_rid", "_rng")
+                 "request_index", "rid", "delay_s", "replica",
+                 "probes", "fired", "target_rid", "_rng")
 
     def __init__(self, entry: Dict[str, Any], seed: int, index: int):
         if not isinstance(entry, dict):
@@ -169,12 +189,12 @@ class FaultSpec:
                              f"{type(entry).__name__}")
         unknown = set(entry) - {"site", "kind", "p", "after", "every",
                                 "times", "request_index", "rid",
-                                "delay_s"}
+                                "delay_s", "replica"}
         if unknown:
             raise ValueError(
                 f"unknown fault-spec field(s) {sorted(unknown)} "
                 f"(known: site/kind/p/after/every/times/"
-                f"request_index/rid/delay_s)")
+                f"request_index/rid/delay_s/replica)")
         site = entry.get("site")
         if site not in SITES:
             raise ValueError(
@@ -217,9 +237,25 @@ class FaultSpec:
                 "request_index (Nth engine submission, 0-based) or "
                 "rid (explicit request ID)")
         self.delay_s = float(entry.get("delay_s", 0.05))
-        if site == "slow_step" and self.delay_s <= 0:
+        if site in ("slow_step", "replica_slow") and self.delay_s <= 0:
             raise ValueError(
-                f"slow_step delay_s must be > 0; got {self.delay_s}")
+                f"{site} delay_s must be > 0; got {self.delay_s}")
+        # FLEET sites target a replica by index (the router resolves
+        # it modulo its fleet size, so one plan runs on any fleet).
+        rep = entry.get("replica")
+        if rep is not None and site not in FLEET_SITES:
+            raise ValueError(
+                f"'replica' only applies to fleet sites "
+                f"{FLEET_SITES} (got replica={rep!r} on site "
+                f"{site!r})")
+        if site in FLEET_SITES and rep is None:
+            raise ValueError(
+                f"fleet fault site {site!r} needs its target: "
+                f"'replica' (fleet index, 0-based)")
+        self.replica = int(rep) if rep is not None else None
+        if self.replica is not None and self.replica < 0:
+            raise ValueError(f"fault replica must be >= 0; got "
+                             f"{self.replica}")
         # Live state: eligible-probe count, fire count, and the
         # resolved target rid for request_index-keyed poisoned specs.
         self.probes = 0
@@ -241,6 +277,8 @@ class FaultSpec:
                 **({"request_index": self.request_index}
                    if self.request_index is not None else {}),
                 **({"rid": self.rid} if self.rid else {}),
+                **({"replica": self.replica}
+                   if self.replica is not None else {}),
                 "fired": self.fired}
 
 
@@ -306,6 +344,28 @@ class FaultPlan:
 
     # -- the probe -------------------------------------------------------
 
+    def _gates_pass(self, spec: FaultSpec) -> bool:
+        """after/every/p gating for one eligible probe (mutates the
+        spec's probe counter and draws from its seeded stream; the
+        caller holds ``_plan_lock``)."""
+        spec.probes += 1
+        if spec.probes <= spec.after:
+            return False
+        if spec.every > 1 and \
+                (spec.probes - spec.after - 1) % spec.every != 0:
+            return False
+        if spec.p < 1.0 and spec._rng.random() >= spec.p:
+            return False
+        return True
+
+    def _note_fired(self, spec: FaultSpec) -> None:
+        """Injection bookkeeping (caller holds ``_plan_lock``)."""
+        spec.fired += 1
+        self.injected[spec.site] = self.injected.get(spec.site, 0) + 1
+        self.injected_total += 1
+        self.last_site = spec.site
+        self.last_fault_t = time.time()
+
     def check(self, site: str,
               rids: Optional[Sequence[Optional[str]]] = None) -> None:
         """One probe at ``site``: raise the site's injected fault
@@ -325,20 +385,9 @@ class FaultPlan:
                     tgt = spec.target_rid
                     if tgt is None or rids is None or tgt not in rids:
                         continue
-                spec.probes += 1
-                if spec.probes <= spec.after:
+                if not self._gates_pass(spec):
                     continue
-                if spec.every > 1 and \
-                        (spec.probes - spec.after - 1) \
-                        % spec.every != 0:
-                    continue
-                if spec.p < 1.0 and spec._rng.random() >= spec.p:
-                    continue
-                spec.fired += 1
-                self.injected[site] = self.injected.get(site, 0) + 1
-                self.injected_total += 1
-                self.last_site = site
-                self.last_fault_t = time.time()
+                self._note_fired(spec)
                 if site == "slow_step":
                     delay = max(delay, spec.delay_s)
                     continue        # a sleep composes with a raise
@@ -351,6 +400,32 @@ class FaultPlan:
             time.sleep(delay)
         if to_fire is not None:
             raise self._exception_for(to_fire)
+
+    def poll(self, site: str) -> Optional[Dict[str, Any]]:
+        """One probe at a FLEET site: return the fired fault as a
+        dict (``{"site", "replica", "delay_s"}``) for the caller —
+        the router tier — to APPLY to the target replica, or None.
+        Polling, not raising: a replica fault is an action against
+        fleet state, not an exception on the probing thread.  Same
+        gates and counters as :meth:`check`, so a fleet plan's fire
+        pattern stays a pure function of (plan, probe order)."""
+        if site not in FLEET_SITES:
+            raise ValueError(
+                f"poll() takes a fleet site {FLEET_SITES}; got "
+                f"{site!r} (exception sites go through check())")
+        with self._plan_lock:
+            for spec in self.specs:
+                if spec.site != site:
+                    continue
+                if spec.times is not None \
+                        and spec.fired >= spec.times:
+                    continue
+                if not self._gates_pass(spec):
+                    continue
+                self._note_fired(spec)
+                return {"site": site, "replica": spec.replica,
+                        "delay_s": spec.delay_s}
+        return None
 
     @staticmethod
     def _exception_for(spec: FaultSpec) -> BaseException:

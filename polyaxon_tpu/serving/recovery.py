@@ -123,6 +123,7 @@ class CircuitBreaker:
         self.trips_total = 0
         self._crashes: "deque[float]" = deque()
         self._half_open_t: Optional[float] = None
+        self._probe_claimed = False
         self._lock = threading.Lock()
 
     def record_crash(self, now: Optional[float] = None) -> str:
@@ -160,6 +161,25 @@ class CircuitBreaker:
             if self.state == self.OPEN:
                 self.state = self.HALF_OPEN
                 self._half_open_t = time.monotonic()
+                self._probe_claimed = False
+
+    def try_probe(self) -> bool:
+        """Claim the HALF_OPEN state's single probe slot.
+
+        Exactly ONE caller gets True per half-open transition — the
+        half-open contract is "one trial, then judge", and concurrent
+        submitters racing a recovering replica must not all pile onto
+        it at once (that is the retry-storm shape a half-open state
+        exists to prevent).  The claim re-arms when a crash re-opens
+        the breaker and the next cooldown half-opens it again; a
+        ``record_success`` closes the breaker, after which callers
+        should route normally instead of probing.  Returns False in
+        every non-HALF_OPEN state."""
+        with self._lock:
+            if self.state != self.HALF_OPEN or self._probe_claimed:
+                return False
+            self._probe_claimed = True
+            return True
 
     def record_success(self) -> None:
         """A worked tick after recovery: a HALF_OPEN (or, defensively,

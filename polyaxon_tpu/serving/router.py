@@ -1,0 +1,1483 @@
+"""Replica router tier: fleet-grade fault tolerance in front of N
+``ModelServer`` replicas.
+
+PR 11 made ONE engine crash-only (supervised restart, requeue-and-
+resume, circuit breaker) — but the process stayed a single point of
+failure: kill the server and every caller is stranded.  This module
+is the robustness half of the serving fleet (ROADMAP item 2), in the
+Podracer decoupled-dataflow mold (arXiv:2104.06272): a front tier
+that treats replica death, slowness, and drain as ROUTINE SCHEDULING
+EVENTS, with the co-tenancy tail pathologies of arXiv:2011.03641 as
+the failure class the retry/hedging policy must never amplify.
+
+- :class:`Replica` — one routed endpoint (URL in production,
+  :class:`LocalReplica` spawns an in-process ``ModelServer`` fleet
+  for tests/benches).  Per replica: outstanding-request count, the
+  last health verdict, and a ``recovery.CircuitBreaker`` whose
+  HALF_OPEN state admits exactly ONE live probe request
+  (``try_probe``) before the replica re-enters rotation.
+- :class:`ReplicaRouter` — probes ``GET /healthz`` on an interval
+  (every probe socket carries an EXPLICIT timeout — the SOCKET-
+  TIMEOUT rule: a timeout-less probe is how a hung replica wedges
+  the router), parses the unified ``{"status", "reason"}`` schema
+  (503 ``draining``/``engine_down`` -> out of rotation, recovery ->
+  back in after a half-open success probe), and routes with
+  least-outstanding load balancing plus RADIX-PREFIX AFFINITY: a
+  request whose prompt extends a prefix registered via the router's
+  ``/prefill`` goes to the replica whose radix store already holds
+  it — unless that replica is saturated or unhealthy (affinity must
+  NEVER beat health).
+- FAILOVER, not client retries: a replica that dies mid-request gets
+  the request replayed on a healthy replica as ``prompt ++
+  tokens_received_so_far`` with ``resume_tokens`` (the cross-replica
+  resume contract, docs/DESIGN.md — position-keyed RNG makes the
+  resumed tokens bitwise identical per seed), governed by a global
+  bounded :class:`RetryBudget` (token bucket: retries+hedges may
+  never exceed a fraction of live traffic, so a sick fleet degrades
+  to fast 503 ``retry_budget`` instead of a retry storm) with
+  jittered backoff from the shared ``recovery.RetryPolicy``.
+- HEDGING (optional): a request sitting past the p99 watermark fires
+  a duplicate to a second replica; the first winner cancels the
+  loser by closing its connection — the replica's client-disconnect
+  probe cancels the request at its next step boundary (the PR 6
+  cancel path), so a hedge never double-spends decode budget to
+  completion.
+- ROLLING RESTART: ``POST /fleet/restart`` drains one replica at a
+  time (``/drain``, wait for in-flight zero, restart, re-admit via
+  health probe) and never drops the ready count below
+  ``min_ready``.  Requests shed by a drain race retry within budget
+  — zero failed requests is the contract, pinned in
+  tests/test_router.py.
+- FLEET CHAOS: ``fleet_faults`` arms the seeded ``faults.FaultPlan``
+  replica sites (``replica_kill`` / ``replica_hang`` /
+  ``replica_slow``), polled once per routed request, so a fleet
+  chaos run's fire pattern is a pure function of the plan.
+
+Observability rides the existing surfaces: one ``router.stats()``
+dict renders into ``GET /metrics`` (``ptpu_router_*`` gauges) and
+``GET /info``, and ``X-Request-Id`` is forwarded replica-ward with a
+replica-id prefix (``r0-<rid>`` — the convention serving/debug.py
+documents) so one request's history is traceable across a failover.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from .debug import new_request_id, sanitize_request_id
+from .faults import FLEET_SITES, FaultPlan
+from .recovery import CircuitBreaker, RetryPolicy
+
+__all__ = ["Replica", "LocalReplica", "ReplicaRouter", "RetryBudget",
+           "make_router_server"]
+
+logger = logging.getLogger(__name__)
+
+
+class RetryBudget:
+    """Global bounded retry budget: a token bucket refilled by LIVE
+    traffic.
+
+    Every admitted request deposits ``ratio`` tokens (capped at
+    ``burst``); every retry or hedge withdraws one.  The invariant —
+    retries can never exceed ``ratio`` x live traffic plus the
+    ``burst`` head start — is what keeps a sick fleet from
+    amplifying itself into a retry storm (arXiv:2011.03641's
+    concurrency-limit pathology applied to the router tier): when
+    every replica is failing, the bucket drains and callers get FAST
+    503 ``retry_budget`` instead of N x the load.  Counters are the
+    pinned evidence (``tests/test_router.py``): ``withdrawals +
+    denied`` exactly accounts for every retry decision ever made."""
+
+    def __init__(self, ratio: float = 0.1, burst: float = 8.0):
+        if ratio < 0:
+            raise ValueError(f"retry ratio must be >= 0; got {ratio}")
+        if burst < 1:
+            raise ValueError(f"retry burst must be >= 1; got {burst}")
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self._level = float(burst)     # start full: a cold fleet must
+        #                                be able to fail over at once
+        self._lock = threading.Lock()
+        self.deposits_total = 0.0
+        self.withdrawals_total = 0
+        self.denied_total = 0
+
+    def on_request(self) -> None:
+        """One live request admitted: deposit ``ratio`` tokens."""
+        with self._lock:
+            self._level = min(self.burst, self._level + self.ratio)
+            self.deposits_total += self.ratio
+
+    def try_spend(self) -> bool:
+        """Withdraw one token for a retry/hedge; False = budget
+        exhausted (the caller sheds fast instead of retrying)."""
+        with self._lock:
+            if self._level >= 1.0:
+                self._level -= 1.0
+                self.withdrawals_total += 1
+                return True
+            self.denied_total += 1
+            return False
+
+    def level(self) -> float:
+        with self._lock:
+            return round(self._level, 3)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"retry_budget_level": round(self._level, 3),
+                    "retry_budget_ratio": self.ratio,
+                    "retry_budget_burst": self.burst,
+                    "retry_budget_spent_total": self.withdrawals_total,
+                    "retry_budget_denied_total": self.denied_total}
+
+
+class Replica:
+    """One routed endpoint + its health state.
+
+    The health machine mirrors ``recovery.CircuitBreaker`` semantics
+    per replica: transport failures (probe or live) are "crashes";
+    ``down_after`` of them inside the breaker window trips the
+    replica OUT of rotation; after ``cooldown_s`` a healthy probe
+    HALF-OPENs it, and exactly one live request (``breaker.
+    try_probe``) — or a second consecutive healthy probe — closes it
+    back IN.  A 503 from the replica itself (``reason: draining`` /
+    ``engine_down`` — the unified /healthz schema) is an HONEST
+    not-ready, tracked separately from crash suspicion: it clears
+    the moment the replica answers 200 again, with no cooldown."""
+
+    restartable = False
+
+    def __init__(self, url: str, replica_id: str, *,
+                 down_after: int = 2, window_s: float = 30.0,
+                 cooldown_s: float = 1.0):
+        parsed = urlparse(url if "//" in url else "http://" + url)
+        if parsed.scheme not in ("http", ""):
+            raise ValueError(
+                f"replica URL must be http:// (got {url!r}; the "
+                f"stdlib router tier does not speak TLS — put it "
+                f"behind your ingress)")
+        if not parsed.hostname or not parsed.port:
+            raise ValueError(
+                f"replica URL needs host:port (got {url!r})")
+        self.host = parsed.hostname
+        self.port = int(parsed.port)
+        self.url = f"http://{self.host}:{self.port}"
+        self.id = replica_id
+        self.breaker = CircuitBreaker(
+            threshold=down_after, window_s=window_s,
+            cooldown_s=cooldown_s)
+        self.health_ok = True          # optimistic until probed
+        self.health_reason: Optional[str] = None
+        self.draining = False          # router-side rotation latch
+        #                                (rolling restart)
+        self.consecutive_probe_failures = 0
+        self.last_failure_t: Optional[float] = None
+        self.requests_total = 0
+        self.failures_total = 0
+        self._out_lock = threading.Lock()
+        self.outstanding = 0
+
+    # -- rotation --------------------------------------------------------
+
+    def eligible(self) -> bool:
+        """In rotation for NORMAL routing (HALF_OPEN is handled by
+        the router via ``breaker.try_probe`` — one live probe)."""
+        return (not self.draining and self.health_ok
+                and self.breaker.state == CircuitBreaker.CLOSED)
+
+    def up(self) -> bool:
+        """The readiness gauge (``ptpu_router_replica_up``) and the
+        rolling restart's min-ready accounting."""
+        return self.eligible()
+
+    def note_failure(self, now: Optional[float] = None) -> None:
+        """Transport-level evidence against this replica (probe or
+        live request): feeds the breaker."""
+        self.failures_total += 1
+        self.last_failure_t = time.monotonic() if now is None else now
+        self.breaker.record_crash(self.last_failure_t)
+
+    def note_success(self) -> None:
+        self.breaker.record_success()
+
+    def maybe_half_open(self) -> None:
+        """A healthy probe on an OPEN breaker: half-open once the
+        cooldown since the last failure has elapsed (the supervisor's
+        cooldown-then-probe cycle, router-side)."""
+        if self.breaker.state != CircuitBreaker.OPEN:
+            return
+        last = self.last_failure_t
+        if last is None or time.monotonic() - last \
+                >= self.breaker.cooldown_s:
+            self.breaker.half_open()
+
+    # -- accounting ------------------------------------------------------
+
+    def inc_outstanding(self) -> None:
+        with self._out_lock:
+            self.outstanding += 1
+            self.requests_total += 1
+
+    def dec_outstanding(self) -> None:
+        with self._out_lock:
+            self.outstanding = max(0, self.outstanding - 1)
+
+    # -- chaos hooks (LocalReplica implements; URL replicas are not
+    #    controllable from here) ----------------------------------------
+
+    def chaos_kill(self) -> bool:
+        return False
+
+    def chaos_hang(self) -> bool:
+        return False
+
+    def chaos_slow(self, delay_s: float) -> bool:
+        return False
+
+    def restart(self) -> None:
+        raise RuntimeError(
+            f"replica {self.id} ({self.url}) is not restartable "
+            f"from this router (URL replicas restart via their own "
+            f"orchestrator; drain it with POST {self.url}/drain)")
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "id": self.id, "url": self.url,
+            "up": self.up(),
+            "state": ("draining" if self.draining
+                      else self.breaker.state if not self.health_ok
+                      or self.breaker.state != CircuitBreaker.CLOSED
+                      else "up"),
+            "breaker": self.breaker.state,
+            **({"health_reason": self.health_reason}
+               if self.health_reason else {}),
+            "outstanding": self.outstanding,
+            "consecutive_probe_failures":
+                self.consecutive_probe_failures,
+            "requests_total": self.requests_total,
+            "failures_total": self.failures_total,
+        }
+
+
+class _ChaosHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with replica-level chaos hooks: ``killed``
+    (connections closed unanswered, listener down), ``hang_event``
+    (connections accepted, held silently — the probe-timeout
+    pathology), ``slow_s`` (every request slow-walked — the tail
+    pathology hedging absorbs).  Tracks live client sockets so
+    ``kill`` can reset in-flight connections the way a process death
+    would."""
+
+    request_queue_size = 128
+    daemon_threads = True
+
+    def __init__(self, addr, handler):
+        super().__init__(addr, handler)
+        self.killed = False
+        self.hang_event = threading.Event()
+        self.slow_s = 0.0
+        self._conn_lock = threading.Lock()
+        self._conns: set = set()
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def finish_request(self, request, client_address):
+        if self.killed:
+            return                      # closed unanswered
+        while self.hang_event.is_set() and not self.killed:
+            # Hold the connection open, serve nothing: the router's
+            # EXPLICIT socket timeouts are what keep this from
+            # wedging anything upstream.
+            time.sleep(0.02)
+        if self.killed:
+            return
+        if self.slow_s > 0.0:
+            time.sleep(self.slow_s)
+        super().finish_request(request, client_address)
+
+    def reset_connections(self) -> None:
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class LocalReplica(Replica):
+    """An in-process replica: spawns a ``ModelServer`` from
+    ``factory`` behind a chaos-capable HTTP server on a local port.
+    The test/bench fleet substrate — and the restart hook the rolling
+    restart drives.  ``factory()`` must return a fresh
+    ``ModelServer`` (it is called again on ``restart``)."""
+
+    restartable = True
+
+    def __init__(self, factory: Callable[[], Any], replica_id: str,
+                 *, host: str = "127.0.0.1", **kw):
+        self.factory = factory
+        self._spawn_host = host
+        self.ms = factory()
+        self.srv = _ChaosHTTPServer((host, 0), _replica_handler(
+            self.ms))
+        self._serve_thread = threading.Thread(
+            target=self.srv.serve_forever, daemon=True,
+            name=f"replica-{replica_id}")
+        self._serve_thread.start()
+        port = self.srv.server_address[1]
+        super().__init__(f"http://{host}:{port}", replica_id, **kw)
+
+    # -- chaos -----------------------------------------------------------
+
+    def chaos_kill(self) -> bool:
+        """Process-death simulation: listener closed (new connections
+        refused), in-flight connections reset unanswered, engine
+        stopped.  ``restart`` brings a fresh server up on the SAME
+        port."""
+        self.srv.killed = True
+        self.srv.shutdown()
+        self.srv.server_close()
+        self.srv.reset_connections()
+        try:
+            self.ms.close()
+        except Exception:
+            logger.debug("replica %s kill: ModelServer close failed",
+                         self.id, exc_info=True)
+        return True
+
+    def chaos_hang(self) -> bool:
+        self.srv.hang_event.set()
+        return True
+
+    def chaos_unhang(self) -> bool:
+        self.srv.hang_event.clear()
+        return True
+
+    def chaos_slow(self, delay_s: float) -> bool:
+        self.srv.slow_s = float(delay_s)
+        return True
+
+    def restart(self) -> None:
+        """Fresh ``ModelServer`` + HTTP server on the same port (the
+        rolling-restart unit).  Also the recovery path after
+        ``chaos_kill``."""
+        if not self.srv.killed:
+            # A live server restarting in place: take the old one
+            # down first (the rolling restart drained it already).
+            self.srv.killed = True
+            self.srv.shutdown()
+            self.srv.server_close()
+            self.srv.reset_connections()
+            try:
+                self.ms.close()
+            except Exception:
+                logger.debug(
+                    "replica %s restart: old ModelServer close "
+                    "failed", self.id, exc_info=True)
+        self.ms = self.factory()
+        self.srv = _ChaosHTTPServer((self._spawn_host, self.port),
+                                    _replica_handler(self.ms))
+        self._serve_thread = threading.Thread(
+            target=self.srv.serve_forever, daemon=True,
+            name=f"replica-{self.id}")
+        self._serve_thread.start()
+
+    def close(self) -> None:
+        try:
+            self.srv.killed = True
+            self.srv.shutdown()
+            self.srv.server_close()
+            self.srv.reset_connections()
+        except Exception:
+            logger.debug("replica %s close: HTTP server teardown "
+                         "failed", self.id, exc_info=True)
+        try:
+            self.ms.close()
+        except Exception:
+            logger.debug("replica %s close: ModelServer close "
+                         "failed", self.id, exc_info=True)
+
+
+def _replica_handler(ms):
+    """The ModelServer's own HTTP handler class, mounted on the
+    chaos-capable server instead of make_server's plain one."""
+    from .server import make_handler
+
+    return make_handler(ms)
+
+
+class _Attempt:
+    """One in-flight forwarded request: its own connection (with an
+    EXPLICIT timeout), its own thread, and a cancel that closes the
+    socket — which IS the replica-side cancel path (the client-
+    disconnect probe evicts the request at the next step boundary,
+    PR 6)."""
+
+    def __init__(self, replica: Replica, method: str, path: str,
+                 body: bytes, headers: Dict[str, str],
+                 timeout_s: float):
+        self.replica = replica
+        self.method = method
+        self.path = path
+        self.body = body
+        self.headers = headers
+        self.timeout_s = max(0.05, float(timeout_s))
+        self.done = threading.Event()
+        self.code: Optional[int] = None
+        self.resp: Optional[Dict[str, Any]] = None
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "_Attempt":
+        self.replica.inc_outstanding()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"route-{self.replica.id}")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                self.replica.host, self.replica.port,
+                timeout=self.timeout_s)
+            self._conn = conn
+            conn.request(self.method, self.path, self.body,
+                         self.headers)
+            r = conn.getresponse()
+            data = r.read()
+            self.code = r.status
+            try:
+                self.resp = json.loads(data)
+            except (ValueError, TypeError):
+                self.resp = {"error": "replica returned a non-JSON "
+                                      "body"}
+        except BaseException as e:  # transport verdicts, incl. timeout
+            self.error = e
+        finally:
+            self.replica.dec_outstanding()
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self.done.set()
+
+    def cancel(self) -> None:
+        """First-winner-cancels: closing the connection delivers the
+        replica-side cancel (the disconnect probe — PR 6), so the
+        loser stops burning decode budget at its next boundary."""
+        self.cancelled = True
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- outcome classification -----------------------------------------
+
+    RETRYABLE_REASONS = frozenset({"draining", "engine_down"})
+
+    def outcome(self) -> str:
+        """``ok`` | ``retryable`` | ``terminal`` — the router's whole
+        decision space.  Retryable: transport death (connect
+        refused/reset, read timeout — a dead or hung replica), 429
+        (that replica's queue is full; another may be idle), and the
+        replica-level 503s (``draining``/``engine_down``).  Terminal:
+        everything else — 400s, poisoned convictions, deterministic
+        sheds (``kv_pages`` fails identically fleet-wide; retrying it
+        amplifies load for nothing)."""
+        if self.error is not None:
+            return "retryable"
+        if self.code == 200:
+            return "ok"
+        if self.code == 429:
+            return "retryable"
+        if self.code == 503:
+            reason = (self.resp or {}).get("reason")
+            if reason in self.RETRYABLE_REASONS:
+                return "retryable"
+        return "terminal"
+
+
+class ReplicaRouter:
+    """The front tier: owns N replicas, probes their health, routes
+    with least-outstanding + prefix affinity, fails over with a
+    bounded retry budget, hedges stragglers, and rolls restarts.
+    See the module docstring for the full design."""
+
+    def __init__(self, replicas: List, *,
+                 probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 2.0,
+                 down_after: int = 2,
+                 cooldown_s: float = 1.0,
+                 retry_ratio: float = 0.1,
+                 retry_burst: float = 8.0,
+                 max_attempts: int = 3,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 request_timeout_s: float = 120.0,
+                 hedge: str = "off",
+                 hedge_min_s: float = 0.2,
+                 affinity: bool = True,
+                 affinity_max_outstanding: int = 8,
+                 affinity_entries: int = 64,
+                 min_ready: int = 1,
+                 fleet_faults=None,
+                 autostart: bool = True):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas: List[Replica] = []
+        for i, r in enumerate(replicas):
+            if isinstance(r, Replica):
+                self.replicas.append(r)
+            else:
+                r = Replica(str(r), f"r{i}")
+                self.replicas.append(r)
+            # The ROUTER owns rotation policy: its down_after /
+            # cooldown_s knobs configure every replica's breaker,
+            # constructed or passed (a passed Replica's ctor-default
+            # breaker silently overriding the router's knobs was a
+            # real config trap — the test/bench fleets all pass
+            # instances).
+            r.breaker = CircuitBreaker(
+                threshold=down_after, window_s=r.breaker.window_s,
+                cooldown_s=cooldown_s)
+        ids = [r.id for r in self.replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        if probe_interval_s <= 0 or probe_timeout_s <= 0:
+            raise ValueError(
+                f"probe_interval_s and probe_timeout_s must be > 0; "
+                f"got {probe_interval_s}, {probe_timeout_s}")
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1; got "
+                             f"{max_attempts}")
+        if request_timeout_s <= 0:
+            raise ValueError(f"request_timeout_s must be > 0; got "
+                             f"{request_timeout_s}")
+        if min_ready < 0:
+            raise ValueError(f"min_ready must be >= 0; got "
+                             f"{min_ready}")
+        if hedge != "off" and hedge != "p99":
+            try:
+                float(hedge)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"hedge must be 'off', 'p99', or a threshold in "
+                    f"seconds; got {hedge!r}")
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_attempts = int(max_attempts)
+        self.budget = RetryBudget(retry_ratio, retry_burst)
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy(max_attempts=max_attempts,
+                             base_delay_s=0.02, max_delay_s=0.5)
+        self.hedge = hedge
+        self.hedge_min_s = float(hedge_min_s)
+        self.affinity_enabled = bool(affinity)
+        self.affinity_max_outstanding = int(affinity_max_outstanding)
+        self.min_ready = int(min_ready)
+        self.fleet_faults = FaultPlan.load(fleet_faults) \
+            if fleet_faults is not None else None
+        self.draining = False
+        # Prefix-affinity map: registered-prefix token tuple ->
+        # replica id, LRU-bounded.  Router-side mirror of what each
+        # replica's radix store holds; longest-match by scan (the
+        # registered-prefix population is small — system prompts).
+        from collections import OrderedDict
+
+        self._affinity: "OrderedDict[Tuple[int, ...], str]" = \
+            OrderedDict()
+        self._affinity_cap = int(affinity_entries)
+        self._affinity_lock = threading.Lock()
+        # Latency window for the hedge watermark (the engine's
+        # sliding-p99 idiom: recent observations, never the
+        # cumulative histogram).
+        from collections import deque
+
+        self._lat_recent: "deque[float]" = deque(maxlen=64)
+        self._lat_lock = threading.Lock()
+        # Counters (one stats() dict -> /metrics + /info, no drift).
+        self._stats_lock = threading.Lock()
+        self.requests_total = 0
+        self.completed_total = 0
+        self.errors_total = 0
+        self.shed_total = 0            # router-level fast 503s
+        self.failovers_total = 0
+        self.resumed_tokens_total = 0
+        self.resumes_total = 0         # failovers replayed WITH
+        #                                partial output
+        self.hedges_fired_total = 0
+        self.hedges_won_total = 0
+        self.hedges_cancelled_total = 0
+        self.fleet_faults_applied: Dict[str, int] = {}
+        self._rr = 0                   # least-outstanding tiebreak
+        # Rolling restart state (one at a time; POST /fleet/restart).
+        # ``restart_state["completed"]`` is per-RUN progress (resets
+        # each restart); ``restarts_completed_total`` is the
+        # monotonic counter /metrics exports — a Prometheus counter
+        # must never go backwards.
+        self._restart_lock = threading.Lock()
+        self.restarts_completed_total = 0
+        self.restart_state: Dict[str, Any] = {
+            "in_progress": False, "completed": 0, "rounds_total": 0,
+            "last_error": None, "min_ready_floor_observed": None}
+        self._stop = False
+        self._probe_thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._probe_thread is not None \
+                and self._probe_thread.is_alive():
+            return
+        self._stop = False
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="router-probe")
+        self._probe_thread.start()
+
+    def close(self) -> None:
+        self._stop = True
+        t = self._probe_thread
+        if t is not None:
+            t.join(timeout=self.probe_timeout_s
+                   * max(2, len(self.replicas)) + 5)
+
+    def drain(self) -> Dict[str, Any]:
+        """Router-level drain: stop admitting (503 ``draining``) —
+        the replicas keep running; drain them individually or via
+        the rolling restart."""
+        self.draining = True
+        return {"draining": True}
+
+    # -- health probing --------------------------------------------------
+
+    def _http_json(self, replica: Replica, method: str, path: str,
+                   *, body: Optional[bytes] = None
+                   ) -> Tuple[Optional[int], Dict[str, Any]]:
+        """One bounded HTTP exchange with a replica: ``(status,
+        parsed-JSON-dict)``, or ``(None, {})`` on transport failure.
+        The ONE copy of the connect/request/parse/close sequence the
+        probe, drain, and re-admission paths share (every connection
+        carries the explicit ``probe_timeout_s`` — SOCKET-TIMEOUT)."""
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                replica.host, replica.port,
+                timeout=self.probe_timeout_s)
+            conn.request(method, path, body,
+                         {"Content-Type": "application/json"}
+                         if body is not None else {})
+            r = conn.getresponse()
+            raw = r.read()
+            try:
+                parsed = json.loads(raw)
+                if not isinstance(parsed, dict):
+                    parsed = {}
+            except (ValueError, TypeError):
+                parsed = {}
+            return r.status, parsed
+        except (OSError, http.client.HTTPException):
+            return None, {}
+        finally:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _probe_once(self, replica: Replica) -> None:
+        """One /healthz probe.  200 -> healthy (half-open/close the
+        breaker per the recovery semantics); 503 with the unified
+        schema -> honest not-ready; transport failure -> crash
+        evidence."""
+        status, parsed = self._http_json(replica, "GET", "/healthz")
+        if status is None:
+            replica.consecutive_probe_failures += 1
+            replica.health_ok = False
+            replica.health_reason = "unreachable"
+            replica.note_failure()
+            return
+        replica.consecutive_probe_failures = 0
+        if status == 200:
+            replica.health_ok = True
+            replica.health_reason = None
+            st = replica.breaker.state
+            if st == CircuitBreaker.OPEN:
+                replica.maybe_half_open()
+            elif st == CircuitBreaker.HALF_OPEN:
+                # Second consecutive healthy probe: the half-open
+                # success probe an idle fleet needs (live traffic
+                # closes it sooner via try_probe + success).
+                replica.note_success()
+        else:
+            replica.health_ok = False
+            replica.health_reason = parsed.get(
+                "reason", parsed.get("status", f"http_{status}"))
+
+    def _probe_loop(self) -> None:
+        while not self._stop:
+            for replica in self.replicas:
+                if self._stop:
+                    return
+                self._probe_once(replica)
+            deadline = time.monotonic() + self.probe_interval_s
+            while not self._stop and time.monotonic() < deadline:
+                time.sleep(0.02)
+
+    # -- affinity --------------------------------------------------------
+
+    def _note_prefix(self, toks: Tuple[int, ...],
+                     replica_id: str) -> None:
+        with self._affinity_lock:
+            self._affinity[toks] = replica_id
+            self._affinity.move_to_end(toks)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
+
+    def _affinity_for(self, prompt: Optional[List[int]]
+                      ) -> Optional[str]:
+        """The replica holding the LONGEST registered prefix of this
+        prompt, or None."""
+        if not self.affinity_enabled or not prompt:
+            return None
+        best_len, best = 0, None
+        with self._affinity_lock:
+            for toks, rid in self._affinity.items():
+                n = len(toks)
+                if n > best_len and n <= len(prompt) \
+                        and list(toks) == prompt[:n]:
+                    best_len, best = n, rid
+        return best
+
+    # -- replica selection -----------------------------------------------
+
+    def _pick(self, prompt: Optional[List[int]],
+              exclude: set) -> Optional[Replica]:
+        """Least-outstanding among in-rotation replicas, with prefix
+        affinity as a PREFERENCE: the affinity replica wins only
+        while it is healthy and below the saturation bound —
+        affinity must never beat health (pinned)."""
+        eligible = [r for r in self.replicas
+                    if r.id not in exclude and r.eligible()]
+        half_open = [r for r in self.replicas
+                     if r.id not in exclude and not r.draining
+                     and r.health_ok
+                     and r.breaker.state == CircuitBreaker.HALF_OPEN]
+        aff = self._affinity_for(prompt)
+        if aff is not None:
+            for r in eligible:
+                if r.id == aff and r.outstanding \
+                        < self.affinity_max_outstanding:
+                    return r
+        if eligible:
+            self._rr += 1
+            return min(
+                eligible,
+                key=lambda r: (r.outstanding,
+                               (self.replicas.index(r) + self._rr)
+                               % len(self.replicas)))
+        # No closed replica in rotation: offer a HALF_OPEN one its
+        # single live probe (exactly one concurrent claimant passes —
+        # recovery.CircuitBreaker.try_probe).
+        for r in half_open:
+            if r.breaker.try_probe():
+                return r
+        return None
+
+    # -- fleet chaos -----------------------------------------------------
+
+    def _poll_fleet_faults(self) -> None:
+        if self.fleet_faults is None:
+            return
+        for site in FLEET_SITES:
+            fired = self.fleet_faults.poll(site)
+            if fired is None:
+                continue
+            idx = fired["replica"] % len(self.replicas)
+            replica = self.replicas[idx]
+            applied = False
+            if site == "replica_kill":
+                applied = replica.chaos_kill()
+            elif site == "replica_hang":
+                applied = replica.chaos_hang()
+            elif site == "replica_slow":
+                applied = replica.chaos_slow(fired["delay_s"])
+            with self._stats_lock:
+                key = site if applied else site + "_unsupported"
+                self.fleet_faults_applied[key] = \
+                    self.fleet_faults_applied.get(key, 0) + 1
+
+    # -- the hedge watermark ---------------------------------------------
+
+    def _observe_latency(self, dt: float) -> None:
+        with self._lat_lock:
+            self._lat_recent.append(dt)
+
+    def _hedge_after_s(self) -> Optional[float]:
+        if self.hedge == "off":
+            return None
+        if self.hedge != "p99":
+            return max(self.hedge_min_s, float(self.hedge))
+        with self._lat_lock:
+            xs = sorted(self._lat_recent)
+        if len(xs) < 8:
+            # Too little signal for a p99: hedge only past the floor.
+            return self.hedge_min_s if xs else None
+        idx = min(len(xs) - 1, int(0.99 * (len(xs) - 1) + 0.9999))
+        return max(self.hedge_min_s, xs[idx])
+
+    # -- routing ---------------------------------------------------------
+
+    def _forward_headers(self, replica: Replica,
+                         rid: str) -> Dict[str, str]:
+        """X-Request-Id forwarded REPLICA-WARD with the replica-id
+        prefix (serving/debug.py's convention): the replica's access
+        log, trace ring, and /requests/<id> all key on
+        ``r0-<rid>`` — one grep string per (request, replica) leg of
+        a failover."""
+        fwd = f"{replica.id}-{rid}"[:128]
+        return {"Content-Type": "application/json",
+                "X-Request-Id": fwd}
+
+    def _race(self, primary: _Attempt, deadline: float,
+              payload_bytes: bytes, rid: str, prompt,
+              exclude: set) -> Tuple[_Attempt, Optional[_Attempt]]:
+        """Wait the primary out, optionally firing ONE hedge at the
+        watermark; returns (winner, loser).  The winner is the first
+        attempt to reach a decisive outcome (ok/terminal); a
+        retryable loser is just evidence, and a still-running loser
+        is CANCELLED (connection close -> replica-side cancel)."""
+        hedge_after = self._hedge_after_s()
+        hedge: Optional[_Attempt] = None
+        t0 = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                # The caller maps this to a retryable timeout on the
+                # primary; cancel everything in flight.
+                primary.cancel()
+                if hedge is not None:
+                    hedge.cancel()
+                    with self._stats_lock:
+                        self.hedges_cancelled_total += 1
+                return primary, hedge
+            if primary.done.is_set() and (
+                    hedge is None or hedge.done.is_set()
+                    or primary.outcome() != "retryable"):
+                # Primary decided (or both are done).
+                if hedge is not None and not hedge.done.is_set():
+                    hedge.cancel()
+                    with self._stats_lock:
+                        self.hedges_cancelled_total += 1
+                return primary, hedge
+            if hedge is not None and hedge.done.is_set() \
+                    and hedge.outcome() != "retryable":
+                # The hedge won: cancel the straggling primary (the
+                # PR 6 cancel path reclaims its slot).
+                primary_live = not primary.done.is_set()
+                if primary_live:
+                    primary.cancel()
+                with self._stats_lock:
+                    self.hedges_won_total += 1
+                    if primary_live:
+                        self.hedges_cancelled_total += 1
+                return hedge, primary
+            if hedge is None and hedge_after is not None \
+                    and now - t0 >= hedge_after \
+                    and not primary.done.is_set():
+                second = self._pick(
+                    prompt, exclude | {primary.replica.id})
+                if second is not None and self.budget.try_spend():
+                    hedge = _Attempt(
+                        second, "POST", "/generate", payload_bytes,
+                        self._forward_headers(second, rid),
+                        min(self.request_timeout_s,
+                            max(0.05, deadline - now))).start()
+                    with self._stats_lock:
+                        self.hedges_fired_total += 1
+                else:
+                    hedge_after = None      # nothing to hedge onto
+            # BLOCK, don't poll: before a hedge exists the only
+            # wake-up sources are the primary finishing, the hedge
+            # watermark, and the deadline — wait on the primary's
+            # event up to the nearest of them.  Once a hedge is in
+            # flight there are two events to watch, so a short
+            # bounded wait keeps the race responsive (the hedge
+            # window is the rare tail case, not the steady state).
+            if hedge is None:
+                wake = deadline
+                if hedge_after is not None:
+                    wake = min(wake, t0 + hedge_after)
+                primary.done.wait(
+                    max(0.001, wake - time.monotonic()))
+            elif primary.done.is_set():
+                # Primary already decided (retryable, or we'd have
+                # returned): the hedge is the only pending event.
+                hedge.done.wait(
+                    max(0.001, deadline - time.monotonic()))
+            else:
+                primary.done.wait(0.005)
+
+    def route_generate(self, req: Dict[str, Any],
+                       rid: Optional[str] = None
+                       ) -> Tuple[int, Dict[str, Any]]:
+        """Route one /generate body; returns (status, response).
+        Failure handling lives HERE, not in the client: failover with
+        resume replay, bounded by the retry budget and
+        ``max_attempts``, hedged past the p99 watermark."""
+        rid = rid or new_request_id()
+        if self.draining:
+            with self._stats_lock:
+                self.shed_total += 1
+            return 503, {"error": "router is draining",
+                         "reason": "draining", "request_id": rid}
+        self._poll_fleet_faults()
+        with self._stats_lock:
+            self.requests_total += 1
+        self.budget.on_request()
+        prompt = None
+        rows = req.get("prompt")
+        if isinstance(rows, list) and rows:
+            prompt = rows[0] if isinstance(rows[0], list) else rows
+        deadline_ms = req.get("deadline_ms")
+        t0 = time.monotonic()
+        deadline = t0 + (min(self.request_timeout_s,
+                             deadline_ms / 1e3)
+                         if isinstance(deadline_ms, (int, float))
+                         and not isinstance(deadline_ms, bool)
+                         and deadline_ms > 0
+                         else self.request_timeout_s)
+        partial: List[int] = []        # tokens recovered so far —
+        #                                replayed with resume_tokens
+        #                                (populated by the streaming
+        #                                protocol, ROADMAP item 1;
+        #                                empty replays are full
+        #                                replays, same contract)
+        exclude: set = set()
+        attempt_n = 0
+        while True:
+            payload = dict(req)
+            if partial:
+                # CROSS-REPLICA RESUME: prompt ++ received tokens,
+                # RNG continues at position key len(partial)
+                # (docs/DESIGN.md; token-identical per seed).
+                payload["prompt"] = list(prompt) + partial
+                payload["resume_tokens"] = len(partial)
+            body = json.dumps(payload).encode()
+            replica = self._pick(prompt, exclude)
+            if replica is None and exclude:
+                # Every replica already failed this request once:
+                # widen back out rather than shedding while capacity
+                # exists (the failed one may have merely been busy).
+                exclude = set()
+                replica = self._pick(prompt, exclude)
+            if replica is None:
+                with self._stats_lock:
+                    self.shed_total += 1
+                    self.errors_total += 1
+                return 503, {
+                    "error": "no replica in rotation",
+                    "reason": "no_replica", "request_id": rid,
+                    "router": self._route_info(None, attempt_n,
+                                               partial)}
+            attempt_n += 1
+            att = _Attempt(
+                replica, "POST", "/generate", body,
+                self._forward_headers(replica, rid),
+                min(self.request_timeout_s,
+                    max(0.05, deadline - time.monotonic()))).start()
+            winner, loser = self._race(att, deadline, body, rid,
+                                       prompt, exclude)
+            out = winner.outcome() if winner.done.is_set() \
+                else "retryable"
+            if out == "ok":
+                winner.replica.note_success()
+                resp = dict(winner.resp or {})
+                # Recover the tokens generated by THIS attempt so a
+                # later consumer (and the stats) see the stitched
+                # stream; the replica already returned the FULL
+                # sequence (resume replays carry the original budget).
+                if partial:
+                    with self._stats_lock:
+                        self.resumes_total += 1
+                        self.resumed_tokens_total += len(partial)
+                resp["request_id"] = rid
+                resp["router"] = self._route_info(
+                    winner.replica, attempt_n, partial,
+                    hedged=(winner is not att))
+                self._observe_latency(time.monotonic() - t0)
+                with self._stats_lock:
+                    self.completed_total += 1
+                return 200, resp
+            if out == "terminal":
+                code = winner.code or 500
+                resp = dict(winner.resp or {"error": "replica error"})
+                resp["request_id"] = rid
+                resp["router"] = self._route_info(
+                    winner.replica, attempt_n, partial,
+                    hedged=(winner is not att))
+                with self._stats_lock:
+                    self.errors_total += 1
+                return code, resp
+            # Retryable: evidence against the replica, then fail
+            # over within budget.  An attempt the ROUTER itself
+            # cancelled (deadline expiry, hedge race) is NOT crash
+            # evidence — its socket error is self-inflicted, and
+            # counting it would let sustained short-deadline traffic
+            # breaker-trip perfectly healthy replicas.
+            for a in (att, loser):
+                if a is not None and a.done.is_set() \
+                        and a.outcome() == "retryable" \
+                        and a.error is not None \
+                        and not a.cancelled:
+                    a.replica.note_failure()
+                if a is not None:
+                    exclude.add(a.replica.id)
+            if time.monotonic() >= deadline:
+                with self._stats_lock:
+                    self.errors_total += 1
+                return 504, {
+                    "error": f"request deadline exhausted after "
+                             f"{attempt_n} attempt(s)",
+                    "reason": "deadline", "request_id": rid,
+                    "router": self._route_info(replica, attempt_n,
+                                               partial)}
+            if attempt_n >= self.max_attempts:
+                with self._stats_lock:
+                    self.errors_total += 1
+                    self.shed_total += 1
+                return 503, {
+                    "error": f"request failed on {attempt_n} "
+                             f"replica(s); attempts exhausted",
+                    "reason": "retries_exhausted", "request_id": rid,
+                    "router": self._route_info(replica, attempt_n,
+                                               partial)}
+            if not self.budget.try_spend():
+                # The sick-fleet contract: degrade to a FAST 503
+                # instead of a retry storm.
+                with self._stats_lock:
+                    self.errors_total += 1
+                    self.shed_total += 1
+                return 503, {
+                    "error": "retry budget exhausted (the fleet is "
+                             "failing faster than live traffic "
+                             "refills retries)",
+                    "reason": "retry_budget", "request_id": rid,
+                    "router": self._route_info(replica, attempt_n,
+                                               partial)}
+            with self._stats_lock:
+                self.failovers_total += 1
+            # Jittered backoff (shared RetryPolicy), bounded by the
+            # deadline.
+            delay = min(self.retry_policy.delay_s(attempt_n - 1),
+                        max(0.0, deadline - time.monotonic()))
+            if delay > 0:
+                time.sleep(delay)
+
+    def _route_info(self, replica: Optional[Replica], attempts: int,
+                    partial: List[int], *,
+                    hedged: bool = False) -> Dict[str, Any]:
+        return {
+            **({"replica": replica.id} if replica is not None
+               else {}),
+            "attempts": attempts,
+            **({"hedged": True} if hedged else {}),
+            **({"resumed_tokens": len(partial)} if partial else {}),
+        }
+
+    def route_prefill(self, req: Dict[str, Any],
+                      rid: Optional[str] = None
+                      ) -> Tuple[int, Dict[str, Any]]:
+        """Forward /prefill to the affinity replica (a growing
+        session re-registers where its ancestor lives) or the least-
+        outstanding one, and record the prefix -> replica binding the
+        affinity router consults."""
+        rid = rid or new_request_id()
+        if self.draining:
+            with self._stats_lock:
+                self.shed_total += 1
+            return 503, {"error": "router is draining",
+                         "reason": "draining", "request_id": rid}
+        prompt = None
+        rows = req.get("prompt")
+        if isinstance(rows, list) and rows:
+            prompt = rows[0] if isinstance(rows[0], list) else rows
+        replica = self._pick(prompt, set())
+        if replica is None:
+            with self._stats_lock:
+                self.shed_total += 1
+            return 503, {"error": "no replica in rotation",
+                         "reason": "no_replica", "request_id": rid}
+        att = _Attempt(replica, "POST", "/prefill",
+                       json.dumps(req).encode(),
+                       self._forward_headers(replica, rid),
+                       self.request_timeout_s).start()
+        att.done.wait(self.request_timeout_s + 1.0)
+        if att.outcome() == "ok" and prompt \
+                and all(isinstance(t, int) for t in prompt):
+            self._note_prefix(tuple(prompt), replica.id)
+            resp = dict(att.resp or {})
+            resp["request_id"] = rid
+            resp["router"] = {"replica": replica.id}
+            return 200, resp
+        if att.error is not None:
+            replica.note_failure()
+            with self._stats_lock:
+                self.errors_total += 1
+            return 503, {"error": f"replica {replica.id} failed: "
+                                  f"{type(att.error).__name__}",
+                         "reason": "replica_unreachable",
+                         "request_id": rid}
+        resp = dict(att.resp or {"error": "replica error"})
+        resp["request_id"] = rid
+        return att.code or 500, resp
+
+    # -- rolling restart -------------------------------------------------
+
+    def fleet_restart(self) -> Dict[str, Any]:
+        """``POST /fleet/restart``: drain-restart every replica, one
+        at a time, never dropping the ready count below
+        ``min_ready``.  Returns immediately; progress rides
+        ``restart_state`` in stats()/info.  409 (RuntimeError) when
+        one is already running; ValueError when the fleet has
+        non-restartable replicas."""
+        not_restartable = [r.id for r in self.replicas
+                           if not r.restartable]
+        if not_restartable:
+            raise ValueError(
+                f"replicas {not_restartable} are not restartable "
+                f"from this router (URL replicas restart via their "
+                f"orchestrator; drain them directly instead)")
+        with self._restart_lock:
+            if self.restart_state["in_progress"]:
+                raise RuntimeError(
+                    "a rolling restart is already in progress")
+            self.restart_state = {
+                "in_progress": True, "completed": 0,
+                "rounds_total": len(self.replicas),
+                "last_error": None,
+                "min_ready_floor_observed": self._ready_count()}
+        t = threading.Thread(target=self._rolling_restart_run,
+                             daemon=True, name="fleet-restart")
+        t.start()
+        return dict(self.restart_state)
+
+    def _ready_count(self) -> int:
+        return sum(1 for r in self.replicas if r.up())
+
+    def _note_ready_floor(self) -> None:
+        n = self._ready_count()
+        with self._restart_lock:
+            floor = self.restart_state.get(
+                "min_ready_floor_observed")
+            if floor is None or n < floor:
+                self.restart_state["min_ready_floor_observed"] = n
+
+    def _rolling_restart_run(self) -> None:
+        err = None
+        try:
+            for replica in list(self.replicas):
+                # Gate: taking this replica out must leave min_ready
+                # in rotation.
+                gate_deadline = time.monotonic() + 120.0
+                while self._ready_count() - (1 if replica.up()
+                                             else 0) < self.min_ready:
+                    if time.monotonic() > gate_deadline:
+                        raise RuntimeError(
+                            f"fleet never reached min_ready="
+                            f"{self.min_ready}+1 before restarting "
+                            f"{replica.id}")
+                    time.sleep(0.05)
+                replica.draining = True     # out of rotation FIRST:
+                #                             new requests route away
+                self._note_ready_floor()
+                self._drain_replica(replica)
+                replica.restart()
+                self._await_healthy(replica)
+                replica.draining = False
+                replica.health_ok = True
+                replica.health_reason = None
+                replica.note_success()      # fresh breaker history
+                self._note_ready_floor()
+                with self._restart_lock:
+                    self.restart_state["completed"] += 1
+                    self.restarts_completed_total += 1
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        finally:
+            with self._restart_lock:
+                self.restart_state["in_progress"] = False
+                self.restart_state["last_error"] = err
+
+    def _drain_replica(self, replica: Replica,
+                       timeout_s: float = 120.0) -> None:
+        """POST /drain (idempotent) and poll the in-flight snapshot
+        to zero — the drain-aware half of the rolling restart."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status, snap = self._http_json(replica, "POST",
+                                           "/drain", body=b"")
+            if status == 200 \
+                    and snap.get("slots_active", 0) == 0 \
+                    and snap.get("queue_len", 0) == 0:
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"replica {replica.id} did not drain within "
+            f"{timeout_s}s")
+
+    def _await_healthy(self, replica: Replica,
+                       timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status, _ = self._http_json(replica, "GET", "/healthz")
+            if status == 200:
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"replica {replica.id} did not come back healthy "
+            f"within {timeout_s}s of its restart")
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """ONE dict behind /metrics and /info (the no-drift contract
+        every serving counter family follows)."""
+        with self._stats_lock:
+            counters = {
+                "requests_total": self.requests_total,
+                "completed_total": self.completed_total,
+                "errors_total": self.errors_total,
+                "shed_total": self.shed_total,
+                "failovers_total": self.failovers_total,
+                "resumes_total": self.resumes_total,
+                "resumed_tokens_total": self.resumed_tokens_total,
+                "hedges_fired_total": self.hedges_fired_total,
+                "hedges_won_total": self.hedges_won_total,
+                "hedges_cancelled_total": self.hedges_cancelled_total,
+                "fleet_faults_applied":
+                    dict(self.fleet_faults_applied),
+            }
+        with self._restart_lock:
+            restart = dict(self.restart_state)
+            restarts_total = self.restarts_completed_total
+        with self._affinity_lock:
+            affinity_entries = len(self._affinity)
+        return {
+            **counters,
+            **self.budget.stats(),
+            "replicas": [r.describe() for r in self.replicas],
+            "replicas_ready": self._ready_count(),
+            "draining": self.draining,
+            "hedge": self.hedge,
+            "affinity_entries": affinity_entries,
+            "rolling_restart": restart,
+            "rolling_restarts_completed_total": restarts_total,
+            **({"fleet_fault_stats": self.fleet_faults.stats()}
+               if self.fleet_faults is not None else {}),
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text rendered FROM stats() — the same dict
+        /info returns."""
+        st = self.stats()
+        lines = []
+
+        def counter(name, value):
+            lines.append(f"# TYPE ptpu_router_{name} counter")
+            lines.append(f"ptpu_router_{name} {value}")
+
+        def gauge(name, value):
+            lines.append(f"# TYPE ptpu_router_{name} gauge")
+            lines.append(f"ptpu_router_{name} {value}")
+
+        for k in ("requests_total", "completed_total", "errors_total",
+                  "shed_total", "failovers_total", "resumes_total",
+                  "resumed_tokens_total", "hedges_fired_total",
+                  "hedges_won_total", "hedges_cancelled_total",
+                  "retry_budget_spent_total",
+                  "retry_budget_denied_total"):
+            counter(k, st[k])
+        gauge("retry_budget_level", st["retry_budget_level"])
+        gauge("replicas", len(st["replicas"]))
+        gauge("replicas_ready", st["replicas_ready"])
+        gauge("draining", int(st["draining"]))
+        gauge("rolling_restart_in_progress",
+              int(st["rolling_restart"]["in_progress"]))
+        counter("rolling_restarts_completed_total",
+                st["rolling_restarts_completed_total"])
+        lines.append("# TYPE ptpu_router_replica_up gauge")
+        for r in st["replicas"]:
+            lines.append(
+                f'ptpu_router_replica_up{{replica="{r["id"]}"}} '
+                f'{int(r["up"])}')
+        lines.append("# TYPE ptpu_router_replica_outstanding gauge")
+        for r in st["replicas"]:
+            lines.append(
+                f'ptpu_router_replica_outstanding'
+                f'{{replica="{r["id"]}"}} {r["outstanding"]}')
+        lines.append(
+            "# TYPE ptpu_router_replica_probe_failures gauge")
+        for r in st["replicas"]:
+            lines.append(
+                f'ptpu_router_replica_probe_failures'
+                f'{{replica="{r["id"]}"}} '
+                f'{r["consecutive_probe_failures"]}')
+        lines.append(
+            "# TYPE ptpu_router_fleet_faults_applied_total counter")
+        for site, n in sorted(st["fleet_faults_applied"].items()):
+            lines.append(
+                f'ptpu_router_fleet_faults_applied_total'
+                f'{{site="{site}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "role": "router",
+            "min_ready": self.min_ready,
+            "max_attempts": self.max_attempts,
+            "probe_interval_s": self.probe_interval_s,
+            "probe_timeout_s": self.probe_timeout_s,
+            "request_timeout_s": self.request_timeout_s,
+            "hedge_min_s": self.hedge_min_s,
+            "affinity": self.affinity_enabled,
+            "affinity_max_outstanding":
+                self.affinity_max_outstanding,
+            **self.stats(),
+        }
+
+
+def make_router_server(host: str, port: int,
+                       router: ReplicaRouter) -> ThreadingHTTPServer:
+    """The router's HTTP front (``ptpu route``): /generate and
+    /prefill route to replicas; /healthz answers the SAME unified
+    schema the replicas do (503 ``no_replica`` when rotation is
+    empty, ``draining`` once drained); /metrics + /info render
+    router.stats(); POST /fleet/restart starts the rolling
+    restart."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _req_id(self) -> str:
+            rid = sanitize_request_id(
+                self.headers.get("X-Request-Id"))
+            self._rid = rid or new_request_id()
+            return self._rid
+
+        def _send(self, code: int, obj: Dict[str, Any]) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Request-Id",
+                             getattr(self, "_rid", None)
+                             or new_request_id())
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            self._req_id()
+            if self.path == "/healthz":
+                ready = router._ready_count()
+                if router.draining:
+                    self._send(503, {"status": "unavailable",
+                                     "reason": "draining"})
+                elif ready == 0:
+                    self._send(503, {"status": "unavailable",
+                                     "reason": "no_replica",
+                                     "replicas_ready": 0})
+                else:
+                    self._send(200, {"status": "ok",
+                                     "role": "router",
+                                     "replicas_ready": ready})
+            elif self.path == "/info":
+                self._send(200, router.info())
+            elif self.path == "/metrics":
+                body = router.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            rid = self._req_id()
+            if self.path == "/fleet/restart":
+                try:
+                    state = router.fleet_restart()
+                    self._send(200, {"started": True, **state})
+                except RuntimeError as e:
+                    self._send(409, {"error": str(e)})
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                return
+            if self.path == "/drain":
+                self._send(200, router.drain())
+                return
+            if self.path not in ("/generate", "/prefill"):
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError(
+                        "request body must be a JSON object")
+            except ValueError as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            try:
+                if self.path == "/generate":
+                    code, resp = router.route_generate(req, rid=rid)
+                else:
+                    code, resp = router.route_prefill(req, rid=rid)
+            except Exception as e:  # never kill the router thread
+                code, resp = 500, {
+                    "error": f"{type(e).__name__}: {e}",
+                    "request_id": rid}
+            self._send(code, resp)
+
+    class _RouterHTTPServer(ThreadingHTTPServer):
+        request_queue_size = 128
+        daemon_threads = True
+
+    return _RouterHTTPServer((host, port), Handler)

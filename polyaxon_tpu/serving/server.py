@@ -1738,6 +1738,40 @@ class ModelServer:
             chunk = None
 
         p_len = lens[0]
+        # CROSS-REPLICA RESUME (docs/DESIGN.md): ``resume_tokens: N``
+        # declares the trailing N prompt tokens a prior attempt's
+        # committed output (a router failover replaying ``prompt ++
+        # tokens_received_so_far``).  The engine re-enters the
+        # request through the preempt-resume machinery, so sampled
+        # draws continue at position key N — token-identical to the
+        # uninterrupted run, per seed, on any replica.
+        resume_tokens = req.get("resume_tokens", 0)
+        try:
+            resume_tokens = _int(resume_tokens)
+        except (TypeError, ValueError):
+            raise ValueError("resume_tokens must be an int")
+        if resume_tokens < 0:
+            raise ValueError("resume_tokens must be >= 0")
+        if resume_tokens:
+            if beams > 1:
+                raise ValueError(
+                    "resume_tokens cannot combine with beam search "
+                    "(beam requests replay whole)")
+            if self.engine is None:
+                raise ValueError(
+                    "resume_tokens requires the continuous-batching "
+                    f"engine (batching={self.batching!r})")
+            if len(rows) != 1:
+                raise ValueError(
+                    "resume_tokens takes a single-row request")
+        # The EFFECTIVE prompt length: what the slot actually holds —
+        # a resume replay's original prompt, not the concatenation.
+        eff_p_len = p_len - resume_tokens
+        if resume_tokens and eff_p_len < 1:
+            raise ValueError(
+                f"resume_tokens ({resume_tokens}) must leave at "
+                f"least one original prompt token (prompt length "
+                f"{p_len})")
         # Capacity checks for EVERY model a request will touch, so
         # doomed requests fail in this cheap validation layer instead
         # of inside the locked device section at jit-trace time.
@@ -1758,9 +1792,10 @@ class ModelServer:
                         f"{spec_k - 1} for spec_k={spec_k} "
                         f"(got {ring_slack})")
                 continue  # ring caches are position-keyed, unbounded
-            if max_pos is not None and p_len + new + slack > max_pos:
+            if max_pos is not None \
+                    and eff_p_len + new + slack > max_pos:
                 raise ValueError(
-                    f"prompt ({p_len}) + max_new_tokens ({new})"
+                    f"prompt ({eff_p_len}) + max_new_tokens ({new})"
                     + (f" + spec_k-1 ({slack})" if slack else "")
                     + f" exceeds the {label}'s max_position "
                     f"({max_pos})")
@@ -1773,7 +1808,11 @@ class ModelServer:
         # it on the solo split path — beam tiles and speculative rolls
         # back the cache, so they stay cold.
         prefix_hit = None
-        if self._prefix_enabled and beams == 1 and not speculative:
+        if self._prefix_enabled and beams == 1 and not speculative \
+                and not resume_tokens:
+            # Resume replays skip the prefix store: the replayed
+            # tokens ARE the state, and a store hit would re-seed a
+            # stream the resume machinery is about to re-prefill.
             prefix_hit = self._prefix_lookup_safe(toks)
         # Engine eligibility: any non-beam request on a decoder-only
         # model — greedy, sampled, AND speculative (the engine owns
@@ -1809,7 +1848,7 @@ class ModelServer:
                     f"request spec_k {spec_k} exceeds the engine cap "
                     f"{cap} (--spec-k); decoding solo")
             elif not ring and max_pos is not None \
-                    and p_len + new + cap - 1 > max_pos:
+                    and eff_p_len + new + cap - 1 > max_pos:
                 engine_ok = False
                 self._note_fallback(
                     "near-capacity",
@@ -1817,6 +1856,15 @@ class ModelServer:
                     f"tokens of max_position ({max_pos}) cannot "
                     f"co-tenant a speculative pool (verify chunks "
                     f"are {cap + 1} wide); decoding solo")
+        if resume_tokens and not engine_ok:
+            # A request that fell off the engine (spec_k over cap,
+            # near-capacity spec pool) replays WHOLE: solo paths have
+            # no resume machinery, and silently restarting the RNG at
+            # index 0 would break the token-identity contract.
+            raise ValueError(
+                "resume_tokens requires the engine path for this "
+                "request (it fell back solo); replay the request "
+                "without resume_tokens instead")
         sampling = None
         if speculative:
             sampling = SamplingSpec(seed, temp, top_k, top_p,
@@ -1895,7 +1943,8 @@ class ModelServer:
                                        record_timings=want_timings,
                                        priority=priority,
                                        deadline_s=deadline_s,
-                                       rid=rid)
+                                       rid=rid,
+                                       resume_tokens=resume_tokens)
             self._wait_group(group, cancel_check)
             out = group.result()
             breakdown = group.breakdown()
@@ -2624,6 +2673,14 @@ class _ServingHTTPServer(ThreadingHTTPServer):
 
 def make_server(host: str, port: int, ms: ModelServer
                 ) -> ThreadingHTTPServer:
+    return _ServingHTTPServer((host, port), make_handler(ms))
+
+
+def make_handler(ms: ModelServer):
+    """The request-handler CLASS for ``ms`` (what ``make_server``
+    binds).  Exposed separately so the router tier's in-process
+    replicas (serving/router.py LocalReplica) can mount the same
+    handler on their chaos-capable HTTP server."""
     class Handler(BaseHTTPRequestHandler):
         def _req_id(self) -> str:
             """This request's correlation ID: the inbound
@@ -2676,13 +2733,21 @@ def make_server(host: str, port: int, ms: ModelServer
                 # breaker-open engine answers 503 ``engine_down`` so
                 # the router sheds AROUND a crash-storming replica
                 # instead of feeding it work it will hang.
+                # ONE machine-readable schema for every not-ready
+                # path: {"status": "unavailable", "reason": ...} —
+                # the router probe parses a single contract whether
+                # the replica is draining or breaker-open (pinned in
+                # tests/test_serving_smoke.py + tests/test_faults.py;
+                # extras ride behind the two fixed keys).
                 if ms.draining:
-                    self._send(503, {"status": "draining",
+                    self._send(503, {"status": "unavailable",
+                                     "reason": "draining",
                                      "model": ms.model_name,
                                      **ms.drain_status()})
                 elif ms.engine is not None and ms.engine.down:
                     self._send(503, {
-                        "status": "engine_down",
+                        "status": "unavailable",
+                        "reason": "engine_down",
                         "model": ms.model_name,
                         **({"supervisor": ms.supervisor.status()}
                            if ms.supervisor is not None else {})})
@@ -2922,4 +2987,4 @@ def make_server(host: str, port: int, ms: ModelServer
             # engine's full causal record wins when both exist.
             ms.record_front(rid, self.path, code, req, resp)
 
-    return _ServingHTTPServer((host, port), Handler)
+    return Handler

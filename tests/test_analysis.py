@@ -762,7 +762,7 @@ def test_retry_backoff_flags_unbounded_retry_loops():
     def spin_on_peer(self, url):
         while True:
             try:
-                return urllib.request.urlopen(url)
+                return urllib.request.urlopen(url, timeout=5)
             except OSError:
                 pass
     """
@@ -843,6 +843,68 @@ def test_retry_backoff_narrow_and_scoped():
                 continue
     """
     assert _rules(unbounded, "polyaxon_tpu/train.py") == []
+
+
+# -- SOCKET-TIMEOUT ---------------------------------------------------------
+
+
+def test_socket_timeout_flags_timeoutless_outbound_calls():
+    """The router-tier liveness contract: an outbound network call
+    in serving/ without an explicit timeout blocks forever against a
+    hung replica — every flagged shape (create_connection, urlopen,
+    the HTTPConnection constructors)."""
+    src = """
+    import http.client
+    import socket
+    import urllib.request
+
+    def probe(self, replica):
+        return socket.create_connection((replica.host, replica.port))
+
+    def fetch(self, url):
+        return urllib.request.urlopen(url)
+
+    def connect(self, replica):
+        return http.client.HTTPConnection(replica.host, replica.port)
+
+    def connect_tls(self, replica):
+        return http.client.HTTPSConnection(replica.host)
+    """
+    assert _rules(src) == ["SOCKET-TIMEOUT"] * 4
+
+
+def test_socket_timeout_explicit_timeouts_pass():
+    """A ``timeout=`` kwarg clears every shape; so does a positional
+    timeout in the slot the signature defines (create_connection's
+    2nd, urlopen's 3rd) — and the rule stays scoped to serving/."""
+    src = """
+    import http.client
+    import socket
+    import urllib.request
+
+    def probe(self, replica):
+        return socket.create_connection(
+            (replica.host, replica.port), 2.0)
+
+    def fetch(self, url):
+        return urllib.request.urlopen(url, None, 5.0)
+
+    def fetch_kw(self, url):
+        return urllib.request.urlopen(url, timeout=self.timeout_s)
+
+    def connect(self, replica):
+        return http.client.HTTPConnection(
+            replica.host, replica.port, timeout=self.probe_timeout_s)
+    """
+    assert _rules(src) == []
+    timeoutless = """
+    import urllib.request
+
+    def fetch(self, url):
+        return urllib.request.urlopen(url)
+    """
+    assert _rules(timeoutless, "benchmarks/bench_serving_load.py") \
+        == []
 
 
 # -- suppressions -----------------------------------------------------------

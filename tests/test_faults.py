@@ -488,6 +488,61 @@ def test_circuit_breaker_unit():
         CircuitBreaker(window_s=0)
 
 
+def test_breaker_half_open_admits_exactly_one_probe_concurrent():
+    """The half-open contract under CONCURRENT submitters (before
+    this pin it was only exercised end-to-end through the
+    supervisor): however many threads race ``try_probe`` while the
+    breaker is HALF_OPEN, exactly ONE wins the probe slot — the
+    others must route elsewhere instead of piling onto a replica
+    that has not proven itself."""
+    br = CircuitBreaker(threshold=1, window_s=60.0, cooldown_s=0.0)
+    br.record_crash()
+    assert br.state == CircuitBreaker.OPEN
+    # not half-open yet: nobody probes an OPEN breaker
+    assert not br.try_probe()
+    br.half_open()
+    n_threads = 16
+    wins = []
+    gate = threading.Barrier(n_threads)
+
+    def claim():
+        gate.wait()
+        if br.try_probe():
+            wins.append(threading.get_ident())
+
+    threads = [threading.Thread(target=claim)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(wins) == 1, \
+        f"half-open admitted {len(wins)} probes (want exactly 1)"
+    # the slot stays claimed until the state moves
+    assert not br.try_probe()
+    # probe success closes: normal routing, no more probe slots
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert not br.try_probe()
+
+
+def test_breaker_half_open_probe_failure_reopens_and_rearms():
+    """A probe FAILURE re-opens the breaker; the next half-open
+    transition re-arms the (single) probe slot."""
+    br = CircuitBreaker(threshold=1, window_s=60.0, cooldown_s=0.0)
+    br.record_crash()
+    br.half_open()
+    assert br.try_probe()
+    # the probe request failed: straight back open
+    assert br.record_crash() == CircuitBreaker.OPEN
+    assert not br.try_probe()          # open: no probes
+    br.half_open()
+    assert br.state == CircuitBreaker.HALF_OPEN
+    # fresh transition, fresh slot — exactly one again
+    assert br.try_probe()
+    assert not br.try_probe()
+
+
 def test_retry_policy_unit():
     p1 = RetryPolicy(max_attempts=3, base_delay_s=0.01,
                      max_delay_s=1.0, jitter=0.5, seed=42)
@@ -812,8 +867,10 @@ def test_poisoned_request_maps_to_typed_500(http_server, small_model):
 
 
 def test_healthz_503_engine_down_then_recovers(http_server):
-    """Breaker open => /healthz answers 503 ``engine_down`` (the
-    router sheds around the replica); recovery flips it back 200."""
+    """Breaker open => /healthz answers the UNIFIED not-ready schema
+    (503 ``{"status": "unavailable", "reason": "engine_down"}`` —
+    the same two keys the drain path answers, so the router's probe
+    parses one contract); recovery flips it back 200."""
     base, ms = http_server(
         supervise=False,
         fault_plan={"seed": 0, "faults": [
@@ -831,7 +888,8 @@ def test_healthz_503_engine_down_then_recovers(http_server):
     _post(base, {"prompt": PROBE[0].tolist(), "max_new_tokens": 2},
           expect=503)
     body = _get(base, "/healthz", expect=503)
-    assert body["status"] == "engine_down"
+    assert body["status"] == "unavailable"
+    assert body["reason"] == "engine_down"
     assert body["supervisor"]["breaker"]["state"] == "open"
     deadline = time.monotonic() + 30
     while ms.engine.down and time.monotonic() < deadline:

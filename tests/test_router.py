@@ -1,0 +1,715 @@
+"""The replica router tier's proof obligations (serving/router.py).
+
+The hard property mirrors test_faults.py one tier up: DETERMINISM
+UNDER FLEET CHAOS — with seeded ``replica_kill`` / ``replica_hang``
+/ ``replica_slow`` plans armed over a 3-replica fleet, every
+SURVIVING request's tokens are bitwise identical to the fault-free
+single-replica run (which, by the position-keyed RNG contract, is
+the solo reference), no request hangs past its deadline, and the
+retry-budget token bucket is never overdrawn (counter-pinned).
+
+Alongside the matrix: the cross-replica resume contract (replay of
+``prompt ++ tokens_received_so_far`` with ``resume_tokens`` is
+token-identical per seed across replicas — plain, sampled, AND
+speculative), health-probe rotation with half-open re-admission,
+affinity-vs-health precedence (affinity NEVER beats health),
+hedging with first-winner-cancels-loser, the sick-fleet fast-503
+(``retry_budget``), the drain-aware rolling restart (ready count
+never below min_ready, zero failed requests), request-ID prefixing
+across a failover, and the router stats no-drift pin across
+/metrics + /info.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.generate import (generate,
+                                          generate_positional,
+                                          generate_speculative)
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import (LocalReplica, ModelServer,
+                                  ReplicaRouter, RetryBudget,
+                                  make_router_server)
+from polyaxon_tpu.serving.faults import FLEET_SITES, FaultPlan
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    draft_vars = model.init(jax.random.PRNGKey(99),
+                            jnp.zeros((1, 4), jnp.int32))
+    return model, variables, draft_vars
+
+
+def _factory(small_model, **kw):
+    """One replica's ModelServer — spec-capable, history-armed (the
+    rid-prefix test reads it back), small pools."""
+    model, variables, draft_vars = small_model
+
+    def make():
+        return ModelServer(
+            model, variables, model_name="tiny", max_batch=4,
+            n_slots=2, queue_depth=16, decode_window=2,
+            draft_model=model, draft_variables=draft_vars,
+            spec_k=2, request_history=64, **kw)
+    return make
+
+
+def _spawn_fleet(small_model, n=3, *, router_kw=None, ms_kw=None):
+    reps = [LocalReplica(_factory(small_model, **(ms_kw or {})),
+                         f"r{i}")
+            for i in range(n)]
+    kw = dict(probe_interval_s=0.1, probe_timeout_s=0.5,
+              cooldown_s=0.2, request_timeout_s=60.0)
+    kw.update(router_kw or {})
+    router = ReplicaRouter(reps, **kw)
+    srv = make_router_server("127.0.0.1", 0, router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    return base, router, srv, reps
+
+
+def _teardown(router, srv, reps):
+    router.close()
+    srv.shutdown()
+    srv.server_close()
+    for r in reps:
+        r.close()
+
+
+@pytest.fixture(scope="module")
+def fleet(small_model):
+    """Shared NON-DESTRUCTIVE fleet (routing, affinity, resume,
+    observability).  Chaos/restart tests spawn their own."""
+    base, router, srv, reps = _spawn_fleet(small_model)
+    yield base, router, srv, reps
+    _teardown(router, srv, reps)
+
+
+def _post(base, payload, timeout=120, path="/generate",
+          headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(base, path, timeout=30, expect=200):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) \
+                as r:
+            assert r.status == expect
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        assert e.code == expect, body
+        return json.loads(body)
+
+
+# The shared request set: greedy, sampled, speculative (greedy
+# accept lane == target greedy), sampled speculative.
+def _request_set():
+    return [
+        ("greedy", {"prompt": [5, 6, 7], "max_new_tokens": 8}),
+        ("sampled", {"prompt": [3, 1, 4, 1], "max_new_tokens": 8,
+                     "temperature": 0.9, "top_k": 16,
+                     "top_p": 0.95, "seed": 7}),
+        ("spec", {"prompt": [2, 7, 1, 8], "max_new_tokens": 8,
+                  "speculative": True, "spec_k": 2}),
+        ("spec-sampled", {"prompt": [9, 9, 2, 6],
+                          "max_new_tokens": 8,
+                          "speculative": True, "spec_k": 2,
+                          "temperature": 1.1, "top_k": 8,
+                          "seed": 3}),
+    ]
+
+
+@pytest.fixture(scope="module")
+def refs(small_model):
+    """Solo references — the fault-free single-replica ground truth
+    every surviving routed request must match bitwise."""
+    model, variables, draft_vars = small_model
+    out = {}
+    for name, req in _request_set():
+        prompt = np.asarray([req["prompt"]], np.int32)
+        if req.get("speculative") and req.get("temperature", 0.0):
+            # Sampled speculative draws through the 3-deep fold_in
+            # (row, index, lane) — its OWN reference, exact w.r.t.
+            # the target distribution but a different stream than
+            # plain sampling.
+            want = generate_speculative(
+                model, variables, model, draft_vars, prompt,
+                max_new_tokens=req["max_new_tokens"],
+                k=req["spec_k"], seed=req["seed"],
+                temperature=req["temperature"],
+                top_k=req.get("top_k"), top_p=req.get("top_p"))
+        elif req.get("temperature", 0.0) == 0.0:
+            # Greedy — and greedy SPECULATIVE, whose accept lane
+            # commits exactly the target's greedy tokens.
+            want = generate(model, variables, prompt,
+                            max_new_tokens=req["max_new_tokens"])
+        else:
+            want = generate_positional(
+                model, variables, prompt,
+                max_new_tokens=req["max_new_tokens"],
+                seed=req["seed"], temperature=req["temperature"],
+                top_k=req.get("top_k"), top_p=req.get("top_p"))
+        out[name] = np.asarray(want).tolist()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unit: retry budget + fleet fault plan
+# ---------------------------------------------------------------------------
+
+
+def test_retry_budget_token_bucket_unit():
+    """Deposits capped at burst, withdrawals bounded, and the
+    accounting identity that makes "never overdrawn" checkable:
+    every spend decision lands in spent_total XOR denied_total, and
+    spent_total can never exceed burst + ratio x live traffic."""
+    b = RetryBudget(ratio=0.5, burst=2.0)
+    assert b.try_spend() and b.try_spend()     # the cold-start burst
+    assert not b.try_spend()                   # empty: denied
+    for _ in range(4):                         # 4 live requests
+        b.on_request()                         # -> +2.0 tokens
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()
+    st = b.stats()
+    assert st["retry_budget_spent_total"] == 4
+    assert st["retry_budget_denied_total"] == 2
+    assert st["retry_budget_spent_total"] <= \
+        b.burst + 0.5 * 4                      # the invariant
+    assert b.level() == 0.0
+    with pytest.raises(ValueError):
+        RetryBudget(ratio=-0.1)
+    with pytest.raises(ValueError):
+        RetryBudget(burst=0.5)
+
+
+def test_fleet_fault_plan_validation_and_poll():
+    """Replica sites validate eagerly (target required, delay > 0)
+    and fire through poll() — deterministically, as a pure function
+    of the plan — while exception sites refuse poll()."""
+    with pytest.raises(ValueError):      # fleet site needs a target
+        FaultPlan({"faults": [{"site": "replica_kill"}]})
+    with pytest.raises(ValueError):      # replica only on fleet sites
+        FaultPlan({"faults": [{"site": "step", "replica": 0}]})
+    with pytest.raises(ValueError):      # slow needs a positive delay
+        FaultPlan({"faults": [{"site": "replica_slow", "replica": 1,
+                               "delay_s": 0}]})
+    plan_dict = {"seed": 5, "faults": [
+        {"site": "replica_kill", "replica": 1, "after": 2,
+         "times": 1},
+        {"site": "replica_slow", "replica": 0, "delay_s": 0.25,
+         "p": 0.5, "times": 2},
+    ]}
+
+    def fire_pattern():
+        plan = FaultPlan(plan_dict)
+        fires = []
+        for i in range(12):
+            for site in FLEET_SITES:
+                f = plan.poll(site)
+                if f is not None:
+                    fires.append((i, site, f["replica"],
+                                  f["delay_s"]))
+        return fires, plan.stats()
+
+    f1, st1 = fire_pattern()
+    f2, st2 = fire_pattern()
+    assert f1 == f2, "seeded fleet plan must be deterministic"
+    kills = [f for f in f1 if f[1] == "replica_kill"]
+    assert len(kills) == 1 and kills[0][2] == 1
+    assert kills[0][0] == 2                 # after 2 eligible probes
+    assert st1["faults_injected"]["replica_kill"] == 1
+    # counters identical; last_fault_t is wall-clock by design
+    st1.pop("last_fault_t", None)
+    st2.pop("last_fault_t", None)
+    assert st1 == st2
+    plan = FaultPlan(plan_dict)
+    with pytest.raises(ValueError):         # exception sites: check()
+        plan.poll("step")
+
+
+# ---------------------------------------------------------------------------
+# routing basics + observability
+# ---------------------------------------------------------------------------
+
+
+def test_routes_complete_and_balance(fleet, refs):
+    """A concurrent burst across all request kinds completes through
+    the fleet, every response bitwise equal to the solo reference,
+    and the load spread over more than one replica
+    (least-outstanding)."""
+    base, router, _, reps = fleet
+    reqs = _request_set() * 3
+    results = [None] * len(reqs)
+    errors = []
+
+    def go(i):
+        try:
+            results[i] = _post(base, dict(reqs[i][1]))
+        except Exception as e:  # noqa: BLE001 - reported below
+            errors.append(f"{reqs[i][0]}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    used = set()
+    for (name, _), res in zip(reqs, results):
+        assert res is not None
+        assert res["tokens"] == refs[name], name
+        used.add(res["router"]["replica"])
+    assert len(used) >= 2, \
+        f"least-outstanding never spread the burst: {used}"
+    st = router.stats()
+    assert st["completed_total"] >= len(reqs)
+    # every replica drained its outstanding count back to zero
+    assert all(r["outstanding"] == 0 for r in st["replicas"])
+
+
+def test_router_healthz_metrics_info_no_drift(fleet):
+    """Router /healthz follows the SAME unified schema as replicas;
+    /metrics renders from the SAME stats() dict /info embeds (the
+    no-drift pin)."""
+    base, router, _, reps = fleet
+    h = _get(base, "/healthz")
+    assert h["status"] == "ok" and h["replicas_ready"] == 3
+    st = router.stats()
+    info = _get(base, "/info")
+    text = urllib.request.urlopen(base + "/metrics",
+                                  timeout=30).read().decode()
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name, _, value = line.rpartition(" ")
+            metrics[name] = float(value)
+    for key, gauge in [
+            ("requests_total", "ptpu_router_requests_total"),
+            ("completed_total", "ptpu_router_completed_total"),
+            ("failovers_total", "ptpu_router_failovers_total"),
+            ("hedges_fired_total", "ptpu_router_hedges_fired_total"),
+            ("hedges_won_total", "ptpu_router_hedges_won_total"),
+            ("hedges_cancelled_total",
+             "ptpu_router_hedges_cancelled_total"),
+            ("retry_budget_spent_total",
+             "ptpu_router_retry_budget_spent_total"),
+            ("retry_budget_denied_total",
+             "ptpu_router_retry_budget_denied_total")]:
+        assert info[key] >= st[key]              # monotonic counters
+        assert gauge in metrics, gauge
+    assert "ptpu_router_retry_budget_level" in metrics
+    for r in st["replicas"]:
+        assert f'ptpu_router_replica_up{{replica="{r["id"]}"}}' \
+            in text
+        assert (f'ptpu_router_replica_outstanding'
+                f'{{replica="{r["id"]}"}}') in text
+    assert metrics["ptpu_router_replicas"] == 3
+
+
+def test_request_id_prefixed_replica_ward(fleet):
+    """X-Request-Id forwards replica-ward with the replica-id prefix
+    (serving/debug.py's convention): the client keeps its own ID,
+    and the serving replica's history ring records the prefixed one
+    — one request's history is traceable across the tier."""
+    base, router, _, reps = fleet
+    rid = "trace-me-123"
+    res = _post(base, {"prompt": [5, 6, 7], "max_new_tokens": 3},
+                headers={"X-Request-Id": rid})
+    assert res["request_id"] == rid
+    served_by = res["router"]["replica"]
+    replica = next(r for r in reps if r.id == served_by)
+    rec = _get(replica.url, f"/requests/{served_by}-{rid}")
+    assert rec["request_id"] == f"{served_by}-{rid}"
+    assert rec["status"] == "complete"
+
+
+# ---------------------------------------------------------------------------
+# cross-replica resume: THE determinism contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["greedy", "sampled", "spec",
+                                  "spec-sampled"])
+def test_cross_replica_resume_token_identical(fleet, refs, name):
+    """The contract the failover path stands on (docs/DESIGN.md):
+    replaying ``prompt ++ tokens_received_so_far`` with
+    ``resume_tokens`` on a DIFFERENT replica yields tokens bitwise
+    identical to the uninterrupted run — plain, sampled, and
+    speculative, because position-keyed RNG draws are a function of
+    the request alone, now pinned ACROSS replicas."""
+    base, router, _, reps = fleet
+    req = dict(_request_set()[["greedy", "sampled", "spec",
+                               "spec-sampled"].index(name)][1])
+    want = refs[name]
+    for cut in (1, 3, req["max_new_tokens"] - 1):
+        part = want[0][len(req["prompt"]):][:cut]
+        resumed = _post(reps[(cut + 1) % len(reps)].url + "/generate",
+                        {**req,
+                         "prompt": list(req["prompt"]) + part,
+                         "resume_tokens": cut}, path="")
+        assert resumed["tokens"] == want, \
+            f"{name} resume at {cut} diverged"
+        # this attempt generated only the remainder
+        assert resumed["new_tokens"][0] == \
+            want[0][len(req["prompt"]) + cut:]
+
+
+def test_resume_validation(fleet):
+    """resume_tokens guards: must leave a prompt token, must leave
+    budget, refuses beams, refuses eos-complete prefixes."""
+    base, router, _, reps = fleet
+    url = reps[0].url + "/generate"
+    good = {"prompt": [5, 6, 7, 8], "max_new_tokens": 4}
+    for bad in (
+            {**good, "resume_tokens": 4},          # no prompt left
+            {**good, "resume_tokens": -1},
+            {**good, "resume_tokens": True},
+            {"prompt": [5, 6, 7, 8], "max_new_tokens": 2,
+             "resume_tokens": 2},                  # no budget left
+            {**good, "resume_tokens": 1, "num_beams": 2},
+            {**good, "resume_tokens": 1, "eos_id": 8}):  # eos in out
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, bad, path="")
+        assert ei.value.code == 400, bad
+
+
+# ---------------------------------------------------------------------------
+# affinity: prefix-holder routing that NEVER beats health
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_prefers_prefix_holder_until_unhealthy(fleet, refs):
+    """/prefill through the router registers the prefix on ONE
+    replica; extending requests route there (the radix store already
+    holds the KV).  Saturation falls back to least-outstanding, and
+    an unhealthy holder is NEVER chosen — affinity must not beat
+    health."""
+    base, router, _, reps = fleet
+    sys_prompt = [11, 12, 13, 14, 15, 16]
+    reg = _post(base, {"prompt": sys_prompt}, path="/prefill")
+    holder = reg["router"]["replica"]
+    ext = {"prompt": sys_prompt + [4, 2], "max_new_tokens": 4}
+    for _ in range(3):
+        res = _post(base, dict(ext))
+        assert res["router"]["replica"] == holder
+        assert res.get("prefix_hit_len", 0) >= len(sys_prompt)
+    # saturated holder: affinity yields to least-outstanding
+    saved = router.affinity_max_outstanding
+    router.affinity_max_outstanding = 0
+    try:
+        res = _post(base, dict(ext))
+        assert res["router"]["replica"] != holder
+    finally:
+        router.affinity_max_outstanding = saved
+    # unhealthy holder: out of rotation entirely (health > affinity)
+    rep = next(r for r in reps if r.id == holder)
+    rep.draining = True
+    try:
+        res = _post(base, dict(ext))
+        assert res["router"]["replica"] != holder
+        assert res["tokens"][0][:len(sys_prompt)] == sys_prompt
+    finally:
+        rep.draining = False
+
+
+# ---------------------------------------------------------------------------
+# health rotation: kill -> out, restart -> half-open -> back in
+# ---------------------------------------------------------------------------
+
+
+def test_kill_rotates_out_restart_readmits(small_model, refs):
+    base, router, srv, reps = _spawn_fleet(small_model)
+    try:
+        _post(base, {"prompt": [5, 6, 7], "max_new_tokens": 3})
+        reps[0].chaos_kill()
+        deadline = time.monotonic() + 15
+        while reps[0].up() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not reps[0].up(), "killed replica never left rotation"
+        # the fleet keeps serving, bitwise
+        res = _post(base, dict(_request_set()[1][1]))
+        assert res["tokens"] == refs["sampled"]
+        assert res["router"]["replica"] != "r0"
+        # restart: the probe re-admits via half-open -> closed
+        reps[0].restart()
+        deadline = time.monotonic() + 30
+        while not reps[0].up() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert reps[0].up(), "restarted replica never re-admitted"
+        st = router.stats()
+        assert st["replicas_ready"] == 3
+    finally:
+        _teardown(router, srv, reps)
+
+
+# ---------------------------------------------------------------------------
+# THE fleet determinism-under-chaos matrix
+# ---------------------------------------------------------------------------
+
+CHAOS_PLAN = {"seed": 17, "faults": [
+    # Kill r1 a few requests into the burst: failover + replay.
+    {"site": "replica_kill", "replica": 1, "after": 3, "times": 1},
+    # Slow-walk r2 by more than the hedge watermark: the tail
+    # pathology hedging absorbs (first winner cancels the loser).
+    {"site": "replica_slow", "replica": 2, "delay_s": 0.6,
+     "after": 1, "times": 1},
+    # Hang r0 late in the burst: probe timeouts + hedges around it.
+    {"site": "replica_hang", "replica": 0, "after": 8, "times": 1},
+]}
+
+
+def test_fleet_determinism_under_chaos_matrix(small_model, refs):
+    """replica_kill + replica_hang + replica_slow armed over 3
+    replicas x plain/sampled/spec requests: every SURVIVING
+    request's tokens are bitwise identical to the fault-free
+    single-replica run, no request hangs past its deadline, and the
+    retry budget is never overdrawn (counter-pinned)."""
+    base, router, srv, reps = _spawn_fleet(
+        small_model,
+        router_kw=dict(
+            fleet_faults=dict(CHAOS_PLAN),
+            hedge="0.4", hedge_min_s=0.2,
+            retry_ratio=0.25, retry_burst=8.0,
+            max_attempts=3, request_timeout_s=20.0))
+    deadline_ms = 15000
+    reqs = _request_set() * 4                   # 16 requests
+    results = [None] * len(reqs)
+    statuses = [None] * len(reqs)
+    hung = []
+    try:
+        # Warm each replica's programs OUTSIDE the storm so chaos
+        # timing exercises scheduling, not first-compile stalls.
+        for rep in reps:
+            for _, req in _request_set():
+                _post(rep.url + "/generate", dict(req), path="")
+
+        def go(i):
+            t0 = time.monotonic()
+            name, req = reqs[i]
+            try:
+                results[i] = _post(
+                    base, {**req, "deadline_ms": deadline_ms},
+                    timeout=40)
+                statuses[i] = 200
+            except urllib.error.HTTPError as e:
+                statuses[i] = e.code
+                e.read()
+            except Exception as e:  # noqa: BLE001 - checked below
+                statuses[i] = f"{type(e).__name__}"
+            if time.monotonic() - t0 > deadline_ms / 1e3 + 10:
+                hung.append(reqs[i][0])
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)        # a burst, not a single instant —
+            #                         the plan's `after` gates see a
+            #                         deterministic probe ORDER per
+            #                         routed request regardless
+        for t in threads:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in threads), \
+            "caller thread hung past every deadline"
+        assert not hung, f"requests exceeded deadline + slack: {hung}"
+        # every surviving request: bitwise vs the fault-free run
+        survivors = 0
+        for (name, _), res, code in zip(reqs, results, statuses):
+            if code == 200:
+                survivors += 1
+                assert res["tokens"] == refs[name], \
+                    f"{name} diverged under chaos"
+        # the fleet kept most of the burst alive (kill+hang+slow
+        # leaves one clean replica; failover + hedging carry it)
+        assert survivors >= len(reqs) // 2, \
+            f"only {survivors}/{len(reqs)} survived: {statuses}"
+        st = router.stats()
+        # the chaos plan actually fired
+        applied = st["fleet_faults_applied"]
+        assert applied.get("replica_kill") == 1, applied
+        assert applied.get("replica_slow") == 1, applied
+        assert applied.get("replica_hang") == 1, applied
+        # retry budget NEVER overdrawn: the counter-pinned invariant
+        assert st["retry_budget_level"] >= 0.0
+        assert st["retry_budget_spent_total"] <= \
+            router.budget.burst \
+            + router.budget.ratio * st["requests_total"]
+        # hedges cancel their losers — no double-completion: every
+        # hedge either won (loser cancelled) or lost (itself
+        # cancelled or finished retryable); cancel count can never
+        # exceed fires
+        assert st["hedges_cancelled_total"] <= \
+            st["hedges_fired_total"]
+        assert st["hedges_won_total"] <= st["hedges_fired_total"]
+    finally:
+        reps[0].chaos_unhang()
+        _teardown(router, srv, reps)
+
+
+def test_sick_fleet_degrades_to_fast_503_within_budget(small_model):
+    """Every replica failing: the retry budget drains and callers
+    get FAST 503 ``retry_budget`` — the anti-retry-storm contract —
+    instead of timeouts or unbounded retries."""
+    # socket_reset on every response: replicas are healthy to probes
+    # but every /generate dies retryably at the write.
+    base, router, srv, reps = _spawn_fleet(
+        small_model,
+        router_kw=dict(retry_ratio=0.0, retry_burst=2.0,
+                       max_attempts=4, request_timeout_s=10.0),
+        ms_kw=dict(fault_plan={"seed": 0, "faults": [
+            {"site": "socket_reset"}]}))
+    try:
+        codes, reasons, walls = [], [], []
+        for _ in range(4):
+            t0 = time.monotonic()
+            try:
+                _post(base, {"prompt": [5, 6, 7],
+                             "max_new_tokens": 2}, timeout=30)
+                codes.append(200)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+                reasons.append(json.loads(e.read()).get("reason"))
+            walls.append(time.monotonic() - t0)
+        assert all(c == 503 for c in codes), codes
+        # burst of 2 spends on the first request(s); once drained,
+        # the deny is the terminal reason
+        assert "retry_budget" in reasons, reasons
+        st = router.stats()
+        assert st["retry_budget_denied_total"] >= 1
+        assert st["retry_budget_spent_total"] <= 2      # == burst
+        # FAST: an exhausted budget answers in well under a timeout
+        assert walls[-1] < 5.0, walls
+    finally:
+        _teardown(router, srv, reps)
+
+
+# ---------------------------------------------------------------------------
+# rolling restart: drain-aware, min-ready floor, zero failed requests
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_restart_under_live_load(small_model, refs):
+    """POST /fleet/restart drains + restarts one replica at a time
+    under live mixed load: the ready count NEVER drops below
+    min_ready=2, and ZERO requests fail (drain-shed requests retried
+    within budget count as success — the router owns the retry)."""
+    base, router, srv, reps = _spawn_fleet(
+        small_model,
+        router_kw=dict(min_ready=2, retry_ratio=0.5,
+                       retry_burst=8.0, max_attempts=4))
+    stop = threading.Event()
+    floor = [len(reps)]
+    failures = []
+    completed = [0]
+    lock = threading.Lock()
+    try:
+        # warm every replica first (restart gates on all-ready)
+        for rep in reps:
+            _post(rep.url + "/generate",
+                  {"prompt": [5, 6, 7], "max_new_tokens": 3},
+                  path="")
+
+        def monitor():
+            while not stop.is_set():
+                n = router._ready_count()
+                with lock:
+                    floor[0] = min(floor[0], n)
+                time.sleep(0.005)
+
+        def client(i):
+            name, req = _request_set()[i % 2]    # greedy + sampled
+            while not stop.is_set():
+                try:
+                    res = _post(base, dict(req), timeout=60)
+                    assert res["tokens"] == refs[name]
+                    with lock:
+                        completed[0] += 1
+                except Exception as e:  # noqa: BLE001 - collected
+                    failures.append(
+                        f"{name}: {type(e).__name__}: {e}")
+
+        mon = threading.Thread(target=monitor, daemon=True)
+        mon.start()
+        clients = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in clients:
+            t.start()
+        time.sleep(0.3)
+        state = _post(base, {}, path="/fleet/restart")
+        assert state["started"] is True
+        # a second restart while one runs: 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {}, path="/fleet/restart")
+        assert ei.value.code == 409
+        deadline = time.monotonic() + 180
+        while router.restart_state["in_progress"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        rs = router.restart_state
+        assert not rs["in_progress"], "rolling restart never finished"
+        assert rs["last_error"] is None, rs
+        assert rs["completed"] == len(reps)
+        stop.set()
+        for t in clients:
+            t.join(timeout=90)
+        mon.join(timeout=10)
+        assert not failures, failures[:5]
+        assert completed[0] > 0
+        with lock:
+            observed_floor = floor[0]
+        assert observed_floor >= 2, \
+            f"ready count dropped to {observed_floor} (< min_ready)"
+        assert rs["min_ready_floor_observed"] >= 2
+        # the fleet is whole again
+        assert router._ready_count() == 3
+    finally:
+        stop.set()
+        _teardown(router, srv, reps)
+
+
+# ---------------------------------------------------------------------------
+# router drain
+# ---------------------------------------------------------------------------
+
+
+def test_zz_router_drain_unified_schema(fleet):
+    """Router /drain flips its own readiness off with the SAME
+    unified schema the replicas answer.  Runs last: the latch is
+    one-way on the shared fleet."""
+    base, router, _, reps = fleet
+    _post(base, {}, path="/drain")
+    h = _get(base, "/healthz", expect=503)
+    assert h["status"] == "unavailable"
+    assert h["reason"] == "draining"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, {"prompt": [1, 2], "max_new_tokens": 2})
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["reason"] == "draining"

@@ -251,11 +251,17 @@ def test_zz_drain_finishes_in_flight_and_flips_readiness(
                                  method="POST")
     with urllib.request.urlopen(req, timeout=30) as r:
         assert json.loads(r.read())["draining"] is True
-    # readiness off -> the router stops sending traffic here
+    # readiness off -> the router stops sending traffic here.  The
+    # body follows the ONE unified schema every not-ready path
+    # answers ({"status": "unavailable", "reason": ...} — the
+    # router's probe parses a single contract, pinned here for the
+    # drain path and in test_faults.py for the breaker path).
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(base + "/healthz", timeout=30)
     assert ei.value.code == 503
-    assert json.loads(ei.value.read())["status"] == "draining"
+    health = json.loads(ei.value.read())
+    assert health["status"] == "unavailable"
+    assert health["reason"] == "draining"
     # new work sheds with the machine-readable reason
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(base, {"prompt": [1, 2], "max_new_tokens": 2})
