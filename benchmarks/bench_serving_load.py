@@ -96,6 +96,15 @@ stays under the ~3% agg tok/s contract documented in docs/DESIGN.md
 (``telemetry_overhead``; ``summarize_results.py`` surfaces it as its
 own column).
 
+A FLEET-OBSERVABILITY leg A/Bs the router tier's observability
+layer itself: the same mixed load through two 3-replica fleets —
+router request-span history + SLO burn accounting + a live
+``GET /fleet/metrics`` federation scraper on vs all off — under a
+seeded slow-replica chaos flavor, alternating rounds per the
+overhead protocol (``fleet_observability``); the leg also
+cross-checks the router's SLO burn-rate gauges against bench-side
+math (burn > 0 iff the bench saw violations).
+
 Run: python benchmarks/bench_serving_load.py [--model gpt2-medium]
      [--short-clients 12] [--long-clients 4] [--requests 6]
 """
@@ -455,6 +464,9 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
     fleet = bench_fleet_chaos(
         model, variables, model_name, vocab, shapes,
         n_slots=n_slots, requests=requests)
+    fleetobs = bench_fleet_observability(
+        model, variables, model_name, vocab, shapes,
+        n_slots=n_slots, requests=max(2, requests // 2))
     overload = bench_overload(model, variables, model_name, vocab,
                               shapes, n_slots=n_slots,
                               requests=requests)
@@ -500,6 +512,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         **faults,
         **chaos,
         **fleet,
+        **fleetobs,
         **overload,
         **longtail,
         **lazy,
@@ -949,6 +962,13 @@ def bench_fleet_chaos(model, variables, model_name: str, vocab: int,
         cooldown_s=0.3, retry_ratio=0.25, retry_burst=8.0,
         max_attempts=3, request_timeout_s=120.0,
         hedge="0.3", hedge_min_s=0.25,
+        # SLO burn-rate cross-check: the router's own availability
+        # accounting must agree with the bench-side outcome counts
+        # (burn > 0 iff the bench saw typed 5xx sheds); the latency
+        # objective is loose enough that nothing under this chaos
+        # mix can violate it (burn must stay 0).
+        slo="availability=99,latency_p99_ms=60000",
+        slo_window=4096,
         fleet_faults={"seed": 97, "faults": [
             # kill r1 a few requests into the burst; slow-walk r2
             {"site": "replica_kill", "replica": 1, "after": 6,
@@ -1032,6 +1052,16 @@ def bench_fleet_chaos(model, variables, model_name: str, vocab: int,
     with count_lock:
         counts["hung"] += sum(1 for t in threads if t.is_alive())
     st = router.stats()
+    # Router-side SLO accounting vs bench-side math: availability
+    # burn must be > 0 exactly when the bench counted 5xx sheds, and
+    # the loose latency objective must not have burned at all.
+    slo_obj = (st.get("slo") or {}).get("objectives", {})
+    avail_burn = slo_obj.get("availability", {}).get("burn_rate")
+    lat_burn = slo_obj.get("latency_p99_ms", {}).get("burn_rate")
+    slo_burn_consistent = (
+        avail_burn is not None and lat_burn is not None
+        and (avail_burn > 0) == (counts["failed"] > 0)
+        and lat_burn == 0.0)
     # Survivors of the storm: every replica the plan did not kill.
     survivor_miss_delta = {
         rep.id: rep.ms.recompile.snapshot()["compile_cache_misses"]
@@ -1066,6 +1096,9 @@ def bench_fleet_chaos(model, variables, model_name: str, vocab: int,
         "fleet_faults_applied": st["fleet_faults_applied"],
         "survivor_recompiles": survivor_miss_delta,
         "killed_replica_readmitted": bool(reps[1].up()),
+        "slo_availability_burn": avail_burn,
+        "slo_latency_burn": lat_burn,
+        "slo_burn_consistent": slo_burn_consistent,
     }
     router.close()
     srv.shutdown()
@@ -1083,9 +1116,213 @@ def bench_fleet_chaos(model, variables, model_name: str, vocab: int,
           f"budget={row['retry_budget_spent']}/"
           f"{row['retry_budget_cap']} "
           f"survivor_recompiles={survivor_miss_delta} "
-          f"readmitted={row['killed_replica_readmitted']}",
+          f"readmitted={row['killed_replica_readmitted']} "
+          f"slo_burn(avail={row['slo_availability_burn']}, "
+          f"lat={row['slo_latency_burn']}, "
+          f"consistent={row['slo_burn_consistent']})",
           file=sys.stderr)
     return {"fleet": row}
+
+
+def bench_fleet_observability(model, variables, model_name: str,
+                              vocab: int, shapes, *, n_slots: int,
+                              requests: int):
+    """FLEET-OBSERVABILITY overhead A/B (serving/router.py fleet
+    tier): the SAME mixed greedy/sampled load through two 3-replica
+    fleets — ON: router request-span history + SLO burn accounting
+    armed AND a live federation scraper hitting ``GET
+    /fleet/metrics`` throughout every timed round; OFF: history
+    disabled, no SLO, no scrapes — alternating rounds per the PR 11
+    protocol (one unscored warmup alternation + >=3 paired rounds
+    scored by per-arm MEDIANS, the harness's own noise floor
+    measured, rows honestly ``noisy_box``-flagged when the box
+    drifts past the band).  Both fleets run the same seeded chaos
+    flavor: one replica latches slow above the hedge watermark a few
+    requests in, so the hedge/failover machinery the observability
+    layer instruments is ACTIVE in both arms (the kill site is
+    excluded on purpose — a dead replica's capacity loss compounds
+    across rounds and would not be round-symmetric).
+
+    Alongside the overhead contract, the leg cross-checks the SLO
+    burn gauges against bench-side math on the ON fleet: the
+    impossible ``latency_p99_ms=1`` objective must burn at the
+    window maximum (every request's bench-measured latency exceeds
+    1ms), the loose ``ttft_p99_ms=30000`` must burn zero (no
+    bench-measured latency — an upper bound on TTFT — crossed 30s),
+    and ``availability`` burns iff the bench counted 5xx failures."""
+    import numpy as np
+
+    from polyaxon_tpu.serving import (LocalReplica, ModelServer,
+                                      ReplicaRouter,
+                                      make_router_server)
+
+    def factory():
+        return ModelServer(model, variables, model_name=model_name,
+                           max_batch=n_slots, batching="continuous",
+                           n_slots=n_slots, queue_depth=64)
+
+    chaos = {"seed": 11, "faults": [
+        {"site": "replica_slow", "replica": 2, "delay_s": 0.3,
+         "after": 10, "times": 1}]}
+    fleets = {}
+    try:
+        for arm in ("on", "off"):
+            reps = [LocalReplica(factory, f"r{i}")
+                    for i in range(3)]
+            router = ReplicaRouter(
+                reps, probe_interval_s=0.1, probe_timeout_s=1.5,
+                cooldown_s=0.3, retry_ratio=0.25, retry_burst=8.0,
+                max_attempts=3, request_timeout_s=120.0,
+                hedge="0.25", hedge_min_s=0.2,
+                fleet_faults=dict(chaos),
+                request_history=256 if arm == "on" else 0,
+                slo=("availability=99,ttft_p99_ms=30000,"
+                     "latency_p99_ms=1") if arm == "on" else None,
+                slo_window=4096)
+            srv = make_router_server("127.0.0.1", 0, router)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            fleets[arm] = (reps, router, srv, base)
+            # direct warm of both shapes on every replica: round 0
+            # is unscored, but a multi-second first compile inside
+            # it would starve the alternation of its warmup value
+            warm_rng = np.random.RandomState(2)
+            for rep in reps:
+                for cls in ("short", "long"):
+                    p_len, new = shapes[cls]
+                    req = urllib.request.Request(
+                        rep.url + "/generate",
+                        data=json.dumps({
+                            "prompt": warm_rng.randint(
+                                0, vocab, size=p_len).tolist(),
+                            "max_new_tokens": new}).encode(),
+                        headers={"Content-Type":
+                                 "application/json"})
+                    with urllib.request.urlopen(req,
+                                                timeout=900) as r:
+                        r.read()
+        scrapes = [0, 0]                # ok, errors
+
+        def scrape_loop(base_on, stop):
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                            base_on + "/fleet/metrics",
+                            timeout=10) as r:
+                        r.read()
+                    scrapes[0] += 1
+                except Exception:  # noqa: BLE001 - counted
+                    scrapes[1] += 1
+                stop.wait(0.25)
+
+        rounds = max(MIN_OVERHEAD_ROUNDS, 3)
+        samples = {"on": [], "off": []}
+        on_lats = []
+        failed_rounds = []
+        for rnd in range(rounds + 1):
+            order = ["on", "off"] if rnd % 2 == 0 else ["off", "on"]
+            for arm in order:
+                _, _, _, base = fleets[arm]
+                stop = None
+                if arm == "on":
+                    # the federation scraper runs ONLY during ON
+                    # rounds: scraping the on-fleet during an OFF
+                    # round would burn CPU the OFF arm pays for
+                    stop = threading.Event()
+                    threading.Thread(target=scrape_loop,
+                                     args=(base, stop),
+                                     daemon=True).start()
+                lats, wall, errors = run_mixed_load(
+                    base, n_short=8, n_long=2, requests=requests,
+                    shapes=shapes, vocab=vocab, sampled_mix=True)
+                if stop is not None:
+                    stop.set()
+                if errors:
+                    failed_rounds.append(
+                        f"rnd{rnd} arm={arm}: {errors[:3]}")
+                    continue
+                if arm == "on":
+                    # EVERY on-arm latency, warmup round included:
+                    # the router's SLO window holds all of them, so
+                    # the bench-side math below must too (a warmup
+                    # straggler that burned the window would
+                    # otherwise read as an inconsistency).
+                    on_lats += lats["short"] + lats["long"]
+                if rnd == 0:
+                    continue            # warmup alternation
+                total_toks = (len(lats["short"]) * shapes["short"][1]
+                              + len(lats["long"])
+                              * shapes["long"][1])
+                samples[arm].append(round(total_toks / wall, 1))
+        if failed_rounds or not samples["on"] or not samples["off"]:
+            print(f"# fleet-observability leg errors: "
+                  f"{failed_rounds[:3]}", file=sys.stderr)
+            return {}
+        med = {arm: round(percentile(xs, 50), 1)
+               for arm, xs in samples.items()}
+        noise_pct = max(
+            round(100.0 * (max(xs) - min(xs)) / med[arm], 2)
+            if med[arm] > 0 else 0.0
+            for arm, xs in samples.items())
+        noise = {"rounds": rounds, "noise_pct": noise_pct,
+                 "samples": samples}
+        if noise_pct > OVERHEAD_CONTRACT_PCT:
+            print(f"# fleet-observability: NOISY BOX — same-arm "
+                  f"spread {noise_pct}% exceeds the "
+                  f"{OVERHEAD_CONTRACT_PCT}% band; row will carry "
+                  f"noisy_box", file=sys.stderr)
+        # SLO burn gauges vs bench-side math (ON fleet).  burn > 0
+        # means ANY violation in the window, so each bench predicate
+        # must be the matching any/none form over the SAME request
+        # population (every on-arm request, warmup included).
+        _, router_on, _, base_on = fleets["on"]
+        st = router_on.stats()
+        obj = st["slo"]["objectives"]
+        bench_any_over_1ms = any(l > 1e-3 for l in on_lats)
+        bench_none_over_30s = bool(on_lats) \
+            and max(on_lats) < 30.0
+        slo_burn_consistent = (
+            (obj["latency_p99_ms"]["burn_rate"] > 0)
+            == bench_any_over_1ms
+            # latency bounds TTFT from above, so a bench run whose
+            # every latency stayed under 30s PROVES no TTFT
+            # violation; past 30s the bench can't see TTFT directly
+            # and asserts nothing
+            and ((obj["ttft_p99_ms"]["burn_rate"] == 0.0)
+                 if bench_none_over_30s else True)
+            # zero bench-side failures reached this point (an
+            # errored round returns {} above), so availability must
+            # not have burned
+            and obj["availability"]["burn_rate"] == 0.0)
+        row = {
+            "replicas": 3,
+            **_overhead_row(med, noise),
+            "federation_scrapes": scrapes[0],
+            "federation_scrape_errors": scrapes[1],
+            "history_records": len(router_on.history),
+            "slo_burns": {name: o["burn_rate"]
+                          for name, o in obj.items()},
+            "slo_burn_consistent": slo_burn_consistent,
+            "hedges_fired_on": st["hedges_fired_total"],
+            "fleet_faults_applied": st["fleet_faults_applied"],
+        }
+        print(f"# fleet observability overhead: on={med['on']} "
+              f"off={med['off']} tok/s -> {row['overhead_pct']}% "
+              f"(noise {noise_pct}%), "
+              f"{scrapes[0]} federation scrapes "
+              f"({scrapes[1]} errors), "
+              f"{row['history_records']} router records, "
+              f"slo burns {row['slo_burns']} "
+              f"consistent={slo_burn_consistent}", file=sys.stderr)
+        return {"fleet_observability": row}
+    finally:
+        for reps, router, srv, _ in fleets.values():
+            router.close()
+            srv.shutdown()
+            srv.server_close()
+            for rep in reps:
+                rep.close()
 
 
 def bench_overload(model, variables, model_name: str, vocab: int,
@@ -2238,6 +2475,7 @@ def main() -> int:
             or "faults_overhead" not in r \
             or "chaos" not in r \
             or "fleet" not in r \
+            or "fleet_observability" not in r \
             or "overload" not in r \
             or "longtail" not in r \
             or "lazy_longtail" not in r \
@@ -2324,11 +2562,50 @@ def main() -> int:
             fl["survivor_recompiles"]
     if not fl.get("killed_replica_readmitted"):
         fleet_violations["killed_replica_readmitted"] = False
+    if not fl.get("slo_burn_consistent"):
+        # The router's own SLO accounting disagreed with bench-side
+        # math — the burn gauges are the thing this leg attests.
+        fleet_violations["slo_burn_consistent"] = False
     if fleet_violations:
         raise SystemExit(
             f"fleet chaos soak violated the router-tier contract: "
             f"{fleet_violations} (full evidence in the fleet field "
             f"of the row just written)")
+    # The FLEET-OBSERVABILITY leg: same post-persist discipline as
+    # the other overhead legs (<=3% contract, noisy_box-aware), plus
+    # its own burn-gauge/bench-math and federation-liveness checks.
+    fo = r.get("fleet_observability")
+    if fo is None:
+        raise SystemExit(
+            "fleet_observability leg missing from this run (see "
+            "stderr above); row marked partial")
+    ov = fo.get("overhead_pct")
+    if ov is not None and ov > OVERHEAD_CONTRACT_PCT:
+        if fo.get("noisy_box"):
+            print(f"# fleet-observability overhead {ov}% is above "
+                  f"the {OVERHEAD_CONTRACT_PCT}% contract but the "
+                  f"box's own noise floor is {fo.get('noise_pct')}% "
+                  f"— row committed with noisy_box, not failed",
+                  file=sys.stderr)
+        else:
+            raise SystemExit(
+                f"fleet-observability overhead {ov}% exceeds the "
+                f"~{OVERHEAD_CONTRACT_PCT}% agg tok/s contract "
+                f"(see the fleet_observability field of the row "
+                f"just written)")
+    fo_violations = {}
+    if not fo.get("slo_burn_consistent"):
+        fo_violations["slo_burn_consistent"] = False
+    if not fo.get("federation_scrapes"):
+        fo_violations["federation_scrapes"] = 0
+    if fo.get("federation_scrape_errors"):
+        fo_violations["federation_scrape_errors"] = \
+            fo["federation_scrape_errors"]
+    if fo_violations:
+        raise SystemExit(
+            f"fleet_observability leg violated its contract: "
+            f"{fo_violations} (full evidence in the "
+            f"fleet_observability field of the row just written)")
     return 0
 
 

@@ -268,6 +268,23 @@ def fleet_value(r):
     return out
 
 
+def fleetobs_value(r):
+    """serving-load rows: the FLEET-OBSERVABILITY overhead A/B
+    column — router request-span history + SLO accounting + live
+    federation scrapes on vs off, in % agg tok/s (same <= ~3%
+    contract, noisy-box ``!`` suffix), with the federation scrape
+    count and a ``SLO!`` flag when the router's burn gauges
+    disagreed with bench-side math.  Empty for every other bench."""
+    fo = r.get("fleet_observability") or {}
+    pct = _overhead_pct(fo)
+    if not pct:
+        return ""
+    out = pct + f" ({fo.get('federation_scrapes', 0)}sc)"
+    if not fo.get("slo_burn_consistent", True):
+        out += " SLO!"
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -278,10 +295,10 @@ def main() -> int:
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
           "| spec-mix | paged | lazy | spill | mesh | telemetry "
-          "| recorder | debug | chaos | fleet | overload | mfu "
-          "| age |")
+          "| recorder | debug | chaos | fleet | fleetobs | overload "
+          "| mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|---|---|---|---|")
+          "---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -307,6 +324,7 @@ def main() -> int:
               f"| {debug_value(r)} "
               f"| {chaos_value(r)} "
               f"| {fleet_value(r)} "
+              f"| {fleetobs_value(r)} "
               f"| {overload_value(r)} "
               f"| {r.get('mfu', '')} | {age_h:.0f}h |")
     return 0

@@ -38,6 +38,8 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 
 from bench_serving_load import percentile as pctl  # noqa: E402
+from polyaxon_tpu.serving.debug import \
+    parse_replica_rid  # noqa: E402
 from polyaxon_tpu.serving.telemetry import (ENGINE_PID,  # noqa: E402
                                             REQUESTS_PID,
                                             load_trace_events)
@@ -289,6 +291,90 @@ def request_timeline(events, rid: str):
     }
 
 
+def fleet_report(doc):
+    """Summary of a saved ``GET /fleet/requests/<id>`` body (the
+    router's stitched cross-tier timeline): the attempt table, the
+    per-replica segments with their send/receive brackets and any
+    clock-clamped events, and the merged causal timeline with a
+    source column — one request's whole fleet story in one block.
+
+    Returns None when ``doc`` is not a stitched-timeline document."""
+    if not isinstance(doc, dict) or "segments" not in doc \
+            or "timeline" not in doc:
+        return None
+    router_rec = doc.get("router") or {}
+    segments = []
+    for seg in doc.get("segments", []):
+        # The replica-id prefix convention, parsed through the ONE
+        # shared helper (serving/debug.py) the router formats with.
+        replica, bare = parse_replica_rid(seg.get("request_id", ""))
+        segments.append({
+            "attempt": seg.get("attempt"),
+            "replica": seg.get("replica") or replica,
+            "request_id": seg.get("request_id"),
+            "bare_id": bare,
+            "send_ms": seg.get("send_ms"),
+            "recv_ms": seg.get("recv_ms"),
+            "status": (seg.get("record") or {}).get("status"),
+            "clamped_events": seg.get("clamped_events", 0),
+            **({"fetch_error": seg["fetch_error"]}
+               if seg.get("fetch_error") else {}),
+            **({"record_superseded": True}
+               if seg.get("record_superseded") else {}),
+        })
+    return {
+        "request_id": doc.get("request_id"),
+        "status": doc.get("status"),
+        "wall_s": doc.get("wall_s"),
+        "replicas": doc.get("replicas", []),
+        "attempts": router_rec.get("attempts", []),
+        "hedged": bool(router_rec.get("hedged")),
+        "resume_tokens": router_rec.get("resume_tokens", 0),
+        "segments": segments,
+        "timeline": doc.get("timeline", []),
+        "n_events": len(doc.get("timeline", [])),
+    }
+
+
+def print_fleet_report(fr) -> None:
+    print(f"# fleet request {fr['request_id']}: {fr['status']} in "
+          f"{fr['wall_s']}s over replicas "
+          f"{', '.join(fr['replicas']) or '(none)'}"
+          + (" [hedged]" if fr["hedged"] else "")
+          + (f" [resumed {fr['resume_tokens']} tokens]"
+             if fr["resume_tokens"] else ""))
+    print("\n## attempts (router clock, ms since submit)")
+    print("| n | replica | send | recv | outcome | code | hedge |")
+    print("|---|---|---|---|---|---|---|")
+    for a in fr["attempts"]:
+        print(f"| {a.get('n')} | {a.get('replica')} "
+              f"| {a.get('send_ms')} | {a.get('recv_ms')} "
+              f"| {a.get('outcome')} | {a.get('code', '')} "
+              f"| {'y' if a.get('hedge') else ''} |")
+    print("\n## replica segments")
+    print("| attempt | replica | replica-side id | status | note |")
+    print("|---|---|---|---|---|")
+    for s in fr["segments"]:
+        note = s.get("fetch_error") \
+            or ("superseded" if s.get("record_superseded") else "") \
+            or (f"{s['clamped_events']} clamped"
+                if s.get("clamped_events") else "")
+        print(f"| {s['attempt']} | {s['replica']} "
+              f"| {s['request_id']} | {s.get('status') or ''} "
+              f"| {note} |")
+    print("\n## merged causal timeline")
+    print("| at ms | source | event | dur ms | detail |")
+    print("|---|---|---|---|---|")
+    for e in fr["timeline"]:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in (e.get("args") or {}).items())
+        if e.get("clamped"):
+            detail = (detail + ", " if detail else "") + "clamped"
+        print(f"| {e.get('at_ms')} | {e.get('source')} "
+              f"| {e.get('event')} | {e.get('dur_ms', '')} "
+              f"| {detail} |")
+
+
 def summarize(path: str, profile_report=None):
     events = load_trace_events(path)
     attribution = None
@@ -319,9 +405,29 @@ def main() -> int:
                          "(phases, preemptions with preemptor IDs, "
                          "page waits) by its X-Request-Id instead "
                          "of the aggregate summary")
+    ap.add_argument("--fleet", action="store_true",
+                    help="TRACE_FILE is a saved GET "
+                         "/fleet/requests/<id> body (the router's "
+                         "stitched cross-tier timeline): render the "
+                         "attempt table, replica segments, and the "
+                         "merged causal timeline")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
+    if args.fleet:
+        with open(args.trace) as f:
+            fr = fleet_report(json.load(f))
+        if fr is None:
+            print(f"{args.trace} is not a stitched fleet-request "
+                  f"document (expected the GET /fleet/requests/<id> "
+                  f"shape with 'segments' and 'timeline')",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(fr, indent=2))
+            return 0
+        print_fleet_report(fr)
+        return 0
     if args.request is not None:
         tl = request_timeline(load_trace_events(args.trace),
                               args.request)

@@ -900,10 +900,23 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
               help="Seeded fleet chaos plan (JSON; replica_kill/"
                    "replica_hang/replica_slow sites) — local "
                    "replicas only.")
+@click.option("--request-history", default=256, type=int,
+              help="Router-side request-span retention ring "
+                   "(GET /fleet/requests/<id> — the cross-replica "
+                   "stitched timeline); 0 disables.")
+@click.option("--slo", default=None,
+              help="Declared objectives evaluated over a sliding "
+                   "window of the router's own accounting, e.g. "
+                   "'availability=99.9,ttft_p99_ms=1000'; exported "
+                   "as ptpu_router_slo_burn_rate{objective=}.")
+@click.option("--slo-window", default=512, type=int,
+              help="Sliding-window size (requests) the SLO burn "
+                   "rates are computed over.")
 def route(host, port, replicas, probe_interval, probe_timeout,
           down_after, cooldown, retry_ratio, retry_burst,
           max_attempts, request_timeout, hedge, hedge_min, affinity,
-          min_ready, fleet_fault_plan):
+          min_ready, fleet_fault_plan, request_history, slo,
+          slo_window):
     """Run the replica ROUTER tier in front of N `ptpu serve`
     replicas (docs/SERVING.md "Fleet").
 
@@ -915,6 +928,13 @@ def route(host, port, replicas, probe_interval, probe_timeout,
     requests past the p99 watermark (first winner cancels the
     loser), and rolls restarts via POST /fleet/restart without
     dropping below --min-ready ready replicas.
+
+    Fleet observability (docs/SERVING.md "Fleet observability"):
+    GET /fleet/requests/<id> stitches the router's request spans
+    with every involved replica's history record into one causal
+    timeline; GET /fleet/metrics federates every replica's /metrics
+    with replica= labels and fleet rollups; --slo arms router-side
+    error-budget burn-rate gauges.
     """
     from polyaxon_tpu.serving import (ReplicaRouter,
                                       make_router_server)
@@ -934,7 +954,10 @@ def route(host, port, replicas, probe_interval, probe_timeout,
             hedge_min_s=hedge_min,
             affinity=affinity,
             min_ready=min_ready,
-            fleet_faults=fleet_fault_plan)
+            fleet_faults=fleet_fault_plan,
+            request_history=request_history,
+            slo=slo,
+            slo_window=slo_window)
     except ValueError as e:
         raise click.ClickException(str(e))
     try:

@@ -70,7 +70,7 @@ from .paged import PagedSlotKVManager
 from .radix import RadixPrefixIndex
 from .recovery import CircuitBreaker, EngineSupervisor, RetryPolicy
 from .router import (LocalReplica, Replica, ReplicaRouter,
-                     RetryBudget, make_router_server)
+                     RetryBudget, SLOTracker, make_router_server)
 from .scheduler import (DeadlineExceeded, PRIORITIES,
                         PoisonedRequest, QueueFullError,
                         RequestCancelled, SamplingSpec,
@@ -89,7 +89,7 @@ __all__ = ["ModelServer", "make_server", "DecodeEngine",
            "FaultPlan", "RetryPolicy", "CircuitBreaker",
            "EngineSupervisor",
            "ReplicaRouter", "Replica", "LocalReplica",
-           "RetryBudget", "make_router_server",
+           "RetryBudget", "SLOTracker", "make_router_server",
            "Telemetry", "Histogram",
            "ProfileSession", "render_histogram",
            "RequestHistory", "StallWatchdog", "new_request_id"]

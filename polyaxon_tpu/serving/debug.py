@@ -59,9 +59,10 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-__all__ = ["new_request_id", "sanitize_request_id", "RequestHistory",
-           "SnapshotBoard", "StallWatchdog", "dump_thread_stacks",
-           "events_to_dicts"]
+__all__ = ["new_request_id", "sanitize_request_id",
+           "format_replica_rid", "parse_replica_rid",
+           "RequestHistory", "SnapshotBoard", "StallWatchdog",
+           "dump_thread_stacks", "events_to_dicts"]
 
 # Inbound X-Request-Id values are used as log fields, JSON keys, and
 # file-name-adjacent strings — constrain them to a sane charset and
@@ -86,6 +87,37 @@ def sanitize_request_id(raw: Optional[str]) -> Optional[str]:
         return None
     raw = raw.strip()
     return raw if _RID_RE.match(raw) else None
+
+
+# The replica-id prefix the router stamps on forwarded request IDs:
+# ``r<N>-<rid>``.  One parse/format pair here instead of string
+# splicing at the call sites — the router's forwarding headers, the
+# /fleet/requests stitcher, and trace_report.py all have to agree on
+# this convention or cross-tier correlation silently breaks.
+_REPLICA_RID_RE = re.compile(r"^(r\d+)-(.+)$")
+
+
+def format_replica_rid(replica_id: str, rid: str) -> str:
+    """The request ID forwarded REPLICA-ward for one (request,
+    replica) leg: ``r0-<rid>``, length-capped to the same 128-char
+    bound :data:`_RID_RE` enforces inbound (a router must never mint
+    an ID a replica would reject and regenerate — that breaks the
+    correlation the prefix exists for)."""
+    return f"{replica_id}-{rid}"[:128]
+
+
+def parse_replica_rid(prefixed: str):
+    """``(replica_id, rid)`` for a router-prefixed request ID, or
+    ``(None, prefixed)`` when the ID carries no replica prefix (a
+    request that reached the replica directly).  The inverse of
+    :func:`format_replica_rid` for well-formed prefixes; never
+    raises."""
+    if not isinstance(prefixed, str):
+        return None, prefixed
+    m = _REPLICA_RID_RE.match(prefixed)
+    if m is None:
+        return None, prefixed
+    return m.group(1), m.group(2)
 
 
 def events_to_dicts(events, t0: float) -> List[Dict[str, Any]]:

@@ -56,8 +56,35 @@ the failure class the retry/hedging policy must never amplify.
 Observability rides the existing surfaces: one ``router.stats()``
 dict renders into ``GET /metrics`` (``ptpu_router_*`` gauges) and
 ``GET /info``, and ``X-Request-Id`` is forwarded replica-ward with a
-replica-id prefix (``r0-<rid>`` — the convention serving/debug.py
-documents) so one request's history is traceable across a failover.
+replica-id prefix (``r0-<rid>`` — ``debug.format_replica_rid``) so
+one request's history is traceable across a failover.
+
+FLEET OBSERVABILITY (the cross-replica tier):
+
+- Router-side REQUEST SPANS: every routed request leaves a causal
+  record in a bounded ``debug.RequestHistory`` ring — the route
+  decision (chosen replica + why: affinity / least-outstanding /
+  half-open probe), every attempt with its send/receive bracket,
+  failover replays with their ``resume_tokens`` count, hedge
+  fire/win/cancel, and retry-budget denials.
+- ``GET /fleet/requests/<id>`` STITCHES that router timeline with
+  every involved replica's own ``GET /requests/<rN-id>`` record into
+  ONE causal timeline: per-host monotonic clocks are reconciled by
+  anchoring each replica segment at the router's SEND timestamp for
+  that attempt and clamping it inside the send/receive bracket (a
+  replica event can never appear to precede its own request or
+  outlive its response — the causal-consistency pin in
+  tests/test_fleet_observability.py).
+- ``GET /fleet/metrics`` FEDERATES every replica's ``/metrics``:
+  each series re-exported with a ``replica=`` label plus fleet
+  rollups (``<name>_fleet{agg="sum"|"min"|"max"}``), so one scrape
+  covers the tier.
+- SLO BURN RATES: declared objectives (``--slo
+  availability=99.9,ttft_p99_ms=1000``) are evaluated over a sliding
+  window of the router's OWN accounting and exported as
+  ``ptpu_router_slo_burn_rate{objective=}`` — burn 1.0 means the
+  error budget is being spent exactly at the sustainable rate,
+  burn >> 1 is the page.
 """
 
 from __future__ import annotations
@@ -65,20 +92,236 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import re
 import threading
 import time
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
-from .debug import new_request_id, sanitize_request_id
+from .debug import (RequestHistory, events_to_dicts,
+                    format_replica_rid, new_request_id,
+                    sanitize_request_id)
 from .faults import FLEET_SITES, FaultPlan
 from .recovery import CircuitBreaker, RetryPolicy
+from .telemetry import (LATENCY_BUCKETS, Histogram,
+                        parse_prometheus_families, render_histogram)
 
 __all__ = ["Replica", "LocalReplica", "ReplicaRouter", "RetryBudget",
-           "make_router_server"]
+           "SLOTracker", "make_router_server"]
 
 logger = logging.getLogger(__name__)
+
+
+# Structural no-drift contract (tests/test_fleet_observability.py):
+# EVERY key of ReplicaRouter.stats() must render on /metrics under
+# ``ptpu_router_<key>``, under a rename listed here, or carry an
+# explicit exemption reason — a new router counter that skips the
+# /metrics surface fails tier-1 instead of shipping dark.
+STATS_METRIC_RENAMES = {
+    "request_records_evicted":
+        "ptpu_router_request_records_evicted_total",
+    "rolling_restart": "ptpu_router_rolling_restart_in_progress",
+    "fleet_faults_applied": "ptpu_router_fleet_faults_applied_total",
+    # The probe-duration histogram's four stats keys all render
+    # through ONE telemetry.render_histogram family.
+    "probe_duration_buckets": "ptpu_router_probe_duration_seconds",
+    "probe_duration_hist": "ptpu_router_probe_duration_seconds",
+    "probe_duration_sum": "ptpu_router_probe_duration_seconds",
+    "probe_duration_count": "ptpu_router_probe_duration_seconds",
+    # The SLO block renders as the labeled burn-rate/target/violation
+    # families.
+    "slo": "ptpu_router_slo_burn_rate",
+}
+STATS_METRIC_EXEMPT = {
+    "hedge": "config string; hedge activity rides hedges_*_total",
+    "fleet_fault_stats": "plan-internal detail; applied counts "
+                         "render via fleet_faults_applied_total",
+}
+
+
+_SLO_PCTL_RE = re.compile(r"^(ttft|latency)_p(\d{1,2}(?:\.\d+)?)_ms$")
+
+
+class SLOTracker:
+    """Declared service objectives evaluated over a sliding window of
+    the router's own per-request accounting, exported as error-budget
+    BURN RATES.
+
+    Objectives (the ``--slo`` spec, comma-separated ``name=value``):
+
+    - ``availability=99.9`` — at most 0.1% of requests may end 5xx
+      (router sheds, deadline 504s, replica failures).  4xx client
+      errors are EXCLUDED from the window: a bad request spends no
+      error budget.
+    - ``ttft_p99_ms=1000`` / ``latency_p99_ms=500`` — at most
+      (100-99)=1% of COMPLETED requests may exceed the threshold.
+      TTFT is client-visible from the router's vantage: the winning
+      attempt's queue/hedge time at the router PLUS the replica's
+      admission-anchored TTFT (the router injects ``timings`` into
+      the forwarded request to read it; full latency stands in when
+      a replica reports none).
+
+    Burn rate = (violation rate over the window) / (error-budget
+    rate): 1.0 means the budget is being spent exactly at the
+    sustainable rate, 0 means no violations in the window, and a
+    multi-window alerting stack pages on sustained burn >> 1 —
+    Prometheus-side math the router now makes possible from its OWN
+    accounting instead of bench-side reconstruction."""
+
+    def __init__(self, objectives: Dict[str, float],
+                 window: int = 512):
+        if not objectives:
+            raise ValueError("slo needs at least one objective")
+        if window < 8:
+            raise ValueError(
+                f"slo window must be >= 8 requests; got {window}")
+        self.objectives: Dict[str, Dict[str, float]] = {}
+        for name, target in objectives.items():
+            target = float(target)
+            if name == "availability":
+                if not 0.0 < target < 100.0:
+                    raise ValueError(
+                        f"availability target must be in (0, 100); "
+                        f"got {target}")
+                budget = (100.0 - target) / 100.0
+                self.objectives[name] = {
+                    "target": target, "budget": budget}
+                continue
+            m = _SLO_PCTL_RE.match(name)
+            if m is None:
+                raise ValueError(
+                    f"unknown SLO objective {name!r} (supported: "
+                    f"availability=<pct>, ttft_p<q>_ms=<ms>, "
+                    f"latency_p<q>_ms=<ms>)")
+            q = float(m.group(2))
+            if not 0.0 < q < 100.0 or target <= 0:
+                raise ValueError(
+                    f"objective {name!r} needs 0 < percentile < 100 "
+                    f"and a positive threshold; got {target}")
+            self.objectives[name] = {
+                "target": target, "metric": m.group(1),
+                "budget": (100.0 - q) / 100.0}
+        self._lock = threading.Lock()
+        self._window: "deque[Dict[str, Any]]" = deque(maxlen=window)
+        self.violations_total = {name: 0 for name in self.objectives}
+
+    @staticmethod
+    def parse(spec: str) -> Dict[str, float]:
+        """``"availability=99.9,ttft_p99_ms=1000"`` -> objective
+        dict.  Raises ValueError with the offending piece named."""
+        out: Dict[str, float] = {}
+        for piece in str(spec).split(","):
+            piece = piece.strip()
+            if not piece:
+                continue
+            name, sep, value = piece.partition("=")
+            if not sep or not name.strip():
+                raise ValueError(
+                    f"SLO objective {piece!r} must be name=value")
+            try:
+                out[name.strip()] = float(value)
+            except ValueError:
+                raise ValueError(
+                    f"SLO objective {piece!r} has a non-numeric "
+                    f"target")
+        if not out:
+            raise ValueError(f"empty SLO spec {spec!r}")
+        return out
+
+    def observe(self, code: int, *, ttft_s: Optional[float],
+                latency_s: float) -> None:
+        """One terminal routed request.  4xx client errors are
+        excluded entirely (they spend no budget and count in no
+        window)."""
+        if 400 <= code < 500:
+            return
+        ok = code == 200
+        obs = {"ok": ok, "ttft": ttft_s if ok else None,
+               "latency": latency_s if ok else None}
+        with self._lock:
+            self._window.append(obs)
+            for name, o in self.objectives.items():
+                if name == "availability":
+                    if not ok:
+                        self.violations_total[name] += 1
+                else:
+                    v = obs[o["metric"]]
+                    if v is not None and v > o["target"] / 1e3:
+                        self.violations_total[name] += 1
+
+    def burn_rates(self) -> Dict[str, float]:
+        with self._lock:
+            window = list(self._window)
+            out = {}
+            for name, o in self.objectives.items():
+                if name == "availability":
+                    n = len(window)
+                    bad = sum(1 for w in window if not w["ok"])
+                else:
+                    vals = [w[o["metric"]] for w in window
+                            if w[o["metric"]] is not None]
+                    n = len(vals)
+                    bad = sum(1 for v in vals
+                              if v > o["target"] / 1e3)
+                rate = bad / n if n else 0.0
+                out[name] = round(rate / o["budget"], 4)
+            return out
+
+    def stats(self) -> Dict[str, Any]:
+        burns = self.burn_rates()
+        with self._lock:
+            n = len(self._window)
+            return {
+                "window": self._window.maxlen,
+                "window_observations": n,
+                "objectives": {
+                    name: {"target": o["target"],
+                           "burn_rate": burns[name],
+                           "violations_total":
+                               self.violations_total[name]}
+                    for name, o in self.objectives.items()},
+            }
+
+
+def _attempt_record(att: "_Attempt", n: int, t0: float, *,
+                    hedge: bool = False,
+                    resume_n: int = 0) -> Dict[str, Any]:
+    """ONE attempt-dict shape for every router record (/generate and
+    /prefill paths both) — the stitcher keys on n/replica/send_ms/
+    recv_ms, so the two paths must never diverge by hand."""
+    def rel(t):
+        return round(1e3 * (t - t0), 3) if t is not None else None
+
+    return {
+        "n": n,
+        "replica": att.replica.id,
+        "send_ms": rel(att.t_send),
+        "recv_ms": rel(att.t_recv),
+        "outcome": att.outcome() if att.done.is_set()
+        else "abandoned",
+        **({"code": att.code} if att.code is not None else {}),
+        **({"hedge": True} if hedge else {}),
+        **({"resume_tokens": resume_n} if resume_n else {}),
+        **({"cancelled": True} if att.cancelled else {}),
+    }
+
+
+def _terminal_status(code: int) -> str:
+    """The router record's terminal-status vocabulary — the SAME one
+    the replica history uses (server.record_front's mapping), so
+    ``GET /fleet/requests?status=`` filters read identically at both
+    tiers."""
+    if code == 200:
+        return "complete"
+    if code in (429, 503):
+        return "shed"
+    if code == 504:
+        return "expired"
+    if code == 499:
+        return "cancelled"
+    return "failed"
 
 
 class RetryBudget:
@@ -181,6 +424,11 @@ class Replica:
         #                                (rolling restart)
         self.consecutive_probe_failures = 0
         self.last_failure_t: Optional[float] = None
+        # Wall time of the most recent /healthz probe (seconds): the
+        # per-replica twin of the ptpu_router_probe_duration_seconds
+        # histogram, so a slow-but-alive replica is identifiable in
+        # rotation before it trips the hedge watermark.
+        self.last_probe_s: Optional[float] = None
         self.requests_total = 0
         self.failures_total = 0
         self._out_lock = threading.Lock()
@@ -263,6 +511,8 @@ class Replica:
             "outstanding": self.outstanding,
             "consecutive_probe_failures":
                 self.consecutive_probe_failures,
+            **({"last_probe_s": self.last_probe_s}
+               if self.last_probe_s is not None else {}),
             "requests_total": self.requests_total,
             "failures_total": self.failures_total,
         }
@@ -446,11 +696,18 @@ class _Attempt:
         self.resp: Optional[Dict[str, Any]] = None
         self.error: Optional[BaseException] = None
         self.cancelled = False
+        # Send/receive bracket (monotonic): the router-side causal
+        # anchor the /fleet/requests stitcher reconciles each
+        # replica's own clock against — a replica event for this
+        # attempt can only have happened inside [t_send, t_recv].
+        self.t_send: Optional[float] = None
+        self.t_recv: Optional[float] = None
         self._conn: Optional[http.client.HTTPConnection] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "_Attempt":
         self.replica.inc_outstanding()
+        self.t_send = time.monotonic()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"route-{self.replica.id}")
@@ -477,6 +734,7 @@ class _Attempt:
         except BaseException as e:  # transport verdicts, incl. timeout
             self.error = e
         finally:
+            self.t_recv = time.monotonic()
             self.replica.dec_outstanding()
             if conn is not None:
                 try:
@@ -546,6 +804,9 @@ class ReplicaRouter:
                  affinity_entries: int = 64,
                  min_ready: int = 1,
                  fleet_faults=None,
+                 request_history: int = 256,
+                 slo=None,
+                 slo_window: int = 512,
                  autostart: bool = True):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -604,12 +865,31 @@ class ReplicaRouter:
         self.fleet_faults = FaultPlan.load(fleet_faults) \
             if fleet_faults is not None else None
         self.draining = False
+        # Router-side request spans: the bounded terminal-record ring
+        # behind GET /fleet/requests — the SAME RequestHistory
+        # machinery each replica runs (serving/debug.py), holding the
+        # router's half of a request's causal story (route decisions,
+        # attempt brackets, failovers, hedges, budget denials).
+        # 0 disables the layer, one attribute check per request.
+        self.history = RequestHistory(request_history)
+        # Per-probe wall-time histogram: a slow-but-alive replica is
+        # visible in rotation BEFORE it trips the hedge watermark.
+        self.probe_hist = Histogram(LATENCY_BUCKETS)
+        # SLO layer: declared objectives evaluated over a sliding
+        # window of the router's own accounting (burn-rate gauges).
+        if slo is None:
+            self.slo: Optional[SLOTracker] = None
+        elif isinstance(slo, SLOTracker):
+            self.slo = slo
+        else:
+            self.slo = SLOTracker(
+                SLOTracker.parse(slo) if isinstance(slo, str)
+                else dict(slo),
+                window=int(slo_window))
         # Prefix-affinity map: registered-prefix token tuple ->
         # replica id, LRU-bounded.  Router-side mirror of what each
         # replica's radix store holds; longest-match by scan (the
         # registered-prefix population is small — system prompts).
-        from collections import OrderedDict
-
         self._affinity: "OrderedDict[Tuple[int, ...], str]" = \
             OrderedDict()
         self._affinity_cap = int(affinity_entries)
@@ -617,8 +897,6 @@ class ReplicaRouter:
         # Latency window for the hedge watermark (the engine's
         # sliding-p99 idiom: recent observations, never the
         # cumulative histogram).
-        from collections import deque
-
         self._lat_recent: "deque[float]" = deque(maxlen=64)
         self._lat_lock = threading.Lock()
         # Counters (one stats() dict -> /metrics + /info, no drift).
@@ -634,6 +912,9 @@ class ReplicaRouter:
         self.hedges_fired_total = 0
         self.hedges_won_total = 0
         self.hedges_cancelled_total = 0
+        # Metrics federation (GET /fleet/metrics): scrape accounting.
+        self.fleet_scrapes_total = 0
+        self.fleet_scrape_errors_total = 0
         self.fleet_faults_applied: Dict[str, int] = {}
         self._rr = 0                   # least-outstanding tiebreak
         # Rolling restart state (one at a time; POST /fleet/restart).
@@ -679,33 +960,29 @@ class ReplicaRouter:
 
     # -- health probing --------------------------------------------------
 
-    def _http_json(self, replica: Replica, method: str, path: str,
-                   *, body: Optional[bytes] = None
-                   ) -> Tuple[Optional[int], Dict[str, Any]]:
-        """One bounded HTTP exchange with a replica: ``(status,
-        parsed-JSON-dict)``, or ``(None, {})`` on transport failure.
-        The ONE copy of the connect/request/parse/close sequence the
-        probe, drain, and re-admission paths share (every connection
-        carries the explicit ``probe_timeout_s`` — SOCKET-TIMEOUT)."""
+    def _http_text(self, replica: Replica, method: str, path: str,
+                   *, body: Optional[bytes] = None,
+                   timeout_s: Optional[float] = None
+                   ) -> Tuple[Optional[int], bytes]:
+        """One bounded HTTP exchange with a replica: ``(status, raw
+        body)``, or ``(None, b"")`` on transport failure.  The ONE
+        copy of the connect/request/read/close sequence the probe,
+        drain, re-admission, federation-scrape, and request-stitch
+        paths share (every connection carries an explicit timeout —
+        SOCKET-TIMEOUT)."""
         conn = None
         try:
             conn = http.client.HTTPConnection(
                 replica.host, replica.port,
-                timeout=self.probe_timeout_s)
+                timeout=timeout_s if timeout_s is not None
+                else self.probe_timeout_s)
             conn.request(method, path, body,
                          {"Content-Type": "application/json"}
                          if body is not None else {})
             r = conn.getresponse()
-            raw = r.read()
-            try:
-                parsed = json.loads(raw)
-                if not isinstance(parsed, dict):
-                    parsed = {}
-            except (ValueError, TypeError):
-                parsed = {}
-            return r.status, parsed
+            return r.status, r.read()
         except (OSError, http.client.HTTPException):
-            return None, {}
+            return None, b""
         finally:
             if conn is not None:
                 try:
@@ -713,12 +990,37 @@ class ReplicaRouter:
                 except OSError:
                     pass
 
+    def _http_json(self, replica: Replica, method: str, path: str,
+                   *, body: Optional[bytes] = None
+                   ) -> Tuple[Optional[int], Dict[str, Any]]:
+        """:meth:`_http_text` with the body parsed as a JSON dict
+        (non-dict / non-JSON bodies parse to ``{}``)."""
+        status, raw = self._http_text(replica, method, path,
+                                      body=body)
+        if status is None:
+            return None, {}
+        try:
+            parsed = json.loads(raw)
+            if not isinstance(parsed, dict):
+                parsed = {}
+        except (ValueError, TypeError):
+            parsed = {}
+        return status, parsed
+
     def _probe_once(self, replica: Replica) -> None:
         """One /healthz probe.  200 -> healthy (half-open/close the
         breaker per the recovery semantics); 503 with the unified
         schema -> honest not-ready; transport failure -> crash
-        evidence."""
+        evidence.  Every probe's wall time feeds the
+        ``ptpu_router_probe_duration_seconds`` histogram and the
+        replica's ``last_probe_s`` — the early-warning surface for a
+        slow-but-alive replica (a probe that takes 800ms of a 2s
+        timeout is a replica already hurting, still in rotation)."""
+        t0 = time.monotonic()
         status, parsed = self._http_json(replica, "GET", "/healthz")
+        dt = time.monotonic() - t0
+        self.probe_hist.observe(dt)
+        replica.last_probe_s = round(dt, 6)
         if status is None:
             replica.consecutive_probe_failures += 1
             replica.health_ok = False
@@ -780,11 +1082,14 @@ class ReplicaRouter:
     # -- replica selection -----------------------------------------------
 
     def _pick(self, prompt: Optional[List[int]],
-              exclude: set) -> Optional[Replica]:
-        """Least-outstanding among in-rotation replicas, with prefix
-        affinity as a PREFERENCE: the affinity replica wins only
-        while it is healthy and below the saturation bound —
-        affinity must never beat health (pinned)."""
+              exclude: set) -> Tuple[Optional[Replica], str]:
+        """``(replica, why)``: least-outstanding among in-rotation
+        replicas, with prefix affinity as a PREFERENCE — the affinity
+        replica wins only while it is healthy and below the
+        saturation bound (affinity must never beat health, pinned).
+        ``why`` is the route-decision tag the request-span record
+        carries: ``affinity`` / ``least_outstanding`` /
+        ``half_open_probe`` / ``none``."""
         eligible = [r for r in self.replicas
                     if r.id not in exclude and r.eligible()]
         half_open = [r for r in self.replicas
@@ -796,21 +1101,22 @@ class ReplicaRouter:
             for r in eligible:
                 if r.id == aff and r.outstanding \
                         < self.affinity_max_outstanding:
-                    return r
+                    return r, "affinity"
         if eligible:
             self._rr += 1
             return min(
                 eligible,
                 key=lambda r: (r.outstanding,
                                (self.replicas.index(r) + self._rr)
-                               % len(self.replicas)))
+                               % len(self.replicas))), \
+                "least_outstanding"
         # No closed replica in rotation: offer a HALF_OPEN one its
         # single live probe (exactly one concurrent claimant passes —
         # recovery.CircuitBreaker.try_probe).
         for r in half_open:
             if r.breaker.try_probe():
-                return r
-        return None
+                return r, "half_open_probe"
+        return None, "none"
 
     # -- fleet chaos -----------------------------------------------------
 
@@ -863,18 +1169,24 @@ class ReplicaRouter:
         log, trace ring, and /requests/<id> all key on
         ``r0-<rid>`` — one grep string per (request, replica) leg of
         a failover."""
-        fwd = f"{replica.id}-{rid}"[:128]
+        fwd = format_replica_rid(replica.id, rid)
         return {"Content-Type": "application/json",
                 "X-Request-Id": fwd}
 
     def _race(self, primary: _Attempt, deadline: float,
               payload_bytes: bytes, rid: str, prompt,
-              exclude: set) -> Tuple[_Attempt, Optional[_Attempt]]:
+              exclude: set, note=None
+              ) -> Tuple[_Attempt, Optional[_Attempt]]:
         """Wait the primary out, optionally firing ONE hedge at the
         watermark; returns (winner, loser).  The winner is the first
         attempt to reach a decisive outcome (ok/terminal); a
         retryable loser is just evidence, and a still-running loser
-        is CANCELLED (connection close -> replica-side cancel)."""
+        is CANCELLED (connection close -> replica-side cancel).
+        ``note(name, t, **args)`` (optional) receives the hedge
+        lifecycle instants for the request-span record."""
+        if note is None:
+            def note(name, t, **args):
+                pass
         hedge_after = self._hedge_after_s()
         hedge: Optional[_Attempt] = None
         t0 = time.monotonic()
@@ -886,6 +1198,9 @@ class ReplicaRouter:
                 primary.cancel()
                 if hedge is not None:
                     hedge.cancel()
+                    note("hedge_cancelled", time.monotonic(),
+                         replica=hedge.replica.id,
+                         reason="deadline")
                     with self._stats_lock:
                         self.hedges_cancelled_total += 1
                 return primary, hedge
@@ -895,6 +1210,9 @@ class ReplicaRouter:
                 # Primary decided (or both are done).
                 if hedge is not None and not hedge.done.is_set():
                     hedge.cancel()
+                    note("hedge_cancelled", time.monotonic(),
+                         replica=hedge.replica.id,
+                         reason="primary_won")
                     with self._stats_lock:
                         self.hedges_cancelled_total += 1
                 return primary, hedge
@@ -905,6 +1223,10 @@ class ReplicaRouter:
                 primary_live = not primary.done.is_set()
                 if primary_live:
                     primary.cancel()
+                note("hedge_won", time.monotonic(),
+                     replica=hedge.replica.id,
+                     **({"cancelled_primary": primary.replica.id}
+                        if primary_live else {}))
                 with self._stats_lock:
                     self.hedges_won_total += 1
                     if primary_live:
@@ -913,7 +1235,7 @@ class ReplicaRouter:
             if hedge is None and hedge_after is not None \
                     and now - t0 >= hedge_after \
                     and not primary.done.is_set():
-                second = self._pick(
+                second, _why = self._pick(
                     prompt, exclude | {primary.replica.id})
                 if second is not None and self.budget.try_spend():
                     hedge = _Attempt(
@@ -921,9 +1243,18 @@ class ReplicaRouter:
                         self._forward_headers(second, rid),
                         min(self.request_timeout_s,
                             max(0.05, deadline - now))).start()
+                    note("hedge_fired", time.monotonic(),
+                         replica=second.id,
+                         watermark_s=round(hedge_after, 4))
                     with self._stats_lock:
                         self.hedges_fired_total += 1
                 else:
+                    if second is not None:
+                        # A hedge target existed but the budget said
+                        # no — the denial is part of the causal story
+                        # (budget.denied_total already counted it).
+                        note("retry_budget_denied", time.monotonic(),
+                             for_="hedge")
                     hedge_after = None      # nothing to hedge onto
             # BLOCK, don't poll: before a hedge exists the only
             # wake-up sources are the primary finishing, the hedge
@@ -952,13 +1283,117 @@ class ReplicaRouter:
         """Route one /generate body; returns (status, response).
         Failure handling lives HERE, not in the client: failover with
         resume replay, bounded by the retry budget and
-        ``max_attempts``, hedged past the p99 watermark."""
+        ``max_attempts``, hedged past the p99 watermark.  The whole
+        causal story — route decisions, attempt send/receive
+        brackets, failovers, hedges, budget denials — lands in ONE
+        terminal record in the router's history ring, the router half
+        of ``GET /fleet/requests/<id>``."""
         rid = rid or new_request_id()
+        t0 = time.monotonic()
+        # Request-span trace: (name, t_start, t_end, args) tuples in
+        # the router's monotonic clock, rendered into the record via
+        # the same events_to_dicts the replica records use.
+        trace: List[Tuple[str, float, float, Dict[str, Any]]] = []
+        attempts_log: List[Dict[str, Any]] = []
+        # With a TTFT objective armed the router needs the replica's
+        # admission-anchored TTFT, so it injects a timings request
+        # into the forwarded payload — and strips the block back off
+        # the response when the CLIENT never asked for it.
+        # Availability/latency objectives need no replica timings
+        # (latency is the router's own clock), so they don't tax the
+        # replicas with per-stream span rendering.
+        slo_inject = self.slo is not None \
+            and any(o.get("metric") == "ttft"
+                    for o in self.slo.objectives.values()) \
+            and not req.get("timings", False)
+        partial: List[int] = []        # tokens recovered so far —
+        #                                replayed with resume_tokens
+        #                                (populated by the streaming
+        #                                protocol, ROADMAP item 1;
+        #                                empty replays are full
+        #                                replays, same contract)
+
+        def note(name, a, b=None, **args):
+            trace.append((name, a, a if b is None else b, args))
+
+        def log_attempt(att: _Attempt, *, hedge: bool,
+                        resume_n: int) -> None:
+            rec = _attempt_record(att, len(attempts_log) + 1, t0,
+                                  hedge=hedge, resume_n=resume_n)
+            attempts_log.append(rec)
+            if att.t_send is not None:
+                note("attempt", att.t_send,
+                     att.t_recv if att.t_recv is not None
+                     else time.monotonic(),
+                     replica=att.replica.id, n=rec["n"],
+                     outcome=rec["outcome"],
+                     **({"code": att.code} if att.code is not None
+                        else {}),
+                     **({"hedge": True} if hedge else {}))
+
+        def finish(code: int, resp: Dict[str, Any],
+                   winner: Optional[_Attempt] = None
+                   ) -> Tuple[int, Dict[str, Any]]:
+            """Every terminal path funnels through here: the SLO
+            observation and the history record are built from the
+            same trace the response rode."""
+            now = time.monotonic()
+            if self.slo is not None:
+                ttft_s = None
+                if code == 200:
+                    tm = ((resp or {}).get("timings") or {}) \
+                        .get("ttft_ms")
+                    if tm is not None and winner is not None \
+                            and winner.t_send is not None:
+                        # Client-visible TTFT: router queue/hedge
+                        # time up to the WINNING send, plus the
+                        # replica's admission-anchored TTFT.
+                        ttft_s = (winner.t_send - t0) + tm / 1e3
+                    else:
+                        ttft_s = now - t0
+                self.slo.observe(code, ttft_s=ttft_s,
+                                 latency_s=now - t0)
+            if slo_inject and isinstance(resp, dict):
+                resp.pop("timings", None)
+            if self.history.enabled:
+                status = _terminal_status(code)
+                replicas_involved: List[str] = []
+                for a in attempts_log:
+                    if a["replica"] not in replicas_involved:
+                        replicas_involved.append(a["replica"])
+                rec: Dict[str, Any] = {
+                    "request_id": rid,
+                    "t": round(time.time(), 3),
+                    "path": "/generate",
+                    "status": status,
+                    "code": code,
+                    "wall_s": round(now - t0, 6),
+                    "attempts": attempts_log,
+                    "replicas": replicas_involved,
+                    "resume_tokens": len(partial),
+                    "timeline": events_to_dicts(trace, t0),
+                }
+                if isinstance(resp, dict):
+                    if resp.get("reason"):
+                        rec["reason"] = resp["reason"]
+                    if status != "complete" and resp.get("error"):
+                        rec["error"] = str(resp["error"])[:300]
+                # "hedged" means a hedge FIRED for this request (the
+                # attempt table's truth), not that it won — the
+                # response's router.hedged only marks wins, and a
+                # record whose summary disagreed with its own
+                # attempt table would be poison during an incident.
+                if any(a.get("hedge") for a in attempts_log):
+                    rec["hedged"] = True
+                self.history.record(rec)
+            return code, resp
+
         if self.draining:
             with self._stats_lock:
                 self.shed_total += 1
-            return 503, {"error": "router is draining",
-                         "reason": "draining", "request_id": rid}
+            return finish(503, {"error": "router is draining",
+                                "reason": "draining",
+                                "request_id": rid})
         self._poll_fleet_faults()
         with self._stats_lock:
             self.requests_total += 1
@@ -968,23 +1403,18 @@ class ReplicaRouter:
         if isinstance(rows, list) and rows:
             prompt = rows[0] if isinstance(rows[0], list) else rows
         deadline_ms = req.get("deadline_ms")
-        t0 = time.monotonic()
         deadline = t0 + (min(self.request_timeout_s,
                              deadline_ms / 1e3)
                          if isinstance(deadline_ms, (int, float))
                          and not isinstance(deadline_ms, bool)
                          and deadline_ms > 0
                          else self.request_timeout_s)
-        partial: List[int] = []        # tokens recovered so far —
-        #                                replayed with resume_tokens
-        #                                (populated by the streaming
-        #                                protocol, ROADMAP item 1;
-        #                                empty replays are full
-        #                                replays, same contract)
         exclude: set = set()
         attempt_n = 0
         while True:
             payload = dict(req)
+            if slo_inject:
+                payload["timings"] = True
             if partial:
                 # CROSS-REPLICA RESUME: prompt ++ received tokens,
                 # RNG continues at position key len(partial)
@@ -992,30 +1422,41 @@ class ReplicaRouter:
                 payload["prompt"] = list(prompt) + partial
                 payload["resume_tokens"] = len(partial)
             body = json.dumps(payload).encode()
-            replica = self._pick(prompt, exclude)
+            replica, why = self._pick(prompt, exclude)
             if replica is None and exclude:
                 # Every replica already failed this request once:
                 # widen back out rather than shedding while capacity
                 # exists (the failed one may have merely been busy).
+                note("exclusions_widened", time.monotonic(),
+                     excluded=sorted(exclude))
                 exclude = set()
-                replica = self._pick(prompt, exclude)
+                replica, why = self._pick(prompt, exclude)
             if replica is None:
                 with self._stats_lock:
                     self.shed_total += 1
                     self.errors_total += 1
-                return 503, {
+                return finish(503, {
                     "error": "no replica in rotation",
                     "reason": "no_replica", "request_id": rid,
                     "router": self._route_info(None, attempt_n,
-                                               partial)}
+                                               partial)})
             attempt_n += 1
+            note("route", time.monotonic(), replica=replica.id,
+                 why=why,
+                 **({"excluded": sorted(exclude)} if exclude
+                    else {}))
             att = _Attempt(
                 replica, "POST", "/generate", body,
                 self._forward_headers(replica, rid),
                 min(self.request_timeout_s,
                     max(0.05, deadline - time.monotonic()))).start()
             winner, loser = self._race(att, deadline, body, rid,
-                                       prompt, exclude)
+                                       prompt, exclude, note=note)
+            hedge_att = winner if winner is not att else loser
+            log_attempt(att, hedge=False, resume_n=len(partial))
+            if hedge_att is not None:
+                log_attempt(hedge_att, hedge=True,
+                            resume_n=len(partial))
             out = winner.outcome() if winner.done.is_set() \
                 else "retryable"
             if out == "ok":
@@ -1036,7 +1477,7 @@ class ReplicaRouter:
                 self._observe_latency(time.monotonic() - t0)
                 with self._stats_lock:
                     self.completed_total += 1
-                return 200, resp
+                return finish(200, resp, winner)
             if out == "terminal":
                 code = winner.code or 500
                 resp = dict(winner.resp or {"error": "replica error"})
@@ -1046,7 +1487,7 @@ class ReplicaRouter:
                     hedged=(winner is not att))
                 with self._stats_lock:
                     self.errors_total += 1
-                return code, resp
+                return finish(code, resp, winner)
             # Retryable: evidence against the replica, then fail
             # over within budget.  An attempt the ROUTER itself
             # cancelled (deadline expiry, hedge race) is NOT crash
@@ -1064,37 +1505,42 @@ class ReplicaRouter:
             if time.monotonic() >= deadline:
                 with self._stats_lock:
                     self.errors_total += 1
-                return 504, {
+                return finish(504, {
                     "error": f"request deadline exhausted after "
                              f"{attempt_n} attempt(s)",
                     "reason": "deadline", "request_id": rid,
                     "router": self._route_info(replica, attempt_n,
-                                               partial)}
+                                               partial)})
             if attempt_n >= self.max_attempts:
                 with self._stats_lock:
                     self.errors_total += 1
                     self.shed_total += 1
-                return 503, {
+                return finish(503, {
                     "error": f"request failed on {attempt_n} "
                              f"replica(s); attempts exhausted",
                     "reason": "retries_exhausted", "request_id": rid,
                     "router": self._route_info(replica, attempt_n,
-                                               partial)}
+                                               partial)})
             if not self.budget.try_spend():
                 # The sick-fleet contract: degrade to a FAST 503
                 # instead of a retry storm.
+                note("retry_budget_denied", time.monotonic(),
+                     for_="failover")
                 with self._stats_lock:
                     self.errors_total += 1
                     self.shed_total += 1
-                return 503, {
+                return finish(503, {
                     "error": "retry budget exhausted (the fleet is "
                              "failing faster than live traffic "
                              "refills retries)",
                     "reason": "retry_budget", "request_id": rid,
                     "router": self._route_info(replica, attempt_n,
-                                               partial)}
+                                               partial)})
             with self._stats_lock:
                 self.failovers_total += 1
+            note("failover", time.monotonic(),
+                 from_replica=replica.id,
+                 resume_tokens=len(partial))
             # Jittered backoff (shared RetryPolicy), bounded by the
             # deadline.
             delay = min(self.retry_policy.delay_s(attempt_n - 1),
@@ -1121,21 +1567,48 @@ class ReplicaRouter:
         outstanding one, and record the prefix -> replica binding the
         affinity router consults."""
         rid = rid or new_request_id()
+        t0 = time.monotonic()
+
+        def finish(code: int, resp: Dict[str, Any],
+                   att: Optional[_Attempt] = None, why: str = ""
+                   ) -> Tuple[int, Dict[str, Any]]:
+            if self.history.enabled:
+                attempts = []
+                if att is not None:
+                    attempts.append(_attempt_record(att, 1, t0))
+                self.history.record({
+                    "request_id": rid,
+                    "t": round(time.time(), 3),
+                    "path": "/prefill",
+                    "status": _terminal_status(code),
+                    "code": code,
+                    "wall_s": round(time.monotonic() - t0, 6),
+                    "attempts": attempts,
+                    "replicas": [a["replica"] for a in attempts],
+                    **({"why": why} if why else {}),
+                    **({"reason": resp.get("reason")}
+                       if isinstance(resp, dict)
+                       and resp.get("reason") else {}),
+                })
+            return code, resp
+
         if self.draining:
             with self._stats_lock:
                 self.shed_total += 1
-            return 503, {"error": "router is draining",
-                         "reason": "draining", "request_id": rid}
+            return finish(503, {"error": "router is draining",
+                                "reason": "draining",
+                                "request_id": rid})
         prompt = None
         rows = req.get("prompt")
         if isinstance(rows, list) and rows:
             prompt = rows[0] if isinstance(rows[0], list) else rows
-        replica = self._pick(prompt, set())
+        replica, why = self._pick(prompt, set())
         if replica is None:
             with self._stats_lock:
                 self.shed_total += 1
-            return 503, {"error": "no replica in rotation",
-                         "reason": "no_replica", "request_id": rid}
+            return finish(503, {"error": "no replica in rotation",
+                                "reason": "no_replica",
+                                "request_id": rid})
         att = _Attempt(replica, "POST", "/prefill",
                        json.dumps(req).encode(),
                        self._forward_headers(replica, rid),
@@ -1147,18 +1620,291 @@ class ReplicaRouter:
             resp = dict(att.resp or {})
             resp["request_id"] = rid
             resp["router"] = {"replica": replica.id}
-            return 200, resp
+            return finish(200, resp, att, why)
         if att.error is not None:
             replica.note_failure()
             with self._stats_lock:
                 self.errors_total += 1
-            return 503, {"error": f"replica {replica.id} failed: "
-                                  f"{type(att.error).__name__}",
-                         "reason": "replica_unreachable",
-                         "request_id": rid}
+            return finish(503, {"error": f"replica {replica.id} "
+                                         f"failed: "
+                                         f"{type(att.error).__name__}",
+                                "reason": "replica_unreachable",
+                                "request_id": rid}, att, why)
         resp = dict(att.resp or {"error": "replica error"})
         resp["request_id"] = rid
-        return att.code or 500, resp
+        return finish(att.code or 500, resp, att, why)
+
+    # -- fleet observability: cross-tier stitching -----------------------
+
+    def fleet_request(self, rid: str) -> Optional[Dict[str, Any]]:
+        """``GET /fleet/requests/<id>``: ONE merged causal timeline
+        for a routed request — the router's record (route decisions,
+        attempt brackets, failovers, hedges) stitched with every
+        involved replica's own ``GET /requests/<rN-id>`` record.
+
+        CLOCK RECONCILIATION: the router and each replica run
+        independent monotonic clocks, so replica-local offsets are
+        meaningless fleet-wide.  Each replica segment is anchored at
+        the router's SEND timestamp for that attempt and clamped
+        inside the send/receive bracket — by causality the replica
+        processed the request inside that bracket, so the stitched
+        ordering is consistent even with arbitrary clock skew (the
+        residual error is the one-way network delay, bounded by the
+        bracket width; events the clamp had to move carry
+        ``clamped: true``).  A re-attempt on the SAME replica shares
+        one replica-side record (replace-by-id retention): only the
+        LAST attempt's segment carries it, earlier ones read
+        ``record_superseded``."""
+        rec = self.history.get(rid)
+        if rec is None:
+            return None
+        by_id = {r.id: r for r in self.replicas}
+        merged: List[Dict[str, Any]] = []
+        for ev in rec.get("timeline", []):
+            merged.append({"at_ms": ev.get("start_ms"),
+                           **({"dur_ms": ev["dur_ms"]}
+                              if ev.get("dur_ms") else {}),
+                           "source": "router",
+                           "event": ev.get("name"),
+                           **({"args": ev["args"]}
+                              if ev.get("args") else {})})
+        attempts = rec.get("attempts", [])
+        # One replica record per replica (replace-by-id retention):
+        # fetch it for the LAST attempt on each replica only — and
+        # fetch the replicas CONCURRENTLY, like the federation
+        # scrape: a failover across hung replicas must not make the
+        # endpoint that debugs it pay each timeout back to back.
+        last_per_replica = {a["replica"]: a["n"] for a in attempts}
+        fetches: Dict[str, List] = {}
+        fetch_threads = []
+        for replica_id in last_per_replica:
+            replica = by_id.get(replica_id)
+            if replica is None:
+                continue
+            fwd = format_replica_rid(replica_id, rid)
+            slot: List = [None, {}]
+            fetches[replica_id] = slot
+
+            def fetch(replica=replica, fwd=fwd, slot=slot):
+                slot[0], slot[1] = self._http_json(
+                    replica, "GET", f"/requests/{fwd}")
+
+            t = threading.Thread(target=fetch, daemon=True,
+                                 name=f"fleet-stitch-{replica_id}")
+            fetch_threads.append(t)
+            t.start()
+        for t in fetch_threads:
+            t.join(timeout=self.probe_timeout_s + 1.0)
+        segments: List[Dict[str, Any]] = []
+        for att in attempts:
+            replica_id = att["replica"]
+            seg: Dict[str, Any] = {
+                "replica": replica_id,
+                "attempt": att["n"],
+                "request_id": format_replica_rid(replica_id, rid),
+                "send_ms": att.get("send_ms"),
+                "recv_ms": att.get("recv_ms"),
+            }
+            if last_per_replica.get(replica_id) != att["n"]:
+                # An earlier attempt on a replica a later attempt
+                # also hit: the replica's ring keeps only the latest
+                # record for this ID.
+                seg["record_superseded"] = True
+                segments.append(seg)
+                continue
+            if replica_id not in fetches:
+                seg["fetch_error"] = "replica_gone"
+                segments.append(seg)
+                continue
+            status, body = fetches[replica_id]
+            if status is None:
+                seg["fetch_error"] = "unreachable"
+                segments.append(seg)
+                continue
+            if status != 200:
+                seg["fetch_error"] = f"http_{status}"
+                if isinstance(body, dict) and body.get("error"):
+                    seg["fetch_detail"] = str(body["error"])[:200]
+                segments.append(seg)
+                continue
+            seg["record"] = body
+            seg["clamped_events"] = self._anchor_segment(
+                seg, body, merged)
+            segments.append(seg)
+        merged.sort(key=lambda e: (e.get("at_ms")
+                                   if e.get("at_ms") is not None
+                                   else 0.0))
+        return {
+            "request_id": rid,
+            "status": rec.get("status"),
+            "path": rec.get("path"),
+            "wall_s": rec.get("wall_s"),
+            "replicas": rec.get("replicas", []),
+            "router": rec,
+            "segments": segments,
+            "timeline": merged,
+        }
+
+    @staticmethod
+    def _anchor_segment(seg: Dict[str, Any],
+                        record: Dict[str, Any],
+                        merged: List[Dict[str, Any]]) -> int:
+        """Fold one replica record's stream timelines into the
+        merged fleet timeline, anchored to the attempt's send/receive
+        bracket.  Returns how many events the clamp had to move."""
+        send_ms = seg.get("send_ms")
+        recv_ms = seg.get("recv_ms")
+        if send_ms is None:
+            return 0
+        clamped = 0
+        for stream in record.get("streams", []):
+            for ev in stream.get("timeline", []):
+                at = send_ms + max(0.0, ev.get("start_ms", 0.0))
+                dur = ev.get("dur_ms", 0.0) or 0.0
+                was_clamped = False
+                if recv_ms is not None:
+                    if at > recv_ms:
+                        at, was_clamped = recv_ms, True
+                    if at + dur > recv_ms:
+                        dur, was_clamped = recv_ms - at, True
+                if was_clamped:
+                    clamped += 1
+                merged.append({
+                    "at_ms": round(at, 3),
+                    **({"dur_ms": round(dur, 3)} if dur else {}),
+                    "source": seg["replica"],
+                    "event": ev.get("name"),
+                    **({"args": ev["args"]} if ev.get("args")
+                       else {}),
+                    **({"clamped": True} if was_clamped else {}),
+                })
+        return clamped
+
+    # -- fleet observability: metrics federation -------------------------
+
+    # Families whose fleet rollup is a plain sum (counters and the
+    # cumulative histogram/summary component series); gauges get
+    # sum AND min/max (a fleet-wide queue_len sum says load, the max
+    # says imbalance).
+    _SUM_TYPES = frozenset({"counter", "histogram", "summary"})
+
+    def fleet_metrics_text(self) -> str:
+        """``GET /fleet/metrics``: the router's own exposition, every
+        replica's ``/metrics`` re-exported with a ``replica=`` label,
+        and fleet ROLLUPS (``<name>_fleet{agg=...}``) — one scrape
+        target for the whole tier.  A replica that fails its scrape
+        is reported via ``ptpu_fleet_replica_scrape_ok{replica=}``
+        and the ``fleet_scrape_errors_total`` counter; its series are
+        simply absent (partial federation beats a 500)."""
+        errors = 0
+        fam_types: Dict[str, str] = {}
+        fam_lines: "OrderedDict[str, List[str]]" = OrderedDict()
+        rollup: "OrderedDict[Tuple[str, str], List[float]]" = \
+            OrderedDict()
+        scrape_ok: List[Tuple[str, int]] = []
+        replicas = list(self.replicas)
+        # Scrape the fleet CONCURRENTLY: a sequential walk pays each
+        # hung replica's full timeout back to back (N x timeout on
+        # the scrape path, exactly when the fleet is degraded and a
+        # scraper's own timeout is ticking); the fetches are
+        # independent, so one thread each, joined within the bounded
+        # socket timeout they all share.
+        results: List[Optional[Tuple[Optional[int], bytes]]] = \
+            [None] * len(replicas)
+
+        def scrape(i: int, replica: Replica) -> None:
+            results[i] = self._http_text(replica, "GET", "/metrics")
+
+        threads = [threading.Thread(target=scrape, args=(i, r),
+                                    daemon=True,
+                                    name=f"fleet-scrape-{r.id}")
+                   for i, r in enumerate(replicas)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.probe_timeout_s + 1.0)
+        for replica, res in zip(replicas, results):
+            status, raw = res if res is not None else (None, b"")
+            if status != 200 or not raw:
+                errors += 1
+                scrape_ok.append((replica.id, 0))
+                continue
+            try:
+                types, samples = parse_prometheus_families(
+                    raw.decode("utf-8", "replace"))
+            except ValueError:
+                errors += 1
+                scrape_ok.append((replica.id, 0))
+                continue
+            scrape_ok.append((replica.id, 1))
+            for name, t in types.items():
+                fam_types.setdefault(name, t)
+            for name, labels, value in samples:
+                lab = f'replica="{replica.id}"' \
+                    + (f",{labels}" if labels else "")
+                fam_lines.setdefault(name, []).append(
+                    f"{name}{{{lab}}} {value}")
+                try:
+                    rollup.setdefault((name, labels),
+                                      []).append(float(value))
+                except ValueError:
+                    pass
+        with self._stats_lock:
+            self.fleet_scrapes_total += 1
+            self.fleet_scrape_errors_total += errors
+        # Router's own metrics AFTER the counters above so the scrape
+        # that failed is already visible in the exposition it emits.
+        lines = [self.metrics_text().rstrip("\n")]
+        lines.append("# TYPE ptpu_fleet_replica_scrape_ok gauge")
+        for rid_, ok in scrape_ok:
+            lines.append(
+                f'ptpu_fleet_replica_scrape_ok{{replica="{rid_}"}} '
+                f'{ok}')
+        for name, ls in fam_lines.items():
+            t = self._family_type(name, fam_types)
+            if t:
+                lines.append(f"# TYPE {name} {t}")
+            lines.extend(ls)
+        # Fleet rollups: per distinct (family, label-set), summed
+        # across replicas — and min/max spread for gauges.
+        emitted_type: set = set()
+        for (name, labels), values in rollup.items():
+            t = self._family_type(name, fam_types)
+            if t in self._SUM_TYPES:
+                aggs = (("sum", sum(values)),)
+            elif t == "gauge":
+                aggs = (("sum", sum(values)),
+                        ("min", min(values)),
+                        ("max", max(values)))
+            else:
+                continue            # untyped: no meaningful rollup
+            rname = f"{name}_fleet"
+            if rname not in emitted_type:
+                emitted_type.add(rname)
+                lines.append(
+                    f"# TYPE {rname} "
+                    f"{'counter' if t in self._SUM_TYPES else 'gauge'}")
+            for agg, v in aggs:
+                lab = f'agg="{agg}"' + (f",{labels}" if labels
+                                        else "")
+                v = round(v, 6)
+                lines.append(f"{rname}{{{lab}}} "
+                             f"{int(v) if v == int(v) else v}")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _family_type(name: str, types: Dict[str, str]
+                     ) -> Optional[str]:
+        """The declared TYPE for a SAMPLE name: direct hit, or the
+        histogram/summary component suffixes resolved to their
+        family's declaration."""
+        t = types.get(name)
+        if t is not None:
+            return t
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                return types.get(name[:-len(suffix)])
+        return None
 
     # -- rolling restart -------------------------------------------------
 
@@ -1270,7 +2016,9 @@ class ReplicaRouter:
 
     def stats(self) -> Dict[str, Any]:
         """ONE dict behind /metrics and /info (the no-drift contract
-        every serving counter family follows)."""
+        every serving counter family follows) — held STRUCTURALLY by
+        tests/test_fleet_observability.py: every key here must render
+        on /metrics per STATS_METRIC_RENAMES/STATS_METRIC_EXEMPT."""
         with self._stats_lock:
             counters = {
                 "requests_total": self.requests_total,
@@ -1283,6 +2031,9 @@ class ReplicaRouter:
                 "hedges_fired_total": self.hedges_fired_total,
                 "hedges_won_total": self.hedges_won_total,
                 "hedges_cancelled_total": self.hedges_cancelled_total,
+                "fleet_scrapes_total": self.fleet_scrapes_total,
+                "fleet_scrape_errors_total":
+                    self.fleet_scrape_errors_total,
                 "fleet_faults_applied":
                     dict(self.fleet_faults_applied),
             }
@@ -1291,6 +2042,8 @@ class ReplicaRouter:
             restarts_total = self.restarts_completed_total
         with self._affinity_lock:
             affinity_entries = len(self._affinity)
+        probe_counts, probe_sum, probe_n = \
+            self.probe_hist.snapshot()
         return {
             **counters,
             **self.budget.stats(),
@@ -1301,6 +2054,18 @@ class ReplicaRouter:
             "affinity_entries": affinity_entries,
             "rolling_restart": restart,
             "rolling_restarts_completed_total": restarts_total,
+            # Router-side request spans: ring occupancy/evictions
+            # (GET /fleet/requests) — serving/debug.RequestHistory.
+            **self.history.stats(),
+            # Per-probe wall-time histogram (per-bucket counts, the
+            # render_histogram shape — same idiom as the engine's
+            # spec-acceptance histogram).
+            "probe_duration_buckets": list(self.probe_hist.buckets),
+            "probe_duration_hist": probe_counts,
+            "probe_duration_sum": round(probe_sum, 6),
+            "probe_duration_count": probe_n,
+            **({"slo": self.slo.stats()}
+               if self.slo is not None else {}),
             **({"fleet_fault_stats": self.fleet_faults.stats()}
                if self.fleet_faults is not None else {}),
         }
@@ -1324,16 +2089,58 @@ class ReplicaRouter:
                   "resumed_tokens_total", "hedges_fired_total",
                   "hedges_won_total", "hedges_cancelled_total",
                   "retry_budget_spent_total",
-                  "retry_budget_denied_total"):
+                  "retry_budget_denied_total",
+                  "fleet_scrapes_total",
+                  "fleet_scrape_errors_total",
+                  "request_records_total"):
             counter(k, st[k])
+        counter("request_records_evicted_total",
+                st["request_records_evicted"])
         gauge("retry_budget_level", st["retry_budget_level"])
+        gauge("retry_budget_ratio", st["retry_budget_ratio"])
+        gauge("retry_budget_burst", st["retry_budget_burst"])
         gauge("replicas", len(st["replicas"]))
         gauge("replicas_ready", st["replicas_ready"])
         gauge("draining", int(st["draining"]))
+        gauge("affinity_entries", st["affinity_entries"])
+        gauge("request_history", st["request_history"])
+        gauge("request_records", st["request_records"])
         gauge("rolling_restart_in_progress",
               int(st["rolling_restart"]["in_progress"]))
         counter("rolling_restarts_completed_total",
                 st["rolling_restarts_completed_total"])
+        # Per-probe wall-time histogram, rendered by the SAME shared
+        # telemetry helper as every serving histogram (satellite: a
+        # slow-but-alive replica shows up here before the hedge
+        # watermark trips).
+        lines += render_histogram(
+            "ptpu_router_probe_duration_seconds",
+            st["probe_duration_buckets"], st["probe_duration_hist"],
+            st["probe_duration_sum"], st["probe_duration_count"])
+        # SLO layer: burn-rate / target / violation families per
+        # declared objective, from the same stats() dict.
+        if "slo" in st:
+            slo = st["slo"]
+            objectives = sorted(slo["objectives"].items())
+            lines.append("# TYPE ptpu_router_slo_burn_rate gauge")
+            for name, o in objectives:
+                lines.append(
+                    f'ptpu_router_slo_burn_rate'
+                    f'{{objective="{name}"}} {o["burn_rate"]}')
+            lines.append("# TYPE ptpu_router_slo_target gauge")
+            for name, o in objectives:
+                lines.append(
+                    f'ptpu_router_slo_target'
+                    f'{{objective="{name}"}} {o["target"]}')
+            lines.append(
+                "# TYPE ptpu_router_slo_violations_total counter")
+            for name, o in objectives:
+                lines.append(
+                    f'ptpu_router_slo_violations_total'
+                    f'{{objective="{name}"}} '
+                    f'{o["violations_total"]}')
+            gauge("slo_window_observations",
+                  slo["window_observations"])
         lines.append("# TYPE ptpu_router_replica_up gauge")
         for r in st["replicas"]:
             lines.append(
@@ -1351,6 +2158,15 @@ class ReplicaRouter:
                 f'ptpu_router_replica_probe_failures'
                 f'{{replica="{r["id"]}"}} '
                 f'{r["consecutive_probe_failures"]}')
+        # Most recent probe wall per replica: the labeled twin of the
+        # probe-duration histogram, so the SLOW replica is nameable.
+        lines.append(
+            "# TYPE ptpu_router_replica_last_probe_seconds gauge")
+        for r in st["replicas"]:
+            if r.get("last_probe_s") is not None:
+                lines.append(
+                    f'ptpu_router_replica_last_probe_seconds'
+                    f'{{replica="{r["id"]}"}} {r["last_probe_s"]}')
         lines.append(
             "# TYPE ptpu_router_fleet_faults_applied_total counter")
         for site, n in sorted(st["fleet_faults_applied"].items()):
@@ -1381,8 +2197,11 @@ def make_router_server(host: str, port: int,
     /prefill route to replicas; /healthz answers the SAME unified
     schema the replicas do (503 ``no_replica`` when rotation is
     empty, ``draining`` once drained); /metrics + /info render
-    router.stats(); POST /fleet/restart starts the rolling
-    restart."""
+    router.stats(); POST /fleet/restart starts the rolling restart.
+    Fleet observability: GET /fleet/requests[/<id>] serves the
+    router's request-span ring and the cross-tier stitched timeline,
+    GET /fleet/metrics federates every replica's /metrics with
+    ``replica=`` labels and fleet rollups."""
 
     class Handler(BaseHTTPRequestHandler):
         def _req_id(self) -> str:
@@ -1408,8 +2227,64 @@ def make_router_server(host: str, port: int,
         def log_message(self, fmt, *args):
             pass
 
+        def _send_text(self, body: bytes) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass
+
+        def _do_fleet_requests(self, path: str) -> None:
+            """The router half of the request-debuggability surface:
+
+            - ``GET /fleet/requests?status=&limit=`` — newest-first
+              summaries from the router's terminal-record ring.
+            - ``GET /fleet/requests/<id>`` — the STITCHED cross-tier
+              causal timeline (router record + every involved
+              replica's history record, clock-reconciled)."""
+            if not router.history.enabled:
+                self._send(400, {
+                    "error": "router request history disabled "
+                             "(start the router with "
+                             "--request-history N)"})
+                return
+            if path in ("/fleet/requests", "/fleet/requests/"):
+                q = parse_qs(urlparse(self.path).query)
+                status = (q.get("status") or [None])[0]
+                try:
+                    limit = int((q.get("limit") or ["100"])[0])
+                except ValueError:
+                    self._send(400,
+                               {"error": "limit must be an int"})
+                    return
+                self._send(200, {
+                    "requests": router.history.list(status=status,
+                                                    limit=limit),
+                    **router.history.stats()})
+                return
+            want = path[len("/fleet/requests/"):]
+            stitched = router.fleet_request(want)
+            if stitched is None:
+                self._send(404, {
+                    "error": f"no router record for request "
+                             f"{want!r} (never routed, or rolled "
+                             f"off the "
+                             f"{router.history.capacity}-record "
+                             f"retention ring)"})
+            else:
+                self._send(200, stitched)
+
         def do_GET(self):
             self._req_id()
+            path = urlparse(self.path).path
+            if path == "/fleet/requests" \
+                    or path.startswith("/fleet/requests/"):
+                self._do_fleet_requests(path)
+                return
             if self.path == "/healthz":
                 ready = router._ready_count()
                 if router.draining:
@@ -1426,16 +2301,12 @@ def make_router_server(host: str, port: int,
             elif self.path == "/info":
                 self._send(200, router.info())
             elif self.path == "/metrics":
-                body = router.metrics_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                try:
-                    self.wfile.write(body)
-                except OSError:
-                    pass
+                self._send_text(router.metrics_text().encode())
+            elif self.path == "/fleet/metrics":
+                # Metrics federation: router + every replica's
+                # /metrics (replica= labels) + fleet rollups, one
+                # Prometheus scrape for the whole tier.
+                self._send_text(router.fleet_metrics_text().encode())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 

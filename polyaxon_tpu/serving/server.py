@@ -175,6 +175,43 @@ class PagePins(tuple):
 _span_dicts = events_to_dicts
 
 
+# Structural no-drift contract (tests/test_fleet_observability.py):
+# EVERY key of engine.stats() must render on the server's /metrics
+# under ``ptpu_serving_<key>``, under a rename listed here, or carry
+# an explicit exemption reason below — earlier PRs re-pinned this
+# counter by counter; the structural walk means a NEW engine counter
+# that skips the /metrics surface fails tier-1 instead of shipping
+# dark.
+ENGINE_STATS_METRIC_RENAMES = {
+    "expired_total": "ptpu_serving_deadline_expired_total",
+    # The breaker state string renders as the 0/1 open gauge.
+    "breaker_state": "ptpu_serving_breaker_open",
+    # The per-site dict IS the labeled counter family.
+    "faults_injected": "ptpu_serving_faults_injected_total",
+    # The acceptance-rate histogram's four stats keys all render
+    # through ONE telemetry.render_histogram family.
+    "spec_accept_buckets": "ptpu_serving_spec_accept_rate",
+    "spec_accept_hist": "ptpu_serving_spec_accept_rate",
+    "spec_accept_sum": "ptpu_serving_spec_accept_rate",
+    "spec_accept_count": "ptpu_serving_spec_accept_rate",
+    # Recompile-sentinel counters (telemetry.render_compile_cache).
+    "compile_cache_misses": "ptpu_serving_compile_cache_misses_total",
+    "compile_cache_hits": "ptpu_serving_compile_cache_hits_total",
+    "compile_cache_evictions":
+        "ptpu_serving_compile_cache_evictions_total",
+}
+ENGINE_STATS_METRIC_EXEMPT = {
+    "faults_injected_total":
+        "sum of the labeled ptpu_serving_faults_injected_total{site=}"
+        " series a scrape can compute",
+    "compile_cache_by_kind":
+        "per-kind split lives in /info's routing report; the totals "
+        "render via render_compile_cache",
+    "mesh": "topology dict; renders as ptpu_serving_mesh_devices + "
+            "per-axis ptpu_serving_mesh_axis_size{axis=}",
+}
+
+
 def _int_param(v):
     """int() that refuses booleans: int(True) == 1 would silently
     accept {"num_beams": true} / {"prefill_chunk": true}."""
@@ -2401,6 +2438,8 @@ class ModelServer:
                 f"ptpu_serving_slot_occupancy {es['slot_occupancy']}",
                 "# TYPE ptpu_serving_queue_len gauge",
                 f"ptpu_serving_queue_len {es['queue_len']}",
+                "# TYPE ptpu_serving_queue_depth gauge",
+                f"ptpu_serving_queue_depth {es['queue_depth']}",
                 "# TYPE ptpu_serving_admitted_total counter",
                 f"ptpu_serving_admitted_total {es['admitted_total']}",
                 # admissions/completions split by decode mode: how
@@ -2450,6 +2489,17 @@ class ModelServer:
                 f"{es['preempted_total']}",
                 "# TYPE ptpu_serving_resumed_total counter",
                 f"ptpu_serving_resumed_total {es['resumed_total']}",
+                # Page-shed and exhaustion-preempt counters live in
+                # engine.stats() on EVERY layout (0 on fixed lanes),
+                # so they render unconditionally — the structural
+                # no-drift walk covers fixed-lane servers too.
+                "# TYPE ptpu_serving_shed_kv_pages_total counter",
+                f"ptpu_serving_shed_kv_pages_total "
+                f"{es['shed_kv_pages_total']}",
+                "# TYPE ptpu_serving_kv_preempt_exhaustion_total "
+                "counter",
+                f"ptpu_serving_kv_preempt_exhaustion_total "
+                f"{es['kv_preempt_exhaustion_total']}",
                 # Fault tolerance (serving/faults.py + recovery.py):
                 # step retries, requeue-and-resume events, quarantine
                 # convictions, supervised crash/restart totals, the
@@ -2551,6 +2601,9 @@ class ModelServer:
                     "counter",
                     f"ptpu_serving_step_wall_seconds_total "
                     f"{es['step_wall_seconds_total']}",
+                    "# TYPE ptpu_serving_step_device_share gauge",
+                    f"ptpu_serving_step_device_share "
+                    f"{es['step_device_share'] or 0}",
                 ]
             if "kv_pages" in es:
                 # Paged-KV page-pool gauges (kv_paged engines only):
@@ -2571,13 +2624,13 @@ class ModelServer:
                     "# TYPE ptpu_serving_kv_pages_shared gauge",
                     f"ptpu_serving_kv_pages_shared "
                     f"{es['kv_pages_shared']}",
-                    "# TYPE ptpu_serving_shed_kv_pages_total counter",
-                    f"ptpu_serving_shed_kv_pages_total "
-                    f"{es['shed_kv_pages_total']}",
                     # Tiered KV memory (PR 12): lazy growth/preempt
                     # counters from the same engine.stats() dict, and
                     # the host-spill tier's gauges from ONE
                     # _spill_stats() dict shared with /info.
+                    "# TYPE ptpu_serving_kv_lazy gauge",
+                    f"ptpu_serving_kv_lazy "
+                    f"{1 if es['kv_lazy'] else 0}",
                     "# TYPE ptpu_serving_kv_pages_lazy_growths_total "
                     "counter",
                     f"ptpu_serving_kv_pages_lazy_growths_total "
@@ -2586,10 +2639,6 @@ class ModelServer:
                     "counter",
                     f"ptpu_serving_kv_pages_lazy_grown_total "
                     f"{es['kv_pages_lazy_grown_total']}",
-                    "# TYPE ptpu_serving_kv_preempt_exhaustion_total "
-                    "counter",
-                    f"ptpu_serving_kv_preempt_exhaustion_total "
-                    f"{es['kv_preempt_exhaustion_total']}",
                 ]
                 sp = self._spill_stats()
                 lines += [

@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = ["Histogram", "Telemetry", "ProfileSession",
            "render_histogram", "render_compile_cache",
            "dump_spans_jsonl",
+           "parse_prometheus_text", "parse_prometheus_families",
            "LATENCY_BUCKETS", "PER_TOKEN_BUCKETS",
            "REQUESTS_PID", "ENGINE_PID"]
 
@@ -438,6 +439,51 @@ def parse_prometheus_text(body: str) -> Dict[str, float]:
                              f"{line!r}")
         out[name] = float(value)   # raises on a non-numeric value
     return out
+
+
+def parse_prometheus_families(body: str
+                              ) -> Tuple[Dict[str, str],
+                                         List[Tuple[str, str, str]]]:
+    """Prometheus text split for RE-exposition (the router tier's
+    ``GET /fleet/metrics`` federation): ``(types, samples)`` where
+    ``types`` maps each declared family name to its ``# TYPE``, and
+    ``samples`` is the ordered list of ``(name, labels, raw_value)``
+    — ``labels`` is the inner label string (``''`` when unlabeled)
+    and the value is kept RAW, so a federator relaying a number never
+    reformats it.  Strict like :func:`parse_prometheus_text`: a
+    malformed sample line or non-numeric value raises."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, str, str]] = []
+    for lineno, line in enumerate(body.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        labels = ""
+        if "{" in line:
+            # Label VALUES may legally contain spaces — split at the
+            # closing brace, not the last space (a federated replica
+            # exporting reason="engine down" must not cost its whole
+            # scrape).
+            end = line.rfind("} ")
+            i = line.find("{")
+            if end < 0 or i < 0 or i > end:
+                raise ValueError(f"line {lineno}: unbalanced labels "
+                                 f"in {line!r}")
+            name = line[:i]
+            labels = line[i + 1:end]
+            value = line[end + 2:].strip()
+        else:
+            name, _, value = line.rpartition(" ")
+        if not name or any(c.isspace() for c in name):
+            raise ValueError(f"line {lineno}: malformed metric line "
+                             f"{line!r}")
+        float(value)          # raises on a non-numeric value
+        samples.append((name, labels, value))
+    return types, samples
 
 
 def load_trace_events(path: str) -> List[Dict[str, Any]]:
