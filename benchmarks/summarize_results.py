@@ -285,6 +285,33 @@ def fleetobs_value(r):
     return out
 
 
+def fleetprefix_value(r):
+    """serving-load rows: the FLEET-PREFIX column — through-restart
+    hit rate, wire-fetch arm vs per-replica-only arm (the PR 16
+    migration tier's headline), plus the wire-fetch TTFT as a
+    fraction of the re-prefill cost it replaces (contract: between
+    the local spilled-hit ratio and 1.0; ``!`` marks a noisy-box
+    ordering the box could not resolve).  ``INEXACT`` flags a
+    wire-fetched greedy stream that diverged from the local one —
+    the bitwise-identity contract violated (the bench run itself
+    fails on it; a committed flag marks a preserved-evidence row).
+    Empty for every other bench."""
+    fp = r.get("fleet_prefix") or {}
+    if not fp:
+        return ""
+    fleet = (fp.get("fleet") or {}).get("restart_hit_rate")
+    local = (fp.get("per_replica") or {}).get("restart_hit_rate")
+    out = f"hit {fleet} vs {local}"
+    ratio = fp.get("wire_fetch_vs_re_prefill")
+    if ratio is not None:
+        out += f"; wire {ratio}x"
+        if not fp.get("wire_between_bounds"):
+            out += "!"
+    if not fp.get("exact", True):
+        out += " INEXACT"
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -294,11 +321,11 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | paged | lazy | spill | mesh | telemetry "
-          "| recorder | debug | chaos | fleet | fleetobs | overload "
-          "| mfu | age |")
+          "| spec-mix | paged | lazy | spill | fleetpfx | mesh "
+          "| telemetry | recorder | debug | chaos | fleet | fleetobs "
+          "| overload | mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|---|---|---|---|---|")
+          "---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -318,6 +345,7 @@ def main() -> int:
               f"| {paged_value(r)} "
               f"| {lazy_value(r)} "
               f"| {spill_value(r)} "
+              f"| {fleetprefix_value(r)} "
               f"| {meshed_value(r)} "
               f"| {telemetry_value(r)} "
               f"| {recorder_value(r)} "

@@ -308,6 +308,16 @@ def fleet_report(doc):
         # The replica-id prefix convention, parsed through the ONE
         # shared helper (serving/debug.py) the router formats with.
         replica, bare = parse_replica_rid(seg.get("request_id", ""))
+        rec = seg.get("record") or {}
+        # Per-request PREFIX SOURCE: where this attempt's prefill
+        # came from (local-hot / local-spilled / wire-fetch /
+        # re-prefill) — the replica record's prefix provenance
+        # (engine prefix_info, PR 16), re-prefill when the record
+        # completed without a prefix block.
+        prefix = rec.get("prefix") or {}
+        source = prefix.get("source")
+        if source is None and rec.get("status") is not None:
+            source = "re_prefill"
         segments.append({
             "attempt": seg.get("attempt"),
             "replica": seg.get("replica") or replica,
@@ -315,13 +325,23 @@ def fleet_report(doc):
             "bare_id": bare,
             "send_ms": seg.get("send_ms"),
             "recv_ms": seg.get("recv_ms"),
-            "status": (seg.get("record") or {}).get("status"),
+            "status": rec.get("status"),
             "clamped_events": seg.get("clamped_events", 0),
+            **({"prefix_source": source} if source else {}),
+            **({"prefix_tokens": prefix["cached_tokens"]}
+               if prefix.get("cached_tokens") else {}),
             **({"fetch_error": seg["fetch_error"]}
                if seg.get("fetch_error") else {}),
             **({"record_superseded": True}
                if seg.get("record_superseded") else {}),
         })
+    # Fleet prefix-cache spans (wire fetch round-trips, drain
+    # handoffs) in the merged timeline, surfaced as their own
+    # rollup so the migration cost is readable without scanning.
+    cache_events = [e for e in doc.get("timeline", [])
+                    if e.get("event") in ("prefix_wire_fetch",
+                                          "prefix_handoff",
+                                          "prefix_hint")]
     return {
         "request_id": doc.get("request_id"),
         "status": doc.get("status"),
@@ -333,6 +353,8 @@ def fleet_report(doc):
         "segments": segments,
         "timeline": doc.get("timeline", []),
         "n_events": len(doc.get("timeline", [])),
+        **({"prefix_cache_events": cache_events}
+           if cache_events else {}),
     }
 
 
@@ -352,16 +374,30 @@ def print_fleet_report(fr) -> None:
               f"| {a.get('outcome')} | {a.get('code', '')} "
               f"| {'y' if a.get('hedge') else ''} |")
     print("\n## replica segments")
-    print("| attempt | replica | replica-side id | status | note |")
-    print("|---|---|---|---|---|")
+    print("| attempt | replica | replica-side id | status "
+          "| prefix source | note |")
+    print("|---|---|---|---|---|---|")
     for s in fr["segments"]:
         note = s.get("fetch_error") \
             or ("superseded" if s.get("record_superseded") else "") \
             or (f"{s['clamped_events']} clamped"
                 if s.get("clamped_events") else "")
+        src = s.get("prefix_source") or ""
+        if src and src != "re_prefill" and s.get("prefix_tokens"):
+            src = f"{src} ({s['prefix_tokens']} tok)"
         print(f"| {s['attempt']} | {s['replica']} "
               f"| {s['request_id']} | {s.get('status') or ''} "
-              f"| {note} |")
+              f"| {src} | {note} |")
+    if fr.get("prefix_cache_events"):
+        print("\n## fleet prefix-cache spans")
+        print("| at ms | source | event | dur ms | detail |")
+        print("|---|---|---|---|---|")
+        for e in fr["prefix_cache_events"]:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in (e.get("args") or {}).items())
+            print(f"| {e.get('at_ms')} | {e.get('source')} "
+                  f"| {e.get('event')} | {e.get('dur_ms', '')} "
+                  f"| {detail} |")
     print("\n## merged causal timeline")
     print("| at ms | source | event | dur ms | detail |")
     print("|---|---|---|---|---|")

@@ -495,6 +495,28 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
                    "re-materializes via device_put (and promotes "
                    "back to pages when the pool has room).  0 "
                    "(default) keeps the drop-on-evict behavior.")
+@click.option("--prefix-fetch/--no-prefix-fetch", default=False,
+              help="With --kv-paged and --kv-host-spill-bytes: arm "
+                   "the FLEET prefix tier's wire-fetch client — a "
+                   "local prefix miss carrying a router hint "
+                   "({\"prefix_hint\": ...}) fetches the holder's "
+                   "spilled payload over HTTP (checksummed; any "
+                   "failure degrades to re-prefill, counted in "
+                   "prefix_fetch_failed_total).  The SERVING half "
+                   "(/prefix/fetch|ingest|handoff|evict, GET "
+                   "/prefix/index) is always mounted on paged "
+                   "servers.")
+@click.option("--prefix-fetch-timeout", default=5.0, type=float,
+              help="Per-connection timeout (seconds) for wire "
+                   "fetches and handoff pushes.")
+@click.option("--prefix-fetch-min-tokens", default=16, type=int,
+              help="Fetch-policy floor: prefixes shorter than this "
+                   "re-prefill locally (wire RTT beats tiny "
+                   "prefills).")
+@click.option("--prefix-fetch-remat-ratio", default=0.26, type=float,
+              help="Fetch-policy curve: rematerialization cost as a "
+                   "fraction of re-prefill cost (the measured "
+                   "spilled-hit ratio; docs/SERVING.md).")
 @click.option("--default-priority", default="interactive",
               type=click.Choice(["interactive", "batch"]),
               help="Priority class for requests that don't declare "
@@ -619,6 +641,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           n_slots, queue_depth, prefill_chunk, decode_window,
           mesh_arg, kv_paged, kv_page_tokens, kv_pages,
           kv_lazy, kv_host_spill_bytes,
+          prefix_fetch, prefix_fetch_timeout,
+          prefix_fetch_min_tokens, prefix_fetch_remat_ratio,
           default_priority, batch_queue_depth, queue_deadline_ms,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
@@ -659,7 +683,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
 
     if cpu:
         jax.config.update("jax_platforms", "cpu")
-    from polyaxon_tpu.serving import ModelServer, make_server
+    from polyaxon_tpu.serving import (ModelServer,
+                                      PrefixFetchPolicy,
+                                      make_server)
 
     if draft_checkpoint and not draft_model:
         # pre-checkable usage error: fail before paying the full
@@ -737,6 +763,11 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
         raise click.ClickException(
             "--kv-host-spill-bytes requires --kv-paged (the host "
             "tier spills page-pool payloads)")
+    if prefix_fetch and not (kv_paged and kv_host_spill_bytes):
+        raise click.ClickException(
+            "--prefix-fetch requires --kv-paged and "
+            "--kv-host-spill-bytes (wire-fetched payloads admit "
+            "through the host spill tier)")
     mesh_spec = None
     if mesh_arg is not None:
         # Parse BEFORE the model build (fail-fast contract): a typo'd
@@ -787,6 +818,12 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          kv_pages=kv_pages,
                          kv_lazy=kv_lazy,
                          kv_host_spill_bytes=kv_host_spill_bytes,
+                         prefix_fetch=prefix_fetch,
+                         prefix_fetch_policy=PrefixFetchPolicy(
+                             min_tokens=prefix_fetch_min_tokens,
+                             remat_ratio=prefix_fetch_remat_ratio)
+                         if prefix_fetch else None,
+                         prefix_fetch_timeout_s=prefix_fetch_timeout,
                          default_priority=default_priority,
                          batch_queue_depth=batch_queue_depth,
                          queue_deadline_s=queue_deadline_ms / 1e3
@@ -893,6 +930,13 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
               help="Radix-prefix affinity: route a request to the "
                    "replica whose store holds its registered "
                    "prefix (never beats health).")
+@click.option("--prefix-handoff/--no-prefix-handoff", default=True,
+              help="Drain-time cache migration: a rolling restart "
+                   "pushes the drainee's hot host-tier prefix "
+                   "entries to its router-chosen successor (POST "
+                   "/prefix/handoff) before the flush.  Off = a "
+                   "restart is a cache flush (the per-replica "
+                   "baseline).")
 @click.option("--min-ready", default=1, type=int,
               help="Rolling restart never drops the ready-replica "
                    "count below this.")
@@ -915,8 +959,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
 def route(host, port, replicas, probe_interval, probe_timeout,
           down_after, cooldown, retry_ratio, retry_burst,
           max_attempts, request_timeout, hedge, hedge_min, affinity,
-          min_ready, fleet_fault_plan, request_history, slo,
-          slo_window):
+          prefix_handoff, min_ready, fleet_fault_plan,
+          request_history, slo, slo_window):
     """Run the replica ROUTER tier in front of N `ptpu serve`
     replicas (docs/SERVING.md "Fleet").
 
@@ -953,6 +997,7 @@ def route(host, port, replicas, probe_interval, probe_timeout,
             hedge=hedge,
             hedge_min_s=hedge_min,
             affinity=affinity,
+            prefix_handoff=prefix_handoff,
             min_ready=min_ready,
             fleet_faults=fleet_fault_plan,
             request_history=request_history,

@@ -75,12 +75,14 @@ from .scheduler import (DeadlineExceeded, PRIORITIES,
                         PoisonedRequest, QueueFullError,
                         RequestCancelled, SamplingSpec,
                         SchedulerPolicy, ShedError)
-from .server import ModelServer, make_server
+from .server import (ModelServer, PrefixFetchPolicy,
+                     make_server)
 from .slots import SlotKVManager
 from .telemetry import (Histogram, ProfileSession, Telemetry,
                         render_histogram)
 
-__all__ = ["ModelServer", "make_server", "DecodeEngine",
+__all__ = ["ModelServer", "PrefixFetchPolicy",
+           "make_server", "DecodeEngine",
            "SchedulerPolicy", "SamplingSpec", "SlotKVManager",
            "PagedSlotKVManager", "RadixPrefixIndex",
            "ServingMesh", "parse_mesh", "MeshError",

@@ -367,6 +367,7 @@ class DecodeEngine:
                shared_pages=None,
                rid: Optional[str] = None,
                prefix_info=None,
+               pre_events=None,
                resume_tokens: int = 0) -> RequestGroup:
         """Enqueue a request (may raise QueueFullError) and make sure
         the loop is running.  Returns the group; callers block on
@@ -410,7 +411,11 @@ class DecodeEngine:
         the inbound/generated ``X-Request-Id``); None generates one,
         so EVERY group carries an ID into its trace spans and its
         request-history record.  ``prefix_info`` rides the history
-        record as prefix-cache hit provenance.
+        record as prefix-cache hit provenance.  ``pre_events`` are
+        span tuples the CALLER paid before submit (a fleet wire
+        fetch): prepended to the stream's timeline so the history
+        record and the ``timings`` block attribute that cost to this
+        request.
 
         ``resume_tokens=N`` (single-row) declares the trailing N
         prompt tokens a PRIOR attempt's committed output — the
@@ -592,6 +597,11 @@ class DecodeEngine:
             stream.sid = self.tel.new_tid()
             if keep_events:
                 stream.events = []
+        if pre_events and keep_events and group.streams:
+            # Caller-paid spans (wire fetch) lead the timeline —
+            # they happened before anything the engine records.
+            s0 = group.streams[0]
+            s0.events = list(pre_events) + (s0.events or [])
         # Idle -> busy transition: re-stamp the watchdog's progress
         # signal, or a server that sat idle past --stall-timeout
         # would read as stalled the moment work arrives (the loop

@@ -88,12 +88,131 @@ import numpy as np
 from .slots import (alloc_decode_state, build_spec_step_body,
                     build_step_body, step_annotation)
 
-__all__ = ["PagedSlotKVManager", "PageExhausted"]
+__all__ = ["PagedSlotKVManager", "PageExhausted",
+           "WirePayloadError", "pack_spilled", "unpack_spilled"]
 
 
 class PageExhausted(RuntimeError):
     """Page reservation failed.  Engine admission is gated on
     ``can_admit`` so this is a defensive error, not a control path."""
+
+
+class WirePayloadError(ValueError):
+    """A serialized spill payload failed integrity verification
+    (truncated body, checksum mismatch, malformed header).  Callers
+    on the fetch path treat this as a typed MISS — fall back to
+    re-prefill, never admit bytes that don't verify."""
+
+
+# -- wire serialization (fleet prefix cache) -----------------------------
+#
+# A host-tier prefix entry is device-independent by construction
+# (spill_pages gathered it to plain np arrays), which makes it
+# REPLICA-independent too: the same buffers device_put cleanly into
+# any replica's pool (rematerialize is byte-identical to materialize
+# for the same content).  These helpers turn one spilled entry into a
+# single self-describing byte string and back — pure host numpy, no
+# device work, so they sit outside the TIER-XFER sanctioned set on
+# purpose.  Layout: 4-byte big-endian header length, a JSON header
+# (prompt tokens, leaf shapes/dtypes, logits shape/dtype, body
+# crc32), then the raw C-order buffers concatenated (logits first).
+
+_WIRE_VERSION = 1
+
+
+def pack_spilled(toks: np.ndarray,
+                 leaves: Sequence[Optional[np.ndarray]],
+                 n_tokens: int, logits: np.ndarray) -> bytes:
+    """Serialize one host-tier prefix entry for the wire."""
+    import json
+    import struct
+    import zlib
+
+    toks = np.ascontiguousarray(np.asarray(toks, np.int32))
+    logits = np.ascontiguousarray(np.asarray(logits))
+    parts = [logits.tobytes()]
+    leaf_meta = []
+    for h in leaves:
+        if h is None:
+            leaf_meta.append(None)
+            continue
+        h = np.ascontiguousarray(h)
+        leaf_meta.append({"shape": list(h.shape),
+                          "dtype": h.dtype.name})
+        parts.append(h.tobytes())
+    body = b"".join(parts)
+    header = json.dumps({
+        "v": _WIRE_VERSION,
+        "n_tokens": int(n_tokens),
+        "prompt": toks.tolist(),
+        "logits": {"shape": list(logits.shape),
+                   "dtype": logits.dtype.name},
+        "leaves": leaf_meta,
+        "crc32": zlib.crc32(body) & 0xFFFFFFFF,
+    }).encode()
+    return struct.pack(">I", len(header)) + header + body
+
+
+def unpack_spilled(blob: bytes):
+    """Parse + VERIFY a :func:`pack_spilled` byte string; returns
+    ``(toks, leaves, n_tokens, logits)``.  Raises
+    :class:`WirePayloadError` on any truncation, checksum mismatch,
+    or malformed header — never a partially-decoded payload."""
+    import json
+    import struct
+    import zlib
+
+    if len(blob) < 4:
+        raise WirePayloadError("payload shorter than its own "
+                               "header-length field")
+    (hlen,) = struct.unpack(">I", blob[:4])
+    if len(blob) < 4 + hlen:
+        raise WirePayloadError("payload truncated inside the header")
+    try:
+        header = json.loads(blob[4:4 + hlen].decode())
+        version = header["v"]
+        n_tokens = int(header["n_tokens"])
+        prompt = header["prompt"]
+        logits_meta = header["logits"]
+        leaf_meta = header["leaves"]
+        crc_want = int(header["crc32"])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+        raise WirePayloadError("malformed wire header")
+    if version != _WIRE_VERSION:
+        raise WirePayloadError(
+            f"wire version {version!r} != {_WIRE_VERSION} "
+            f"(mixed-version fleet; refetch or re-prefill)")
+    body = blob[4 + hlen:]
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc_want:
+        raise WirePayloadError("payload checksum mismatch")
+
+    def _take(meta):
+        nonlocal off
+        a = np.empty(meta["shape"], np.dtype(meta["dtype"]))
+        n = a.nbytes
+        if off + n > len(body):
+            raise WirePayloadError("payload truncated inside a "
+                                   "buffer (header/body disagree)")
+        a = np.frombuffer(body[off:off + n],
+                          np.dtype(meta["dtype"])).reshape(
+                              meta["shape"]).copy()
+        off += n
+        return a
+
+    off = 0
+    logits = _take(logits_meta)
+    leaves: List[Optional[np.ndarray]] = []
+    for m in leaf_meta:
+        leaves.append(None if m is None else _take(m))
+    if off != len(body):
+        raise WirePayloadError(
+            f"payload has {len(body) - off} trailing bytes past the "
+            f"declared buffers")
+    toks = np.asarray(prompt, np.int32)
+    if toks.ndim != 2 or toks.shape[1] != n_tokens:
+        raise WirePayloadError(
+            "prompt/n_tokens disagree in the wire header")
+    return toks, leaves, n_tokens, logits
 
 
 def _pow2ceil(n: int) -> int:
@@ -514,6 +633,25 @@ class PagedSlotKVManager:
         if self._pool is None:
             self._meta, self._treedef = self._classify(template_cache)
             self._pool, self._pool_sh = self._alloc_pool(self._meta)
+
+    @property
+    def shaped(self) -> bool:
+        """Whether the main pool's leaf layout is known yet (shaped
+        by the first page write, or by :meth:`ensure_shaped`)."""
+        return self._meta is not None
+
+    def ensure_shaped(self, template_cache) -> None:
+        """Shape the main pool from a template WITHOUT a page write.
+        Classification reads only tree paths, shapes and dtypes, so
+        an ABSTRACT template (``jax.eval_shape`` pytree of
+        ``ShapeDtypeStruct`` leaves) works — no model compute, no
+        template allocation.  This is the cold-pool escape hatch for
+        the fleet prefix tier: a wire-fetched or handed-off host
+        entry can arrive BEFORE this replica's first prefill (a
+        freshly restarted drain successor), and its rematerialize
+        must not depend on prior traffic.  Caller holds the device
+        lock."""
+        self._ensure_pool(template_cache)
 
     def _ensure_draft_pool(self, template_cache) -> None:
         if self._draft_pool is None:

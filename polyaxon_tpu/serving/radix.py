@@ -45,7 +45,7 @@ __all__ = ["RadixPrefixIndex"]
 
 
 class _Node:
-    __slots__ = ("edge", "children", "entry", "parent")
+    __slots__ = ("edge", "children", "entry", "parent", "hits")
 
     def __init__(self, edge: np.ndarray,
                  parent: Optional["_Node"]):
@@ -53,6 +53,10 @@ class _Node:
         self.children = {}               # first-column bytes -> _Node
         self.entry: Optional[Tuple[np.ndarray, Any]] = None
         self.parent = parent
+        # Lifetime hit count for the entry stored HERE (0 until a
+        # lookup lands on it) — the fleet eviction policy's "which
+        # copy is the hot one" signal (entries_meta).
+        self.hits = 0
 
 
 def _col_key(toks: np.ndarray, i: int) -> bytes:
@@ -123,6 +127,7 @@ class RadixPrefixIndex:
         if best is None:
             return None
         ent_toks, payload = best.entry
+        best.hits += 1
         self._promote(self._key(ent_toks))
         return ent_toks, payload
 
@@ -288,3 +293,17 @@ class RadixPrefixIndex:
         return [n.entry
                 for ring in (self._cold, self._hot)
                 for n in ring.values() if n.entry is not None]
+
+    def entries_meta(self) -> List[Tuple[np.ndarray, Any, int, bool]]:
+        """Every stored entry with its recency metadata, eviction
+        order (coldest first): ``(tokens, payload, hits, hot)``.
+        The fleet prefix-index endpoint reads this — hit counts and
+        ring membership are what the router's one-copy-somewhere
+        eviction pass ranks duplicate copies by."""
+        out: List[Tuple[np.ndarray, Any, int, bool]] = []
+        for ring, hot in ((self._cold, False), (self._hot, True)):
+            for n in ring.values():
+                if n.entry is not None:
+                    out.append((n.entry[0], n.entry[1],
+                                n.hits, hot))
+        return out

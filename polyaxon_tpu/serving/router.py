@@ -802,6 +802,7 @@ class ReplicaRouter:
                  affinity: bool = True,
                  affinity_max_outstanding: int = 8,
                  affinity_entries: int = 64,
+                 prefix_handoff: bool = True,
                  min_ready: int = 1,
                  fleet_faults=None,
                  request_history: int = 256,
@@ -861,6 +862,10 @@ class ReplicaRouter:
         self.hedge_min_s = float(hedge_min_s)
         self.affinity_enabled = bool(affinity)
         self.affinity_max_outstanding = int(affinity_max_outstanding)
+        # Drain-time cache migration (POST /prefix/handoff before a
+        # rolling-restart flush).  Off = the seed per-replica-only
+        # behavior: a restart is a cache flush.
+        self.prefix_handoff_enabled = bool(prefix_handoff)
         self.min_ready = int(min_ready)
         self.fleet_faults = FaultPlan.load(fleet_faults) \
             if fleet_faults is not None else None
@@ -887,11 +892,15 @@ class ReplicaRouter:
                 else dict(slo),
                 window=int(slo_window))
         # Prefix-affinity map: registered-prefix token tuple ->
-        # replica id, LRU-bounded.  Router-side mirror of what each
-        # replica's radix store holds; longest-match by scan (the
-        # registered-prefix population is small — system prompts).
-        self._affinity: "OrderedDict[Tuple[int, ...], str]" = \
-            OrderedDict()
+        # ORDERED holder list (primary first), LRU-bounded.
+        # Router-side mirror of what the replicas' radix stores
+        # hold; longest-match by scan (the registered-prefix
+        # population is small — system prompts).  Secondary holders
+        # accumulate from drain handoffs and observed wire fetches,
+        # so failover and the fetch hint both have somewhere to go
+        # when the primary leaves rotation.
+        self._affinity: "OrderedDict[Tuple[int, ...], List[str]]" \
+            = OrderedDict()
         self._affinity_cap = int(affinity_entries)
         self._affinity_lock = threading.Lock()
         # Latency window for the hedge watermark (the engine's
@@ -915,6 +924,16 @@ class ReplicaRouter:
         # Metrics federation (GET /fleet/metrics): scrape accounting.
         self.fleet_scrapes_total = 0
         self.fleet_scrape_errors_total = 0
+        # Fleet prefix cache (the kv_fleet_* family): hint
+        # injections, observed wire fetches, drain handoffs, and the
+        # one-copy-somewhere rebalance pass.
+        self.kv_fleet_hints_injected_total = 0
+        self.kv_fleet_wire_fetches_total = 0
+        self.kv_fleet_handoffs_total = 0
+        self.kv_fleet_handoff_entries_total = 0
+        self.kv_fleet_handoff_failed_total = 0
+        self.kv_fleet_rebalances_total = 0
+        self.kv_fleet_evict_hints_total = 0
         self.fleet_faults_applied: Dict[str, int] = {}
         self._rr = 0                   # least-outstanding tiebreak
         # Rolling restart state (one at a time; POST /fleet/restart).
@@ -1057,27 +1076,67 @@ class ReplicaRouter:
     # -- affinity --------------------------------------------------------
 
     def _note_prefix(self, toks: Tuple[int, ...],
-                     replica_id: str) -> None:
+                     replica_id: str, *,
+                     primary: bool = True) -> None:
+        """Record ``replica_id`` as a holder of ``toks``.  Primary
+        holders (a routed /prefill, a handoff successor) lead the
+        list; secondary holders (an observed wire fetch — the
+        fetcher keeps a host-tier copy) append behind them."""
         with self._affinity_lock:
-            self._affinity[toks] = replica_id
+            holders = self._affinity.get(toks)
+            if holders is None:
+                holders = self._affinity[toks] = []
+            if replica_id in holders:
+                if primary and holders[0] != replica_id:
+                    holders.remove(replica_id)
+                    holders.insert(0, replica_id)
+            elif primary:
+                holders.insert(0, replica_id)
+            else:
+                holders.append(replica_id)
             self._affinity.move_to_end(toks)
             while len(self._affinity) > self._affinity_cap:
                 self._affinity.popitem(last=False)
 
-    def _affinity_for(self, prompt: Optional[List[int]]
-                      ) -> Optional[str]:
-        """The replica holding the LONGEST registered prefix of this
-        prompt, or None."""
+    def _affinity_holders(self, prompt: Optional[List[int]]
+                          ) -> List[str]:
+        """ORDERED holder list (primary first) for the LONGEST
+        registered prefix of this prompt — empty when none."""
         if not self.affinity_enabled or not prompt:
-            return None
-        best_len, best = 0, None
+            return []
+        best_len, best = 0, []
         with self._affinity_lock:
-            for toks, rid in self._affinity.items():
+            for toks, holders in self._affinity.items():
                 n = len(toks)
                 if n > best_len and n <= len(prompt) \
                         and list(toks) == prompt[:n]:
-                    best_len, best = n, rid
+                    best_len, best = n, list(holders)
         return best
+
+    def _affinity_for(self, prompt: Optional[List[int]]
+                      ) -> Optional[str]:
+        """The PRIMARY holder of the longest registered prefix of
+        this prompt, or None."""
+        holders = self._affinity_holders(prompt)
+        return holders[0] if holders else None
+
+    def _affinity_replace(self, old_id: str,
+                          new_id: Optional[str]) -> None:
+        """Re-point every holder entry from ``old_id`` to ``new_id``
+        (drain handoff succeeded: the successor now holds what the
+        drainee held), or drop ``old_id`` everywhere when ``new_id``
+        is None (handoff failed: the restart flushes the drainee's
+        store, so the stale binding must not attract traffic)."""
+        with self._affinity_lock:
+            for toks in list(self._affinity):
+                holders = self._affinity[toks]
+                if old_id not in holders:
+                    continue
+                holders.remove(old_id)
+                if new_id is not None and new_id not in holders:
+                    holders.append(new_id)
+                if not holders:
+                    del self._affinity[toks]
 
     # -- replica selection -----------------------------------------------
 
@@ -1096,12 +1155,17 @@ class ReplicaRouter:
                      if r.id not in exclude and not r.draining
                      and r.health_ok
                      and r.breaker.state == CircuitBreaker.HALF_OPEN]
-        aff = self._affinity_for(prompt)
-        if aff is not None:
-            for r in eligible:
-                if r.id == aff and r.outstanding \
-                        < self.affinity_max_outstanding:
-                    return r, "affinity"
+        by_id = {r.id: r for r in eligible}
+        # Holders in preference order (primary first): the FIRST
+        # surviving, unsaturated one wins — so a failover replay
+        # (primary excluded/dead) lands on a secondary holder of the
+        # request's prefix instead of a cold least-outstanding pick,
+        # and the replay's re-prefill cost drops for free.
+        for aff in self._affinity_holders(prompt):
+            r = by_id.get(aff)
+            if r is not None and r.outstanding \
+                    < self.affinity_max_outstanding:
+                return r, "affinity"
         if eligible:
             self._rr += 1
             return min(
@@ -1421,7 +1485,6 @@ class ReplicaRouter:
                 # (docs/DESIGN.md; token-identical per seed).
                 payload["prompt"] = list(prompt) + partial
                 payload["resume_tokens"] = len(partial)
-            body = json.dumps(payload).encode()
             replica, why = self._pick(prompt, exclude)
             if replica is None and exclude:
                 # Every replica already failed this request once:
@@ -1441,6 +1504,31 @@ class ReplicaRouter:
                     "router": self._route_info(None, attempt_n,
                                                partial)})
             attempt_n += 1
+            if why != "affinity":
+                # Routed AWAY from the prefix's holders (saturation,
+                # exclusion, drain): hand the chosen replica a FETCH
+                # HINT naming a live holder, so its local miss can
+                # become a wire fetch instead of a re-prefill.  A
+                # DRAINING holder still qualifies — the drain window
+                # is exactly when its entries need serving out.
+                holders = self._affinity_holders(prompt)
+                if holders and replica.id not in holders:
+                    by_id = {r.id: r for r in self.replicas}
+                    for h in holders:
+                        hr = by_id.get(h)
+                        if hr is not None and (
+                                hr.health_ok
+                                or hr.health_reason == "draining"):
+                            payload["prefix_hint"] = {
+                                "host": hr.host, "port": hr.port,
+                                "replica": hr.id}
+                            with self._stats_lock:
+                                self.kv_fleet_hints_injected_total \
+                                    += 1
+                            note("prefix_hint", time.monotonic(),
+                                 holder=hr.id)
+                            break
+            body = json.dumps(payload).encode()
             note("route", time.monotonic(), replica=replica.id,
                  why=why,
                  **({"excluded": sorted(exclude)} if exclude
@@ -1470,6 +1558,25 @@ class ReplicaRouter:
                     with self._stats_lock:
                         self.resumes_total += 1
                         self.resumed_tokens_total += len(partial)
+                # Holder learning: the response says where the
+                # prefill actually came from.  A wire fetch (or a
+                # hit on a replica the map didn't list) means the
+                # winner now holds a copy — record it as a SECONDARY
+                # holder so the next miss/failover can use it.
+                src = resp.get("prefix_source")
+                if src == "wire_fetch":
+                    with self._stats_lock:
+                        self.kv_fleet_wire_fetches_total += 1
+                hit_len = resp.get("prefix_hit_len")
+                if src in ("wire_fetch", "local_hot",
+                           "local_spilled") \
+                        and isinstance(hit_len, int) \
+                        and prompt and 0 < hit_len <= len(prompt) \
+                        and all(isinstance(t, int)
+                                for t in prompt[:hit_len]):
+                    self._note_prefix(tuple(prompt[:hit_len]),
+                                      winner.replica.id,
+                                      primary=False)
                 resp["request_id"] = rid
                 resp["router"] = self._route_info(
                     winner.replica, attempt_n, partial,
@@ -1966,6 +2073,16 @@ class ReplicaRouter:
                 #                             new requests route away
                 self._note_ready_floor()
                 self._drain_replica(replica)
+                # Cache half of the drain: push the drainee's prefix
+                # entries to a router-chosen successor BEFORE the
+                # restart flushes them.  Best-effort by contract —
+                # the restart proceeds whatever happens here.
+                if self.prefix_handoff_enabled:
+                    self._drain_handoff(replica)
+                else:
+                    # No migration: the restart flushes the store the
+                    # drainee's affinity bindings point at.
+                    self._affinity_replace(replica.id, None)
                 replica.restart()
                 self._await_healthy(replica)
                 replica.draining = False
@@ -1999,6 +2116,128 @@ class ReplicaRouter:
         raise RuntimeError(
             f"replica {replica.id} did not drain within "
             f"{timeout_s}s")
+
+    def _drain_handoff(self, replica: Replica,
+                       timeout_s: float = 30.0) -> None:
+        """Ask a DRAINED replica to push its prefix entries to a
+        successor (POST /prefix/handoff) and re-point the affinity
+        map accordingly.  Every failure path is absorbed: a replica
+        without the endpoint (no paged engine, older build) answers
+        404 and the restart just proceeds with the seed behavior —
+        a cold post-restart cache."""
+        successor = None
+        candidates = [r for r in self.replicas
+                      if r.id != replica.id and r.eligible()]
+        if candidates:
+            successor = min(candidates,
+                            key=lambda r: r.outstanding)
+        if successor is None:
+            # Nowhere to hand off (single-replica fleet, everyone
+            # else down): the drainee's entries die with the
+            # restart, so the affinity map must forget it.
+            with self._stats_lock:
+                self.kv_fleet_handoff_failed_total += 1
+            self._affinity_replace(replica.id, None)
+            return
+        status, raw = self._http_text(
+            replica, "POST", "/prefix/handoff",
+            body=json.dumps({"host": successor.host,
+                             "port": successor.port}).encode(),
+            timeout_s=timeout_s)
+        out: Dict[str, Any] = {}
+        if status == 200:
+            try:
+                parsed = json.loads(raw)
+                if isinstance(parsed, dict):
+                    out = parsed
+            except ValueError:
+                pass
+        sent = out.get("sent", 0) if status == 200 else 0
+        with self._stats_lock:
+            self.kv_fleet_handoffs_total += 1
+            if isinstance(sent, int) and sent > 0:
+                self.kv_fleet_handoff_entries_total += sent
+            if status != 200:
+                self.kv_fleet_handoff_failed_total += 1
+        # Successful push: the successor now PRIMARILY holds what
+        # the drainee held, so traffic (and fetch hints) follow the
+        # entries.  Anything else: drop the drainee's bindings — its
+        # restart flushes the store they pointed at.
+        self._affinity_replace(
+            replica.id,
+            successor.id if isinstance(sent, int) and sent > 0
+            else None)
+
+    def fleet_prefix_rebalance(self) -> Dict[str, Any]:
+        """``POST /fleet/prefix/rebalance``: the one-copy-somewhere
+        eviction pass.  Scrape every up replica's ``GET
+        /prefix/index`` (stable cross-replica entry keys), find
+        prefixes with REDUNDANT host-tier copies, keep the
+        most-useful copy — device-tier copies always win (they are a
+        replica's live working set and never evicted by hint); among
+        host-tier copies the highest hit count survives — and post
+        the rest back as ``/prefix/evict`` hints.  Budget freed this
+        way goes back to prefixes only one replica holds, which is
+        what makes the fleet's aggregate host tier worth more than N
+        private ones."""
+        inventory: Dict[str, List[Tuple[Replica, Dict[str, Any]]]] \
+            = {}
+        scraped = []
+        for r in self.replicas:
+            if not r.up():
+                continue
+            status, parsed = self._http_json(r, "GET",
+                                             "/prefix/index")
+            if status != 200 or not isinstance(
+                    parsed.get("entries"), list):
+                continue
+            scraped.append(r.id)
+            for ent in parsed["entries"]:
+                if isinstance(ent, dict) and \
+                        isinstance(ent.get("key"), str):
+                    inventory.setdefault(ent["key"], []).append(
+                        (r, ent))
+        evict: Dict[str, List[str]] = {}   # replica id -> keys
+        by_id = {r.id: r for r in self.replicas}
+        for key, copies in inventory.items():
+            if len(copies) < 2:
+                continue
+            host_copies = [(r, e) for r, e in copies
+                           if e.get("tier") == "host"]
+            device_held = any(e.get("tier") == "device"
+                              for _, e in copies)
+            if not host_copies:
+                continue
+            if device_held:
+                doomed = host_copies
+            else:
+                # Keep the host copy with the most hits (stable on
+                # ties: first scraped) — evict the rest.
+                keep = max(host_copies,
+                           key=lambda re: re[1].get("hits", 0))
+                doomed = [c for c in host_copies if c is not keep]
+            for r, _ in doomed:
+                evict.setdefault(r.id, []).append(key)
+        hinted = 0
+        evicted = 0
+        for rid_, keys in evict.items():
+            hinted += len(keys)
+            status, parsed = self._http_json(
+                by_id[rid_], "POST", "/prefix/evict",
+                body=json.dumps({"keys": keys}).encode())
+            if status == 200:
+                got = parsed.get("evicted", 0)
+                if isinstance(got, int):
+                    evicted += got
+        with self._stats_lock:
+            self.kv_fleet_rebalances_total += 1
+            self.kv_fleet_evict_hints_total += hinted
+        return {"replicas_scraped": scraped,
+                "prefixes_seen": len(inventory),
+                "duplicates": sum(
+                    1 for c in inventory.values() if len(c) > 1),
+                "evict_hints": hinted,
+                "evicted": evicted}
 
     def _await_healthy(self, replica: Replica,
                        timeout_s: float = 120.0) -> None:
@@ -2034,6 +2273,20 @@ class ReplicaRouter:
                 "fleet_scrapes_total": self.fleet_scrapes_total,
                 "fleet_scrape_errors_total":
                     self.fleet_scrape_errors_total,
+                "kv_fleet_hints_injected_total":
+                    self.kv_fleet_hints_injected_total,
+                "kv_fleet_wire_fetches_total":
+                    self.kv_fleet_wire_fetches_total,
+                "kv_fleet_handoffs_total":
+                    self.kv_fleet_handoffs_total,
+                "kv_fleet_handoff_entries_total":
+                    self.kv_fleet_handoff_entries_total,
+                "kv_fleet_handoff_failed_total":
+                    self.kv_fleet_handoff_failed_total,
+                "kv_fleet_rebalances_total":
+                    self.kv_fleet_rebalances_total,
+                "kv_fleet_evict_hints_total":
+                    self.kv_fleet_evict_hints_total,
                 "fleet_faults_applied":
                     dict(self.fleet_faults_applied),
             }
@@ -2092,6 +2345,13 @@ class ReplicaRouter:
                   "retry_budget_denied_total",
                   "fleet_scrapes_total",
                   "fleet_scrape_errors_total",
+                  "kv_fleet_hints_injected_total",
+                  "kv_fleet_wire_fetches_total",
+                  "kv_fleet_handoffs_total",
+                  "kv_fleet_handoff_entries_total",
+                  "kv_fleet_handoff_failed_total",
+                  "kv_fleet_rebalances_total",
+                  "kv_fleet_evict_hints_total",
                   "request_records_total"):
             counter(k, st[k])
         counter("request_records_evicted_total",
@@ -2187,6 +2447,7 @@ class ReplicaRouter:
             "affinity": self.affinity_enabled,
             "affinity_max_outstanding":
                 self.affinity_max_outstanding,
+            "prefix_handoff": self.prefix_handoff_enabled,
             **self.stats(),
         }
 
@@ -2323,6 +2584,16 @@ def make_router_server(host: str, port: int,
                 return
             if self.path == "/drain":
                 self._send(200, router.drain())
+                return
+            if self.path == "/fleet/prefix/rebalance":
+                # One-copy-somewhere pass over the fleet's host
+                # tiers; synchronous (scrapes + hints are bounded
+                # HTTP exchanges) and idempotent.
+                try:
+                    self._send(200, router.fleet_prefix_rebalance())
+                except Exception as e:
+                    self._send(500, {
+                        "error": f"{type(e).__name__}: {e}"})
                 return
             if self.path not in ("/generate", "/prefill"):
                 self._send(404, {"error": f"no route {self.path}"})

@@ -90,6 +90,7 @@ from .debug import (RequestHistory, StallWatchdog, events_to_dicts,
 from .engine import DecodeEngine
 from .faults import FaultPlan, SocketReset
 from .legacy import RequestCoalescer
+from .paged import WirePayloadError, pack_spilled, unpack_spilled
 from .radix import RadixPrefixIndex
 from .recovery import EngineSupervisor
 from .scheduler import (DeadlineExceeded, PRIORITIES,
@@ -142,14 +143,86 @@ class _SpilledPrefix:
 
 
 PrefixHit = collections.namedtuple(
-    "PrefixHit", ["p_cached", "logits", "cache", "pins"])
+    "PrefixHit", ["p_cached", "logits", "cache", "pins", "source"],
+    defaults=("device",))
 """One prefix-cache lookup result: ``p_cached`` tokens of stored
 prefill, the stored last-position ``logits``, a CONTIGUOUS ``cache``
 holding them (materialized from pool pages in paged mode), and
 ``pins`` — still-pinned FULL-page ids the engine path maps read-only
 into the admitted slot's table (empty for legacy entries).  The
 caller owns the pins until ``engine.submit(shared_pages=pins)``
-returns; every other outcome must unpin them."""
+returns; every other outcome must unpin them.  ``source`` records
+which tier served the hit (``"device"`` or ``"host"``) so responses
+and history records can attribute the prefix's provenance."""
+
+
+class PrefixFetchPolicy:
+    """The wire-fetch cost curve: fetch a spilled prefix from a
+    holder replica only when the expected wire cost beats the local
+    re-prefill cost.  A spilled LOCAL hit lands at ~0.26x of a
+    re-prefill miss (the PR 12 measurement — ``remat_ratio``); a WIRE
+    hit pays that same re-materialization PLUS one round trip and the
+    body transfer, so the curve is::
+
+        rtt + nbytes / wire_bytes_per_s + remat_ratio * reprefill
+            < reprefill,   where reprefill = n_tokens / prefill_tok_per_s
+
+    plus two hard gates — a minimum match length (tiny prefixes
+    re-prefill faster than any network hop) and a byte ceiling (one
+    giant payload must not monopolize the fetch path).  Pure and
+    deterministic, so the thresholds unit-test without a fleet.  The
+    client evaluates it twice: once before dialing (``nbytes=0`` —
+    only the token gate can veto yet) and again on the holder's
+    Content-Length BEFORE reading the body, so a policy veto costs
+    headers, never the transfer."""
+
+    def __init__(self, *, min_tokens: int = 16,
+                 max_bytes: int = 1 << 30,
+                 wire_bytes_per_s: float = 1e9,
+                 rtt_s: float = 2e-3,
+                 prefill_tok_per_s: float = 4e3,
+                 remat_ratio: float = 0.26):
+        if min_tokens < 1:
+            raise ValueError(
+                f"min_tokens must be >= 1; got {min_tokens}")
+        if max_bytes < 1:
+            raise ValueError(
+                f"max_bytes must be >= 1; got {max_bytes}")
+        if wire_bytes_per_s <= 0 or prefill_tok_per_s <= 0:
+            raise ValueError(
+                "wire_bytes_per_s and prefill_tok_per_s must be > 0")
+        if rtt_s < 0 or not 0.0 <= remat_ratio < 1.0:
+            raise ValueError(
+                "need rtt_s >= 0 and 0 <= remat_ratio < 1")
+        self.min_tokens = int(min_tokens)
+        self.max_bytes = int(max_bytes)
+        self.wire_bytes_per_s = float(wire_bytes_per_s)
+        self.rtt_s = float(rtt_s)
+        self.prefill_tok_per_s = float(prefill_tok_per_s)
+        self.remat_ratio = float(remat_ratio)
+
+    def should_fetch(self, n_tokens: int, nbytes: int
+                     ) -> Tuple[bool, str]:
+        """``(ok, reason)`` — ``reason`` is the typed veto (the
+        ``prefix_fetch_failed_total{reason=}`` label) or ``"ok"``."""
+        if n_tokens < self.min_tokens:
+            return False, "below_min_tokens"
+        if nbytes > self.max_bytes:
+            return False, "over_max_bytes"
+        reprefill_s = n_tokens / self.prefill_tok_per_s
+        wire_s = (self.rtt_s + nbytes / self.wire_bytes_per_s
+                  + self.remat_ratio * reprefill_s)
+        if wire_s >= reprefill_s:
+            return False, "wire_slower"
+        return True, "ok"
+
+    def describe(self) -> Dict[str, Any]:
+        return {"min_tokens": self.min_tokens,
+                "max_bytes": self.max_bytes,
+                "wire_bytes_per_s": self.wire_bytes_per_s,
+                "rtt_s": self.rtt_s,
+                "prefill_tok_per_s": self.prefill_tok_per_s,
+                "remat_ratio": self.remat_ratio}
 
 
 class PagePins(tuple):
@@ -269,6 +342,10 @@ class ModelServer:
                  kv_pages: Optional[int] = None,
                  kv_lazy: bool = False,
                  kv_host_spill_bytes: int = 0,
+                 prefix_fetch: bool = False,
+                 prefix_fetch_policy: Optional[
+                     "PrefixFetchPolicy"] = None,
+                 prefix_fetch_timeout_s: float = 5.0,
                  default_priority: str = "interactive",
                  batch_queue_depth: Optional[int] = None,
                  queue_deadline_s: Optional[float] = None,
@@ -430,6 +507,16 @@ class ModelServer:
                 "kv_host_spill_bytes requires kv_paged (the host "
                 "tier spills page-pool payloads; legacy prefix "
                 "entries already own independent caches)")
+        if prefix_fetch and not (kv_paged and kv_host_spill_bytes):
+            raise ValueError(
+                "prefix_fetch requires kv_paged AND a host spill "
+                "budget (--kv-host-spill-bytes): wire-fetched "
+                "payloads are host-tier entries — they enter through "
+                "the spill machinery and count against its budget")
+        if prefix_fetch_timeout_s <= 0:
+            raise ValueError(
+                f"prefix_fetch_timeout_s must be > 0; got "
+                f"{prefix_fetch_timeout_s}")
         # Serving mesh ("tp=4" / MeshSpec / ServingMesh): shard the
         # slot KV pools over the mesh and place params under
         # NamedSharding (serving/meshed.py — the exact layout, so
@@ -581,6 +668,32 @@ class ModelServer:
         self._remat_hits_total = 0
         self._remat_bytes_total = 0
         self._promotions_total = 0
+        # FLEET PREFIX CACHE (PR 16): the host tier goes on the wire.
+        # ``prefix_fetch`` arms the CLIENT half (an affinity miss
+        # with a router-supplied ``prefix_hint`` fetches the holder's
+        # spilled payload instead of re-prefilling, gated by the
+        # PrefixFetchPolicy cost curve); the SERVING half — the
+        # /prefix/fetch|ingest|index|evict|handoff endpoints — is
+        # always mounted on paged servers so a drain handoff or a
+        # peer's fetch needs no arming on the holder.  All counters
+        # under _stats_lock; _spill_stats() renders them on BOTH
+        # /metrics and /info (no drift).  Every failure class on
+        # these paths degrades to a typed re-prefill — never a
+        # request failure.
+        self.prefix_fetch = bool(prefix_fetch)
+        self.prefix_fetch_timeout_s = float(prefix_fetch_timeout_s)
+        self.fetch_policy = prefix_fetch_policy \
+            if prefix_fetch_policy is not None else PrefixFetchPolicy()
+        self._fetch_attempts_total = 0
+        self._fetch_hits_total = 0
+        self._fetch_bytes_total = 0
+        self._fetch_failed: Dict[str, int] = {}
+        self._ingest_total = 0
+        self._ingest_rejected_total = 0
+        self._handoff_entries_total = 0
+        self._handoff_bytes_total = 0
+        self._handoff_failed_total = 0
+        self._evict_hints_total = 0
         if self.kv_paged:
             # Page-pressure relief: when an admit-ready stream is
             # blocked on free pages, the engine asks us to evict
@@ -1214,6 +1327,23 @@ class ModelServer:
                                     epoch=pin_epoch)
         return PrefixHit(pc, payload.logits, cache, pins)
 
+    def _cache_template(self):
+        """ABSTRACT cache pytree (``ShapeDtypeStruct`` leaves) for
+        cold-pool shaping — the same shape probe
+        ``models.generate.init_cache`` uses, minus the zeros: the
+        classifier only reads paths/shapes/dtypes, so nothing is
+        allocated or computed here."""
+        import jax
+        import jax.numpy as jnp
+
+        tokens = jnp.zeros((1, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            # Shape probe under eval_shape (nothing is ever drawn
+            # from this key).  # ptpu: ignore[RNG-DET]
+            lambda: self.model.init(jax.random.PRNGKey(0), tokens,
+                                    decode=True, decode_position=0))
+        return shapes["cache"]
+
     def _rematerialize_hit(self, ent_toks, payload: "_SpilledPrefix",
                            pc: int) -> PrefixHit:
         """HOST-TIER hit: ``device_put`` the spilled leaves back into
@@ -1228,6 +1358,13 @@ class ModelServer:
         ladder."""
         mgr = self.engine.slots
         with self._lock:
+            if not mgr.shaped:
+                # Cold pool: this entry arrived over the wire (fetch
+                # or drain handoff) BEFORE this replica's first
+                # prefill shaped the page pool — a freshly restarted
+                # drain successor hits exactly this.  Shape it from
+                # an abstract template instead of failing the hit.
+                mgr.ensure_shaped(self._cache_template())
             cache = mgr.rematerialize(payload.leaves, pc)
         with self._stats_lock:
             self._remat_hits_total += 1
@@ -1274,7 +1411,8 @@ class ModelServer:
                     mgr.unpin(ids, epoch=ep)
             else:
                 mgr.unpin(ids, epoch=ep)
-        return PrefixHit(pc, payload.logits, cache, pins)
+        return PrefixHit(pc, payload.logits, cache, pins,
+                         source="host")
 
     def _unpin_prefix(self, pins) -> None:
         if pins:
@@ -1527,6 +1665,387 @@ class ModelServer:
         self._prefix_store_safe(np.asarray(stream.toks),
                                 stream.logits, stream.cache,
                                 hot=False)
+
+    # -- fleet prefix cache (wire fetch / ingest / handoff) --------------
+
+    @staticmethod
+    def _prefix_key(toks: np.ndarray) -> str:
+        """Stable cross-replica identity of one stored prompt: every
+        replica (and the router's rebalance pass) derives the same
+        key from the same tokens, so fleet inventory needs no shared
+        namespace service."""
+        import hashlib
+
+        toks = np.ascontiguousarray(np.asarray(toks, np.int32))
+        return hashlib.sha1(
+            b"%d|%d|" % toks.shape + toks.tobytes()).hexdigest()
+
+    def _note_fetch_failed(self, reason: str) -> None:
+        with self._stats_lock:
+            self._fetch_failed[reason] = \
+                self._fetch_failed.get(reason, 0) + 1
+
+    def _pack_entry_wire(self, ent_toks, payload) -> Optional[bytes]:
+        """Serialize ONE radix entry for the wire.  Host-tier entries
+        pack directly (immutable host buffers — no locks needed past
+        the lookup that produced them).  Device-tier entries gather
+        READ-ONLY: pin under the prefix lock, ``spill_pages`` under
+        the device lock, unpin — the entry keeps its pages and its
+        payload (unlike ``_spill_entry`` there is NO swap; serving a
+        peer must not demote the holder's own hot copy).  Returns
+        None when the entry vanished or the gather failed — callers
+        treat that as a miss."""
+        if isinstance(payload, _SpilledPrefix):
+            return pack_spilled(ent_toks, payload.leaves,
+                                payload.n_tokens, payload.logits)
+        if not isinstance(payload, _PagedPrefix):
+            return None     # legacy contiguous entries stay local
+        import jax
+
+        mgr = self.engine.slots
+        with self._prefix_lock:
+            # Identity-guarded presence check + pin under the prefix
+            # lock — same discipline as _spill_entry's gather.
+            if not self._prefix.set_payload(ent_toks, payload,
+                                            expect=payload):
+                return None
+            pin_epoch = mgr.pin(payload.pages)
+        try:
+            with self._lock:
+                if mgr.epoch != pin_epoch:
+                    return None
+                host = mgr.spill_pages(payload.pages,
+                                       payload.n_tokens)
+                logits_host = np.asarray(
+                    jax.device_get(payload.logits))
+        except Exception:
+            return None
+        finally:
+            mgr.unpin(payload.pages, epoch=pin_epoch)
+        return pack_spilled(ent_toks, host, payload.n_tokens,
+                            logits_host)
+
+    def prefix_wire_payload(self, req: Dict[str, Any]
+                            ) -> Optional[bytes]:
+        """POST /prefix/fetch: serve the longest stored entry that
+        prefixes the peer's prompt, serialized for the wire.  Served
+        even while DRAINING — the drain window is exactly when peers
+        come asking.  None -> the handler's 404 (holder miss)."""
+        if not self.kv_paged:
+            raise ValueError(
+                "prefix wire fetch requires a paged engine "
+                "(kv_paged)")
+        rows = _parse_prompt_rows(req, self.max_batch)
+        toks = np.asarray(rows, np.int32)
+        with self._prefix_lock:
+            # lookup (not longest_ancestor): a fleet hit IS a hit —
+            # it should refresh the entry's recency here too.
+            hit = self._prefix.lookup(toks)
+        if hit is None:
+            return None
+        return self._pack_entry_wire(hit[0], hit[1])
+
+    def prefix_ingest(self, blob: bytes, *,
+                      hot: bool = True) -> Dict[str, Any]:
+        """POST /prefix/ingest: verify + store one wire payload as a
+        HOST-TIER entry (a drain handoff's push, or a prefetch).  The
+        payload is checksummed end to end — a mismatch raises the
+        typed :class:`WirePayloadError` (400), and nothing partial is
+        ever admitted.  Stored entries enter the spill byte budget
+        exactly like locally-spilled ones."""
+        if not self.kv_paged or self.kv_host_spill_bytes <= 0:
+            with self._stats_lock:
+                self._ingest_rejected_total += 1
+            raise ValueError(
+                "prefix ingest requires a paged engine with a host "
+                "spill budget (--kv-host-spill-bytes)")
+        if not self._prefix_enabled:
+            with self._stats_lock:
+                self._ingest_rejected_total += 1
+            raise ValueError(
+                "prefix cache is disabled on this server")
+        try:
+            toks, leaves, n_tokens, logits = unpack_spilled(blob)
+        except WirePayloadError:
+            with self._stats_lock:
+                self._ingest_rejected_total += 1
+            raise
+        spilled = _SpilledPrefix(leaves, n_tokens, logits)
+        if spilled.nbytes > self.kv_host_spill_bytes:
+            with self._stats_lock:
+                self._ingest_rejected_total += 1
+            return {"stored": False, "reason": "over_budget",
+                    "nbytes": spilled.nbytes,
+                    "budget": self.kv_host_spill_bytes}
+        with self._prefix_lock:
+            anc = self._prefix.longest_ancestor(toks)
+            if anc is not None and anc[0].shape[1] >= n_tokens:
+                return {"stored": False, "reason": "already_stored"}
+            if not self._prefix.accepts(hot):
+                with self._stats_lock:
+                    self._ingest_rejected_total += 1
+                return {"stored": False, "reason": "at_capacity"}
+            displaced = self._prefix.store(toks, spilled, hot=hot)
+        self._free_displaced(displaced)
+        with self._stats_lock:
+            self._host_bytes += spilled.nbytes
+            self._host_entries += 1
+            self._ingest_total += 1
+        self._enforce_spill_budget()
+        return {"stored": True, "n_tokens": int(n_tokens),
+                "nbytes": spilled.nbytes}
+
+    def prefix_index(self) -> Dict[str, Any]:
+        """GET /prefix/index: this replica's prefix inventory — the
+        fleet eviction policy's input.  Each entry carries its stable
+        cross-replica key, tier, recency ring, per-entry hit count,
+        and (host tier) byte size, so the router can decide which
+        spilled copies are redundant WITHOUT fetching any payload."""
+        with self._prefix_lock:
+            meta = self._prefix.entries_meta()
+        entries = []
+        for toks, payload, hits, hot in meta:
+            if isinstance(payload, _SpilledPrefix):
+                tier: Dict[str, Any] = {"tier": "host",
+                                        "nbytes": payload.nbytes}
+            elif isinstance(payload, _PagedPrefix):
+                tier = {"tier": "device"}
+            else:
+                tier = {"tier": "legacy"}
+            entries.append({"key": self._prefix_key(toks),
+                            "rows": int(toks.shape[0]),
+                            "tokens": int(toks.shape[1]),
+                            "hits": int(hits),
+                            "hot": bool(hot), **tier})
+        with self._stats_lock:
+            host_bytes = self._host_bytes
+        return {"entries": entries,
+                "host_bytes": host_bytes,
+                "host_budget_bytes": self.kv_host_spill_bytes}
+
+    def prefix_evict(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /prefix/evict: apply fleet eviction HINTS — drop the
+        named HOST-TIER entries (the redundant cold copies the
+        router's one-copy-somewhere policy identified).  Device-tier
+        entries never drop on a hint: they are this replica's own
+        working set, and fleet policy only governs the spill tier it
+        can see through ``kv_host_*``.  Hints are advisory by
+        construction — an unknown key is simply skipped."""
+        keys = req.get("keys")
+        if not isinstance(keys, list) \
+                or not all(isinstance(k, str) for k in keys):
+            raise ValueError("'keys' must be a list of entry keys "
+                             "(GET /prefix/index)")
+        want = set(keys)
+        dropped = []
+        with self._prefix_lock:
+            for toks, payload in list(self._prefix.entries()):
+                if not isinstance(payload, _SpilledPrefix):
+                    continue
+                if self._prefix_key(toks) in want:
+                    self._prefix.remove(toks)
+                    dropped.append((toks, payload))
+        self._free_displaced(dropped)
+        with self._stats_lock:
+            self._evict_hints_total += len(dropped)
+        return {"evicted": len(dropped),
+                "requested": len(want)}
+
+    def prefix_handoff(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /prefix/handoff: push this replica's prefix entries
+        to a successor — the drain workflow's cache half (the router
+        posts this between drain-complete and restart, so a rolling
+        restart stops being a cache massacre).  Hottest entries
+        first; device-tier entries ride too (gathered read-only —
+        on a DRAINED replica the device lock is idle).  Serialization
+        and every push happen OUTSIDE all locks, each over its own
+        bounded connection; per-entry failures are counted and
+        skipped, never raised — the restart must proceed whatever the
+        successor says."""
+        host = req.get("host")
+        port = req.get("port")
+        if not isinstance(host, str) or not host:
+            raise ValueError("'host' must be a non-empty string")
+        try:
+            port = _int_param(port)
+        except (TypeError, ValueError):
+            raise ValueError("'port' must be an int")
+        max_entries = req.get("max_entries")
+        if max_entries is not None:
+            max_entries = _int_param(max_entries)
+            if max_entries < 1:
+                raise ValueError("max_entries must be >= 1")
+        include_device = req.get("include_device", True)
+        if not isinstance(include_device, bool):
+            raise ValueError("'include_device' must be a boolean")
+        import http.client
+
+        t0 = time.perf_counter()
+        with self._prefix_lock:
+            # entries() is coldest-first; the handoff budget should
+            # go to the HOTTEST entries, so reverse.
+            ents = [(t, p) for t, p in
+                    reversed(self._prefix.entries())
+                    if isinstance(p, _SpilledPrefix)
+                    or (include_device
+                        and isinstance(p, _PagedPrefix))]
+        if max_entries is not None:
+            ents = ents[:max_entries]
+        sent = bytes_sent = failed = 0
+        for ent_toks, payload in ents:
+            blob = self._pack_entry_wire(ent_toks, payload)
+            if blob is None:
+                failed += 1
+                continue
+            try:
+                conn = http.client.HTTPConnection(
+                    host, port, timeout=self.prefix_fetch_timeout_s)
+                try:
+                    conn.request(
+                        "POST", "/prefix/ingest", body=blob,
+                        headers={"Content-Type":
+                                 "application/octet-stream"})
+                    resp = conn.getresponse()
+                    body = resp.read()
+                finally:
+                    conn.close()
+                out = json.loads(body or b"{}") \
+                    if resp.status == 200 else {}
+                if out.get("stored"):
+                    sent += 1
+                    bytes_sent += len(blob)
+                elif out.get("reason") == "already_stored":
+                    sent += 1   # the successor already holds it —
+                    #             the handoff's goal state
+                else:
+                    failed += 1
+            except (OSError, ValueError,
+                    http.client.HTTPException):
+                failed += 1
+        with self._stats_lock:
+            self._handoff_entries_total += sent
+            self._handoff_bytes_total += bytes_sent
+            self._handoff_failed_total += failed
+        t_end = time.perf_counter()
+        # The handoff span rides the shared trace ring, so the
+        # stitched fleet timeline can attribute the restart's cache
+        # migration cost next to the drain/restart spans.
+        self._push_solo_events(
+            [("prefix_handoff", t0, t_end,
+              {"to": f"{host}:{port}", "entries": sent,
+               "bytes": bytes_sent, "failed": failed})])
+        return {"sent": sent, "bytes": bytes_sent,
+                "failed": failed, "considered": len(ents),
+                "wall_s": round(t_end - t0, 4)}
+
+    def _prefix_wire_fetch(self, toks: np.ndarray,
+                           hint: Dict[str, Any]):
+        """Affinity-miss wire fetch (the client half): ask the
+        router-designated holder for the spilled payload, verify it,
+        admit it through the host tier, and serve THIS request from
+        it.  Returns ``(PrefixHit, fetch_span_events)`` or None; every
+        failure lands in ``prefix_fetch_failed_total{reason=}`` and
+        falls back to re-prefill — the fetch tier is an optimization,
+        never a request dependency.  No locks are held across any
+        socket work."""
+        host, port = hint.get("host"), hint.get("port")
+        if not host or not port:
+            self._note_fetch_failed("bad_hint")
+            return None
+        n_tokens = int(toks.shape[1])
+        ok, why = self.fetch_policy.should_fetch(n_tokens, 0)
+        if not ok:
+            self._note_fetch_failed(why)
+            return None
+        import http.client
+
+        with self._stats_lock:
+            self._fetch_attempts_total += 1
+        t0 = time.perf_counter()
+        blob = None
+        try:
+            conn = http.client.HTTPConnection(
+                str(host), int(port),
+                timeout=self.prefix_fetch_timeout_s)
+            try:
+                conn.request(
+                    "POST", "/prefix/fetch",
+                    body=json.dumps(
+                        {"prompt": toks.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    resp.read()
+                    self._note_fetch_failed(
+                        "holder_miss" if resp.status == 404
+                        else f"http_{resp.status}")
+                    return None
+                nbytes = int(resp.getheader("Content-Length") or 0)
+                # The policy's second look, on the TRUE size, before
+                # the body transfer: a veto here has paid one RTT and
+                # headers, nothing more.
+                ok, why = self.fetch_policy.should_fetch(n_tokens,
+                                                         nbytes)
+                if not ok:
+                    self._note_fetch_failed(why)
+                    return None
+                blob = resp.read()
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            self._note_fetch_failed("wire_error")
+            return None
+        try:
+            ent_toks, leaves, pc, logits = unpack_spilled(blob)
+        except WirePayloadError:
+            self._note_fetch_failed("integrity")
+            return None
+        if ent_toks.shape[0] != toks.shape[0] or pc > n_tokens \
+                or not np.array_equal(ent_toks, toks[:, :pc]):
+            # Verified bytes but the WRONG prefix (a holder bug or a
+            # stale hint): admitting it would poison the cache.
+            self._note_fetch_failed("wrong_prefix")
+            return None
+        spilled = _SpilledPrefix(leaves, pc, logits)
+        # Admit through the host tier (budget-gated) so later local
+        # requests hit it without another wire trip...
+        stored = False
+        if spilled.nbytes <= self.kv_host_spill_bytes:
+            with self._prefix_lock:
+                anc = self._prefix.longest_ancestor(ent_toks)
+                have = anc is not None \
+                    and anc[0].shape[1] >= pc
+                displaced = [] if have \
+                    else self._prefix.store(ent_toks, spilled)
+                stored = not have
+            self._free_displaced(displaced)
+            if stored:
+                with self._stats_lock:
+                    self._host_bytes += spilled.nbytes
+                    self._host_entries += 1
+                self._enforce_spill_budget()
+        # ...then serve THIS request: the normal lookup path when the
+        # entry landed (promotion and shared pages included), or a
+        # direct re-materialization when it didn't — bitwise-
+        # identical either way (rematerialize == materialize for the
+        # same content).
+        try:
+            hit = self._prefix_lookup(toks) if stored else None
+            if hit is None:
+                hit = self._rematerialize_hit(ent_toks, spilled, pc)
+        except Exception:
+            self._note_fetch_failed("rematerialize")
+            self._note_prefix_error("lookup")
+            return None
+        t_end = time.perf_counter()
+        with self._stats_lock:
+            self._fetch_hits_total += 1
+            self._fetch_bytes_total += len(blob)
+        events = [("prefix_wire_fetch", t0, t_end,
+                   {"holder": str(hint.get("replica")
+                                  or f"{host}:{port}"),
+                    "bytes": len(blob), "tokens": int(pc)})]
+        return hit, events
 
     def prefill_prompt(self, req: Dict[str, Any]) -> Dict[str, Any]:
         """POST /prefill: register a prompt (prefix) in the prefix
@@ -1845,12 +2364,34 @@ class ModelServer:
         # it on the solo split path — beam tiles and speculative rolls
         # back the cache, so they stay cold.
         prefix_hit = None
+        fetch_events = None
         if self._prefix_enabled and beams == 1 and not speculative \
                 and not resume_tokens:
             # Resume replays skip the prefix store: the replayed
             # tokens ARE the state, and a store hit would re-seed a
             # stream the resume machinery is about to re-prefill.
             prefix_hit = self._prefix_lookup_safe(toks)
+            if prefix_hit is None and self.prefix_fetch \
+                    and isinstance(req.get("prefix_hint"), dict):
+                # Local miss + a router hint naming the holder: try
+                # the fleet tier.  Any failure inside lands in
+                # prefix_fetch_failed_total{reason=} and leaves
+                # prefix_hit None — this request just re-prefills.
+                fetched = self._prefix_wire_fetch(
+                    toks, req["prefix_hint"])
+                if fetched is not None:
+                    prefix_hit, fetch_events = fetched
+        # Where this request's prefill came from — reported in the
+        # response (the router learns holders from it) and in the
+        # trace timeline's per-request "prefix source" column.
+        if prefix_hit is None:
+            prefix_source = "re_prefill"
+        elif fetch_events is not None:
+            prefix_source = "wire_fetch"
+        elif prefix_hit.source == "host":
+            prefix_source = "local_spilled"
+        else:
+            prefix_source = "local_hot"
         # Engine eligibility: any non-beam request on a decoder-only
         # model — greedy, sampled, AND speculative (the engine owns
         # the draft model whenever the server does).  temperature==0
@@ -1948,10 +2489,17 @@ class ModelServer:
                     # read-only instead of refilling.
                     prefix_info={"cached_tokens": pc,
                                  "shared_pages":
-                                     len(prefix_hit.pins or ())})
+                                     len(prefix_hit.pins or ()),
+                                 "source": prefix_source},
+                    pre_events=fetch_events)
             except BaseException:
                 self._unpin_prefix(prefix_hit.pins)
                 raise
+            if fetch_events:
+                # The wire-fetch span also rides the shared trace
+                # ring so the stitched fleet timeline shows the
+                # holder round-trip next to this request's spans.
+                self._push_solo_events(list(fetch_events), rid=rid)
             self._wait_group(group, cancel_check)
             out = group.result()
             breakdown = group.breakdown()
@@ -1965,8 +2513,12 @@ class ModelServer:
                 seed, prefix_hit,
                 deadline=t0 + deadline_s
                 if deadline_s is not None else None)
+            if fetch_events:
+                self._push_solo_events(list(fetch_events), rid=rid)
             solo_events = self._emit_solo(t0, "prefix_solo",
                                           len(rows), rid=rid)
+            if fetch_events:
+                solo_events = list(fetch_events) + solo_events
         elif engine_ok:
             # CONTINUOUS BATCHING: per-row decode streams through the
             # slot pool.  Greedy streams ignore ``seed`` (greedy
@@ -2147,6 +2699,11 @@ class ModelServer:
                if breakdown is not None else {}),
             **({"prefix_hit_len": prefix_hit.p_cached}
                if prefix_hit is not None else {}),
+            # Always present when the prefix store is armed: the
+            # router's affinity learner and trace_report's "prefix
+            # source" column both read it.
+            **({"prefix_source": prefix_source}
+               if self._prefix_enabled else {}),
             **({"timings": timings} if timings is not None else {}),
         }
 
@@ -2194,6 +2751,20 @@ class ModelServer:
                 "kv_rematerialize_bytes_total":
                     self._remat_bytes_total,
                 "kv_promotions_total": self._promotions_total,
+                "prefix_fetch_total": self._fetch_attempts_total,
+                "prefix_fetch_hits_total": self._fetch_hits_total,
+                "prefix_fetch_bytes_total": self._fetch_bytes_total,
+                "prefix_fetch_failed": dict(self._fetch_failed),
+                "prefix_ingest_total": self._ingest_total,
+                "prefix_ingest_rejected_total":
+                    self._ingest_rejected_total,
+                "prefix_handoff_entries_total":
+                    self._handoff_entries_total,
+                "prefix_handoff_bytes_total":
+                    self._handoff_bytes_total,
+                "prefix_handoff_failed_total":
+                    self._handoff_failed_total,
+                "prefix_evict_hints_total": self._evict_hints_total,
             }
 
     def info(self) -> Dict[str, Any]:
@@ -2290,6 +2861,12 @@ class ModelServer:
                 # counters from the same _spill_stats() dict /metrics
                 # renders.
                 **(self._spill_stats() if self.kv_paged else {}),
+                # Fleet prefix cache: whether the wire-fetch client
+                # is armed, and the policy curve it gates on.
+                "prefix_fetch": self.prefix_fetch,
+                **({"prefix_fetch_policy":
+                    self.fetch_policy.describe()}
+                   if self.prefix_fetch else {}),
                 **{k: engine[k] for k in
                    ("slots", "slots_active", "slot_occupancy",
                     "queue_len", "queue_depth", "admitted_total",
@@ -2666,6 +3243,53 @@ class ModelServer:
                     "# TYPE ptpu_serving_kv_promotions_total counter",
                     f"ptpu_serving_kv_promotions_total "
                     f"{sp['kv_promotions_total']}",
+                    "# TYPE ptpu_serving_prefix_fetch_total counter",
+                    f"ptpu_serving_prefix_fetch_total "
+                    f"{sp['prefix_fetch_total']}",
+                    "# TYPE ptpu_serving_prefix_fetch_hits_total "
+                    "counter",
+                    f"ptpu_serving_prefix_fetch_hits_total "
+                    f"{sp['prefix_fetch_hits_total']}",
+                    "# TYPE ptpu_serving_prefix_fetch_bytes_total "
+                    "counter",
+                    f"ptpu_serving_prefix_fetch_bytes_total "
+                    f"{sp['prefix_fetch_bytes_total']}",
+                    # The TYPE line renders even with no failures yet
+                    # — scrapers (and the no-drift walk) see the
+                    # family exists before its first labeled sample.
+                    "# TYPE ptpu_serving_prefix_fetch_failed_total "
+                    "counter",
+                ]
+                lines += [
+                    f"ptpu_serving_prefix_fetch_failed_total"
+                    f'{{reason="{r}"}} {n}'
+                    for r, n in sorted(
+                        sp["prefix_fetch_failed"].items())
+                ]
+                lines += [
+                    "# TYPE ptpu_serving_prefix_ingest_total counter",
+                    f"ptpu_serving_prefix_ingest_total "
+                    f"{sp['prefix_ingest_total']}",
+                    "# TYPE ptpu_serving_prefix_ingest_rejected_total "
+                    "counter",
+                    f"ptpu_serving_prefix_ingest_rejected_total "
+                    f"{sp['prefix_ingest_rejected_total']}",
+                    "# TYPE ptpu_serving_prefix_handoff_entries_total "
+                    "counter",
+                    f"ptpu_serving_prefix_handoff_entries_total "
+                    f"{sp['prefix_handoff_entries_total']}",
+                    "# TYPE ptpu_serving_prefix_handoff_bytes_total "
+                    "counter",
+                    f"ptpu_serving_prefix_handoff_bytes_total "
+                    f"{sp['prefix_handoff_bytes_total']}",
+                    "# TYPE ptpu_serving_prefix_handoff_failed_total "
+                    "counter",
+                    f"ptpu_serving_prefix_handoff_failed_total "
+                    f"{sp['prefix_handoff_failed_total']}",
+                    "# TYPE ptpu_serving_prefix_evict_hints_total "
+                    "counter",
+                    f"ptpu_serving_prefix_evict_hints_total "
+                    f"{sp['prefix_evict_hints_total']}",
                 ]
             # The acceptance-rate histogram renders through the SAME
             # shared helper as the latency histograms, from the same
@@ -2833,6 +3457,16 @@ def make_handler(ms: ModelServer):
                                 "windows_deferred", "last_error")}})
                     else:
                         self._send(200, rep)
+            elif self.path == "/prefix/index":
+                # Fleet inventory: stable entry keys + tier/hits so
+                # the router's one-copy-somewhere pass can plan
+                # evictions without pulling any payload.
+                if not ms.kv_paged:
+                    self._send(400, {
+                        "error": "prefix index requires a paged "
+                                 "engine (--kv-paged)"})
+                else:
+                    self._send(200, ms.prefix_index())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -2916,6 +3550,72 @@ def make_handler(ms: ModelServer):
                           time.perf_counter() - t0,
                           rid=getattr(self, "_rid", None))
 
+        def _do_prefix(self, rid: str) -> None:
+            """The fleet prefix cache's wire surface:
+
+            - ``POST /prefix/fetch``  — serve a stored entry,
+              serialized + checksummed (404 = holder miss).
+            - ``POST /prefix/ingest`` — verify + admit one wire
+              payload into the host tier (drain handoff's push).
+            - ``POST /prefix/handoff`` — push this replica's entries
+              to a successor (the router posts this mid-drain).
+            - ``POST /prefix/evict``  — apply fleet eviction hints
+              (host-tier only).
+
+            All answer while DRAINING — the drain window is when the
+            fleet needs this surface most."""
+            t0 = time.perf_counter()
+            req = None
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                if self.path == "/prefix/fetch":
+                    req = json.loads(raw or b"{}")
+                    blob = ms.prefix_wire_payload(req)
+                    if blob is None:
+                        code, resp = 404, {"error": "prefix not held "
+                                                    "here"}
+                    else:
+                        self._send_raw(200, blob,
+                                       "application/octet-stream")
+                        ms.log_access("POST", self.path, 200, req,
+                                      {"nbytes": len(blob)},
+                                      time.perf_counter() - t0,
+                                      rid=rid)
+                        return
+                elif self.path == "/prefix/ingest":
+                    # Body IS the wire payload (octet-stream, not
+                    # JSON) — checksum verified inside.
+                    req = {"nbytes": len(raw)}
+                    code, resp = 200, ms.prefix_ingest(raw)
+                elif self.path == "/prefix/handoff":
+                    req = json.loads(raw or b"{}")
+                    code, resp = 200, ms.prefix_handoff(req)
+                elif self.path == "/prefix/evict":
+                    req = json.loads(raw or b"{}")
+                    code, resp = 200, ms.prefix_evict(req)
+                else:
+                    code, resp = 404, {"error":
+                                       f"no route {self.path}"}
+            except WirePayloadError as e:
+                # Typed integrity failure: the payload never touched
+                # the cache (counted prefix_ingest_rejected_total).
+                code, resp = 400, {"error": str(e),
+                                   "reason": "payload_integrity"}
+            except ValueError as e:
+                code, resp = 400, {"error": str(e)}
+            except Exception as e:  # never kill the server thread
+                code, resp = 500, {"error":
+                                   f"{type(e).__name__}: {e}"}
+            if isinstance(resp, dict):
+                resp.setdefault("request_id", rid)
+            try:
+                self._send(code, resp)
+            except OSError:
+                pass
+            ms.log_access("POST", self.path, code, req, resp,
+                          time.perf_counter() - t0, rid=rid)
+
         def do_POST(self):
             rid = self._req_id()
             if self.path in ("/profile/start", "/profile/stop"):
@@ -2933,6 +3633,9 @@ def make_handler(ms: ModelServer):
                     pass
                 ms.log_access("POST", self.path, 200, None, resp,
                               time.perf_counter() - t0, rid=rid)
+                return
+            if self.path.startswith("/prefix/"):
+                self._do_prefix(rid)
                 return
             if self.path not in ("/generate", "/prefill"):
                 self._send(404, {"error": f"no route {self.path}"})

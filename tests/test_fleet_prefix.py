@@ -1,0 +1,451 @@
+"""Fleet-amortized prefix cache proof obligations (PR 16:
+serving/paged.py wire format + serving/server.py fetch/ingest/handoff
+endpoints + serving/router.py hint injection, drain handoff and the
+one-copy-somewhere rebalance).
+
+THE pins:
+
+- WIRE FORMAT: pack/unpack round-trips a host-tier entry bitwise;
+  every corruption (flipped byte, truncation, malformed header, wrong
+  version) raises the typed :class:`WirePayloadError` — never a
+  partially-admitted payload.
+- FETCH POLICY: the cost curve's gates fire for the right reasons
+  (below_min_tokens / over_max_bytes / wire_slower / ok).
+- BITWISE IDENTITY: the same prompt served via local hit, wire fetch,
+  and full re-prefill produces IDENTICAL token streams — greedy,
+  sampled (seeded), and speculative.  A fetched prefix must not
+  change a single token.
+- DRAIN HANDOFF: after a rolling restart, the migrated prefix is
+  served WITHOUT a re-prefill (the successor holds it).
+- TYPED DEGRADE: a fetch against a dead holder still answers 200 via
+  re-prefill, with the failure counted under
+  ``prefix_fetch_failed_total{reason=}``.
+
+Satellites: failover target selection consults the affinity holder
+list (secondary holder beats a cold pick when the primary is out);
+the one-copy-somewhere rebalance evicts the redundant host copy and
+keeps the device one; the new counter families render on both
+/metrics surfaces.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import (LocalReplica, ModelServer,
+                                  PrefixFetchPolicy, ReplicaRouter,
+                                  make_router_server)
+from polyaxon_tpu.serving.paged import (WirePayloadError,
+                                        pack_spilled, unpack_spilled)
+from polyaxon_tpu.serving.router import Replica
+
+SYS_LEN, USER_LEN, NEW = 24, 4, 4
+
+# ---------------------------------------------------------------------------
+# fixtures (the test_fleet_observability.py fleet idiom, paged + spill
+# + fetch-armed; self-draft so the speculative lane runs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _factory(small_model, **kw):
+    model, variables = small_model
+    kw.setdefault("prefix_cache", 8)
+    kw.setdefault("kv_paged", True)
+    kw.setdefault("kv_page_tokens", 8)
+    kw.setdefault("kv_pages", 32)
+    kw.setdefault("kv_host_spill_bytes", 1 << 20)
+    kw.setdefault("prefix_fetch", True)
+    kw.setdefault("prefix_fetch_policy",
+                  PrefixFetchPolicy(min_tokens=1))
+
+    def make():
+        return ModelServer(
+            model, variables, model_name="tiny", max_batch=4,
+            n_slots=2, queue_depth=16, decode_window=2,
+            draft_model=model, draft_variables=variables, **kw)
+    return make
+
+
+def _spawn_fleet(small_model, n=3, *, router_kw=None, ms_kw=None):
+    reps = [LocalReplica(_factory(small_model, **(ms_kw or {})),
+                         f"r{i}")
+            for i in range(n)]
+    kw = dict(probe_interval_s=0.1, probe_timeout_s=0.5,
+              cooldown_s=0.2, request_timeout_s=60.0)
+    kw.update(router_kw or {})
+    router = ReplicaRouter(reps, **kw)
+    srv = make_router_server("127.0.0.1", 0, router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    return base, router, srv, reps
+
+
+def _teardown(router, srv, reps):
+    router.close()
+    srv.shutdown()
+    srv.server_close()
+    for r in reps:
+        r.close()
+
+
+@pytest.fixture(scope="module")
+def fleet(small_model):
+    """Shared non-destructive paged fleet (identity, degrade,
+    rebalance, metrics).  The handoff test spawns its own — a rolling
+    restart is destructive state."""
+    base, router, srv, reps = _spawn_fleet(small_model)
+    yield base, router, srv, reps
+    _teardown(router, srv, reps)
+
+
+def _post(base, payload, timeout=120, path="/generate"):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        assert r.status == 200
+        return r.read().decode()
+
+
+def _prompt(seed, n=SYS_LEN):
+    return np.random.RandomState(seed).randint(
+        0, 32, size=n).tolist()
+
+
+def _hint(rep):
+    return {"host": rep.host, "port": rep.port, "replica": rep.id}
+
+
+# ---------------------------------------------------------------------------
+# wire format: bitwise round-trip, typed corruption
+# ---------------------------------------------------------------------------
+
+
+def _sample_entry():
+    rng = np.random.RandomState(7)
+    toks = rng.randint(0, 32, size=(1, 12)).astype(np.int32)
+    leaves = [rng.randn(2, 1, 12, 4).astype(np.float32), None,
+              rng.randn(2, 1, 12, 4).astype(np.float16)]
+    logits = rng.randn(1, 32).astype(np.float32)
+    return toks, leaves, 12, logits
+
+
+def test_wire_roundtrip_bitwise():
+    toks, leaves, n_tokens, logits = _sample_entry()
+    blob = pack_spilled(toks, leaves, n_tokens, logits)
+    t2, l2, n2, g2 = unpack_spilled(blob)
+    assert n2 == n_tokens
+    assert t2.tobytes() == toks.tobytes() and t2.shape == toks.shape
+    assert g2.tobytes() == logits.tobytes() \
+        and g2.dtype == logits.dtype
+    assert len(l2) == len(leaves)
+    for a, b in zip(leaves, l2):
+        if a is None:
+            assert b is None
+        else:
+            assert b.tobytes() == a.tobytes() \
+                and b.shape == a.shape and b.dtype == a.dtype
+
+
+def test_wire_corruption_is_typed():
+    toks, leaves, n_tokens, logits = _sample_entry()
+    blob = pack_spilled(toks, leaves, n_tokens, logits)
+    # Flipped byte deep in the body: checksum mismatch.
+    bad = bytearray(blob)
+    bad[-3] ^= 0xFF
+    with pytest.raises(WirePayloadError):
+        unpack_spilled(bytes(bad))
+    # Truncations at every boundary class.
+    for cut in (2, 10, len(blob) - 5):
+        with pytest.raises(WirePayloadError):
+            unpack_spilled(blob[:cut])
+    # Malformed header (valid length prefix, garbage JSON).
+    with pytest.raises(WirePayloadError):
+        unpack_spilled(b"\x00\x00\x00\x04carpbody")
+    # WirePayloadError IS a ValueError: the HTTP layer's 400 path.
+    assert issubclass(WirePayloadError, ValueError)
+
+
+def test_fetch_policy_gates():
+    p = PrefixFetchPolicy()
+    ok, why = p.should_fetch(4, 1000)
+    assert (ok, why) == (False, "below_min_tokens")
+    ok, why = p.should_fetch(64, p.max_bytes + 1)
+    assert (ok, why) == (False, "over_max_bytes")
+    # A payload whose wire time swamps the re-prefill saving.
+    slow = PrefixFetchPolicy(min_tokens=1, wire_bytes_per_s=1e3)
+    ok, why = slow.should_fetch(64, 10 ** 6)
+    assert (ok, why) == (False, "wire_slower")
+    ok, why = p.should_fetch(64, 10 ** 6)
+    assert (ok, why) == (True, "ok")
+    # The knobs the CLI wires through are all described.
+    assert set(p.describe()) == {
+        "min_tokens", "max_bytes", "wire_bytes_per_s", "rtt_s",
+        "prefill_tok_per_s", "remat_ratio"}
+
+
+# ---------------------------------------------------------------------------
+# THE pin: wire-fetched == local == re-prefilled, token for token
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode_kw", [
+    {},
+    {"temperature": 0.9, "top_k": 8, "seed": 11},
+], ids=["greedy", "sampled"])
+def test_wire_fetch_bitwise_identity(fleet, mode_kw):
+    _, _, _, reps = fleet
+    holder, fetcher, fresh = reps[0], reps[1], reps[2]
+    # A distinct registered prefix per mode: a fetched entry is
+    # STORED on the fetcher, so reusing one would test a local hit.
+    seed = 100 + len(mode_kw)
+    system = _prompt(seed)
+    _post(holder.url, {"prompt": system}, path="/prefill")
+    body = {"prompt": system + _prompt(seed + 50, USER_LEN),
+            "max_new_tokens": NEW, **mode_kw}
+    # Wire fetch FIRST (before any store-back of this prompt exists
+    # off-holder), then the two references.
+    wired = _post(fetcher.url, {**body, "prefix_hint": _hint(holder)})
+    assert wired["prefix_source"] == "wire_fetch"
+    assert wired["prefix_hit_len"] >= SYS_LEN - SYS_LEN % 4
+    local = _post(holder.url, dict(body))
+    assert local["prefix_source"] in ("local_hot", "local_spilled")
+    replayed = _post(fresh.url, dict(body))
+    assert replayed["prefix_source"] == "re_prefill"
+    assert wired["new_tokens"] == local["new_tokens"] \
+        == replayed["new_tokens"]
+
+
+def test_wire_fetched_state_does_not_perturb_spec(fleet):
+    """Speculative requests stay COLD by design (spec rolls the
+    cache back, so the prefix path gates on ``not speculative``) —
+    the pin here is that a replica holding a wire-fetched entry for
+    the prompt still specs out the exact same tokens as one that
+    never saw the fleet tier."""
+    _, _, _, reps = fleet
+    holder, fetcher, fresh = reps[0], reps[1], reps[2]
+    system = _prompt(120)
+    _post(holder.url, {"prompt": system}, path="/prefill")
+    # Plant the wired entry on the fetcher via a greedy request.
+    planted = _post(fetcher.url, {
+        "prompt": system + _prompt(121, USER_LEN),
+        "max_new_tokens": NEW, "prefix_hint": _hint(holder)})
+    assert planted["prefix_source"] == "wire_fetch"
+    body = {"prompt": system + _prompt(122, USER_LEN),
+            "max_new_tokens": NEW, "speculative": True, "spec_k": 2}
+    outs = [_post(rep.url, dict(body))
+            for rep in (fetcher, holder, fresh)]
+    for o in outs:
+        assert o["prefix_source"] == "re_prefill"
+    assert outs[0]["new_tokens"] == outs[1]["new_tokens"] \
+        == outs[2]["new_tokens"]
+
+
+def test_fetch_failure_degrades_to_typed_re_prefill(fleet):
+    _, _, _, reps = fleet
+    fetcher = reps[1]
+    pre = json.loads(_get_text(fetcher.url, "/info"))
+    system = _prompt(300)
+    # Hint at a dead holder: the request must still answer 200, via
+    # re-prefill, with the failure counted by reason.
+    resp = _post(fetcher.url, {
+        "prompt": system + _prompt(301, USER_LEN),
+        "max_new_tokens": NEW,
+        "prefix_hint": {"host": "127.0.0.1", "port": 9}})
+    assert resp["prefix_source"] == "re_prefill"
+    assert len(resp["new_tokens"][0]) == NEW
+    info = json.loads(_get_text(fetcher.url, "/info"))
+    assert info["prefix_fetch_total"] > pre["prefix_fetch_total"]
+    failed = info["prefix_fetch_failed"]
+    assert sum(failed.values()) \
+        > sum(pre["prefix_fetch_failed"].values())
+    # Corrupt ingest: typed 400, counted, nothing admitted.
+    blob = bytearray(pack_spilled(*_sample_entry()))
+    blob[-1] ^= 0xFF
+    req = urllib.request.Request(
+        fetcher.url + "/prefix/ingest", data=bytes(blob),
+        headers={"Content-Type": "application/octet-stream"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["reason"] \
+        == "payload_integrity"
+    info2 = json.loads(_get_text(fetcher.url, "/info"))
+    assert info2["prefix_ingest_rejected_total"] \
+        == info["prefix_ingest_rejected_total"] + 1
+
+
+# ---------------------------------------------------------------------------
+# drain handoff: a rolling restart is no longer a cache massacre
+# ---------------------------------------------------------------------------
+
+
+def test_drain_handoff_successor_serves_without_re_prefill(
+        small_model):
+    base, router, srv, reps = _spawn_fleet(small_model, n=2)
+    try:
+        system = _prompt(400)
+        _post(base, {"prompt": system}, path="/prefill")
+        with urllib.request.urlopen(urllib.request.Request(
+                base + "/fleet/restart", data=b"",
+                headers={"Content-Type": "application/json"}),
+                timeout=30) as r:
+            assert r.status == 200
+        deadline = time.monotonic() + 120.0
+        while router.restart_state["in_progress"]:
+            assert time.monotonic() < deadline, "restart wedged"
+            time.sleep(0.05)
+        assert router.restart_state["last_error"] is None
+        st = router.stats()
+        assert st["kv_fleet_handoffs_total"] >= 2
+        assert st["kv_fleet_handoff_entries_total"] >= 1
+        # Both replicas restarted (their stores flushed), yet the
+        # prefix survived the migration chain: the routed request
+        # serves it WITHOUT a re-prefill.
+        resp = _post(base, {
+            "prompt": system + _prompt(401, USER_LEN),
+            "max_new_tokens": NEW})
+        assert resp["prefix_source"] != "re_prefill"
+        assert resp["prefix_hit_len"] >= SYS_LEN - SYS_LEN % 4
+    finally:
+        _teardown(router, srv, reps)
+
+
+# ---------------------------------------------------------------------------
+# satellites: affinity failover, rebalance, metrics families
+# ---------------------------------------------------------------------------
+
+
+def test_failover_pick_consults_secondary_holders():
+    r0, r1, r2 = (Replica("http://127.0.0.1:1", "r0"),
+                  Replica("http://127.0.0.1:2", "r1"),
+                  Replica("http://127.0.0.1:3", "r2"))
+    router = ReplicaRouter([r0, r1, r2], autostart=False)
+    prompt = list(range(8))
+    router._note_prefix(tuple(prompt), "r0")
+    router._note_prefix(tuple(prompt), "r1", primary=False)
+    # Primary healthy: primary wins.
+    picked, why = router._pick(prompt, set())
+    assert (picked.id, why) == ("r0", "affinity")
+    # Primary out of rotation: the SECONDARY holder (a fetcher that
+    # kept a host-tier copy) beats a cold least-outstanding pick.
+    r0.health_ok = False
+    picked, why = router._pick(prompt, set())
+    assert (picked.id, why) == ("r1", "affinity")
+    # Both holders out: plain least-outstanding fallback.
+    r1.health_ok = False
+    picked, why = router._pick(prompt, set())
+    assert (picked.id, why) == ("r2", "least_outstanding")
+
+
+def test_rebalance_keeps_one_copy_somewhere(small_model):
+    # Fresh 2-replica fleet so the tiers are deterministic: the
+    # holder's registered prefix sits in the DEVICE tier (no page
+    # pressure yet) and the wire fetch plants the duplicate in the
+    # fetcher's HOST tier.
+    base, router, srv, reps = _spawn_fleet(small_model, n=2)
+    try:
+        holder, fetcher = reps[0], reps[1]
+        system = _prompt(500)
+        _post(holder.url, {"prompt": system}, path="/prefill")
+        # Replicate the entry into the fetcher's HOST tier directly
+        # (a served wire fetch would PROMOTE it to device pages on a
+        # roomy pool — ingest alone leaves the spilled copy, which
+        # is the redundant-cold-copy shape the policy targets).
+        req = urllib.request.Request(
+            holder.url + "/prefix/fetch",
+            data=json.dumps({"prompt": system}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            blob = r.read()
+        req = urllib.request.Request(
+            fetcher.url + "/prefix/ingest", data=blob,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        idx = json.loads(_get_text(fetcher.url, "/prefix/index"))
+        host_before = {e["key"] for e in idx["entries"]
+                       if e["tier"] == "host"
+                       and e["tokens"] == SYS_LEN}
+        assert host_before, "wire fetch left no host-tier copy"
+        req = urllib.request.Request(
+            base + "/fleet/prefix/rebalance", data=b"",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["duplicates"] >= 1
+        assert out["evict_hints"] >= 1 and out["evicted"] >= 1
+        # The redundant host copy is gone from the fetcher...
+        idx2 = json.loads(_get_text(fetcher.url, "/prefix/index"))
+        host_after = {e["key"] for e in idx2["entries"]
+                      if e["tier"] == "host"
+                      and e["tokens"] == SYS_LEN}
+        assert not (host_after & host_before)
+        # ...and the device-tier copy survived: one copy SOMEWHERE,
+        # still serving hits.
+        again = _post(holder.url, {
+            "prompt": system + _prompt(502, USER_LEN),
+            "max_new_tokens": NEW})
+        assert again["prefix_source"] in ("local_hot",
+                                          "local_spilled")
+        assert router.stats()["kv_fleet_rebalances_total"] >= 1
+    finally:
+        _teardown(router, srv, reps)
+
+
+def test_new_counter_families_render(fleet):
+    base, _, _, reps = fleet
+    replica_families = [
+        "ptpu_serving_prefix_fetch_total",
+        "ptpu_serving_prefix_fetch_hits_total",
+        "ptpu_serving_prefix_fetch_bytes_total",
+        "ptpu_serving_prefix_fetch_failed_total",
+        "ptpu_serving_prefix_ingest_total",
+        "ptpu_serving_prefix_ingest_rejected_total",
+        "ptpu_serving_prefix_handoff_entries_total",
+        "ptpu_serving_prefix_handoff_bytes_total",
+        "ptpu_serving_prefix_handoff_failed_total",
+        "ptpu_serving_prefix_evict_hints_total",
+    ]
+    text = _get_text(reps[0].url, "/metrics")
+    for fam in replica_families:
+        assert f"# TYPE {fam} counter" in text, fam
+    router_families = [
+        "ptpu_router_kv_fleet_hints_injected_total",
+        "ptpu_router_kv_fleet_wire_fetches_total",
+        "ptpu_router_kv_fleet_handoffs_total",
+        "ptpu_router_kv_fleet_handoff_entries_total",
+        "ptpu_router_kv_fleet_handoff_failed_total",
+        "ptpu_router_kv_fleet_rebalances_total",
+        "ptpu_router_kv_fleet_evict_hints_total",
+    ]
+    text = _get_text(base, "/fleet/metrics")
+    for fam in router_families:
+        assert f"# TYPE {fam} counter" in text, fam
