@@ -312,6 +312,38 @@ def fleetprefix_value(r):
     return out
 
 
+def disagg_value(r):
+    """serving-load rows: the DISAGG column — interactive TTFT p99
+    of the role-split arm (1 prefill + 2 decode) as a fraction of
+    the monolithic arm's at equal total KV budget (the PR 17
+    headline; < 1.0 is the win), with the agg-tok/s ratio (contract:
+    in band) and the measured handoff cost as a fraction of the
+    re-prefill it replaces (contract: < 1.0; ``!`` marks a noisy-box
+    ordering the box could not resolve).  ``INEXACT`` flags a
+    disagg stream that diverged bitwise from the monolithic one;
+    ``RECOMPILED`` flags steady-state recompiles on either tier
+    (both violate the tentpole contract — the bench run itself
+    fails on them; a committed flag marks a preserved-evidence
+    row).  Empty for every other bench."""
+    dg = r.get("disagg") or {}
+    if not dg:
+        return ""
+    out = f"ttft {dg.get('ttft_p99_vs_mono')}x"
+    if dg.get("noisy_box"):
+        out += "!"
+    agg = dg.get("agg_tok_ratio")
+    if agg is not None:
+        out += f" agg {agg}x"
+    ho = dg.get("handoff_vs_re_prefill")
+    if ho is not None:
+        out += f" ho {ho}x"
+    if not dg.get("exact", True):
+        out += " INEXACT"
+    if dg.get("steady_recompiles"):
+        out += " RECOMPILED"
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu-only", action="store_true")
@@ -321,11 +353,11 @@ def main() -> int:
         rows = [r for r in rows
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
-          "| spec-mix | paged | lazy | spill | fleetpfx | mesh "
-          "| telemetry | recorder | debug | chaos | fleet | fleetobs "
-          "| overload | mfu | age |")
+          "| spec-mix | paged | lazy | spill | fleetpfx | disagg "
+          "| mesh | telemetry | recorder | debug | chaos | fleet "
+          "| fleetobs | overload | mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|---|---|---|---|---|---|")
+          "---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -346,6 +378,7 @@ def main() -> int:
               f"| {lazy_value(r)} "
               f"| {spill_value(r)} "
               f"| {fleetprefix_value(r)} "
+              f"| {disagg_value(r)} "
               f"| {meshed_value(r)} "
               f"| {telemetry_value(r)} "
               f"| {recorder_value(r)} "

@@ -517,6 +517,19 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
               help="Fetch-policy curve: rematerialization cost as a "
                    "fraction of re-prefill cost (the measured "
                    "spilled-hit ratio; docs/SERVING.md).")
+@click.option("--role", default="both",
+              type=click.Choice(["prefill", "decode", "both"]),
+              help="Disaggregated-serving role (docs/SERVING.md "
+                   "\"Disaggregated serving\"). 'both' (default) is "
+                   "today's monolithic replica, byte-for-byte. "
+                   "'prefill' runs prompt prefill only — serves "
+                   "/prefill and the /prefix/* wire lanes, rejects "
+                   "/generate (400), no decode residents; needs "
+                   "--kv-paged and --kv-host-spill-bytes. 'decode' "
+                   "pulls handed-off KV over the wire-fetch lane; "
+                   "needs --prefix-fetch. The router learns roles "
+                   "from /healthz and schedules prefill->decode as "
+                   "a two-stage attempt.")
 @click.option("--default-priority", default="interactive",
               type=click.Choice(["interactive", "batch"]),
               help="Priority class for requests that don't declare "
@@ -643,6 +656,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           kv_lazy, kv_host_spill_bytes,
           prefix_fetch, prefix_fetch_timeout,
           prefix_fetch_min_tokens, prefix_fetch_remat_ratio,
+          role,
           default_priority, batch_queue_depth, queue_deadline_ms,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
@@ -768,6 +782,19 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
             "--prefix-fetch requires --kv-paged and "
             "--kv-host-spill-bytes (wire-fetched payloads admit "
             "through the host spill tier)")
+    # Role validation BEFORE the model build (fail-fast contract) —
+    # mirror the ModelServer checks so a mis-flagged tier dies on
+    # usage, not after a checkpoint restore.
+    if role == "prefill" and not (kv_paged and kv_host_spill_bytes):
+        raise click.ClickException(
+            "--role prefill requires --kv-paged and "
+            "--kv-host-spill-bytes (a prefill tier's product is "
+            "admit-ready KV served over the /prefix/fetch lane)")
+    if role == "decode" and not prefix_fetch:
+        raise click.ClickException(
+            "--role decode requires --prefix-fetch (the decode tier "
+            "admits handed-off prefills through the wire-fetch "
+            "lane)")
     mesh_spec = None
     if mesh_arg is not None:
         # Parse BEFORE the model build (fail-fast contract): a typo'd
@@ -824,6 +851,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                              remat_ratio=prefix_fetch_remat_ratio)
                          if prefix_fetch else None,
                          prefix_fetch_timeout_s=prefix_fetch_timeout,
+                         role=role,
                          default_priority=default_priority,
                          batch_queue_depth=batch_queue_depth,
                          queue_deadline_s=queue_deadline_ms / 1e3
@@ -937,6 +965,20 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                    "/prefix/handoff) before the flush.  Off = a "
                    "restart is a cache flush (the per-replica "
                    "baseline).")
+@click.option("--disagg-min-tokens", default=16, type=int,
+              help="Disaggregated serving: prompts at or above this "
+                   "length take the two-stage prefill->decode "
+                   "schedule when the fleet runs a dedicated "
+                   "--role prefill tier (shorter prompts decode "
+                   "locally — the handoff would cost more than the "
+                   "prefill).")
+@click.option("--rebalance-every", default=0.0, type=float,
+              help="Seconds between cadenced POST "
+                   "/fleet/prefix/rebalance passes, driven off the "
+                   "federated kv_host_* gauges (runs only while "
+                   ">=2 replicas hold host-tier entries; "
+                   "one-flight; failures counted, never fatal).  "
+                   "0 = operator trigger only (default).")
 @click.option("--min-ready", default=1, type=int,
               help="Rolling restart never drops the ready-replica "
                    "count below this.")
@@ -959,7 +1001,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
 def route(host, port, replicas, probe_interval, probe_timeout,
           down_after, cooldown, retry_ratio, retry_burst,
           max_attempts, request_timeout, hedge, hedge_min, affinity,
-          prefix_handoff, min_ready, fleet_fault_plan,
+          prefix_handoff, disagg_min_tokens, rebalance_every,
+          min_ready, fleet_fault_plan,
           request_history, slo, slo_window):
     """Run the replica ROUTER tier in front of N `ptpu serve`
     replicas (docs/SERVING.md "Fleet").
@@ -998,6 +1041,8 @@ def route(host, port, replicas, probe_interval, probe_timeout,
             hedge_min_s=hedge_min,
             affinity=affinity,
             prefix_handoff=prefix_handoff,
+            disagg_min_tokens=disagg_min_tokens,
+            rebalance_every_s=rebalance_every,
             min_ready=min_ready,
             fleet_faults=fleet_fault_plan,
             request_history=request_history,

@@ -1720,6 +1720,14 @@ class DecodeEngine:
         cap = self.policy.decode_window
         if cap <= 1:
             return 1
+        waiters = getattr(self.device_lock, "waiters", None)
+        if waiters is not None and waiters():
+            # A handler thread is WAITING on the device lock right
+            # now (wire-fetch admit, direct /prefill, solo request):
+            # fusing would make it wait out the whole fused hold.
+            # Window 1 bounds its wait to one step, exactly like a
+            # queued interactive head.
+            return 1
         head = self.queue.head()
         if head is not None and (
                 not head.pf_done

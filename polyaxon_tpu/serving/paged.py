@@ -353,6 +353,12 @@ class PagedSlotKVManager:
         self._step_fns: Dict[Tuple, Any] = {}
         self._insert_fns: Dict[Tuple, Any] = {}
         self._gather_fns: Dict[int, Any] = {}
+        # First-touch pool shaping is double-checked under this lock:
+        # two concurrent handoffs racing a FRESH replica's unshaped
+        # pool (ensure_shaped from two wire admissions) must not both
+        # allocate — the loser's pool would replace a pool the winner
+        # already wrote pages into, silently dropping its KV.
+        self._shape_lock = threading.Lock()
 
         # -- per-slot decode state (identical to SlotKVManager;
         # shared helper, also called by crash-recovery reset()) -----
@@ -630,9 +636,18 @@ class PagedSlotKVManager:
         return pool, shardings
 
     def _ensure_pool(self, template_cache) -> None:
-        if self._pool is None:
-            self._meta, self._treedef = self._classify(template_cache)
-            self._pool, self._pool_sh = self._alloc_pool(self._meta)
+        if self._pool is not None:
+            return
+        with self._shape_lock:
+            if self._pool is not None:      # lost the race: done
+                return
+            meta, treedef = self._classify(template_cache)
+            pool, pool_sh = self._alloc_pool(meta)
+            # Publish LAST, fully formed: a concurrent ``shaped``
+            # reader must never observe meta without its pool.
+            self._meta, self._treedef = meta, treedef
+            self._pool_sh = pool_sh
+            self._pool = pool
 
     @property
     def shaped(self) -> bool:
@@ -649,16 +664,24 @@ class PagedSlotKVManager:
         the fleet prefix tier: a wire-fetched or handed-off host
         entry can arrive BEFORE this replica's first prefill (a
         freshly restarted drain successor), and its rematerialize
-        must not depend on prior traffic.  Caller holds the device
-        lock."""
+        must not depend on prior traffic.  Safe under concurrent
+        first-touch (two handoffs racing a fresh replica's unshaped
+        pool): shaping is double-checked under an internal lock, so
+        exactly one caller allocates and the rest observe the
+        finished pool."""
         self._ensure_pool(template_cache)
 
     def _ensure_draft_pool(self, template_cache) -> None:
-        if self._draft_pool is None:
-            self._draft_meta, self._draft_treedef = \
-                self._classify(template_cache)
-            self._draft_pool, self._draft_pool_sh = \
-                self._alloc_pool(self._draft_meta)
+        if self._draft_pool is not None:
+            return
+        with self._shape_lock:
+            if self._draft_pool is not None:
+                return
+            meta, treedef = self._classify(template_cache)
+            pool, pool_sh = self._alloc_pool(meta)
+            self._draft_meta, self._draft_treedef = meta, treedef
+            self._draft_pool_sh = pool_sh
+            self._draft_pool = pool
 
     def _pad_class(self, n_pages: int) -> int:
         return min(self.table_width, _pow2ceil(max(1, n_pages)))

@@ -429,10 +429,70 @@ class Replica:
         # histogram, so a slow-but-alive replica is identifiable in
         # rotation before it trips the hedge watermark.
         self.last_probe_s: Optional[float] = None
+        # Disaggregated-serving role, learned from the /healthz 200
+        # body ("prefill" / "decode" / "both"); optimistic "both"
+        # until probed — an unprobed replica must stay routable.
+        self.role = "both"
+        # Per-link calibration (ROADMAP item 3): EWMA of the measured
+        # wire throughput serving FROM this replica (completed
+        # fetches + handoffs) and of its probe round-trip time.  None
+        # until a measurement lands; shipped inside prefix hints so
+        # the holder-side cost gate runs on observed link truth.
+        self.wire_bytes_per_s: Optional[float] = None
+        self.rtt_s: Optional[float] = None
         self.requests_total = 0
         self.failures_total = 0
         self._out_lock = threading.Lock()
         self.outstanding = 0
+
+    # -- roles -----------------------------------------------------------
+
+    def decode_capable(self) -> bool:
+        return self.role in ("decode", "both")
+
+    def prefill_capable(self) -> bool:
+        return self.role in ("prefill", "both")
+
+    # -- link calibration ------------------------------------------------
+
+    _EWMA_ALPHA = 0.3
+
+    def note_link_sample(self, nbytes: int, wall_s: float) -> None:
+        """One completed transfer FROM this replica (wire fetch or
+        handoff push): fold its observed bytes/s into the link EWMA.
+        Tiny payloads are RTT-dominated and would drag the throughput
+        estimate toward zero, so they only seed, never update."""
+        if wall_s <= 0 or nbytes <= 0:
+            return
+        bps = nbytes / wall_s
+        if self.wire_bytes_per_s is None:
+            self.wire_bytes_per_s = bps
+        elif nbytes >= 4096:
+            a = self._EWMA_ALPHA
+            self.wire_bytes_per_s = \
+                a * bps + (1 - a) * self.wire_bytes_per_s
+
+    def note_rtt_sample(self, rtt_s: float) -> None:
+        """One probe round trip: the link RTT EWMA (the /healthz
+        body is tiny, so probe wall time ~= RTT for this tier)."""
+        if rtt_s <= 0:
+            return
+        if self.rtt_s is None:
+            self.rtt_s = rtt_s
+        else:
+            a = self._EWMA_ALPHA
+            self.rtt_s = a * rtt_s + (1 - a) * self.rtt_s
+
+    def link_estimates(self) -> Dict[str, float]:
+        """The measured-link keys a prefix hint carries (empty until
+        a measurement exists — absent keys leave the holder-side
+        policy on its static defaults)."""
+        out: Dict[str, float] = {}
+        if self.wire_bytes_per_s is not None:
+            out["wire_bytes_per_s"] = round(self.wire_bytes_per_s, 1)
+        if self.rtt_s is not None:
+            out["rtt_s"] = round(self.rtt_s, 6)
+        return out
 
     # -- rotation --------------------------------------------------------
 
@@ -509,6 +569,8 @@ class Replica:
             **({"health_reason": self.health_reason}
                if self.health_reason else {}),
             "outstanding": self.outstanding,
+            "role": self.role,
+            **self.link_estimates(),
             "consecutive_probe_failures":
                 self.consecutive_probe_failures,
             **({"last_probe_s": self.last_probe_s}
@@ -803,6 +865,8 @@ class ReplicaRouter:
                  affinity_max_outstanding: int = 8,
                  affinity_entries: int = 64,
                  prefix_handoff: bool = True,
+                 disagg_min_tokens: int = 16,
+                 rebalance_every_s: float = 0.0,
                  min_ready: int = 1,
                  fleet_faults=None,
                  request_history: int = 256,
@@ -843,6 +907,12 @@ class ReplicaRouter:
         if min_ready < 0:
             raise ValueError(f"min_ready must be >= 0; got "
                              f"{min_ready}")
+        if disagg_min_tokens < 1:
+            raise ValueError(f"disagg_min_tokens must be >= 1; got "
+                             f"{disagg_min_tokens}")
+        if rebalance_every_s < 0:
+            raise ValueError(f"rebalance_every_s must be >= 0; got "
+                             f"{rebalance_every_s}")
         if hedge != "off" and hedge != "p99":
             try:
                 float(hedge)
@@ -866,6 +936,21 @@ class ReplicaRouter:
         # rolling-restart flush).  Off = the seed per-replica-only
         # behavior: a restart is a cache flush.
         self.prefix_handoff_enabled = bool(prefix_handoff)
+        # Disaggregated serving: prompts at or above this length get
+        # the two-stage prefill->decode schedule when a dedicated
+        # prefill tier exists.  Below it the remote-prefill round
+        # trip costs more than decoding the prefill locally (same
+        # calculus as PrefixFetchPolicy.min_tokens, and the same
+        # default).
+        self.disagg_min_tokens = int(disagg_min_tokens)
+        # Optional cadence for POST /fleet/prefix/rebalance driven
+        # off the federated kv_host_* gauges.  0 (default) = operator
+        # trigger only, the PR 16 behavior.  One-flight: the cadence
+        # thread and an operator POST share the same non-blocking
+        # lock, so a slow pass is skipped, never stacked.
+        self.rebalance_every_s = float(rebalance_every_s)
+        self._rebalance_flight = threading.Lock()
+        self._rebalance_thread: Optional[threading.Thread] = None
         self.min_ready = int(min_ready)
         self.fleet_faults = FaultPlan.load(fleet_faults) \
             if fleet_faults is not None else None
@@ -934,6 +1019,17 @@ class ReplicaRouter:
         self.kv_fleet_handoff_failed_total = 0
         self.kv_fleet_rebalances_total = 0
         self.kv_fleet_evict_hints_total = 0
+        # Cadenced rebalance (--rebalance-every): runs attempted /
+        # failed (operator-triggered passes count only in
+        # kv_fleet_rebalances_total, as before).
+        self.kv_fleet_rebalance_runs_total = 0
+        self.kv_fleet_rebalance_failed_total = 0
+        # Disaggregated serving: two-stage schedules taken, and
+        # stage-1 (remote prefill) failures degraded to decode-side
+        # re-prefill — the counted-never-fatal rung of the ladder.
+        self.disagg_prefills_total = 0
+        self.disagg_prefill_failed_total = 0
+        self.disagg_handoffs_total = 0
         self.fleet_faults_applied: Dict[str, int] = {}
         self._rr = 0                   # least-outstanding tiebreak
         # Rolling restart state (one at a time; POST /fleet/restart).
@@ -962,6 +1058,13 @@ class ReplicaRouter:
             target=self._probe_loop, daemon=True,
             name="router-probe")
         self._probe_thread.start()
+        if self.rebalance_every_s > 0 and (
+                self._rebalance_thread is None
+                or not self._rebalance_thread.is_alive()):
+            self._rebalance_thread = threading.Thread(
+                target=self._rebalance_loop, daemon=True,
+                name="router-rebalance")
+            self._rebalance_thread.start()
 
     def close(self) -> None:
         self._stop = True
@@ -969,6 +1072,9 @@ class ReplicaRouter:
         if t is not None:
             t.join(timeout=self.probe_timeout_s
                    * max(2, len(self.replicas)) + 5)
+        t = self._rebalance_thread
+        if t is not None:
+            t.join(timeout=self.probe_timeout_s + 5)
 
     def drain(self) -> Dict[str, Any]:
         """Router-level drain: stop admitting (503 ``draining``) —
@@ -1047,9 +1153,20 @@ class ReplicaRouter:
             replica.note_failure()
             return
         replica.consecutive_probe_failures = 0
+        # Any completed exchange is an RTT sample for the link
+        # calibration EWMA (a /healthz round trip is all overhead —
+        # exactly what the wire-fetch cost gate's rtt term models).
+        replica.note_rtt_sample(dt)
         if status == 200:
             replica.health_ok = True
             replica.health_reason = None
+            # Role discovery: /healthz advertises the replica's
+            # serving role, so the router learns the fleet's shape
+            # from the same probe that learns its health.  Replicas
+            # predating the role field read as "both" (monolithic).
+            role = parsed.get("role")
+            if role in ("prefill", "decode", "both"):
+                replica.role = role
             st = replica.breaker.state
             if st == CircuitBreaker.OPEN:
                 replica.maybe_half_open()
@@ -1141,20 +1258,38 @@ class ReplicaRouter:
     # -- replica selection -----------------------------------------------
 
     def _pick(self, prompt: Optional[List[int]],
-              exclude: set) -> Tuple[Optional[Replica], str]:
+              exclude: set, want: str = "any"
+              ) -> Tuple[Optional[Replica], str]:
         """``(replica, why)``: least-outstanding among in-rotation
         replicas, with prefix affinity as a PREFERENCE — the affinity
         replica wins only while it is healthy and below the
         saturation bound (affinity must never beat health, pinned).
         ``why`` is the route-decision tag the request-span record
         carries: ``affinity`` / ``least_outstanding`` /
-        ``half_open_probe`` / ``none``."""
+        ``half_open_probe`` / ``none``.
+
+        ``want`` is the role-split capability filter.  ``"decode"``
+        is HARD: a role='prefill' replica rejects /generate outright,
+        so routing one there just burns an attempt.  ``"prefill"``
+        is SOFT — every role physically serves /prefill (a decode
+        replica's re-prefill fallback depends on it) — so it narrows
+        to prefill-capable replicas only while at least one is in
+        rotation."""
         eligible = [r for r in self.replicas
                     if r.id not in exclude and r.eligible()]
         half_open = [r for r in self.replicas
                      if r.id not in exclude and not r.draining
                      and r.health_ok
                      and r.breaker.state == CircuitBreaker.HALF_OPEN]
+        if want == "decode":
+            eligible = [r for r in eligible if r.decode_capable()]
+            half_open = [r for r in half_open if r.decode_capable()]
+        elif want == "prefill":
+            pref = [r for r in eligible if r.prefill_capable()]
+            if pref:
+                eligible = pref
+                half_open = [r for r in half_open
+                             if r.prefill_capable()]
         by_id = {r.id: r for r in eligible}
         # Holders in preference order (primary first): the FIRST
         # surviving, unsaturated one wins — so a failover replay
@@ -1181,6 +1316,18 @@ class ReplicaRouter:
             if r.breaker.try_probe():
                 return r, "half_open_probe"
         return None, "none"
+
+    def _pick_prefill_tier(self) -> Optional[Replica]:
+        """Least-outstanding DEDICATED prefill replica in rotation,
+        or None.  The two-stage disagg schedule only activates when
+        the fleet actually runs a prefill tier — a 'both' replica
+        prefills fine, but bouncing a prompt through one buys no
+        decode-lock relief, just an extra hop."""
+        tier = [r for r in self.replicas
+                if r.role == "prefill" and r.eligible()]
+        if not tier:
+            return None
+        return min(tier, key=lambda r: r.outstanding)
 
     # -- fleet chaos -----------------------------------------------------
 
@@ -1300,7 +1447,8 @@ class ReplicaRouter:
                     and now - t0 >= hedge_after \
                     and not primary.done.is_set():
                 second, _why = self._pick(
-                    prompt, exclude | {primary.replica.id})
+                    prompt, exclude | {primary.replica.id},
+                    want="decode")
                 if second is not None and self.budget.try_spend():
                     hedge = _Attempt(
                         second, "POST", "/generate", payload_bytes,
@@ -1473,6 +1621,61 @@ class ReplicaRouter:
                          and not isinstance(deadline_ms, bool)
                          and deadline_ms > 0
                          else self.request_timeout_s)
+        # Disaggregated two-stage schedule (docs/SERVING.md
+        # "Disaggregated serving"): with a dedicated prefill tier in
+        # rotation and a prompt long enough to amortize the handoff,
+        # run STAGE 1 — prompt prefill on a prefill replica — before
+        # the decode attempt loop.  Success records the prefill
+        # replica as the prefix's PRIMARY holder, so the decode
+        # replica the loop picks gets a fetch hint naming it and
+        # ADMITS the prefill's KV over the wire lane (the kv_handoff)
+        # instead of re-prefilling under its own decode lock.  A
+        # prompt whose prefix already sits warm on a routable decode
+        # replica skips stage 1 — affinity routing lands it there
+        # with zero prefill work anywhere.  EVERY stage-1 failure
+        # (dead prefill tier, timeout) degrades to decode-side
+        # re-prefill: counted, never a request failure.
+        disagg: Optional[Replica] = None
+        if prompt and len(prompt) >= self.disagg_min_tokens \
+                and not req.get("resume_tokens") \
+                and all(isinstance(t, int) for t in prompt):
+            pre = self._pick_prefill_tier()
+            if pre is not None:
+                by_id = {r.id: r for r in self.replicas}
+                warm_decode = any(
+                    h is not None and h.eligible()
+                    and h.decode_capable()
+                    and h.outstanding < self.affinity_max_outstanding
+                    for h in (by_id.get(hid) for hid
+                              in self._affinity_holders(prompt)))
+                if not warm_decode:
+                    disagg = pre
+                    tp0 = time.monotonic()
+                    p_att = _Attempt(
+                        pre, "POST", "/prefill",
+                        json.dumps({"prompt": list(prompt)}).encode(),
+                        self._forward_headers(pre, rid),
+                        min(self.request_timeout_s,
+                            max(0.05, deadline - tp0))).start()
+                    p_att.done.wait(max(0.05, deadline - tp0) + 1.0)
+                    ok = p_att.done.is_set() \
+                        and p_att.outcome() == "ok"
+                    note("prefill_remote", tp0, time.monotonic(),
+                         replica=pre.id,
+                         tokens=len(prompt),
+                         **({} if ok else {"failed": True}))
+                    with self._stats_lock:
+                        self.disagg_prefills_total += 1
+                        if not ok:
+                            self.disagg_prefill_failed_total += 1
+                    if ok:
+                        pre.note_success()
+                        self._note_prefix(tuple(prompt), pre.id)
+                    else:
+                        if p_att.error is not None \
+                                and not p_att.cancelled:
+                            pre.note_failure()
+                        disagg = None   # hint-less: re-prefill
         exclude: set = set()
         attempt_n = 0
         while True:
@@ -1485,7 +1688,8 @@ class ReplicaRouter:
                 # (docs/DESIGN.md; token-identical per seed).
                 payload["prompt"] = list(prompt) + partial
                 payload["resume_tokens"] = len(partial)
-            replica, why = self._pick(prompt, exclude)
+            replica, why = self._pick(prompt, exclude,
+                                      want="decode")
             if replica is None and exclude:
                 # Every replica already failed this request once:
                 # widen back out rather than shedding while capacity
@@ -1493,7 +1697,8 @@ class ReplicaRouter:
                 note("exclusions_widened", time.monotonic(),
                      excluded=sorted(exclude))
                 exclude = set()
-                replica, why = self._pick(prompt, exclude)
+                replica, why = self._pick(prompt, exclude,
+                                          want="decode")
             if replica is None:
                 with self._stats_lock:
                     self.shed_total += 1
@@ -1504,13 +1709,19 @@ class ReplicaRouter:
                     "router": self._route_info(None, attempt_n,
                                                partial)})
             attempt_n += 1
+            hint_holder: Optional[Replica] = None
             if why != "affinity":
                 # Routed AWAY from the prefix's holders (saturation,
-                # exclusion, drain): hand the chosen replica a FETCH
-                # HINT naming a live holder, so its local miss can
-                # become a wire fetch instead of a re-prefill.  A
-                # DRAINING holder still qualifies — the drain window
-                # is exactly when its entries need serving out.
+                # exclusion, drain, role split): hand the chosen
+                # replica a FETCH HINT naming a live holder, so its
+                # local miss can become a wire fetch instead of a
+                # re-prefill.  A DRAINING holder still qualifies —
+                # the drain window is exactly when its entries need
+                # serving out.  The hint carries the holder link's
+                # MEASURED wire_bytes_per_s / rtt_s (EWMA) when they
+                # exist, so the fetcher's cost gate runs on observed
+                # truth instead of PrefixFetchPolicy's static
+                # defaults.
                 holders = self._affinity_holders(prompt)
                 if holders and replica.id not in holders:
                     by_id = {r.id: r for r in self.replicas}
@@ -1521,7 +1732,9 @@ class ReplicaRouter:
                                 or hr.health_reason == "draining"):
                             payload["prefix_hint"] = {
                                 "host": hr.host, "port": hr.port,
-                                "replica": hr.id}
+                                "replica": hr.id,
+                                **hr.link_estimates()}
+                            hint_holder = hr
                             with self._stats_lock:
                                 self.kv_fleet_hints_injected_total \
                                     += 1
@@ -1567,6 +1780,32 @@ class ReplicaRouter:
                 if src == "wire_fetch":
                     with self._stats_lock:
                         self.kv_fleet_wire_fetches_total += 1
+                        if disagg is not None:
+                            self.disagg_handoffs_total += 1
+                    # The replica reports the fetch's measured bytes
+                    # and wall — fold them into the HOLDER link's
+                    # EWMA (the transfer ran holder -> winner), and
+                    # stitch the ``kv_handoff`` span into the
+                    # per-request timeline so the handoff cost is
+                    # attributed, not guessed.  The span anchors at
+                    # the winning attempt's send: the fetch runs at
+                    # admission, causally inside the send/receive
+                    # bracket.
+                    fb = resp.get("prefix_fetch_bytes")
+                    fs = resp.get("prefix_fetch_s")
+                    if isinstance(fb, int) and fb > 0 \
+                            and isinstance(fs, (int, float)) \
+                            and not isinstance(fs, bool) and fs > 0:
+                        if hint_holder is not None:
+                            hint_holder.note_link_sample(
+                                fb, float(fs))
+                        if winner.t_send is not None:
+                            note("kv_handoff", winner.t_send,
+                                 winner.t_send + float(fs),
+                                 bytes=fb,
+                                 **({"holder": hint_holder.id}
+                                    if hint_holder is not None
+                                    else {}))
                 hit_len = resp.get("prefix_hit_len")
                 if src in ("wire_fetch", "local_hot",
                            "local_spilled") \
@@ -1709,7 +1948,7 @@ class ReplicaRouter:
         rows = req.get("prompt")
         if isinstance(rows, list) and rows:
             prompt = rows[0] if isinstance(rows[0], list) else rows
-        replica, why = self._pick(prompt, set())
+        replica, why = self._pick(prompt, set(), want="prefill")
         if replica is None:
             with self._stats_lock:
                 self.shed_total += 1
@@ -2139,11 +2378,13 @@ class ReplicaRouter:
                 self.kv_fleet_handoff_failed_total += 1
             self._affinity_replace(replica.id, None)
             return
+        t0 = time.monotonic()
         status, raw = self._http_text(
             replica, "POST", "/prefix/handoff",
             body=json.dumps({"host": successor.host,
                              "port": successor.port}).encode(),
             timeout_s=timeout_s)
+        wall_s = time.monotonic() - t0
         out: Dict[str, Any] = {}
         if status == 200:
             try:
@@ -2153,6 +2394,12 @@ class ReplicaRouter:
             except ValueError:
                 pass
         sent = out.get("sent", 0) if status == 200 else 0
+        # A completed handoff is a measured transfer FROM the
+        # drainee: feed the link calibration EWMA (satellite of
+        # ROADMAP item 3 — measurements over defaults).
+        pushed = out.get("bytes")
+        if status == 200 and isinstance(pushed, int) and pushed > 0:
+            replica.note_link_sample(pushed, wall_s)
         with self._stats_lock:
             self.kv_fleet_handoffs_total += 1
             if isinstance(sent, int) and sent > 0:
@@ -2239,6 +2486,57 @@ class ReplicaRouter:
                 "evict_hints": hinted,
                 "evicted": evicted}
 
+    def _rebalance_due(self) -> bool:
+        """Cadence gate, read off the federated ``kv_host_*``
+        gauges: a rebalance pass can only move host-tier bytes, so
+        it runs only while at least TWO up replicas report host-tier
+        entries (one holder can't have a redundant copy; zero
+        holders have nothing to move).  Keeps the idle-fleet cadence
+        at one cheap /info scrape per replica instead of a full
+        /prefix/index inventory."""
+        holders = 0
+        for r in self.replicas:
+            if not r.up():
+                continue
+            status, parsed = self._http_json(r, "GET", "/info")
+            entries = parsed.get("kv_host_entries", 0) \
+                if status == 200 else 0
+            if isinstance(entries, int) and entries > 0:
+                holders += 1
+                if holders >= 2:
+                    return True
+        return False
+
+    def _rebalance_loop(self) -> None:
+        """The ``--rebalance-every`` cadence thread: drive the same
+        one-copy-somewhere pass an operator POST triggers, on a
+        timer.  ONE-FLIGHT: the cadence and operator triggers share
+        a non-blocking lock, so a slow pass is skipped, never
+        stacked; failures are logged and counted, never raised (a
+        broken rebalance must not take the cadence thread — or the
+        router — down with it)."""
+        deadline = time.monotonic() + self.rebalance_every_s
+        while not self._stop:
+            if time.monotonic() < deadline:
+                time.sleep(0.02)
+                continue
+            deadline = time.monotonic() + self.rebalance_every_s
+            if not self._rebalance_flight.acquire(blocking=False):
+                continue
+            try:
+                if not self._rebalance_due():
+                    continue
+                with self._stats_lock:
+                    self.kv_fleet_rebalance_runs_total += 1
+                self.fleet_prefix_rebalance()
+            except Exception as e:
+                with self._stats_lock:
+                    self.kv_fleet_rebalance_failed_total += 1
+                logger.warning("cadenced prefix rebalance failed: "
+                               "%s: %s", type(e).__name__, e)
+            finally:
+                self._rebalance_flight.release()
+
     def _await_healthy(self, replica: Replica,
                        timeout_s: float = 120.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -2287,6 +2585,16 @@ class ReplicaRouter:
                     self.kv_fleet_rebalances_total,
                 "kv_fleet_evict_hints_total":
                     self.kv_fleet_evict_hints_total,
+                "kv_fleet_rebalance_runs_total":
+                    self.kv_fleet_rebalance_runs_total,
+                "kv_fleet_rebalance_failed_total":
+                    self.kv_fleet_rebalance_failed_total,
+                "disagg_prefills_total":
+                    self.disagg_prefills_total,
+                "disagg_prefill_failed_total":
+                    self.disagg_prefill_failed_total,
+                "disagg_handoffs_total":
+                    self.disagg_handoffs_total,
                 "fleet_faults_applied":
                     dict(self.fleet_faults_applied),
             }
@@ -2352,6 +2660,11 @@ class ReplicaRouter:
                   "kv_fleet_handoff_failed_total",
                   "kv_fleet_rebalances_total",
                   "kv_fleet_evict_hints_total",
+                  "kv_fleet_rebalance_runs_total",
+                  "kv_fleet_rebalance_failed_total",
+                  "disagg_prefills_total",
+                  "disagg_prefill_failed_total",
+                  "disagg_handoffs_total",
                   "request_records_total"):
             counter(k, st[k])
         counter("request_records_evicted_total",
@@ -2448,6 +2761,8 @@ class ReplicaRouter:
             "affinity_max_outstanding":
                 self.affinity_max_outstanding,
             "prefix_handoff": self.prefix_handoff_enabled,
+            "disagg_min_tokens": self.disagg_min_tokens,
+            "rebalance_every_s": self.rebalance_every_s,
             **self.stats(),
         }
 

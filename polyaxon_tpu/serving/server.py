@@ -102,6 +102,13 @@ from .telemetry import (ProfileSession, Telemetry,
 
 BATCHING_MODES = ("continuous", "coalesce", "off")
 
+# Disaggregated-serving roles (docs/SERVING.md "Disaggregated
+# serving"): "both" = monolithic (the default, byte-for-byte today's
+# behavior), "prefill" = prompt prefill + wire export only (rejects
+# /generate), "decode" = full serving expected to ADMIT handed-off
+# prefills over the wire-fetch lane.
+ROLES = ("prefill", "decode", "both")
+
 
 class _PagedPrefix:
     """Radix payload for a PAGE-BACKED prefix entry: the stored
@@ -201,16 +208,29 @@ class PrefixFetchPolicy:
         self.prefill_tok_per_s = float(prefill_tok_per_s)
         self.remat_ratio = float(remat_ratio)
 
-    def should_fetch(self, n_tokens: int, nbytes: int
+    def should_fetch(self, n_tokens: int, nbytes: int, *,
+                     wire_bytes_per_s: Optional[float] = None,
+                     rtt_s: Optional[float] = None
                      ) -> Tuple[bool, str]:
         """``(ok, reason)`` — ``reason`` is the typed veto (the
-        ``prefix_fetch_failed_total{reason=}`` label) or ``"ok"``."""
+        ``prefix_fetch_failed_total{reason=}`` label) or ``"ok"``.
+
+        ``wire_bytes_per_s``/``rtt_s`` override the constructed
+        constants for ONE evaluation: the router measures each link
+        from completed fetches and handoffs (EWMA) and ships the
+        estimates inside the ``prefix_hint``, so the gate runs on
+        observed link truth instead of the static defaults whenever
+        a measurement exists (ROADMAP item 3's calibration half)."""
         if n_tokens < self.min_tokens:
             return False, "below_min_tokens"
         if nbytes > self.max_bytes:
             return False, "over_max_bytes"
+        bw = self.wire_bytes_per_s if wire_bytes_per_s is None \
+            or wire_bytes_per_s <= 0 else float(wire_bytes_per_s)
+        rtt = self.rtt_s if rtt_s is None or rtt_s < 0 \
+            else float(rtt_s)
         reprefill_s = n_tokens / self.prefill_tok_per_s
-        wire_s = (self.rtt_s + nbytes / self.wire_bytes_per_s
+        wire_s = (rtt + nbytes / bw
                   + self.remat_ratio * reprefill_s)
         if wire_s >= reprefill_s:
             return False, "wire_slower"
@@ -326,6 +346,78 @@ def _parse_prompt_rows(req, max_batch: int):
     return rows
 
 
+class FairLock:
+    """``threading.Lock`` with FIFO-ish handoff — a turnstile guards
+    entry, so a releasing thread that immediately re-acquires (the
+    continuous-batching engine's step loop does exactly this, every
+    boundary) queues BEHIND threads already waiting instead of
+    barging past them.
+
+    CPython locks are not fair: release wakes one waiter, but the
+    releasing thread can re-acquire before the waiter is scheduled.
+    Handler threads doing device work — a wire-fetch admit
+    (rematerialize + promote), a direct ``/prefill``, a solo request
+    — sit behind an engine loop that holds/releases the device lock
+    back-to-back while decodes run, and measured waits reach
+    hundreds of milliseconds per acquisition (~30x the actual device
+    work).  The turnstile bounds every waiter to roughly one
+    in-flight hold: acquire the door, then the inner lock, release
+    the door once inside — a barger must first pass the door the
+    oldest waiter still holds."""
+
+    def __init__(self):
+        self._door = threading.Lock()
+        self._inner = threading.Lock()
+        self._waiting = 0
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        if not blocking:
+            if not self._door.acquire(False):
+                return False
+            try:
+                return self._inner.acquire(False)
+            finally:
+                self._door.release()
+        # Advisory waiter count (GIL-coarse, no extra lock): the
+        # engine's window-fuse decision polls it to drop to
+        # single-step granularity while external device work waits.
+        self._waiting += 1
+        try:
+            if timeout is None or timeout < 0:
+                with self._door:
+                    return self._inner.acquire()
+            deadline = time.monotonic() + timeout
+            if not self._door.acquire(True, timeout):
+                return False
+            try:
+                rem = max(0.0, deadline - time.monotonic())
+                return self._inner.acquire(True, rem)
+            finally:
+                self._door.release()
+        finally:
+            self._waiting -= 1
+
+    def waiters(self) -> int:
+        """Threads currently blocked in :meth:`acquire` — including
+        the engine loop itself when it is between holds; callers
+        polling this from OFF-thread contexts only ever see their
+        own wait excluded."""
+        return self._waiting
+
+    def release(self) -> None:
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
 class ModelServer:
     """Wraps one model + params; owns the compile cache, the lock
     serializing device work, and the continuous-batching engine (see
@@ -346,6 +438,7 @@ class ModelServer:
                  prefix_fetch_policy: Optional[
                      "PrefixFetchPolicy"] = None,
                  prefix_fetch_timeout_s: float = 5.0,
+                 role: str = "both",
                  default_priority: str = "interactive",
                  batch_queue_depth: Optional[int] = None,
                  queue_deadline_s: Optional[float] = None,
@@ -465,8 +558,12 @@ class ModelServer:
         self.request_timeout_s = request_timeout_s
         self.draining = False
         self.drain_rejected = 0     # 503s shed at the drain gate
-        self._lock = threading.Lock() if self.sanitizer is None \
-            else self.sanitizer.wrap("device_lock")
+        # Fair handoff (FairLock): the engine's step loop re-acquires
+        # this lock at every boundary, and an unfair lock starves
+        # handler-thread device work (wire-fetch admits, /prefill,
+        # solo requests) behind it for hundreds of ms.
+        self._lock = FairLock() if self.sanitizer is None \
+            else self.sanitizer.wrap("device_lock", FairLock())
         # LRU-bounded: the key includes client-controlled sampling
         # values (temperature must stay trace-static — the greedy
         # branch is Python-level control flow), so unbounded caching
@@ -517,6 +614,32 @@ class ModelServer:
             raise ValueError(
                 f"prefix_fetch_timeout_s must be > 0; got "
                 f"{prefix_fetch_timeout_s}")
+        # DISAGGREGATED ROLES (docs/SERVING.md "Disaggregated
+        # serving"): "both" is today's monolithic replica,
+        # byte-for-byte.  "prefill" runs prompt prefill only — it
+        # serves /prefill and the /prefix/* wire lanes and rejects
+        # /generate with a typed 400, so no decode stream is ever
+        # resident and the whole pool/spill budget backs admit-ready
+        # prefixes.  "decode" is a full replica expected to pull
+        # handed-off KV over the wire-fetch lane (and to degrade to
+        # local re-prefill, counted, when a fetch fails).
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}; "
+                             f"got {role!r}")
+        if role == "prefill" and not (kv_paged and kv_host_spill_bytes):
+            raise ValueError(
+                "role='prefill' requires kv_paged AND a host spill "
+                "budget (--kv-host-spill-bytes): a prefill tier's "
+                "only product is admit-ready KV state served over "
+                "the /prefix/fetch wire lane, which packs from the "
+                "paged pool and the host tier")
+        if role == "decode" and not prefix_fetch:
+            raise ValueError(
+                "role='decode' requires prefix_fetch: a decode tier "
+                "admits handed-off prefills through the wire-fetch "
+                "lane (it still re-prefills locally, counted, when "
+                "a fetch degrades)")
+        self.role = role
         # Serving mesh ("tp=4" / MeshSpec / ServingMesh): shard the
         # slot KV pools over the mesh and place params under
         # NamedSharding (serving/meshed.py — the exact layout, so
@@ -1952,8 +2075,22 @@ class ModelServer:
         if not host or not port:
             self._note_fetch_failed("bad_hint")
             return None
+        # Router-measured link estimates ride the hint (EWMA over
+        # completed fetches/handoffs + probe RTTs): when present the
+        # cost gate runs on observed truth for this link instead of
+        # the policy's static defaults.
+        def _est(key):
+            v = hint.get(key)
+            try:
+                return None if v is None else float(v)
+            except (TypeError, ValueError):
+                return None
+
+        link_bw = _est("wire_bytes_per_s")
+        link_rtt = _est("rtt_s")
         n_tokens = int(toks.shape[1])
-        ok, why = self.fetch_policy.should_fetch(n_tokens, 0)
+        ok, why = self.fetch_policy.should_fetch(
+            n_tokens, 0, wire_bytes_per_s=link_bw, rtt_s=link_rtt)
         if not ok:
             self._note_fetch_failed(why)
             return None
@@ -1984,8 +2121,9 @@ class ModelServer:
                 # The policy's second look, on the TRUE size, before
                 # the body transfer: a veto here has paid one RTT and
                 # headers, nothing more.
-                ok, why = self.fetch_policy.should_fetch(n_tokens,
-                                                         nbytes)
+                ok, why = self.fetch_policy.should_fetch(
+                    n_tokens, nbytes, wire_bytes_per_s=link_bw,
+                    rtt_s=link_rtt)
                 if not ok:
                     self._note_fetch_failed(why)
                     return None
@@ -2183,6 +2321,15 @@ class ModelServer:
         # saw readiness drop; anything still arriving gets the
         # structured 503 immediately.
         self._check_not_draining()
+        if self.role == "prefill":
+            # A role-split fleet never routes /generate here (the
+            # router's capability filter excludes prefill replicas);
+            # a direct caller gets the typed 400 rather than a decode
+            # stream quietly competing with the prefill tier.
+            raise ValueError(
+                "this replica runs role='prefill': it serves "
+                "/prefill and /prefix/* only — send /generate to a "
+                "decode-capable replica (role 'decode' or 'both')")
         rows = _parse_prompt_rows(req, self.max_batch)
         lens = [len(r) for r in rows]
         _int = _int_param
@@ -2704,6 +2851,14 @@ class ModelServer:
             # source" column both read it.
             **({"prefix_source": prefix_source}
                if self._prefix_enabled else {}),
+            # Wire-fetch measurement for the router's link
+            # calibration (EWMA wire_bytes_per_s): the observed
+            # payload size + wall time of the fetch that served this
+            # request, straight from its span.
+            **({"prefix_fetch_bytes": fetch_events[0][3]["bytes"],
+                "prefix_fetch_s": round(
+                    fetch_events[0][2] - fetch_events[0][1], 6)}
+               if fetch_events else {}),
             **({"timings": timings} if timings is not None else {}),
         }
 
@@ -2808,6 +2963,7 @@ class ModelServer:
                 "backend": jax.default_backend(),
                 "max_batch": self.max_batch,
                 "batching": self.batching,
+                "role": self.role,
                 "spec_k_default": self.spec_k_default,
                 "default_priority": self.default_priority,
                 # Engine-less modes still drain (solo/coalesce paths
@@ -3425,8 +3581,12 @@ def make_handler(ms: ModelServer):
                         **({"supervisor": ms.supervisor.status()}
                            if ms.supervisor is not None else {})})
                 else:
+                    # ``role`` rides the 200 body so the router's
+                    # probe loop learns the fleet's prefill/decode
+                    # split without an extra /info round trip.
                     self._send(200, {"status": "ok",
-                                     "model": ms.model_name})
+                                     "model": ms.model_name,
+                                     "role": ms.role})
             elif self.path == "/info":
                 self._send(200, ms.info())
             elif self.path == "/metrics":

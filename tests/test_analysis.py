@@ -907,6 +907,76 @@ def test_socket_timeout_explicit_timeouts_pass():
         == []
 
 
+# -- WIRE-VERIFY ------------------------------------------------------------
+
+
+def test_wire_verify_flags_unverified_payload_decode():
+    """The fleet-wire admission contract: a hand-rolled decode of
+    wire bytes (np.frombuffer) in a function with no checksum
+    verify admits whatever a torn transfer handed it — silently
+    wrong KV instead of the typed payload_integrity degrade."""
+    src = """
+    import json
+    import struct
+
+    import numpy as np
+
+    def admit_handoff(self, blob):
+        (hlen,) = struct.unpack(">I", blob[:4])
+        header = json.loads(blob[4:4 + hlen])
+        body = blob[4 + hlen:]
+        return np.frombuffer(body, dtype=header["dtype"])
+    """
+    assert _rules(src) == ["WIRE-VERIFY"]
+
+
+def test_wire_verify_checksum_or_unpack_spilled_pass():
+    """A crc32 verify in the same function clears the decode; so
+    does admitting through unpack_spilled (the canonical verifying
+    decoder) — and a closure decodes under its ENCLOSING function's
+    verify (one body, one payload)."""
+    src = """
+    import json
+    import struct
+    import zlib
+
+    import numpy as np
+
+    def admit_verified(self, blob):
+        (hlen,) = struct.unpack(">I", blob[:4])
+        header = json.loads(blob[4:4 + hlen])
+        body = blob[4 + hlen:]
+        if zlib.crc32(body) & 0xFFFFFFFF != header["crc32"]:
+            raise WirePayloadError("checksum mismatch")
+        return np.frombuffer(body, dtype=header["dtype"])
+
+    def admit_canonical(self, blob):
+        return unpack_spilled(blob)
+
+    def admit_closure(self, blob, header, body):
+        if zlib.crc32(body) & 0xFFFFFFFF != header["crc32"]:
+            raise WirePayloadError("checksum mismatch")
+
+        def _take(off, n, dtype):
+            return np.frombuffer(body[off:off + n], dtype=dtype)
+
+        return _take(0, header["nbytes"], header["dtype"])
+    """
+    assert _rules(src) == []
+
+
+def test_wire_verify_scoped_to_serving():
+    """frombuffer outside serving/ (checkpoint loaders, analysis
+    tooling) is not wire admission — out of scope."""
+    src = """
+    import numpy as np
+
+    def load(self, raw):
+        return np.frombuffer(raw, dtype=np.float32)
+    """
+    assert _rules(src, "polyaxon_tpu/checkpoint/io.py") == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 

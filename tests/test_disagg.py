@@ -1,0 +1,552 @@
+"""Disaggregated prefill/decode serving proof obligations (PR 17:
+role-split replicas with admit-ready KV handoff over the fleet wire).
+
+THE pins:
+
+- ROLES: ``role`` validation (prefill needs the paged host tier,
+  decode needs the fetch lane), the /healthz + /info surfaces the
+  router learns the fleet shape from, and the typed 400 a prefill
+  replica answers /generate with.
+- TWO-STAGE SCHEDULE: a long prompt on a role-split fleet prefills
+  on the prefill tier and decodes on a decode replica that ADMITS
+  the KV over the wire lane (``prefix_source == "wire_fetch"``),
+  with ``prefill_remote`` + ``kv_handoff`` stitched into the
+  router's per-request timeline.
+- BITWISE IDENTITY: disaggregated == monolithic token streams per
+  seed across plain / sampled / speculative, and ZERO steady-state
+  recompiles on either tier once the lanes are warm.
+- DEGRADE LADDER: a dead prefill tier degrades to decode-side
+  re-prefill (counted, never a request failure); a dead decode
+  replica fails over to another DECODE-capable replica — never to
+  the prefill tier.
+- CALIBRATION (satellite): per-link wire_bytes_per_s / rtt_s EWMAs
+  from completed fetches, handoffs and probes; shipped in prefix
+  hints; consumed by the cost gate as overrides.
+- REBALANCE CADENCE (satellite): ``rebalance_every_s`` drives the
+  one-copy-somewhere pass off the federated kv_host gauges —
+  one-flight, failures counted, gate respected.
+- COLD-POOL RACE (satellite): two handoffs racing a fresh replica's
+  unshaped pool allocate exactly ONE pool.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.gpt2 import GPT2Config, GPT2Model
+from polyaxon_tpu.serving import (LocalReplica, ModelServer,
+                                  PrefixFetchPolicy, ReplicaRouter,
+                                  make_router_server)
+from polyaxon_tpu.serving.paged import PagedSlotKVManager
+from polyaxon_tpu.serving.router import Replica
+
+SYS_LEN, USER_LEN, NEW = 24, 4, 4
+
+# ---------------------------------------------------------------------------
+# fixtures (the test_fleet_prefix.py fleet idiom, plus per-replica
+# roles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), vocab_size=32, hidden_size=32,
+        num_layers=2, num_heads=2, max_position=64,
+        dtype=jnp.float32)
+    model = GPT2Model(cfg=cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _factory(small_model, **kw):
+    model, variables = small_model
+    kw.setdefault("prefix_cache", 8)
+    kw.setdefault("kv_paged", True)
+    kw.setdefault("kv_page_tokens", 8)
+    kw.setdefault("kv_pages", 32)
+    kw.setdefault("kv_host_spill_bytes", 1 << 20)
+    kw.setdefault("prefix_fetch", True)
+    # prefill_tok_per_s=1: re-prefill priced astronomically, so the
+    # cost gate keeps choosing the wire even after link calibration
+    # measures the loopback truth (tiny-model re-prefill really IS
+    # cheaper — the gate vetoing it is correct, just not what these
+    # handoff-path pins exercise).
+    kw.setdefault("prefix_fetch_policy",
+                  PrefixFetchPolicy(min_tokens=1,
+                                    prefill_tok_per_s=1.0))
+
+    def make():
+        return ModelServer(
+            model, variables, model_name="tiny", max_batch=4,
+            n_slots=2, queue_depth=16, decode_window=2,
+            draft_model=model, draft_variables=variables, **kw)
+    return make
+
+
+def _spawn_roles(small_model, roles, *, router_kw=None):
+    """A fleet with one replica per entry of ``roles``; waits until
+    the router's probes have LEARNED every role (the discovery path
+    the tentpole specifies — no out-of-band configuration)."""
+    reps = [LocalReplica(_factory(small_model, role=role), f"r{i}")
+            for i, role in enumerate(roles)]
+    kw = dict(probe_interval_s=0.1, probe_timeout_s=0.5,
+              cooldown_s=0.2, request_timeout_s=60.0)
+    kw.update(router_kw or {})
+    router = ReplicaRouter(reps, **kw)
+    srv = make_router_server("127.0.0.1", 0, router)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if [r.role for r in router.replicas] == list(roles):
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError(
+            f"router never learned roles {roles}: "
+            f"{[r.role for r in router.replicas]}")
+    return base, router, srv, reps
+
+
+def _teardown(router, srv, reps):
+    router.close()
+    srv.shutdown()
+    srv.server_close()
+    for r in reps:
+        r.close()
+
+
+@pytest.fixture(scope="module")
+def disagg_fleet(small_model):
+    """Shared non-destructive role-split fleet: one prefill replica,
+    two decode replicas (the bench topology)."""
+    base, router, srv, reps = _spawn_roles(
+        small_model, ["prefill", "decode", "decode"])
+    yield base, router, srv, reps
+    _teardown(router, srv, reps)
+
+
+def _post(base, payload, timeout=120, path="/generate"):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_json(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        assert r.status == 200
+        return json.loads(r.read())
+
+
+def _prompt(seed, n=SYS_LEN + USER_LEN):
+    return np.random.RandomState(seed).randint(
+        0, 32, size=n).tolist()
+
+
+# ---------------------------------------------------------------------------
+# roles: validation + surfaces + the prefill tier's typed 400
+# ---------------------------------------------------------------------------
+
+
+def test_role_validation(small_model):
+    with pytest.raises(ValueError, match="role"):
+        _factory(small_model, role="router")()
+    # A prefill tier's only product is admit-ready KV over the wire
+    # lane: without the paged host tier it can produce nothing.
+    with pytest.raises(ValueError, match="prefill"):
+        _factory(small_model, role="prefill", kv_paged=False,
+                 kv_host_spill_bytes=0, prefix_fetch=False,
+                 prefix_fetch_policy=None)()
+    # A decode tier that cannot fetch can never admit a handoff.
+    with pytest.raises(ValueError, match="decode"):
+        _factory(small_model, role="decode", prefix_fetch=False,
+                 prefix_fetch_policy=None)()
+
+
+def test_role_surfaces_and_prefill_rejects_generate(disagg_fleet):
+    _, router, _, reps = disagg_fleet
+    pre = reps[0]
+    # /healthz and /info both advertise the role (the router's two
+    # discovery surfaces), and describe() re-exports what it learned.
+    assert _get_json(pre.url, "/healthz")["role"] == "prefill"
+    assert _get_json(pre.url, "/info")["role"] == "prefill"
+    assert _get_json(reps[1].url, "/healthz")["role"] == "decode"
+    st = router.stats()
+    assert {r["id"]: r["role"] for r in st["replicas"]} == {
+        "r0": "prefill", "r1": "decode", "r2": "decode"}
+    # Direct /generate against the prefill tier: typed 400, not a
+    # decode stream quietly competing with prefill work.
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(pre.url, {"prompt": _prompt(1),
+                        "max_new_tokens": NEW})
+    assert exc.value.code == 400
+    body = json.loads(exc.value.read())
+    assert "prefill" in body["error"]
+    # /prefill still works — it is the tier's entire job.
+    out = _post(pre.url, {"prompt": _prompt(2)}, path="/prefill")
+    assert out["cached_len"] == SYS_LEN + USER_LEN
+
+
+# ---------------------------------------------------------------------------
+# the two-stage schedule: handoff admission, timeline, identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode_kw, wired_source", [
+    ({}, "wire_fetch"),
+    ({"temperature": 0.9, "top_k": 8, "seed": 11}, "wire_fetch"),
+    # Speculative requests stay cold BY DESIGN (spec rolls the cache
+    # back, so the prefix path gates on ``not speculative``): the
+    # disagg arm re-prefills, and the pin is pure token identity.
+    ({"speculative": True, "spec_k": 2}, "re_prefill"),
+], ids=["greedy", "sampled", "spec"])
+def test_disagg_two_stage_bitwise_identity(disagg_fleet, small_model,
+                                           mode_kw, wired_source):
+    base, router, _, reps = disagg_fleet
+    seed = 400 + len(mode_kw)
+    body = {"prompt": _prompt(seed), "max_new_tokens": NEW,
+            **mode_kw}
+    pre_prefills = router.disagg_prefills_total
+    resp = _post(base, dict(body))
+    assert resp["prefix_source"] == wired_source
+    assert router.disagg_prefills_total == pre_prefills + 1
+    assert router.disagg_prefill_failed_total == 0
+    # Decode placement: stage 2 must land on a decode replica.
+    assert resp["router"]["replica"] in ("r1", "r2")
+    rec = router.fleet_request(resp["request_id"])
+    events = [e.get("event") for e in rec["timeline"]]
+    assert "prefill_remote" in events
+    if wired_source == "wire_fetch":
+        # Admit-ready handoff: measured bytes + wall in the response,
+        # the kv_handoff span in the timeline, and the holder link's
+        # calibration EWMA seeded from the SAME measurement.
+        assert resp["prefix_fetch_bytes"] > 0
+        assert resp["prefix_fetch_s"] > 0
+        assert "kv_handoff" in events
+        assert router.replicas[0].wire_bytes_per_s is not None
+    # MONOLITHIC reference arms: the same request served by a
+    # stand-alone both-role replica, locally (no fleet tier at all).
+    mono = LocalReplica(_factory(small_model), "mono")
+    try:
+        ref = _post(mono.url, dict(body))
+        assert ref["new_tokens"] == resp["new_tokens"]
+    finally:
+        mono.close()
+
+
+def test_disagg_warm_prefix_skips_stage_one(disagg_fleet):
+    """Land the handoff where the prefix already lives: a prompt
+    whose KV sits warm on a routable decode replica routes there by
+    affinity — no second remote prefill, no second handoff."""
+    base, router, _, _ = disagg_fleet
+    body = {"prompt": _prompt(77), "max_new_tokens": NEW}
+    first = _post(base, dict(body))
+    assert first["prefix_source"] == "wire_fetch"
+    pre_prefills = router.disagg_prefills_total
+    second = _post(base, dict(body))
+    assert second["prefix_source"] in ("local_hot", "local_spilled")
+    assert second["router"]["replica"] == first["router"]["replica"]
+    assert router.disagg_prefills_total == pre_prefills
+
+
+def test_disagg_zero_steady_state_recompiles(small_model):
+    """Both tiers compile during warmup and NEVER again in steady
+    state (1 prefill + 1 decode so placement is deterministic)."""
+    base, router, srv, reps = _spawn_roles(
+        small_model, ["prefill", "decode"])
+    try:
+        for lane_seed, mode_kw in ((500, {}),
+                                   (501, {"temperature": 0.9,
+                                          "seed": 3}),
+                                   (502, {"speculative": True,
+                                          "spec_k": 2})):
+            _post(base, {"prompt": _prompt(lane_seed),
+                         "max_new_tokens": NEW, **mode_kw})
+        warm = {r.id: r.ms.recompile.snapshot()["compile_cache_misses"]
+                for r in reps}
+        for lane_seed, mode_kw in ((510, {}),
+                                   (511, {"temperature": 0.9,
+                                          "seed": 3}),
+                                   (512, {"speculative": True,
+                                          "spec_k": 2})):
+            _post(base, {"prompt": _prompt(lane_seed),
+                         "max_new_tokens": NEW, **mode_kw})
+        steady = {
+            r.id: r.ms.recompile.snapshot()["compile_cache_misses"]
+            - warm[r.id] for r in reps}
+        assert steady == {"r0": 0, "r1": 0}
+    finally:
+        _teardown(router, srv, reps)
+
+
+# ---------------------------------------------------------------------------
+# degrade ladder + capability-filtered failover
+# ---------------------------------------------------------------------------
+
+
+def test_dead_prefill_degrades_to_decode_re_prefill(small_model):
+    """Stage-1 failure is COUNTED, never a request failure: the
+    decode side re-prefills."""
+    dead_pre = Replica("http://127.0.0.1:9", "pre")
+    dead_pre.role = "prefill"
+    live = LocalReplica(_factory(small_model, role="decode"), "dec")
+    live.role = "decode"
+    router = ReplicaRouter([dead_pre, live], autostart=False,
+                           request_timeout_s=60.0)
+    try:
+        code, resp = router.route_generate(
+            {"prompt": _prompt(600), "max_new_tokens": NEW})
+        assert code == 200
+        assert resp["prefix_source"] == "re_prefill"
+        assert resp["router"]["replica"] == "dec"
+        assert router.disagg_prefills_total == 1
+        assert router.disagg_prefill_failed_total == 1
+    finally:
+        router.close()
+        live.close()
+
+
+def test_dead_decode_fails_over_to_decode_never_prefill(small_model):
+    """resume_tokens failover across the split: the retry loop is
+    capability-filtered, so a decode death lands on another DECODE
+    replica — the prefill tier is never a failover target."""
+    pre = LocalReplica(_factory(small_model, role="prefill"), "pre")
+    pre.role = "prefill"
+    dead = Replica("http://127.0.0.1:9", "d0")
+    dead.role = "decode"
+    live = LocalReplica(_factory(small_model, role="decode"), "d1")
+    live.role = "decode"
+    router = ReplicaRouter([pre, dead, live], autostart=False,
+                           request_timeout_s=60.0)
+    # Bias the first pick toward the dead decode replica
+    # (least-outstanding): the request must fail over to d1.
+    live.inc_outstanding()
+    try:
+        code, resp = router.route_generate(
+            {"prompt": _prompt(601), "max_new_tokens": NEW})
+        assert code == 200
+        assert resp["router"]["replica"] == "d1"
+        assert router.failovers_total == 1
+        rec = router.history.get(resp["request_id"])
+        assert "pre" not in rec["replicas"]
+    finally:
+        router.close()
+        pre.close()
+        live.close()
+
+
+def test_pick_capability_filter():
+    """want='decode' is a HARD filter (a prefill replica 400s
+    /generate); want='prefill' is a SOFT preference (every role
+    serves /prefill, so an all-decode fleet still routes it)."""
+    a = Replica("http://127.0.0.1:1", "a")
+    b = Replica("http://127.0.0.1:2", "b")
+    a.role = "prefill"
+    b.role = "decode"
+    router = ReplicaRouter([a, b], autostart=False)
+    try:
+        assert router._pick(None, set(), want="decode")[0] is b
+        assert router._pick(None, set(), want="prefill")[0] is a
+        assert router._pick(None, set())[0] is not None
+        # Soft fallback: no prefill-capable replica in rotation.
+        a.role = "decode"
+        assert router._pick(None, set(), want="prefill")[0] \
+            is not None
+        # Hard filter: no decode-capable replica -> none, even though
+        # the prefill replica is healthy.
+        a.role = b.role = "prefill"
+        assert router._pick(None, set(), want="decode") \
+            == (None, "none")
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# link calibration (satellite): EWMAs, hints, cost-gate overrides
+# ---------------------------------------------------------------------------
+
+
+def test_link_ewma_seed_update_and_estimates():
+    r = Replica("http://127.0.0.1:1", "r0")
+    assert r.link_estimates() == {}
+    # Tiny payloads SEED but never update (RTT-dominated).
+    r.note_link_sample(100, 0.01)            # seeds 10 KB/s
+    assert r.wire_bytes_per_s == pytest.approx(1e4)
+    r.note_link_sample(100, 1e-6)
+    assert r.wire_bytes_per_s == pytest.approx(1e4)
+    r.note_link_sample(1 << 20, 0.001)       # big payload: EWMA
+    assert r.wire_bytes_per_s > 1e4
+    r.note_rtt_sample(0.010)
+    r.note_rtt_sample(0.020)
+    assert 0.010 < r.rtt_s < 0.020
+    est = r.link_estimates()
+    assert set(est) == {"wire_bytes_per_s", "rtt_s"}
+    assert "wire_bytes_per_s" in r.describe()
+
+
+def test_fetch_policy_measured_overrides():
+    p = PrefixFetchPolicy(min_tokens=1)
+    # Static defaults say fetch; a MEASURED slow link flips the gate.
+    assert p.should_fetch(64, 1 << 20) == (True, "ok")
+    ok, why = p.should_fetch(64, 1 << 20, wire_bytes_per_s=1e3)
+    assert (ok, why) == (False, "wire_slower")
+    # And a measured fast link rescues a slow-default policy.
+    slow = PrefixFetchPolicy(min_tokens=1, wire_bytes_per_s=1e3)
+    assert slow.should_fetch(64, 1 << 20)[0] is False
+    assert slow.should_fetch(64, 1 << 20,
+                             wire_bytes_per_s=1e9) == (True, "ok")
+    # Degenerate overrides fall back to the static defaults.
+    assert p.should_fetch(64, 1 << 20,
+                          wire_bytes_per_s=0.0) == (True, "ok")
+
+
+def test_probe_learns_rtt(disagg_fleet):
+    _, router, _, _ = disagg_fleet
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if all(r.rtt_s is not None for r in router.replicas):
+            break
+        time.sleep(0.02)
+    assert all(r.rtt_s is not None and r.rtt_s > 0
+               for r in router.replicas)
+
+
+# ---------------------------------------------------------------------------
+# rebalance cadence (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rebalance_cadence_runs_and_counts_failures():
+    a = Replica("http://127.0.0.1:1", "a")
+    router = ReplicaRouter([a], autostart=False,
+                           probe_interval_s=60.0,
+                           rebalance_every_s=0.05)
+    ran = threading.Event()
+
+    def fake_due():
+        return True
+
+    def boom():
+        ran.set()
+        raise RuntimeError("scrape exploded")
+
+    router._rebalance_due = fake_due
+    router.fleet_prefix_rebalance = boom
+    router.start()
+    try:
+        assert ran.wait(5.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and router.kv_fleet_rebalance_failed_total == 0:
+            time.sleep(0.01)
+        assert router.kv_fleet_rebalance_runs_total >= 1
+        assert router.kv_fleet_rebalance_failed_total >= 1
+    finally:
+        router.close()
+
+
+def test_rebalance_cadence_gate_blocks_pointless_passes():
+    a = Replica("http://127.0.0.1:1", "a")
+    router = ReplicaRouter([a], autostart=False,
+                           probe_interval_s=60.0,
+                           rebalance_every_s=0.05)
+    called = []
+    router._rebalance_due = lambda: False
+    router.fleet_prefix_rebalance = lambda: called.append(1)
+    router.start()
+    try:
+        time.sleep(0.3)
+        assert called == []
+        assert router.kv_fleet_rebalance_runs_total == 0
+    finally:
+        router.close()
+    with pytest.raises(ValueError, match="rebalance_every_s"):
+        ReplicaRouter([Replica("http://127.0.0.1:1", "x")],
+                      autostart=False, rebalance_every_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# cold-pool concurrent first-touch (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_ensure_shaped_concurrent_first_touch(small_model):
+    """Two handoffs racing a FRESH replica's unshaped pool: exactly
+    one allocation, one pool — the loser must observe the winner's
+    pool, never replace it (a replaced pool silently drops every
+    page the winner already wrote)."""
+    model, variables = small_model
+    mgr = PagedSlotKVManager(model, variables, 2, page_tokens=8,
+                             n_pages=32, max_position=64,
+                             decode_window=2)
+    tokens = jnp.zeros((1, 1), jnp.int32)
+    template = jax.eval_shape(
+        # Shape probe under eval_shape (nothing is ever drawn from
+        # this key).  # ptpu: ignore[RNG-DET]
+        lambda: model.init(jax.random.PRNGKey(0), tokens,
+                           decode=True, decode_position=0))["cache"]
+    orig_alloc = mgr._alloc_pool
+    allocs = []
+
+    def slow_alloc(metas):
+        # Widen the race window: without the shape lock both racers
+        # sit in here and the second allocation REPLACES the first.
+        allocs.append(threading.get_ident())
+        time.sleep(0.1)
+        return orig_alloc(metas)
+
+    mgr._alloc_pool = slow_alloc
+    barrier = threading.Barrier(2)
+    pools = []
+
+    def first_touch():
+        barrier.wait()
+        mgr.ensure_shaped(template)
+        pools.append(mgr._pool)
+
+    threads = [threading.Thread(target=first_touch)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(allocs) == 1
+    assert len(pools) == 2 and pools[0] is pools[1]
+    assert mgr.shaped and mgr._pool is not None
+
+
+# ---------------------------------------------------------------------------
+# observability: the new families render (no-drift)
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_and_rebalance_families_render():
+    router = ReplicaRouter([Replica("http://127.0.0.1:1", "a")],
+                           autostart=False)
+    try:
+        st = router.stats()
+        text = router.metrics_text()
+        for fam in ("disagg_prefills_total",
+                    "disagg_prefill_failed_total",
+                    "disagg_handoffs_total",
+                    "kv_fleet_rebalance_runs_total",
+                    "kv_fleet_rebalance_failed_total"):
+            assert fam in st
+            assert f"ptpu_router_{fam}" in text
+        assert router.info()["disagg_min_tokens"] == 16
+        assert router.info()["rebalance_every_s"] == 0.0
+    finally:
+        router.close()
