@@ -454,6 +454,10 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         model, variables, model_name, vocab, shapes,
         n_slots=n_slots, n_short=n_short, n_long=n_long,
         requests=requests, queue_depth=4 * (n_short + n_long))
+    forensics = bench_forensics_overhead(
+        model, variables, model_name, vocab, shapes,
+        n_slots=n_slots, n_short=n_short, n_long=n_long,
+        requests=requests, queue_depth=4 * (n_short + n_long))
     faults = bench_faults_overhead(
         model, variables, model_name, vocab, shapes,
         n_slots=n_slots, n_short=n_short, n_long=n_long,
@@ -514,6 +518,7 @@ def bench_serving_load(jax, model_name: str, backend: str, *,
         **telemetry,
         **recorder,
         **debug,
+        **forensics,
         **faults,
         **chaos,
         **fleet,
@@ -757,6 +762,42 @@ def bench_debug_overhead(model, variables, model_name: str,
           f"off={best['off']} tok/s -> {row['overhead_pct']}% "
           f"(noise {noise['noise_pct']}%)", file=sys.stderr)
     return {"debug_overhead": row}
+
+
+def bench_forensics_overhead(model, variables, model_name: str,
+                             vocab: int, shapes, *, n_slots: int,
+                             n_short: int, n_long: int,
+                             requests: int, queue_depth: int):
+    """Forensics-overhead A/B: the SAME greedy mix with the
+    tail-latency forensics layer ARMED (per-request phase ledger
+    computed at every terminal boundary, histogram exemplar capture
+    on every latency observation, anomaly sentry fed per request —
+    the defaults) vs OFF (``forensics=False``: no ledger, no
+    exemplars, no sentry), through the drift-robust alternating
+    harness (:func:`_overhead_ab`).  Both arms carry the same
+    ``request_history=512`` so the A/B isolates the forensics tax
+    from the history ring the debug leg already prices.  Asserts the
+    layer stays under the same ~3% agg tok/s contract
+    (docs/SERVING.md "Tail-latency forensics") — the ledger is one
+    integer-microsecond sweep over span tuples the timings path
+    already collected, exemplar capture is one bounded-deque append
+    per histogram observation, and the sentry is dict arithmetic at
+    window boundaries; none of it touches the device lock."""
+    best, noise, _ = _overhead_ab(
+        model, variables, model_name, vocab, shapes,
+        arm_kwargs={"on": dict(forensics=True, request_history=512),
+                    "off": dict(forensics=False,
+                                request_history=512)},
+        n_slots=n_slots, n_short=n_short, n_long=n_long,
+        requests=requests, queue_depth=queue_depth,
+        label="forensics-overhead")
+    if not best:
+        return {}
+    row = _overhead_row(best, noise)
+    print(f"# forensics-layer overhead: on={best['on']} "
+          f"off={best['off']} tok/s -> {row['overhead_pct']}% "
+          f"(noise {noise['noise_pct']}%)", file=sys.stderr)
+    return {"forensics_overhead": row}
 
 
 def bench_faults_overhead(model, variables, model_name: str,
@@ -3267,6 +3308,7 @@ def main() -> int:
             or "telemetry_overhead" not in r \
             or "recorder_overhead" not in r \
             or "debug_overhead" not in r \
+            or "forensics_overhead" not in r \
             or "faults_overhead" not in r \
             or "chaos" not in r \
             or "fleet" not in r \
@@ -3297,6 +3339,7 @@ def main() -> int:
     for leg, what in (("telemetry_overhead", "telemetry-on"),
                       ("recorder_overhead", "flight-recorder"),
                       ("debug_overhead", "debug-layer"),
+                      ("forensics_overhead", "forensics-layer"),
                       ("faults_overhead", "fault-probe")):
         sub = r.get(leg, {})
         ov = sub.get("overhead_pct")

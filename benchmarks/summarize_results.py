@@ -221,6 +221,16 @@ def debug_value(r):
     return _overhead_pct(r.get("debug_overhead") or {})
 
 
+def forensics_value(r):
+    """serving-load rows: the forensics-overhead A/B column — the
+    phase-ledger + exemplar-capture + anomaly-sentry tax in % agg
+    tok/s with the layer armed at defaults (same <= ~3% contract as
+    telemetry, the recorder, and the debug ring; both arms carry the
+    same history ring so the number isolates the forensics layer).
+    Empty for every other bench."""
+    return _overhead_pct(r.get("forensics_overhead") or {})
+
+
 def chaos_value(r):
     """serving-load rows: the chaos-soak column — terminal-status
     accounting under the seeded fault storm (ok / poisoned
@@ -354,10 +364,10 @@ def main() -> int:
                 if r.get("backend") in ("tpu", "tpu-compile-only")]
     print("| bench | model | variant | batch | backend | value | unit "
           "| spec-mix | paged | lazy | spill | fleetpfx | disagg "
-          "| mesh | telemetry | recorder | debug | chaos | fleet "
-          "| fleetobs | overload | mfu | age |")
+          "| mesh | telemetry | recorder | debug | forensics | chaos "
+          "| fleet | fleetobs | overload | mfu | age |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|---|---|---|---|---|---|---|")
+          "---|---|---|---|---|---|---|---|---|---|")
     now = time.time()
     for r in rows:
         v, unit = headline_value(r)
@@ -383,6 +393,7 @@ def main() -> int:
               f"| {telemetry_value(r)} "
               f"| {recorder_value(r)} "
               f"| {debug_value(r)} "
+              f"| {forensics_value(r)} "
               f"| {chaos_value(r)} "
               f"| {fleet_value(r)} "
               f"| {fleetobs_value(r)} "

@@ -40,6 +40,10 @@ sys.path.insert(0, os.path.join(REPO, "benchmarks"))
 from bench_serving_load import percentile as pctl  # noqa: E402
 from polyaxon_tpu.serving.debug import \
     parse_replica_rid  # noqa: E402
+from polyaxon_tpu.serving.forensics import (PHASES,  # noqa: E402
+                                            compute_ledger,
+                                            is_solo_events,
+                                            ledger_shares)
 from polyaxon_tpu.serving.telemetry import (ENGINE_PID,  # noqa: E402
                                             REQUESTS_PID,
                                             load_trace_events)
@@ -225,6 +229,82 @@ def attribution_stats(report):
         "host_gap_strip": "".join(
             str(min(9, round(9 * r["host_gap_share"])))
             for r in rows),
+    }
+
+
+def ledger_attribution(events, top_n: int = 3):
+    """Offline phase-ledger attribution over a whole trace: rebuild
+    every request's span tuples from the request track, run the SAME
+    ``compute_ledger`` the serving path uses (one enum, one sweep —
+    the partition pin holds offline too), and rank phases by their
+    mean share of request wall time — with each phase's worst
+    offender requests, so "the fleet is slow because of X, and here
+    are the requests to pull" reads off a saved trace with no server
+    running.
+
+    Returns None when the trace has no rid-tagged request events."""
+    by_rid = {}
+    for ev in events:
+        if ev.get("pid") != REQUESTS_PID:
+            continue
+        rid = ev.get("args", {}).get("rid")
+        if rid is None:
+            continue
+        if ev.get("ph") == "X":
+            tup = (ev["name"], ev["ts"] / 1e6,
+                   (ev["ts"] + ev.get("dur", 0)) / 1e6,
+                   ev.get("args", {}))
+        elif ev.get("ph") == "i":
+            tup = (ev["name"], ev["ts"] / 1e6, ev["ts"] / 1e6,
+                   ev.get("args", {}))
+        else:
+            continue
+        by_rid.setdefault(rid, []).append(tup)
+    if not by_rid:
+        return None
+    per_request = {}
+    share_sum = {ph: 0.0 for ph in PHASES}
+    for rid, evs in by_rid.items():
+        evs.sort(key=lambda e: e[1])
+        t0 = min(e[1] for e in evs)
+        t1 = max(e[2] for e in evs)
+        ledger = compute_ledger(
+            evs, t0, t1, solo=is_solo_events(e[0] for e in evs))
+        per_request[rid] = ledger
+        for ph, sh in ledger_shares(ledger).items():
+            share_sum[ph] = share_sum.get(ph, 0.0) + sh
+    n = len(per_request)
+    ranked = []
+    for ph in PHASES:
+        mean = share_sum.get(ph, 0.0) / n
+        if mean <= 0:
+            continue
+        worst = sorted(
+            ((ledger_shares(led).get(ph, 0.0), rid,
+              float(led.get("wall_s") or 0.0))
+             for rid, led in per_request.items()),
+            reverse=True)[:top_n]
+        ranked.append({
+            "phase": ph,
+            "mean_share": round(mean, 4),
+            "worst_requests": [
+                {"request_id": rid, "share": round(sh, 4),
+                 "wall_s": round(w, 6)}
+                for sh, rid, w in worst if sh > 0],
+        })
+    ranked.sort(key=lambda r: -r["mean_share"])
+    dominant = {}
+    for led in per_request.values():
+        d = led.get("dominant")
+        dominant[d] = dominant.get(d, 0) + 1
+    return {
+        "requests": n,
+        "wall_total_s": round(sum(
+            float(led.get("wall_s") or 0.0)
+            for led in per_request.values()), 6),
+        "phases": ranked,
+        "dominant_counts": dict(sorted(
+            dominant.items(), key=lambda kv: -kv[1])),
     }
 
 
@@ -447,9 +527,37 @@ def main() -> int:
                          "stitched cross-tier timeline): render the "
                          "attempt table, replica segments, and the "
                          "merged causal timeline")
+    ap.add_argument("--attribute", action="store_true",
+                    help="phase-ledger attribution: run the serving "
+                         "stack's OWN compute_ledger over every "
+                         "request in the trace and rank phases by "
+                         "mean share of request wall time, with "
+                         "each phase's worst offender requests")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
+    if args.attribute:
+        att = ledger_attribution(load_trace_events(args.trace))
+        if att is None:
+            print(f"no rid-tagged request events in {args.trace} "
+                  f"(was the server traced with requests in "
+                  f"flight?)", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(att, indent=2))
+            return 0
+        print(f"# phase attribution: {att['requests']} requests, "
+              f"{att['wall_total_s']}s total request wall")
+        print("\n| phase | mean share | worst requests |")
+        print("|---|---|---|")
+        for r in att["phases"]:
+            worst = "; ".join(
+                f"{w['request_id']} ({w['share']})"
+                for w in r["worst_requests"])
+            print(f"| {r['phase']} | {r['mean_share']} | {worst} |")
+        print("\ndominant phase by request: " + ", ".join(
+            f"{ph}={n}" for ph, n in att["dominant_counts"].items()))
+        return 0
     if args.fleet:
         with open(args.trace) as f:
             fr = fleet_report(json.load(f))

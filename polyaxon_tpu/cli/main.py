@@ -629,6 +629,26 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--stall-dir", default=".", type=click.Path(),
               help="With --stall-timeout: directory stall bundles "
                    "(stall_<n>_<pid>.json) are written to.")
+@click.option("--forensics/--no-forensics", "forensics",
+              default=True,
+              help="Tail-latency forensics (docs/SERVING.md): the "
+                   "per-request phase ledger, histogram exemplars, "
+                   "and the anomaly sentry behind GET /anomalies. "
+                   "ON by default (<=3% contract, bench-pinned); "
+                   "--no-forensics reduces it all to attribute "
+                   "checks.")
+@click.option("--exemplar-k", default=4, type=int,
+              help="Request-ID exemplars retained per latency "
+                   "histogram bucket (OpenMetrics suffixes on "
+                   "/metrics + GET /debug/exemplars). 0 disables "
+                   "exemplars only.")
+@click.option("--forensics-dir", default=None, type=click.Path(),
+              help="Arm per-episode anomaly bundles: first "
+                   "detection per episode writes "
+                   "anomaly_<n>_<pid>.json (finding, state, the "
+                   "flagged window's exemplar records, trace tail) "
+                   "here. Unset = findings/counters only, no "
+                   "bundles.")
 @click.option("--fault-plan", "fault_plan_path", default=None,
               type=click.Path(exists=True),
               help="CHAOS TESTING: arm the deterministic seeded "
@@ -662,7 +682,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
           trace_file, profile_dir, profile_every, profile_steps,
           access_log, sanitize, sanitize_max_hold, request_history,
-          stall_timeout, stall_dir, fault_plan_path, no_supervise,
+          stall_timeout, stall_dir, forensics, exemplar_k,
+          forensics_dir, fault_plan_path, no_supervise,
           cpu):
     """Serve a zoo model over HTTP (/healthz, /info, /metrics,
     /generate, /prefill — the last registers a prompt prefix whose
@@ -875,6 +896,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          request_history=request_history,
                          stall_timeout_s=stall_timeout,
                          stall_dir=stall_dir,
+                         forensics=forensics,
+                         exemplar_k=exemplar_k,
+                         forensics_dir=forensics_dir,
                          fault_plan=fault_plan,
                          supervise=not no_supervise,
                          info={**({"int8_weights": True}
@@ -998,12 +1022,24 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
 @click.option("--slo-window", default=512, type=int,
               help="Sliding-window size (requests) the SLO burn "
                    "rates are computed over.")
+@click.option("--forensics/--no-forensics", "forensics",
+              default=True,
+              help="Router-side tail-latency forensics: the "
+                   "per-request router phase ledger (route_pick/"
+                   "replica_attempt/prefill_remote/retry_backoff), "
+                   "its anomaly sentry (GET /anomalies), and the "
+                   "fleet-merged GET /fleet/anomalies ranking.")
+@click.option("--forensics-dir", default=None, type=click.Path(),
+              help="Arm per-episode router anomaly bundles "
+                   "(anomaly_<n>_<pid>.json). Unset = findings/"
+                   "counters only.")
 def route(host, port, replicas, probe_interval, probe_timeout,
           down_after, cooldown, retry_ratio, retry_burst,
           max_attempts, request_timeout, hedge, hedge_min, affinity,
           prefix_handoff, disagg_min_tokens, rebalance_every,
           min_ready, fleet_fault_plan,
-          request_history, slo, slo_window):
+          request_history, slo, slo_window, forensics,
+          forensics_dir):
     """Run the replica ROUTER tier in front of N `ptpu serve`
     replicas (docs/SERVING.md "Fleet").
 
@@ -1047,7 +1083,9 @@ def route(host, port, replicas, probe_interval, probe_timeout,
             fleet_faults=fleet_fault_plan,
             request_history=request_history,
             slo=slo,
-            slo_window=slo_window)
+            slo_window=slo_window,
+            forensics=forensics,
+            forensics_dir=forensics_dir)
     except ValueError as e:
         raise click.ClickException(str(e))
     try:
@@ -1522,6 +1560,94 @@ def check(paths, files, params, fmt, baseline_path, update_baseline):
                    f"({len(findings) - len(new)} baselined)")
     if new:
         raise SystemExit(1)
+
+
+@cli.command()
+@click.argument("url")
+@click.option("--timeout", "timeout_s", default=5.0, type=float,
+              help="Per-request HTTP timeout (seconds).")
+@click.option("--format", "fmt", type=click.Choice(["text", "json"]),
+              default="text", help="Report output format.")
+def doctor(url, timeout_s, fmt):
+    """Tail-latency forensics for a serving endpoint: fetch the
+    anomaly-sentry findings from URL (a router — /fleet/anomalies —
+    or a single replica — /anomalies), rank phase regressions, and
+    print the exemplar request ids that resolve each one to a full
+    per-attempt timeline via GET /fleet/requests/<id>."""
+    import urllib.error
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.startswith(("http://", "https://")):
+        base = "http://" + base
+
+    def fetch(path):
+        try:
+            with urllib.request.urlopen(base + path,
+                                        timeout=timeout_s) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, None
+        except (OSError, ValueError) as e:
+            raise click.ClickException(
+                f"GET {base}{path} failed: {e}")
+
+    # Router first; a replica answers 404 there, so fall back to its
+    # own /anomalies (same findings shape, no source= attribution).
+    status, body = fetch("/fleet/anomalies")
+    source = "/fleet/anomalies"
+    if status == 404 or not isinstance(body, dict):
+        status, body = fetch("/anomalies")
+        source = "/anomalies"
+    if status != 200 or not isinstance(body, dict):
+        raise click.ClickException(
+            f"GET {base}{source} returned {status} "
+            f"(forensics disabled on the target?)")
+    if fmt == "json":
+        click.echo(json.dumps({"url": base, "source": source,
+                               **body}, indent=1))
+        return
+    findings = body.get("findings", [])
+    click.echo(f"doctor {base} ({source})")
+    for rid in body.get("fetch_errors", []):
+        click.echo(f"  warning: replica {rid} did not answer "
+                   f"/anomalies; its findings are absent", err=True)
+    share = body.get("phase_share")
+    if isinstance(share, dict) and share:
+        # Single-replica report: one flat share dict; router report:
+        # one dict per source.
+        per_source = share if all(isinstance(v, dict)
+                                  for v in share.values()) \
+            else {"self": share}
+        click.echo("phase shares (fraction of request wall time):")
+        for src in sorted(per_source):
+            shares = per_source[src]
+            ranked = sorted(shares.items(),
+                            key=lambda kv: -float(kv[1]))
+            top = ", ".join(f"{ph}={float(v):.3f}"
+                            for ph, v in ranked[:5] if float(v) > 0)
+            click.echo(f"  {src:>12}: {top or '(no traffic)'}")
+    if not findings:
+        click.echo("no anomalies: every phase within its baseline "
+                   "band (or the sentry is still building baselines)")
+        return
+    click.echo(f"{len(findings)} anomalous phase"
+               f"{'' if len(findings) == 1 else 's'}, worst first:")
+    for f in findings:
+        src = f.get("source", "self")
+        click.echo(
+            f"  [{src}] {f.get('phase')}: share "
+            f"{float(f.get('share', 0)):.3f} vs baseline "
+            f"{float(f.get('baseline_ewma', 0)):.3f} "
+            f"(band hi {float(f.get('band_hi', 0)):.3f}, score "
+            f"{float(f.get('score', 0)):.3f}, window "
+            f"{f.get('window')})")
+        for rid in f.get("exemplars", []):
+            click.echo(f"      exemplar {rid} -> GET "
+                       f"{base}/fleet/requests/{rid}")
+        if f.get("bundle"):
+            click.echo(f"      bundle {f['bundle']}")
+    raise SystemExit(1)
 
 
 @cli.group()

@@ -60,6 +60,7 @@ import numpy as np
 from ._lru import lru_get
 from .debug import SnapshotBoard, events_to_dicts, new_request_id
 from .faults import is_poisoned, is_transient
+from .forensics import compute_ledger
 from .paged import PageExhausted
 from .recovery import RetryPolicy
 from .scheduler import (AdmissionQueue, DeadlineExceeded, PRIORITIES,
@@ -303,6 +304,11 @@ class DecodeEngine:
         # admission's eventual unblock to the eviction that freed
         # capacity — (request id, why) of the most recent release.
         self.history = None
+        # ``forensics``: the server's ForensicsCore (phase
+        # accumulator + anomaly sentry, serving/forensics.py), or
+        # None — terminal paths feed it the request's phase ledger;
+        # disarmed it is one attribute check.
+        self.forensics = None
         self.debug_board = SnapshotBoard()
         self.last_boundary_t = time.perf_counter()
         self._last_page_free: Optional[Tuple] = None
@@ -588,11 +594,14 @@ class DecodeEngine:
         group.on_prefilled = on_prefilled
         group.record_timings = bool(record_timings)
         # Streams collect their span tuples when the caller asked for
-        # a ``timings`` block OR the history ring is armed — the same
-        # events back both surfaces, so a record's timeline and a
-        # live timings response can never disagree.
+        # a ``timings`` block, the history ring is armed, OR the
+        # forensics core is armed — the same events back all three
+        # surfaces, so a record's timeline, a live timings response,
+        # and the phase ledger can never disagree (a ledger computed
+        # with no events would be pure unattributed wall).
         keep_events = group.record_timings or (
-            self.history is not None and self.history.enabled)
+            self.history is not None and self.history.enabled) \
+            or self.forensics is not None
         for stream in group.streams:
             stream.sid = self.tel.new_tid()
             if keep_events:
@@ -1565,7 +1574,8 @@ class DecodeEngine:
             # control signal, docs/SERVING.md).
             stream.group.t_first_admit = stream.t_admit
             ttft = stream.t_admit - stream.group.t_submit
-            self.tel.observe("ttft_" + stream.group.priority, ttft)
+            self.tel.observe("ttft_" + stream.group.priority, ttft,
+                             exemplar=stream.group.rid)
             if stream.group.priority == "interactive":
                 self._ttft_recent.append(ttft)
         self._emit_instant(stream, "admit", stream.t_admit,
@@ -2283,10 +2293,29 @@ class DecodeEngine:
         fail); re-recording the same request ID replaces the older
         record, so double calls on shutdown races are harmless."""
         h = self.history
-        if h is None or not h.enabled or group.rid is None:
+        if group.rid is None:
             return
         t_done = group.t_done if group.t_done is not None \
             else time.perf_counter()
+        # Phase ledger (serving/forensics.py): ONE computation over
+        # the union of the group's stream events feeds the history
+        # record, the sentry, and (via the same function at the
+        # front-end) the timings block — the partition cannot drift
+        # between surfaces.  Computed whenever a consumer is armed,
+        # even with the history ring off.
+        ledger = None
+        if self.forensics is not None or (h is not None
+                                          and h.enabled):
+            all_events: list = []
+            for s in group.streams:
+                if s.events:
+                    all_events.extend(s.events)
+            ledger = compute_ledger(all_events, group.t_submit,
+                                    t_done)
+            if self.forensics is not None:
+                self.forensics.note(ledger, group.rid)
+        if h is None or not h.enabled:
+            return
         queue_s, prefill_s, decode_s = group.breakdown()
         rec: Dict[str, Any] = {
             "request_id": group.rid,
@@ -2319,6 +2348,8 @@ class DecodeEngine:
                                for s in group.streams),
                 "accepted": sum(s.spec_accepted
                                 for s in group.streams)}
+        if ledger is not None:
+            rec["phases"] = ledger
         rec["streams"] = [
             {"row": s.row,
              "tokens_out": len(s.out),

@@ -104,6 +104,7 @@ from .debug import (RequestHistory, events_to_dicts,
                     format_replica_rid, new_request_id,
                     sanitize_request_id)
 from .faults import FLEET_SITES, FaultPlan
+from .forensics import ForensicsCore, compute_router_ledger
 from .recovery import CircuitBreaker, RetryPolicy
 from .telemetry import (LATENCY_BUCKETS, Histogram,
                         parse_prometheus_families, render_histogram)
@@ -440,6 +441,14 @@ class Replica:
         # the holder-side cost gate runs on observed link truth.
         self.wire_bytes_per_s: Optional[float] = None
         self.rtt_s: Optional[float] = None
+        # Estimated host-clock skew vs the router (seconds, EWMA):
+        # replica /healthz wall-clock minus the router's midpoint
+        # wall-clock for the probe.  A host-clock ESTIMATE (error
+        # bounded by the one-way delay asymmetry), exported as
+        # ptpu_fleet_clock_skew_seconds{replica=} and used to flag
+        # stitched-timeline segments whose silent skew correction
+        # exceeds the suspect threshold.
+        self.clock_skew_s: Optional[float] = None
         self.requests_total = 0
         self.failures_total = 0
         self._out_lock = threading.Lock()
@@ -482,6 +491,17 @@ class Replica:
         else:
             a = self._EWMA_ALPHA
             self.rtt_s = a * rtt_s + (1 - a) * self.rtt_s
+
+    def note_skew_sample(self, skew_s: float) -> None:
+        """One probe's clock-skew estimate: replica /healthz ``t``
+        minus the router's probe-midpoint wall clock, folded into
+        the skew EWMA."""
+        if self.clock_skew_s is None:
+            self.clock_skew_s = skew_s
+        else:
+            a = self._EWMA_ALPHA
+            self.clock_skew_s = \
+                a * skew_s + (1 - a) * self.clock_skew_s
 
     def link_estimates(self) -> Dict[str, float]:
         """The measured-link keys a prefix hint carries (empty until
@@ -575,6 +595,8 @@ class Replica:
                 self.consecutive_probe_failures,
             **({"last_probe_s": self.last_probe_s}
                if self.last_probe_s is not None else {}),
+            **({"clock_skew_s": round(self.clock_skew_s, 6)}
+               if self.clock_skew_s is not None else {}),
             "requests_total": self.requests_total,
             "failures_total": self.failures_total,
         }
@@ -872,6 +894,11 @@ class ReplicaRouter:
                  request_history: int = 256,
                  slo=None,
                  slo_window: int = 512,
+                 forensics: bool = True,
+                 forensics_dir: Optional[str] = None,
+                 sentry_window: int = 64,
+                 sentry_baseline_windows: int = 4,
+                 clock_skew_suspect_s: float = 0.25,
                  autostart: bool = True):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -962,6 +989,23 @@ class ReplicaRouter:
         # attempt brackets, failovers, hedges, budget denials).
         # 0 disables the layer, one attribute check per request.
         self.history = RequestHistory(request_history)
+        # ROUTER-SIDE FORENSICS (serving/forensics.py): the router's
+        # own phase accumulator + anomaly sentry over its ledger
+        # phases (route pick, attempt brackets, remote prefill,
+        # retry backoff) — GET /fleet/anomalies merges its findings
+        # with every replica's GET /anomalies.
+        self.forensics: Optional[ForensicsCore] = None
+        if forensics:
+            self.forensics = ForensicsCore(
+                window=sentry_window,
+                baseline_windows=sentry_baseline_windows,
+                out_dir=forensics_dir,
+                snapshot_fn=self.stats,
+                record_fn=self.history.get)
+        # Stitched-timeline segments whose estimated replica clock
+        # skew exceeds this get flagged ``clock_skew_suspect`` —
+        # the silent anchor correction stops hiding a bad clock.
+        self.clock_skew_suspect_s = float(clock_skew_suspect_s)
         # Per-probe wall-time histogram: a slow-but-alive replica is
         # visible in rotation BEFORE it trips the hedge watermark.
         self.probe_hist = Histogram(LATENCY_BUCKETS)
@@ -1142,6 +1186,7 @@ class ReplicaRouter:
         slow-but-alive replica (a probe that takes 800ms of a 2s
         timeout is a replica already hurting, still in rotation)."""
         t0 = time.monotonic()
+        t0_wall = time.time()
         status, parsed = self._http_json(replica, "GET", "/healthz")
         dt = time.monotonic() - t0
         self.probe_hist.observe(dt)
@@ -1167,6 +1212,17 @@ class ReplicaRouter:
             role = parsed.get("role")
             if role in ("prefill", "decode", "both"):
                 replica.role = role
+            # Clock-skew ESTIMATE: the replica stamps its /healthz
+            # 200 body with its wall clock; against the router's
+            # probe-midpoint wall clock that bounds the skew to the
+            # one-way delay asymmetry.  Host clocks only — labeled
+            # an estimate everywhere it surfaces (the PR 9
+            # time-truth discipline).
+            rt = parsed.get("t")
+            if isinstance(rt, (int, float)) \
+                    and not isinstance(rt, bool):
+                replica.note_skew_sample(
+                    float(rt) - (t0_wall + dt / 2.0))
             st = replica.breaker.state
             if st == CircuitBreaker.OPEN:
                 replica.maybe_half_open()
@@ -1567,6 +1623,14 @@ class ReplicaRouter:
                                  latency_s=now - t0)
             if slo_inject and isinstance(resp, dict):
                 resp.pop("timings", None)
+            # Router-side phase ledger (serving/forensics.py): the
+            # same trace the record's timeline renders from, so the
+            # two views of one request cannot disagree.
+            ledger = None
+            if self.forensics is not None or self.history.enabled:
+                ledger = compute_router_ledger(trace, t0, now)
+                if self.forensics is not None:
+                    self.forensics.note(ledger, rid)
             if self.history.enabled:
                 status = _terminal_status(code)
                 replicas_involved: List[str] = []
@@ -1584,6 +1648,8 @@ class ReplicaRouter:
                     "replicas": replicas_involved,
                     "resume_tokens": len(partial),
                     "timeline": events_to_dicts(trace, t0),
+                    **({"phases": ledger}
+                       if ledger is not None else {}),
                 }
                 if isinstance(resp, dict):
                     if resp.get("reason"):
@@ -2051,6 +2117,22 @@ class ReplicaRouter:
                 "send_ms": att.get("send_ms"),
                 "recv_ms": att.get("recv_ms"),
             }
+            # Clock-skew annotation: the anchor correction below is
+            # applied silently; surfacing the replica's ESTIMATED
+            # skew (probe-derived, host clocks) — and flagging it
+            # past the suspect threshold — stops a bad clock from
+            # hiding behind a plausible-looking causal order.
+            rep_obj = by_id.get(replica_id)
+            if rep_obj is not None \
+                    and rep_obj.clock_skew_s is not None:
+                seg["clock_skew_est_s"] = round(
+                    rep_obj.clock_skew_s, 6)
+                # Explicit False = "checked, inside the threshold";
+                # an absent key would be ambiguous with "no probe
+                # data yet".
+                seg["clock_skew_suspect"] = \
+                    abs(rep_obj.clock_skew_s) \
+                    > self.clock_skew_suspect_s
             if last_per_replica.get(replica_id) != att["n"]:
                 # An earlier attempt on a replica a later attempt
                 # also hit: the replica's ring keeps only the latest
@@ -2074,6 +2156,13 @@ class ReplicaRouter:
                 segments.append(seg)
                 continue
             seg["record"] = body
+            # Lift the replica-computed phase ledger onto the
+            # segment VERBATIM — the per-attempt decomposition of
+            # the stitched timeline is the same bytes the replica's
+            # history record carries (the no-drift pin: one
+            # computation, serving/forensics.py).
+            if isinstance(body.get("phases"), dict):
+                seg["phases"] = body["phases"]
             seg["clamped_events"] = self._anchor_segment(
                 seg, body, merged)
             segments.append(seg)
@@ -2251,6 +2340,57 @@ class ReplicaRouter:
             if name.endswith(suffix):
                 return types.get(name[:-len(suffix)])
         return None
+
+    def fleet_anomalies(self) -> Dict[str, Any]:
+        """``GET /fleet/anomalies``: the router sentry's own findings
+        (route pick / attempt / retry-backoff phases) merged with
+        every replica's ``GET /anomalies``, ranked worst-first by
+        score (observed share over baseline EWMA).  Each finding
+        carries its ``source`` (``router`` or the replica id) and its
+        exemplar request ids — replica exemplars resolve through
+        ``GET /fleet/requests/<id>`` once prefixed back to the
+        router-visible id.  A replica that fails the fetch is listed
+        under ``fetch_errors`` and its findings are simply absent
+        (partial forensics beats a 500, same contract as
+        ``/fleet/metrics``)."""
+        replicas = list(self.replicas)
+        results: List[Optional[Tuple[Optional[int], Any]]] = \
+            [None] * len(replicas)
+
+        def fetch(i: int, replica: Replica) -> None:
+            results[i] = self._http_json(replica, "GET", "/anomalies")
+
+        threads = [threading.Thread(target=fetch, args=(i, r),
+                                    daemon=True,
+                                    name=f"fleet-anomalies-{r.id}")
+                   for i, r in enumerate(replicas)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.probe_timeout_s + 1.0)
+        findings: List[Dict[str, Any]] = []
+        phase_share: Dict[str, Dict[str, float]] = {}
+        fetch_errors: List[str] = []
+        if self.forensics is not None:
+            own = self.forensics.report()
+            for f in own.get("findings", []):
+                findings.append({"source": "router", **f})
+            phase_share["router"] = own.get("phase_share", {})
+        for replica, res in zip(replicas, results):
+            status, body = res if res is not None else (None, None)
+            if status != 200 or not isinstance(body, dict):
+                fetch_errors.append(replica.id)
+                continue
+            for f in body.get("findings", []):
+                findings.append({"source": replica.id, **f})
+            share = body.get("phase_share")
+            if isinstance(share, dict):
+                phase_share[replica.id] = share
+        findings.sort(key=lambda f: -float(f.get("score", 0.0)))
+        return {"findings": findings,
+                "phase_share": phase_share,
+                "fetch_errors": fetch_errors,
+                "replicas_polled": len(replicas)}
 
     # -- rolling restart -------------------------------------------------
 
@@ -2740,12 +2880,27 @@ class ReplicaRouter:
                 lines.append(
                     f'ptpu_router_replica_last_probe_seconds'
                     f'{{replica="{r["id"]}"}} {r["last_probe_s"]}')
+        # Estimated per-replica host-clock skew (probe-derived —
+        # an ESTIMATE, not device truth): the silent stitcher
+        # correction, made visible and alertable.
+        lines.append(
+            "# TYPE ptpu_fleet_clock_skew_seconds gauge")
+        for r in st["replicas"]:
+            if r.get("clock_skew_s") is not None:
+                lines.append(
+                    f'ptpu_fleet_clock_skew_seconds'
+                    f'{{replica="{r["id"]}"}} {r["clock_skew_s"]}')
         lines.append(
             "# TYPE ptpu_router_fleet_faults_applied_total counter")
         for site, n in sorted(st["fleet_faults_applied"].items()):
             lines.append(
                 f'ptpu_router_fleet_faults_applied_total'
                 f'{{site="{site}"}} {n}')
+        # Router-side phase forensics families
+        # (serving/forensics.py): route/attempt/backoff seconds +
+        # shares, and the router sentry's anomaly counter.
+        if self.forensics is not None:
+            lines += self.forensics.metrics_lines("ptpu_router")
         return "\n".join(lines) + "\n"
 
     def info(self) -> Dict[str, Any]:
@@ -2883,6 +3038,15 @@ def make_router_server(host: str, port: int,
                 # /metrics (replica= labels) + fleet rollups, one
                 # Prometheus scrape for the whole tier.
                 self._send_text(router.fleet_metrics_text().encode())
+            elif self.path == "/fleet/anomalies":
+                # Forensics federation: router sentry findings merged
+                # with every replica's /anomalies, ranked by score.
+                self._send(200, router.fleet_anomalies())
+            elif self.path == "/anomalies":
+                if router.forensics is None:
+                    self._send(400, {"error": "forensics disabled"})
+                else:
+                    self._send(200, router.forensics.report())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 

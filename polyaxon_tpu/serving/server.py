@@ -79,7 +79,7 @@ import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
@@ -89,6 +89,7 @@ from .debug import (RequestHistory, StallWatchdog, events_to_dicts,
                     new_request_id, sanitize_request_id)
 from .engine import DecodeEngine
 from .faults import FaultPlan, SocketReset
+from .forensics import ForensicsCore, compute_ledger
 from .legacy import RequestCoalescer
 from .paged import WirePayloadError, pack_spilled, unpack_spilled
 from .radix import RadixPrefixIndex
@@ -460,6 +461,11 @@ class ModelServer:
                  stall_timeout_s: Optional[float] = None,
                  stall_dir: str = ".",
                  stall_queue_factor: float = 4.0,
+                 forensics: bool = True,
+                 exemplar_k: int = 4,
+                 forensics_dir: Optional[str] = None,
+                 sentry_window: int = 64,
+                 sentry_baseline_windows: int = 4,
                  fault_plan=None,
                  supervise: bool = True,
                  info: Optional[Dict[str, Any]] = None):
@@ -479,7 +485,9 @@ class ModelServer:
         # disables span recording (the bench A/B's "telemetry off"
         # arm); the latency histograms stay live — they are the
         # /metrics surface.
-        self.telemetry = Telemetry(buffer=trace_buffer)
+        self.telemetry = Telemetry(
+            buffer=trace_buffer,
+            exemplar_k=(int(exemplar_k) if forensics else 0))
         # Recompile sentinel (analysis/recompile.py): ONE counter set
         # shared by the server's fused/split program LRU, the
         # engine's prefill programs, and the slot pool's step/insert
@@ -879,6 +887,29 @@ class ModelServer:
         self.history = RequestHistory(request_history)
         if self.engine is not None:
             self.engine.history = self.history
+        # TAIL-LATENCY FORENSICS (serving/forensics.py), ON by
+        # default: the phase accumulator behind the per-phase
+        # /metrics families and the anomaly sentry behind
+        # GET /anomalies.  The engine's terminal paths feed it each
+        # request's phase ledger; solo paths feed it from the
+        # handler.  ``forensics=False`` removes the whole layer (the
+        # bench's forensics_overhead off arm); ``forensics_dir``
+        # arms on-disk anomaly bundles (StallWatchdog's one-shot
+        # discipline).
+        self.forensics: Optional[ForensicsCore] = None
+        if forensics:
+            self.forensics = ForensicsCore(
+                window=sentry_window,
+                baseline_windows=sentry_baseline_windows,
+                out_dir=forensics_dir,
+                snapshot_fn=(
+                    (lambda: self.engine.build_debug_snapshot(
+                        forced=True))
+                    if self.engine is not None else None),
+                trace_tail_fn=lambda: self.telemetry.events()[-256:],
+                record_fn=self.history.get)
+            if self.engine is not None:
+                self.engine.forensics = self.forensics
         # STALL WATCHDOG (opt-in via --stall-timeout): declares a
         # stall when work exists but no step boundary completes, and
         # writes a one-shot diagnostic bundle (forced state snapshot
@@ -2787,19 +2818,44 @@ class ModelServer:
                 tokens_done = sum(len(s.out) for s in group.streams)
             else:
                 tokens_done = len(rows) * new
-            self.telemetry.observe("queue_wait", breakdown[0])
-            self.telemetry.observe("prefill", breakdown[1])
+            # Histogram KEY (telemetry.HIST_SPECS), not a ledger
+            # phase reference.  # ptpu: ignore[PHASE-ENUM]
+            self.telemetry.observe("queue_wait", breakdown[0],
+                                   exemplar=rid)
+            self.telemetry.observe("prefill", breakdown[1],
+                                   exemplar=rid)
             self.telemetry.observe(
                 "decode_per_token",
-                breakdown[2] / max(1, tokens_done))
+                breakdown[2] / max(1, tokens_done), exemplar=rid)
         # TTFT: the engine samples token 0 at admission; solo paths
         # deliver all tokens at once, so their client-visible TTFT is
         # the full latency.
         ttft = dt
         if group is not None and group.t_first_admit is not None:
             ttft = group.t_first_admit - group.t_submit
-        self.telemetry.observe("ttft", ttft)
-        self.telemetry.observe("total", dt)
+        self.telemetry.observe("ttft", ttft, exemplar=rid)
+        self.telemetry.observe("total", dt, exemplar=rid)
+        # Phase ledger (serving/forensics.py): the SAME function the
+        # engine's history record runs, over the SAME events — the
+        # timings block and GET /requests/<id> carry identical
+        # ledgers by construction.  Solo paths (no engine terminal
+        # hook) feed the forensics core from here.
+        ledger = None
+        if self.forensics is not None or want_timings:
+            if group is not None:
+                all_events: List = []
+                for s in group.streams:
+                    if s.events:
+                        all_events.extend(s.events)
+                t_done = group.t_done \
+                    if group.t_done is not None else t0 + dt
+                ledger = compute_ledger(all_events, group.t_submit,
+                                        t_done)
+            elif solo_events is not None:
+                ledger = compute_ledger(solo_events, t0, t0 + dt,
+                                        solo=True)
+                if self.forensics is not None:
+                    self.forensics.note(ledger, rid)
         timings = None
         if want_timings:
             timings = {"ttft_ms": round(1e3 * ttft, 3)}
@@ -2811,6 +2867,8 @@ class ModelServer:
                     for s in group.streams]
             elif solo_events is not None:
                 timings["spans"] = _span_dicts(solo_events, t0)
+            if ledger is not None:
+                timings["phases"] = ledger
         with self._stats_lock:
             self._lat_sum += dt
             self._lat_count += 1
@@ -3159,6 +3217,13 @@ class ModelServer:
         # spec-acceptance histogram below, so every histogram on this
         # endpoint shares one exposition path.
         lines += self.telemetry.metrics_lines()
+        # Per-phase forensics families (serving/forensics.py):
+        # cumulative seconds + wall share per ledger phase, and the
+        # sentry's anomaly counter — labeled families whose TYPE
+        # lines render unconditionally, so the fleet federation sees
+        # them before first traffic.
+        if self.forensics is not None:
+            lines += self.forensics.metrics_lines("ptpu_serving")
         if self.engine is not None:
             lines += [
                 "# TYPE ptpu_serving_slots gauge",
@@ -3583,10 +3648,15 @@ def make_handler(ms: ModelServer):
                 else:
                     # ``role`` rides the 200 body so the router's
                     # probe loop learns the fleet's prefill/decode
-                    # split without an extra /info round trip.
+                    # split without an extra /info round trip;
+                    # ``t`` (host wall clock at response build) is
+                    # the router's clock-skew ESTIMATE input — a
+                    # host-clock reading, never device truth
+                    # (docs/DESIGN.md time-truth discipline).
                     self._send(200, {"status": "ok",
                                      "model": ms.model_name,
-                                     "role": ms.role})
+                                     "role": ms.role,
+                                     "t": time.time()})
             elif self.path == "/info":
                 self._send(200, ms.info())
             elif self.path == "/metrics":
@@ -3597,6 +3667,21 @@ def make_handler(ms: ModelServer):
                 # step timeline, loadable directly in Perfetto /
                 # chrome://tracing (docs/SERVING.md).
                 self._send(200, ms.telemetry.chrome_trace())
+            elif self.path == "/anomalies":
+                # The anomaly sentry's ranked findings + baselines
+                # (serving/forensics.py; docs/SERVING.md
+                # "Tail-latency forensics").
+                if ms.forensics is None:
+                    self._send(400, {
+                        "error": "forensics disabled (start the "
+                                 "server with forensics enabled)"})
+                else:
+                    self._send(200, ms.forensics.report())
+            elif self.path == "/debug/exemplars":
+                # Per-bucket request-ID exemplars for every latency
+                # histogram — the full K retained per bucket (the
+                # /metrics exposition carries only the latest).
+                self._send(200, ms.telemetry.exemplars_report())
             elif self.path == "/profile/report":
                 # The flight recorder's parsed attribution for the
                 # most recent profiled window(s) — the same numbers

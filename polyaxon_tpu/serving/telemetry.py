@@ -42,7 +42,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Histogram", "Telemetry", "ProfileSession",
            "render_histogram", "render_compile_cache",
-           "dump_spans_jsonl",
+           "dump_spans_jsonl", "strip_exemplar",
            "parse_prometheus_text", "parse_prometheus_families",
            "LATENCY_BUCKETS", "PER_TOKEN_BUCKETS",
            "REQUESTS_PID", "ENGINE_PID"]
@@ -67,11 +67,20 @@ class Histogram:
     (``le``); observations above the last bound land in the implicit
     +Inf bucket.  ``observe`` is thread-safe and O(len(buckets)) —
     deliberately a linear scan, the ladders are short and a bisect
-    would pay more in constant factor than it saves."""
+    would pay more in constant factor than it saves.
 
-    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock")
+    ``exemplar_k > 0`` arms EXEMPLAR retention: each bucket keeps the
+    last K ``(exemplar_id, value)`` pairs that landed in it (a
+    bounded deque — eviction is oldest-first), so a p99 bucket
+    resolves to concrete request IDs instead of an aggregate.  The
+    tax when disarmed is one attribute check; armed, one deque
+    append."""
 
-    def __init__(self, buckets: Sequence[float]):
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock",
+                 "exemplar_k", "_exemplars")
+
+    def __init__(self, buckets: Sequence[float],
+                 exemplar_k: int = 0):
         b = tuple(float(x) for x in buckets)
         if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
             raise ValueError(
@@ -82,8 +91,14 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self.exemplar_k = int(exemplar_k)
+        self._exemplars: Optional[List["deque"]] = (
+            [deque(maxlen=self.exemplar_k)
+             for _ in range(len(b) + 1)]
+            if self.exemplar_k > 0 else None)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(value)
         i = 0
         for le in self.buckets:
@@ -94,6 +109,8 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if self._exemplars is not None and exemplar is not None:
+                self._exemplars[i].append((exemplar, v))
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         """(per-bucket counts incl. the +Inf overflow slot, sum,
@@ -101,24 +118,50 @@ class Histogram:
         with self._lock:
             return list(self._counts), self._sum, self._count
 
+    def exemplars(self) -> List[List[Tuple[str, float]]]:
+        """Per-bucket retained ``(exemplar_id, value)`` pairs,
+        oldest first, +Inf last; empty lists when disarmed."""
+        with self._lock:
+            if self._exemplars is None:
+                return [[] for _ in range(len(self.buckets) + 1)]
+            return [list(d) for d in self._exemplars]
+
 
 def render_histogram(name: str, buckets: Sequence[float],
                      counts: Sequence[int], total_sum,
-                     count: int) -> List[str]:
+                     count: int,
+                     exemplars: Optional[Sequence[
+                         Sequence[Tuple[str, float]]]] = None
+                     ) -> List[str]:
     """Prometheus text exposition for one histogram: ``# TYPE``,
     CUMULATIVE ``_bucket{le=...}`` lines (ascending le, ending at
     +Inf == ``_count``), then ``_sum``/``_count``.  ``counts`` is
     per-bucket (non-cumulative) with the +Inf overflow last — the
     shape :meth:`Histogram.snapshot` returns and ``engine.stats()``
-    reports, so /metrics and /info render from ONE structure."""
+    reports, so /metrics and /info render from ONE structure.
+
+    ``exemplars`` (optional, :meth:`Histogram.exemplars` shape)
+    appends an OpenMetrics exemplar — `` # {trace_id="<id>"} <v>`` —
+    to each bucket line that retained one (the most recent lands on
+    the wire; the /debug/exemplars surface serves the full K).
+    Omitted, the output is byte-identical to the pre-exemplar
+    exposition."""
+    def _ex(i: int) -> str:
+        if exemplars is None or i >= len(exemplars) \
+                or not exemplars[i]:
+            return ""
+        rid, v = exemplars[i][-1]
+        return f' # {{trace_id="{rid}"}} {round(float(v), 6)}'
+
     lines = [f"# TYPE {name} histogram"]
     cum = 0
-    for le, n in zip(buckets, counts):
+    for i, (le, n) in enumerate(zip(buckets, counts)):
         cum += n
-        lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="{le}"}} {cum}{_ex(i)}')
     if len(counts) > len(buckets):
         cum += counts[len(buckets)]
-    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}'
+                 f"{_ex(len(buckets))}")
     lines.append(f"{name}_sum {total_sum}")
     lines.append(f"{name}_count {count}")
     return lines
@@ -147,7 +190,10 @@ def render_compile_cache(snapshot: Dict[str, Any]) -> List[str]:
 # (telemetry key, prometheus metric name, bucket ladder) for the
 # serving latency histograms — ordered, so /metrics output is stable.
 HIST_SPECS = (
-    ("queue_wait", "ptpu_serving_queue_wait_seconds",
+    # Histogram KEY namespace, not a ledger phase reference: the key
+    # predates the phase enum and pins the exported metric name.
+    ("queue_wait",  # ptpu: ignore[PHASE-ENUM]
+     "ptpu_serving_queue_wait_seconds",
      LATENCY_BUCKETS),
     ("prefill", "ptpu_serving_prefill_phase_seconds",
      LATENCY_BUCKETS),
@@ -180,7 +226,7 @@ class Telemetry:
     (they are the /metrics surface, and cost one lock + add each).
     """
 
-    def __init__(self, buffer: int = 4096):
+    def __init__(self, buffer: int = 4096, exemplar_k: int = 0):
         buffer = int(buffer)
         self.enabled = buffer > 0
         self.buffer = buffer
@@ -190,8 +236,12 @@ class Telemetry:
         self._lock = threading.Lock()
         self._tids = itertools.count(1)
         self.dropped = 0           # events pushed out of a full ring
+        # exemplar_k > 0 arms per-bucket request-ID exemplars on
+        # every latency histogram (the forensics layer's knob).
+        self.exemplar_k = int(exemplar_k)
         self.hist: Dict[str, Histogram] = {
-            key: Histogram(buckets) for key, _, buckets in HIST_SPECS}
+            key: Histogram(buckets, exemplar_k=self.exemplar_k)
+            for key, _, buckets in HIST_SPECS}
 
     # -- ids / clock ----------------------------------------------------
 
@@ -233,8 +283,9 @@ class Telemetry:
         """Engine-track step record (one per decode dispatch)."""
         self.span(0, name, t0, t1, pid=ENGINE_PID, **args)
 
-    def observe(self, key: str, value: float) -> None:
-        self.hist[key].observe(value)
+    def observe(self, key: str, value: float,
+                exemplar: Optional[str] = None) -> None:
+        self.hist[key].observe(value, exemplar=exemplar)
 
     # -- export ---------------------------------------------------------
 
@@ -259,14 +310,40 @@ class Telemetry:
                    if self.dropped else {})}
 
     def metrics_lines(self) -> List[str]:
-        """Prometheus exposition for every latency histogram."""
+        """Prometheus exposition for every latency histogram (with
+        OpenMetrics exemplar suffixes when exemplars are armed)."""
         out: List[str] = []
         for key, prom_name, _ in HIST_SPECS:
             h = self.hist[key]
             counts, s, n = h.snapshot()
-            out += render_histogram(prom_name, h.buckets, counts,
-                                    round(s, 6), n)
+            out += render_histogram(
+                prom_name, h.buckets, counts, round(s, 6), n,
+                exemplars=(h.exemplars() if self.exemplar_k > 0
+                           else None))
         return out
+
+    def exemplars_report(self) -> Dict[str, Any]:
+        """The ``GET /debug/exemplars`` body: every histogram's
+        retained per-bucket ``(request id, value)`` pairs — the full
+        K per bucket, where the /metrics exposition carries only the
+        most recent."""
+        hists: Dict[str, Any] = {}
+        for key, prom_name, _ in HIST_SPECS:
+            h = self.hist[key]
+            les = [str(le) for le in h.buckets] + ["+Inf"]
+            buckets = []
+            for le, ex in zip(les, h.exemplars()):
+                if not ex:
+                    continue
+                buckets.append({
+                    "le": le,
+                    "exemplars": [
+                        {"request_id": rid,
+                         "value": round(float(v), 6)}
+                        for rid, v in ex]})
+            hists[prom_name] = {"key": key, "buckets": buckets}
+        return {"exemplar_k": self.exemplar_k,
+                "histograms": hists}
 
 
 class ProfileSession:
@@ -424,16 +501,25 @@ def dump_spans_jsonl(telemetry: Telemetry, path: str,
     return len(events)
 
 
+def strip_exemplar(line: str) -> str:
+    """Drop an OpenMetrics exemplar suffix (`` # {...} <value>``)
+    from a sample line, if present — both parsers below consume the
+    sample itself; the exemplar surface is ``/debug/exemplars``."""
+    i = line.find(" # {")
+    return line[:i] if i >= 0 else line
+
+
 def parse_prometheus_text(body: str) -> Dict[str, float]:
     """Tiny Prometheus text-format parser: ``{'name{labels}': value}``.
     Validates the line grammar strictly enough for tests (and for the
     trace_report tooling) — every non-comment line must be
-    ``name[{labels}] value`` with a float value."""
+    ``name[{labels}] value`` with a float value (an OpenMetrics
+    exemplar suffix is stripped first)."""
     out: Dict[str, float] = {}
     for lineno, line in enumerate(body.splitlines(), 1):
         if not line or line.startswith("#"):
             continue
-        name, _, value = line.rpartition(" ")
+        name, _, value = strip_exemplar(line).rpartition(" ")
         if not name or any(c.isspace() for c in name):
             raise ValueError(f"line {lineno}: malformed metric line "
                              f"{line!r}")
@@ -462,6 +548,7 @@ def parse_prometheus_families(body: str
             if len(parts) >= 4 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3]
             continue
+        line = strip_exemplar(line)
         labels = ""
         if "{" in line:
             # Label VALUES may legally contain spaces — split at the

@@ -977,6 +977,52 @@ def test_wire_verify_scoped_to_serving():
     assert _rules(src, "polyaxon_tpu/checkpoint/io.py") == []
 
 
+# -- PHASE-ENUM -------------------------------------------------------------
+
+
+def test_phase_enum_flags_literal_phase_names_in_serving():
+    """A phase-name string literal in serving/ outside forensics.py
+    is a second copy of the ledger vocabulary: rename the phase in
+    the enum and the stray literal silently keys a dict miss instead
+    of a NameError."""
+    src = """
+    def classify(led):
+        slow = led["phases"].get("queue_wait", 0.0)
+        if led["dominant"] == "preempt_gap":
+            return "preempted"
+        return "ok" if slow < 0.5 else "slow"
+    """
+    assert _rules(src) == ["PHASE-ENUM", "PHASE-ENUM"]
+
+
+def test_phase_enum_scoped_to_serving_minus_forensics():
+    """forensics.py DEFINES the enum (its literals are the source of
+    truth), and code outside serving/ never touches ledgers — both
+    out of scope.  Collision-prone span names ("prefill", "decode")
+    are deliberately not in the literal set at all."""
+    src = """
+    PHASE = "queue_wait"
+    SPAN = "prefill"
+    """
+    assert _rules(src, "polyaxon_tpu/serving/forensics.py") == []
+    assert _rules(src, "polyaxon_tpu/analysis/report.py") == []
+    assert _rules(src) == ["PHASE-ENUM"]
+
+
+def test_phase_enum_literals_track_the_live_enum():
+    """rules.py must stay import-light (no serving -> jax chain), so
+    the rule carries its own literal copy of the phase vocabulary.
+    THIS test is the sync pin: the copy must equal the live enum
+    minus the span-name collisions the rule excludes on purpose."""
+    from polyaxon_tpu.analysis.rules import PhaseEnumRule
+    from polyaxon_tpu.serving.forensics import PHASES, ROUTER_PHASES
+
+    collisions = {"prefill", "decode", "kv_handoff", "prefill_remote"}
+    live = set(PHASES) | set(ROUTER_PHASES)
+    assert collisions < live
+    assert PhaseEnumRule._PHASE_LITERALS == live - collisions
+
+
 # -- suppressions -----------------------------------------------------------
 
 

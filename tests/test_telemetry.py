@@ -28,7 +28,8 @@ from polyaxon_tpu.serving.telemetry import (ENGINE_PID, REQUESTS_PID,
                                             dump_spans_jsonl,
                                             load_trace_events,
                                             parse_prometheus_text,
-                                            render_histogram)
+                                            render_histogram,
+                                            strip_exemplar)
 
 # ---------------------------------------------------------------------------
 # histogram core
@@ -249,7 +250,10 @@ def test_metrics_histograms_and_checker(tel_server):
     metrics = parse_prometheus_text(body)   # grammar check
     families = {}
     for line in body.splitlines():
-        m = re.match(r'^(\w+)_bucket\{le="([^"]+)"\} (\d+)$', line)
+        # exemplar suffixes (forensics.py) ride bucket lines; the
+        # shared stripper recovers the bare sample for the checker
+        m = re.match(r'^(\w+)_bucket\{le="([^"]+)"\} (\d+)$',
+                     strip_exemplar(line))
         if m:
             families.setdefault(m.group(1), []).append(
                 (m.group(2), int(m.group(3))))
