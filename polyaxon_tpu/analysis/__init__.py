@@ -6,8 +6,16 @@ Three layers, one theme — the conventions PRs 1-4 wrote down in prose
 no hidden host syncs, no swallowed errors) become checked artifacts:
 
 - :mod:`rules` / :mod:`checker` — the AST linter (`ptpu check`),
-  rule families RNG-DET, LOCK-HOLD, JIT-PURITY, HOST-SYNC,
-  EXC-SWALLOW, with ``# ptpu: ignore[RULE]`` suppressions.
+  per-module rule families RNG-DET, LOCK-HOLD, JIT-PURITY, HOST-SYNC,
+  EXC-SWALLOW, ... with ``# ptpu: ignore[RULE]`` suppressions.
+- :mod:`lockgraph` / :mod:`threads` — the whole-program concurrency
+  families LOCK-ORDER (static lock-acquisition graph over a call
+  graph with held-lock propagation; cycles are potential deadlocks)
+  and THREAD-SHARE (attributes written from ≥ 2 inferred thread
+  roots with no common lock; ``# ptpu: lockfree[reason]`` sanctions
+  by-design sharing).  The committed ``lockorder.json`` is the
+  canonical lock-order DAG, and locksan's runtime edges are
+  cross-checked against the static graph in the sanitized smoke.
 - :mod:`baseline` — the committed, justified list of accepted
   findings; the tier-1 clean-check test holds the package to it.
 - :mod:`locksan` / :mod:`recompile` — runtime sanitizers for what
@@ -22,18 +30,19 @@ no hidden host syncs, no swallowed errors) become checked artifacts:
 
 from .baseline import (DEFAULT_BASELINE, apply_baseline,
                        load_baseline, save_baseline)
-from .checker import check_file, check_paths, check_source
-from .locksan import (LockHeldTooLongError, LockOrderError,
-                      LockSanitizer, SanitizedLock)
+from .checker import (PROGRAM_RULE_IDS, check_file, check_paths,
+                      check_program, check_source)
+from .locksan import (LOCK_REGISTRY, LockHeldTooLongError,
+                      LockOrderError, LockSanitizer, SanitizedLock)
 from .recompile import RecompileSentinel
 from .rules import ALL_RULES, RULE_IDS, Finding
 
 __all__ = [
-    "ALL_RULES", "RULE_IDS", "Finding",
-    "check_source", "check_file", "check_paths",
+    "ALL_RULES", "RULE_IDS", "PROGRAM_RULE_IDS", "Finding",
+    "check_source", "check_file", "check_paths", "check_program",
     "DEFAULT_BASELINE", "load_baseline", "save_baseline",
     "apply_baseline",
     "LockSanitizer", "SanitizedLock", "LockOrderError",
-    "LockHeldTooLongError",
+    "LockHeldTooLongError", "LOCK_REGISTRY",
     "RecompileSentinel",
 ]

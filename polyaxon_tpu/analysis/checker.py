@@ -1,10 +1,20 @@
-"""Run the rule families (rules.py) over source trees.
+"""Run the rule families (rules/) over source trees.
 
 One entry point for every surface: the ``ptpu check`` CLI, the tier-1
 clean-check test (tests/test_check_clean.py), and the analyzer's own
 unit tests (which feed snippets through :func:`check_source` under
 virtual paths, so path-scoped rules can be exercised without touching
 the real tree).
+
+Two kinds of analysis run here.  The per-module families
+(rules/ALL_RULES) see one file at a time.  The *program* analyses —
+LOCK-ORDER (analysis/lockgraph.py) and THREAD-SHARE
+(analysis/threads.py) — see the whole in-scope file set at once
+(:data:`lockgraph.PROGRAM_SCOPE`: serving/ plus locksan.py) and are
+run by :func:`check_paths` after the per-module pass, or directly via
+:func:`check_program` with virtual paths (the fixture tests do this).
+Their findings ride the same Finding shape, so suppression, baseline,
+text/JSON rendering, and exit semantics need no special cases.
 
 Suppression comments are extracted from the raw source, not the AST:
 ``# ptpu: ignore[RULE-A,RULE-B]`` on the flagged line or the line
@@ -21,9 +31,15 @@ import re
 from typing import Dict, Iterable, List, Sequence, Set
 
 from .rules import ALL_RULES, Finding, Rule
+from . import lockgraph as _lockgraph
+from . import threads as _threads
 
 __all__ = ["check_source", "check_file", "check_paths",
-           "iter_py_files"]
+           "check_program", "iter_py_files", "PROGRAM_RULE_IDS"]
+
+# The interprocedural families check_program arms (rules/RULE_IDS
+# covers the per-module families; the union is the full catalog).
+PROGRAM_RULE_IDS = ("LOCK-ORDER", "THREAD-SHARE")
 
 _SUPPRESS = re.compile(r"#\s*ptpu:\s*ignore\[([^\]]*)\]")
 
@@ -112,12 +128,61 @@ def iter_py_files(paths: Iterable[str]) -> List[str]:
     return out
 
 
+def check_program(sources: Dict[str, str]) -> List[Finding]:
+    """Run the whole-program analyses over the in-scope subset of
+    ``sources`` ({relpath: source}) — LOCK-ORDER then THREAD-SHARE —
+    with per-line suppressions applied.  Files outside
+    :data:`lockgraph.PROGRAM_SCOPE` and files that don't parse are
+    dropped silently (the per-module pass already reports SYNTAX)."""
+    scoped: Dict[str, str] = {}
+    for relpath, src in sources.items():
+        rp = relpath.replace(os.sep, "/")
+        if not _lockgraph.in_program_scope(rp):
+            continue
+        try:
+            ast.parse(src)
+        except SyntaxError:
+            continue
+        scoped[rp] = src
+    if not scoped:
+        return []
+    model = _lockgraph.build_model(scoped)
+    findings = _lockgraph.lock_order_findings(
+        _lockgraph.build_lock_graph(model))
+    findings += _threads.thread_share_findings(model)
+    sup_cache: Dict[str, Dict[int, Set[str]]] = {}
+    out: List[Finding] = []
+    for f in findings:
+        sup = sup_cache.get(f.path)
+        if sup is None:
+            sup = sup_cache[f.path] = _suppressions(
+                scoped.get(f.path, "").splitlines())
+        ids = sup.get(f.line, ())
+        if f.rule in ids or "*" in ids:
+            continue
+        out.append(f)
+    out.sort(key=Finding.sort_key)
+    return out
+
+
 def check_paths(paths: Iterable[str], root: str = ".",
-                rules: Sequence[Rule] = ALL_RULES) -> List[Finding]:
+                rules: Sequence[Rule] = ALL_RULES,
+                program: bool = True) -> List[Finding]:
     """Analyze every .py file under ``paths``; findings are reported
-    with paths relative to ``root`` and sorted stably."""
+    with paths relative to ``root`` and sorted stably.  The
+    whole-program families run over the in-scope subset of the same
+    file set (``program=False`` restricts to per-module rules)."""
     findings: List[Finding] = []
+    sources: Dict[str, str] = {}
+    absroot = os.path.abspath(root)
     for path in iter_py_files(paths):
-        findings.extend(check_file(path, root, rules))
+        relpath = os.path.relpath(os.path.abspath(path),
+                                  absroot).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(check_source(src, relpath, rules))
+        sources[relpath] = src
+    if program:
+        findings.extend(check_program(sources))
     findings.sort(key=Finding.sort_key)
     return findings

@@ -34,7 +34,42 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["LockOrderError", "LockHeldTooLongError", "LockSanitizer",
-           "SanitizedLock"]
+           "SanitizedLock", "LOCK_REGISTRY", "RECEIVER_TYPES"]
+
+
+# -- the serving lock registry ----------------------------------------
+#
+# The static analyzer (analysis/lockgraph.py) names a lock by its
+# declaring class (``Telemetry._lock`` and ``Replica._lock`` are
+# different locks); the runtime sanitizer names a lock by the string
+# passed to :meth:`LockSanitizer.wrap`.  This registry is the single
+# place the two vocabularies meet: static ``Class.attr`` identities
+# that alias the same underlying lock map to one canonical name — the
+# wrap name for sanitized locks, so the static graph's edges are
+# directly comparable with ``stats()["edges"]``.
+#
+# The one genuine alias today: ModelServer passes its ``_lock`` into
+# DecodeEngine as ``device_lock`` (engine.py takes ``device_lock or
+# threading.Lock()``), so acquisitions through either attribute are
+# the SAME lock and must share a node or the inversion
+# device_lock -> X -> ModelServer._lock would be invisible statically.
+LOCK_REGISTRY: Dict[str, str] = {
+    "ModelServer._lock": "device_lock",
+    "DecodeEngine.device_lock": "device_lock",
+    "ModelServer._stats_lock": "_stats_lock",
+    "ModelServer._prefix_lock": "_prefix_lock",
+}
+
+# Receiver-name conventions the static analyzer uses to type a
+# non-``self`` receiver it cannot infer from assignments — e.g. the
+# HTTP handler closure's ``ms._stats_lock`` and the legacy
+# coalescer's ``self.ms._lock``.  Conventions, not inference: keep the
+# list short and only for names used consistently across serving/.
+RECEIVER_TYPES: Dict[str, str] = {
+    "ms": "ModelServer",
+    "sentry": "AnomalySentry",
+    "engine": "DecodeEngine",
+}
 
 
 class LockOrderError(RuntimeError):

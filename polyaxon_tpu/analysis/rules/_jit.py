@@ -1,0 +1,87 @@
+"""The shared jitted-body collector: JIT-PURITY and JIT-DEADLINE
+both consume it, so the two rules can never disagree about what
+"inside a jitted program" means."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._base import dotted_name
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _collect_jitted(tree: ast.Module):
+    """Every jit-wrapped body in a module: decorated defs,
+    ``jax.jit(lambda ...)``, and ``jax.jit(fn_name)`` with the name
+    resolved LEXICALLY (scope chain from the call site — without
+    this, ``jax.jit(step)`` inside a builder method resolves to an
+    unrelated same-named METHOD elsewhere in the module and flags
+    code that never traces).  Returns ``(jitted_bodies, jit_calls)``:
+    ``jitted_bodies`` is ``[(body node, label)]`` deduplicated,
+    ``jit_calls`` is ``[(jit Call node, resolved def or None)]`` for
+    call-site checks (static_argnums hashability).  Shared by
+    JIT-PURITY and JIT-DEADLINE so the two rules can never disagree
+    about what "inside a jitted program" means."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for p in ast.walk(tree):
+        for c in ast.iter_child_nodes(p):
+            parents[c] = p
+    scopes: Dict[ast.AST, Dict[str, ast.FunctionDef]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            s = parents.get(n)
+            while s is not None and not isinstance(
+                    s, (ast.Module, ast.FunctionDef,
+                        ast.AsyncFunctionDef, ast.ClassDef)):
+                s = parents.get(s)
+            scopes.setdefault(s, {})[n.name] = n
+
+    def resolve(call: ast.AST, name: str):
+        """Innermost def named ``name`` visible from ``call``."""
+        s = parents.get(call)
+        while s is not None:
+            if isinstance(s, (ast.Module, ast.FunctionDef,
+                              ast.AsyncFunctionDef, ast.ClassDef)):
+                d = scopes.get(s, {}).get(name)
+                if d is not None:
+                    return d
+            s = parents.get(s)
+        return None
+
+    jitted_bodies: List[Tuple[ast.AST, str]] = []
+    jit_calls: List[Tuple[ast.Call, Optional[ast.FunctionDef]]] = []
+    seen: Set[int] = set()
+
+    def add(node, label):
+        if id(node) not in seen:
+            seen.add(id(node))
+            jitted_bodies.append((node, label))
+
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if _is_jax_jit(dec):
+                    add(n, n.name)
+                elif isinstance(dec, ast.Call) and (
+                        _is_jax_jit(dec.func)
+                        or (dotted_name(dec.func) or "").endswith(
+                            "partial")
+                        and dec.args
+                        and _is_jax_jit(dec.args[0])):
+                    add(n, n.name)
+        elif isinstance(n, ast.Call) and _is_jax_jit(n.func):
+            fn = None
+            if n.args:
+                target = n.args[0]
+                if isinstance(target, ast.Lambda):
+                    add(target, "<lambda>")
+                elif isinstance(target, ast.Name):
+                    fn = resolve(n, target.id)
+                    if fn is not None:
+                        add(fn, target.id)
+            jit_calls.append((n, fn))
+    return jitted_bodies, jit_calls

@@ -1,0 +1,73 @@
+"""HOST-SYNC: no implicit device->host syncs in the decode hot path."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ._base import Finding, Rule, _ScopedVisitor, _src_line, \
+    dotted_name
+
+
+_JAX_ROOTS = ("jax", "jnp", "jrandom")
+
+_HOT_PATHS = ("serving/engine.py", "serving/slots.py")
+
+
+def _is_jax_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    root = name.split(".", 1)[0]
+    return root in _JAX_ROOTS and not name.endswith("device_get")
+
+
+class HostSyncRule(Rule):
+    """No implicit device->host syncs in the decode hot path.
+
+    ``np.asarray``/``np.array``/``float``/``int`` applied directly to
+    a jax-producing call, and ``.tolist()``/``.item()``, each hide a
+    ``block_until_ready`` — the decode loop stalls on device work the
+    author never sees.  The sanctioned spelling is explicit:
+    ``np.asarray(jax.device_get(x))``.  Scoped to the engine step /
+    decode modules (serving/engine.py, serving/slots.py) where one
+    stray sync costs every resident stream a step."""
+
+    id = "HOST-SYNC"
+
+    def applies_to(self, relpath: str) -> bool:
+        return any(relpath.endswith(p) for p in _HOT_PATHS)
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def visit_Call(self, node):
+                name = dotted_name(node.func) or ""
+                tail = name.rsplit(".", 1)[-1]
+                if name in ("np.asarray", "np.array", "float",
+                            "int") and node.args and \
+                        _is_jax_call(node.args[0]):
+                    findings.append(Finding(
+                        rule.id, relpath, node.lineno, self.func,
+                        _src_line(lines, node.lineno),
+                        f"{name}() directly on a jax call is an "
+                        f"implicit device->host sync in the decode "
+                        f"hot path; spell it jax.device_get(...) so "
+                        f"the sync is visible"))
+                elif tail in ("tolist", "item") and \
+                        isinstance(node.func, ast.Attribute) and \
+                        not node.args:
+                    findings.append(Finding(
+                        rule.id, relpath, node.lineno, self.func,
+                        _src_line(lines, node.lineno),
+                        f".{tail}() in the decode hot path is an "
+                        f"implicit device->host sync; device_get "
+                        f"once, index on the host"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return findings
+
+RULES = (HostSyncRule(),)

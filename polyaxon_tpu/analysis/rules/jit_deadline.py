@@ -1,0 +1,51 @@
+"""JIT-DEADLINE: lifecycle control stays host-side — no ``time.*``
+calls at all inside jitted programs."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ._base import Finding, Rule, _src_line, dotted_name
+from ._jit import _collect_jitted
+
+
+class DeadlineInJitRule(Rule):
+    """Lifecycle control stays HOST-SIDE: no ``time.*`` deadline math
+    inside a jit-wrapped step program.
+
+    The request-lifecycle layer (serving/engine.py sweep) delivers
+    cancellation, deadline expiry, and preemption at step boundaries
+    by comparing host wall-clock against per-group deadlines.  Any
+    ``time.*`` call inside a jitted function — not just the clocks
+    JIT-PURITY flags, but ALL of the module (``time_ns``,
+    ``monotonic_ns``, ``sleep``, ``strftime`` ...) — executes once at
+    trace time and freezes into the compiled program: a deadline
+    comparison there would evaluate exactly once and never fire
+    again, silently turning "evict at the boundary" into "immortal".
+    This is the Podracer decoupled-dataflow discipline
+    (arXiv:2104.06272): scheduling decisions on the host, pure math
+    on the device."""
+
+    id = "JIT-DEADLINE"
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        jitted_bodies, _ = _collect_jitted(tree)
+        for body, label in jitted_bodies:
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name.startswith("time."):
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno, label,
+                        _src_line(lines, node.lineno),
+                        f"{name}() inside a jitted program: deadline/"
+                        f"lifecycle math is host-side scheduling — "
+                        f"it freezes at trace time in a compiled "
+                        f"step, so a deadline check here would "
+                        f"evaluate once and never fire again"))
+        return findings
+
+RULES = (DeadlineInJitRule(),)

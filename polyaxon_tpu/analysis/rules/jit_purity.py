@@ -1,0 +1,94 @@
+"""JIT-PURITY: no trace-time-frozen impurity inside jitted functions."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from ._base import Finding, Rule, _src_line, dotted_name
+from ._jit import _collect_jitted
+
+
+_IMPURE_CALLS = re.compile(
+    r"^(time\.(time|perf_counter|monotonic)"
+    r"|np\.random\.\w+|numpy\.random\.\w+"
+    r"|random\.\w+)$")
+
+
+class JitPurityRule(Rule):
+    """No trace-time impurity inside jitted functions.
+
+    A ``jax.jit``-wrapped function's Python body runs ONCE, at trace
+    time: ``time.time()`` / ``np.random.*`` / stdlib ``random.*``
+    results are baked into the compiled program as constants, and
+    ``global`` writes happen once per compile, not per call — all
+    silent wrong-answer bugs.  Also checks that
+    ``static_argnums``/``static_argnames`` targets are hashable by
+    construction (an unhashable static arg fails at call time, far
+    from the jit site): a targeted parameter whose default is a
+    list/dict/set literal is flagged."""
+
+    id = "JIT-PURITY"
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        jitted_bodies, jit_calls = _collect_jitted(tree)
+        for call, fn in jit_calls:
+            self._check_static_args(call, fn, lines, relpath,
+                                    findings)
+
+        for body, label in jitted_bodies:
+            for node in ast.walk(body):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func) or ""
+                    if _IMPURE_CALLS.match(name) and \
+                            not name.startswith(("jax.random.",
+                                                 "jrandom.")):
+                        findings.append(Finding(
+                            self.id, relpath, node.lineno, label,
+                            _src_line(lines, node.lineno),
+                            f"{name}() inside a jitted function runs "
+                            f"once at TRACE time and is baked into "
+                            f"the program as a constant"))
+                elif isinstance(node, ast.Global):
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno, label,
+                        _src_line(lines, node.lineno),
+                        "global mutation inside a jitted function "
+                        "happens once per compile, not per call"))
+        return findings
+
+    def _check_static_args(self, call: ast.Call, fn, lines,
+                           relpath, findings) -> None:
+        if fn is None:
+            return
+        params = [a.arg for a in fn.args.args]
+        defaults = dict(zip(params[len(params)
+                                   - len(fn.args.defaults):],
+                            fn.args.defaults))
+        marked: List[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        marked.append(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int) and \
+                            el.value < len(params):
+                        marked.append(params[el.value])
+        for pname in marked:
+            default = defaults.get(pname)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                findings.append(Finding(
+                    self.id, relpath, call.lineno, fn.name,
+                    _src_line(lines, call.lineno),
+                    f"static arg {pname!r} defaults to an unhashable "
+                    f"{type(default).__name__.lower()} literal — "
+                    f"static_argnums/static_argnames targets must be "
+                    f"hashable by construction"))
+
+RULES = (JitPurityRule(),)

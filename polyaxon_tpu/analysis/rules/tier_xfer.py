@@ -1,0 +1,90 @@
+"""TIER-XFER: tiered-KV device<->host transfer discipline."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ._base import Finding, Rule, _ScopedVisitor, _in_serving, \
+    _src_line, dotted_name
+
+
+# Identifier shapes that name page-pool payload state: the pools
+# themselves (_pool/_draft_pool/pool), page-id collections
+# (pages/page_tables/shared_pages), and page-granular leaves.
+_TIER_NAMES = re.compile(
+    r"(^|_)(pages?|pools?)($|_)|page_table")
+
+# The sanctioned tiered-memory helpers (serving/paged.py): the ONLY
+# functions allowed to move page-pool payloads across the
+# device<->host boundary.  Matched against the innermost enclosing
+# function name.
+_TIER_SANCTIONED = {"spill_pages", "rematerialize", "materialize",
+                    "_alloc_pool", "scatter_cache"}
+
+
+class TierXferRule(Rule):
+    """Tiered-KV transfer discipline (serving/paged.py host tier).
+
+    The two-tier prefix store moves page payloads device->host only
+    through ``spill_pages`` (page-pressure reclaim) and host->device
+    only through ``rematerialize``/``scatter_cache`` (prefix-hit
+    admission / promotion) — both OFF the decode step path.  A stray
+    ``jax.device_put``/``jax.device_get`` whose operand touches
+    pool/page state is a page-sized PCIe transfer on whatever path it
+    sits; on the step path it is a silent TTFT cliff (and on a mesh,
+    an uncommitted placement on top — see SHARD-LEAK).  Flagged in
+    serving/ outside the sanctioned helper set."""
+
+    id = "TIER-XFER"
+
+    def applies_to(self, relpath: str) -> bool:
+        return _in_serving(relpath)
+
+    @staticmethod
+    def _touches_pool(node: ast.AST) -> Optional[str]:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) \
+                    and _TIER_NAMES.search(n.attr):
+                return n.attr
+            if isinstance(n, ast.Name) \
+                    and _TIER_NAMES.search(n.id):
+                return n.id
+        return None
+
+    def check(self, tree, lines, relpath):
+        findings: List[Finding] = []
+        rule = self
+
+        class V(_ScopedVisitor):
+            def visit_Call(self, node):
+                name = dotted_name(node.func) or ""
+                tail = name.rsplit(".", 1)[-1]
+                if tail in ("device_put", "device_get"):
+                    inner = self._stack[-1] if self._stack else ""
+                    if inner not in _TIER_SANCTIONED:
+                        for arg in list(node.args) + [
+                                kw.value for kw in node.keywords]:
+                            hit = rule._touches_pool(arg)
+                            if hit:
+                                findings.append(Finding(
+                                    rule.id, relpath, node.lineno,
+                                    self.func,
+                                    _src_line(lines, node.lineno),
+                                    f"{tail} of page-pool payload "
+                                    f"({hit}) outside the sanctioned "
+                                    f"tiered-memory helpers "
+                                    f"({', '.join(sorted(_TIER_SANCTIONED))})"
+                                    f": page-sized device<->host "
+                                    f"transfers belong to the spill/"
+                                    f"re-materialize tier — on the "
+                                    f"step path this is a silent "
+                                    f"TTFT cliff"))
+                                break
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return findings
+
+RULES = (TierXferRule(),)

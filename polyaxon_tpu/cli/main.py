@@ -612,6 +612,13 @@ def generate(model_name, prompt, max_new_tokens, temperature, top_k,
 @click.option("--sanitize-max-hold", default=None, type=float,
               help="With --sanitize: flag device_lock holds longer "
                    "than this many seconds (unset = no hold limit).")
+@click.option("--sanitize-report", "sanitize_report", default=None,
+              type=click.Path(),
+              help="With --sanitize: write the observed lock "
+                   "acquisition graph (the same dict /info reports) "
+                   "to this JSON file at shutdown — the offline "
+                   "input to the static-vs-runtime lock-graph "
+                   "cross-check (docs/ANALYSIS.md).")
 @click.option("--request-history", default=256, type=int,
               help="Terminal request-record retention ring behind "
                    "GET /requests/<id>: per-request causal timelines "
@@ -681,7 +688,8 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
           batch_queue_deadline_ms, slo_ttft_ms, request_timeout,
           draft_model, draft_checkpoint, spec_k, trace_buffer,
           trace_file, profile_dir, profile_every, profile_steps,
-          access_log, sanitize, sanitize_max_hold, request_history,
+          access_log, sanitize, sanitize_max_hold, sanitize_report,
+          request_history,
           stall_timeout, stall_dir, forensics, exemplar_k,
           forensics_dir, fault_plan_path, no_supervise,
           cpu):
@@ -745,6 +753,9 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
     if sanitize_max_hold is not None and not sanitize:
         raise click.ClickException(
             "--sanitize-max-hold requires --sanitize")
+    if sanitize_report is not None and not sanitize:
+        raise click.ClickException(
+            "--sanitize-report requires --sanitize")
     if request_history < 0:
         raise click.ClickException("--request-history must be >= 0")
     if stall_timeout is not None and stall_timeout <= 0:
@@ -893,6 +904,7 @@ def serve(model_name, host, port, checkpoint, int8_weights, int8_kv,
                          access_log=access_log,
                          sanitize=sanitize,
                          sanitize_max_hold_s=sanitize_max_hold,
+                         sanitize_report=sanitize_report,
                          request_history=request_history,
                          stall_timeout_s=stall_timeout,
                          stall_dir=stall_dir,
@@ -1468,15 +1480,39 @@ def _restart(run_uuid: str, copy_artifacts: bool, resume: bool):
               help="Rewrite the baseline from the current findings "
                    "(stable sort; justifications preserved, new "
                    "entries get a TODO placeholder to fill in).")
-def check(paths, files, params, fmt, baseline_path, update_baseline):
+@click.option("--changed", "changed_ref", is_flag=False,
+              flag_value="HEAD", metavar="[REF]",
+              # No `default=`: click only treats the value as optional
+              # (bare `--changed` -> flag_value) when the default is
+              # left UNSET; passing default=None re-arms the
+              # requires-an-argument parse.  The resolved default is
+              # still None.
+              help="Incremental mode: lint only files changed vs a "
+                   "git ref (default HEAD), plus untracked files — "
+                   "identical findings/exit semantics to a full run "
+                   "on those files.  Fast enough for a pre-commit "
+                   "hook.  Use --changed=REF when followed by PATHS "
+                   "(a bare ref would swallow the next argument).")
+@click.option("--dump-lock-graph", "lock_graph_path", default=None,
+              type=click.Path(),
+              help="Write the canonical static lock-order graph "
+                   "(the committed analysis/lockorder.json artifact) "
+                   "to this path and exit.")
+def check(paths, files, params, fmt, baseline_path, update_baseline,
+          changed_ref, lock_graph_path):
     """Validate a polyaxonfile (-f), or run the JAX-aware static
     analyzer over PATHS (default: polyaxon_tpu/).
 
     The analyzer machine-checks the serving stack's own invariants —
-    rule families RNG-DET, LOCK-HOLD, JIT-PURITY, HOST-SYNC,
-    EXC-SWALLOW (docs/ANALYSIS.md has the catalog).  Exit status is
+    per-module rule families RNG-DET, LOCK-HOLD, JIT-PURITY,
+    HOST-SYNC, EXC-SWALLOW, ... plus the whole-program concurrency
+    families LOCK-ORDER (static lock-acquisition-graph cycles =
+    potential deadlocks, with witness paths) and THREAD-SHARE
+    (attributes written from several thread roots with no common
+    lock) — docs/ANALYSIS.md has the catalog.  Exit status is
     non-zero when findings exist beyond the committed baseline;
-    suppress locally-justified findings with `# ptpu: ignore[RULE]`,
+    suppress locally-justified findings with `# ptpu: ignore[RULE]`
+    (or `# ptpu: lockfree[reason]` for by-design lock-free sharing),
     baseline historically-justified ones with --update-baseline plus
     a written justification.
     """
@@ -1519,6 +1555,60 @@ def check(paths, files, params, fmt, baseline_path, update_baseline):
     for p in target:
         if not os.path.exists(p):
             raise click.ClickException(f"no such path: {p}")
+
+    if lock_graph_path is not None:
+        from polyaxon_tpu.analysis import lockgraph as _lockgraph
+
+        sources = {}
+        for p in iter_py_files(target):
+            rel = os.path.relpath(os.path.abspath(p), root).replace(
+                os.sep, "/")
+            if _lockgraph.in_program_scope(rel):
+                with open(p, encoding="utf-8") as fh:
+                    sources[rel] = fh.read()
+        graph = _lockgraph.build_lock_graph(
+            _lockgraph.build_model(sources))
+        with open(lock_graph_path, "w", encoding="utf-8") as fh:
+            json.dump(_lockgraph.canonical_graph(graph), fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+        click.echo(f"wrote {len(graph.edges)} lock-order edges to "
+                   f"{lock_graph_path}")
+        return
+
+    if changed_ref is not None:
+        # Incremental mode: the checked file set becomes "changed vs
+        # REF (plus untracked)" intersected with the target paths.
+        # Everything downstream — per-module rules, the program
+        # families over the in-scope subset, baseline, exit status —
+        # is exactly a full run on those files.
+        import subprocess
+
+        def _git(*args):
+            return subprocess.run(["git", *args], cwd=root,
+                                  capture_output=True, text=True)
+
+        diff = _git("diff", "--name-only", changed_ref, "--", "*.py")
+        if diff.returncode != 0:
+            raise click.ClickException(
+                f"git diff vs {changed_ref!r} failed: "
+                f"{diff.stderr.strip() or diff.stdout.strip()}")
+        names = set(diff.stdout.split())
+        untracked = _git("ls-files", "--others", "--exclude-standard",
+                         "--", "*.py")
+        if untracked.returncode == 0:
+            names.update(untracked.stdout.split())
+        roots_abs = [os.path.abspath(t) for t in target]
+        target = []
+        for name in sorted(names):
+            p = os.path.join(root, name)
+            if not (name.endswith(".py") and os.path.isfile(p)):
+                continue            # deleted files have no findings
+            ap = os.path.abspath(p)
+            if any(ap == t or ap.startswith(t + os.sep)
+                   for t in roots_abs):
+                target.append(p)
+
     baseline_path = baseline_path or DEFAULT_BASELINE
     findings = check_paths(target, root=root)
     if update_baseline:

@@ -619,6 +619,7 @@ class DecodeEngine:
         # into an already-busy (possibly wedged) engine must NOT
         # keep resetting staleness.
         if not self._resident and len(self.queue) == 0:
+            # ptpu: lockfree[monotonic staleness stamp: torn/lost stamps only shift stall detection by one tick]
             self.last_boundary_t = time.perf_counter()
         # Queue-entry instant: the FIRST trace event a request owns,
         # so even one that never reaches admission (wedged engine,
@@ -674,6 +675,7 @@ class DecodeEngine:
         # flag is guaranteed to see the cancel_error too.  Then wake
         # an idle loop so delivery doesn't wait out the idle sleep;
         # manual-tick owners just call tick().
+        # ptpu: lockfree[handoff flag: writers only store True, the engine sweep clears; next boundary re-reads]
         self._cancel_pending = True
         with self._wake:
             self._wake.notify()
@@ -1352,6 +1354,7 @@ class DecodeEngine:
                 self.faults.check("telemetry")
             self.tel.span(stream.sid or 0, name, t0, t1, **args)
         except Exception:
+            # ptpu: lockfree[best-effort drop counter: a lost increment under-counts a diagnostic, nothing else]
             self.telemetry_errors_total += 1
         if stream.events is not None:
             stream.events.append((name, t0, t1, args))

@@ -261,8 +261,14 @@ class FlightRecorder:
                 # never escape into the engine tick — it would fail
                 # every in-flight request, every N dispatches.
                 # Record it and retry at the next cadence (the
-                # volume may come back).
-                self.last_error = f"start: {type(e).__name__}: {e}"
+                # volume may come back).  last_error is elsewhere
+                # written (and always read) under _lock by the
+                # analyzer thread — this engine-thread write must
+                # agree on the lock or it can vanish under a
+                # concurrent _analyze success-clear.
+                with self._lock:
+                    self.last_error = \
+                        f"start: {type(e).__name__}: {e}"
                 self.windows_skipped += 1
                 self._since = 0
                 return

@@ -466,6 +466,7 @@ class Replica:
 
     _EWMA_ALPHA = 0.3
 
+    # ptpu: lockfree[advisory EWMA gauge: a lost fold costs one sample of calibration accuracy, never correctness]
     def note_link_sample(self, nbytes: int, wall_s: float) -> None:
         """One completed transfer FROM this replica (wire fetch or
         handoff push): fold its observed bytes/s into the link EWMA.
@@ -527,6 +528,7 @@ class Replica:
         rolling restart's min-ready accounting."""
         return self.eligible()
 
+    # ptpu: lockfree[advisory failure stats: the breaker serializes real state under its own lock; these feed metrics]
     def note_failure(self, now: Optional[float] = None) -> None:
         """Transport-level evidence against this replica (probe or
         live request): feeds the breaker."""

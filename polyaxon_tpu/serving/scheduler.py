@@ -441,6 +441,7 @@ class Stream:
     def p_len(self) -> int:
         return self.toks.shape[1]
 
+    # ptpu: lockfree[single owner: a preempted stream is operated on by exactly one thread, ownership moves through locked queues]
     def prepare_resume(self, pieces: List[int]) -> None:
         """Reset this PREEMPTED stream for re-prefill + re-admission
         with its generated-so-far prefix, so no token is resampled.
@@ -574,6 +575,7 @@ class RequestGroup:
         request_cancel is only guaranteed delivery when something
         else (a deadline, a queue deadline) keeps the sweep on."""
         if self.cancel_error is None and not self.event.is_set():
+            # ptpu: lockfree[single reference store read by the engine sweep; first-wins race is acceptable by contract]
             self.cancel_error = err
 
     def status_phase(self) -> str:

@@ -457,6 +457,7 @@ class ModelServer:
                  access_log: bool = False,
                  sanitize: bool = False,
                  sanitize_max_hold_s: Optional[float] = None,
+                 sanitize_report: Optional[str] = None,
                  request_history: int = 256,
                  stall_timeout_s: Optional[float] = None,
                  stall_dir: str = ".",
@@ -511,6 +512,13 @@ class ModelServer:
             self.sanitizer = LockSanitizer(
                 max_hold_s={"device_lock": sanitize_max_hold_s}
                 if sanitize_max_hold_s is not None else None)
+        # Machine-readable dump of the observed acquisition graph
+        # (the same dict /info reports), written at close() — the
+        # offline half of the static ⊆ runtime lock-graph
+        # cross-check (analysis/lockgraph.py).
+        self.sanitize_report = sanitize_report
+        if sanitize_report is not None and self.sanitizer is None:
+            raise ValueError("sanitize_report requires sanitize=True")
         # POST /profile/start|stop (single-flight jax.profiler wrap);
         # None keeps the endpoints disabled — profiling writes device
         # traces to disk, so it must be an explicit operator opt-in.
@@ -956,6 +964,16 @@ class ModelServer:
             self.recorder.close()
         if self.profiler is not None:
             self.profiler.close()
+        if self.sanitizer is not None \
+                and self.sanitize_report is not None:
+            # Written LAST: the engine drain above is the final
+            # source of acquisitions, so the dump is the complete
+            # observed graph for this server's lifetime.
+            with open(self.sanitize_report, "w",
+                      encoding="utf-8") as fh:
+                json.dump(self.sanitizer.stats(), fh, indent=1,
+                          sort_keys=True)
+                fh.write("\n")
 
     def _exact(self):
         """Serving-exact trace context for the server's own device

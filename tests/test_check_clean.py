@@ -2,10 +2,12 @@
 report NOTHING beyond the committed baseline.
 
 This is the enforcement half of the analysis subsystem: the rule
-families in polyaxon_tpu/analysis/rules.py machine-check the serving
+families in polyaxon_tpu/analysis/rules/ machine-check the serving
 stack's written contracts (position-keyed RNG, lock discipline,
-jit purity, explicit host syncs, no swallowed errors), and this test
-holds every future diff to them.  A new finding means: fix it,
+jit purity, explicit host syncs, no swallowed errors), the
+whole-program families (lockgraph.py / threads.py) machine-check its
+lock ordering and cross-thread sharing, and this test holds every
+future diff to them.  A new finding means: fix it,
 suppress it inline with a local justification
 (``# ptpu: ignore[RULE]``), or add a baseline entry with a written
 justification (``ptpu check --update-baseline``, then REPLACE the
@@ -57,8 +59,9 @@ def test_baseline_entries_are_justified():
 def test_no_findings_escape_rule_scoping():
     """The committed baseline only carries rules the catalog defines
     (a typo'd rule id in the baseline would silently never match)."""
-    from polyaxon_tpu.analysis import RULE_IDS
+    from polyaxon_tpu.analysis import PROGRAM_RULE_IDS, RULE_IDS
 
     entries = load_baseline(DEFAULT_BASELINE)
-    unknown = {e["rule"] for e in entries} - set(RULE_IDS)
+    unknown = ({e["rule"] for e in entries}
+               - set(RULE_IDS) - set(PROGRAM_RULE_IDS))
     assert not unknown, f"baseline references unknown rules: {unknown}"

@@ -992,15 +992,23 @@ def test_debug_state_and_stall_bundle_carry_supervisor_state(
 # ---------------------------------------------------------------------------
 
 
-def test_crash_recovery_smoke_sanitized(small_model):
+def test_crash_recovery_smoke_sanitized(small_model, tmp_path):
     """The acceptance smoke: a sanitized server survives one
     injected engine crash mid-burst — every caller reaches a
     terminal status with reference tokens, the engine restarts
-    exactly once, and teardown is lock-sanitizer quiet."""
+    exactly once, and teardown is lock-sanitizer quiet.
+
+    The same run doubles as the static-vs-runtime lock-graph
+    cross-check (analysis/lockgraph.py): every acquisition edge the
+    sanitizer OBSERVED here must exist in the static graph built
+    from the live sources — a runtime edge the analyzer can't see is
+    an analyzer blind spot, and fails the suite."""
+    report = tmp_path / "locksan.json"
     model, variables = small_model
     ms = ModelServer(model, variables, model_name="tiny",
                      max_batch=8, n_slots=4, queue_depth=32,
                      sanitize=True,
+                     sanitize_report=str(report),
                      fault_plan={"seed": 6, "faults": [
                          {"site": "engine_death", "after": 4,
                           "times": 1}]})
@@ -1037,3 +1045,68 @@ def test_crash_recovery_smoke_sanitized(small_model):
         ms.close()
     assert ms.sanitizer is not None and not ms.sanitizer.violations, \
         f"lock sanitizer violations: {ms.sanitizer.violations}"
+
+    # --sanitize-report wrote the observed acquisition graph (the
+    # same dict /info reports) at close()
+    doc = json.loads(report.read_text())
+    assert doc["violations"] == []
+    assert doc["acquisitions"] > 0
+    assert doc == ms.sanitizer.stats()
+
+    # static-vs-runtime cross-check: observed edges ⊆ static graph.
+    # The continuous engine never NESTS the three wrapped locks, so
+    # the burst above alone would make the subset check vacuous; the
+    # legacy coalescer path does nest (device_lock -> _stats_lock in
+    # RequestCoalescer._execute_batch), so run one sanitized request
+    # through it to guarantee at least one observed edge.
+    ms2 = ModelServer(model, variables, model_name="tiny",
+                      batching="coalesce", sanitize=True)
+    try:
+        ms2.generate({"prompt": PROBE[0].tolist(),
+                      "max_new_tokens": 4})
+    finally:
+        ms2.close()
+    observed = set(doc["edges"]) | set(ms2.sanitizer.stats()["edges"])
+    assert observed, "cross-check vacuous: no runtime edges observed"
+
+    import os
+
+    import polyaxon_tpu
+    from polyaxon_tpu.analysis import lockgraph
+    from polyaxon_tpu.analysis.checker import iter_py_files
+
+    pkg = os.path.dirname(os.path.abspath(polyaxon_tpu.__file__))
+    root = os.path.dirname(pkg)
+    sources = {}
+    for p in iter_py_files([pkg]):
+        rel = os.path.relpath(os.path.abspath(p), root).replace(
+            os.sep, "/")
+        if lockgraph.in_program_scope(rel):
+            with open(p, encoding="utf-8") as fh:
+                sources[rel] = fh.read()
+    static = lockgraph.build_lock_graph(
+        lockgraph.build_model(sources)).edge_names()
+    missing = sorted(observed - static)
+    assert not missing, (
+        "lock-acquisition edges observed at runtime but ABSENT from "
+        f"the static graph (analyzer blind spot): {missing}; "
+        f"static graph has {sorted(static)}")
+
+
+def test_sanitize_report_requires_sanitize(small_model, tmp_path):
+    """Fail-fast on both surfaces: the constructor rejects a report
+    path with no sanitizer to fill it, and `ptpu serve` rejects the
+    flag combination before paying the model build."""
+    model, variables = small_model
+    with pytest.raises(ValueError, match="requires sanitize"):
+        ModelServer(model, variables,
+                    sanitize_report=str(tmp_path / "x.json"))
+
+    from click.testing import CliRunner
+
+    from polyaxon_tpu.cli.main import cli
+
+    res = CliRunner().invoke(cli, ["serve", "--model", "gpt2",
+                                   "--sanitize-report", "x.json"])
+    assert res.exit_code != 0
+    assert "--sanitize-report requires --sanitize" in res.output
